@@ -1,0 +1,4 @@
+from paddle_tpu.core import dtypes
+from paddle_tpu.core.sequence import SequenceBatch
+
+__all__ = ["dtypes", "SequenceBatch"]

@@ -1,0 +1,44 @@
+"""dtype policy.
+
+The reference compiles with ``real`` = float or double (WITH_DOUBLE,
+cmake flag; SURVEY.md §2.10).  On TPU the equivalent policy is: parameters
+and optimizer state in float32, matmul/conv compute in bfloat16 (MXU-native),
+reductions/softmax in float32.
+"""
+
+import jax.numpy as jnp
+
+_param_dtype = jnp.float32
+_compute_dtype = jnp.bfloat16
+
+_NAMES = {
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
+
+
+def set_policy(param_dtype="float32", compute_dtype="bfloat16"):
+    global _param_dtype, _compute_dtype
+    _param_dtype = _NAMES[str(param_dtype)] if isinstance(param_dtype, str) else param_dtype
+    _compute_dtype = _NAMES[str(compute_dtype)] if isinstance(compute_dtype, str) else compute_dtype
+
+
+def param_dtype():
+    return _param_dtype
+
+
+def compute_dtype():
+    return _compute_dtype
+
+
+def to_compute(x):
+    """Cast activations to the compute dtype (bf16 on the MXU path)."""
+    if x.dtype in (jnp.float32, jnp.float64, jnp.bfloat16, jnp.float16):
+        return x.astype(_compute_dtype)
+    return x
+
+
+def to_param(x):
+    return x.astype(_param_dtype)

@@ -1,0 +1,131 @@
+"""Ragged sequence batches, TPU-style.
+
+The reference represents variable-length batches padding-free as a flat
+value matrix plus ``sequenceStartPositions`` / ``subSequenceStartPositions``
+(reference: paddle/parameter/Argument.h:29-100).  XLA wants static shapes, so
+the TPU-native design is *padded dense + lengths*, with bucketing-by-length at
+the data feeder to bound padding waste (SURVEY.md §5 "Long-context").
+
+``SequenceBatch``  — data [B, T, ...] + lengths [B]   (one sequence level)
+``NestedSequenceBatch`` — data [B, S, T, ...] + outer/inner lengths
+(two levels, the reference's sub-sequences).
+
+Both are pytrees (NamedTuples), so they flow through jit/grad/scan/pjit.
+"""
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class SequenceBatch(NamedTuple):
+    data: jnp.ndarray      # [B, T, ...] padded values (or int ids)
+    lengths: jnp.ndarray   # [B] int32 true lengths
+
+    @property
+    def batch_size(self):
+        return self.data.shape[0]
+
+    @property
+    def max_len(self):
+        return self.data.shape[1]
+
+    def mask(self, dtype=jnp.float32):
+        """[B, T] 1.0 where valid, 0.0 at padding."""
+        t = jnp.arange(self.max_len, dtype=jnp.int32)
+        return (t[None, :] < self.lengths[:, None]).astype(dtype)
+
+    def bool_mask(self):
+        t = jnp.arange(self.max_len, dtype=jnp.int32)
+        return t[None, :] < self.lengths[:, None]
+
+    def with_data(self, data):
+        return SequenceBatch(data=data, lengths=self.lengths)
+
+    @property
+    def total_tokens(self):
+        return jnp.sum(self.lengths)
+
+
+class NestedSequenceBatch(NamedTuple):
+    data: jnp.ndarray           # [B, S, T, ...]
+    outer_lengths: jnp.ndarray  # [B]    number of valid sub-sequences
+    inner_lengths: jnp.ndarray  # [B, S] length of each sub-sequence
+
+    def outer_mask(self, dtype=jnp.float32):
+        s = jnp.arange(self.data.shape[1], dtype=jnp.int32)
+        return (s[None, :] < self.outer_lengths[:, None]).astype(dtype)
+
+    def inner_mask(self, dtype=jnp.float32):
+        t = jnp.arange(self.data.shape[2], dtype=jnp.int32)
+        m = (t[None, None, :] < self.inner_lengths[:, :, None]).astype(dtype)
+        return m * self.outer_mask(dtype)[:, :, None]
+
+    def flatten_outer(self) -> SequenceBatch:
+        """View each sub-sequence as an independent sequence: [B*S, T, ...]."""
+        b, s = self.data.shape[:2]
+        data = self.data.reshape((b * s,) + self.data.shape[2:])
+        lengths = jnp.where(
+            self.outer_mask(jnp.int32).reshape(-1) > 0,
+            self.inner_lengths.reshape(-1), 0)
+        return SequenceBatch(data=data, lengths=lengths)
+
+
+def pad_sequences(seqs: Sequence[np.ndarray], max_len: Optional[int] = None,
+                  pad_value=0, dtype=None) -> SequenceBatch:
+    """Host-side: list of per-sequence arrays -> padded SequenceBatch."""
+    lengths = np.array([len(s) for s in seqs], dtype=np.int32)
+    tmax = int(max_len or (lengths.max() if len(seqs) else 1))
+    first = np.asarray(seqs[0])
+    trailing = first.shape[1:]
+    dtype = dtype or first.dtype
+    out = np.full((len(seqs), tmax) + trailing, pad_value, dtype=dtype)
+    for i, s in enumerate(seqs):
+        n = min(len(s), tmax)
+        out[i, :n] = np.asarray(s)[:n]
+    return SequenceBatch(data=jnp.asarray(out), lengths=jnp.asarray(np.minimum(lengths, tmax)))
+
+
+def pad_nested_sequences(seqs, max_outer=None, max_inner=None, pad_value=0,
+                         dtype=None) -> NestedSequenceBatch:
+    """list (per sample) of lists (sub-seqs) of arrays -> NestedSequenceBatch."""
+    outer = np.array([len(s) for s in seqs], dtype=np.int32)
+    smax = int(max_outer or max(outer.max(), 1))
+    inner = np.zeros((len(seqs), smax), dtype=np.int32)
+    for i, s in enumerate(seqs):
+        for j, sub in enumerate(s[:smax]):
+            inner[i, j] = len(sub)
+    tmax = int(max_inner or max(int(inner.max()), 1))
+    probe = np.asarray(seqs[0][0])
+    trailing = probe.shape[1:]
+    dtype = dtype or probe.dtype
+    out = np.full((len(seqs), smax, tmax) + trailing, pad_value, dtype=dtype)
+    for i, s in enumerate(seqs):
+        for j, sub in enumerate(s[:smax]):
+            n = min(len(sub), tmax)
+            out[i, j, :n] = np.asarray(sub)[:n]
+    return NestedSequenceBatch(
+        data=jnp.asarray(out),
+        outer_lengths=jnp.asarray(np.minimum(outer, smax)),
+        inner_lengths=jnp.asarray(np.minimum(inner, tmax)))
+
+
+def bucket_boundaries(lengths, num_buckets=4, multiple=8):
+    """Pick padded-length buckets (quantiles rounded up to `multiple`).
+
+    Replaces the reference's batch-shrinking dynamic shapes
+    (RecurrentGradientMachine.cpp:642) with a small static-shape set so XLA
+    compiles one program per bucket.
+    """
+    lengths = np.asarray(lengths)
+    qs = np.quantile(lengths, np.linspace(0, 1, num_buckets + 1)[1:])
+    bounds = sorted({int(-(-q // multiple) * multiple) for q in qs})
+    return bounds
+
+
+def bucket_for(length: int, bounds) -> int:
+    for b in bounds:
+        if length <= b:
+            return b
+    return bounds[-1]

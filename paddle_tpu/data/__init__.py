@@ -1,0 +1,20 @@
+"""Data path: reader decorators, PyDataProvider2-compatible @provider,
+DataFeeder, dataset zoo (reference §2.2 DataProviders + v2 readers/datasets)."""
+
+from paddle_tpu.data import reader
+from paddle_tpu.data.provider import (
+    provider, dense_vector, sparse_binary_vector, sparse_float_vector,
+    integer_value, dense_vector_sequence, sparse_binary_vector_sequence,
+    sparse_float_vector_sequence, integer_value_sequence,
+    integer_value_sub_sequence, CacheType, SeqType, InputType,
+)
+from paddle_tpu.data.feeder import DataFeeder
+from paddle_tpu.data import datasets
+
+__all__ = [
+    "reader", "provider", "DataFeeder", "datasets",
+    "dense_vector", "sparse_binary_vector", "sparse_float_vector",
+    "integer_value", "dense_vector_sequence", "sparse_binary_vector_sequence",
+    "sparse_float_vector_sequence", "integer_value_sequence",
+    "integer_value_sub_sequence", "CacheType", "SeqType", "InputType",
+]

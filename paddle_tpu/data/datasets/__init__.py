@@ -1,0 +1,15 @@
+"""Dataset zoo mirroring paddle.v2.dataset (mnist, cifar, imdb, imikolov,
+movielens, conll05, sentiment, uci_housing, wmt14 — reference
+python/paddle/v2/dataset/).
+
+This environment has no network egress, so each dataset loads from a local
+path when present (PADDLE_TPU_DATA_DIR) and otherwise falls back to a
+deterministic synthetic generator with the same sample schema — keeping the
+training pipelines runnable end-to-end anywhere.
+"""
+
+from paddle_tpu.data.datasets import mnist, cifar, imdb, uci_housing, \
+    movielens, imikolov, wmt14, conll05
+
+__all__ = ["mnist", "cifar", "imdb", "uci_housing", "movielens", "imikolov",
+           "wmt14", "conll05"]

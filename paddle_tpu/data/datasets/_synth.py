@@ -1,0 +1,16 @@
+"""Synthetic data helpers shared by the dataset modules."""
+
+import os
+
+import numpy as np
+
+DATA_DIR = os.environ.get("PADDLE_TPU_DATA_DIR",
+                          os.path.expanduser("~/.cache/paddle_tpu/dataset"))
+
+
+def rng_for(name, split):
+    return np.random.RandomState(abs(hash((name, split))) % (2 ** 31))
+
+
+def local_path(*parts):
+    return os.path.join(DATA_DIR, *parts)

@@ -1,0 +1,43 @@
+"""CoNLL-05 SRL (reference v2/dataset/conll05.py: word/predicate/ctx features
++ IOB label sequence)."""
+
+import numpy as np
+
+from paddle_tpu.data.datasets._synth import rng_for
+
+WORD_DICT = 4000
+PRED_DICT = 300
+LABEL_KINDS = 19   # span types
+NUM_LABELS = 2 * LABEL_KINDS + 1
+
+
+def get_dict():
+    return ({f"w{i}": i for i in range(WORD_DICT)},
+            {f"v{i}": i for i in range(PRED_DICT)},
+            {f"l{i}": i for i in range(NUM_LABELS)})
+
+
+def _reader(split, n):
+    def reader():
+        rng = rng_for("conll05", split)
+        for _ in range(n):
+            length = int(rng.randint(5, 40))
+            words = list(rng.randint(0, WORD_DICT, size=length))
+            pred = int(rng.randint(0, PRED_DICT))
+            labels = []
+            t = 0
+            while t < length:
+                span = min(int(rng.randint(1, 4)), length - t)
+                kind = int(rng.randint(0, LABEL_KINDS))
+                labels.extend([2 * kind] + [2 * kind + 1] * (span - 1))
+                t += span
+            yield words, [pred] * length, labels
+    return reader
+
+
+def train():
+    return _reader("train", 1024)
+
+
+def test():
+    return _reader("test", 128)

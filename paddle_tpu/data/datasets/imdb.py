@@ -1,0 +1,34 @@
+"""IMDB sentiment (reference v2/dataset/imdb.py: word-id sequence + 0/1
+label).  Synthetic fallback: two token distributions."""
+
+import numpy as np
+
+from paddle_tpu.data.datasets._synth import rng_for
+
+WORD_DIM = 5147  # compact synthetic vocab
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(WORD_DIM)}
+
+
+def _reader(split, n):
+    def reader():
+        rng = rng_for("imdb", split)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 120))
+            # positive reviews skew to low ids, negative to high
+            center = WORD_DIM // 4 if label else 3 * WORD_DIM // 4
+            ids = np.clip(rng.normal(center, WORD_DIM // 6, size=length),
+                          0, WORD_DIM - 1).astype(np.int64)
+            yield list(ids), label
+    return reader
+
+
+def train(word_idx=None):
+    return _reader("train", 2048)
+
+
+def test(word_idx=None):
+    return _reader("test", 256)

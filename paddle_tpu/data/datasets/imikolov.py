@@ -1,0 +1,30 @@
+"""PTB n-gram LM (reference v2/dataset/imikolov.py: N-gram word ids)."""
+
+import numpy as np
+
+from paddle_tpu.data.datasets._synth import rng_for
+
+WORD_DIM = 2073
+
+
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(WORD_DIM)}
+
+
+def _reader(split, n, ngram):
+    def reader():
+        rng = rng_for("imikolov", split)
+        for _ in range(n):
+            # markov-ish synthetic stream
+            start = int(rng.randint(0, WORD_DIM))
+            ids = [(start + k * 7) % WORD_DIM for k in range(ngram)]
+            yield tuple(ids)
+    return reader
+
+
+def train(word_idx=None, n=5):
+    return _reader("train", 4096, n)
+
+
+def test(word_idx=None, n=5):
+    return _reader("test", 512, n)

@@ -1,0 +1,50 @@
+"""MovieLens CTR (reference v2/dataset/movielens.py: user/movie categorical
+features -> rating)."""
+
+import numpy as np
+
+from paddle_tpu.data.datasets._synth import rng_for
+
+MAX_USER = 6040
+MAX_MOVIE = 3952
+AGES = 7
+JOBS = 21
+CATEGORIES = 18
+TITLE_DIM = 5174
+
+
+def max_user_id():
+    return MAX_USER
+
+
+def max_movie_id():
+    return MAX_MOVIE
+
+
+def max_job_id():
+    return JOBS - 1
+
+
+def _reader(split, n):
+    def reader():
+        rng = rng_for("movielens", split)
+        for _ in range(n):
+            uid = int(rng.randint(0, MAX_USER))
+            mid = int(rng.randint(0, MAX_MOVIE))
+            gender = int(rng.randint(0, 2))
+            age = int(rng.randint(0, AGES))
+            job = int(rng.randint(0, JOBS))
+            category = list(rng.choice(CATEGORIES,
+                                       size=rng.randint(1, 4), replace=False))
+            title = list(rng.randint(0, TITLE_DIM, size=rng.randint(2, 8)))
+            score = float((uid * 31 + mid * 17) % 5 + 1)
+            yield uid, gender, age, job, mid, category, title, score
+    return reader
+
+
+def train():
+    return _reader("train", 4096)
+
+
+def test():
+    return _reader("test", 512)

@@ -1,0 +1,89 @@
+"""DataFeeder: host samples -> device-ready arrays / SequenceBatches.
+
+Reference: python/paddle/v2/data_feeder.py + py_paddle
+dataprovider_converter.py (numpy -> Arguments with sequenceStartPositions).
+TPU design: pack ragged samples into padded SequenceBatch with
+bucketed max_len (static shapes for XLA; see core.sequence.bucket_boundaries)
+and densify sparse vectors (sparse input becomes dense rows or id lists —
+the embedding path takes ids, the MXU path takes dense).
+"""
+
+import numpy as np
+
+from paddle_tpu.core.sequence import (
+    SequenceBatch, pad_sequences, pad_nested_sequences, bucket_for)
+from paddle_tpu.data.provider import InputType, SeqType
+
+
+class DataFeeder:
+    def __init__(self, feeding, bucket_bounds=None, pad_batch_to=None):
+        """feeding: {name: InputType} or {name: index} paired with types.
+
+        bucket_bounds: optional list of allowed padded lengths (per name or
+        shared) to bound XLA recompilation.
+        pad_batch_to: optional fixed batch size (pads short final batches).
+        """
+        self.feeding = feeding
+        self.bucket_bounds = bucket_bounds
+        self.pad_batch_to = pad_batch_to
+
+    def _convert_one(self, name, itype: InputType, columns):
+        if itype.seq_type == SeqType.NO_SEQUENCE:
+            if itype.kind == "index":
+                return np.asarray(columns, dtype=np.int32).reshape(len(columns))
+            if itype.kind == "dense":
+                return np.asarray(columns, dtype=np.float32)
+            if itype.kind in ("sparse_binary", "sparse_float"):
+                out = np.zeros((len(columns), itype.dim), np.float32)
+                for i, ids in enumerate(columns):
+                    if itype.kind == "sparse_binary":
+                        out[i, np.asarray(ids, np.int64)] = 1.0
+                    else:
+                        for j, v in ids:
+                            out[i, j] = v
+                return out
+        elif itype.seq_type == SeqType.SEQUENCE:
+            if itype.kind == "index":
+                seqs = [np.asarray(s, np.int32) for s in columns]
+            elif itype.kind == "dense":
+                seqs = [np.asarray(s, np.float32) for s in columns]
+            elif itype.kind == "sparse_binary":
+                seqs = []
+                for s in columns:
+                    rows = np.zeros((len(s), itype.dim), np.float32)
+                    for t, ids in enumerate(s):
+                        rows[t, np.asarray(ids, np.int64)] = 1.0
+                    seqs.append(rows)
+            else:
+                seqs = []
+                for s in columns:
+                    rows = np.zeros((len(s), itype.dim), np.float32)
+                    for t, pairs in enumerate(s):
+                        for j, v in pairs:
+                            rows[t, j] = v
+                    seqs.append(rows)
+            max_len = max(len(s) for s in seqs)
+            if self.bucket_bounds:
+                max_len = bucket_for(max_len, self.bucket_bounds)
+            return pad_sequences(seqs, max_len=max_len)
+        else:  # SUB_SEQUENCE
+            nested = [[np.asarray(sub, np.int32 if itype.kind == "index"
+                                  else np.float32) for sub in s]
+                      for s in columns]
+            return pad_nested_sequences(nested)
+        raise ValueError(f"unsupported input type {itype}")
+
+    def __call__(self, batch):
+        """batch: list of dicts {name: sample} or tuples in feeding order."""
+        names = list(self.feeding)
+        if self.pad_batch_to and len(batch) < self.pad_batch_to:
+            batch = list(batch) + [batch[-1]] * (self.pad_batch_to - len(batch))
+        feed = {}
+        for idx, name in enumerate(names):
+            itype = self.feeding[name]
+            if isinstance(batch[0], dict):
+                columns = [b[name] for b in batch]
+            else:
+                columns = [b[idx] for b in batch]
+            feed[name] = self._convert_one(name, itype, columns)
+        return feed

@@ -1,0 +1,107 @@
+"""PyDataProvider2-compatible @provider decorator + input type declarations.
+
+Reference: python/paddle/trainer/PyDataProvider2.py:25-210 — input types
+dense_vector, sparse_binary_vector, sparse_float_vector, integer_value, each
+x (no_sequence | sequence | sub_sequence), cache types, and the @provider
+decorator turning a Python generator into a framework data source.  The C++
+consumer (gserver/dataproviders/PyDataProvider2.cpp) becomes the DataFeeder
+(feeder.py) which packs samples into device arrays.
+"""
+
+import dataclasses
+import functools
+from enum import Enum
+
+
+class SeqType(Enum):
+    NO_SEQUENCE = 0
+    SEQUENCE = 1
+    SUB_SEQUENCE = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    dim: int
+    seq_type: SeqType
+    kind: str  # dense | sparse_binary | sparse_float | index
+
+
+def dense_vector(dim, seq_type=SeqType.NO_SEQUENCE):
+    return InputType(dim, seq_type, "dense")
+
+
+def sparse_binary_vector(dim, seq_type=SeqType.NO_SEQUENCE):
+    return InputType(dim, seq_type, "sparse_binary")
+
+
+def sparse_float_vector(dim, seq_type=SeqType.NO_SEQUENCE):
+    return InputType(dim, seq_type, "sparse_float")
+
+
+def integer_value(value_range, seq_type=SeqType.NO_SEQUENCE):
+    return InputType(value_range, seq_type, "index")
+
+
+def dense_vector_sequence(dim):
+    return dense_vector(dim, SeqType.SEQUENCE)
+
+
+def sparse_binary_vector_sequence(dim):
+    return sparse_binary_vector(dim, SeqType.SEQUENCE)
+
+
+def sparse_float_vector_sequence(dim):
+    return sparse_float_vector(dim, SeqType.SEQUENCE)
+
+
+def integer_value_sequence(value_range):
+    return integer_value(value_range, SeqType.SEQUENCE)
+
+
+def integer_value_sub_sequence(value_range):
+    return integer_value(value_range, SeqType.SUB_SEQUENCE)
+
+
+class CacheType(Enum):
+    NO_CACHE = 0
+    CACHE_PASS_IN_MEM = 1
+
+
+def provider(input_types=None, cache=CacheType.NO_CACHE, should_shuffle=None,
+             min_pool_size=-1, **outer_kwargs):
+    """@provider(input_types={'word': integer_value_sequence(dict_len), ...})
+
+    The wrapped generator has signature gen(settings, filename) and yields
+    dicts keyed by input name (or tuples in declaration order).  Returns a
+    reader factory: fn(filenames) -> reader compatible with trainer.SGD.
+    """
+    def deco(gen):
+        @functools.wraps(gen)
+        def make_reader(file_list, **kw):
+            files = [file_list] if isinstance(file_list, str) else list(file_list)
+
+            class Settings:
+                pass
+
+            settings = Settings()
+            settings.input_types = input_types
+            settings.logger = __import__("logging").getLogger("provider")
+            for k, v in {**outer_kwargs, **kw}.items():
+                setattr(settings, k, v)
+
+            cached = []
+
+            def reader():
+                if cache == CacheType.CACHE_PASS_IN_MEM and cached:
+                    yield from cached
+                    return
+                for f in files:
+                    for sample in gen(settings, f):
+                        if cache == CacheType.CACHE_PASS_IN_MEM:
+                            cached.append(sample)
+                        yield sample
+            reader.input_types = input_types
+            return reader
+        make_reader.input_types = input_types
+        return make_reader
+    return deco

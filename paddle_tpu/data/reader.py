@@ -1,0 +1,111 @@
+"""Reader decorators.
+
+Reference: python/paddle/v2/reader/decorator.py — map_readers, buffered,
+shuffle, batched(+minibatch.py), compose, chain, firstn — and the creator
+helpers.  A reader is a zero-arg callable returning an iterator of samples.
+"""
+
+import itertools
+import random
+import threading
+import queue as _queue
+
+
+def map_readers(func, *readers):
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return reader
+
+
+def shuffle(reader, buf_size, seed=None):
+    def new_reader():
+        rng = random.Random(seed)
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        rng.shuffle(buf)
+        yield from buf
+    return new_reader
+
+
+def buffered(reader, size):
+    """Async prefetch thread (reference DoubleBuffer, DataProvider.h:251)."""
+    _end = object()
+
+    def new_reader():
+        q = _queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(_end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _end:
+                break
+            yield item
+    return new_reader
+
+
+def batch(reader, batch_size, drop_last=False):
+    def new_reader():
+        it = reader()
+        while True:
+            chunk = list(itertools.islice(it, batch_size))
+            if not chunk:
+                return
+            if len(chunk) < batch_size and drop_last:
+                return
+            yield chunk
+    return new_reader
+
+
+batched = batch
+
+
+def compose(*readers):
+    def new_reader():
+        for items in zip(*[r() for r in readers]):
+            out = []
+            for x in items:
+                if isinstance(x, tuple):
+                    out.extend(x)
+                else:
+                    out.append(x)
+            yield tuple(out)
+    return new_reader
+
+
+def chain(*readers):
+    def new_reader():
+        for r in readers:
+            yield from r()
+    return new_reader
+
+
+def firstn(reader, n):
+    def new_reader():
+        yield from itertools.islice(reader(), n)
+    return new_reader
+
+
+def cache(reader):
+    data = []
+    filled = []
+
+    def new_reader():
+        if not filled:
+            data.extend(reader())
+            filled.append(True)
+        yield from data
+    return new_reader

@@ -1,0 +1,21 @@
+"""Evaluator suite.
+
+Reference: gserver/evaluators/Evaluator.{h,cpp}:172-1346 REGISTER_EVALUATOR
+zoo — classification_error, sum, column_sum, rankauc, precision_recall,
+pnpair, auc, chunk (NER F1), ctc_error, printers.
+
+Design: each evaluator is (init, update, result) with jittable additive
+statistics where possible (the reference's distributed merge of evaluator
+counters becomes a psum over the same statistics).
+"""
+
+from paddle_tpu.evaluators.evaluators import (
+    Evaluator, ClassificationError, Auc, PrecisionRecall, PnPair, RankAuc,
+    SumEvaluator, ColumnSum, ChunkEvaluator, CTCError, get,
+)
+
+__all__ = [
+    "Evaluator", "ClassificationError", "Auc", "PrecisionRecall", "PnPair",
+    "RankAuc", "SumEvaluator", "ColumnSum", "ChunkEvaluator", "CTCError",
+    "get",
+]

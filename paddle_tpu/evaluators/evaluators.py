@@ -1,0 +1,306 @@
+"""Evaluator implementations (see package docstring; reference
+gserver/evaluators/Evaluator.cpp + ChunkEvaluator.cpp + CTCErrorEvaluator.cpp).
+
+Contract:
+  ev.init() -> state (pytree of arrays; additive across batches/devices)
+  ev.update(state, **batch outputs) -> state  (pure, jittable)
+  ev.result(state) -> float | dict
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class Evaluator:
+    name = "evaluator"
+
+    def init(self):
+        raise NotImplementedError
+
+    def update(self, state, **kw):
+        raise NotImplementedError
+
+    def result(self, state):
+        raise NotImplementedError
+
+
+class ClassificationError(Evaluator):
+    """Reference ClassificationErrorEvaluator: fraction of rows whose argmax
+    != label (with optional per-row weight)."""
+    name = "classification_error"
+
+    def init(self):
+        return {"wrong": jnp.zeros(()), "total": jnp.zeros(())}
+
+    def update(self, state, pred=None, label=None, weight=None, mask=None):
+        ids = jnp.argmax(pred, axis=-1)
+        lab = label.reshape(ids.shape)
+        err = (ids != lab).astype(jnp.float32)
+        w = jnp.ones_like(err) if weight is None else weight.reshape(err.shape)
+        if mask is not None:
+            w = w * mask.reshape(err.shape)
+        return {"wrong": state["wrong"] + jnp.sum(err * w),
+                "total": state["total"] + jnp.sum(w)}
+
+    def result(self, state):
+        t = float(state["total"])
+        return float(state["wrong"]) / t if t else 0.0
+
+
+class SumEvaluator(Evaluator):
+    name = "sum"
+
+    def init(self):
+        return {"sum": jnp.zeros(()), "total": jnp.zeros(())}
+
+    def update(self, state, value=None, weight=None, **_):
+        w = jnp.ones(value.shape[0]) if weight is None else weight.reshape(-1)
+        return {"sum": state["sum"] + jnp.sum(value.reshape(value.shape[0], -1).sum(-1) * w),
+                "total": state["total"] + jnp.sum(w)}
+
+    def result(self, state):
+        return float(state["sum"])
+
+
+class ColumnSum(Evaluator):
+    name = "column_sum"
+
+    def __init__(self, size):
+        self.size = size
+
+    def init(self):
+        return {"sum": jnp.zeros((self.size,)), "total": jnp.zeros(())}
+
+    def update(self, state, value=None, weight=None, **_):
+        w = jnp.ones(value.shape[0]) if weight is None else weight.reshape(-1)
+        return {"sum": state["sum"] + jnp.sum(value * w[:, None], axis=0),
+                "total": state["total"] + jnp.sum(w)}
+
+    def result(self, state):
+        return np.asarray(state["sum"])
+
+
+class Auc(Evaluator):
+    """Reference AucEvaluator: histogram-bucketed ROC AUC (the reference
+    uses a fixed-resolution discretization too)."""
+    name = "auc"
+
+    def __init__(self, buckets=1024):
+        self.buckets = buckets
+
+    def init(self):
+        return {"pos": jnp.zeros((self.buckets,)),
+                "neg": jnp.zeros((self.buckets,))}
+
+    def update(self, state, pred=None, label=None, weight=None, **_):
+        # pred: [B, 2] softmax or [B, 1]/[B] positive-class prob
+        p = pred[:, 1] if (pred.ndim == 2 and pred.shape[1] == 2) else pred.reshape(-1)
+        lab = label.reshape(-1).astype(jnp.float32)
+        w = jnp.ones_like(p) if weight is None else weight.reshape(-1)
+        idx = jnp.clip((p * self.buckets).astype(jnp.int32), 0, self.buckets - 1)
+        pos = state["pos"].at[idx].add(lab * w)
+        neg = state["neg"].at[idx].add((1 - lab) * w)
+        return {"pos": pos, "neg": neg}
+
+    def result(self, state):
+        pos = np.asarray(state["pos"])[::-1]  # descending threshold
+        neg = np.asarray(state["neg"])[::-1]
+        tp = np.cumsum(pos)
+        fp = np.cumsum(neg)
+        tot_pos, tot_neg = tp[-1], fp[-1]
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.5
+        # trapezoid over ROC points
+        tpr = np.concatenate([[0.0], tp / tot_pos])
+        fpr = np.concatenate([[0.0], fp / tot_neg])
+        return float(np.trapezoid(tpr, fpr))
+
+
+class PrecisionRecall(Evaluator):
+    """Reference PrecisionRecallEvaluator: per-class TP/FP/FN -> macro F1
+    (or binary stats when positive_label given)."""
+    name = "precision_recall"
+
+    def __init__(self, num_classes, positive_label=None):
+        self.num_classes = num_classes
+        self.positive_label = positive_label
+
+    def init(self):
+        n = self.num_classes
+        return {"tp": jnp.zeros((n,)), "fp": jnp.zeros((n,)),
+                "fn": jnp.zeros((n,))}
+
+    def update(self, state, pred=None, label=None, **_):
+        ids = jnp.argmax(pred, axis=-1)
+        lab = label.reshape(ids.shape).astype(jnp.int32)
+        n = self.num_classes
+        oh_pred = jax.nn.one_hot(ids, n)
+        oh_lab = jax.nn.one_hot(lab, n)
+        tp = jnp.sum(oh_pred * oh_lab, axis=0)
+        fp = jnp.sum(oh_pred * (1 - oh_lab), axis=0)
+        fn = jnp.sum((1 - oh_pred) * oh_lab, axis=0)
+        return {"tp": state["tp"] + tp, "fp": state["fp"] + fp,
+                "fn": state["fn"] + fn}
+
+    def result(self, state):
+        tp, fp, fn = (np.asarray(state[k]) for k in ("tp", "fp", "fn"))
+        if self.positive_label is not None:
+            i = self.positive_label
+            prec = tp[i] / max(tp[i] + fp[i], 1e-9)
+            rec = tp[i] / max(tp[i] + fn[i], 1e-9)
+            f1 = 2 * prec * rec / max(prec + rec, 1e-9)
+            return {"precision": float(prec), "recall": float(rec), "f1": float(f1)}
+        prec = tp / np.maximum(tp + fp, 1e-9)
+        rec = tp / np.maximum(tp + fn, 1e-9)
+        f1 = 2 * prec * rec / np.maximum(prec + rec, 1e-9)
+        return {"macro_f1": float(f1.mean()),
+                "precision": float(prec.mean()), "recall": float(rec.mean())}
+
+
+class PnPair(Evaluator):
+    """Reference PnpairEvaluator: counts correctly-ordered (pos before neg)
+    prediction pairs within query groups.  Host-side accumulation (pairwise
+    over variable-size groups is not worth a kernel)."""
+    name = "pnpair"
+
+    def init(self):
+        return {"records": []}
+
+    def update(self, state, pred=None, label=None, query_id=None, **_):
+        p = np.asarray(pred).reshape(-1)
+        l = np.asarray(label).reshape(-1)
+        q = np.asarray(query_id).reshape(-1) if query_id is not None \
+            else np.zeros_like(l)
+        state["records"].append((p, l, q))
+        return state
+
+    def result(self, state):
+        if not state["records"]:
+            return 0.0
+        p = np.concatenate([r[0] for r in state["records"]])
+        l = np.concatenate([r[1] for r in state["records"]])
+        q = np.concatenate([r[2] for r in state["records"]])
+        pos_cnt = neg_cnt = spe = 0.0
+        for qid in np.unique(q):
+            m = q == qid
+            pi, li = p[m], l[m]
+            diff_l = li[:, None] - li[None, :]
+            diff_p = pi[:, None] - pi[None, :]
+            pairs = diff_l > 0
+            pos_cnt += np.sum(pairs & (diff_p > 0))
+            neg_cnt += np.sum(pairs & (diff_p < 0))
+            spe += np.sum(pairs & (diff_p == 0))
+        denom = neg_cnt + spe / 2.0
+        return float(pos_cnt / max(denom, 1e-9))
+
+
+class RankAuc(Auc):
+    name = "rankauc"
+
+
+class ChunkEvaluator(Evaluator):
+    """Reference ChunkEvaluator.cpp: chunk (NER span) F1 over IOB/IOE/IOBES
+    tagging.  Host-side decode of spans."""
+    name = "chunk"
+
+    def __init__(self, scheme="IOB", num_chunk_types=None):
+        self.scheme = scheme
+
+    def init(self):
+        return {"correct": 0, "pred": 0, "gold": 0}
+
+    @staticmethod
+    def _spans_iob(tags):
+        """tags: list of (is 2*type + {0:B,1:I}) per reference encoding."""
+        spans, start, cur_type = [], None, None
+        for i, t in enumerate(tags):
+            if t < 0:
+                break
+            ttype, pos = t // 2, t % 2
+            if pos == 0:  # B
+                if start is not None:
+                    spans.append((start, i, cur_type))
+                start, cur_type = i, ttype
+            elif start is None or ttype != cur_type:
+                # I without matching B: treat as start (reference tolerant mode)
+                if start is not None:
+                    spans.append((start, i, cur_type))
+                start, cur_type = i, ttype
+        if start is not None:
+            spans.append((start, len(tags), cur_type))
+        return set(spans)
+
+    def update(self, state, pred=None, label=None, lengths=None, **_):
+        p = np.asarray(pred)
+        l = np.asarray(label)
+        lens = np.asarray(lengths) if lengths is not None else \
+            np.full(p.shape[0], p.shape[1])
+        for i in range(p.shape[0]):
+            ps = self._spans_iob(list(p[i, :lens[i]]))
+            gs = self._spans_iob(list(l[i, :lens[i]]))
+            state["correct"] += len(ps & gs)
+            state["pred"] += len(ps)
+            state["gold"] += len(gs)
+        return state
+
+    def result(self, state):
+        prec = state["correct"] / max(state["pred"], 1e-9)
+        rec = state["correct"] / max(state["gold"], 1e-9)
+        return {"precision": prec, "recall": rec,
+                "f1": 2 * prec * rec / max(prec + rec, 1e-9)}
+
+
+class CTCError(Evaluator):
+    """Reference CTCErrorEvaluator: edit distance between greedy-decoded
+    output and label, normalized by label length."""
+    name = "ctc_error"
+
+    def init(self):
+        return {"dist": 0.0, "len": 0.0}
+
+    @staticmethod
+    def _edit_distance(a, b):
+        dp = np.arange(len(b) + 1, dtype=np.int32)
+        for i in range(1, len(a) + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, len(b) + 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (a[i - 1] != b[j - 1]))
+        return int(dp[-1])
+
+    def update(self, state, decoded=None, decoded_lengths=None, label=None,
+               label_lengths=None, **_):
+        d = np.asarray(decoded)
+        dl = np.asarray(decoded_lengths)
+        l = np.asarray(label)
+        ll = np.asarray(label_lengths)
+        for i in range(d.shape[0]):
+            state["dist"] += self._edit_distance(
+                list(d[i, :dl[i]]), list(l[i, :ll[i]]))
+            state["len"] += float(ll[i])
+        return state
+
+    def result(self, state):
+        return state["dist"] / max(state["len"], 1e-9)
+
+
+_REGISTRY = {
+    "classification_error": ClassificationError,
+    "sum": SumEvaluator,
+    "column_sum": ColumnSum,
+    "auc": Auc,
+    "rankauc": RankAuc,
+    "precision_recall": PrecisionRecall,
+    "pnpair": PnPair,
+    "chunk": ChunkEvaluator,
+    "ctc_error": CTCError,
+}
+
+
+def get(name, **kw):
+    try:
+        return _REGISTRY[name](**kw)
+    except KeyError:
+        raise KeyError(f"unknown evaluator {name!r}; have {sorted(_REGISTRY)}")

@@ -1,0 +1,13 @@
+"""Layer DSL (the reference's trainer_config_helpers surface) + graph IR."""
+
+from paddle_tpu.layers.graph import LayerOutput, Topology, Context
+from paddle_tpu.layers.api import *          # noqa: F401,F403
+from paddle_tpu.layers.vision import *       # noqa: F401,F403
+from paddle_tpu.layers.recurrent import *    # noqa: F401,F403
+from paddle_tpu.layers import networks
+from paddle_tpu.layers import api as _api
+from paddle_tpu.layers import vision as _vision
+from paddle_tpu.layers import recurrent as _recurrent
+
+__all__ = (["LayerOutput", "Topology", "Context", "networks"]
+           + _api.__all__ + _vision.__all__ + _recurrent.__all__)

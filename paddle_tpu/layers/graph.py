@@ -1,0 +1,261 @@
+"""Layer-graph IR + compiler.
+
+The reference's front-end is a Python DSL whose ctors register layer configs
+into a global proto (config_parser.py:166-184 @config_layer registries,
+emitting ModelConfig — "the protobuf IS the IR", SURVEY.md §1).  The
+TPU-native redesign keeps the DSL surface but compiles to a *functional* IR:
+
+  ctor (fc_layer, lstmemory, ...) -> LayerOutput node (name, type, size, inputs)
+  Topology(outputs)               -> topological order over nodes
+  Topology.init(rng)              -> params pytree {layer_name: {param: array}}
+  Topology.apply(params, feed)    -> pure function, jit/grad/pjit-able
+
+Values flowing between layers are either plain arrays [B, D] (one row per
+sample) or SequenceBatch (padded [B, T, D] + lengths) — the reference's
+Argument with sequenceStartPositions.  Layer kernels accept both via
+row-mapping (the reference's layers see a flat row matrix either way).
+
+Each layer type registers a LayerImpl:
+  infer(cfg, in_sizes) -> output size
+  init(rng, cfg, in_sizes) -> param dict (may be {})
+  apply(ctx, cfg, params, *inputs) -> output value
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.utils.error import ConfigError
+
+_LAYER_IMPLS: Dict[str, "LayerImpl"] = {}
+_NAME_COUNTERS: Dict[str, int] = {}
+
+
+@dataclasses.dataclass
+class LayerImpl:
+    type: str
+    infer: Callable            # (cfg, in_sizes) -> int
+    init: Callable             # (rng, cfg, in_sizes) -> dict
+    apply: Callable            # (ctx, cfg, params, *inputs) -> value
+
+
+def register_layer(type_name):
+    def deco(cls_or_fns):
+        impl = cls_or_fns() if isinstance(cls_or_fns, type) else cls_or_fns
+        _LAYER_IMPLS[type_name] = LayerImpl(
+            type=type_name,
+            infer=getattr(impl, "infer"),
+            init=getattr(impl, "init", lambda rng, cfg, in_sizes: {}),
+            apply=getattr(impl, "apply"))
+        return cls_or_fns
+    return deco
+
+
+def get_impl(type_name) -> LayerImpl:
+    try:
+        return _LAYER_IMPLS[type_name]
+    except KeyError:
+        raise ConfigError(f"no layer impl registered for type {type_name!r}")
+
+
+def auto_name(prefix):
+    n = _NAME_COUNTERS.get(prefix, 0)
+    _NAME_COUNTERS[prefix] = n + 1
+    return f"__{prefix}_{n}__"
+
+
+def reset_names():
+    _NAME_COUNTERS.clear()
+
+
+class LayerOutput:
+    """A node in the layer graph (reference: the LayerOutput returned by every
+    trainer_config_helpers ctor, wrapping a config_parser Layer)."""
+
+    __slots__ = ("name", "layer_type", "size", "inputs", "cfg", "is_seq",
+                 "num_filters", "img_shape")
+
+    def __init__(self, name, layer_type, size, inputs=(), cfg=None,
+                 is_seq=None, num_filters=None, img_shape=None):
+        self.name = name
+        self.layer_type = layer_type
+        self.size = int(size)
+        self.inputs: List[LayerOutput] = list(inputs)
+        self.cfg = dict(cfg or {})
+        # sequence-ness propagates: seq in -> seq out unless overridden
+        if is_seq is None:
+            is_seq = any(getattr(i, "is_seq", False) for i in self.inputs)
+        self.is_seq = is_seq
+        self.num_filters = num_filters      # conv image metadata
+        self.img_shape = img_shape          # (h, w) after this layer
+
+    def __repr__(self):
+        return (f"LayerOutput({self.name}, {self.layer_type}, size={self.size}"
+                f"{', seq' if self.is_seq else ''})")
+
+    # arithmetic sugar (reference layer_math.py monkeypatches +,-,*)
+    def __add__(self, other):
+        from paddle_tpu.layers import api
+        if isinstance(other, LayerOutput):
+            return api.addto_layer(input=[self, other])
+        return api.slope_intercept_layer(input=self, slope=1.0, intercept=other)
+
+    __radd__ = __add__
+
+    def __mul__(self, other):
+        from paddle_tpu.layers import api
+        if isinstance(other, (int, float)):
+            return api.slope_intercept_layer(input=self, slope=other, intercept=0.0)
+        raise TypeError("LayerOutput * LayerOutput needs dotmul")
+
+    __rmul__ = __mul__
+
+    def __sub__(self, other):
+        from paddle_tpu.layers import api
+        if isinstance(other, (int, float)):
+            return api.slope_intercept_layer(input=self, slope=1.0, intercept=-other)
+        neg = api.slope_intercept_layer(input=other, slope=-1.0, intercept=0.0)
+        return api.addto_layer(input=[self, neg])
+
+
+class Context:
+    """Per-apply execution context: mode, rng, mutable-state collection
+    (batch-norm moving stats thread through here, functionally)."""
+
+    def __init__(self, mode="train", rng=None, state=None):
+        self.mode = mode                  # "train" | "test"
+        self.rng = rng
+        self.state_in = state or {}       # {layer_name: pytree} (e.g. BN stats)
+        self.state_out = {}
+        self.aux = {}                     # scratch (e.g. recurrent_group outputs)
+
+    def is_train(self):
+        return self.mode == "train"
+
+    def next_rng(self):
+        if self.rng is None:
+            raise ConfigError("this graph needs an rng (dropout/sampling); "
+                              "pass rng= to Topology.apply")
+        self.rng, sub = jax.random.split(self.rng)
+        return sub
+
+    def get_state(self, name, default_fn):
+        if name in self.state_in:
+            return self.state_in[name]
+        return default_fn()
+
+    def put_state(self, name, value):
+        self.state_out[name] = value
+
+
+# ---------------------------------------------------------------- helpers
+
+def value_data(v):
+    return v.data if isinstance(v, SequenceBatch) else v
+
+
+def map_rows(fn, *values):
+    """Apply a row-wise fn to values that may be SequenceBatch or arrays.
+    If any input is a sequence, output is a SequenceBatch with its lengths."""
+    seq = next((v for v in values if isinstance(v, SequenceBatch)), None)
+    datas = [value_data(v) for v in values]
+    out = fn(*datas)
+    if seq is not None:
+        return SequenceBatch(data=out, lengths=seq.lengths)
+    return out
+
+
+def as_seq(v) -> SequenceBatch:
+    if not isinstance(v, SequenceBatch):
+        raise ConfigError(f"expected a sequence input, got array {getattr(v, 'shape', v)}")
+    return v
+
+
+# ---------------------------------------------------------------- topology
+
+class Topology:
+    """Compiled graph over one or more output layers (reference:
+    v2/topology.py Topology walking cost layers -> ModelConfig)."""
+
+    def __init__(self, outputs, extra_feeds=()):
+        if isinstance(outputs, LayerOutput):
+            outputs = [outputs]
+        self.outputs = list(outputs)
+        self.order = self._topo_sort(self.outputs)
+        self.data_layers = {n.name: n for n in self.order
+                            if n.layer_type == "data"}
+        for feed in extra_feeds:
+            self.data_layers.setdefault(feed.name, feed)
+
+    @staticmethod
+    def _topo_sort(outputs):
+        seen, order = set(), []
+
+        def visit(node, stack):
+            if id(node) in seen:
+                return
+            if id(node) in stack:
+                raise ConfigError(f"cycle through layer {node.name}")
+            stack = stack | {id(node)}
+            for dep in node.inputs:
+                visit(dep, stack)
+            seen.add(id(node))
+            order.append(node)
+
+        for out in outputs:
+            visit(out, frozenset())
+        return order
+
+    def init(self, rng):
+        """Initialize all parameters: {layer_name: {param_name: array}}.
+
+        Layers with shared parameters (cfg['param_name']) alias the same
+        entry keyed by that shared name."""
+        params = {}
+        for node in self.order:
+            impl = get_impl(node.layer_type)
+            in_sizes = [i.size for i in node.inputs]
+            rng, sub = jax.random.split(rng)
+            p = impl.init(sub, node.cfg, in_sizes)
+            if p:
+                key = node.cfg.get("param_name", node.name)
+                if key not in params:
+                    params[key] = p
+        return params
+
+    def _param_key(self, node):
+        return node.cfg.get("param_name", node.name)
+
+    def apply(self, params, feed, mode="train", rng=None, state=None,
+              return_state=False, extra_outputs=()):
+        """Run the graph.  feed: {data_layer_name: array|SequenceBatch}."""
+        ctx = Context(mode=mode, rng=rng, state=state)
+        cache = {}
+        for node in self.order:
+            if node.layer_type == "data":
+                if node.name not in feed:
+                    raise ConfigError(f"missing feed for data layer {node.name!r}")
+                cache[id(node)] = feed[node.name]
+                continue
+            impl = get_impl(node.layer_type)
+            ins = [cache[id(i)] for i in node.inputs]
+            p = params.get(self._param_key(node), {})
+            cache[id(node)] = impl.apply(ctx, node.cfg, p, *ins)
+        outs = [cache[id(o)] for o in self.outputs]
+        outs += [cache[id(o)] for o in extra_outputs if id(o) in cache]
+        result = outs[0] if len(outs) == 1 else tuple(outs)
+        if return_state:
+            return result, ctx.state_out
+        return result
+
+    def init_state(self):
+        """Initial mutable state (BN moving stats) for all layers that need it."""
+        state = {}
+        for node in self.order:
+            if node.layer_type == "batch_norm":
+                size = node.cfg["size"]
+                state[node.name] = (jnp.zeros((size,)), jnp.ones((size,)))
+        return state

@@ -1,0 +1,163 @@
+"""Composite networks.
+
+Reference: trainer_config_helpers/networks.py — simple_img_conv_pool,
+img_conv_group, vgg_16_network, simple_lstm, simple_gru, bidirectional_lstm,
+simple_attention (:1273), text_conv_pool, sequence_conv_pool.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.layers import api, vision, recurrent
+from paddle_tpu.layers.api import (
+    fc_layer, mixed_layer, full_matrix_projection, concat_layer,
+    pooling_layer, pooling, dropout_layer)
+from paddle_tpu.layers.graph import LayerOutput, auto_name
+from paddle_tpu.layers.vision import img_conv_layer, img_pool_layer, batch_norm_layer
+from paddle_tpu.layers.recurrent import lstmemory, grumemory
+
+__all__ = [
+    "simple_img_conv_pool", "img_conv_group", "vgg_16_network",
+    "simple_lstm", "simple_gru", "bidirectional_lstm", "simple_attention",
+    "text_conv_pool", "sequence_conv_pool",
+]
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         num_channels=None, pool_stride=1, act="relu",
+                         conv_padding=0, pool_type="max", name=None):
+    conv = img_conv_layer(input, filter_size=filter_size,
+                          num_filters=num_filters, num_channels=num_channels,
+                          padding=conv_padding, act=act,
+                          name=name and f"{name}_conv")
+    return img_pool_layer(conv, pool_size=pool_size, stride=pool_stride,
+                          pool_type=pool_type, name=name and f"{name}_pool")
+
+
+def img_conv_group(input, conv_num_filter, pool_size, num_channels=None,
+                   conv_padding=1, conv_filter_size=3, conv_act="relu",
+                   conv_with_batchnorm=False, pool_stride=2,
+                   pool_type="max", conv_batchnorm_drop_rate=None):
+    """VGG-style conv block (reference img_conv_group)."""
+    tmp = input
+    drops = conv_batchnorm_drop_rate or [0.0] * len(conv_num_filter)
+    for i, nf in enumerate(conv_num_filter):
+        tmp = img_conv_layer(tmp, filter_size=conv_filter_size,
+                             num_filters=nf,
+                             num_channels=num_channels if i == 0 else None,
+                             padding=conv_padding,
+                             act=None if conv_with_batchnorm else conv_act)
+        if conv_with_batchnorm:
+            tmp = batch_norm_layer(tmp, act=conv_act)
+            if drops[i]:
+                tmp = dropout_layer(tmp, drops[i])
+    return img_pool_layer(tmp, pool_size=pool_size, stride=pool_stride,
+                          pool_type=pool_type)
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000):
+    """Reference vgg_16_network."""
+    tmp = img_conv_group(input_image, [64, 64], 2, num_channels=num_channels)
+    tmp = img_conv_group(tmp, [128, 128], 2)
+    tmp = img_conv_group(tmp, [256, 256, 256], 2)
+    tmp = img_conv_group(tmp, [512, 512, 512], 2)
+    tmp = img_pool_layer(tmp, pool_size=2, stride=2)
+    tmp = fc_layer(tmp, size=4096, act="relu")
+    tmp = dropout_layer(tmp, 0.5)
+    tmp = fc_layer(tmp, size=4096, act="relu")
+    tmp = dropout_layer(tmp, 0.5)
+    return fc_layer(tmp, size=num_classes, act="softmax")
+
+
+def simple_lstm(input, size, reverse=False, act="tanh", gate_act="sigmoid",
+                state_act="tanh", name=None, mat_param_attr=None,
+                bias_param_attr=True, inner_param_attr=None):
+    """Reference simple_lstm: fc (4*size) -> lstmemory."""
+    mix = fc_layer(input, size=size * 4, act=None, bias_attr=False,
+                   param_attr=mat_param_attr,
+                   name=name and f"{name}_transform")
+    return lstmemory(mix, size=size, reverse=reverse, act=act,
+                     gate_act=gate_act, state_act=state_act, name=name,
+                     bias_attr=bias_param_attr, param_attr=inner_param_attr)
+
+
+def simple_gru(input, size, reverse=False, act="tanh", gate_act="sigmoid",
+               name=None):
+    mix = fc_layer(input, size=size * 3, act=None, bias_attr=False,
+                   name=name and f"{name}_transform")
+    return grumemory(mix, size=size, reverse=reverse, act=act,
+                     gate_act=gate_act, name=name)
+
+
+def bidirectional_lstm(input, size, name=None, return_seq=False):
+    """Reference bidirectional_lstm: concat(fwd lstm, bwd lstm)."""
+    fwd = simple_lstm(input, size, reverse=False, name=name and f"{name}_fwd")
+    bwd = simple_lstm(input, size, reverse=True, name=name and f"{name}_bwd")
+    if return_seq:
+        return concat_layer([fwd, bwd])
+    f_last = api.last_seq(fwd)
+    b_first = api.first_seq(bwd)
+    return concat_layer([f_last, b_first])
+
+
+def text_conv_pool(input, context_len, hidden_size, context_start=None,
+                   pool_type=None, act="relu", name=None):
+    """Reference sequence_conv_pool / text_conv_pool: context window fc +
+    sequence max pool."""
+    ctx_proj = api.context_projection(input, context_len=context_len,
+                                      context_start=context_start)
+    conv = mixed_layer(size=hidden_size, input=[ctx_proj], act=act,
+                       bias_attr=True, name=name and f"{name}_conv")
+    return pooling_layer(conv, pooling_type=pool_type or pooling.Max,
+                         name=name and f"{name}_pool")
+
+
+sequence_conv_pool = text_conv_pool
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     name=None):
+    """Bahdanau attention (reference networks.py:1273 simple_attention):
+    score_t = v . tanh(enc_proj_t + W s);  context = sum softmax * enc.
+
+    Used inside a recurrent_group with StaticInput encoder outputs.
+    """
+    decoder_proj = fc_layer(decoder_state, size=encoded_proj.size, act=None,
+                            bias_attr=False, param_attr=transform_param_attr,
+                            name=name and f"{name}_transform")
+    return attention_context_layer(encoded_sequence, encoded_proj,
+                                   decoder_proj,
+                                   param_attr=softmax_param_attr, name=name)
+
+
+# attention context as a first-class layer ---------------------------------
+
+from paddle_tpu.layers.graph import register_layer, as_seq, value_data
+from paddle_tpu.ops import attention as attn_ops
+from paddle_tpu.layers.api import _winit
+
+
+class _AttnContextImpl:
+    def infer(self, cfg, in_sizes):
+        return in_sizes[0]
+
+    def init(self, rng, cfg, in_sizes):
+        return {"v": _winit(cfg.get("param_attr"))(rng, (cfg["att_size"],))}
+
+    def apply(self, ctx, cfg, params, enc, enc_proj, dec_proj):
+        enc_sb, proj_sb = as_seq(enc), as_seq(enc_proj)
+        scores = attn_ops.additive_attention_scores(
+            proj_sb, value_data(dec_proj), params["v"])
+        return attn_ops.attention_context(scores, enc_sb)
+
+
+register_layer("attention_context")(_AttnContextImpl)
+
+
+def attention_context_layer(encoded_sequence, encoded_proj, decoder_proj,
+                            param_attr=None, name=None):
+    return LayerOutput(name or auto_name("attention"), "attention_context",
+                       encoded_sequence.size,
+                       [encoded_sequence, encoded_proj, decoder_proj],
+                       {"att_size": encoded_proj.size, "param_attr": param_attr},
+                       is_seq=False)

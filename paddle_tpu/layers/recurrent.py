@@ -1,0 +1,443 @@
+"""Recurrent DSL: lstmemory / grumemory / recurrent_layer whole-sequence
+layers, and the recurrent_group / memory engine.
+
+Reference surface: trainer_config_helpers layers.py lstmemory/grumemory/
+recurrent_layer/recurrent_group/memory/lstm_step_layer/gru_step_layer/
+get_output_layer + RecurrentLayerGroup lowering (config_parser.py sub_models,
+gserver RecurrentLayerGroup.cpp:23-60, RecurrentGradientMachine engine).
+
+TPU design: a recurrent_group's step sub-graph is built once at config time
+(placeholders for step inputs and memories), compiled to a pure step
+function, and driven by ops.rnn.recurrent_group — one lax.scan, static
+shapes, masked carries (vs the reference's per-frame network instantiation
+with batch shrinking).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtypes
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.layers.graph import (
+    LayerOutput, Topology, register_layer, auto_name, as_seq, value_data,
+    Context, get_impl)
+from paddle_tpu.layers.api import _winit, _maybe_bias
+from paddle_tpu.ops import rnn as rnn_ops
+from paddle_tpu.utils.error import ConfigError
+
+__all__ = [
+    "lstmemory", "grumemory", "recurrent_layer", "recurrent_group", "memory",
+    "StaticInput", "lstm_step_layer", "gru_step_layer", "get_output_layer",
+]
+
+
+# ----------------------------------------------------- whole-sequence RNNs
+
+class _LstmImpl:
+    def infer(self, cfg, in_sizes):
+        return cfg["size"]
+
+    def init(self, rng, cfg, in_sizes):
+        d = cfg["size"]
+        if in_sizes[0] != 4 * d:
+            raise ConfigError(
+                f"lstmemory input must be 4*size={4 * d} wide (a mixed/fc "
+                f"projection), got {in_sizes[0]} — reference LstmLayer "
+                "semantics")
+        r1, r2 = jax.random.split(rng)
+        p = {"w": _winit(cfg.get("param_attr"), 1.0 / math.sqrt(d))(r1, (d, 4 * d))}
+        # bias layout (reference LstmLayer): 4*size gate bias + 3*size peepholes
+        if cfg.get("bias_attr", True) is not False:
+            p["b"] = jnp.zeros((7 * d,), dtypes.param_dtype())
+        return p
+
+    def apply(self, ctx, cfg, params, x):
+        d = cfg["size"]
+        b = params.get("b")
+        bias = b[:4 * d] if b is not None else None
+        ci = b[4 * d:5 * d] if b is not None else None
+        cf = b[5 * d:6 * d] if b is not None else None
+        co = b[6 * d:] if b is not None else None
+        out, _ = rnn_ops.lstm(as_seq(x), params["w"], bias=bias,
+                              check_i=ci, check_f=cf, check_o=co,
+                              reverse=cfg.get("reverse", False),
+                              act=cfg.get("act", "tanh"),
+                              gate_act=cfg.get("gate_act", "sigmoid"),
+                              state_act=cfg.get("state_act", "tanh"))
+        return out
+
+
+register_layer("lstmemory")(_LstmImpl)
+
+
+def lstmemory(input, size=None, reverse=False, act="tanh",
+              gate_act="sigmoid", state_act="tanh", name=None,
+              bias_attr=True, param_attr=None):
+    d = size or input.size // 4
+    return LayerOutput(name or auto_name("lstmemory"), "lstmemory", d, [input],
+                       {"size": d, "reverse": reverse, "act": act,
+                        "gate_act": gate_act, "state_act": state_act,
+                        "bias_attr": bias_attr, "param_attr": param_attr},
+                       is_seq=True)
+
+
+class _GruImpl:
+    def infer(self, cfg, in_sizes):
+        return cfg["size"]
+
+    def init(self, rng, cfg, in_sizes):
+        d = cfg["size"]
+        if in_sizes[0] != 3 * d:
+            raise ConfigError(
+                f"grumemory input must be 3*size={3 * d} wide, got {in_sizes[0]}")
+        r1, r2, r3 = jax.random.split(rng, 3)
+        wi = _winit(cfg.get("param_attr"), 1.0 / math.sqrt(d))
+        p = {"w_gate": wi(r1, (d, 2 * d)), "w_state": wi(r2, (d, d))}
+        if cfg.get("bias_attr", True) is not False:
+            p["b"] = jnp.zeros((3 * d,), dtypes.param_dtype())
+        return p
+
+    def apply(self, ctx, cfg, params, x):
+        out, _ = rnn_ops.gru(as_seq(x), params["w_gate"], params["w_state"],
+                             bias=params.get("b"),
+                             reverse=cfg.get("reverse", False),
+                             act=cfg.get("act", "tanh"),
+                             gate_act=cfg.get("gate_act", "sigmoid"))
+        return out
+
+
+register_layer("grumemory")(_GruImpl)
+
+
+def grumemory(input, size=None, reverse=False, act="tanh",
+              gate_act="sigmoid", name=None, bias_attr=True, param_attr=None):
+    d = size or input.size // 3
+    return LayerOutput(name or auto_name("grumemory"), "grumemory", d, [input],
+                       {"size": d, "reverse": reverse, "act": act,
+                        "gate_act": gate_act, "bias_attr": bias_attr,
+                        "param_attr": param_attr}, is_seq=True)
+
+
+class _SimpleRnnImpl:
+    def infer(self, cfg, in_sizes):
+        return cfg["size"]
+
+    def init(self, rng, cfg, in_sizes):
+        d = cfg["size"]
+        p = {"w": _winit(cfg.get("param_attr"), 1.0 / math.sqrt(d))(rng, (d, d))}
+        if cfg.get("bias_attr", True) is not False:
+            p["b"] = jnp.zeros((d,), dtypes.param_dtype())
+        return p
+
+    def apply(self, ctx, cfg, params, x):
+        out, _ = rnn_ops.simple_rnn(as_seq(x), params["w"],
+                                    bias=params.get("b"),
+                                    reverse=cfg.get("reverse", False),
+                                    act=cfg.get("act", "tanh"))
+        return out
+
+
+register_layer("recurrent")(_SimpleRnnImpl)
+
+
+def recurrent_layer(input, act="tanh", reverse=False, name=None,
+                    bias_attr=True, param_attr=None):
+    """Reference RecurrentLayer: h_t = act(x_t + W h_{t-1})."""
+    return LayerOutput(name or auto_name("recurrent"), "recurrent",
+                       input.size, [input],
+                       {"size": input.size, "act": act, "reverse": reverse,
+                        "bias_attr": bias_attr, "param_attr": param_attr},
+                       is_seq=True)
+
+
+# ----------------------------------------------------- recurrent_group
+
+class StaticInput:
+    """Whole-layer input visible unchanged at every step (reference
+    StaticInput for recurrent_group; used for the encoder context in
+    simple_attention)."""
+
+    def __init__(self, input, is_seq=False):
+        self.input = input
+        self.is_seq = is_seq  # True: the step sees the whole sequence
+
+
+class _GroupBuildCtx:
+    current = None
+
+    def __init__(self):
+        self.memories = []  # list of (placeholder, link_name, boot, init_zero)
+
+
+def memory(name, size, boot_layer=None, boot_with_const_id=None,
+           is_seq=False):
+    """Previous-step output of the layer called `name` (reference memory()
+    with boot layers, RecurrentGradientMachine memory frames :715)."""
+    g = _GroupBuildCtx.current
+    if g is None:
+        raise ConfigError("memory() must be called inside recurrent_group's step")
+    ph = LayerOutput(auto_name(f"mem_{name}"), "__memory__", size, [],
+                     {"link": name}, is_seq=False)
+    g.memories.append((ph, name, boot_layer, boot_with_const_id))
+    return ph
+
+
+def recurrent_group(step, input, reverse=False, name=None):
+    """Build the step sub-graph once, compile to a scan (see module doc).
+
+    input: one or a list of sequence LayerOutputs and/or StaticInputs.
+    step: fn(*step_inputs) -> LayerOutput or tuple of LayerOutputs.
+    """
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    seq_inputs, static_inputs = [], []
+    step_args = []
+    for item in ins:
+        if isinstance(item, StaticInput):
+            ph = LayerOutput(auto_name("static_in"), "__static__",
+                             item.input.size, [], {}, is_seq=item.is_seq)
+            static_inputs.append((ph, item))
+            step_args.append(ph)
+        else:
+            if not item.is_seq:
+                raise ConfigError(
+                    f"recurrent_group input {item.name} is not a sequence; "
+                    "wrap non-sequence inputs in StaticInput")
+            ph = LayerOutput(auto_name("step_in"), "__step_input__",
+                             item.size, [], {}, is_seq=False)
+            seq_inputs.append((ph, item))
+            step_args.append(ph)
+
+    g = _GroupBuildCtx()
+    prev = _GroupBuildCtx.current
+    _GroupBuildCtx.current = g
+    try:
+        outs = step(*step_args)
+    finally:
+        _GroupBuildCtx.current = prev
+    outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+    # resolve memory links: each memory's `link` names a layer in the step
+    # graph; collect all step nodes to find them
+    sub_topo = Topology(outs)
+    by_name = {n.name: n for n in sub_topo.order}
+    links = []
+    for ph, link_name, boot, boot_const in g.memories:
+        if link_name not in by_name:
+            raise ConfigError(
+                f"memory(name={link_name!r}) has no matching layer in the "
+                f"step function (have {sorted(by_name)})")
+        links.append((ph, by_name[link_name], boot, boot_const))
+
+    group_inputs = ([real for _, real in seq_inputs]
+                    + [s.input for _, s in static_inputs]
+                    + [b for _, _, b, _ in links if isinstance(b, LayerOutput)])
+
+    cfg = {
+        "sub_topo": sub_topo,
+        "outs": outs,
+        "seq_phs": [ph for ph, _ in seq_inputs],
+        "static_phs": [ph for ph, _ in static_inputs],
+        "links": links,
+        "reverse": reverse,
+        "n_seq": len(seq_inputs),
+        "n_static": len(static_inputs),
+    }
+    node = LayerOutput(name or auto_name("recurrent_group"),
+                       "recurrent_group", outs[0].size, group_inputs, cfg,
+                       is_seq=True)
+    node.cfg["self_name"] = node.name
+    return node
+
+
+class _RecurrentGroupImpl:
+    def infer(self, cfg, in_sizes):
+        return cfg["outs"][0].size
+
+    def init(self, rng, cfg, in_sizes):
+        return {"__sub__": cfg["sub_topo"].init(rng)}
+
+    def apply(self, ctx, cfg, params, *inputs):
+        sub_topo: Topology = cfg["sub_topo"]
+        n_seq, n_static = cfg["n_seq"], cfg["n_static"]
+        seqs = [as_seq(v) for v in inputs[:n_seq]]
+        statics = list(inputs[n_seq:n_seq + n_static])
+        boots = list(inputs[n_seq + n_static:])
+        sub_params = params["__sub__"]
+
+        ref = seqs[0]
+        bsz = ref.data.shape[0]
+
+        # boot memories
+        boot_vals = []
+        bi = 0
+        for ph, link_node, boot, boot_const in cfg["links"]:
+            if isinstance(boot, LayerOutput):
+                boot_vals.append(value_data(boots[bi]))
+                bi += 1
+            elif boot_const is not None:
+                boot_vals.append(jnp.full((bsz, ph.size), float(boot_const)))
+            else:
+                boot_vals.append(jnp.zeros((bsz, ph.size)))
+
+        mode, rng_ = ctx.mode, ctx.rng
+
+        def step_fn(mems, frames):
+            feed = {}
+            for ph, frame in zip(cfg["seq_phs"], frames):
+                feed[ph.name] = frame
+            for ph, s in zip(cfg["static_phs"], statics):
+                feed[ph.name] = s
+            for (ph, _, _, _), m in zip(cfg["links"], mems):
+                feed[ph.name] = m
+            out_vals = sub_topo.apply(sub_params, feed, mode=mode, rng=rng_)
+            out_vals = out_vals if isinstance(out_vals, tuple) else (out_vals,)
+            cache = dict(zip((o.name for o in cfg["outs"]), out_vals))
+            # recompute memory-link values: links name step-graph layers;
+            # get them via extra outputs
+            new_mems = []
+            for ph, link_node, _, _ in cfg["links"]:
+                if link_node.name in cache:
+                    new_mems.append(value_data(cache[link_node.name]))
+                else:
+                    # link to an intermediate layer: evaluate with it as output
+                    v = Topology([link_node]).apply(sub_params, feed,
+                                                    mode=mode, rng=rng_)
+                    new_mems.append(value_data(v))
+            return tuple(new_mems), tuple(value_data(v) for v in out_vals)
+
+        outs, _ = rnn_ops.recurrent_group(step_fn, tuple(seqs),
+                                          tuple(boot_vals),
+                                          reverse=cfg["reverse"])
+        # rnn_ops.recurrent_group maps over the input pytree; our step_fn
+        # consumed a tuple of SequenceBatches and returned tuples
+        if isinstance(outs, tuple) and len(outs) == 1:
+            result = outs[0]
+        else:
+            result = outs
+        ctx.aux[cfg["self_name"] + "/outputs"] = result
+        return result[0] if isinstance(result, tuple) else result
+
+
+register_layer("recurrent_group")(_RecurrentGroupImpl)
+
+
+class _MemoryPlaceholderImpl:
+    def infer(self, cfg, in_sizes):
+        return 0
+
+    def apply(self, ctx, cfg, params, *ins):
+        raise RuntimeError("memory placeholders are fed by the group engine")
+
+
+register_layer("__memory__")(_MemoryPlaceholderImpl)
+register_layer("__step_input__")(_MemoryPlaceholderImpl)
+register_layer("__static__")(_MemoryPlaceholderImpl)
+
+
+def get_output_layer(input, arg_name=None, name=None, index=1):
+    """Fetch a secondary output of a recurrent_group (reference
+    GetOutputLayer).  index selects among the step function's outputs."""
+    return LayerOutput(name or auto_name("get_output"), "get_output",
+                       input.cfg["outs"][index].size, [input],
+                       {"index": index, "group": input.cfg["self_name"]},
+                       is_seq=True)
+
+
+class _GetOutputImpl:
+    def infer(self, cfg, in_sizes):
+        return in_sizes[0]
+
+    def apply(self, ctx, cfg, params, group_out):
+        outs = ctx.aux.get(cfg["group"] + "/outputs")
+        if not isinstance(outs, tuple):
+            raise ConfigError("get_output_layer: group has a single output")
+        return outs[cfg["index"]]
+
+
+register_layer("get_output")(_GetOutputImpl)
+
+
+# ----------------------------------------------------- step layers
+
+class _LstmStepImpl:
+    """One LSTM step as a layer (reference LstmStepLayer), for custom
+    recurrent groups: inputs = (gate_input [B,4D], prev_state [B,D]);
+    outputs h (primary); the cell state is exposed as output index 1 via
+    a paired state node."""
+
+    def infer(self, cfg, in_sizes):
+        return cfg["size"]
+
+    def init(self, rng, cfg, in_sizes):
+        d = cfg["size"]
+        if cfg.get("bias_attr", True) is False:
+            return {}
+        return {"b": jnp.zeros((7 * d,), dtypes.param_dtype())}
+
+    def apply(self, ctx, cfg, params, x4, prev_state):
+        d = cfg["size"]
+        b = params.get("b")
+        x4d, prev = value_data(x4), value_data(prev_state)
+        if b is not None:
+            x4d = x4d + b[:4 * d]
+        ci = b[4 * d:5 * d] if b is not None else None
+        cf = b[5 * d:6 * d] if b is not None else None
+        co = b[6 * d:] if b is not None else None
+        # prev_state carries [h | c] concatenated (2D wide)
+        h_prev, c_prev = prev[..., :d], prev[..., d:]
+        st = rnn_ops.lstm_cell(
+            x4d, rnn_ops.LstmState(h=h_prev, c=c_prev),
+            jnp.zeros((d, 4 * d), x4d.dtype),  # recurrence is in the mixed input
+            check_i=ci, check_f=cf, check_o=co,
+            act=cfg.get("act", "tanh"), gate_act=cfg.get("gate_act", "sigmoid"),
+            state_act=cfg.get("state_act", "tanh"))
+        return jnp.concatenate([st.h, st.c], axis=-1)
+
+
+register_layer("lstm_step")(_LstmStepImpl)
+
+
+def lstm_step_layer(input, state, size=None, act="tanh", gate_act="sigmoid",
+                    state_act="tanh", name=None, bias_attr=True):
+    d = size or input.size // 4
+    return LayerOutput(name or auto_name("lstm_step"), "lstm_step", 2 * d,
+                       [input, state],
+                       {"size": d, "act": act, "gate_act": gate_act,
+                        "state_act": state_act, "bias_attr": bias_attr})
+
+
+class _GruStepImpl:
+    def infer(self, cfg, in_sizes):
+        return cfg["size"]
+
+    def init(self, rng, cfg, in_sizes):
+        d = cfg["size"]
+        r1, r2 = jax.random.split(rng)
+        wi = _winit(cfg.get("param_attr"), 1.0 / math.sqrt(d))
+        p = {"w_gate": wi(r1, (d, 2 * d)), "w_state": wi(r2, (d, d))}
+        if cfg.get("bias_attr", True) is not False:
+            p["b"] = jnp.zeros((3 * d,), dtypes.param_dtype())
+        return p
+
+    def apply(self, ctx, cfg, params, x3, prev):
+        x3d, h_prev = value_data(x3), value_data(prev)
+        if "b" in params:
+            x3d = x3d + params["b"]
+        return rnn_ops.gru_cell(x3d, h_prev, params["w_gate"],
+                                params["w_state"], act=cfg.get("act", "tanh"),
+                                gate_act=cfg.get("gate_act", "sigmoid"))
+
+
+register_layer("gru_step")(_GruStepImpl)
+
+
+def gru_step_layer(input, output_mem, size=None, act="tanh",
+                   gate_act="sigmoid", name=None, bias_attr=True,
+                   param_attr=None):
+    d = size or input.size // 3
+    return LayerOutput(name or auto_name("gru_step"), "gru_step", d,
+                       [input, output_mem],
+                       {"size": d, "act": act, "gate_act": gate_act,
+                        "bias_attr": bias_attr, "param_attr": param_attr})

@@ -1,0 +1,24 @@
+"""Functional op zoo — the TPU-native equivalent of the reference's
+gserver/layers kernels + paddle/function ops + hl_* device layer, with
+autodiff replacing every hand-written backward."""
+
+from paddle_tpu.ops import activations
+from paddle_tpu.ops import attention
+from paddle_tpu.ops import conv
+from paddle_tpu.ops import crf
+from paddle_tpu.ops import ctc
+from paddle_tpu.ops import embedding
+from paddle_tpu.ops import initializers
+from paddle_tpu.ops import linear
+from paddle_tpu.ops import losses
+from paddle_tpu.ops import math_ops
+from paddle_tpu.ops import norm
+from paddle_tpu.ops import rnn
+from paddle_tpu.ops import sampling
+from paddle_tpu.ops import sequence
+
+__all__ = [
+    "activations", "attention", "conv", "crf", "ctc", "embedding",
+    "initializers", "linear", "losses", "math_ops", "norm", "rnn",
+    "sampling", "sequence",
+]

@@ -1,0 +1,88 @@
+"""Activation registry.
+
+Reference: paddle/gserver/activations/ActivationFunction.cpp:94-405 registers
+13 activations: sigmoid, softmax, sequence_softmax, relu, brelu, tanh, stanh,
+softrelu, abs, square, exponential, log (+ linear/identity).  Hand-written
+backward passes there are replaced by autodiff here.
+"""
+
+import jax
+import jax.numpy as jnp
+
+_REGISTRY = {}
+
+
+def register(name):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def get(name):
+    if callable(name):
+        return name
+    if name in (None, "", "linear", "identity"):
+        return lambda x: x
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"unknown activation {name!r}; have {sorted(_REGISTRY)}")
+
+
+def names():
+    return sorted(_REGISTRY)
+
+
+register("sigmoid")(jax.nn.sigmoid)
+register("relu")(jax.nn.relu)
+register("tanh")(jnp.tanh)
+register("abs")(jnp.abs)
+register("square")(jnp.square)
+register("exponential")(jnp.exp)
+
+
+@register("softmax")
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register("log")
+def log(x):
+    return jnp.log(jnp.maximum(x, 1e-20))
+
+
+@register("brelu")
+def brelu(x):
+    # reference BReluActivation: min(max(x, 0), 24)
+    return jnp.clip(x, 0.0, 24.0)
+
+
+@register("softrelu")
+def softrelu(x):
+    # reference SoftReluActivation: log(1 + exp(clip(x, -40, 40)))
+    return jnp.log1p(jnp.exp(jnp.clip(x, -40.0, 40.0)))
+
+
+@register("stanh")
+def stanh(x):
+    # reference STanhActivation: 1.7159 * tanh(2/3 x)
+    return 1.7159 * jnp.tanh((2.0 / 3.0) * x)
+
+
+def sequence_softmax(x, mask):
+    """Softmax over the time axis of a padded [B, T] (or [B, T, 1]) batch.
+
+    Reference SequenceSoftmaxActivation normalizes within each ragged
+    sequence; here padding is masked out before the softmax.
+    """
+    squeeze = False
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = x[..., 0]
+        squeeze = True
+    x = jnp.where(mask > 0, x, -jnp.inf)
+    out = jax.nn.softmax(x, axis=-1)
+    out = jnp.where(mask > 0, out, 0.0)
+    if squeeze:
+        out = out[..., None]
+    return out

@@ -1,0 +1,80 @@
+"""Attention ops.
+
+Reference: `simple_attention` composite (trainer_config_helpers/networks.py:1273)
+— additive (Bahdanau) attention built from fc + sequence ops for the seqToseq
+NMT demo.  Plus TPU-era capabilities the reference lacks: scaled dot-product
+multi-head attention (for the Transformer model family) with masking, built
+to fuse on the MXU; the sequence-parallel ring variant lives in
+paddle_tpu.parallel.ring_attention.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops.linear import matmul
+
+_NEG = -1e30
+
+
+def additive_attention_scores(enc_proj: SequenceBatch, dec_state_proj, v):
+    """Bahdanau scores: v . tanh(enc_proj + dec_proj).
+
+    enc_proj.data: [B, T, A] (precomputed once per sequence — hoisted out of
+    the decode loop, as the reference does with encoded_proj), dec_state_proj:
+    [B, A], v: [A] -> [B, T] masked scores.
+    """
+    e = jnp.tanh(enc_proj.data + dec_state_proj[:, None, :])
+    scores = jnp.einsum("bta,a->bt", e, v)
+    return jnp.where(enc_proj.bool_mask(), scores, _NEG)
+
+
+def attention_context(scores, values: SequenceBatch):
+    """softmax(scores) @ values -> [B, D]."""
+    w = jax.nn.softmax(scores, axis=-1)
+    w = w * values.mask(w.dtype)
+    w = w / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), 1e-9)
+    return jnp.einsum("bt,btd->bd", w, values.data)
+
+
+def dot_product_attention(q, k, v, mask=None, scale=None, causal=False):
+    """q: [B, H, Tq, Dh], k/v: [B, H, Tk, Dh] -> [B, H, Tq, Dh].
+
+    Softmax in f32 (TPU numerics), logits computed on the MXU in bf16.
+    """
+    dh = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(float(dh))
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = logits.shape[-2:]
+        cm = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        logits = jnp.where(cm, logits, _NEG)
+    if mask is not None:
+        logits = jnp.where(mask, logits, _NEG)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w.astype(v.dtype), v)
+
+
+def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
+                         causal=False):
+    """Dense multi-head attention.  x_q: [B, Tq, D], x_kv: [B, Tk, D],
+    wq/wk/wv: [D, D], wo: [D, D]."""
+    b, tq, d = x_q.shape
+    tk = x_kv.shape[1]
+    dh = d // num_heads
+
+    def split(x, w, t):
+        return matmul(x, w).reshape(b, t, num_heads, dh).transpose(0, 2, 1, 3)
+
+    q = split(x_q, wq, tq)
+    k = split(x_kv, wk, tk)
+    v = split(x_kv, wv, tk)
+    out = dot_product_attention(q, k, v, mask=mask, causal=causal)
+    out = out.transpose(0, 2, 1, 3).reshape(b, tq, d)
+    return matmul(out, wo)
+
+
+def padding_mask(q_len_mask, k_len_mask):
+    """[B, Tq], [B, Tk] -> [B, 1, Tq, Tk] boolean attention mask."""
+    return (q_len_mask[:, None, :, None] > 0) & (k_len_mask[:, None, None, :] > 0)

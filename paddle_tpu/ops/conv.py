@@ -1,0 +1,205 @@
+"""Convolution / vision ops, NHWC (TPU-native layout).
+
+Reference CNN stack (SURVEY.md §2.2 "Conv/vision"): ExpandConvLayer (im2col)
+and CudnnConvLayer, PoolLayer/CudnnPoolLayer (max/avg), NormLayer (LRN
+cross-map), MaxOutLayer, BilinearInterpLayer, BlockExpandLayer,
+SpatialPyramidPoolLayer, PadLayer, conv output-size calc
+(math/MathUtils.cpp outputSize).  The dual plain/cudnn variants collapse
+into one XLA `conv_general_dilated` path that the compiler tiles onto the
+MXU; im2col disappears.
+
+Layout note: the reference flattens images row-major as [C, H, W] per sample.
+All ops here take/return NHWC; layer wrappers do the flat<->NHWC reshapes.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtypes
+from paddle_tpu.ops import activations
+
+_DN = ("NHWC", "HWIO", "NHWC")
+
+
+def conv_output_size(in_size, filter_size, stride, padding):
+    """Reference math/MathUtils.cpp outputSize (caffeMode=True):
+    (in + 2*pad - filter) / stride + 1."""
+    return (in_size + 2 * padding - filter_size) // stride + 1
+
+
+def conv2d(x, w, b=None, stride=(1, 1), padding=(0, 0), groups=1,
+           dilation=(1, 1), act=None):
+    """x: [B, H, W, Cin], w: [kh, kw, Cin/groups, Cout] -> [B, H', W', Cout]."""
+    cd = dtypes.compute_dtype()
+    pad = ((padding[0], padding[0]), (padding[1], padding[1]))
+    y = jax.lax.conv_general_dilated(
+        x.astype(cd), w.astype(cd),
+        window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=_DN,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32)
+    if b is not None:
+        y = y + b
+    return activations.get(act)(y)
+
+
+def conv2d_transpose(x, w, b=None, stride=(1, 1), padding=(0, 0), act=None):
+    """Gradient-of-conv deconvolution (reference ExpandConvTransLayer).
+    w: [kh, kw, Cout, Cin] stored like the forward conv's weight."""
+    cd = dtypes.compute_dtype()
+    kh, kw = w.shape[0], w.shape[1]
+    pad = ((kh - 1 - padding[0], kh - 1 - padding[0]),
+           (kw - 1 - padding[1], kw - 1 - padding[1]))
+    y = jax.lax.conv_general_dilated(
+        x.astype(cd), jnp.flip(w, (0, 1)).swapaxes(2, 3).astype(cd),
+        window_strides=(1, 1), padding=pad,
+        lhs_dilation=stride, dimension_numbers=_DN,
+        preferred_element_type=jnp.float32)
+    if b is not None:
+        y = y + b
+    return activations.get(act)(y)
+
+
+def _pool_pad(padding):
+    """(ph, pw) symmetric or ((plo,phi),(plo,phi)) asymmetric (asymmetric
+    covers the reference's ceil-mode output sizes)."""
+    ph, pw = padding
+    ph = ph if isinstance(ph, (tuple, list)) else (ph, ph)
+    pw = pw if isinstance(pw, (tuple, list)) else (pw, pw)
+    return ((0, 0), tuple(ph), tuple(pw), (0, 0))
+
+
+def max_pool2d(x, window, stride=None, padding=(0, 0)):
+    stride = stride or window
+    pad = _pool_pad(padding)
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max,
+        (1, window[0], window[1], 1), (1, stride[0], stride[1], 1), pad)
+
+
+def avg_pool2d(x, window, stride=None, padding=(0, 0), exclude_pad=True):
+    stride = stride or window
+    pad = _pool_pad(padding)
+    summed = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add,
+        (1, window[0], window[1], 1), (1, stride[0], stride[1], 1), pad)
+    if exclude_pad and any(p for dims in pad for p in dims):
+        ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+        cnt = jax.lax.reduce_window(
+            ones, 0.0, jax.lax.add,
+            (1, window[0], window[1], 1), (1, stride[0], stride[1], 1), pad)
+        return summed / jnp.maximum(cnt, 1.0)
+    return summed / float(window[0] * window[1])
+
+
+def lrn_cross_map(x, size=5, scale=1e-4, power=0.75):
+    """Local response norm across channels (reference NormProjectionLayer,
+    'cmrnorm-projection'): out = x * (1 + scale/size * sum(x^2))^-power."""
+    sq = jnp.square(x)
+    half = size // 2
+    padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, size - 1 - half)))
+    acc = jnp.cumsum(padded, axis=-1)
+    zeros = jnp.zeros_like(acc[..., :1])
+    acc = jnp.concatenate([zeros, acc], axis=-1)
+    window = acc[..., size:] - acc[..., :-size]
+    denom = (1.0 + (scale / size) * window) ** power
+    return x / denom
+
+
+def cross_channel_norm(x, scale):
+    """L2-normalize across channels then scale per-channel (reference
+    CrossChannelNormLayer, SSD)."""
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True) + 1e-10)
+    return x / norm * scale
+
+
+def maxout(x, groups):
+    """Channel maxout (reference MaxOutLayer): Cout = Cin/groups."""
+    b, h, w, c = x.shape
+    return jnp.max(x.reshape(b, h, w, c // groups, groups), axis=-1)
+
+
+def bilinear_interp(x, out_h, out_w):
+    """Bilinear resize (reference BilinearInterpLayer)."""
+    return jax.image.resize(x, (x.shape[0], out_h, out_w, x.shape[3]),
+                            method="bilinear")
+
+
+def pad_chw(x, pad_c=(0, 0), pad_h=(0, 0), pad_w=(0, 0)):
+    """Reference PadLayer pads (C, H, W) of NCHW; here NHWC."""
+    return jnp.pad(x, ((0, 0), pad_h, pad_w, pad_c))
+
+
+def block_expand(x, block, stride, padding=(0, 0)):
+    """im2col as a layer (reference BlockExpandLayer): NHWC ->
+    [B, num_blocks, block_h*block_w*C] patch sequence."""
+    bh, bw = block
+    pad = ((0, 0), (padding[0], padding[0]), (padding[1], padding[1]), (0, 0))
+    xp = jnp.pad(x, pad)
+    patches = jax.lax.conv_general_dilated_patches(
+        xp.transpose(0, 3, 1, 2), (bh, bw), stride, "VALID")
+    # patches: [B, C*bh*bw, oh, ow]
+    b, f, oh, ow = patches.shape
+    return patches.reshape(b, f, oh * ow).transpose(0, 2, 1)
+
+
+def adaptive_pool2d(x, bins, pool_type="max"):
+    """Pool NHWC to an exact [B, bins, bins, C] regardless of input size
+    (uneven windows like torch AdaptiveMaxPool; bins is static so the
+    slice loop unrolls at trace time)."""
+    b, h, w, c = x.shape
+    reduce_fn = (lambda v: jnp.max(v, axis=(1, 2))) if pool_type == "max" \
+        else (lambda v: jnp.mean(v, axis=(1, 2)))
+    rows = []
+    for i in range(bins):
+        hs, he = (i * h) // bins, max(-(-((i + 1) * h) // bins), (i * h) // bins + 1)
+        cols = []
+        for j in range(bins):
+            ws, we = (j * w) // bins, max(-(-((j + 1) * w) // bins), (j * w) // bins + 1)
+            cols.append(reduce_fn(x[:, hs:he, ws:we, :]))
+        rows.append(jnp.stack(cols, axis=1))
+    return jnp.stack(rows, axis=1)  # [B, bins, bins, C]
+
+
+def spatial_pyramid_pool(x, pyramid_height, pool_type="max"):
+    """Reference SpatialPyramidPoolLayer: concat pooled maps at scales
+    1x1, 2x2, ... 2^(h-1) bins.  Output width is fixed at
+    C * sum(4^level) regardless of the input's spatial size — the whole
+    point of SPP — via adaptive (uneven-window) pooling."""
+    b = x.shape[0]
+    outs = []
+    for level in range(pyramid_height):
+        bins = 2 ** level
+        outs.append(adaptive_pool2d(x, bins, pool_type).reshape(b, -1))
+    return jnp.concatenate(outs, axis=-1)
+
+
+def prior_box(feature_shape, image_shape, min_sizes, max_sizes, aspect_ratios,
+              variance=(0.1, 0.1, 0.2, 0.2), clip=True):
+    """SSD prior boxes (reference PriorBox layer).  Pure numpy-style compute,
+    returns [num_priors, 4(+4 var)] center-size encoded corners."""
+    fh, fw = feature_shape
+    ih, iw = image_shape
+    step_h, step_w = ih / fh, iw / fw
+    boxes = []
+    for y in range(fh):
+        for x in range(fw):
+            cx, cy = (x + 0.5) * step_w, (y + 0.5) * step_h
+            for k, ms in enumerate(min_sizes):
+                boxes.append([cx - ms / 2, cy - ms / 2, cx + ms / 2, cy + ms / 2])
+                if max_sizes:
+                    sz = (ms * max_sizes[k]) ** 0.5
+                    boxes.append([cx - sz / 2, cy - sz / 2, cx + sz / 2, cy + sz / 2])
+                for ar in aspect_ratios:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    for a in (ar, 1.0 / ar):
+                        bw_, bh_ = ms * a ** 0.5, ms / a ** 0.5
+                        boxes.append([cx - bw_ / 2, cy - bh_ / 2,
+                                      cx + bw_ / 2, cy + bh_ / 2])
+    boxes = jnp.asarray(boxes)
+    boxes = boxes / jnp.asarray([iw, ih, iw, ih])
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance), boxes.shape)
+    return jnp.concatenate([boxes, var], axis=-1)

@@ -1,0 +1,97 @@
+"""Linear-chain CRF: log-likelihood + Viterbi decode.
+
+Reference: CRFLayer + LinearChainCRF (gserver/layers/LinearChainCRF.{h,cpp}),
+CRFDecodingLayer.  The reference parameter is a [(N+2), N] matrix: row 0 =
+start transition a, row 1 = end transition b, rows 2.. = transition w[i][j]
+(from tag i to tag j).  Same layout kept here so checkpoints are comparable.
+
+Forward/backward over time = `lax.scan` with logsumexp carries; Viterbi =
+scan with max+argmax carries and a reverse traceback scan.  Autodiff
+provides the gradient of the partition function (the reference hand-codes
+the forward-backward recursions).
+"""
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def _split_params(w):
+    """w: [N+2, N] -> (start [N], end [N], trans [N, N])."""
+    return w[0], w[1], w[2:]
+
+
+def crf_log_likelihood(emissions, tags, lengths, w):
+    """Negative log-likelihood per sequence.
+
+    emissions: [B, T, N] unnormalized scores (the reference feeds raw layer
+    output, no softmax), tags: int [B, T], lengths: [B], w: [N+2, N].
+    Returns [B] loss = log Z - score(tags).
+    """
+    start, end, trans = _split_params(w)
+    b, t, n = emissions.shape
+    mask = (jnp.arange(t)[None, :] < lengths[:, None])
+
+    # --- partition function: alpha recursion ---
+    alpha0 = start[None, :] + emissions[:, 0]
+
+    def fwd(alpha, xs):
+        emit, m = xs
+        # alpha': logsumexp_i(alpha_i + trans_ij) + emit_j
+        scores = alpha[:, :, None] + trans[None, :, :]
+        new = jax.nn.logsumexp(scores, axis=1) + emit
+        return jnp.where(m[:, None], new, alpha), None
+
+    emits_tm = emissions.transpose(1, 0, 2)[1:]
+    mask_tm = mask.transpose(1, 0)[1:]
+    alpha_final, _ = jax.lax.scan(fwd, alpha0, (emits_tm, mask_tm))
+    log_z = jax.nn.logsumexp(alpha_final + end[None, :], axis=-1)
+
+    # --- gold path score ---
+    tags = jnp.clip(tags.astype(jnp.int32), 0, n - 1)
+    emit_scores = jnp.take_along_axis(emissions, tags[..., None], axis=-1)[..., 0]
+    emit_score = jnp.sum(emit_scores * mask, axis=-1)
+    trans_scores = trans[tags[:, :-1], tags[:, 1:]]
+    trans_score = jnp.sum(trans_scores * mask[:, 1:], axis=-1)
+    first_score = start[tags[:, 0]]
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_tag = jnp.take_along_axis(tags, last_idx[:, None], axis=1)[:, 0]
+    last_score = end[last_tag]
+    gold = emit_score + trans_score + first_score + last_score
+    return log_z - gold
+
+
+def crf_decode(emissions, lengths, w):
+    """Viterbi decode -> (tags [B, T] int32, best_score [B])."""
+    start, end, trans = _split_params(w)
+    b, t, n = emissions.shape
+    mask = (jnp.arange(t)[None, :] < lengths[:, None])
+
+    delta0 = start[None, :] + emissions[:, 0]
+
+    def fwd(delta, xs):
+        emit, m = xs
+        scores = delta[:, :, None] + trans[None, :, :]      # [B, N, N]
+        best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        new = jnp.max(scores, axis=1) + emit
+        delta_out = jnp.where(m[:, None], new, delta)
+        # where masked, traceback points to self so the path freezes
+        bp = jnp.where(m[:, None], best_prev,
+                       jnp.arange(n, dtype=jnp.int32)[None, :])
+        return delta_out, bp
+
+    emits_tm = emissions.transpose(1, 0, 2)[1:]
+    mask_tm = mask.transpose(1, 0)[1:]
+    delta_final, bps = jax.lax.scan(fwd, delta0, (emits_tm, mask_tm))
+    final_scores = delta_final + end[None, :]
+    best_last = jnp.argmax(final_scores, axis=-1).astype(jnp.int32)
+    best_score = jnp.max(final_scores, axis=-1)
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, tags_rev = jax.lax.scan(back, best_last, bps, reverse=True)
+    tags = jnp.concatenate([tags_rev, best_last[None]], axis=0).transpose(1, 0)
+    return tags * mask.astype(jnp.int32), best_score

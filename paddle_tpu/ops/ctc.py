@@ -1,0 +1,98 @@
+"""CTC loss (dynamic-programming forward), TPU-native.
+
+Reference: CTCLayer + LinearChainCTC (gserver/layers/LinearChainCTC.cpp) and
+WarpCTCLayer (dlopen'd warp-ctc).  Here one implementation: the standard
+alpha recursion over the extended label sequence (blanks interleaved), run as
+`lax.scan` over time in log space, vectorized over batch and label positions
+— no per-sample loops, static shapes, autodiff supplies the gradient.
+
+Convention: blank = 0 by default (the reference uses num_classes as blank in
+warpctc and 0 in LinearChainCTC; configurable here).
+"""
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def ctc_loss(log_probs, logit_lengths, labels, label_lengths, blank=0):
+    """Per-sample CTC negative log-likelihood.
+
+    log_probs: [B, T, C] log-softmax outputs; logit_lengths: [B];
+    labels: int [B, L] (padded with anything); label_lengths: [B].
+    Returns [B] loss.
+    """
+    b, t, c = log_probs.shape
+    l = labels.shape[1]
+    s = 2 * l + 1  # extended sequence: blank label blank label ... blank
+
+    labels = jnp.clip(labels.astype(jnp.int32), 0, c - 1)
+    # extended label sequence ids [B, S]
+    ext = jnp.full((b, s), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+
+    # allowed skip: alpha[s] can come from s-2 if ext[s] != blank and
+    # ext[s] != ext[s-2]
+    ext_prev2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)), constant_values=-1)
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    # positions beyond 2*label_len are invalid
+    pos = jnp.arange(s)[None, :]
+    valid_pos = pos < (2 * label_lengths[:, None] + 1)
+
+    def emit(t_idx):
+        # log_probs at time t for each extended position: [B, S]
+        lp = log_probs[:, t_idx]                   # [B, C]
+        return jnp.take_along_axis(lp, ext, axis=1)
+
+    alpha0 = jnp.full((b, s), _NEG)
+    e0 = emit(0)
+    alpha0 = alpha0.at[:, 0].set(e0[:, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(label_lengths > 0, e0[:, 1], _NEG))
+    alpha0 = jnp.where(valid_pos, alpha0, _NEG)
+
+    def step(alpha, t_idx):
+        stay = alpha
+        prev1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=_NEG)
+        prev2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=_NEG)
+        prev2 = jnp.where(can_skip, prev2, _NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2) + emit(t_idx)
+        merged = jnp.where(valid_pos, merged, _NEG)
+        # freeze past the logit length
+        active = (t_idx < logit_lengths)[:, None]
+        return jnp.where(active, merged, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t))
+
+    # final: last blank or last label position
+    last = 2 * label_lengths  # index of final blank
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(label_lengths > 0, a_prev, _NEG)
+    ll = jnp.logaddexp(a_last, a_prev)
+    return -ll
+
+
+def ctc_greedy_decode(log_probs, logit_lengths, blank=0):
+    """Best-path decode: argmax per step, collapse repeats, drop blanks.
+    Returns (ids [B, T] int32 padded with -1, lengths [B])."""
+    ids = jnp.argmax(log_probs, axis=-1).astype(jnp.int32)   # [B, T]
+    t = ids.shape[1]
+    step_mask = jnp.arange(t)[None, :] < logit_lengths[:, None]
+    prev = jnp.pad(ids[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+    keep = (ids != blank) & (ids != prev) & step_mask
+
+    # stable compaction: position of each kept element
+    kept_pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out = jnp.full_like(ids, -1)
+    scatter_idx = jnp.where(keep, kept_pos, t - 1)
+    # scatter kept ids; colliding writes at t-1 are later overwritten by -1 pad fix
+    out = jax.vmap(lambda o, idx, v, k: o.at[idx].set(jnp.where(k, v, o[idx])))(
+        out, scatter_idx, ids, keep)
+    lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
+    # clean anything at/after length
+    pos = jnp.arange(t)[None, :]
+    out = jnp.where(pos < lengths[:, None], out, -1)
+    return out, lengths

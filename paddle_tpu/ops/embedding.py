@@ -1,0 +1,29 @@
+"""Embedding / table projection.
+
+Reference: TableProjection + SparseRowMatrix family
+(math/SparseRowMatrix.h:29-299, gserver/layers/TableProjection.cpp).  The
+reference's sparse-row prefetch/update machinery becomes a plain gather here;
+sparse *updates* are recovered by the optimizer's sparse-row path
+(paddle_tpu.optim) and by sharding the table over the mesh's model axis for
+large vocabularies (paddle_tpu.parallel.sharding).
+"""
+
+import jax.numpy as jnp
+
+
+def embedding_lookup(table, ids):
+    """table: [vocab, dim], ids: int [...] -> [..., dim].
+
+    Out-of-range ids (e.g. padding -1) return zeros.
+    """
+    valid = (ids >= 0) & (ids < table.shape[0])
+    safe = jnp.clip(ids, 0, table.shape[0] - 1)
+    out = jnp.take(table, safe, axis=0)
+    return out * valid[..., None].astype(out.dtype)
+
+
+def one_hot(ids, depth, dtype=jnp.float32):
+    """Out-of-range ids (padding) give all-zero rows, matching
+    embedding_lookup's convention."""
+    import jax
+    return jax.nn.one_hot(ids, depth, dtype=dtype)

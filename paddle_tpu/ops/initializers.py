@@ -1,0 +1,68 @@
+"""Parameter initializers.
+
+The reference's default is gaussian std = 1/sqrt(fan_in) per ParameterConfig
+(reference: python/paddle/trainer/config_parser.py Parameter() defaults,
+parameter/Parameter.cpp randomize()).  Exposed here as first-class
+initializer fns (rng, shape, dtype) -> array.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtypes
+
+
+def _dtype(dtype):
+    return dtype or dtypes.param_dtype()
+
+
+def constant(value=0.0):
+    def init(rng, shape, dtype=None):
+        return jnp.full(shape, value, dtype=_dtype(dtype))
+    return init
+
+
+def normal(std=None, mean=0.0):
+    """std=None -> reference default 1/sqrt(fan_in) (fan_in = shape[0])."""
+    def init(rng, shape, dtype=None):
+        s = std if std is not None else 1.0 / math.sqrt(max(shape[0], 1))
+        return mean + s * jax.random.normal(rng, shape, dtype=_dtype(dtype))
+    return init
+
+
+def uniform(scale=None):
+    def init(rng, shape, dtype=None):
+        s = scale if scale is not None else 1.0 / math.sqrt(max(shape[0], 1))
+        return jax.random.uniform(rng, shape, dtype=_dtype(dtype), minval=-s, maxval=s)
+    return init
+
+
+def xavier():
+    def init(rng, shape, dtype=None):
+        fan_in = shape[0] if len(shape) >= 1 else 1
+        fan_out = shape[-1] if len(shape) >= 2 else fan_in
+        s = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype=_dtype(dtype), minval=-s, maxval=s)
+    return init
+
+
+def msra(fan_in_axis=0):
+    def init(rng, shape, dtype=None):
+        fan_in = shape[fan_in_axis] if shape else 1
+        s = math.sqrt(2.0 / max(fan_in, 1))
+        return s * jax.random.normal(rng, shape, dtype=_dtype(dtype))
+    return init
+
+
+def conv_default():
+    """Reference conv init: normal with std 1/sqrt(fan_in), fan_in = prod(kernel)*in_ch."""
+    def init(rng, shape, dtype=None):
+        # shape: [kh, kw, in_ch, out_ch]
+        fan_in = 1
+        for d in shape[:-1]:
+            fan_in *= d
+        s = 1.0 / math.sqrt(max(fan_in, 1))
+        return s * jax.random.normal(rng, shape, dtype=_dtype(dtype))
+    return init

@@ -1,0 +1,138 @@
+"""Cost/loss ops.
+
+Reference: gserver/layers/CostLayer.{h,cpp} — MSE (square_error), multi-class
+cross-entropy (+ soft-dist variant), binary CE over multiple labels, huber
+classification/regression, rank cost, lambda-rank, smooth-L1, sum cost — and
+the structured/sampled losses live in crf.py / ctc.py / sampling.py.
+
+All take [B, ...] and return a per-sample loss [B]; callers mean over the
+batch (the reference sums then divides by num sequences in Argument::sum).
+Each is a pure function so autodiff supplies the backward pass.
+"""
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-10
+
+
+def square_error(pred, label):
+    """MSE (reference CostLayer::SumOfSquaresCostLayer): 0.5*||pred-label||^2."""
+    d = pred - label
+    return 0.5 * jnp.sum(d * d, axis=-1)
+
+
+def classification_cost(logits_or_probs, label_ids, *, from_logits=True):
+    """Multi-class CE with integer labels (reference MultiClassCrossEntropy)."""
+    if from_logits:
+        logp = jax.nn.log_softmax(logits_or_probs, axis=-1)
+    else:
+        logp = jnp.log(jnp.maximum(logits_or_probs, _EPS))
+    label_ids = jnp.clip(label_ids.astype(jnp.int32), 0, logp.shape[-1] - 1)
+    return -jnp.take_along_axis(logp, label_ids[..., None], axis=-1)[..., 0]
+
+
+def cross_entropy_with_selfnorm(logits, label_ids, alpha=0.1):
+    """Reference MultiClassCrossEntropyWithSelfNorm: CE + alpha*log(Z)^2."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    ce = classification_cost(logits, label_ids)
+    return ce + alpha * jnp.square(logz)
+
+
+def soft_binary_class_cross_entropy(probs, soft_labels):
+    """Reference SoftBinaryClassCrossEntropy: sum over dims of binary CE
+    against soft targets.  `probs` in (0,1) (apply sigmoid first)."""
+    p = jnp.clip(probs, _EPS, 1.0 - _EPS)
+    return -jnp.sum(soft_labels * jnp.log(p) + (1 - soft_labels) * jnp.log1p(-p), axis=-1)
+
+
+def multi_binary_label_cross_entropy(logits, labels):
+    """Reference MultiBinaryLabelCrossEntropy: sigmoid CE, multi-hot labels."""
+    logp = jax.nn.log_sigmoid(logits)
+    lognotp = jax.nn.log_sigmoid(-logits)
+    return -jnp.sum(labels * logp + (1 - labels) * lognotp, axis=-1)
+
+
+def binary_classification_cost(prob, label):
+    """Two-class CE on a scalar probability output."""
+    p = jnp.clip(prob.reshape(prob.shape[0]), _EPS, 1 - _EPS)
+    y = label.reshape(label.shape[0]).astype(p.dtype)
+    return -(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
+
+
+def rank_cost(left, right, label, weight=None):
+    """Pairwise rank loss (reference RankingCost):
+    C = log(1 + exp(o)) - t*o, o = left - right, t in {0, 0.5, 1}."""
+    o = (left - right).reshape(left.shape[0])
+    t = label.reshape(label.shape[0]).astype(o.dtype)
+    c = jnp.logaddexp(0.0, o) - t * o
+    if weight is not None:
+        c = c * weight.reshape(weight.shape[0])
+    return c
+
+
+def lambda_cost(scores, relevance, mask, ndcg_num=5):
+    """LambdaRank cost over a padded sequence of documents
+    (reference LambdaCost, gserver/layers/CostLayer.cpp).
+
+    scores, relevance, mask: [B, T].  Returns a [B] surrogate whose gradient
+    matches the lambda gradients: for each pair (i, j) with rel_i > rel_j the
+    score difference is pushed by |delta NDCG|.  We compute the standard
+    LambdaRank pairwise logistic with |ΔNDCG| weights, stopping gradients
+    through the weights.
+    """
+    s_i = scores[:, :, None]
+    s_j = scores[:, None, :]
+    r_i = relevance[:, :, None]
+    r_j = relevance[:, None, :]
+    valid = (mask[:, :, None] * mask[:, None, :]) > 0
+    pair = (r_i > r_j) & valid
+
+    # ideal DCG per list (top-ndcg_num), for NDCG normalization
+    topk = jnp.sort(jnp.where(mask > 0, relevance, -jnp.inf), axis=-1)[:, ::-1]
+    k = min(ndcg_num, scores.shape[-1])
+    disc = 1.0 / jnp.log2(jnp.arange(2, k + 2).astype(scores.dtype))
+    ideal = jnp.sum(jnp.where(jnp.isfinite(topk[:, :k]),
+                              (2.0 ** topk[:, :k] - 1) * disc, 0.0), axis=-1)
+    ideal = jnp.maximum(ideal, _EPS)[:, None, None]
+
+    # rank positions by current scores
+    order = jnp.argsort(jnp.argsort(
+        jnp.where(mask > 0, -scores, jnp.inf), axis=-1), axis=-1)  # 0 = best
+    d = 1.0 / jnp.log2(2.0 + order.astype(scores.dtype))
+    gain = 2.0 ** relevance - 1.0
+    delta_ndcg = jnp.abs(
+        (gain[:, :, None] - gain[:, None, :]) *
+        (d[:, :, None] - d[:, None, :])) / ideal
+    w = jax.lax.stop_gradient(jnp.where(pair, delta_ndcg, 0.0))
+    loss = w * jnp.logaddexp(0.0, -(s_i - s_j))
+    return jnp.sum(loss, axis=(1, 2))
+
+
+def huber_regression(pred, label, delta=1.0):
+    d = jnp.abs(pred - label)
+    return jnp.sum(jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta)), axis=-1)
+
+
+def huber_classification(pred, label):
+    """Reference HuberTwoClassification: labels {0,1} -> y in {-1,1}."""
+    y = (2.0 * label.reshape(label.shape[0]) - 1.0).astype(pred.dtype)
+    a = y * pred.reshape(pred.shape[0])
+    return jnp.where(a < -1.0, -4.0 * a, jnp.where(a < 1.0, jnp.square(1.0 - a), 0.0))
+
+
+def smooth_l1(pred, label):
+    d = pred - label
+    ad = jnp.abs(d)
+    return jnp.sum(jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5), axis=-1)
+
+
+def sum_cost(x):
+    """Reference SumCostLayer: just sums the input."""
+    return jnp.sum(x, axis=tuple(range(1, x.ndim)))
+
+
+def masked_seq_mean(per_token_loss, mask):
+    """Average a [B, T] per-token loss over valid tokens, per sample."""
+    tot = jnp.sum(per_token_loss * mask, axis=-1)
+    return tot / jnp.maximum(jnp.sum(mask, axis=-1), 1.0)

@@ -1,0 +1,148 @@
+"""Elementwise / small-algebra layer ops.
+
+Reference zoo (SURVEY.md §2.2 "Dense/basic layers"): AddtoLayer,
+InterpolationLayer, PowerLayer, ScalingLayer, SlopeInterceptLayer,
+ConvexCombinationLayer, SumToOneNormLayer, CosSimLayer, CosSimVecMatLayer,
+OuterProdLayer, TransLayer, RotateLayer, MultiplexLayer, ConvShiftLayer,
+TensorLayer, BilinearInterpLayer(-> conv.py), FeatureMapExpandLayer,
+ResizeLayer, DataNormLayer, ParameterReluLayer.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops import activations
+
+
+def addto(*xs, bias=None, act=None):
+    y = xs[0]
+    for x in xs[1:]:
+        y = y + x
+    if bias is not None:
+        y = y + bias
+    return activations.get(act)(y)
+
+
+def interpolation(w, a, b):
+    """w in [0,1] per-row: w*a + (1-w)*b.  w: [..., 1] or [...]."""
+    if w.ndim == a.ndim - 1:
+        w = w[..., None]
+    return w * a + (1.0 - w) * b
+
+
+def power(p, x):
+    """Per-row exponent: x ** p (reference PowerLayer)."""
+    if p.ndim == x.ndim - 1:
+        p = p[..., None]
+    return x ** p
+
+
+def scaling(s, x):
+    """Per-row scalar scale (reference ScalingLayer)."""
+    if s.ndim == x.ndim - 1:
+        s = s[..., None]
+    return s * x
+
+
+def slope_intercept(x, slope=1.0, intercept=0.0):
+    return slope * x + intercept
+
+
+def sum_to_one_norm(x, eps=1e-12):
+    return x / (jnp.sum(x, axis=-1, keepdims=True) + eps)
+
+
+def cos_sim(a, b, scale=1.0, eps=1e-8):
+    """Row-wise cosine similarity -> [..., 1] (reference CosSimLayer, scale=5)."""
+    dot = jnp.sum(a * b, axis=-1, keepdims=True)
+    na = jnp.sqrt(jnp.sum(a * a, axis=-1, keepdims=True))
+    nb = jnp.sqrt(jnp.sum(b * b, axis=-1, keepdims=True))
+    return scale * dot / jnp.maximum(na * nb, eps)
+
+
+def cos_sim_vec_mat(vec, mat, scale=1.0, eps=1e-8):
+    """vec [B, D], mat [B, K, D] -> [B, K] cos sims (CosSimVecMatLayer)."""
+    dot = jnp.einsum("bd,bkd->bk", vec, mat)
+    nv = jnp.linalg.norm(vec, axis=-1, keepdims=True)
+    nm = jnp.linalg.norm(mat, axis=-1)
+    return scale * dot / jnp.maximum(nv * nm, eps)
+
+
+def outer_prod(a, b):
+    """[B, M], [B, N] -> [B, M*N] (reference OuterProdLayer)."""
+    out = jnp.einsum("bm,bn->bmn", a, b)
+    return out.reshape(out.shape[0], -1)
+
+
+def trans(x):
+    """Matrix transpose of a [H, W]-shaped row batch is meaningless without
+    frame info; reference TransLayer transposes the whole batch matrix."""
+    return x.T
+
+
+def rotate(x, height, width):
+    """Rotate each row's [C, H, W] feature map 90° CCW (reference RotateLayer)."""
+    b = x.shape[0]
+    c = x.shape[-1] // (height * width)
+    img = x.reshape(b, c, height, width)
+    rot = jnp.rot90(img, k=1, axes=(2, 3))
+    return rot.reshape(b, -1)
+
+
+def multiplex(index, *xs):
+    """Per-row select among K same-shaped inputs (reference MultiplexLayer).
+    index: int [B]; xs: K arrays [B, D]."""
+    stacked = jnp.stack(xs, axis=1)          # [B, K, D]
+    idx = jnp.clip(index.astype(jnp.int32), 0, len(xs) - 1)
+    return jnp.take_along_axis(stacked, idx[:, None, None], axis=1)[:, 0]
+
+
+def conv_shift(a, b):
+    """Circular convolution (reference ConvShiftLayer, NTM-style shift).
+    a: [B, M], b: [B, N] (N odd, N<M) -> [B, M]."""
+    m = a.shape[-1]
+    n = b.shape[-1]
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(-half, half + 1)[None, :]) % m
+    gathered = a[:, idx]                      # [B, M, N]
+    return jnp.einsum("bmn,bn->bm", gathered, b)
+
+
+def tensor_product(a, b, w, act=None):
+    """Reference TensorLayer: y_k = a @ W_k @ b^T.
+    a: [B, M], b: [B, N], w: [K, M, N] -> [B, K]."""
+    y = jnp.einsum("bm,kmn,bn->bk", a, w, b)
+    return activations.get(act)(y)
+
+
+def feature_map_expand(x, num_filters, as_row_vector=True):
+    """[B, D] -> [B, num_filters*D] by tiling (reference FeatureMapExpandLayer).
+
+    as_row_vector=True: output = [x; x; ...] (num_filters copies of the whole
+    row). False: each element repeated num_filters times in place
+    ([x0 x num_filters, x1 x num_filters, ...])."""
+    if as_row_vector:
+        tiled = jnp.tile(x[:, None, :], (1, num_filters, 1))
+    else:
+        tiled = jnp.tile(x[:, :, None], (1, 1, num_filters))
+    return tiled.reshape(x.shape[0], -1)
+
+
+def resize(x, size):
+    """Reinterpret batch rows with a new row width (reference ResizeLayer)."""
+    return x.reshape(-1, size)
+
+
+def prelu(x, alpha):
+    """ParameterReluLayer: per-partition learned negative slope."""
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def data_norm(x, mean, std_inv, strategy="z-score", min_=None, span_inv=None):
+    """DataNormLayer: z-score / min-max normalization with precomputed stats."""
+    if strategy == "min-max":
+        return (x - min_) * span_inv
+    return (x - mean) * std_inv
+
+
+def pad_value_replace(x, mask, value=0.0):
+    return jnp.where(mask > 0, x, value)

@@ -1,0 +1,44 @@
+"""Batch normalization.
+
+Reference: BatchNormBaseLayer / BatchNormalizationLayer / CudnnBatchNorm
+(gserver/layers/BatchNorm*.cpp) with use_global_stats switching and
+moving-average accumulation.  Functional form: apply returns (y, new_state)
+so the moving stats thread through the training step as explicit state —
+no mutation, jit-friendly.
+"""
+
+import jax.numpy as jnp
+
+
+def batch_norm_train(x, gamma, beta, moving_mean, moving_var,
+                     momentum=0.9, eps=1e-5, axis=None):
+    """Normalize over all axes except the channel (last) axis.
+
+    Returns (y, (new_moving_mean, new_moving_var)).
+    """
+    if axis is None:
+        axis = tuple(range(x.ndim - 1))
+    mean = jnp.mean(x, axis=axis)
+    var = jnp.var(x, axis=axis)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    y = y * gamma + beta
+    new_mean = momentum * moving_mean + (1.0 - momentum) * mean
+    new_var = momentum * moving_var + (1.0 - momentum) * var
+    return y, (new_mean, new_var)
+
+
+def batch_norm_infer(x, gamma, beta, moving_mean, moving_var, eps=1e-5):
+    y = (x - moving_mean) / jnp.sqrt(moving_var + eps)
+    return y * gamma + beta
+
+
+def layer_norm(x, gamma, beta, eps=1e-6):
+    """LayerNorm (new capability for the transformer stack)."""
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * gamma + beta
+
+
+def rms_norm(x, gamma, eps=1e-6):
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x / jnp.sqrt(ms + eps) * gamma

@@ -1,0 +1,211 @@
+"""Recurrent ops: fused LSTM/GRU cells + whole-sequence scans + the generic
+recurrent-group engine.
+
+Reference: LstmLayer/LstmCompute + hl_lstm fused kernels
+(cuda/include/hl_lstm_ops.cuh:46-66: gate order [input, input_gate,
+forget_gate, output_gate], peephole checkI/F/O), GatedRecurrentLayer /
+GruCompute (cuda/include/hl_gru_ops.cuh:37-80: h = prev - u*prev + u*c),
+RecurrentLayer, and the per-step unrolled engine
+RecurrentGradientMachine.cpp:379-712.
+
+TPU design: whole-sequence compute is one `lax.scan` whose body is a fused
+(gate-matmul + elementwise) step — XLA fuses the elementwise block; the
+input-to-hidden projection for ALL timesteps is hoisted out of the scan as a
+single big MXU matmul (the same trick as the reference's SequenceToBatch
+batching, but in time-major form).  Padding is handled by carrying state
+through masked steps unchanged, so results match the reference's padding-free
+semantics exactly.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import activations
+from paddle_tpu.ops.linear import matmul
+
+
+class LstmState(NamedTuple):
+    h: jnp.ndarray  # [B, D] hidden (output)
+    c: jnp.ndarray  # [B, D] cell state
+
+
+def lstm_cell(x4, state: LstmState, w_r, check_i=None, check_f=None,
+              check_o=None, act="tanh", gate_act="sigmoid", state_act="tanh"):
+    """One LSTM step.
+
+    x4: [B, 4D] input already projected to the 4 gates in reference order
+        [in, input_gate, forget_gate, output_gate] (hl_lstm_ops.cuh:46).
+    w_r: [D, 4D] recurrent weights.  check_*: [D] peepholes (optional).
+    """
+    d = state.h.shape[-1]
+    gates = x4 + matmul(state.h, w_r)
+    a, ig, fg, og = jnp.split(gates, 4, axis=-1)
+    act_f = activations.get(act)
+    gate_f = activations.get(gate_act)
+    state_f = activations.get(state_act)
+    a = act_f(a)
+    if check_i is not None:
+        ig = ig + state.c * check_i
+        fg = fg + state.c * check_f
+    i = gate_f(ig)
+    f = gate_f(fg)
+    c = a * i + state.c * f
+    if check_o is not None:
+        og = og + c * check_o
+    o = gate_f(og)
+    h = o * state_f(c)
+    return LstmState(h=h, c=c)
+
+
+def gru_cell(x3, h_prev, w_gate, w_state, act="tanh", gate_act="sigmoid"):
+    """One GRU step (reference hl_gru_ops.cuh:37-80).
+
+    x3: [B, 3D] projected input, layout [update, reset, candidate].
+    w_gate: [D, 2D] recurrent weights for update/reset;
+    w_state: [D, D] recurrent weights for the candidate.
+    h = prev - u*prev + u*c~,  c~ = act(x_c + (r*prev) @ w_state)
+    """
+    d = h_prev.shape[-1]
+    xu, xr, xc = x3[..., :d], x3[..., d:2 * d], x3[..., 2 * d:]
+    ru = matmul(h_prev, w_gate)
+    gate_f = activations.get(gate_act)
+    u = gate_f(xu + ru[..., :d])
+    r = gate_f(xr + ru[..., d:])
+    c = activations.get(act)(xc + matmul(r * h_prev, w_state))
+    return h_prev - u * h_prev + u * c
+
+
+def simple_rnn_cell(x, h_prev, w_r, act="tanh"):
+    """Reference RecurrentLayer: h = act(x + h_prev @ w_r)."""
+    return activations.get(act)(x + matmul(h_prev, w_r))
+
+
+def _masked_scan(step, init_carry, xs_time_major, mask_time_major, reverse=False):
+    """Scan over time; where mask==0 the carry passes through unchanged."""
+    def body(carry, inp):
+        x, m = inp
+        new_carry = step(carry, x)
+        merged = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(
+                m.reshape((-1,) + (1,) * (new.ndim - 1)) > 0, new, old),
+            new_carry, carry)
+        return merged, merged
+    return jax.lax.scan(body, init_carry, (xs_time_major, mask_time_major),
+                        reverse=reverse)
+
+
+def lstm(seq: SequenceBatch, w_r, bias=None, check_i=None, check_f=None,
+         check_o=None, reverse=False, act="tanh", gate_act="sigmoid",
+         state_act="tanh", init_state=None):
+    """Whole-sequence LSTM (reference LstmLayer + SequenceToBatch).
+
+    seq.data: [B, T, 4D] pre-projected gate inputs (the reference's lstmemory
+    also expects a 4*size mixed input).  bias: [4D].  Returns
+    (SequenceBatch of h [B, T, D], final LstmState).
+    """
+    b, t, d4 = seq.data.shape
+    d = d4 // 4
+    x = seq.data if bias is None else seq.data + bias
+    xs = x.transpose(1, 0, 2)                       # time-major [T, B, 4D]
+    ms = seq.mask().transpose(1, 0)                 # [T, B]
+    if init_state is None:
+        init_state = LstmState(h=jnp.zeros((b, d), x.dtype),
+                               c=jnp.zeros((b, d), x.dtype))
+
+    def step(state, x4):
+        return lstm_cell(x4, state, w_r, check_i, check_f, check_o,
+                         act, gate_act, state_act)
+
+    final, hs = _masked_scan(step, init_state, xs, ms, reverse=reverse)
+    out = hs.h.transpose(1, 0, 2) * seq.mask(hs.h.dtype)[..., None]
+    return SequenceBatch(data=out, lengths=seq.lengths), final
+
+
+def gru(seq: SequenceBatch, w_gate, w_state, bias=None, reverse=False,
+        act="tanh", gate_act="sigmoid", init_state=None):
+    """Whole-sequence GRU (reference GatedRecurrentLayer).
+
+    seq.data: [B, T, 3D] pre-projected [update|reset|candidate] inputs.
+    """
+    b, t, d3 = seq.data.shape
+    d = d3 // 3
+    x = seq.data if bias is None else seq.data + bias
+    xs = x.transpose(1, 0, 2)
+    ms = seq.mask().transpose(1, 0)
+    if init_state is None:
+        init_state = jnp.zeros((b, d), x.dtype)
+
+    def step(h, x3):
+        return gru_cell(x3, h, w_gate, w_state, act, gate_act)
+
+    final, hs = _masked_scan(step, init_state, xs, ms, reverse=reverse)
+    out = hs.transpose(1, 0, 2) * seq.mask(hs.dtype)[..., None]
+    return SequenceBatch(data=out, lengths=seq.lengths), final
+
+
+def simple_rnn(seq: SequenceBatch, w_r, bias=None, reverse=False, act="tanh",
+               init_state=None):
+    """Reference RecurrentLayer over a whole sequence; input pre-projected [B,T,D]."""
+    b, t, d = seq.data.shape
+    x = seq.data if bias is None else seq.data + bias
+    xs = x.transpose(1, 0, 2)
+    ms = seq.mask().transpose(1, 0)
+    if init_state is None:
+        init_state = jnp.zeros((b, d), x.dtype)
+    final, hs = _masked_scan(lambda h, xt: simple_rnn_cell(xt, h, w_r, act),
+                             init_state, xs, ms, reverse=reverse)
+    out = hs.transpose(1, 0, 2) * seq.mask(hs.dtype)[..., None]
+    return SequenceBatch(data=out, lengths=seq.lengths), final
+
+
+def recurrent_group(step_fn, inputs, boot_memories, reverse=False):
+    """The generic dynamic-RNN engine (reference RecurrentGradientMachine
+    forward :379 / createInFrameInfo :642).
+
+    step_fn(memories, frame_inputs) -> (new_memories, frame_outputs), where
+    `memories` is any pytree of [B, ...] arrays (the reference's memory()
+    links with boot layers) and frame_inputs is a pytree of per-step slices.
+
+    inputs: pytree of SequenceBatch sharing lengths; scanned time-major.
+    Returns (pytree of SequenceBatch outputs, final memories).
+
+    The reference shrinks the batch as short sequences finish (dynamic
+    shapes); here finished sequences' memories are frozen by masking, which
+    is numerically identical and keeps shapes static for XLA.
+    """
+    leaves = jax.tree_util.tree_leaves(inputs, is_leaf=lambda x: isinstance(x, SequenceBatch))
+    ref = leaves[0]
+    mask_tm = ref.mask().transpose(1, 0)
+
+    xs_tm = jax.tree_util.tree_map(
+        lambda sb: sb.data.transpose((1, 0) + tuple(range(2, sb.data.ndim))),
+        inputs, is_leaf=lambda x: isinstance(x, SequenceBatch))
+
+    def body(mem, scanned):
+        x, m = scanned
+        new_mem, out = step_fn(mem, x)
+        merged = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(
+                m.reshape((-1,) + (1,) * (new.ndim - 1)) > 0, new, old),
+            new_mem, mem)
+        return merged, out
+
+    final_mem, outs_tm = jax.lax.scan(body, boot_memories, (xs_tm, mask_tm),
+                                      reverse=reverse)
+    outs = jax.tree_util.tree_map(
+        lambda o: SequenceBatch(
+            data=o.transpose((1, 0) + tuple(range(2, o.ndim)))
+            * ref.mask(o.dtype).reshape(ref.mask().shape + (1,) * (o.ndim - 2)),
+            lengths=ref.lengths),
+        outs_tm)
+    return outs, final_mem
+
+
+def bidirectional(fwd_out: SequenceBatch, bwd_out: SequenceBatch) -> SequenceBatch:
+    """Concat forward and reverse passes (reference bidirectional_lstm)."""
+    return SequenceBatch(
+        data=jnp.concatenate([fwd_out.data, bwd_out.data], axis=-1),
+        lengths=fwd_out.lengths)

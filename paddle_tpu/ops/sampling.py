@@ -1,0 +1,92 @@
+"""Large-vocabulary sampled losses: NCE and hierarchical sigmoid.
+
+Reference: NCELayer (gserver/layers/NCELayer.cpp) with MultinomialSampler
+(AliasMethod-style), HierarchicalSigmoidLayer + bit-code ops
+(math/MatrixBitCode.cpp).  The reference updates only sampled/visited rows
+(sparse-row matrices); here the same sparsity arrives via gather + the
+optimizer's sparse-row handling, and the sampled matmuls stay dense minis so
+they run on the MXU.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.linear import matmul
+
+
+def uniform_neg_samples(rng, shape, num_classes):
+    return jax.random.randint(rng, shape, 0, num_classes, dtype=jnp.int32)
+
+
+def nce_loss(x, w, b, labels, neg_samples, num_classes, sample_probs=None):
+    """Noise-contrastive estimation loss.
+
+    x: [B, D] features; w: [V, D] class embeddings; b: [V];
+    labels: int [B]; neg_samples: int [B, K] (pre-drawn noise ids).
+    Returns [B] loss: binary CE of true class as positive + K noise ids as
+    negatives, with the NCE correction log(k * P_n(w)).
+    """
+    k = neg_samples.shape[1]
+    if sample_probs is None:
+        log_pn = -jnp.log(float(num_classes))
+    else:
+        log_pn = jnp.log(jnp.maximum(sample_probs, 1e-20))
+
+    def logit(ids):
+        wv = w[ids]                      # [..., D]
+        bv = b[ids]
+        s = jnp.einsum("bd,b...d->b...", x, wv) + bv
+        if sample_probs is None:
+            corr = jnp.log(float(k)) + log_pn
+        else:
+            corr = jnp.log(float(k)) + log_pn[ids]
+        return s - corr
+
+    pos = logit(labels[:, None])[:, 0]                 # [B]
+    neg = logit(neg_samples)                           # [B, K]
+    loss_pos = -jax.nn.log_sigmoid(pos)
+    loss_neg = -jnp.sum(jax.nn.log_sigmoid(-neg), axis=-1)
+    return loss_pos + loss_neg
+
+
+def _bit_codes(labels, code_len):
+    """Huffman-free binary codes as in the reference (MatrixBitCode.cpp):
+    class c's path visits internal nodes ((c+1) >> (d+1)) - 1 with branch bit
+    ((c+1) >> d) & 1, for d = 0..code_len-1 while node index >= 0."""
+    c1 = labels + 1
+    ds = jnp.arange(code_len)
+    nodes = (c1[..., None] >> (ds + 1)) - 1            # [..., D]
+    bits = (c1[..., None] >> ds) & 1
+    valid = nodes >= 0
+    return jnp.maximum(nodes, 0), bits.astype(jnp.float32), valid
+
+
+def hsigmoid_loss(x, w, b, labels, num_classes):
+    """Hierarchical sigmoid loss (reference HierarchicalSigmoidLayer).
+
+    x: [B, D]; w: [num_classes-1, D] internal-node weights; b: [num_classes-1];
+    labels: int [B].  Returns [B] loss, computed over the ~log2(V) nodes on
+    each label's path.
+    """
+    import math
+    code_len = max(1, math.ceil(math.log2(max(num_classes, 2))))
+    nodes, bits, valid = _bit_codes(labels, code_len)   # [B, L]
+    wv = w[nodes]                                       # [B, L, D]
+    bv = b[nodes]
+    s = jnp.einsum("bd,bld->bl", x, wv) + bv
+    # reference convention: cost = sum log(1 + exp(s)) - bit*s
+    loss = jnp.logaddexp(0.0, s) - bits * s
+    return jnp.sum(loss * valid, axis=-1)
+
+
+def multinomial_alias_sample(rng, probs, shape):
+    """Draw ids from an arbitrary distribution (reference MultinomialSampler;
+    jax.random.categorical is the XLA-native Gumbel-max equivalent)."""
+    logits = jnp.log(jnp.maximum(probs, 1e-20))
+    return jax.random.categorical(rng, logits, shape=shape).astype(jnp.int32)
+
+
+def top_k(x, k):
+    """Top-k values/ids (reference hl_top_k.cu / Matrix::rowMax(ids, vals))."""
+    vals, ids = jax.lax.top_k(x, k)
+    return vals, ids.astype(jnp.int32)

@@ -1,0 +1,193 @@
+"""Variable-length sequence ops over padded batches.
+
+Reference zoo (SURVEY.md §2.2 "Sequence manipulation"): SequencePoolLayer
+(MaxLayer/AverageLayer/SequenceLastInstanceLayer), ExpandLayer,
+SequenceConcatLayer, SequenceReshapeLayer, SubSequenceLayer, ContextProjection
+(function/ContextProjectionOp.cpp), EosIdCheckLayer, MaxIdLayer,
+SamplingIdLayer.  The reference operates padding-free on
+sequenceStartPositions; here every op takes the padded data plus mask/lengths
+(see paddle_tpu.core.sequence) and is careful that padding never leaks into
+results — the mask-correctness invariant (SURVEY.md §7 hard part (c)).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+
+_NEG = -1e30
+
+
+def seq_max_pool(seq: SequenceBatch):
+    """[B, T, D] -> [B, D] max over valid steps (reference MaxLayer)."""
+    m = seq.mask()[..., None]
+    x = jnp.where(m > 0, seq.data, _NEG)
+    out = jnp.max(x, axis=1)
+    # all-empty sequences -> 0
+    any_valid = (seq.lengths > 0)[:, None]
+    return jnp.where(any_valid, out, 0.0)
+
+
+def seq_avg_pool(seq: SequenceBatch):
+    """Average over valid steps (reference AverageLayer, strategy 'average')."""
+    m = seq.mask()[..., None]
+    s = jnp.sum(seq.data * m, axis=1)
+    n = jnp.maximum(seq.lengths.astype(s.dtype), 1.0)[:, None]
+    return s / n
+
+
+def seq_sum_pool(seq: SequenceBatch):
+    """Sum over valid steps (reference AverageLayer 'sum' strategy)."""
+    return jnp.sum(seq.data * seq.mask()[..., None], axis=1)
+
+
+def seq_sqrt_pool(seq: SequenceBatch):
+    """sum / sqrt(len) (reference AverageLayer 'squarerootn' strategy)."""
+    s = seq_sum_pool(seq)
+    n = jnp.sqrt(jnp.maximum(seq.lengths.astype(s.dtype), 1.0))[:, None]
+    return s / n
+
+
+def seq_last(seq: SequenceBatch):
+    """Last valid step (reference SequenceLastInstanceLayer)."""
+    idx = jnp.maximum(seq.lengths - 1, 0)
+    return jnp.take_along_axis(
+        seq.data, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+
+
+def seq_first(seq: SequenceBatch):
+    """First step (reference first_seq / SequenceLastInstanceLayer select_first)."""
+    return seq.data[:, 0]
+
+
+def seq_pool(seq: SequenceBatch, pooling: str):
+    return {
+        "max": seq_max_pool,
+        "avg": seq_avg_pool,
+        "average": seq_avg_pool,
+        "sum": seq_sum_pool,
+        "sqrt": seq_sqrt_pool,
+        "last": seq_last,
+        "first": seq_first,
+    }[pooling](seq)
+
+
+def expand(vec, like: SequenceBatch):
+    """[B, D] -> [B, T, D]: broadcast one row per sequence across its steps
+    (reference ExpandLayer)."""
+    data = jnp.broadcast_to(vec[:, None, :], (vec.shape[0], like.max_len, vec.shape[-1]))
+    data = data * like.mask(vec.dtype)[..., None]
+    return SequenceBatch(data=data, lengths=like.lengths)
+
+
+def seq_concat(a: SequenceBatch, b: SequenceBatch) -> SequenceBatch:
+    """Concatenate along time: [a_i ; b_i] per sample (reference
+    SequenceConcatLayer).  Output padded to Ta+Tb."""
+    bsz, ta = a.data.shape[:2]
+    tb = b.data.shape[1]
+    tout = ta + tb
+    out_len = a.lengths + b.lengths
+    # scatter b after a's valid prefix
+    pos = jnp.arange(tout, dtype=jnp.int32)[None, :]
+    # index into a where pos < len_a, into b where len_a <= pos < len_a+len_b
+    in_a = pos < a.lengths[:, None]
+    b_idx = jnp.clip(pos - a.lengths[:, None], 0, tb - 1)
+    a_idx = jnp.clip(pos, 0, ta - 1)
+    ga = jnp.take_along_axis(a.data, a_idx[..., None], axis=1)
+    gb = jnp.take_along_axis(b.data, b_idx[..., None], axis=1)
+    data = jnp.where(in_a[..., None], ga, gb)
+    valid = pos < out_len[:, None]
+    return SequenceBatch(data=data * valid[..., None].astype(data.dtype),
+                         lengths=out_len)
+
+
+def seq_reshape(seq: SequenceBatch, new_dim: int) -> SequenceBatch:
+    """Re-chunk each sequence's flattened tokens into rows of new_dim
+    (reference SequenceReshapeLayer).  Requires T*D % new_dim == 0."""
+    b, t, d = seq.data.shape
+    assert (t * d) % new_dim == 0
+    data = seq.data.reshape(b, (t * d) // new_dim, new_dim)
+    # ceil so a sequence whose len*d is not divisible keeps all its tokens
+    # (tail row zero-padded) instead of silently dropping them
+    new_len = -(-(seq.lengths * d) // new_dim)
+    return SequenceBatch(data=data, lengths=new_len.astype(jnp.int32))
+
+
+def sub_seq(seq: SequenceBatch, offsets, sizes, max_out: int) -> SequenceBatch:
+    """Per-sample slice [offset, offset+size) (reference SubSequenceLayer)."""
+    pos = jnp.arange(max_out, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(offsets[:, None] + pos, 0, seq.max_len - 1)
+    data = jnp.take_along_axis(seq.data, idx[..., None], axis=1)
+    valid = pos < sizes[:, None]
+    return SequenceBatch(data=data * valid[..., None].astype(data.dtype),
+                         lengths=sizes.astype(jnp.int32))
+
+
+def seq_slice(seq: SequenceBatch, starts=None, ends=None) -> SequenceBatch:
+    starts = jnp.zeros_like(seq.lengths) if starts is None else starts
+    ends = seq.lengths if ends is None else jnp.minimum(ends, seq.lengths)
+    return sub_seq(seq, starts, ends - starts, seq.max_len)
+
+
+def context_projection(seq: SequenceBatch, context_len: int,
+                       context_start: int, padding_weights=None):
+    """Sliding-window concat over time (reference ContextProjection,
+    function/ContextProjectionOp.cpp:392).
+
+    Each step t gets [x_{t+start}, ..., x_{t+start+len-1}] concatenated
+    (D*len wide).  Out-of-range positions use zeros, or learned padding rows
+    `padding_weights` [pad_rows, D] when trainable padding is configured
+    (rows: max(0,-start) heads then tails).
+    """
+    b, t, d = seq.data.shape
+    cols = []
+    lengths = seq.lengths
+    for k in range(context_len):
+        off = context_start + k
+        idx = jnp.arange(t, dtype=jnp.int32) + off
+        oob_head = idx < 0
+        oob_tail = idx[None, :] >= lengths[:, None]
+        gathered = seq.data[:, jnp.clip(idx, 0, t - 1), :]
+        col = gathered
+        if padding_weights is not None:
+            n_head = max(0, -context_start)
+            if n_head:
+                head_row = jnp.clip(idx + n_head, 0, n_head - 1)
+                head_pad = padding_weights[jnp.clip(head_row, 0, padding_weights.shape[0] - 1)]
+                col = jnp.where(oob_head[None, :, None], head_pad[None], col)
+            n_tail = max(0, context_start + context_len - 1)
+            if n_tail:
+                tail_row = n_head + jnp.clip(idx[None, :] - lengths[:, None], 0, n_tail - 1)
+                tail_row = jnp.clip(tail_row, 0, padding_weights.shape[0] - 1)
+                tail_pad = padding_weights[tail_row]
+                col = jnp.where(oob_tail[..., None], tail_pad, col)
+        else:
+            oob = oob_head[None, :, None] | oob_tail[..., None]
+            col = jnp.where(oob, 0.0, col)
+        cols.append(col)
+    out = jnp.concatenate(cols, axis=-1)
+    return SequenceBatch(data=out * seq.mask(out.dtype)[..., None], lengths=lengths)
+
+
+def max_id(x):
+    """argmax over the feature axis (reference MaxIdLayer)."""
+    return jnp.argmax(x, axis=-1).astype(jnp.int32)
+
+
+def eos_check(ids, eos_id):
+    """1.0 where id == eos (reference EosIdCheckLayer)."""
+    return (ids == eos_id).astype(jnp.float32)
+
+
+def sampling_id(rng, probs):
+    """Sample an id per row from a prob distribution (reference SamplingIdLayer)."""
+    return jax.random.categorical(rng, jnp.log(jnp.maximum(probs, 1e-20)), axis=-1)
+
+
+def scatter_rows_to_steps(seq: SequenceBatch):
+    """[B, T, D] + lengths -> flat [sum_len, D] host-side helper (inverse of
+    padding).  Only for eval/IO; not jit-friendly (dynamic shape)."""
+    import numpy as np
+    data = np.asarray(seq.data)
+    lens = np.asarray(seq.lengths)
+    return np.concatenate([data[i, :l] for i, l in enumerate(lens)], axis=0)

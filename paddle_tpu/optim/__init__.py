@@ -1,0 +1,15 @@
+"""Optimizers, LR schedules, regularization, Polyak averaging —
+the TPU-native equivalent of reference §2.4 (parameter/FirstOrderOptimizer
+zoo + LearningRateScheduler + AverageOptimizer + updater semantics)."""
+
+from paddle_tpu.optim.optimizers import (
+    Optimizer, Momentum, AdaGrad, AdaDelta, RMSProp, DecayedAdaGrad,
+    Adam, AdaMax, get,
+)
+from paddle_tpu.optim import schedules
+from paddle_tpu.optim import averaging
+
+__all__ = [
+    "Optimizer", "Momentum", "AdaGrad", "AdaDelta", "RMSProp",
+    "DecayedAdaGrad", "Adam", "AdaMax", "get", "schedules", "averaging",
+]

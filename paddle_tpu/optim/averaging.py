@@ -1,0 +1,53 @@
+"""Polyak parameter averaging with apply/restore.
+
+Reference: parameter/AverageOptimizer.{h,cpp} — maintains an accumulated sum
+of parameter values (SUM1-3 buffers) over a moving window
+(average_window * num_batches), and the Trainer/Tester temporarily *apply*
+the averaged value for evaluation then *restore* the live value
+(trainer/Tester.cpp, ParameterUpdaterBase apply/restore).
+
+Functional design: AveragerState rides next to the optimizer state; apply()
+returns the averaged params (no mutation), so "apply/restore" is just using
+a different pytree for eval.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class AveragerState(NamedTuple):
+    sum_: object      # pytree: windowed running sum
+    count: jnp.ndarray
+
+
+def init(params):
+    return AveragerState(
+        sum_=jax.tree_util.tree_map(jnp.zeros_like, params),
+        count=jnp.zeros((), jnp.float32))
+
+
+def accumulate(state: AveragerState, params, decay=None) -> AveragerState:
+    """Call once per batch after the optimizer update.  With decay=d the
+    window is exponential (reference's moving-average mode); otherwise a
+    plain running sum."""
+    if decay is None:
+        new_sum = jax.tree_util.tree_map(lambda s, p: s + p, state.sum_, params)
+        return AveragerState(sum_=new_sum, count=state.count + 1.0)
+    new_sum = jax.tree_util.tree_map(
+        lambda s, p: decay * s + (1.0 - decay) * p, state.sum_, params)
+    return AveragerState(sum_=new_sum, count=jnp.ones((), jnp.float32))
+
+
+def apply(state: AveragerState, params):
+    """Averaged parameters for eval (reference apply()); falls back to live
+    params when nothing accumulated yet."""
+    def avg(s, p):
+        return jnp.where(state.count > 0, s / jnp.maximum(state.count, 1.0), p)
+    return jax.tree_util.tree_map(avg, state.sum_, params)
+
+
+def reset(state: AveragerState, params):
+    """Start a new window (reference startPass/window roll-over)."""
+    return init(params)

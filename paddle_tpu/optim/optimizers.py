@@ -1,0 +1,238 @@
+"""First-order optimizer zoo.
+
+Reference: parameter/FirstOrderOptimizer.{h,cpp} — SGD-momentum,
+SparseMomentum, AdaGrad, AdaDelta, RMSProp, DecayedAdaGrad, Adam, AdaMax —
+plus decorator optimizers OptimizerWithRegularizer (L1/L2) and
+OptimizerWithGradientClipping, and AverageOptimizer (Polyak) in averaging.py.
+The reference's multi-buffer Parameter (MOMENTUM, SUM1-3... GlobalConstants.h)
+becomes an explicit state pytree here; the same update math runs inside the
+jitted SPMD train step (so in the sharded setting the optimizer runs
+"in-pserver" and "in-trainer" at once — there is no separate server).
+
+API: factory(**cfg) -> Optimizer(init, update) where
+  init(params) -> state
+  update(grads, state, params) -> (new_params, new_state)
+Everything is a pure pytree function; lr schedules thread via a step counter
+held in state.
+"""
+
+import math
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.optim import schedules
+
+
+class Optimizer(NamedTuple):
+    init: Callable[[Any], Any]
+    update: Callable[[Any, Any, Any], Any]
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def _resolve_sched(learning_rate, learning_rate_schedule, **kw):
+    if callable(learning_rate):
+        return learning_rate
+    return schedules.get(learning_rate_schedule, learning_rate, **kw)
+
+
+def _apply_decay(updates, params, grads, l2=0.0, l1=0.0):
+    """Reference OptimizerWithRegularizer folds decay into the gradient:
+    g <- g + l2*w  (+ l1 sign term)."""
+    if l2 == 0.0 and l1 == 0.0:
+        return grads
+    def fold(g, p):
+        out = g
+        if l2:
+            out = out + l2 * p
+        if l1:
+            out = out + l1 * jnp.sign(p)
+        return out
+    return _tmap(fold, grads, params)
+
+
+def _clip(grads, clip_threshold=None, clip_norm=None):
+    """Reference OptimizerWithGradientClipping: per-element value clip at
+    gradient_clipping_threshold.  clip_norm additionally offers global-norm
+    clipping (TPU-era standard for RNN/transformer training)."""
+    if clip_threshold:
+        grads = _tmap(lambda g: jnp.clip(g, -clip_threshold, clip_threshold), grads)
+    if clip_norm:
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                          for g in jax.tree_util.tree_leaves(grads)) + 1e-12)
+        scale = jnp.minimum(1.0, clip_norm / gn)
+        grads = _tmap(lambda g: g * scale, grads)
+    return grads
+
+
+def _make(update_one, extra_state_fn, learning_rate, learning_rate_schedule,
+          l1=0.0, l2=0.0, clip_threshold=None, clip_norm=None, sched_kw=None):
+    sched = _resolve_sched(learning_rate, learning_rate_schedule, **(sched_kw or {}))
+
+    def init(params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "slots": extra_state_fn(params)}
+
+    def update(grads, state, params):
+        step = state["step"]
+        lr = sched(step)
+        grads = _clip(grads, clip_threshold, clip_norm)
+        grads = _apply_decay(None, params, grads, l2=l2, l1=l1)
+        new_params, new_slots = update_one(grads, state["slots"], params, lr,
+                                           step)
+        return new_params, {"step": step + 1, "slots": new_slots}
+
+    return Optimizer(init=init, update=update)
+
+
+# ---------------------------------------------------------------- momentum
+
+def Momentum(learning_rate=0.01, momentum=0.9, nesterov=False,
+             learning_rate_schedule=None, **kw):
+    """SGD with momentum (reference SgdOptimizer/sgdUpdate,
+    parameter/ParameterUpdateFunctions.cpp:33: mom = m*mom - lr*g;
+    w += mom)."""
+    def slots(params):
+        return {"mom": _tmap(jnp.zeros_like, params)}
+
+    def upd(grads, s, params, lr, step):
+        new_mom = _tmap(lambda m, g: momentum * m - lr * g, s["mom"], grads)
+        if nesterov:
+            new_p = _tmap(lambda p, m, g: p + momentum * m - lr * g,
+                          params, new_mom, grads)
+        else:
+            new_p = _tmap(lambda p, m: p + m, params, new_mom)
+        return new_p, {"mom": new_mom}
+
+    return _make(upd, slots, learning_rate, learning_rate_schedule, **kw)
+
+
+def AdaGrad(learning_rate=0.01, epsilon=1e-6, learning_rate_schedule=None, **kw):
+    """Reference AdagradParameterOptimizer: accum += g^2;
+    w -= lr * g / (sqrt(accum) + eps)."""
+    def slots(params):
+        return {"accum": _tmap(jnp.zeros_like, params)}
+
+    def upd(grads, s, params, lr, step):
+        accum = _tmap(lambda a, g: a + g * g, s["accum"], grads)
+        new_p = _tmap(lambda p, g, a: p - lr * g / (jnp.sqrt(a) + epsilon),
+                      params, grads, accum)
+        return new_p, {"accum": accum}
+
+    return _make(upd, slots, learning_rate, learning_rate_schedule, **kw)
+
+
+def AdaDelta(learning_rate=1.0, rho=0.95, epsilon=1e-6,
+             learning_rate_schedule=None, **kw):
+    """Reference AdaDeltaParameterOptimizer:
+    E[g2] = rho*E[g2] + (1-rho)g2; dx = g*sqrt((E[dx2]+eps)/(E[g2]+eps));
+    E[dx2] = rho*E[dx2] + (1-rho)dx^2; w -= lr*dx."""
+    def slots(params):
+        z = _tmap(jnp.zeros_like, params)
+        return {"eg2": z, "edx2": _tmap(jnp.zeros_like, params)}
+
+    def upd(grads, s, params, lr, step):
+        eg2 = _tmap(lambda a, g: rho * a + (1 - rho) * g * g, s["eg2"], grads)
+        dx = _tmap(lambda g, a, d: g * jnp.sqrt((d + epsilon) / (a + epsilon)),
+                   grads, eg2, s["edx2"])
+        edx2 = _tmap(lambda d, x: rho * d + (1 - rho) * x * x, s["edx2"], dx)
+        new_p = _tmap(lambda p, x: p - lr * x, params, dx)
+        return new_p, {"eg2": eg2, "edx2": edx2}
+
+    return _make(upd, slots, learning_rate, learning_rate_schedule, **kw)
+
+
+def RMSProp(learning_rate=0.01, rho=0.95, epsilon=1e-6,
+            learning_rate_schedule=None, **kw):
+    """Reference RMSPropParameterOptimizer (the centered variant):
+    E[g2] = rho*E[g2]+(1-rho)g2;  E[g] = rho*E[g]+(1-rho)g;
+    w -= lr * g / sqrt(E[g2] - E[g]^2 + eps)."""
+    def slots(params):
+        return {"eg2": _tmap(jnp.zeros_like, params),
+                "eg": _tmap(jnp.zeros_like, params)}
+
+    def upd(grads, s, params, lr, step):
+        eg2 = _tmap(lambda a, g: rho * a + (1 - rho) * g * g, s["eg2"], grads)
+        eg = _tmap(lambda a, g: rho * a + (1 - rho) * g, s["eg"], grads)
+        new_p = _tmap(
+            lambda p, g, a, m: p - lr * g / jnp.sqrt(a - m * m + epsilon),
+            params, grads, eg2, eg)
+        return new_p, {"eg2": eg2, "eg": eg}
+
+    return _make(upd, slots, learning_rate, learning_rate_schedule, **kw)
+
+
+def DecayedAdaGrad(learning_rate=0.01, rho=0.95, epsilon=1e-6,
+                   learning_rate_schedule=None, **kw):
+    """Reference DecayedAdagradParameterOptimizer: like RMSProp without the
+    mean term."""
+    def slots(params):
+        return {"accum": _tmap(jnp.zeros_like, params)}
+
+    def upd(grads, s, params, lr, step):
+        accum = _tmap(lambda a, g: rho * a + (1 - rho) * g * g, s["accum"], grads)
+        new_p = _tmap(lambda p, g, a: p - lr * g / jnp.sqrt(a + epsilon),
+                      params, grads, accum)
+        return new_p, {"accum": accum}
+
+    return _make(upd, slots, learning_rate, learning_rate_schedule, **kw)
+
+
+def Adam(learning_rate=1e-3, beta1=0.9, beta2=0.999, epsilon=1e-8,
+         learning_rate_schedule=None, **kw):
+    """Reference AdamParameterOptimizer (with bias correction)."""
+    def slots(params):
+        return {"m": _tmap(jnp.zeros_like, params),
+                "v": _tmap(jnp.zeros_like, params)}
+
+    def upd(grads, s, params, lr, step):
+        t = (step + 1).astype(jnp.float32)
+        m = _tmap(lambda a, g: beta1 * a + (1 - beta1) * g, s["m"], grads)
+        v = _tmap(lambda a, g: beta2 * a + (1 - beta2) * g * g, s["v"], grads)
+        mhat_scale = 1.0 / (1.0 - beta1 ** t)
+        vhat_scale = 1.0 / (1.0 - beta2 ** t)
+        new_p = _tmap(
+            lambda p, mm, vv: p - lr * (mm * mhat_scale)
+            / (jnp.sqrt(vv * vhat_scale) + epsilon),
+            params, m, v)
+        return new_p, {"m": m, "v": v}
+
+    return _make(upd, slots, learning_rate, learning_rate_schedule, **kw)
+
+
+def AdaMax(learning_rate=2e-3, beta1=0.9, beta2=0.999,
+           learning_rate_schedule=None, **kw):
+    """Reference AdamaxParameterOptimizer: u = max(beta2*u, |g|);
+    w -= lr/(1-beta1^t) * m / u."""
+    def slots(params):
+        return {"m": _tmap(jnp.zeros_like, params),
+                "u": _tmap(jnp.zeros_like, params)}
+
+    def upd(grads, s, params, lr, step):
+        t = (step + 1).astype(jnp.float32)
+        m = _tmap(lambda a, g: beta1 * a + (1 - beta1) * g, s["m"], grads)
+        u = _tmap(lambda a, g: jnp.maximum(beta2 * a, jnp.abs(g)), s["u"], grads)
+        new_p = _tmap(
+            lambda p, mm, uu: p - (lr / (1 - beta1 ** t)) * mm / (uu + 1e-12),
+            params, m, u)
+        return new_p, {"m": m, "u": u}
+
+    return _make(upd, slots, learning_rate, learning_rate_schedule, **kw)
+
+
+_REGISTRY = {
+    "momentum": Momentum, "sgd": Momentum, "adagrad": AdaGrad,
+    "adadelta": AdaDelta, "rmsprop": RMSProp,
+    "decayed_adagrad": DecayedAdaGrad, "adam": Adam, "adamax": AdaMax,
+}
+
+
+def get(name, **kw):
+    try:
+        return _REGISTRY[name.lower()](**kw)
+    except KeyError:
+        raise KeyError(f"unknown optimizer {name!r}; have {sorted(_REGISTRY)}")

@@ -1,0 +1,89 @@
+"""Learning-rate schedules.
+
+Reference: parameter/LearningRateScheduler.cpp — constant, poly, exp,
+discexp, linear, manual, pass_manual (plus the trainer's warmup-free
+defaults).  Each returns fn(step) -> lr multiplier-applied rate; pure so it
+traces into the jitted train step.
+"""
+
+import jax.numpy as jnp
+
+
+def constant(learning_rate):
+    def sched(step):
+        return jnp.asarray(learning_rate, jnp.float32)
+    return sched
+
+
+def poly(learning_rate, decay_a, decay_b):
+    """lr * (1 + a*t)^(-b) (reference 'poly')."""
+    def sched(step):
+        t = jnp.asarray(step, jnp.float32)
+        return learning_rate * (1.0 + decay_a * t) ** (-decay_b)
+    return sched
+
+
+def exp(learning_rate, decay_a, decay_b):
+    """lr * a^(t/b) (reference 'exp')."""
+    def sched(step):
+        t = jnp.asarray(step, jnp.float32)
+        return learning_rate * decay_a ** (t / decay_b)
+    return sched
+
+
+def discexp(learning_rate, decay_a, decay_b):
+    """lr * a^floor(t/b) (reference 'discexp')."""
+    def sched(step):
+        t = jnp.asarray(step, jnp.float32)
+        return learning_rate * decay_a ** jnp.floor(t / decay_b)
+    return sched
+
+
+def linear(learning_rate, decay_a, decay_b):
+    """max(lr - a*t, b) (reference 'linear')."""
+    def sched(step):
+        t = jnp.asarray(step, jnp.float32)
+        return jnp.maximum(learning_rate - decay_a * t, decay_b)
+    return sched
+
+
+def manual(learning_rate, segments):
+    """Piecewise-constant by sample/batch count (reference 'manual' /
+    'pass_manual'): segments = [(boundary, multiplier), ...]."""
+    bounds = jnp.asarray([b for b, _ in segments], jnp.float32)
+    mults = jnp.asarray([m for _, m in segments] + [segments[-1][1]], jnp.float32)
+
+    def sched(step):
+        idx = jnp.searchsorted(bounds, jnp.asarray(step, jnp.float32), side="right")
+        return learning_rate * mults[idx]
+    return sched
+
+
+def warmup_cosine(learning_rate, warmup_steps, total_steps, min_ratio=0.0):
+    """TPU-era addition for the transformer family."""
+    def sched(step):
+        t = jnp.asarray(step, jnp.float32)
+        warm = t / jnp.maximum(warmup_steps, 1)
+        progress = jnp.clip((t - warmup_steps) / jnp.maximum(total_steps - warmup_steps, 1), 0, 1)
+        cos = min_ratio + (1 - min_ratio) * 0.5 * (1 + jnp.cos(jnp.pi * progress))
+        return learning_rate * jnp.where(t < warmup_steps, warm, cos)
+    return sched
+
+
+def get(name, learning_rate, decay_a=0.0, decay_b=0.0, segments=None, **kw):
+    """Reference config: learning_rate_schedule string in OptimizationConfig."""
+    if name in (None, "constant"):
+        return constant(learning_rate)
+    if name == "poly":
+        return poly(learning_rate, decay_a, decay_b)
+    if name == "exp":
+        return exp(learning_rate, decay_a, decay_b)
+    if name == "discexp":
+        return discexp(learning_rate, decay_a, decay_b)
+    if name == "linear":
+        return linear(learning_rate, decay_a, decay_b)
+    if name in ("manual", "pass_manual"):
+        return manual(learning_rate, segments)
+    if name == "warmup_cosine":
+        return warmup_cosine(learning_rate, **kw)
+    raise KeyError(f"unknown lr schedule {name!r}")

@@ -1,0 +1,14 @@
+"""v2-style top-level API (paddle.init / paddle.infer equivalents)."""
+
+
+def init(**kwargs):
+    """Reference: paddle.v2 init(use_gpu=, trainer_count=) -> here mesh/flags."""
+    from paddle_tpu.utils.flags import FLAGS
+    for k, v in kwargs.items():
+        if hasattr(FLAGS, k):
+            setattr(FLAGS, k, v)
+    return FLAGS
+
+
+def infer(*args, **kwargs):
+    raise NotImplementedError("paddle_tpu.infer arrives with the inference module")

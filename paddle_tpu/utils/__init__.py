@@ -1,0 +1,17 @@
+from paddle_tpu.utils.logging import logger, get_logger
+from paddle_tpu.utils.stats import Stat, global_stats, timer, print_all_stats
+from paddle_tpu.utils.flags import FLAGS
+from paddle_tpu.utils.error import PaddleTpuError, ConfigError, ShapeError
+
+__all__ = [
+    "logger",
+    "get_logger",
+    "Stat",
+    "global_stats",
+    "timer",
+    "print_all_stats",
+    "FLAGS",
+    "PaddleTpuError",
+    "ConfigError",
+    "ShapeError",
+]

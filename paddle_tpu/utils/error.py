@@ -1,0 +1,13 @@
+"""Errors (reference: paddle/utils/Error.h)."""
+
+
+class PaddleTpuError(Exception):
+    """Base error for paddle_tpu."""
+
+
+class ConfigError(PaddleTpuError):
+    """Invalid model / trainer configuration."""
+
+
+class ShapeError(PaddleTpuError):
+    """Shape/size inference mismatch."""

@@ -1,0 +1,58 @@
+"""Global runtime flags.
+
+TPU-native equivalent of the reference's ~60 gflags (paddle/utils/Flags.cpp:18-110);
+multi-GPU/pserver topology flags become mesh-shape flags here.
+"""
+
+import argparse
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Flags:
+    # device / precision
+    use_tpu: bool = True
+    dtype: str = "float32"          # parameter dtype ("real" in the reference)
+    compute_dtype: str = "bfloat16"  # matmul/conv compute dtype on TPU
+
+    # training loop (reference: --log_period, --saving_period, --test_period)
+    log_period: int = 100
+    saving_period: int = 1
+    test_period: int = 0
+    num_passes: int = 1
+    start_pass: int = 0
+    save_dir: Optional[str] = None
+    save_only_one: bool = False
+    seed: int = 1
+
+    # parallelism (replaces --trainer_count / pserver topology)
+    data_parallel: int = 0   # 0 = all devices
+    model_parallel: int = 1
+    seq_parallel: int = 1
+    expert_parallel: int = 1
+
+    # decoding
+    beam_size: int = 1
+
+    # data
+    async_load_data: bool = True
+    prefetch_depth: int = 2
+
+    def update_from_args(self, args):
+        for field in dataclasses.fields(self):
+            if hasattr(args, field.name) and getattr(args, field.name) is not None:
+                setattr(self, field.name, getattr(args, field.name))
+
+    def add_to_parser(self, parser: argparse.ArgumentParser):
+        for field in dataclasses.fields(self):
+            name = "--" + field.name
+            if field.type is bool or isinstance(field.default, bool):
+                parser.add_argument(name, type=lambda v: v.lower() in ("1", "true", "yes"),
+                                    default=None)
+            else:
+                typ = int if isinstance(field.default, int) else str
+                parser.add_argument(name, type=typ, default=None)
+
+
+FLAGS = Flags()

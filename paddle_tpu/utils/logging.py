@@ -1,0 +1,21 @@
+"""Logging (reference: paddle/utils/Logging.h glog wrapper)."""
+
+import logging
+import os
+import sys
+
+_FMT = "%(levelname).1s %(asctime)s %(name)s] %(message)s"
+
+
+def get_logger(name: str = "paddle_tpu", level=None) -> logging.Logger:
+    log = logging.getLogger(name)
+    if not log.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FMT, datefmt="%m%d %H:%M:%S"))
+        log.addHandler(handler)
+        log.propagate = False
+        log.setLevel(level or os.environ.get("PADDLE_TPU_LOG_LEVEL", "INFO"))
+    return log
+
+
+logger = get_logger()

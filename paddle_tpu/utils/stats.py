@@ -1,0 +1,92 @@
+"""Timer/stat registry.
+
+TPU-native equivalent of the reference's ``REGISTER_TIMER`` RAII timers that
+accumulate into ``globalStat`` (reference: paddle/utils/Stat.h:70-241,
+printed each --log_period in trainer/Trainer.cpp:443-447).  Host-side wall
+timers here; device-side profiling goes through jax.profiler (see
+paddle_tpu.utils.profiler).
+"""
+
+import contextlib
+import threading
+import time
+from collections import OrderedDict
+
+
+class Stat:
+    __slots__ = ("name", "total", "count", "max", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.total = 0.0
+        self.count = 0
+        self.max = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, seconds: float):
+        with self._lock:
+            self.total += seconds
+            self.count += 1
+            if seconds > self.max:
+                self.max = seconds
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def reset(self):
+        with self._lock:
+            self.total = 0.0
+            self.count = 0
+            self.max = 0.0
+
+    def __repr__(self):
+        return (f"Stat({self.name}: total={self.total * 1e3:.2f}ms "
+                f"avg={self.avg * 1e3:.3f}ms max={self.max * 1e3:.3f}ms "
+                f"count={self.count})")
+
+
+class StatRegistry:
+    def __init__(self):
+        self._stats = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, name: str) -> Stat:
+        with self._lock:
+            stat = self._stats.get(name)
+            if stat is None:
+                stat = self._stats[name] = Stat(name)
+            return stat
+
+    def reset_all(self):
+        for stat in list(self._stats.values()):
+            stat.reset()
+
+    def print_all(self, log=None):
+        from paddle_tpu.utils.logging import logger
+        log = log or logger
+        log.info("======= StatSet =======")
+        for stat in self._stats.values():
+            if stat.count:
+                log.info("  %s", stat)
+
+    def items(self):
+        return list(self._stats.items())
+
+
+global_stats = StatRegistry()
+
+
+@contextlib.contextmanager
+def timer(name: str, registry: StatRegistry = None):
+    """with timer("forwardBackward"): ...  — REGISTER_TIMER equivalent."""
+    stat = (registry or global_stats).get(name)
+    start = time.perf_counter()
+    try:
+        yield stat
+    finally:
+        stat.add(time.perf_counter() - start)
+
+
+def print_all_stats():
+    global_stats.print_all()

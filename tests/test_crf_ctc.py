@@ -1,0 +1,105 @@
+"""CRF / CTC correctness vs brute-force enumeration (tiny shapes)."""
+
+import itertools
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.ops import crf, ctc
+
+
+def brute_force_crf(em, length, w):
+    start, end, trans = np.asarray(w[0]), np.asarray(w[1]), np.asarray(w[2:])
+    n = em.shape[-1]
+    best, best_path, logz = -np.inf, None, -np.inf
+    scores = []
+    for path in itertools.product(range(n), repeat=length):
+        s = start[path[0]] + end[path[-1]] + em[0, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + em[t, path[t]]
+        scores.append(s)
+        if s > best:
+            best, best_path = s, path
+    logz = np.logaddexp.reduce(scores)
+    return best, best_path, logz
+
+
+def test_crf_decode_matches_bruteforce(np_rng):
+    n, t = 3, 4
+    em = np_rng.randn(2, t, n).astype(np.float32)
+    w = (np_rng.randn(n + 2, n) * 0.5).astype(np.float32)
+    lengths = np.array([4, 2], np.int32)
+    tags, score = crf.crf_decode(jnp.asarray(em), jnp.asarray(lengths), jnp.asarray(w))
+    for i in range(2):
+        b_score, b_path, _ = brute_force_crf(em[i], int(lengths[i]), w)
+        np.testing.assert_allclose(float(score[i]), b_score, rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(tags[i, :lengths[i]]), b_path)
+
+
+def test_crf_loss_matches_bruteforce_logz(np_rng):
+    n, t = 3, 3
+    em = np_rng.randn(1, t, n).astype(np.float32)
+    w = (np_rng.randn(n + 2, n) * 0.5).astype(np.float32)
+    tags = np.array([[1, 0, 2]], np.int32)
+    nll = crf.crf_log_likelihood(jnp.asarray(em), jnp.asarray(tags),
+                                 jnp.asarray([t]), jnp.asarray(w))
+    _, _, logz = brute_force_crf(em[0], t, w)
+    start, end, trans = w[0], w[1], w[2:]
+    gold = (start[1] + em[0, 0, 1] + trans[1, 0] + em[0, 1, 0]
+            + trans[0, 2] + em[0, 2, 2] + end[2])
+    np.testing.assert_allclose(float(nll[0]), logz - gold, rtol=1e-5)
+
+
+def brute_force_ctc(logp, T, labels, blank=0):
+    """Sum over all alignments of length T that collapse to `labels`."""
+    c = logp.shape[-1]
+    total = -np.inf
+    for align in itertools.product(range(c), repeat=T):
+        collapsed = []
+        prev = None
+        for a in align:
+            if a != blank and a != prev:
+                collapsed.append(a)
+            prev = a
+        if collapsed == list(labels):
+            total = np.logaddexp(total, sum(logp[t, align[t]] for t in range(T)))
+    return -total
+
+
+def test_ctc_matches_bruteforce(np_rng):
+    t, c = 4, 3
+    logits = np_rng.randn(1, t, c).astype(np.float32)
+    logp = np.asarray(jnp.log(jnp.exp(logits) / jnp.exp(logits).sum(-1, keepdims=True)))
+    labels = [1, 2]
+    loss = ctc.ctc_loss(jnp.asarray(logp), jnp.asarray([t]),
+                        jnp.asarray([labels]), jnp.asarray([2]))
+    expect = brute_force_ctc(logp[0], t, labels)
+    np.testing.assert_allclose(float(loss[0]), expect, rtol=1e-4)
+
+
+def test_ctc_respects_logit_lengths(np_rng):
+    t, c = 5, 3
+    logits = np_rng.randn(1, t, c).astype(np.float32)
+    logp = np.asarray(jnp.log(jnp.exp(logits) / jnp.exp(logits).sum(-1, keepdims=True)))
+    loss_a = ctc.ctc_loss(jnp.asarray(logp), jnp.asarray([3]),
+                          jnp.asarray([[1]]), jnp.asarray([1]))
+    expect = brute_force_ctc(logp[0, :3], 3, [1])
+    np.testing.assert_allclose(float(loss_a[0]), expect, rtol=1e-4)
+
+
+def test_ctc_greedy_decode():
+    # argmax path: [1, 1, 0, 2, 2] -> collapse -> [1, 2]
+    lp = np.full((1, 5, 3), -5.0, np.float32)
+    for t, k in enumerate([1, 1, 0, 2, 2]):
+        lp[0, t, k] = -0.1
+    ids, lens = ctc.ctc_greedy_decode(jnp.asarray(lp), jnp.asarray([5]))
+    assert int(lens[0]) == 2
+    np.testing.assert_array_equal(np.asarray(ids[0, :2]), [1, 2])
+
+
+def test_spp_fixed_width_regardless_of_input():
+    from paddle_tpu.ops.conv import spatial_pyramid_pool
+    for hw in (3, 7, 8):
+        x = jnp.ones((2, hw, hw, 5))
+        out = spatial_pyramid_pool(x, pyramid_height=3)
+        assert out.shape == (2, 5 * (1 + 4 + 16)), out.shape

@@ -1,0 +1,123 @@
+"""Optimizer numerics vs reference-style numpy loops (the reference's
+math/tests/test_TrainingAlgorithm.cpp pattern: fused update vs
+OriginalOptimizerApi.h naive implementation)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu import optim
+
+
+def run_steps(opt, w0, grads):
+    state = opt.init({"w": jnp.asarray(w0)})
+    params = {"w": jnp.asarray(w0)}
+    for g in grads:
+        params, state = opt.update({"w": jnp.asarray(g)}, state, params)
+    return np.asarray(params["w"])
+
+
+def test_momentum_matches_loop(np_rng):
+    w0 = np_rng.randn(5).astype(np.float32)
+    grads = [np_rng.randn(5).astype(np.float32) for _ in range(4)]
+    got = run_steps(optim.Momentum(learning_rate=0.1, momentum=0.9), w0, grads)
+    w, mom = w0.copy(), np.zeros(5, np.float32)
+    for g in grads:
+        mom = 0.9 * mom - 0.1 * g
+        w = w + mom
+    np.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_adagrad_matches_loop(np_rng):
+    w0 = np_rng.randn(5).astype(np.float32)
+    grads = [np_rng.randn(5).astype(np.float32) for _ in range(4)]
+    got = run_steps(optim.AdaGrad(learning_rate=0.1, epsilon=1e-6), w0, grads)
+    w, acc = w0.copy(), np.zeros(5, np.float32)
+    for g in grads:
+        acc += g * g
+        w -= 0.1 * g / (np.sqrt(acc) + 1e-6)
+    np.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_adam_matches_loop(np_rng):
+    w0 = np_rng.randn(5).astype(np.float32)
+    grads = [np_rng.randn(5).astype(np.float32) for _ in range(5)]
+    got = run_steps(optim.Adam(learning_rate=0.01), w0, grads)
+    w = w0.copy()
+    m = np.zeros(5, np.float32)
+    v = np.zeros(5, np.float32)
+    for t, g in enumerate(grads, start=1):
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mhat = m / (1 - 0.9 ** t)
+        vhat = v / (1 - 0.999 ** t)
+        w -= 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(got, w, rtol=1e-4)
+
+
+def test_rmsprop_centered(np_rng):
+    w0 = np_rng.randn(4).astype(np.float32)
+    grads = [np_rng.randn(4).astype(np.float32) for _ in range(3)]
+    got = run_steps(optim.RMSProp(learning_rate=0.05, rho=0.9, epsilon=1e-6), w0, grads)
+    w = w0.copy()
+    eg2 = np.zeros(4, np.float32)
+    eg = np.zeros(4, np.float32)
+    for g in grads:
+        eg2 = 0.9 * eg2 + 0.1 * g * g
+        eg = 0.9 * eg + 0.1 * g
+        w -= 0.05 * g / np.sqrt(eg2 - eg * eg + 1e-6)
+    np.testing.assert_allclose(got, w, rtol=1e-4)
+
+
+def test_adadelta_matches_loop(np_rng):
+    w0 = np_rng.randn(4).astype(np.float32)
+    grads = [np_rng.randn(4).astype(np.float32) for _ in range(3)]
+    got = run_steps(optim.AdaDelta(learning_rate=1.0, rho=0.95, epsilon=1e-6), w0, grads)
+    w = w0.copy()
+    eg2 = np.zeros(4, np.float32)
+    edx2 = np.zeros(4, np.float32)
+    for g in grads:
+        eg2 = 0.95 * eg2 + 0.05 * g * g
+        dx = g * np.sqrt((edx2 + 1e-6) / (eg2 + 1e-6))
+        edx2 = 0.95 * edx2 + 0.05 * dx * dx
+        w -= dx
+    np.testing.assert_allclose(got, w, rtol=1e-4)
+
+
+def test_l2_decay_folds_into_grad(np_rng):
+    w0 = np.ones(3, np.float32)
+    g = np.zeros(3, np.float32)
+    got = run_steps(optim.Momentum(learning_rate=0.1, momentum=0.0, l2=0.5),
+                    w0, [g])
+    np.testing.assert_allclose(got, w0 - 0.1 * 0.5 * w0, rtol=1e-6)
+
+
+def test_clip_by_value(np_rng):
+    w0 = np.zeros(3, np.float32)
+    g = np.array([10.0, -10.0, 0.5], np.float32)
+    got = run_steps(optim.Momentum(learning_rate=1.0, momentum=0.0,
+                                   clip_threshold=1.0), w0, [g])
+    np.testing.assert_allclose(got, [-1.0, 1.0, -0.5], rtol=1e-6)
+
+
+def test_lr_schedules():
+    import jax.numpy as jnp
+    from paddle_tpu.optim import schedules
+    s = schedules.get("poly", 0.1, decay_a=0.5, decay_b=1.0)
+    np.testing.assert_allclose(float(s(0)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(s(2)), 0.1 / 2.0, rtol=1e-6)
+    s = schedules.get("discexp", 0.1, decay_a=0.5, decay_b=10)
+    np.testing.assert_allclose(float(s(9)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(s(10)), 0.05, rtol=1e-6)
+    s = schedules.get("linear", 0.1, decay_a=0.01, decay_b=0.05)
+    np.testing.assert_allclose(float(s(3)), 0.07, rtol=1e-5)
+    np.testing.assert_allclose(float(s(100)), 0.05, rtol=1e-6)
+
+
+def test_averaging_apply():
+    from paddle_tpu.optim import averaging
+    params = {"w": jnp.asarray([0.0])}
+    st = averaging.init(params)
+    for v in (1.0, 2.0, 3.0):
+        st = averaging.accumulate(st, {"w": jnp.asarray([v])})
+    avg = averaging.apply(st, params)
+    np.testing.assert_allclose(np.asarray(avg["w"]), [2.0], rtol=1e-6)

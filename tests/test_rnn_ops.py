@@ -1,0 +1,141 @@
+"""RNN differential tests: scanned whole-sequence LSTM/GRU vs per-step numpy
+reference loops implementing the reference formulas (hl_lstm_ops.cuh:60-66,
+hl_gru_ops.cuh:42-80), including padding-invariance (reference semantics are
+padding-free, so results must not depend on pad length)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch, pad_sequences
+from paddle_tpu.ops import rnn
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_lstm_ref(x4, w_r, ci, cf, co):
+    """x4: [T, 4D] -> outputs [T, D] per the reference gate equations."""
+    t, d4 = x4.shape
+    d = d4 // 4
+    h = np.zeros(d, np.float32)
+    c = np.zeros(d, np.float32)
+    outs = []
+    for step in range(t):
+        g = x4[step] + h @ w_r
+        a, ig, fg, og = g[:d], g[d:2*d], g[2*d:3*d], g[3*d:]
+        a = np.tanh(a)
+        i = sigmoid(ig + c * ci)
+        f = sigmoid(fg + c * cf)
+        c = a * i + c * f
+        o = sigmoid(og + c * co)
+        h = o * np.tanh(c)
+        outs.append(h.copy())
+    return np.stack(outs), h, c
+
+
+def np_gru_ref(x3, wg, ws):
+    t, d3 = x3.shape
+    d = d3 // 3
+    h = np.zeros(d, np.float32)
+    outs = []
+    for step in range(t):
+        xu, xr, xc = x3[step][:d], x3[step][d:2*d], x3[step][2*d:]
+        ru = h @ wg
+        u = sigmoid(xu + ru[:d])
+        r = sigmoid(xr + ru[d:])
+        c = np.tanh(xc + (r * h) @ ws)
+        h = h - u * h + u * c
+        outs.append(h.copy())
+    return np.stack(outs), h
+
+
+def test_lstm_matches_reference_loop(np_rng):
+    d = 5
+    lens = (4, 7, 1)
+    seqs = [np_rng.randn(l, 4 * d).astype(np.float32) * 0.5 for l in lens]
+    w_r = (np_rng.randn(d, 4 * d) * 0.3).astype(np.float32)
+    ci, cf, co = [(np_rng.randn(d) * 0.2).astype(np.float32) for _ in range(3)]
+
+    sb = pad_sequences(seqs)
+    out, final = rnn.lstm(sb, jnp.asarray(w_r), check_i=jnp.asarray(ci),
+                          check_f=jnp.asarray(cf), check_o=jnp.asarray(co))
+    for i, s in enumerate(seqs):
+        ref, href, cref = np_lstm_ref(s, w_r, ci, cf, co)
+        np.testing.assert_allclose(np.asarray(out.data[i, :len(s)]), ref,
+                                   rtol=2e-2, atol=2e-3)
+        # final state must be the state at the last VALID step
+        np.testing.assert_allclose(np.asarray(final.h[i]), href, rtol=2e-2, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(final.c[i]), cref, rtol=2e-2, atol=2e-3)
+
+
+def test_lstm_padding_invariance(np_rng):
+    d = 4
+    seqs = [np_rng.randn(3, 4 * d).astype(np.float32)]
+    w_r = (np_rng.randn(d, 4 * d) * 0.3).astype(np.float32)
+    out_a, fin_a = rnn.lstm(pad_sequences(seqs, max_len=3), jnp.asarray(w_r))
+    out_b, fin_b = rnn.lstm(pad_sequences(seqs, max_len=10), jnp.asarray(w_r))
+    np.testing.assert_allclose(np.asarray(out_a.data[0, :3]),
+                               np.asarray(out_b.data[0, :3]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(fin_a.h), np.asarray(fin_b.h), rtol=1e-6)
+
+
+def test_gru_matches_reference_loop(np_rng):
+    d = 6
+    lens = (5, 2)
+    seqs = [np_rng.randn(l, 3 * d).astype(np.float32) * 0.5 for l in lens]
+    wg = (np_rng.randn(d, 2 * d) * 0.3).astype(np.float32)
+    ws = (np_rng.randn(d, d) * 0.3).astype(np.float32)
+    out, final = rnn.gru(pad_sequences(seqs), jnp.asarray(wg), jnp.asarray(ws))
+    for i, s in enumerate(seqs):
+        ref, href = np_gru_ref(s, wg, ws)
+        np.testing.assert_allclose(np.asarray(out.data[i, :len(s)]), ref,
+                                   rtol=2e-2, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(final[i]), href, rtol=2e-2, atol=2e-3)
+
+
+def test_reverse_lstm(np_rng):
+    d = 3
+    seqs = [np_rng.randn(4, 4 * d).astype(np.float32)]
+    w_r = (np_rng.randn(d, 4 * d) * 0.3).astype(np.float32)
+    # reverse pass on seq == forward pass on reversed seq, output re-reversed
+    out_r, _ = rnn.lstm(pad_sequences(seqs), jnp.asarray(w_r), reverse=True)
+    out_f, _ = rnn.lstm(pad_sequences([seqs[0][::-1]]), jnp.asarray(w_r))
+    np.testing.assert_allclose(np.asarray(out_r.data[0]),
+                               np.asarray(out_f.data[0])[::-1], rtol=1e-5, atol=1e-6)
+
+
+def test_recurrent_group_generic(np_rng):
+    """recurrent_group with a custom step must equal simple_rnn."""
+    d = 4
+    lens = (3, 6)
+    seqs = [np_rng.randn(l, d).astype(np.float32) for l in lens]
+    w_r = (np_rng.randn(d, d) * 0.3).astype(np.float32)
+    sb = pad_sequences(seqs)
+
+    out_ref, fin_ref = rnn.simple_rnn(sb, jnp.asarray(w_r))
+
+    def step(mem, x):
+        h = rnn.simple_rnn_cell(x, mem, jnp.asarray(w_r))
+        return h, h
+
+    out_g, fin_g = rnn.recurrent_group(step, sb, jnp.zeros((2, d)))
+    np.testing.assert_allclose(np.asarray(out_g.data), np.asarray(out_ref.data),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(fin_g), np.asarray(fin_ref), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_lstm_grad_flows(np_rng):
+    d = 3
+    seqs = [np_rng.randn(4, 4 * d).astype(np.float32)]
+    sb = pad_sequences(seqs)
+
+    def loss(w_r):
+        out, _ = rnn.lstm(sb, w_r)
+        return jnp.sum(out.data ** 2)
+
+    g = jax.grad(loss)(jnp.asarray((np_rng.randn(d, 4 * d) * 0.3).astype(np.float32)))
+    assert np.all(np.isfinite(np.asarray(g)))
+    assert float(jnp.sum(jnp.abs(g))) > 0

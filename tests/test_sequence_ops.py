@@ -1,0 +1,118 @@
+"""Sequence op tests: padded+mask results must equal per-sequence numpy
+loops (the reference's padding-free semantics — SURVEY.md §7 hard part (c))."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch, pad_sequences, pad_nested_sequences
+from paddle_tpu.ops import sequence as seq_ops
+
+
+def make_batch(np_rng, lens=(5, 3, 1, 7), dim=4):
+    seqs = [np_rng.randn(l, dim).astype(np.float32) for l in lens]
+    return seqs, pad_sequences(seqs)
+
+
+def test_pad_sequences_roundtrip(np_rng):
+    seqs, sb = make_batch(np_rng)
+    assert sb.data.shape == (4, 7, 4)
+    np.testing.assert_array_equal(np.asarray(sb.lengths), [5, 3, 1, 7])
+    flat = seq_ops.scatter_rows_to_steps(sb)
+    np.testing.assert_allclose(flat, np.concatenate(seqs, axis=0), rtol=1e-6)
+
+
+def test_seq_pools_match_numpy(np_rng):
+    seqs, sb = make_batch(np_rng)
+    np.testing.assert_allclose(
+        np.asarray(seq_ops.seq_max_pool(sb)),
+        np.stack([s.max(0) for s in seqs]), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(seq_ops.seq_avg_pool(sb)),
+        np.stack([s.mean(0) for s in seqs]), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(seq_ops.seq_sum_pool(sb)),
+        np.stack([s.sum(0) for s in seqs]), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(seq_ops.seq_last(sb)),
+        np.stack([s[-1] for s in seqs]), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(seq_ops.seq_first(sb)),
+        np.stack([s[0] for s in seqs]), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(seq_ops.seq_sqrt_pool(sb)),
+        np.stack([s.sum(0) / np.sqrt(len(s)) for s in seqs]), rtol=1e-5)
+
+
+def test_expand(np_rng):
+    seqs, sb = make_batch(np_rng)
+    vec = np_rng.randn(4, 6).astype(np.float32)
+    out = seq_ops.expand(jnp.asarray(vec), sb)
+    for i, s in enumerate(seqs):
+        got = np.asarray(out.data[i, :len(s)])
+        np.testing.assert_allclose(got, np.tile(vec[i], (len(s), 1)), rtol=1e-6)
+    # padding is zero
+    assert np.all(np.asarray(out.data[2, 1:]) == 0)
+
+
+def test_seq_concat(np_rng):
+    la, lb = (3, 5, 2), (4, 1, 6)
+    sa = [np_rng.randn(l, 3).astype(np.float32) for l in la]
+    sb_ = [np_rng.randn(l, 3).astype(np.float32) for l in lb]
+    out = seq_ops.seq_concat(pad_sequences(sa), pad_sequences(sb_))
+    for i in range(3):
+        expect = np.concatenate([sa[i], sb_[i]], axis=0)
+        np.testing.assert_allclose(np.asarray(out.data[i, :len(expect)]),
+                                   expect, rtol=1e-6)
+        assert int(out.lengths[i]) == la[i] + lb[i]
+
+
+def test_context_projection_matches_reference_semantics(np_rng):
+    # context_start=-1, context_len=3: each step concats [prev, cur, next]
+    seqs, sb = make_batch(np_rng, lens=(4, 2), dim=3)
+    out = seq_ops.context_projection(sb, context_len=3, context_start=-1)
+    for i, s in enumerate(seqs):
+        T = len(s)
+        for t in range(T):
+            parts = []
+            for off in (-1, 0, 1):
+                j = t + off
+                parts.append(s[j] if 0 <= j < T else np.zeros(3, np.float32))
+            np.testing.assert_allclose(np.asarray(out.data[i, t]),
+                                       np.concatenate(parts), rtol=1e-6,
+                                       err_msg=f"seq {i} step {t}")
+
+
+def test_sub_seq_and_slice(np_rng):
+    seqs, sb = make_batch(np_rng, lens=(6, 4), dim=2)
+    out = seq_ops.sub_seq(sb, jnp.asarray([1, 0]), jnp.asarray([3, 2]), max_out=4)
+    np.testing.assert_allclose(np.asarray(out.data[0, :3]), seqs[0][1:4], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out.data[1, :2]), seqs[1][0:2], rtol=1e-6)
+    assert np.all(np.asarray(out.data[1, 2:]) == 0)
+
+
+def test_seq_reshape(np_rng):
+    seqs, sb = make_batch(np_rng, lens=(4, 2), dim=4)
+    out = seq_ops.seq_reshape(sb, new_dim=2)
+    assert out.data.shape == (2, 8, 2)
+    np.testing.assert_array_equal(np.asarray(out.lengths), [8, 4])
+    np.testing.assert_allclose(np.asarray(out.data[0, :8]).reshape(-1),
+                               seqs[0].reshape(-1), rtol=1e-6)
+
+
+def test_nested_batch(np_rng):
+    data = [
+        [np_rng.randn(2, 3).astype(np.float32), np_rng.randn(4, 3).astype(np.float32)],
+        [np_rng.randn(1, 3).astype(np.float32)],
+    ]
+    nb = pad_nested_sequences(data)
+    assert nb.data.shape == (2, 2, 4, 3)
+    np.testing.assert_array_equal(np.asarray(nb.outer_lengths), [2, 1])
+    flat = nb.flatten_outer()
+    np.testing.assert_array_equal(np.asarray(flat.lengths), [2, 4, 1, 0])
+
+
+def test_max_id_and_eos():
+    x = jnp.asarray([[0.1, 0.9], [0.8, 0.2]])
+    np.testing.assert_array_equal(np.asarray(seq_ops.max_id(x)), [1, 0])
+    ids = jnp.asarray([1, 2, 1])
+    np.testing.assert_array_equal(np.asarray(seq_ops.eos_check(ids, 1)), [1.0, 0.0, 1.0])
