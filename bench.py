@@ -1,0 +1,114 @@
+"""Benchmark harness: prints ONE JSON line for the driver.
+
+Primary metric mirrors the reference's headline RNN benchmark
+(benchmark/paddle/rnn/rnn.py + BASELINE.md): LSTM text classifier,
+2 stacked LSTM h=512, batch 64, seq len 100, vocab 30k — reference Paddle
+on 1x K40m: 184 ms/batch (including parameter update; BASELINE.md line
+"LSTM h=512 | 64 | 184").
+
+value = our ms/batch for the full train step (fwd+bwd+momentum update) on
+one TPU chip; vs_baseline = 184 / value (speedup, >1 is better).
+
+Env overrides: BENCH_MODEL=lstm|resnet50, BENCH_STEPS, BENCH_BATCH.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def bench_lstm(steps, batch=64, seq_len=100, hidden=512, vocab=30000):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models import text_lstm
+    from paddle_tpu import optim
+
+    params = text_lstm.init(jax.random.PRNGKey(0), vocab=vocab,
+                            emb_dim=128, hidden=hidden, num_layers=2)
+    opt = optim.Momentum(learning_rate=0.01, momentum=0.9)
+    opt_state = opt.init(params)
+
+    rng = np.random.RandomState(0)
+    ids = SequenceBatch(
+        data=jnp.asarray(rng.randint(0, vocab, (batch, seq_len)), jnp.int32),
+        lengths=jnp.full((batch,), seq_len, jnp.int32))
+    labels = jnp.asarray(rng.randint(0, 2, (batch,)), jnp.int32)
+
+    @jax.jit
+    def step(params, opt_state, ids, labels):
+        loss, grads = jax.value_and_grad(text_lstm.loss)(
+            params, ids, labels, 2, hidden)
+        new_params, new_opt = opt.update(grads, opt_state, params)
+        return new_params, new_opt, loss
+
+    # compile + warmup
+    params, opt_state, loss = step(params, opt_state, ids, labels)
+    jax.block_until_ready(loss)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, ids, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, ids, labels)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+    return dt * 1e3, 184.0, "LSTM-textclass h=512 bs=64 len=100 ms/batch"
+
+
+def bench_resnet50(steps, batch=32):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import resnet
+    from paddle_tpu import optim
+
+    params, state = resnet.init(jax.random.PRNGKey(0), depth=50,
+                                num_classes=1000)
+    opt = optim.Momentum(learning_rate=0.1, momentum=0.9)
+    opt_state = opt.init(params)
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(batch, 224, 224, 3), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 1000, (batch,)), jnp.int32)
+
+    @jax.jit
+    def step(params, state, opt_state, images, labels):
+        (loss, new_state), grads = jax.value_and_grad(
+            resnet.loss, has_aux=True)(params, state, images, labels, 50)
+        new_params, new_opt = opt.update(grads, opt_state, params)
+        return new_params, new_state, new_opt, loss
+
+    params, state, opt_state, loss = step(params, state, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, state, opt_state, loss = step(params, state, opt_state,
+                                              images, labels)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+    imgs_per_sec = batch / dt
+    return imgs_per_sec, None, "ResNet-50 images/sec/chip bs=32"
+
+
+def main():
+    model = os.environ.get("BENCH_MODEL", "lstm")
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    if model == "resnet50":
+        value, baseline, metric = bench_resnet50(steps)
+        out = {"metric": metric, "value": round(value, 2),
+               "unit": "images/sec",
+               "vs_baseline": None}
+    else:
+        value, baseline, metric = bench_lstm(steps)
+        out = {"metric": metric, "value": round(value, 3), "unit": "ms/batch",
+               "vs_baseline": round(baseline / value, 2)}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

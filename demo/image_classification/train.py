@@ -1,0 +1,64 @@
+"""CIFAR image classification (reference demo/image_classification VGG /
+ResNet on CIFAR-10).  --config_args model=vgg|resnet."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu.layers as L
+from paddle_tpu import optim
+from paddle_tpu.data import dense_vector, integer_value
+from paddle_tpu.data import reader as reader_mod
+from paddle_tpu.data.datasets import cifar
+
+
+def vgg_bn_drop(img):
+    return L.networks.img_conv_group(
+        img, [64, 64], pool_size=2, num_channels=3, conv_with_batchnorm=True,
+        conv_batchnorm_drop_rate=[0.3, 0.0])
+
+
+def resnet_cifar(img, depth=32):
+    # uses the DSL conv stack; the fast functional ResNet lives in
+    # paddle_tpu.models.resnet
+    n = (depth - 2) // 6
+    net = L.img_conv_layer(img, filter_size=3, num_filters=16, num_channels=3,
+                           padding=1, act=None)
+    net = L.batch_norm_layer(net, act="relu")
+    filters = 16
+    for stage, nf in enumerate((16, 32, 64)):
+        for block in range(n):
+            stride = 2 if (stage > 0 and block == 0) else 1
+            conv1 = L.img_conv_layer(net, filter_size=3, num_filters=nf,
+                                     stride=stride, padding=1, act=None)
+            bn1 = L.batch_norm_layer(conv1, act="relu")
+            conv2 = L.img_conv_layer(bn1, filter_size=3, num_filters=nf,
+                                     padding=1, act=None)
+            bn2 = L.batch_norm_layer(conv2, act=None)
+            if stride == 2 or filters != nf:
+                proj = L.img_conv_layer(net, filter_size=1, num_filters=nf,
+                                        stride=stride, act=None)
+                net = L.addto_layer([bn2, proj], act="relu")
+            else:
+                net = L.addto_layer([bn2, net], act="relu")
+            filters = nf
+    return L.img_pool_layer(net, pool_size=8, stride=1, pool_type="avg")
+
+
+def get_config():
+    model = globals().get("CONFIG_ARGS", {}).get("model", "resnet")
+    img = L.data_layer("image", size=3 * 32 * 32, height=32, width=32)
+    label = L.data_layer("label", size=1)
+    net = vgg_bn_drop(img) if model == "vgg" else resnet_cifar(img)
+    out = L.fc_layer(net, size=10, act="softmax")
+    cost = L.classification_cost(out, label)
+    return {
+        "cost": cost,
+        "output": out,
+        "optimizer": optim.Momentum(learning_rate=0.01, momentum=0.9,
+                                    l2=1e-4),
+        "train_reader": reader_mod.batch(
+            reader_mod.shuffle(cifar.train10(), 1024, seed=0), 128),
+        "test_reader": reader_mod.batch(cifar.test10(), 128),
+        "feeding": {"image": dense_vector(3 * 32 * 32),
+                    "label": integer_value(10)},
+    }

@@ -1,0 +1,50 @@
+"""MNIST LeNet demo (reference demo/mnist api_train_v2.py).
+
+Run:  python -m paddle_tpu train --config demo/mnist/train.py --num_passes 5
+or:   python demo/mnist/train.py   (standalone)
+"""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu as paddle
+import paddle_tpu.layers as L
+from paddle_tpu import optim
+from paddle_tpu.data import DataFeeder, dense_vector, integer_value
+from paddle_tpu.data import reader as reader_mod
+from paddle_tpu.data.datasets import mnist
+
+
+def network():
+    img = L.data_layer("pixel", size=784, height=28, width=28)
+    label = L.data_layer("label", size=1)
+    conv1 = L.img_conv_layer(img, filter_size=5, num_filters=20,
+                             num_channels=1, act="relu")
+    pool1 = L.img_pool_layer(conv1, pool_size=2, stride=2, ceil_mode=False)
+    conv2 = L.img_conv_layer(pool1, filter_size=5, num_filters=50, act="relu")
+    pool2 = L.img_pool_layer(conv2, pool_size=2, stride=2, ceil_mode=False)
+    fc1 = L.fc_layer(pool2, size=500, act="relu")
+    out = L.fc_layer(fc1, size=10, act="softmax")
+    cost = L.classification_cost(out, label)
+    return cost, out
+
+
+def get_config():
+    cost, out = network()
+    return {
+        "cost": cost,
+        "output": out,
+        "optimizer": optim.Momentum(learning_rate=0.01, momentum=0.9),
+        "train_reader": reader_mod.batch(
+            reader_mod.shuffle(mnist.train(), 1024, seed=0), 128),
+        "test_reader": reader_mod.batch(mnist.test(), 128),
+        "feeding": {"pixel": dense_vector(784), "label": integer_value(10)},
+    }
+
+
+if __name__ == "__main__":
+    from paddle_tpu.trainer import SGD
+    cfg = get_config()
+    SGD(cost=cfg["cost"], update_equation=cfg["optimizer"]).train(
+        cfg["train_reader"], num_passes=3, feeding=cfg["feeding"],
+        test_reader=cfg["test_reader"], log_period=10)

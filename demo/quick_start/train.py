@@ -1,0 +1,54 @@
+"""Text classification quick start (reference demo/quick_start: LR / CNN /
+LSTM variants over bag-of-words product reviews).  Variant selected via
+--config_args model=lr|cnn|lstm."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu.layers as L
+from paddle_tpu import optim
+from paddle_tpu.data import integer_value_sequence, integer_value
+from paddle_tpu.data import reader as reader_mod
+from paddle_tpu.data.datasets import imdb
+
+DICT_DIM = imdb.WORD_DIM
+
+
+def lr_net(words, label):
+    emb = L.embedding_layer(words, size=64)
+    pooled = L.pooling_layer(emb, pooling_type=L.pooling.Sum)
+    out = L.fc_layer(pooled, size=2, act="softmax")
+    return L.classification_cost(out, label), out
+
+
+def cnn_net(words, label):
+    emb = L.embedding_layer(words, size=128)
+    conv = L.networks.sequence_conv_pool(emb, context_len=3, hidden_size=256)
+    out = L.fc_layer(conv, size=2, act="softmax")
+    return L.classification_cost(out, label), out
+
+
+def lstm_net(words, label):
+    emb = L.embedding_layer(words, size=128)
+    lstm = L.networks.simple_lstm(emb, size=128)
+    pooled = L.pooling_layer(lstm, pooling_type=L.pooling.Max)
+    out = L.fc_layer(pooled, size=2, act="softmax")
+    return L.classification_cost(out, label), out
+
+
+def get_config():
+    model = globals().get("CONFIG_ARGS", {}).get("model", "cnn")
+    words = L.data_layer("words", size=DICT_DIM, is_seq=True)
+    label = L.data_layer("label", size=1)
+    cost, out = {"lr": lr_net, "cnn": cnn_net, "lstm": lstm_net}[model](
+        words, label)
+    return {
+        "cost": cost,
+        "output": out,
+        "optimizer": optim.Adam(learning_rate=0.002),
+        "train_reader": reader_mod.batch(
+            reader_mod.shuffle(imdb.train(), 512, seed=0), 64),
+        "test_reader": reader_mod.batch(imdb.test(), 64),
+        "feeding": {"words": integer_value_sequence(DICT_DIM),
+                    "label": integer_value(2)},
+    }

@@ -1,0 +1,57 @@
+"""MovieLens recommender demo (reference demo/recommendation api_train_v2) —
+functional dual-tower model from paddle_tpu.models.recommendation."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import pad_sequences
+from paddle_tpu.models import recommendation
+from paddle_tpu import optim
+from paddle_tpu.data import reader as reader_mod
+from paddle_tpu.data.datasets import movielens
+from paddle_tpu.utils.logging import logger
+
+
+def feed_batch(batch):
+    uid = jnp.asarray([b[0] for b in batch], jnp.int32)
+    gender = jnp.asarray([b[1] for b in batch], jnp.int32)
+    age = jnp.asarray([b[2] for b in batch], jnp.int32)
+    job = jnp.asarray([b[3] for b in batch], jnp.int32)
+    mid = jnp.asarray([b[4] for b in batch], jnp.int32)
+    cats = np.zeros((len(batch), movielens.CATEGORIES), np.float32)
+    for i, b in enumerate(batch):
+        cats[i, np.asarray(b[5], np.int64)] = 1.0
+    title = pad_sequences([np.asarray(b[6], np.int32) for b in batch])
+    score = jnp.asarray([b[7] for b in batch], jnp.float32)
+    return (uid, gender, age, job, mid, jnp.asarray(cats), title, score)
+
+
+def main(num_passes=2, batch_size=128):
+    params = recommendation.init(jax.random.PRNGKey(0))
+    opt = optim.Adam(learning_rate=1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, *feed):
+        loss, grads = jax.value_and_grad(recommendation.loss)(params, *feed)
+        params, opt_state = opt.update(grads, opt_state, params)
+        return params, opt_state, loss
+
+    reader = reader_mod.batch(
+        reader_mod.shuffle(movielens.train(), 1024, seed=0), batch_size)
+    for p in range(num_passes):
+        losses = []
+        for batch in reader():
+            params, opt_state, loss = step(params, opt_state,
+                                           *feed_batch(batch))
+            losses.append(float(loss))
+        logger.info("pass %d mean loss %.4f", p, np.mean(losses))
+    return params
+
+
+if __name__ == "__main__":
+    main()

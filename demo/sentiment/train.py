@@ -1,0 +1,49 @@
+"""IMDB sentiment stacked bi-LSTM (reference demo/sentiment
+sentiment_net.py stacked_lstm_net / bidirectional_lstm_net)."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu.layers as L
+from paddle_tpu import optim
+from paddle_tpu.data import integer_value_sequence, integer_value
+from paddle_tpu.data import reader as reader_mod
+from paddle_tpu.data.datasets import imdb
+
+DICT_DIM = imdb.WORD_DIM
+
+
+def stacked_lstm_net(words, label, hid=128, stacked_num=3):
+    emb = L.embedding_layer(words, size=128)
+    fc1 = L.fc_layer(emb, size=hid, act=None)
+    lstm1 = L.lstmemory(L.fc_layer(fc1, size=hid * 4, act=None,
+                                   bias_attr=False), size=hid)
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        fc = L.fc_layer(L.concat_layer(inputs), size=hid, act=None)
+        lstm = L.lstmemory(L.fc_layer(fc, size=hid * 4, act=None,
+                                      bias_attr=False),
+                           size=hid, reverse=(i % 2 == 0))
+        inputs = [fc, lstm]
+    fc_last = L.pooling_layer(inputs[0], pooling_type=L.pooling.Max)
+    lstm_last = L.pooling_layer(inputs[1], pooling_type=L.pooling.Max)
+    out = L.fc_layer(L.concat_layer([fc_last, lstm_last]), size=2,
+                     act="softmax")
+    return L.classification_cost(out, label), out
+
+
+def get_config():
+    words = L.data_layer("words", size=DICT_DIM, is_seq=True)
+    label = L.data_layer("label", size=1)
+    cost, out = stacked_lstm_net(words, label)
+    return {
+        "cost": cost,
+        "output": out,
+        "optimizer": optim.Adam(learning_rate=0.002, l2=1e-4,
+                                clip_norm=5.0),
+        "train_reader": reader_mod.batch(
+            reader_mod.shuffle(imdb.train(), 512, seed=0), 64),
+        "test_reader": reader_mod.batch(imdb.test(), 64),
+        "feeding": {"words": integer_value_sequence(DICT_DIM),
+                    "label": integer_value(2)},
+    }

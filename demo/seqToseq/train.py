@@ -1,0 +1,88 @@
+"""Attention NMT (reference demo/seqToseq seqToseq_net.py) — functional
+flagship model; supports training and beam-search generation.
+
+Train:    python demo/seqToseq/train.py
+Generate: python demo/seqToseq/train.py --generate --model_dir output
+"""
+
+import argparse
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import pad_sequences
+from paddle_tpu.models import seq2seq
+from paddle_tpu import optim
+from paddle_tpu.data import reader as reader_mod
+from paddle_tpu.data.datasets import wmt14
+from paddle_tpu.trainer.checkpoint import save_checkpoint, load_checkpoint
+from paddle_tpu.utils.logging import logger
+
+
+def make_batches(batch_size=32):
+    return reader_mod.batch(
+        reader_mod.shuffle(wmt14.train(), 1024, seed=0), batch_size)
+
+
+def feed_batch(batch):
+    src = pad_sequences([np.asarray(b[0], np.int32) for b in batch])
+    trg_in = pad_sequences([np.asarray(b[1], np.int32) for b in batch])
+    trg_next = pad_sequences([np.asarray(b[2], np.int32) for b in batch])
+    return src, trg_in, trg_next
+
+
+def train(num_passes=2, save_dir="output", hidden=256, emb=256):
+    params = seq2seq.init(jax.random.PRNGKey(0),
+                          src_vocab=wmt14.SRC_DICT_SIZE,
+                          trg_vocab=wmt14.TRG_DICT_SIZE,
+                          emb_dim=emb, hidden=hidden)
+    opt = optim.Adam(learning_rate=5e-4, clip_norm=5.0)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, src, trg_in, trg_next):
+        loss, grads = jax.value_and_grad(seq2seq.loss)(params, src, trg_in,
+                                                       trg_next)
+        params, opt_state = opt.update(grads, opt_state, params)
+        return params, opt_state, loss
+
+    for pass_id in range(num_passes):
+        losses = []
+        for i, batch in enumerate(make_batches()()):
+            src, trg_in, trg_next = feed_batch(batch)
+            params, opt_state, loss = step(params, opt_state, src, trg_in,
+                                           trg_next)
+            losses.append(float(loss))
+            if (i + 1) % 10 == 0:
+                logger.info("pass %d batch %d loss %.4f", pass_id, i + 1,
+                            np.mean(losses[-10:]))
+        save_checkpoint(save_dir, pass_id, params)
+    return params
+
+
+def generate(model_dir, beam_size=5, max_len=40):
+    params, _, _, _ = load_checkpoint(model_dir)
+    batch = list(__import__("itertools").islice(wmt14.test()(), 8))
+    src, _, _ = feed_batch(batch)
+    res = seq2seq.generate(params, src, beam_size=beam_size, max_len=max_len,
+                           bos_id=wmt14.START, eos_id=wmt14.END)
+    for i in range(src.data.shape[0]):
+        hyp = [int(t) for t in np.asarray(res.tokens[i, 0])
+               [:int(res.lengths[i, 0])]]
+        print(f"src={list(map(int, np.asarray(src.data[i])[:int(src.lengths[i])]))}")
+        print(f"  -> {hyp} (score {float(res.scores[i, 0]):.3f})")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--generate", action="store_true")
+    ap.add_argument("--model_dir", default="output")
+    ap.add_argument("--num_passes", type=int, default=2)
+    args = ap.parse_args()
+    if args.generate:
+        generate(args.model_dir)
+    else:
+        train(args.num_passes, args.model_dir)

@@ -1,0 +1,42 @@
+"""Linear-CRF sequence tagging (reference demo/sequence_tagging linear_crf
+NER config): context-window features -> fc -> CRF loss + decoding."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu.layers as L
+from paddle_tpu import optim
+from paddle_tpu.data import integer_value_sequence
+from paddle_tpu.data import reader as reader_mod
+from paddle_tpu.data.datasets import conll05
+
+
+def get_config():
+    num_labels = conll05.NUM_LABELS
+    words = L.data_layer("words", size=conll05.WORD_DICT, is_seq=True)
+    preds = L.data_layer("preds", size=conll05.PRED_DICT, is_seq=True)
+    label = L.data_layer("label", size=1, is_seq=True)
+
+    word_emb = L.embedding_layer(words, size=64)
+    pred_emb = L.embedding_layer(preds, size=32)
+    feats = L.mixed_layer(size=64 * 3 + 32, input=[
+        L.context_projection(word_emb, context_len=3, context_start=-1),
+        L.identity_projection(pred_emb),
+    ], act=None)
+    hidden = L.fc_layer(feats, size=128, act="tanh")
+    emission = L.fc_layer(hidden, size=num_labels, act=None)
+    crf_cost = L.crf_layer(emission, label, size=num_labels, name="crf")
+    decoded = L.crf_decoding_layer(emission, size=num_labels,
+                                   param_name=crf_cost.cfg["param_name"])
+    return {
+        "cost": crf_cost,
+        "output": decoded,
+        "optimizer": optim.Momentum(learning_rate=0.01, momentum=0.9,
+                                    l2=1e-4),
+        "train_reader": reader_mod.batch(
+            reader_mod.shuffle(conll05.train(), 256, seed=0), 32),
+        "test_reader": reader_mod.batch(conll05.test(), 32),
+        "feeding": {"words": integer_value_sequence(conll05.WORD_DICT),
+                    "preds": integer_value_sequence(conll05.PRED_DICT),
+                    "label": integer_value_sequence(num_labels)},
+    }

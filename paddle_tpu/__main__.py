@@ -1,0 +1,4 @@
+from paddle_tpu.trainer.cli import main
+import sys
+
+sys.exit(main())

@@ -10,9 +10,27 @@ the embedding path takes ids, the MXU path takes dense).
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from paddle_tpu.core.sequence import (
     SequenceBatch, pad_sequences, pad_nested_sequences, bucket_for)
 from paddle_tpu.data.provider import InputType, SeqType
+from paddle_tpu import native
+
+
+def _pad_int_seqs(seqs, max_len):
+    """Native fast path for the hot ragged-int packing loop."""
+    if native.is_available():
+        out, lens = native.pack_i32(seqs, max_len=max_len)
+        return SequenceBatch(data=jnp.asarray(out), lengths=jnp.asarray(lens))
+    return pad_sequences(seqs, max_len=max_len)
+
+
+def _pad_f32_seqs(seqs, max_len):
+    if native.is_available() and seqs and seqs[0].ndim == 2:
+        out, lens = native.pack_f32(seqs, max_len=max_len)
+        return SequenceBatch(data=jnp.asarray(out), lengths=jnp.asarray(lens))
+    return pad_sequences(seqs, max_len=max_len)
 
 
 class DataFeeder:
@@ -65,7 +83,9 @@ class DataFeeder:
             max_len = max(len(s) for s in seqs)
             if self.bucket_bounds:
                 max_len = bucket_for(max_len, self.bucket_bounds)
-            return pad_sequences(seqs, max_len=max_len)
+            if itype.kind == "index":
+                return _pad_int_seqs(seqs, max_len)
+            return _pad_f32_seqs(seqs, max_len)
         else:  # SUB_SEQUENCE
             nested = [[np.asarray(sub, np.int32 if itype.kind == "index"
                                   else np.float32) for sub in s]

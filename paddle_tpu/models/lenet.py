@@ -1,0 +1,37 @@
+"""LeNet-ish MNIST CNN (reference demo/mnist: conv-pool x2 + fc, the PR1
+end-to-end slice per SURVEY.md §7.4).  Functional NHWC/bf16 implementation."""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import linear, losses, initializers
+
+
+def init(rng, num_classes=10):
+    ks = jax.random.split(rng, 8)
+    cinit = initializers.conv_default()
+    ninit = initializers.normal()
+    return {
+        "c1": {"w": cinit(ks[0], (5, 5, 1, 20)), "b": jnp.zeros((20,))},
+        "c2": {"w": cinit(ks[1], (5, 5, 20, 50)), "b": jnp.zeros((50,))},
+        "f1": {"w": ninit(ks[2], (4 * 4 * 50, 500)), "b": jnp.zeros((500,))},
+        "f2": {"w": ninit(ks[3], (500, num_classes)),
+               "b": jnp.zeros((num_classes,))},
+    }
+
+
+def forward(params, images):
+    """images: [B, 784] in [-1, 1] -> logits [B, 10]."""
+    x = images.reshape(-1, 28, 28, 1)
+    x = conv_ops.conv2d(x, params["c1"]["w"], params["c1"]["b"], act="relu")
+    x = conv_ops.max_pool2d(x, (2, 2))
+    x = conv_ops.conv2d(x, params["c2"]["w"], params["c2"]["b"], act="relu")
+    x = conv_ops.max_pool2d(x, (2, 2))
+    x = x.reshape(x.shape[0], -1)
+    x = linear.fc(x, params["f1"]["w"], params["f1"]["b"], act="relu")
+    return linear.fc(x, params["f2"]["w"], params["f2"]["b"])
+
+
+def loss(params, images, labels):
+    return jnp.mean(losses.classification_cost(forward(params, images), labels))

@@ -1,0 +1,62 @@
+"""MovieLens recommender (reference demo/recommendation: user/movie feature
+towers -> cos-sim rating regression; the sparse-CTR acceptance config in
+BASELINE.json).  Embedding tables are the sparse-parameter path — sharded
+over the 'model' mesh axis at scale (parallel.megatron_rules matches the
+'emb' names)."""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import linear, losses, embedding as emb_ops
+from paddle_tpu.ops import sequence as seq_ops
+from paddle_tpu.ops import math_ops
+from paddle_tpu.ops import initializers
+
+
+def init(rng, max_user=6040, max_movie=3952, ages=7, jobs=21, genders=2,
+         categories=18, title_vocab=5174, emb=256, hidden=256):
+    ks = iter(jax.random.split(rng, 20))
+    u = initializers.uniform(0.05)
+    n = initializers.normal()
+    return {
+        "user_emb": u(next(ks), (max_user + 1, emb)),
+        "gender_emb": u(next(ks), (genders, emb // 8)),
+        "age_emb": u(next(ks), (ages, emb // 8)),
+        "job_emb": u(next(ks), (jobs, emb // 8)),
+        "user_fc": {"w": n(next(ks), (emb + 3 * (emb // 8), hidden)),
+                    "b": jnp.zeros((hidden,))},
+        "movie_emb": u(next(ks), (max_movie + 1, emb)),
+        "cat_emb": u(next(ks), (categories, emb // 4)),
+        "title_emb": u(next(ks), (title_vocab, emb // 2)),
+        "movie_fc": {"w": n(next(ks), (emb + emb // 4 + emb // 2, hidden)),
+                     "b": jnp.zeros((hidden,))},
+    }
+
+
+def forward(params, uid, gender, age, job, mid, categories, title):
+    """categories: multi-hot [B, n_cat]; title: SequenceBatch of word ids.
+    Returns predicted rating [B] in [1, 5] (reference: 5 * cos_sim scale)."""
+    uf = jnp.concatenate([
+        emb_ops.embedding_lookup(params["user_emb"], uid),
+        emb_ops.embedding_lookup(params["gender_emb"], gender),
+        emb_ops.embedding_lookup(params["age_emb"], age),
+        emb_ops.embedding_lookup(params["job_emb"], job),
+    ], axis=-1)
+    user_vec = jnp.tanh(linear.matmul(uf, params["user_fc"]["w"])
+                        + params["user_fc"]["b"])
+
+    cat_vec = linear.matmul(categories, params["cat_emb"])
+    title_emb = emb_ops.embedding_lookup(params["title_emb"], title.data)
+    title_vec = seq_ops.seq_avg_pool(SequenceBatch(title_emb, title.lengths))
+    mf = jnp.concatenate([
+        emb_ops.embedding_lookup(params["movie_emb"], mid), cat_vec, title_vec,
+    ], axis=-1)
+    movie_vec = jnp.tanh(linear.matmul(mf, params["movie_fc"]["w"])
+                         + params["movie_fc"]["b"])
+    return 5.0 * math_ops.cos_sim(user_vec, movie_vec)[:, 0]
+
+
+def loss(params, uid, gender, age, job, mid, categories, title, score):
+    pred = forward(params, uid, gender, age, job, mid, categories, title)
+    return jnp.mean(0.5 * jnp.square(pred - score))

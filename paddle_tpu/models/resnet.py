@@ -1,0 +1,175 @@
+"""ResNet family (reference demo/image_classification resnet configs +
+BASELINE.json 'ResNet-50 images/sec/chip' headline metric).
+
+Functional NHWC implementation designed for the MXU: bf16 conv compute with
+f32 accumulation (ops.conv), BN as explicit state, identity downsample via
+strided 1x1.  Supports CIFAR depths (20/32/56: 3 stages of n blocks) and
+ImageNet bottleneck depths (50/101/152).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import linear, losses
+from paddle_tpu.ops.norm import batch_norm_train, batch_norm_infer
+
+
+def _conv_init(rng, kh, kw, cin, cout):
+    fan = kh * kw * cin
+    return (2.0 / fan) ** 0.5 * jax.random.normal(
+        rng, (kh, kw, cin, cout), jnp.float32)
+
+
+def _bn_params(c):
+    return {"gamma": jnp.ones((c,)), "beta": jnp.zeros((c,))}
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,)), "var": jnp.ones((c,))}
+
+
+def _apply_bn(x, p, s, train, momentum=0.9):
+    if train:
+        y, (nm, nv) = batch_norm_train(x, p["gamma"], p["beta"],
+                                       s["mean"], s["var"], momentum)
+        return y, {"mean": nm, "var": nv}
+    return batch_norm_infer(x, p["gamma"], p["beta"], s["mean"], s["var"]), s
+
+
+def init(rng, depth=50, num_classes=1000, in_channels=3, imagenet=None):
+    """Returns (params, state)."""
+    imagenet = imagenet if imagenet is not None else depth in (50, 101, 152)
+    keys = iter(jax.random.split(rng, 512))
+    params, state = {}, {}
+
+    if imagenet:
+        blocks_per = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3),
+                      152: (3, 8, 36, 3)}[depth]
+        widths = (256, 512, 1024, 2048)
+        params["stem"] = {"w": _conv_init(next(keys), 7, 7, in_channels, 64),
+                          "bn": _bn_params(64)}
+        state["stem"] = _bn_state(64)
+        cin = 64
+        for si, (n, w) in enumerate(zip(blocks_per, widths)):
+            mid = w // 4
+            for bi in range(n):
+                nm = f"s{si}b{bi}"
+                stride = 2 if (bi == 0 and si > 0) else 1
+                blk = {
+                    "c1": {"w": _conv_init(next(keys), 1, 1, cin, mid),
+                           "bn": _bn_params(mid)},
+                    "c2": {"w": _conv_init(next(keys), 3, 3, mid, mid),
+                           "bn": _bn_params(mid)},
+                    "c3": {"w": _conv_init(next(keys), 1, 1, mid, w),
+                           "bn": _bn_params(w)},
+                }
+                st = {"c1": _bn_state(mid), "c2": _bn_state(mid),
+                      "c3": _bn_state(w)}
+                if cin != w or stride != 1:
+                    blk["proj"] = {"w": _conv_init(next(keys), 1, 1, cin, w),
+                                   "bn": _bn_params(w)}
+                    st["proj"] = _bn_state(w)
+                params[nm], state[nm] = blk, st
+                cin = w
+        params["head"] = {"w": 0.01 * jax.random.normal(
+            next(keys), (cin, num_classes)), "b": jnp.zeros((num_classes,))}
+    else:
+        n = {20: 3, 32: 5, 56: 9, 110: 18}[depth]
+        widths = (16, 32, 64)
+        params["stem"] = {"w": _conv_init(next(keys), 3, 3, in_channels, 16),
+                          "bn": _bn_params(16)}
+        state["stem"] = _bn_state(16)
+        cin = 16
+        for si, w in enumerate(widths):
+            for bi in range(n):
+                nm = f"s{si}b{bi}"
+                stride = 2 if (bi == 0 and si > 0) else 1
+                blk = {"c1": {"w": _conv_init(next(keys), 3, 3, cin, w),
+                              "bn": _bn_params(w)},
+                       "c2": {"w": _conv_init(next(keys), 3, 3, w, w),
+                              "bn": _bn_params(w)}}
+                st = {"c1": _bn_state(w), "c2": _bn_state(w)}
+                if cin != w or stride != 1:
+                    blk["proj"] = {"w": _conv_init(next(keys), 1, 1, cin, w),
+                                   "bn": _bn_params(w)}
+                    st["proj"] = _bn_state(w)
+                params[nm], state[nm] = blk, st
+                cin = w
+        params["head"] = {"w": 0.01 * jax.random.normal(
+            next(keys), (cin, num_classes)), "b": jnp.zeros((num_classes,))}
+    return params, state
+
+
+def _bottleneck(x, blk, st, stride, train):
+    new_st = {}
+    y = conv_ops.conv2d(x, blk["c1"]["w"], stride=(1, 1))
+    y, new_st["c1"] = _apply_bn(y, blk["c1"]["bn"], st["c1"], train)
+    y = jax.nn.relu(y)
+    y = conv_ops.conv2d(y, blk["c2"]["w"], stride=(stride, stride),
+                        padding=(1, 1))
+    y, new_st["c2"] = _apply_bn(y, blk["c2"]["bn"], st["c2"], train)
+    y = jax.nn.relu(y)
+    y = conv_ops.conv2d(y, blk["c3"]["w"], stride=(1, 1))
+    y, new_st["c3"] = _apply_bn(y, blk["c3"]["bn"], st["c3"], train)
+    if "proj" in blk:
+        x = conv_ops.conv2d(x, blk["proj"]["w"], stride=(stride, stride))
+        x, new_st["proj"] = _apply_bn(x, blk["proj"]["bn"], st["proj"], train)
+    return jax.nn.relu(x + y), new_st
+
+
+def _basic(x, blk, st, stride, train):
+    new_st = {}
+    y = conv_ops.conv2d(x, blk["c1"]["w"], stride=(stride, stride),
+                        padding=(1, 1))
+    y, new_st["c1"] = _apply_bn(y, blk["c1"]["bn"], st["c1"], train)
+    y = jax.nn.relu(y)
+    y = conv_ops.conv2d(y, blk["c2"]["w"], stride=(1, 1), padding=(1, 1))
+    y, new_st["c2"] = _apply_bn(y, blk["c2"]["bn"], st["c2"], train)
+    if "proj" in blk:
+        x = conv_ops.conv2d(x, blk["proj"]["w"], stride=(stride, stride))
+        x, new_st["proj"] = _apply_bn(x, blk["proj"]["bn"], st["proj"], train)
+    return jax.nn.relu(x + y), new_st
+
+
+def forward(params, state, images, depth=50, train=True, imagenet=None):
+    """images: NHWC float.  depth/imagenet are static config (must match
+    init).  Returns (logits, new_state)."""
+    imagenet = imagenet if imagenet is not None else depth in (50, 101, 152)
+    new_state = {}
+    x = images
+    if imagenet:
+        x = conv_ops.conv2d(x, params["stem"]["w"], stride=(2, 2),
+                            padding=(3, 3))
+        x, new_state["stem"] = _apply_bn(x, params["stem"]["bn"],
+                                         state["stem"], train)
+        x = jax.nn.relu(x)
+        x = conv_ops.max_pool2d(x, (3, 3), (2, 2), (1, 1))
+        blocks_per = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3),
+                      152: (3, 8, 36, 3)}[depth]
+        for si, n in enumerate(blocks_per):
+            for bi in range(n):
+                nm = f"s{si}b{bi}"
+                stride = 2 if (bi == 0 and si > 0) else 1
+                x, new_state[nm] = _bottleneck(x, params[nm], state[nm],
+                                               stride, train)
+    else:
+        x = conv_ops.conv2d(x, params["stem"]["w"], padding=(1, 1))
+        x, new_state["stem"] = _apply_bn(x, params["stem"]["bn"],
+                                         state["stem"], train)
+        x = jax.nn.relu(x)
+        n = {20: 3, 32: 5, 56: 9, 110: 18}[depth]
+        for si in range(3):
+            for bi in range(n):
+                nm = f"s{si}b{bi}"
+                stride = 2 if (bi == 0 and si > 0) else 1
+                x, new_state[nm] = _basic(x, params[nm], state[nm], stride,
+                                          train)
+    x = jnp.mean(x, axis=(1, 2))
+    logits = linear.fc(x, params["head"]["w"], params["head"]["b"])
+    return logits, new_state
+
+
+def loss(params, state, images, labels, depth=50, train=True, imagenet=None):
+    logits, new_state = forward(params, state, images, depth, train, imagenet)
+    return jnp.mean(losses.classification_cost(logits, labels)), new_state

@@ -1,0 +1,151 @@
+"""Attention NMT encoder-decoder — the reference's demo/seqToseq
+(seqToseq_net.py: bi-GRU encoder, Bahdanau attention, GRU decoder with
+gru_step inside a recurrent_group, beam-search generation) rebuilt
+functionally: teacher-forced training is one lax.scan over target steps;
+generation is ops.beam.beam_search with the decoder step as the lane-major
+step function.  Encoder projections are hoisted out of the decode loop
+(one MXU matmul for all source positions, as the reference hoists
+encoded_proj).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import rnn, linear, losses, embedding as emb_ops
+from paddle_tpu.ops import attention as attn_ops
+from paddle_tpu.ops import beam as beam_ops
+from paddle_tpu.ops import initializers
+
+
+def init(rng, src_vocab=30000, trg_vocab=30000, emb_dim=512, hidden=512,
+         att_dim=None):
+    att_dim = att_dim or hidden
+    ks = iter(jax.random.split(rng, 24))
+    ninit = initializers.normal()
+    uinit = initializers.uniform(0.1)
+    h = hidden
+    return {
+        "src_emb": uinit(next(ks), (src_vocab, emb_dim)),
+        "trg_emb": uinit(next(ks), (trg_vocab, emb_dim)),
+        # encoder bi-GRU
+        "enc_fwd": {"w_in": ninit(next(ks), (emb_dim, 3 * h)),
+                    "w_gate": ninit(next(ks), (h, 2 * h)),
+                    "w_state": ninit(next(ks), (h, h)),
+                    "b": jnp.zeros((3 * h,))},
+        "enc_bwd": {"w_in": ninit(next(ks), (emb_dim, 3 * h)),
+                    "w_gate": ninit(next(ks), (h, 2 * h)),
+                    "w_state": ninit(next(ks), (h, h)),
+                    "b": jnp.zeros((3 * h,))},
+        # attention (additive): enc_proj once per sentence + dec proj per step
+        "att_enc": ninit(next(ks), (2 * h, att_dim)),
+        "att_dec": ninit(next(ks), (h, att_dim)),
+        "att_v": ninit(next(ks), (att_dim,)),
+        # decoder boot from encoder backward first state (reference decoder_boot)
+        "boot": {"w": ninit(next(ks), (h, h)), "b": jnp.zeros((h,))},
+        # decoder GRU: input = [trg_emb ; context(2h)] -> 3h projection
+        "dec_in": ninit(next(ks), (emb_dim + 2 * h, 3 * h)),
+        "dec_b": jnp.zeros((3 * h,)),
+        "dec_gate": ninit(next(ks), (h, 2 * h)),
+        "dec_state": ninit(next(ks), (h, h)),
+        # readout: [state ; context ; emb] -> logits
+        "out1": {"w": ninit(next(ks), (h + 2 * h + emb_dim, h)),
+                 "b": jnp.zeros((h,))},
+        "out2": {"w": ninit(next(ks), (h, trg_vocab)),
+                 "b": jnp.zeros((trg_vocab,))},
+    }
+
+
+def encode(params, src: SequenceBatch):
+    """-> (enc_states SequenceBatch [B,T,2H], enc_proj SequenceBatch
+    [B,T,A], boot decoder state [B,H])."""
+    x = emb_ops.embedding_lookup(params["src_emb"], src.data)
+    pf, pb = params["enc_fwd"], params["enc_bwd"]
+    fwd, _ = rnn.gru(SequenceBatch(linear.matmul(x, pf["w_in"]), src.lengths),
+                     pf["w_gate"], pf["w_state"], bias=pf["b"])
+    bwd, _ = rnn.gru(SequenceBatch(linear.matmul(x, pb["w_in"]), src.lengths),
+                     pb["w_gate"], pb["w_state"], bias=pb["b"], reverse=True)
+    enc = rnn.bidirectional(fwd, bwd)
+    proj = SequenceBatch(linear.matmul(enc.data, params["att_enc"]),
+                         enc.lengths)
+    # reference decoder_boot: fc(tanh) of backward encoder's first step
+    boot = jnp.tanh(linear.matmul(bwd.data[:, 0], params["boot"]["w"])
+                    + params["boot"]["b"])
+    return enc, proj, boot
+
+
+def _dec_step(params, enc, enc_proj, state, emb_t):
+    """One decoder step: attention + GRU + readout.  state: [B,H]."""
+    dec_proj = linear.matmul(state, params["att_dec"])
+    scores = attn_ops.additive_attention_scores(enc_proj, dec_proj,
+                                                params["att_v"])
+    context = attn_ops.attention_context(scores, enc)          # [B, 2H]
+    x = jnp.concatenate([emb_t, context], axis=-1)
+    x3 = linear.matmul(x, params["dec_in"]) + params["dec_b"]
+    new_state = rnn.gru_cell(x3, state, params["dec_gate"], params["dec_state"])
+    readout = jnp.tanh(linear.matmul(
+        jnp.concatenate([new_state, context, emb_t], axis=-1),
+        params["out1"]["w"]) + params["out1"]["b"])
+    logits = linear.matmul(readout, params["out2"]["w"]) + params["out2"]["b"]
+    return new_state, logits
+
+
+def forward(params, src: SequenceBatch, trg_in: SequenceBatch):
+    """Teacher-forced decode -> logits [B, T_trg, V]."""
+    enc, enc_proj, boot = encode(params, src)
+    emb = emb_ops.embedding_lookup(params["trg_emb"], trg_in.data)
+    emb_tm = emb.transpose(1, 0, 2)
+    mask_tm = trg_in.mask().transpose(1, 0)
+
+    def body(state, xs):
+        emb_t, m = xs
+        new_state, logits = _dec_step(params, enc, enc_proj, state, emb_t)
+        state = jnp.where(m[:, None] > 0, new_state, state)
+        return state, logits
+
+    _, logits_tm = jax.lax.scan(body, boot, (emb_tm, mask_tm))
+    return logits_tm.transpose(1, 0, 2)
+
+
+def loss(params, src: SequenceBatch, trg_in: SequenceBatch,
+         trg_next: SequenceBatch):
+    logits = forward(params, src, trg_in)
+    labels = trg_next.data
+    if labels.ndim == 3:
+        labels = labels[..., 0]
+    per_tok = losses.classification_cost(logits, labels)
+    per_seq = losses.masked_seq_mean(per_tok, trg_in.mask(per_tok.dtype))
+    return jnp.mean(per_seq)
+
+
+def generate(params, src: SequenceBatch, beam_size=5, max_len=50, bos_id=0,
+             eos_id=1, length_penalty=0.0):
+    """Beam-search translation (reference gen_trans_file / SequenceGenerator)."""
+    b = src.data.shape[0]
+    enc, enc_proj, boot = encode(params, src)
+
+    def tile(x):
+        return jnp.repeat(x, beam_size, axis=0)
+
+    enc_l = SequenceBatch(tile(enc.data), tile(enc.lengths))
+    proj_l = SequenceBatch(tile(enc_proj.data), tile(enc_proj.lengths))
+
+    def step_fn(state, prev_ids):
+        emb_t = emb_ops.embedding_lookup(params["trg_emb"], prev_ids)
+        new_state, logits = _dec_step(params, enc_l, proj_l, state, emb_t)
+        return jax.nn.log_softmax(logits, axis=-1), new_state
+
+    return beam_ops.beam_search(step_fn, tile(boot), b, beam_size, max_len,
+                                bos_id, eos_id, length_penalty=length_penalty)
+
+
+def greedy_generate(params, src: SequenceBatch, max_len=50, bos_id=0, eos_id=1):
+    b = src.data.shape[0]
+    enc, enc_proj, boot = encode(params, src)
+
+    def step_fn(state, prev_ids):
+        emb_t = emb_ops.embedding_lookup(params["trg_emb"], prev_ids)
+        new_state, logits = _dec_step(params, enc, enc_proj, state, emb_t)
+        return jax.nn.log_softmax(logits, axis=-1), new_state
+
+    return beam_ops.greedy_search(step_fn, boot, b, max_len, bos_id, eos_id)

@@ -1,0 +1,53 @@
+"""LSTM text classifier — the reference's RNN benchmark model
+(benchmark/paddle/rnn/rnn.py: IMDB, embedding 128 -> N stacked LSTM h=H ->
+max-pool over time -> fc 2; BASELINE.md LSTM rows: h=512 bs=64 -> 184
+ms/batch on K40m).
+
+Functional implementation; the per-layer input projections for ALL timesteps
+run as single big MXU matmuls outside the scan (ops.rnn design).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import rnn, linear, losses, embedding as emb_ops
+from paddle_tpu.ops import sequence as seq_ops
+from paddle_tpu.ops import initializers
+
+
+def init(rng, vocab=30000, emb_dim=128, hidden=512, num_layers=2,
+         num_classes=2):
+    ks = iter(jax.random.split(rng, 4 + 3 * num_layers))
+    ninit = initializers.normal()
+    params = {"emb": initializers.uniform(0.1)(next(ks), (vocab, emb_dim))}
+    d_in = emb_dim
+    for i in range(num_layers):
+        params[f"l{i}"] = {
+            "w_in": ninit(next(ks), (d_in, 4 * hidden)),
+            "w_r": ninit(next(ks), (hidden, 4 * hidden)),
+            "b": jnp.zeros((7 * hidden,)),
+        }
+        d_in = hidden
+    params["out"] = {"w": ninit(next(ks), (hidden, num_classes)),
+                     "b": jnp.zeros((num_classes,))}
+    return params
+
+
+def forward(params, ids: SequenceBatch, num_layers=2, hidden=512):
+    x = emb_ops.embedding_lookup(params["emb"], ids.data)
+    sb = SequenceBatch(data=x, lengths=ids.lengths)
+    for i in range(num_layers):
+        p = params[f"l{i}"]
+        proj = linear.matmul(sb.data, p["w_in"])
+        d = hidden
+        sb, _ = rnn.lstm(SequenceBatch(proj, sb.lengths), p["w_r"],
+                         bias=p["b"][:4 * d], check_i=p["b"][4 * d:5 * d],
+                         check_f=p["b"][5 * d:6 * d], check_o=p["b"][6 * d:])
+    pooled = seq_ops.seq_max_pool(sb)
+    return linear.fc(pooled, params["out"]["w"], params["out"]["b"])
+
+
+def loss(params, ids, labels, num_layers=2, hidden=512):
+    logits = forward(params, ids, num_layers, hidden)
+    return jnp.mean(losses.classification_cost(logits, labels))

@@ -1,0 +1,150 @@
+"""Transformer-base MT (BASELINE.json stretch config: "Transformer-base MT —
+stretch gserver layers to attention stack").  The reference predates
+attention; this is the TPU-era flagship: pre-LN encoder-decoder, bf16 MXU
+matmuls, f32 softmax/layernorm, causal+padding masks, beam-search decode
+sharing ops.beam with seq2seq.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import linear, losses, embedding as emb_ops
+from paddle_tpu.ops import attention as attn_ops
+from paddle_tpu.ops import beam as beam_ops
+from paddle_tpu.ops.norm import layer_norm
+
+
+def _dense(rng, din, dout, scale=None):
+    s = scale or (1.0 / math.sqrt(din))
+    return s * jax.random.normal(rng, (din, dout), jnp.float32)
+
+
+def _block_init(ks, d, dff, cross=False):
+    blk = {
+        "ln1": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+        "attn": {"wq": _dense(next(ks), d, d), "wk": _dense(next(ks), d, d),
+                 "wv": _dense(next(ks), d, d), "wo": _dense(next(ks), d, d)},
+        "ln2": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
+        "ffn": {"w1": _dense(next(ks), d, dff), "b1": jnp.zeros((dff,)),
+                "w2": _dense(next(ks), dff, d), "b2": jnp.zeros((d,))},
+    }
+    if cross:
+        blk["ln_x"] = {"g": jnp.ones((d,)), "b": jnp.zeros((d,))}
+        blk["xattn"] = {"wq": _dense(next(ks), d, d),
+                        "wk": _dense(next(ks), d, d),
+                        "wv": _dense(next(ks), d, d),
+                        "wo": _dense(next(ks), d, d)}
+    return blk
+
+
+def init(rng, src_vocab=30000, trg_vocab=30000, d_model=512, num_heads=8,
+         dff=2048, enc_layers=6, dec_layers=6, max_len=512):
+    ks = iter(jax.random.split(rng, 16 + 8 * (enc_layers + dec_layers)))
+    params = {
+        "src_emb": _dense(next(ks), src_vocab, d_model, scale=0.02),
+        "trg_emb": _dense(next(ks), trg_vocab, d_model, scale=0.02),
+        "pos": 0.02 * jax.random.normal(next(ks), (max_len, d_model)),
+        "enc": [_block_init(ks, d_model, dff) for _ in range(enc_layers)],
+        "dec": [_block_init(ks, d_model, dff, cross=True)
+                for _ in range(dec_layers)],
+        "ln_f": {"g": jnp.ones((d_model,)), "b": jnp.zeros((d_model,))},
+        "out": _dense(next(ks), d_model, trg_vocab),
+    }
+    return params
+
+
+def _mha(blk, xq, xkv, num_heads, mask=None, causal=False):
+    return attn_ops.multi_head_attention(
+        xq, xkv, blk["wq"], blk["wk"], blk["wv"], blk["wo"], num_heads,
+        mask=mask, causal=causal)
+
+
+def _ffn(blk, x):
+    h = jax.nn.relu(linear.matmul(x, blk["w1"]) + blk["b1"])
+    return linear.matmul(h, blk["w2"]) + blk["b2"]
+
+
+def _ln(p, x):
+    return layer_norm(x, p["g"], p["b"])
+
+
+def encode(params, src: SequenceBatch, num_heads=8):
+    t = src.data.shape[1]
+    x = emb_ops.embedding_lookup(params["src_emb"], src.data)
+    x = x * math.sqrt(x.shape[-1]) + params["pos"][:t][None]
+    mask = attn_ops.padding_mask(src.mask(), src.mask())
+    for blk in params["enc"]:
+        x = x + _mha(blk["attn"], _ln(blk["ln1"], x), _ln(blk["ln1"], x),
+                     num_heads, mask=mask)
+        x = x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
+    return x
+
+
+def decode(params, enc_out, src_mask, trg_in: SequenceBatch, num_heads=8,
+           pos_offset=0):
+    t = trg_in.data.shape[1]
+    x = emb_ops.embedding_lookup(params["trg_emb"], trg_in.data)
+    x = x * math.sqrt(x.shape[-1]) + \
+        params["pos"][pos_offset:pos_offset + t][None]
+    self_mask = attn_ops.padding_mask(trg_in.mask(), trg_in.mask())
+    cross_mask = attn_ops.padding_mask(trg_in.mask(), src_mask)
+    for blk in params["dec"]:
+        h = _ln(blk["ln1"], x)
+        x = x + _mha(blk["attn"], h, h, num_heads, mask=self_mask, causal=True)
+        x = x + _mha(blk["xattn"], _ln(blk["ln_x"], x), enc_out, num_heads,
+                     mask=cross_mask)
+        x = x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
+    x = _ln(params["ln_f"], x)
+    return linear.matmul(x, params["out"])
+
+
+def forward(params, src: SequenceBatch, trg_in: SequenceBatch, num_heads=8):
+    enc_out = encode(params, src, num_heads)
+    return decode(params, enc_out, src.mask(), trg_in, num_heads)
+
+
+def loss(params, src, trg_in, trg_next, num_heads=8, label_smoothing=0.1):
+    logits = forward(params, src, trg_in, num_heads)
+    labels = trg_next.data
+    if labels.ndim == 3:
+        labels = labels[..., 0]
+    v = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    onehot = jax.nn.one_hot(labels, v)
+    smoothed = onehot * (1 - label_smoothing) + label_smoothing / v
+    per_tok = -jnp.sum(smoothed * logp, axis=-1)
+    per_seq = losses.masked_seq_mean(per_tok, trg_in.mask(per_tok.dtype))
+    return jnp.mean(per_seq)
+
+
+def generate(params, src: SequenceBatch, beam_size=4, max_len=64, bos_id=0,
+             eos_id=1, num_heads=8, length_penalty=0.6):
+    """Beam decode.  Simple full-recompute step (KV-cache decode arrives with
+    the serving module); correctness-first."""
+    b = src.data.shape[0]
+    enc_out = encode(params, src, num_heads)
+
+    def tile(x):
+        return jnp.repeat(x, beam_size, axis=0)
+
+    enc_l, src_mask_l = tile(enc_out), tile(src.mask())
+    bk = b * beam_size
+
+    def step_fn(state, prev_ids):
+        toks, step = state           # toks: [BK, max_len]; step: [BK] (equal)
+        t = step[0]
+        toks = jax.vmap(lambda row, v: row.at[t].set(v))(toks, prev_ids)
+        trg = SequenceBatch(toks, step + 1)
+        logits = decode(params, enc_l, src_mask_l, trg, num_heads)
+        last = jnp.take_along_axis(
+            logits, jnp.broadcast_to(t.reshape(1, 1, 1),
+                                     (bk, 1, logits.shape[-1])), axis=1)[:, 0]
+        return jax.nn.log_softmax(last, axis=-1), (toks, step + 1)
+
+    init_state = (jnp.full((bk, max_len), eos_id, jnp.int32),
+                  jnp.zeros((bk,), jnp.int32))
+    return beam_ops.beam_search(step_fn, init_state, b, beam_size, max_len,
+                                bos_id, eos_id, length_penalty=length_penalty)

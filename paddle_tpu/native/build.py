@@ -1,0 +1,24 @@
+"""Build the native data-path library: python -m paddle_tpu.native.build"""
+
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def build(verbose=True):
+    src = os.path.join(_DIR, "src", "dataio.cpp")
+    out = os.path.join(_DIR, "libpaddle_tpu_dataio.so")
+    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+           "-Wall", src, "-o", out]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.check_call(cmd)
+    return out
+
+
+if __name__ == "__main__":
+    path = build()
+    print("built", path)
+    sys.exit(0)

@@ -1,0 +1,237 @@
+// Native data-path runtime: ragged-batch packing + binary record IO +
+// background prefetch pool.
+//
+// TPU-native counterpart of the reference's C++ data plane —
+// gserver/dataproviders/{DataProvider.cpp (DoubleBuffer), ProtoDataProvider,
+// PyDataProvider2.cpp} and the SequenceToBatch packing in
+// gserver/layers/SequenceToBatch.cpp.  The compute side is XLA; this native
+// module owns what stays on the host: turning millions of small ragged
+// Python/numpy sequences into padded device-ready buffers without the
+// Python interpreter in the per-token loop, and streaming record files with
+// a worker pool ahead of the train step.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <cstdlib>
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- packing
+
+// Pack B ragged int32 sequences into out[B, max_len] (pre-allocated),
+// writing lengths[B].  pad fills the tail.  Returns 0 on success.
+int pt_pack_i32(const int32_t** seqs, const int32_t* lens, int32_t b,
+                int32_t max_len, int32_t pad, int32_t* out,
+                int32_t* out_lens) {
+  if (!seqs || !lens || !out || !out_lens || b < 0 || max_len <= 0) return -1;
+  for (int32_t i = 0; i < b; ++i) {
+    int32_t n = lens[i] < max_len ? lens[i] : max_len;
+    if (n > 0) std::memcpy(out + (size_t)i * max_len, seqs[i],
+                           (size_t)n * sizeof(int32_t));
+    for (int32_t t = n; t < max_len; ++t) out[(size_t)i * max_len + t] = pad;
+    out_lens[i] = n;
+  }
+  return 0;
+}
+
+// Pack B ragged float32 sequences of row width `dim` into
+// out[B, max_len, dim]; zero-fill padding.
+int pt_pack_f32(const float** seqs, const int32_t* lens, int32_t b,
+                int32_t max_len, int32_t dim, float* out, int32_t* out_lens) {
+  if (!seqs || !lens || !out || !out_lens || b < 0 || max_len <= 0 || dim <= 0)
+    return -1;
+  const size_t row = (size_t)max_len * dim;
+  for (int32_t i = 0; i < b; ++i) {
+    int32_t n = lens[i] < max_len ? lens[i] : max_len;
+    if (n > 0) std::memcpy(out + (size_t)i * row, seqs[i],
+                           (size_t)n * dim * sizeof(float));
+    std::memset(out + (size_t)i * row + (size_t)n * dim, 0,
+                ((size_t)(max_len - n) * dim) * sizeof(float));
+    out_lens[i] = n;
+  }
+  return 0;
+}
+
+// Scatter sparse (row, col, value) triples into a dense [b, dim] f32 matrix.
+int pt_densify_sparse(const int32_t* rows, const int32_t* cols,
+                      const float* vals, int64_t nnz, int32_t b, int32_t dim,
+                      float* out) {
+  if (!rows || !cols || !out) return -1;
+  std::memset(out, 0, (size_t)b * dim * sizeof(float));
+  for (int64_t k = 0; k < nnz; ++k) {
+    int32_t r = rows[k], c = cols[k];
+    if (r < 0 || r >= b || c < 0 || c >= dim) return -2;
+    out[(size_t)r * dim + c] = vals ? vals[k] : 1.0f;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------- records
+//
+// Record file format (the ProtoDataProvider/DataFormat.proto role, redesigned
+// as a flat mmap-friendly stream):
+//   magic "PTRC" | u32 version
+//   per record: u32 payload_bytes | payload
+// Payload layout is caller-defined (typically a packed sample).
+
+struct PtWriter {
+  FILE* f;
+};
+
+void* pt_writer_open(const char* path) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return nullptr;
+  const char magic[4] = {'P', 'T', 'R', 'C'};
+  uint32_t version = 1;
+  if (std::fwrite(magic, 1, 4, f) != 4 ||
+      std::fwrite(&version, 4, 1, f) != 1) {
+    std::fclose(f);
+    return nullptr;
+  }
+  auto* w = new PtWriter{f};
+  return w;
+}
+
+int pt_writer_put(void* handle, const uint8_t* data, uint32_t size) {
+  auto* w = static_cast<PtWriter*>(handle);
+  if (!w || !w->f) return -1;
+  if (std::fwrite(&size, 4, 1, w->f) != 1) return -2;
+  if (size && std::fwrite(data, 1, size, w->f) != size) return -2;
+  return 0;
+}
+
+int pt_writer_close(void* handle) {
+  auto* w = static_cast<PtWriter*>(handle);
+  if (!w) return -1;
+  int rc = std::fclose(w->f);
+  delete w;
+  return rc;
+}
+
+struct PtReader {
+  FILE* f;
+  std::vector<uint8_t> buf;
+};
+
+void* pt_reader_open(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  char magic[4];
+  uint32_t version = 0;
+  if (std::fread(magic, 1, 4, f) != 4 || std::memcmp(magic, "PTRC", 4) != 0 ||
+      std::fread(&version, 4, 1, f) != 1 || version != 1) {
+    std::fclose(f);
+    return nullptr;
+  }
+  return new PtReader{f, {}};
+}
+
+// Returns payload size (>=0) and fills *out with an internal pointer valid
+// until the next call; -1 on EOF, -2 on corruption.
+int64_t pt_reader_next(void* handle, const uint8_t** out) {
+  auto* r = static_cast<PtReader*>(handle);
+  if (!r || !r->f) return -2;
+  uint32_t size = 0;
+  size_t got = std::fread(&size, 4, 1, r->f);
+  if (got != 1) return -1;  // EOF
+  r->buf.resize(size);
+  if (size && std::fread(r->buf.data(), 1, size, r->f) != size) return -2;
+  *out = r->buf.data();
+  return (int64_t)size;
+}
+
+int pt_reader_close(void* handle) {
+  auto* r = static_cast<PtReader*>(handle);
+  if (!r) return -1;
+  int rc = std::fclose(r->f);
+  delete r;
+  return rc;
+}
+
+// ------------------------------------------------------------ prefetch pool
+//
+// Bounded MPMC byte-blob queue: producer threads read record files, the
+// consumer (Python) pops assembled payloads.  This is the DoubleBuffer /
+// AsyncThreadPool role (utils/Thread.h:478, DataProvider.h:251) without
+// touching the GIL on the producer side.
+
+struct PtQueue {
+  std::deque<std::vector<uint8_t>> q;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  size_t capacity;
+  std::atomic<bool> closed{false};
+  std::vector<std::thread> workers;
+  std::vector<uint8_t> last;
+};
+
+void* pt_queue_create(int32_t capacity) {
+  auto* pq = new PtQueue();
+  pq->capacity = capacity > 0 ? (size_t)capacity : 64;
+  return pq;
+}
+
+// Start a producer thread streaming every record of `path` into the queue.
+int pt_queue_add_file(void* handle, const char* path) {
+  auto* pq = static_cast<PtQueue*>(handle);
+  if (!pq) return -1;
+  std::string p(path);
+  pq->workers.emplace_back([pq, p]() {
+    void* r = pt_reader_open(p.c_str());
+    if (!r) return;
+    const uint8_t* data = nullptr;
+    int64_t n;
+    while ((n = pt_reader_next(r, &data)) >= 0) {
+      std::vector<uint8_t> blob(data, data + n);
+      std::unique_lock<std::mutex> lk(pq->mu);
+      pq->cv_push.wait(lk, [pq] {
+        return pq->q.size() < pq->capacity || pq->closed.load();
+      });
+      if (pq->closed.load()) break;
+      pq->q.emplace_back(std::move(blob));
+      pq->cv_pop.notify_one();
+    }
+    pt_reader_close(r);
+  });
+  return 0;
+}
+
+// Pop one payload; blocks up to timeout_ms.  Returns size, or -1 on
+// timeout/closed-and-empty.  Pointer valid until next pop on this queue.
+int64_t pt_queue_pop(void* handle, const uint8_t** out, int32_t timeout_ms) {
+  auto* pq = static_cast<PtQueue*>(handle);
+  if (!pq) return -2;
+  std::unique_lock<std::mutex> lk(pq->mu);
+  bool ok = pq->cv_pop.wait_for(
+      lk, std::chrono::milliseconds(timeout_ms),
+      [pq] { return !pq->q.empty(); });
+  if (!ok) return -1;
+  pq->last = std::move(pq->q.front());
+  pq->q.pop_front();
+  pq->cv_push.notify_one();
+  *out = pq->last.data();
+  return (int64_t)pq->last.size();
+}
+
+int pt_queue_destroy(void* handle) {
+  auto* pq = static_cast<PtQueue*>(handle);
+  if (!pq) return -1;
+  pq->closed.store(true);
+  pq->cv_push.notify_all();
+  pq->cv_pop.notify_all();
+  for (auto& t : pq->workers)
+    if (t.joinable()) t.join();
+  delete pq;
+  return 0;
+}
+
+}  // extern "C"

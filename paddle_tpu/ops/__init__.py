@@ -4,6 +4,7 @@ autodiff replacing every hand-written backward."""
 
 from paddle_tpu.ops import activations
 from paddle_tpu.ops import attention
+from paddle_tpu.ops import beam
 from paddle_tpu.ops import conv
 from paddle_tpu.ops import crf
 from paddle_tpu.ops import ctc
@@ -18,7 +19,7 @@ from paddle_tpu.ops import sampling
 from paddle_tpu.ops import sequence
 
 __all__ = [
-    "activations", "attention", "conv", "crf", "ctc", "embedding",
+    "activations", "attention", "beam", "conv", "crf", "ctc", "embedding",
     "initializers", "linear", "losses", "math_ops", "norm", "rnn",
     "sampling", "sequence",
 ]

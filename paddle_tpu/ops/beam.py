@@ -1,0 +1,121 @@
+"""Functional beam-search decoder.
+
+Reference: RecurrentGradientMachine::generateSequence/beamSearch
+(RecurrentGradientMachine.cpp:823,1248) with beamExpand :1101 / beamShrink
+:1127 and user hooks (candidate adjust / per-node drop / eos,
+RecurrentGradientMachine.h:87-177).
+
+TPU design: static beam_size and max_len, one `lax.scan` over decode steps;
+the reference's dynamic Path lists become fixed [B, K] lanes with a finished
+mask; state gathering ("copy scattered memory-layer states per surviving
+path", machineIdVec) is a batched `take_along_axis` on the state pytree.
+Length-normalized scoring and the eos/drop callback semantics are kept.
+"""
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+class BeamResult(NamedTuple):
+    tokens: jnp.ndarray    # [B, K, T] int32 (eos_id-padded after finish)
+    scores: jnp.ndarray    # [B, K] total log-prob (normalized if asked)
+    lengths: jnp.ndarray   # [B, K] tokens before (excluding) eos
+
+
+def beam_search(step_fn: Callable, init_state: Any, batch_size: int,
+                beam_size: int, max_len: int, bos_id: int, eos_id: int,
+                length_penalty: float = 0.0,
+                candidate_adjust: Optional[Callable] = None):
+    """step_fn(state, prev_ids [B*K]) -> (log_probs [B*K, V], new_state).
+
+    State leaves are [B*K, ...] (lane-major).  candidate_adjust(log_probs)
+    optionally rewrites per-step candidate scores (the reference's
+    calc_id_interest / candidate adjust hook).
+
+    Returns BeamResult sorted best-first per batch row.
+    """
+    bk = batch_size * beam_size
+
+    def gather_state(state, src_lane):
+        """src_lane: [B, K] index into K lanes; reindex every state leaf."""
+        flat_idx = (jnp.arange(batch_size)[:, None] * beam_size
+                    + src_lane).reshape(-1)
+        return jax.tree_util.tree_map(
+            lambda x: jnp.take(x, flat_idx, axis=0), state)
+
+    init_tokens = jnp.full((batch_size, beam_size, max_len), eos_id, jnp.int32)
+    # lane 0 active, others dead (so the first expansion is over V not K*V)
+    init_scores = jnp.tile(
+        jnp.asarray([0.0] + [_NEG] * (beam_size - 1))[None, :],
+        (batch_size, 1))
+    init_finished = jnp.zeros((batch_size, beam_size), bool)
+    init_prev = jnp.full((bk,), bos_id, jnp.int32)
+    init_len = jnp.zeros((batch_size, beam_size), jnp.int32)
+
+    def body(carry, t):
+        state, prev, tokens, scores, finished, lengths = carry
+        log_probs, new_state = step_fn(state, prev)
+        if candidate_adjust is not None:
+            log_probs = candidate_adjust(log_probs)
+        v = log_probs.shape[-1]
+        lp = log_probs.reshape(batch_size, beam_size, v)
+
+        # finished lanes: only continuing with eos at zero cost keeps score
+        eos_only = jnp.full((v,), _NEG).at[eos_id].set(0.0)
+        lp = jnp.where(finished[..., None], eos_only[None, None, :], lp)
+
+        cand = scores[..., None] + lp                       # [B, K, V]
+        flat = cand.reshape(batch_size, beam_size * v)
+        top_scores, top_idx = jax.lax.top_k(flat, beam_size)  # [B, K]
+        src_lane = (top_idx // v).astype(jnp.int32)
+        new_tok = (top_idx % v).astype(jnp.int32)
+
+        # reorder histories and state by surviving lanes
+        tokens = jnp.take_along_axis(tokens, src_lane[..., None], axis=1)
+        tokens = tokens.at[:, :, t].set(new_tok)
+        was_finished = jnp.take_along_axis(finished, src_lane, axis=1)
+        lengths = jnp.take_along_axis(lengths, src_lane, axis=1)
+        now_finished = was_finished | (new_tok == eos_id)
+        lengths = jnp.where(was_finished, lengths,
+                            jnp.where(new_tok == eos_id, lengths, lengths + 1))
+        state = gather_state(new_state, src_lane)
+        prev = new_tok.reshape(-1)
+        return (state, prev, tokens, top_scores, now_finished, lengths), None
+
+    carry = (init_state, init_prev, init_tokens, init_scores, init_finished,
+             init_len)
+    (state, prev, tokens, scores, finished, lengths), _ = jax.lax.scan(
+        body, carry, jnp.arange(max_len))
+
+    if length_penalty:
+        norm = ((5.0 + lengths.astype(scores.dtype)) / 6.0) ** length_penalty
+        scores = scores / jnp.maximum(norm, 1e-6)
+    order = jnp.argsort(-scores, axis=1)
+    tokens = jnp.take_along_axis(tokens, order[..., None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    lengths = jnp.take_along_axis(lengths, order, axis=1)
+    return BeamResult(tokens=tokens, scores=scores, lengths=lengths)
+
+
+def greedy_search(step_fn, init_state, batch_size, max_len, bos_id, eos_id):
+    """Reference oneWaySearch (:900): argmax decode."""
+    def body(carry, t):
+        state, prev, tokens, finished, lengths = carry
+        log_probs, state = step_fn(state, prev)
+        nxt = jnp.argmax(log_probs, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(finished, eos_id, nxt)
+        tokens = tokens.at[:, t].set(nxt)
+        lengths = jnp.where(finished | (nxt == eos_id), lengths, lengths + 1)
+        finished = finished | (nxt == eos_id)
+        return (state, nxt, tokens, finished, lengths), None
+
+    tokens0 = jnp.full((batch_size, max_len), eos_id, jnp.int32)
+    carry = (init_state, jnp.full((batch_size,), bos_id, jnp.int32), tokens0,
+             jnp.zeros((batch_size,), bool), jnp.zeros((batch_size,), jnp.int32))
+    (state, _, tokens, finished, lengths), _ = jax.lax.scan(
+        body, carry, jnp.arange(max_len))
+    return tokens, lengths

@@ -1,1 +1,21 @@
+"""SPMD parallelism: mesh, sharding rules, collectives, sequence parallelism.
 
+Replaces reference §2.6 (pserver) + MultiGradientMachine + ParallelNeuralNetwork
+with jax.sharding over a named Mesh (SURVEY.md §2.6 'TPU-native equivalent').
+"""
+
+from paddle_tpu.parallel.mesh import (
+    Mesh, MeshConfig, make_mesh, single_device_mesh, AXIS_DATA, AXIS_MODEL,
+    AXIS_SEQ, AXIS_EXPERT, ALL_AXES,
+)
+from paddle_tpu.parallel.sharding import (
+    ShardingRules, megatron_rules, param_shardings, shard_params,
+    batch_shardings, replicated_shardings, valid_spec,
+)
+
+__all__ = [
+    "Mesh", "MeshConfig", "make_mesh", "single_device_mesh",
+    "AXIS_DATA", "AXIS_MODEL", "AXIS_SEQ", "AXIS_EXPERT", "ALL_AXES",
+    "ShardingRules", "megatron_rules", "param_shardings", "shard_params",
+    "batch_shardings", "replicated_shardings", "valid_spec",
+]

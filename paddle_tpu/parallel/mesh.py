@@ -1,0 +1,75 @@
+"""Device mesh abstraction.
+
+Replaces the reference's process/thread topology knobs — trainer_count
+(MultiGradientMachine.h:37-115), pserver host lists (--pservers, --port,
+--ports_num), --parallel_nn device= placement — with one declarative object:
+a jax.sharding.Mesh over named axes
+
+  data    — batch (data parallelism; the MultiGradientMachine/pserver path)
+  model   — tensor/layer sharding (the parallel_nn path)
+  seq     — sequence/context parallelism (new capability; SURVEY.md §5)
+  expert  — MoE expert parallelism (new capability)
+
+ICI/DCN placement: axes are ordered so the innermost (fastest-varying,
+adjacent devices) axis carries the heaviest collectives — put 'model'
+innermost so tensor-parallel allreduces ride ICI; 'data' outermost so its
+allreduce can cross DCN between slices (scaling-book recipe).
+"""
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+ALL_AXES = (AXIS_DATA, AXIS_SEQ, AXIS_EXPERT, AXIS_MODEL)
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    data: int = 0        # 0 = fill with remaining devices
+    model: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def resolve(self, n_devices):
+        fixed = self.model * self.seq * self.expert
+        data = self.data or max(1, n_devices // fixed)
+        if data * fixed != n_devices:
+            raise ValueError(
+                f"mesh {data}x{self.seq}x{self.expert}x{self.model} != "
+                f"{n_devices} devices")
+        return (data, self.seq, self.expert, self.model)
+
+
+def make_mesh(config: Optional[MeshConfig] = None, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    config = config or MeshConfig()
+    shape = config.resolve(len(devices))
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, ALL_AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1), ALL_AXES)
+
+
+def batch_spec(seq_sharded=False) -> P:
+    """Inputs: batch dim over 'data'; optionally time dim over 'seq'."""
+    if seq_sharded:
+        return P(AXIS_DATA, AXIS_SEQ)
+    return P(AXIS_DATA)
+
+
+def replicated() -> P:
+    return P()
+
+
+def sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)

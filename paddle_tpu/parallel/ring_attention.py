@@ -1,0 +1,133 @@
+"""Ring attention: sequence/context parallelism over the 'seq' mesh axis.
+
+New capability relative to the reference (SURVEY.md §5 "Long-context ...
+the reference has no equivalent, so this is green-field").  Design follows
+the ring-attention pattern: shard the sequence across devices, keep Q local,
+rotate K/V blocks around the ring with `lax.ppermute` while maintaining a
+numerically-stable running softmax (flash-style m/l accumulators), so peak
+memory is O(T/n) per device and comm overlaps compute around the ICI ring.
+
+Also provides all_to_all sequence<->head resharding (DeepSpeed-Ulysses
+style) as an alternative strategy for models whose head count divides the
+mesh axis.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG = -1e30
+
+
+def _block_attn(q, k, v, m_prev, l_prev, acc, mask=None, scale=1.0):
+    """One K/V block of flash-style attention.
+
+    q: [B, H, Tq, D], k/v: [B, H, Tk, D]; m/l: [B, H, Tq]; acc: [B,H,Tq,D].
+    Returns updated (m, l, acc).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked blocks (m_cur == _NEG): exp underflows to 0, fine
+    p = jnp.exp(s - m_new[..., None])
+    l_corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * l_corr + jnp.sum(p, axis=-1)
+    acc_new = acc * l_corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
+                   q_mask=None, kv_mask=None, scale=None):
+    """Sequence-parallel attention under shard_map.
+
+    q/k/v: [B, H, T, D] GLOBAL shapes, sharded over T on `axis_name`
+    (caller annotates; this function builds its own shard_map).
+    q_mask/kv_mask: [B, T] validity (global, sharded the same way).
+    Returns [B, H, T, D] sharded like q.
+    """
+    n = mesh.shape[axis_name]
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+
+    def local_fn(q_l, k_l, v_l, qm_l, kvm_l):
+        # local shapes: [B, H, T/n, D]
+        b, h, tq, d = q_l.shape
+        my = jax.lax.axis_index(axis_name)
+
+        def body(i, carry):
+            m, l, acc, k_blk, v_blk, kvm_blk = carry
+            # block owner index: blocks travel forward, so at step i we hold
+            # the block originally on device (my - i) mod n
+            src = (my - i) % n
+            mask = None
+            if kvm_blk is not None:
+                mask = kvm_blk[:, None, None, :] > 0
+            if causal:
+                # global positions: q pos = my*tq + iq ; k pos = src*tq + ik
+                qpos = my * tq + jnp.arange(tq)
+                kpos = src * tq + jnp.arange(tq)
+                cm = qpos[:, None] >= kpos[None, :]
+                cm = cm[None, None]
+                mask = cm if mask is None else (mask & cm)
+            m, l, acc = _block_attn(q_l, k_blk, v_blk, m, l, acc, mask, scale)
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            if kvm_blk is not None:
+                kvm_blk = jax.lax.ppermute(kvm_blk, axis_name, perm)
+            return m, l, acc, k_blk, v_blk, kvm_blk
+
+        m0 = jnp.full((b, h, tq), _NEG, jnp.float32)
+        l0 = jnp.zeros((b, h, tq), jnp.float32)
+        acc0 = jnp.zeros((b, h, tq, d), jnp.float32)
+        m, l, acc, _, _, _ = jax.lax.fori_loop(
+            0, n, body, (m0, l0, acc0, k_l, v_l, kvm_l))
+        out = acc / jnp.maximum(l[..., None], 1e-20)
+        if qm_l is not None:
+            out = out * (qm_l[:, None, :, None] > 0)
+        return out.astype(q_l.dtype)
+
+    spec = P(None, None, axis_name, None)
+    mspec = P(None, axis_name)
+    qm = q_mask if q_mask is not None else jnp.ones(
+        (q.shape[0], q.shape[2]), jnp.float32)
+    kvm = kv_mask if kv_mask is not None else jnp.ones(
+        (k.shape[0], k.shape[2]), jnp.float32)
+    fn = jax.shard_map(local_fn, mesh=mesh,
+                       in_specs=(spec, spec, spec, mspec, mspec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v, qm, kvm)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
+                      mask=None):
+    """All-to-all sequence parallelism (Ulysses): reshard [B,H,T/n,D] ->
+    [B,H/n,T,D] with all_to_all, run full attention over local heads, then
+    reshard back.  Requires H % n == 0."""
+    n = mesh.shape[axis_name]
+    assert q.shape[1] % n == 0, "heads must divide the seq axis"
+
+    def local_fn(q_l, k_l, v_l):
+        # local [B, H, T/n, D] -> [B, H/n, T, D]
+        def reshard_fwd(x):
+            return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                      concat_axis=2, tiled=True)
+
+        def reshard_bwd(x):
+            return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                      concat_axis=1, tiled=True)
+
+        from paddle_tpu.ops.attention import dot_product_attention
+        qh, kh, vh = reshard_fwd(q_l), reshard_fwd(k_l), reshard_fwd(v_l)
+        out = dot_product_attention(qh, kh, vh, causal=causal)
+        return reshard_bwd(out)
+
+    spec = P(None, None, axis_name, None)
+    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)

@@ -1,0 +1,117 @@
+"""Parameter/activation sharding rules.
+
+This is the TPU-native replacement for the reference's entire distributed
+parameter plane: ParameterServer2 block sharding (pserver/ParameterServer2.h:
+115-120 blockOffsetMap_), ParameterClient2 block routing (block i -> server
+i mod N), and MultiGradientMachine's replicate-params/ring-reduce-grads
+(MultiGradientMachine.h:57-74).  Here the rules are declarative PartitionSpecs
+handed to jit; XLA inserts the psum/all-gather/reduce-scatter collectives
+that the reference hand-built with sockets and threads.
+
+Default policy (overridable per-param by regex rules):
+  - embeddings [vocab, dim]       -> shard vocab over 'model' (the reference's
+                                     sparse pserver ports / SparseRowMatrix)
+  - large fc kernels [in, out]    -> shard out over 'model' (megatron column)
+    paired projections back       -> shard in  over 'model' (megatron row)
+  - everything else               -> replicated (psum'd grads = the pserver
+                                     dense path)
+Optimizer state inherits its parameter's spec via the same path matching.
+"""
+
+import re
+from typing import Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL
+
+
+def _path_str(path):
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+class ShardingRules:
+    """Ordered (regex -> PartitionSpec) rules matched against the pytree path
+    'layer_name/param_name'."""
+
+    def __init__(self, rules=None, default=P()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in (rules or [])]
+        self.default = default
+
+    def spec_for(self, path: str) -> P:
+        for pat, spec in self.rules:
+            if pat.search(path):
+                return spec
+        return self.default
+
+
+def megatron_rules(extra=()):
+    """Column-parallel in-projections, row-parallel out-projections, sharded
+    embeddings (tensor parallelism over the 'model' axis)."""
+    rules = list(extra) + [
+        (r"emb|embedding|table", P(AXIS_MODEL, None)),
+        (r"(w_out|proj_out|o_proj|fc2|down)(/|$)", P(AXIS_MODEL, None)),
+        (r"(^|/)(w|w\d+|kernel)$", P(None, AXIS_MODEL)),
+    ]
+    return ShardingRules(rules)
+
+
+def valid_spec(spec: P, shape, mesh: Mesh) -> P:
+    """Drop axis assignments that don't evenly divide the dim (that dim
+    falls back to replication) — keeps tiny/odd params replicated instead of
+    erroring, like the reference's block-size threshold in
+    ParameterClient2::calcParameterBlockSize."""
+    ndim = len(shape)
+    entries = list(tuple(spec)) + [None] * (ndim - len(tuple(spec)))
+    out = []
+    for i, axis in enumerate(entries[:ndim]):
+        if axis is None:
+            out.append(None)
+            continue
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        size = int(np.prod([mesh.shape[a] for a in axes]))
+        out.append(axis if (shape[i] % size == 0 and shape[i] >= size) else None)
+    return P(*out)
+
+
+def param_shardings(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
+    """NamedSharding pytree for jit in_shardings/out_shardings/device_put."""
+    rules = rules or ShardingRules()
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(
+            mesh, valid_spec(rules.spec_for(_path_str(path)),
+                             np.shape(leaf), mesh)),
+        params)
+
+
+def shard_params(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
+    """Place a params pytree onto the mesh (the pserver 'scatter parameters
+    to shards' moment, minus the sockets)."""
+    shardings = param_shardings(params, mesh, rules)
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+
+def batch_shardings(feed, mesh: Mesh):
+    """Shard every array's leading (batch) dim over 'data'; scalars
+    replicated.  SequenceBatch lengths shard over 'data' too."""
+    def spec_for_leaf(x):
+        nd = np.ndim(x)
+        if nd == 0:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, P(*([AXIS_DATA] + [None] * (nd - 1))))
+    return jax.tree_util.tree_map(spec_for_leaf, feed)
+
+
+def replicated_shardings(tree, mesh: Mesh):
+    return jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P()), tree)

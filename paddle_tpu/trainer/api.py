@@ -2,7 +2,8 @@
 
 
 def init(**kwargs):
-    """Reference: paddle.v2 init(use_gpu=, trainer_count=) -> here mesh/flags."""
+    """Reference: paddle.v2.init(use_gpu=, trainer_count=).  Device-count
+    knobs become mesh flags here (parallel.MeshConfig)."""
     from paddle_tpu.utils.flags import FLAGS
     for k, v in kwargs.items():
         if hasattr(FLAGS, k):
@@ -10,5 +11,7 @@ def init(**kwargs):
     return FLAGS
 
 
-def infer(*args, **kwargs):
-    raise NotImplementedError("paddle_tpu.infer arrives with the inference module")
+def infer(output_layer=None, parameters=None, input=None, feeding=None):
+    """Reference: paddle.v2.infer(output_layer=, parameters=, input=)."""
+    from paddle_tpu.trainer.trainer import infer as _infer
+    return _infer(output_layer, parameters, input, feeding=feeding)

@@ -1,0 +1,129 @@
+"""Checkpoint save/load.
+
+Reference: per-pass dirs output/pass-%05d with one binary file per Parameter
+(header {version, sizeof(real), size} + raw floats, Parameter.cpp:281-307),
+ParamUtil save/load, --saving_period, --save_only_one; v2 tar-of-numpy
+(v2/parameters.py to_tar); optimizer state NOT saved in the reference —
+here it IS (orbax-style full train-state snapshot), fixing resume semantics.
+
+Format: msgpack-free portable .npz per pytree + a JSON manifest; directory
+layout keeps the reference's pass-%05d convention so --start_pass resume
+works the same way.
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{i}/"))
+        out[f"{prefix}__len__"] = np.asarray(
+            [len(tree), 1 if isinstance(tree, tuple) else 0])
+    elif tree is None:
+        out[f"{prefix}__none__"] = np.zeros(0)
+    else:
+        out[prefix.rstrip("/")] = np.asarray(tree)
+    return out
+
+
+def _unflatten(flat):
+    # rebuild nested dict first
+    root = {}
+    for key, val in flat.items():
+        parts = key.split("/")
+        d = root
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = val
+
+    def rebuild(node):
+        if isinstance(node, dict):
+            if "__none__" in node and len(node) == 1:
+                return None
+            if "__len__" in node:
+                n, is_tuple = (int(x) for x in node["__len__"])
+                items = [rebuild(node[str(i)]) for i in range(n)]
+                return tuple(items) if is_tuple else items
+            return {k: rebuild(v) for k, v in node.items()}
+        return node
+    return rebuild(root)
+
+
+def save_checkpoint(save_dir, pass_id, params, opt_state=None, model_state=None,
+                    extra=None, save_only_one=False):
+    """Write output/pass-%05d/{params,opt_state,model_state}.npz + meta."""
+    path = os.path.join(save_dir, f"pass-{pass_id:05d}")
+    os.makedirs(path, exist_ok=True)
+    params = jax.device_get(params)
+    np.savez(os.path.join(path, "params.npz"), **_flatten(params))
+    if opt_state is not None:
+        np.savez(os.path.join(path, "opt_state.npz"),
+                 **_flatten(jax.device_get(opt_state)))
+    if model_state is not None:
+        np.savez(os.path.join(path, "model_state.npz"),
+                 **_flatten(jax.device_get(model_state)))
+    meta = {"pass_id": pass_id, "format_version": 1}
+    meta.update(extra or {})
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    if save_only_one:
+        for name in os.listdir(save_dir):
+            if name.startswith("pass-") and name != f"pass-{pass_id:05d}":
+                shutil.rmtree(os.path.join(save_dir, name), ignore_errors=True)
+    return path
+
+
+def _load_npz(path):
+    if not os.path.exists(path):
+        return None
+    with np.load(path, allow_pickle=False) as z:
+        flat = {k: z[k] for k in z.files}
+    return _unflatten(flat)
+
+
+def load_checkpoint(save_dir, pass_id=None):
+    """Load a pass dir (latest if pass_id is None).  Returns
+    (params, opt_state, model_state, meta)."""
+    if pass_id is None:
+        passes = sorted(n for n in os.listdir(save_dir) if n.startswith("pass-"))
+        if not passes:
+            raise FileNotFoundError(f"no pass-* checkpoints in {save_dir}")
+        path = os.path.join(save_dir, passes[-1])
+    else:
+        path = os.path.join(save_dir, f"pass-{pass_id:05d}")
+    params = _load_npz(os.path.join(path, "params.npz"))
+    opt_state = _load_npz(os.path.join(path, "opt_state.npz"))
+    model_state = _load_npz(os.path.join(path, "model_state.npz"))
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    to_dev = lambda t: jax.tree_util.tree_map(jnp.asarray, t) if t is not None else None
+    return to_dev(params), to_dev(opt_state), to_dev(model_state), meta
+
+
+def merge_model(save_dir, out_path, pass_id=None):
+    """paddle_merge_model equivalent: single deployable file
+    (params + model_state + meta) for inference."""
+    params, _, model_state, meta = load_checkpoint(save_dir, pass_id)
+    blob = _flatten({"params": params, "model_state": model_state or {}})
+    np.savez_compressed(out_path, __meta__=np.frombuffer(
+        json.dumps(meta).encode(), np.uint8), **blob)
+    return out_path
+
+
+def load_merged(path):
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        flat = {k: z[k] for k in z.files if k != "__meta__"}
+    tree = _unflatten(flat)
+    return tree["params"], tree.get("model_state"), meta

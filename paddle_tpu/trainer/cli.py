@@ -1,0 +1,130 @@
+"""CLI driver: the `paddle train|test|merge_model|version` surface
+(reference trainer/TrainerMain.cpp:32-65 + scripts/submit_local.sh.in).
+
+Usage:
+  python -m paddle_tpu train --config my_config.py [--num_passes N]
+       [--save_dir DIR] [--start_pass K] [--data_parallel N --model_parallel M]
+  python -m paddle_tpu test  --config my_config.py --model_dir DIR
+  python -m paddle_tpu merge_model --model_dir DIR --out model.npz
+  python -m paddle_tpu version
+
+The config file is a Python script defining `get_config()` returning a dict:
+  {"cost": LayerOutput, "optimizer": optim.Optimizer,
+   "train_reader": reader, "test_reader": reader (optional),
+   "feeding": {name: InputType}, "batch_size": int (reader already batched)}
+(reference --config=trainer_config.conf scripts, with config_args available
+as CONFIG_ARGS in the script's namespace).
+"""
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def _load_config(path, config_args):
+    ns = runpy.run_path(path, init_globals={"CONFIG_ARGS": config_args})
+    if "get_config" not in ns:
+        raise SystemExit(f"{path} must define get_config()")
+    return ns["get_config"]()
+
+
+def _parse_config_args(s):
+    out = {}
+    if s:
+        for kv in s.split(","):
+            k, _, v = kv.partition("=")
+            out[k.strip()] = v.strip()
+    return out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="paddle_tpu")
+    sub = parser.add_subparsers(dest="job", required=True)
+
+    def add_common(p):
+        p.add_argument("--config", required=True)
+        p.add_argument("--config_args", default="",
+                       help="k=v,k=v passed to the config script")
+        p.add_argument("--data_parallel", type=int, default=0)
+        p.add_argument("--model_parallel", type=int, default=1)
+        p.add_argument("--seq_parallel", type=int, default=1)
+
+    t = sub.add_parser("train")
+    add_common(t)
+    t.add_argument("--num_passes", type=int, default=1)
+    t.add_argument("--save_dir", default=None)
+    t.add_argument("--saving_period", type=int, default=1)
+    t.add_argument("--save_only_one", action="store_true")
+    t.add_argument("--start_pass", type=int, default=0)
+    t.add_argument("--log_period", type=int, default=100)
+    t.add_argument("--test_period", type=int, default=0)
+
+    te = sub.add_parser("test")
+    add_common(te)
+    te.add_argument("--model_dir", required=True)
+    te.add_argument("--test_pass", type=int, default=None)
+
+    m = sub.add_parser("merge_model")
+    m.add_argument("--model_dir", required=True)
+    m.add_argument("--out", required=True)
+    m.add_argument("--pass_id", type=int, default=None)
+
+    sub.add_parser("version")
+
+    args = parser.parse_args(argv)
+
+    if args.job == "version":
+        from paddle_tpu.version import __version__
+        import jax
+        print(f"paddle_tpu {__version__} (jax {jax.__version__}, "
+              f"devices: {jax.devices()})")
+        return 0
+
+    if args.job == "merge_model":
+        from paddle_tpu.trainer.checkpoint import merge_model
+        out = merge_model(args.model_dir, args.out, args.pass_id)
+        print("wrote", out)
+        return 0
+
+    cfg = _load_config(args.config, _parse_config_args(args.config_args))
+
+    from paddle_tpu.trainer import SGD
+    mesh = None
+    if args.model_parallel > 1 or args.seq_parallel > 1 or args.data_parallel > 1:
+        from paddle_tpu.parallel import MeshConfig, make_mesh, megatron_rules
+        mesh = make_mesh(MeshConfig(data=args.data_parallel,
+                                    model=args.model_parallel,
+                                    seq=args.seq_parallel))
+    trainer = SGD(cost=cfg["cost"], update_equation=cfg["optimizer"],
+                  mesh=mesh,
+                  sharding_rules=cfg.get("sharding_rules"))
+
+    if args.job == "train":
+        save_dir = args.save_dir or cfg.get("save_dir")
+        if args.start_pass:
+            if not save_dir:
+                raise SystemExit("--start_pass needs --save_dir (or a "
+                                 "save_dir in the config)")
+            trainer.load(save_dir, args.start_pass - 1)
+        trainer.train(cfg["train_reader"],
+                      num_passes=args.num_passes,
+                      feeding=cfg.get("feeding"),
+                      save_dir=save_dir,
+                      saving_period=args.saving_period,
+                      save_only_one=args.save_only_one,
+                      test_reader=cfg.get("test_reader"),
+                      test_period=args.test_period,
+                      log_period=args.log_period)
+        return 0
+
+    if args.job == "test":
+        trainer.load(args.model_dir, args.test_pass)
+        cost = trainer.test(cfg.get("test_reader") or cfg["train_reader"],
+                            feeding=cfg.get("feeding"))
+        print(f"test cost: {cost:.5f}")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

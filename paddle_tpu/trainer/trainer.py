@@ -1,0 +1,253 @@
+"""The training driver: v2-style `SGD.train(reader, event_handler)`.
+
+Reference: python/paddle/v2/trainer.py:30-175 (SGD class, event loop),
+trainer/Trainer.cpp:261-492 (pass/batch loops, periodic save/test),
+trainer/TrainerInternal.cpp:66-170 (the hot loop: forward/backward/update +
+eval + log).
+
+TPU redesign: the entire hot loop — forward, backward, optimizer update,
+evaluator statistics — is ONE jitted (and mesh-sharded) function.  The
+reference's updater pipeline (grad-ready callbacks overlapping backward with
+pserver sends, RemoteParameterUpdater.h:37-54) is subsumed by XLA scheduling
+collectives inside the step; async host-side data feeding comes from
+reader.buffered (the DoubleBuffer equivalent).
+"""
+
+import os
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.data.feeder import DataFeeder
+from paddle_tpu.data import reader as reader_mod
+from paddle_tpu.layers.graph import Topology, LayerOutput
+from paddle_tpu.optim.optimizers import Optimizer
+from paddle_tpu.trainer import events
+from paddle_tpu.trainer.checkpoint import save_checkpoint, load_checkpoint
+from paddle_tpu.utils.logging import logger
+from paddle_tpu.utils.stats import timer, global_stats
+from paddle_tpu.parallel import (
+    make_mesh, param_shardings, batch_shardings, replicated_shardings,
+    shard_params)
+
+
+class SGD:
+    """paddle.v2.trainer.SGD equivalent.
+
+    cost: LayerOutput (or list) whose value is a per-sample loss [B].
+    update_equation: an optim.Optimizer.
+    extra_layers: additional LayerOutputs to evaluate each batch (for
+    metrics; reference SGD(extra_layers=) used for evaluators).
+    mesh: jax Mesh (None = single device); sharding_rules: parallel.ShardingRules.
+    """
+
+    def __init__(self, cost, parameters=None, update_equation=None,
+                 extra_layers=None, is_local=True, mesh=None,
+                 sharding_rules=None, seed=1, donate=True):
+        self.costs = cost if isinstance(cost, (list, tuple)) else [cost]
+        self.extra_layers = list(extra_layers or [])
+        self.topology = Topology(list(self.costs) + self.extra_layers)
+        if update_equation is None:
+            raise ValueError(
+                "SGD needs update_equation=, e.g. "
+                "optim.Momentum(learning_rate=0.01)")
+        self.optimizer: Optimizer = update_equation
+        self.mesh = mesh
+        self.sharding_rules = sharding_rules
+        rng = jax.random.PRNGKey(seed)
+        self.rng, init_rng = jax.random.split(rng)
+        self.parameters = parameters if parameters is not None \
+            else self.topology.init(init_rng)
+        self.opt_state = self.optimizer.init(self.parameters) \
+            if self.optimizer else None
+        self.model_state = self.topology.init_state()
+        if mesh is not None:
+            rules = sharding_rules
+            self.parameters = shard_params(self.parameters, mesh, rules)
+        self._step_fn = None
+        self._eval_fn = None
+        self._donate = donate
+
+    # ------------------------------------------------------------ build
+
+    def _loss_and_extras(self, params, state, feed, rng):
+        out, new_state = self.topology.apply(
+            params, feed, mode="train", rng=rng, state=state,
+            return_state=True)
+        outs = out if isinstance(out, tuple) else (out,)
+        n_cost = len(self.costs)
+        cost_vals = outs[:n_cost]
+        extra_vals = outs[n_cost:]
+        total = sum(jnp.mean(c) for c in cost_vals)
+        return total, (new_state, extra_vals)
+
+    def _build_step(self, feed_example):
+        def step(params, opt_state, state, feed, rng):
+            (loss, (new_state, extras)), grads = jax.value_and_grad(
+                self._loss_and_extras, has_aux=True)(params, state, feed, rng)
+            new_params, new_opt = self.optimizer.update(grads, opt_state, params)
+            merged_state = {**state, **new_state}
+            return new_params, new_opt, merged_state, loss, extras
+
+        if self.mesh is None:
+            self._step_fn = jax.jit(
+                step, donate_argnums=(0, 1) if self._donate else ())
+            return
+
+        ps = param_shardings(self.parameters, self.mesh, self.sharding_rules)
+        # optimizer slots are params-shaped: inherit the param shardings
+        # (the reference keeps momentum etc. sharded in the pserver the same
+        # way, ParameterServer2 block-indexed buffers)
+        os_ = {"step": replicated_shardings(self.opt_state["step"], self.mesh),
+               "slots": {k: ps for k in self.opt_state["slots"]}} \
+            if isinstance(self.opt_state, dict) and "slots" in self.opt_state \
+            else replicated_shardings(self.opt_state, self.mesh)
+        ss = replicated_shardings(self.model_state, self.mesh)
+        fs = batch_shardings(feed_example, self.mesh)
+        rs = replicated_shardings(jnp.zeros(2, jnp.uint32), self.mesh)
+        self._step_fn = jax.jit(
+            step,
+            in_shardings=(ps, os_, ss, fs, rs),
+            out_shardings=(ps, os_, ss,
+                           replicated_shardings(0.0, self.mesh),
+                           None),
+            donate_argnums=(0, 1) if self._donate else ())
+
+    # ------------------------------------------------------------ train
+
+    def train(self, reader, num_passes=1, event_handler=None, feeding=None,
+              save_dir=None, saving_period=1, save_only_one=False,
+              test_reader=None, test_period=0, log_period=100,
+              buffered_batches=4):
+        """reader: callable -> iterator of batches (lists of samples).
+        feeding: {data_layer_name: InputType} or a DataFeeder."""
+        event_handler = event_handler or (lambda e: None)
+        feeder = feeding if isinstance(feeding, DataFeeder) else (
+            DataFeeder(feeding) if feeding else None)
+
+        for pass_id in range(num_passes):
+            event_handler(events.BeginPass(pass_id))
+            batch_reader = reader
+            if buffered_batches:
+                batch_reader = reader_mod.buffered(reader, buffered_batches)
+            # running device-side sums: no host sync in the hot loop —
+            # cost only crosses to the host every log_period (and for the
+            # event stream, whose .cost is the device scalar; float() it
+            # lazily in your handler if you need the number immediately)
+            cost_sum = jnp.zeros(())
+            n_batches = 0
+            window = []
+            t0 = time.time()
+            for batch_id, batch in enumerate(batch_reader()):
+                feed = feeder(batch) if feeder else batch
+                feed = {k: v if isinstance(v, SequenceBatch) else jnp.asarray(v)
+                        for k, v in feed.items()}
+                event_handler(events.BeginIteration(pass_id, batch_id))
+                self.rng, step_rng = jax.random.split(self.rng)
+                if self._step_fn is None:
+                    self._build_step(feed)
+                with timer("train_step"):
+                    (self.parameters, self.opt_state, self.model_state,
+                     cost, extras) = self._step_fn(
+                        self.parameters, self.opt_state, self.model_state,
+                        feed, step_rng)
+                cost_sum = cost_sum + cost
+                n_batches += 1
+                window.append(cost)
+                if log_period and (batch_id + 1) % log_period == 0:
+                    c = float(jnp.mean(jnp.stack(window)))
+                    window = []
+                    dt = (time.time() - t0) / log_period
+                    logger.info("Pass %d Batch %d Cost %.5f (%.1f ms/batch)",
+                                pass_id, batch_id + 1, c, dt * 1e3)
+                    t0 = time.time()
+                event_handler(events.EndIteration(
+                    pass_id, batch_id, cost=cost,
+                    evaluator_results={f"extra_{i}": e
+                                       for i, e in enumerate(extras)}))
+            pass_cost = float(cost_sum) / n_batches if n_batches else float("nan")
+            logger.info("Pass %d done, mean cost %.5f", pass_id, pass_cost)
+            if test_reader is not None and (
+                    not test_period or (pass_id + 1) % test_period == 0):
+                tc = self.test(test_reader, feeding=feeder)
+                event_handler(events.EndTesting(pass_id, tc))
+            if save_dir and (pass_id + 1) % saving_period == 0:
+                path = save_checkpoint(save_dir, pass_id, self.parameters,
+                                       self.opt_state, self.model_state,
+                                       save_only_one=save_only_one)
+                logger.info("saved checkpoint %s", path)
+            event_handler(events.EndPass(pass_id))
+
+    # ------------------------------------------------------------ test
+
+    def _build_eval(self):
+        def ev(params, state, feed):
+            out = self.topology.apply(params, feed, mode="test", state=state)
+            outs = out if isinstance(out, tuple) else (out,)
+            cost_vals = outs[:len(self.costs)]
+            return sum(jnp.mean(c) for c in cost_vals), outs[len(self.costs):]
+        self._eval_fn = jax.jit(ev)
+
+    def test(self, reader, feeding=None):
+        feeder = feeding if isinstance(feeding, DataFeeder) else (
+            DataFeeder(feeding) if feeding else None)
+        if self._eval_fn is None:
+            self._build_eval()
+        total, n = 0.0, 0
+        for batch in reader():
+            feed = feeder(batch) if feeder else batch
+            feed = {k: v if isinstance(v, SequenceBatch) else jnp.asarray(v)
+                    for k, v in feed.items()}
+            cost, _ = self._eval_fn(self.parameters, self.model_state, feed)
+            total += float(cost)
+            n += 1
+        mean = total / max(n, 1)
+        logger.info("Test cost %.5f over %d batches", mean, n)
+        return mean
+
+    # ------------------------------------------------------------ io
+
+    def save(self, save_dir, pass_id=0, save_only_one=False):
+        return save_checkpoint(save_dir, pass_id, self.parameters,
+                               self.opt_state, self.model_state,
+                               save_only_one=save_only_one)
+
+    def load(self, save_dir, pass_id=None):
+        params, opt_state, model_state, meta = load_checkpoint(save_dir, pass_id)
+        self.parameters = params
+        if opt_state is not None:
+            self.opt_state = opt_state
+        if model_state is not None:
+            self.model_state = model_state
+        return meta
+
+
+class Inferencer:
+    """paddle.v2.inference equivalent: run a topology in test mode."""
+
+    def __init__(self, output_layer, parameters, model_state=None):
+        outs = output_layer if isinstance(output_layer, (list, tuple)) \
+            else [output_layer]
+        self.topology = Topology(list(outs))
+        self.parameters = parameters
+        self.model_state = model_state or {}
+        self._fn = jax.jit(
+            lambda p, s, feed: self.topology.apply(p, feed, mode="test",
+                                                   state=s))
+
+    def infer(self, feed_or_batch, feeding=None):
+        if feeding is not None and not isinstance(feed_or_batch, dict):
+            feeder = feeding if isinstance(feeding, DataFeeder) else DataFeeder(feeding)
+            feed = feeder(feed_or_batch)
+        else:
+            feed = feed_or_batch
+        feed = {k: v if isinstance(v, SequenceBatch) else jnp.asarray(v)
+                for k, v in feed.items()}
+        return self._fn(self.parameters, self.model_state, feed)
+
+
+def infer(output_layer, parameters, input, feeding=None):
+    return Inferencer(output_layer, parameters).infer(input, feeding=feeding)

@@ -1,0 +1,85 @@
+"""Beam search: on a fixed-transition toy LM the beam must find the
+highest-probability sequence (enumerable exactly)."""
+
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import beam as beam_ops
+
+
+def make_step(trans):
+    """trans: [V, V] log-prob of next token given previous (static)."""
+    t = jnp.asarray(trans)
+
+    def step_fn(state, prev_ids):
+        return t[prev_ids], state
+    return step_fn
+
+
+def brute_best(trans, bos, eos, max_len):
+    v = trans.shape[0]
+    best, best_seq = -np.inf, None
+    for seq in itertools.product(range(v), repeat=max_len):
+        score, prev, done = 0.0, bos, False
+        ok = True
+        length = 0
+        for s in seq:
+            score += trans[prev, s]
+            prev = s
+            length += 1
+            if s == eos:
+                done = True
+                break
+        # compare only full-length or eos-terminated sequences as the beam does
+        if score > best:
+            best, best_seq = score, seq[:length]
+    return best, best_seq
+
+
+def test_beam_finds_optimal(np_rng):
+    v, max_len, eos = 5, 4, 1
+    logits = np_rng.randn(v, v).astype(np.float32)
+    trans = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    res = beam_ops.beam_search(make_step(trans), jnp.zeros((1 * 8, 1)),
+                               batch_size=1, beam_size=8, max_len=max_len,
+                               bos_id=0, eos_id=eos)
+    got = float(res.scores[0, 0])
+    best, best_seq = brute_best(trans, 0, eos, max_len)
+    np.testing.assert_allclose(got, best, rtol=1e-4)
+    got_tokens = list(np.asarray(res.tokens[0, 0]))[:len(best_seq)]
+    assert got_tokens == list(best_seq)
+
+
+def test_greedy_matches_manual_chain(np_rng):
+    v, max_len, eos = 6, 5, 1
+    logits = np_rng.randn(v, v).astype(np.float32)
+    trans = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    toks, lens = beam_ops.greedy_search(make_step(trans), jnp.zeros((2, 1)),
+                                        batch_size=2, max_len=max_len,
+                                        bos_id=0, eos_id=eos)
+    # manual argmax chain
+    prev, out = 0, []
+    for _ in range(max_len):
+        nxt = int(np.argmax(trans[prev]))
+        out.append(nxt)
+        prev = nxt
+        if nxt == eos:
+            break
+    got = list(np.asarray(toks[0]))[:len(out)]
+    assert got == out
+
+
+def test_beam_eos_freezes_score(np_rng):
+    """Once a lane emits eos, later steps must not change its score."""
+    v, eos = 4, 1
+    # token 1 (eos) hugely preferred from bos: everything finishes at t=0
+    trans = np.full((v, v), -10.0, np.float32)
+    trans[:, eos] = -0.1
+    res = beam_ops.beam_search(make_step(trans), jnp.zeros((3, 1)),
+                               batch_size=1, beam_size=3, max_len=6,
+                               bos_id=0, eos_id=eos)
+    np.testing.assert_allclose(float(res.scores[0, 0]), -0.1, rtol=1e-5)
+    assert int(res.lengths[0, 0]) == 0  # eos-terminated immediately

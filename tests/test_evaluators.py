@@ -1,0 +1,81 @@
+"""Evaluator correctness vs sklearn-free hand computations."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu import evaluators as E
+
+
+def test_classification_error():
+    ev = E.ClassificationError()
+    st = ev.init()
+    pred = jnp.asarray([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]])
+    label = jnp.asarray([0, 1, 1])
+    st = ev.update(st, pred=pred, label=label)
+    np.testing.assert_allclose(ev.result(st), 1.0 / 3.0, rtol=1e-6)
+
+
+def test_auc_perfect_and_random():
+    ev = E.Auc()
+    st = ev.init()
+    # perfectly separable
+    pred = jnp.asarray([0.9, 0.8, 0.2, 0.1])
+    label = jnp.asarray([1, 1, 0, 0])
+    st = ev.update(st, pred=pred, label=label)
+    assert ev.result(st) > 0.99
+    # inverted
+    st2 = ev.update(ev.init(), pred=1 - pred, label=label)
+    assert ev.result(st2) < 0.01
+
+
+def test_precision_recall_binary():
+    ev = E.PrecisionRecall(num_classes=2, positive_label=1)
+    st = ev.init()
+    pred = jnp.asarray([[0.2, 0.8], [0.9, 0.1], [0.3, 0.7], [0.6, 0.4]])
+    label = jnp.asarray([1, 1, 0, 0])
+    st = ev.update(st, pred=pred, label=label)
+    r = ev.result(st)
+    # predictions: [1, 0, 1, 0]; tp=1 fp=1 fn=1
+    np.testing.assert_allclose(r["precision"], 0.5, rtol=1e-6)
+    np.testing.assert_allclose(r["recall"], 0.5, rtol=1e-6)
+
+
+def test_chunk_f1_exact_match():
+    ev = E.ChunkEvaluator()
+    st = ev.init()
+    # tags: B-0 I-0 B-1 -> spans (0,2,type0),(2,3,type1)
+    tags = np.asarray([[0, 1, 2]])
+    st = ev.update(st, pred=tags, label=tags, lengths=np.asarray([3]))
+    r = ev.result(st)
+    np.testing.assert_allclose(r["f1"], 1.0, rtol=1e-6)
+
+
+def test_chunk_f1_partial():
+    ev = E.ChunkEvaluator()
+    st = ev.init()
+    pred = np.asarray([[0, 0, 2]])   # spans (0,1),(1,2),(2,3)
+    gold = np.asarray([[0, 1, 2]])   # spans (0,2),(2,3)
+    st = ev.update(st, pred=pred, label=gold, lengths=np.asarray([3]))
+    r = ev.result(st)
+    assert 0 < r["f1"] < 1
+
+
+def test_ctc_error_edit_distance():
+    ev = E.CTCError()
+    st = ev.init()
+    st = ev.update(st,
+                   decoded=np.asarray([[1, 2, 3]]),
+                   decoded_lengths=np.asarray([3]),
+                   label=np.asarray([[1, 3]]),
+                   label_lengths=np.asarray([2]))
+    # edit distance(123, 13) = 1; normalized by label len 2
+    np.testing.assert_allclose(ev.result(st), 0.5, rtol=1e-6)
+
+
+def test_sum_and_column_sum():
+    ev = E.SumEvaluator()
+    st = ev.update(ev.init(), value=jnp.asarray([[1.0], [2.0]]))
+    np.testing.assert_allclose(ev.result(st), 3.0)
+    ev2 = E.ColumnSum(size=2)
+    st2 = ev2.update(ev2.init(), value=jnp.asarray([[1.0, 2.0], [3.0, 4.0]]))
+    np.testing.assert_allclose(ev2.result(st2), [4.0, 6.0])

@@ -1,0 +1,196 @@
+"""Layer-DSL graph tests: build small topologies, check size inference,
+init/apply shapes, autodiff flow, train/test mode behavior (the reference's
+config-parser + LayerGrad test roles, SURVEY.md §4)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.layers as L
+from paddle_tpu.core.sequence import pad_sequences
+from paddle_tpu.layers.graph import Topology, reset_names
+
+
+def setup_function(_):
+    reset_names()
+
+
+def test_fc_net_shapes_and_grad(rng, np_rng):
+    x = L.data_layer("x", size=8)
+    h = L.fc_layer(x, size=16, act="relu")
+    y = L.fc_layer(h, size=4, act="softmax")
+    lab = L.data_layer("lab", size=1)
+    cost = L.classification_cost(y, lab)
+    topo = Topology(cost)
+    params = topo.init(rng)
+    assert params[h.name]["w0"].shape == (8, 16)
+    assert params[y.name]["w0"].shape == (16, 4)
+
+    feed = {"x": jnp.asarray(np_rng.randn(5, 8), jnp.float32),
+            "lab": jnp.asarray(np_rng.randint(0, 4, (5,)))}
+
+    def loss(p):
+        return jnp.mean(topo.apply(p, feed, mode="test"))
+
+    val, grads = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(val))
+    gnorm = sum(float(jnp.sum(jnp.abs(g)))
+                for g in jax.tree_util.tree_leaves(grads))
+    assert gnorm > 0
+
+
+def test_mixed_layer_projections(rng, np_rng):
+    a = L.data_layer("a", size=6)
+    b = L.data_layer("b", size=6)
+    m = L.mixed_layer(size=6, input=[
+        L.identity_projection(a),
+        L.dotmul_projection(b),
+    ], act=None)
+    topo = Topology(m)
+    params = topo.init(rng)
+    fa = np_rng.randn(3, 6).astype(np.float32)
+    fb = np_rng.randn(3, 6).astype(np.float32)
+    out = topo.apply(params, {"a": jnp.asarray(fa), "b": jnp.asarray(fb)})
+    # dotmul weight initializes to ones -> out = a + b
+    np.testing.assert_allclose(np.asarray(out), fa + fb, rtol=1e-5)
+
+
+def test_embedding_and_seq_pool(rng, np_rng):
+    w = L.data_layer("w", size=50, is_seq=True)
+    emb = L.embedding_layer(w, size=12)
+    pooled = L.pooling_layer(emb, pooling_type=L.pooling.Max)
+    topo = Topology(pooled)
+    params = topo.init(rng)
+    seqs = [np_rng.randint(0, 50, (l,)) for l in (3, 7)]
+    out = topo.apply(params, {"w": pad_sequences(seqs)})
+    assert out.shape == (2, 12)
+
+
+def test_conv_pool_shapes(rng, np_rng):
+    img = L.data_layer("img", size=1 * 28 * 28, height=28, width=28)
+    conv = L.img_conv_layer(img, filter_size=5, num_filters=4, num_channels=1,
+                            act="relu")
+    assert conv.img_shape == (24, 24)
+    pool = L.img_pool_layer(conv, pool_size=2, stride=2)
+    assert pool.img_shape == (13, 13)  # ceil mode
+    topo = Topology(pool)
+    params = topo.init(rng)
+    out = topo.apply(params, {"img": jnp.asarray(
+        np_rng.randn(2, 784), jnp.float32)})
+    assert out.shape == (2, 4 * 13 * 13)
+
+
+def test_batch_norm_train_updates_state(rng, np_rng):
+    x = L.data_layer("x", size=6)
+    bn = L.batch_norm_layer(L.fc_layer(x, size=6, act=None), act="relu")
+    topo = Topology(bn)
+    params = topo.init(rng)
+    state = topo.init_state()
+    feed = {"x": jnp.asarray(np_rng.randn(8, 6), jnp.float32)}
+    out, new_state = topo.apply(params, feed, mode="train", state=state,
+                                return_state=True)
+    assert bn.name in new_state
+    # moving mean must have moved
+    assert float(jnp.sum(jnp.abs(new_state[bn.name][0]))) > 0
+    # test mode uses provided stats, returns no update
+    out2, st2 = topo.apply(params, feed, mode="test", state=state,
+                           return_state=True)
+    assert bn.name not in st2
+
+
+def test_dropout_train_vs_test(rng, np_rng):
+    x = L.data_layer("x", size=100)
+    d = L.dropout_layer(x, dropout_rate=0.5)
+    topo = Topology(d)
+    params = topo.init(rng)
+    feed = {"x": jnp.ones((4, 100))}
+    out_test = topo.apply(params, feed, mode="test")
+    np.testing.assert_allclose(np.asarray(out_test), 1.0)
+    out_train = topo.apply(params, feed, mode="train", rng=rng)
+    frac_zero = float(jnp.mean(out_train == 0))
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_lstmemory_via_dsl(rng, np_rng):
+    w = L.data_layer("w", size=20, is_seq=True)
+    emb = L.embedding_layer(w, size=8)
+    mix = L.fc_layer(emb, size=16, act=None, bias_attr=False)
+    lstm = L.lstmemory(mix, size=4)
+    last = L.last_seq(lstm)
+    topo = Topology(last)
+    params = topo.init(rng)
+    seqs = [np_rng.randint(0, 20, (l,)) for l in (5, 2)]
+    out = topo.apply(params, {"w": pad_sequences(seqs)})
+    assert out.shape == (2, 4)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_recurrent_group_matches_grumemory(rng, np_rng):
+    """DSL recurrent_group with gru_step must equal grumemory (the
+    reference's test_RecurrentGradientMachine equivalence discipline)."""
+    w = L.data_layer("w", size=30, is_seq=True)
+    emb = L.embedding_layer(w, size=6, param_attr={"initial_std": 0.1})
+    mix = L.fc_layer(emb, size=12, act=None, bias_attr=False,
+                     param_attr={"initial_std": 0.1}, name="mix")
+    whole = L.grumemory(mix, size=4, name="gru_whole")
+
+    def step(x3):
+        mem = L.memory(name="gru_out", size=4)
+        return L.gru_step_layer(x3, mem, size=4, name="gru_out")
+
+    grouped = L.recurrent_group(step, input=mix)
+    topo = Topology([whole, grouped])
+    params = topo.init(rng)
+    # share weights: copy whole-seq params into the group's step params
+    gp = params[grouped.name]["__sub__"]["gru_out"]
+    wp = params["gru_whole"]
+    gp["w_gate"] = wp["w_gate"]
+    gp["w_state"] = wp["w_state"]
+    gp["b"] = wp["b"]
+
+    seqs = [np_rng.randint(0, 30, (l,)) for l in (6, 3)]
+    out_whole, out_group = topo.apply(params, {"w": pad_sequences(seqs)})
+    np.testing.assert_allclose(np.asarray(out_whole.data),
+                               np.asarray(out_group.data), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_cost_layers_all_finite(rng, np_rng):
+    x = L.data_layer("x", size=5)
+    lab_id = L.data_layer("lab", size=1)
+    lab_vec = L.data_layer("labv", size=5)
+    pred = L.fc_layer(x, size=5, act="softmax")
+    costs = [
+        L.classification_cost(pred, lab_id),
+        L.regression_cost(pred, lab_vec),
+        L.multi_binary_label_cross_entropy(L.fc_layer(x, size=5, act=None),
+                                           lab_vec),
+        L.smooth_l1_cost(pred, lab_vec),
+        L.sum_cost(pred),
+    ]
+    topo = Topology(costs)
+    params = topo.init(rng)
+    feed = {"x": jnp.asarray(np_rng.randn(4, 5), jnp.float32),
+            "lab": jnp.asarray(np_rng.randint(0, 5, (4,))),
+            "labv": jnp.asarray(np.abs(np_rng.randn(4, 5)).astype(np.float32))}
+    outs = topo.apply(params, feed)
+    for o in outs:
+        assert np.all(np.isfinite(np.asarray(o)))
+
+
+def test_param_sharing_via_param_name(rng, np_rng):
+    """crf_layer + crf_decoding_layer share weights by param_name."""
+    em = L.data_layer("em", size=3, is_seq=True)
+    lab = L.data_layer("lab", size=1, is_seq=True)
+    cost = L.crf_layer(em, lab, size=3, name="mycrf")
+    decode = L.crf_decoding_layer(em, size=3,
+                                  param_name=cost.cfg["param_name"])
+    topo = Topology([cost, decode])
+    params = topo.init(rng)
+    assert cost.cfg["param_name"] in params
+    seqs = [np_rng.randn(4, 3).astype(np.float32)]
+    labs = [np_rng.randint(0, 3, (4, 1))]
+    out_cost, out_dec = topo.apply(
+        params, {"em": pad_sequences(seqs), "lab": pad_sequences(labs)})
+    assert np.all(np.isfinite(np.asarray(out_cost)))
+    assert out_dec.data.shape == (1, 4, 1)

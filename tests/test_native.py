@@ -1,0 +1,97 @@
+"""Native data-path runtime tests (C++ dataio: packing, record IO, prefetch
+pool).  Pure host-side — no JAX needed."""
+
+import os
+import struct
+import tempfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.is_available(),
+    reason="native lib not built (python -m paddle_tpu.native.build)")
+
+
+def test_pack_i32_matches_numpy(np_rng):
+    seqs = [np_rng.randint(0, 100, (l,)).astype(np.int32) for l in (4, 1, 7)]
+    out, lens = native.pack_i32(seqs, pad=-7)
+    assert out.shape == (3, 7)
+    for i, s in enumerate(seqs):
+        np.testing.assert_array_equal(out[i, :len(s)], s)
+        assert np.all(out[i, len(s):] == -7)
+    np.testing.assert_array_equal(lens, [4, 1, 7])
+
+
+def test_pack_i32_truncates():
+    out, lens = native.pack_i32([np.arange(10, dtype=np.int32)], max_len=4)
+    np.testing.assert_array_equal(out[0], [0, 1, 2, 3])
+    assert lens[0] == 4
+
+
+def test_pack_f32(np_rng):
+    seqs = [np_rng.randn(l, 3).astype(np.float32) for l in (2, 5)]
+    out, lens = native.pack_f32(seqs)
+    assert out.shape == (2, 5, 3)
+    np.testing.assert_allclose(out[0, :2], seqs[0])
+    assert np.all(out[0, 2:] == 0)
+
+
+def test_densify_sparse():
+    d = native.densify_sparse([0, 0, 2], [1, 3, 0], None, 3, 4)
+    assert d[0, 1] == 1.0 and d[0, 3] == 1.0 and d[2, 0] == 1.0
+    assert d.sum() == 3.0
+    with pytest.raises(RuntimeError):
+        native.densify_sparse([5], [0], None, 3, 4)  # row out of range
+
+
+def test_record_roundtrip():
+    p = os.path.join(tempfile.mkdtemp(), "x.ptrc")
+    payloads = [struct.pack("<3i", i, i * 2, i * 3) for i in range(20)]
+    with native.RecordWriter(p) as w:
+        for pl in payloads:
+            w.put(pl)
+    with native.RecordReader(p) as r:
+        got = list(r)
+    assert got == payloads
+
+
+def test_record_reader_rejects_garbage():
+    p = os.path.join(tempfile.mkdtemp(), "bad.ptrc")
+    with open(p, "wb") as f:
+        f.write(b"NOTAMAGIC")
+    with pytest.raises(IOError):
+        native.RecordReader(p)
+
+
+def test_prefetch_queue_streams_all():
+    d = tempfile.mkdtemp()
+    paths = []
+    for fi in range(3):
+        p = os.path.join(d, f"f{fi}.ptrc")
+        with native.RecordWriter(p) as w:
+            for i in range(10):
+                w.put(bytes([fi, i]))
+        paths.append(p)
+    q = native.PrefetchQueue(4)
+    for p in paths:
+        q.add_file(p)
+    got = []
+    while True:
+        item = q.pop(500)
+        if item is None:
+            break
+        got.append(item)
+    q.close()
+    assert len(got) == 30
+    assert sorted(got) == sorted(bytes([fi, i])
+                                 for fi in range(3) for i in range(10))
+
+
+def test_prefetch_queue_timeout_empty():
+    q = native.PrefetchQueue(4)
+    assert q.pop(50) is None
+    q.close()

@@ -1,0 +1,93 @@
+"""Sharding tests on the 8-device virtual CPU mesh (SURVEY.md §4 pattern (4):
+replaces the reference's in-process localhost pserver tests,
+test_CompareSparse.cpp) — including single-device vs data-parallel
+equivalence (pattern (3))."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel import (
+    MeshConfig, make_mesh, megatron_rules, param_shardings, shard_params,
+    batch_shardings, valid_spec, AXIS_MODEL)
+
+
+needs_8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                             reason="needs 8 virtual devices")
+
+
+@needs_8
+def test_mesh_shapes():
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 4, "seq": 1, "expert": 1, "model": 2}
+
+
+def test_valid_spec_fallback():
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    # dim 5 not divisible by model=2 -> replicated
+    assert valid_spec(P(None, AXIS_MODEL), (3, 5), mesh) == P(None, None)
+    assert valid_spec(P(None, AXIS_MODEL), (3, 6), mesh) == P(None, AXIS_MODEL)
+
+
+@needs_8
+def test_megatron_rules_shard_embeddings():
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    params = {"emb": jnp.zeros((64, 16)), "fc": {"w": jnp.zeros((16, 32))},
+              "bias": jnp.zeros((7,))}
+    sh = param_shardings(params, mesh, megatron_rules())
+    assert sh["emb"].spec == P(AXIS_MODEL, None)
+    assert sh["fc"]["w"].spec == P(None, AXIS_MODEL)
+    assert sh["bias"].spec == P()  # odd size -> replicated
+    placed = shard_params(params, mesh, megatron_rules())
+    assert placed["emb"].sharding.spec == P(AXIS_MODEL, None)
+
+
+@needs_8
+def test_data_parallel_matches_single_device(np_rng):
+    """Sharded train step == single-device step (the framework's strongest
+    regression tool per SURVEY.md §4: config-pair equivalence)."""
+    from paddle_tpu.models import lenet
+    from paddle_tpu import optim
+
+    params = lenet.init(jax.random.PRNGKey(0))
+    opt = optim.Momentum(learning_rate=0.1, momentum=0.9)
+    images = jnp.asarray(np_rng.randn(16, 784), jnp.float32)
+    labels = jnp.asarray(np_rng.randint(0, 10, (16,)))
+
+    def step(p, s, im, lab):
+        l, g = jax.value_and_grad(lenet.loss)(p, im, lab)
+        p2, s2 = opt.update(g, s, p)
+        return p2, l
+
+    # single device
+    p1, l1 = jax.jit(step)(params, opt.init(params), images, labels)
+
+    # 8-way data parallel
+    mesh = make_mesh(MeshConfig(data=8, model=1))
+    ps = param_shardings(params, mesh)
+    fs = batch_shardings({"im": images, "lab": labels}, mesh)
+    st = opt.init(params)
+    os_ = {"step": jax.sharding.NamedSharding(mesh, P()),
+           "slots": {"mom": ps}}
+    stepj = jax.jit(step, in_shardings=(ps, os_, fs["im"], fs["lab"]),
+                    out_shardings=(ps, jax.sharding.NamedSharding(mesh, P())))
+    p8, l8 = stepj(jax.device_put(params, ps), jax.device_put(st, os_),
+                   jax.device_put(images, fs["im"]),
+                   jax.device_put(labels, fs["lab"]))
+    np.testing.assert_allclose(float(l1), float(l8), rtol=1e-5)
+    w1 = np.asarray(p1["f2"]["w"])
+    w8 = np.asarray(p8["f2"]["w"])
+    np.testing.assert_allclose(w1, w8, rtol=1e-4, atol=1e-5)
+
+
+@needs_8
+def test_graft_dryrun_multichip():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", "/root/repo/__graft_entry__.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.dryrun_multichip(8)

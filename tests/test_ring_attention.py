@@ -1,0 +1,63 @@
+"""Ring attention vs dense attention equivalence on the 8-device CPU mesh
+(SURVEY.md §4 pattern (3): sharded must match single-device)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.attention import dot_product_attention
+from paddle_tpu.parallel import MeshConfig, make_mesh
+from paddle_tpu.parallel.ring_attention import ring_attention, ulysses_attention
+
+needs_8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                             reason="needs 8 virtual devices")
+
+
+def _qkv(np_rng, b=2, h=4, t=16, d=8):
+    return (jnp.asarray(np_rng.randn(b, h, t, d), jnp.float32),
+            jnp.asarray(np_rng.randn(b, h, t, d), jnp.float32),
+            jnp.asarray(np_rng.randn(b, h, t, d), jnp.float32))
+
+
+@needs_8
+def test_ring_matches_dense(np_rng):
+    mesh = make_mesh(MeshConfig(data=1, seq=8, model=1))
+    q, k, v = _qkv(np_rng)
+    dense = dot_product_attention(q, k, v)
+    ring = ring_attention(q, k, v, mesh)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               rtol=2e-4, atol=2e-5)
+
+
+@needs_8
+def test_ring_causal_matches_dense(np_rng):
+    mesh = make_mesh(MeshConfig(data=1, seq=8, model=1))
+    q, k, v = _qkv(np_rng)
+    dense = dot_product_attention(q, k, v, causal=True)
+    ring = ring_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               rtol=2e-4, atol=2e-5)
+
+
+@needs_8
+def test_ring_with_padding_mask(np_rng):
+    mesh = make_mesh(MeshConfig(data=1, seq=8, model=1))
+    q, k, v = _qkv(np_rng)
+    kv_mask = jnp.asarray(
+        (np.arange(16)[None, :] < np.asarray([12, 9])[:, None]), jnp.float32)
+    mask4 = (kv_mask[:, None, None, :] > 0)
+    dense = dot_product_attention(q, k, v, mask=mask4)
+    ring = ring_attention(q, k, v, mesh, kv_mask=kv_mask)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               rtol=2e-4, atol=2e-5)
+
+
+@needs_8
+def test_ulysses_matches_dense(np_rng):
+    mesh = make_mesh(MeshConfig(data=1, seq=8, model=1))
+    q, k, v = _qkv(np_rng, h=8)
+    dense = dot_product_attention(q, k, v, causal=True)
+    uly = ulysses_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(dense),
+                               rtol=2e-4, atol=2e-5)

@@ -1,0 +1,114 @@
+"""End-to-end trainer tests (the reference's test_Trainer/test_TrainerOnePass
+role): train tiny nets through SGD.train, checkpoint roundtrip, inference."""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu
+import paddle_tpu.layers as L
+from paddle_tpu import optim
+from paddle_tpu.data import DataFeeder, dense_vector, integer_value
+from paddle_tpu.data import reader as reader_mod
+from paddle_tpu.layers.graph import reset_names
+from paddle_tpu.trainer import SGD, Inferencer, events
+from paddle_tpu.trainer.checkpoint import (
+    save_checkpoint, load_checkpoint, merge_model, load_merged)
+
+
+def setup_function(_):
+    reset_names()
+
+
+def _xor_reader(n=256, batch=32, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n, 2).astype(np.float32)
+    ys = ((xs[:, 0] > 0) ^ (xs[:, 1] > 0)).astype(np.int64)
+
+    def reader():
+        for i in range(0, n, batch):
+            yield [(xs[j], int(ys[j])) for j in range(i, min(i + batch, n))]
+    return reader
+
+
+def test_sgd_train_xor_loss_drops():
+    x = L.data_layer("x", size=2)
+    lab = L.data_layer("lab", size=1)
+    h = L.fc_layer(x, size=16, act="tanh")
+    y = L.fc_layer(h, size=2, act="softmax")
+    cost = L.classification_cost(y, lab)
+
+    trainer = SGD(cost=cost, update_equation=optim.Adam(learning_rate=0.05))
+    feeding = {"x": dense_vector(2), "lab": integer_value(2)}
+    seen = []
+    trainer.train(_xor_reader(), num_passes=12,
+                  event_handler=lambda e: seen.append(e)
+                  if isinstance(e, events.EndIteration) else None,
+                  feeding=feeding, log_period=0, buffered_batches=0)
+    first = np.mean([float(e.cost) for e in seen[:8]])
+    last = np.mean([float(e.cost) for e in seen[-8:]])
+    assert last < 0.5 * first, (first, last)
+    # inference on the trained params
+    inf = Inferencer(y, trainer.parameters)
+    probs = inf.infer({"x": jnp.asarray([[1.5, 1.5], [1.5, -1.5]],
+                                        jnp.float32)})
+    pred = np.argmax(np.asarray(probs), axis=-1)
+    np.testing.assert_array_equal(pred, [0, 1])
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    params = {"layer": {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+                        "b": jnp.ones((3,))}}
+    opt_state = {"step": jnp.asarray(5, jnp.int32),
+                 "slots": {"mom": {"layer": {"w": jnp.zeros((2, 3)),
+                                             "b": jnp.zeros((3,))}}}}
+    model_state = {"bn": (jnp.zeros((3,)), jnp.ones((3,)))}
+    path = save_checkpoint(str(tmp_path), 3, params, opt_state, model_state)
+    assert os.path.basename(path) == "pass-00003"
+    p2, o2, m2, meta = load_checkpoint(str(tmp_path))
+    np.testing.assert_allclose(np.asarray(p2["layer"]["w"]),
+                               np.asarray(params["layer"]["w"]))
+    assert int(o2["step"]) == 5
+    assert isinstance(m2["bn"], tuple)
+    np.testing.assert_allclose(np.asarray(m2["bn"][1]), 1.0)
+    assert meta["pass_id"] == 3
+
+
+def test_save_only_one(tmp_path):
+    params = {"w": jnp.ones((2,))}
+    save_checkpoint(str(tmp_path), 0, params)
+    save_checkpoint(str(tmp_path), 1, params, save_only_one=True)
+    dirs = sorted(d for d in os.listdir(tmp_path) if d.startswith("pass-"))
+    assert dirs == ["pass-00001"]
+
+
+def test_merge_model(tmp_path):
+    params = {"w": jnp.asarray([1.0, 2.0])}
+    save_checkpoint(str(tmp_path), 0, params, model_state={"s": jnp.zeros(1)})
+    out = merge_model(str(tmp_path), str(tmp_path / "model.npz"))
+    p, ms, meta = load_merged(out)
+    np.testing.assert_allclose(np.asarray(p["w"]), [1.0, 2.0])
+
+
+def test_trainer_resume(tmp_path):
+    reset_names()
+    x = L.data_layer("x", size=2)
+    lab = L.data_layer("lab", size=1)
+    y = L.fc_layer(x, size=2, act="softmax")
+    cost = L.classification_cost(y, lab)
+    t1 = SGD(cost=cost, update_equation=optim.Momentum(learning_rate=0.1))
+    feeding = {"x": dense_vector(2), "lab": integer_value(2)}
+    t1.train(_xor_reader(n=64), num_passes=1, feeding=feeding, log_period=0,
+             buffered_batches=0, save_dir=str(tmp_path))
+    reset_names()
+    x = L.data_layer("x", size=2)
+    lab = L.data_layer("lab", size=1)
+    y2 = L.fc_layer(x, size=2, act="softmax")
+    cost2 = L.classification_cost(y2, lab)
+    t2 = SGD(cost=cost2, update_equation=optim.Momentum(learning_rate=0.1))
+    meta = t2.load(str(tmp_path))
+    assert meta["pass_id"] == 0
+    w1 = np.asarray(t1.parameters[list(t1.parameters)[0]]["w0"])
+    w2 = np.asarray(t2.parameters[list(t2.parameters)[0]]["w0"])
+    np.testing.assert_allclose(w1, w2)
