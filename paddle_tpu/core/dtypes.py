@@ -9,7 +9,9 @@ reductions/softmax in float32.
 import jax.numpy as jnp
 
 _param_dtype = jnp.float32
-_compute_dtype = jnp.bfloat16
+# None = auto: bfloat16 when the default backend is a TPU (MXU-native),
+# float32 otherwise (XLA-CPU lacks bf16 kernels for some fused dots).
+_compute_dtype = None
 
 _NAMES = {
     "float32": jnp.float32,
@@ -19,24 +21,39 @@ _NAMES = {
 }
 
 
-def set_policy(param_dtype="float32", compute_dtype="bfloat16"):
+def set_policy(param_dtype="float32", compute_dtype=None):
+    """compute_dtype=None restores the platform-auto policy."""
     global _param_dtype, _compute_dtype
     _param_dtype = _NAMES[str(param_dtype)] if isinstance(param_dtype, str) else param_dtype
-    _compute_dtype = _NAMES[str(compute_dtype)] if isinstance(compute_dtype, str) else compute_dtype
+    if compute_dtype is None:
+        _compute_dtype = None
+    else:
+        _compute_dtype = _NAMES[str(compute_dtype)] if isinstance(compute_dtype, str) else compute_dtype
 
 
 def param_dtype():
     return _param_dtype
 
 
+def _auto_compute_dtype():
+    import jax
+    try:
+        platform = jax.default_backend()
+    except Exception:
+        platform = "cpu"
+    return jnp.bfloat16 if platform == "tpu" else jnp.float32
+
+
 def compute_dtype():
+    if _compute_dtype is None:
+        return _auto_compute_dtype()
     return _compute_dtype
 
 
 def to_compute(x):
     """Cast activations to the compute dtype (bf16 on the MXU path)."""
     if x.dtype in (jnp.float32, jnp.float64, jnp.bfloat16, jnp.float16):
-        return x.astype(_compute_dtype)
+        return x.astype(compute_dtype())
     return x
 
 

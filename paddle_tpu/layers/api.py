@@ -852,10 +852,37 @@ def _ce_cost(ctx, cfg, pred, label):
 _register_cost("classification_cost", _ce_cost)
 
 
+def _logits_view(node):
+    """If `node` is a softmax-activated layer, build a logits alias: same
+    type/inputs/params (shared via param_name) with act=None.  This fuses
+    softmax+CE the way the reference's MultiClassCrossEntropy backward
+    writes (p - y) straight into the softmax layer
+    (gserver/layers/CostLayer.cpp) — the log(max(p, eps)) formulation has
+    zero gradient once a probability underflows eps, which kills training;
+    log_softmax(logits) never saturates."""
+    if node.cfg.get("act") != "softmax" or node.cfg.get("drop_rate"):
+        # dropout runs after the activation; CE(log_softmax(dropout(z)))
+        # would differ from the documented CE over dropout(softmax(z)), so a
+        # softmax layer with dropout keeps the unfused probability path.
+        return None
+    cfg = dict(node.cfg)
+    cfg["act"] = None
+    cfg["param_name"] = node.cfg.get("param_name", node.name)
+    return LayerOutput(auto_name(node.name + "_logits"), node.layer_type,
+                       node.size, node.inputs, cfg, is_seq=node.is_seq,
+                       num_filters=node.num_filters, img_shape=node.img_shape)
+
+
 def classification_cost(input, label, name=None, evaluator=None,
                         from_logits=False):
     """Reference classification_cost: input is softmax output; here the
-    graph usually ends with act='softmax', so from_logits defaults False."""
+    graph usually ends with act='softmax', so from_logits defaults False.
+    When the input is a softmax layer we rewire onto its logits (see
+    _logits_view) for a numerically exact fused gradient."""
+    if not from_logits:
+        logits = _logits_view(input)
+        if logits is not None:
+            input, from_logits = logits, True
     return LayerOutput(name or auto_name("cost"), "classification_cost", 1,
                        [input, label], {"from_logits": from_logits},
                        is_seq=False)

@@ -240,6 +240,11 @@ class Topology:
                     raise ConfigError(f"missing feed for data layer {node.name!r}")
                 cache[id(node)] = feed[node.name]
                 continue
+            # recurrent_group feeds its step/memory/static placeholders by
+            # name on each scan step
+            if node.layer_type.startswith("__") and node.name in feed:
+                cache[id(node)] = feed[node.name]
+                continue
             impl = get_impl(node.layer_type)
             ins = [cache[id(i)] for i in node.inputs]
             p = params.get(self._param_key(node), {})

@@ -311,13 +311,14 @@ class _RecurrentGroupImpl:
                                           tuple(boot_vals),
                                           reverse=cfg["reverse"])
         # rnn_ops.recurrent_group maps over the input pytree; our step_fn
-        # consumed a tuple of SequenceBatches and returned tuples
-        if isinstance(outs, tuple) and len(outs) == 1:
-            result = outs[0]
-        else:
-            result = outs
+        # consumed a tuple of SequenceBatches and returned a tuple of outputs.
+        # NB: SequenceBatch is itself a (named) tuple — test explicitly.
+        def is_plain_tuple(v):
+            return isinstance(v, tuple) and not isinstance(v, SequenceBatch)
+
+        result = outs[0] if (is_plain_tuple(outs) and len(outs) == 1) else outs
         ctx.aux[cfg["self_name"] + "/outputs"] = result
-        return result[0] if isinstance(result, tuple) else result
+        return result[0] if is_plain_tuple(result) else result
 
 
 register_layer("recurrent_group")(_RecurrentGroupImpl)

@@ -21,6 +21,47 @@ from paddle_tpu.ops import activations
 _DN = ("NHWC", "HWIO", "NHWC")
 
 
+def _conv_call(x, w, cfg):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=cfg["stride"], padding=cfg["pad"],
+        lhs_dilation=cfg["lhs_dilation"], rhs_dilation=cfg["rhs_dilation"],
+        dimension_numbers=_DN, feature_group_count=cfg["groups"],
+        preferred_element_type=cfg["preferred"])
+
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _conv_f32acc(x, w, cfg_key):
+    """conv with f32 accumulation (preferred_element_type=f32) whose backward
+    keeps operand dtypes uniform: JAX's conv transpose rule rejects mixed
+    (f32 cotangent, bf16 operand) pairs, so the bwd casts the cotangent to
+    the operand dtype and differentiates a same-dtype conv instead."""
+    return _conv_call(x, w, dict(cfg_key) | {"preferred": jnp.float32})
+
+
+def _conv_f32acc_fwd(x, w, cfg_key):
+    return _conv_f32acc(x, w, cfg_key), (x, w)
+
+
+def _conv_f32acc_bwd(cfg_key, res, g):
+    x, w = res
+    cfg = dict(cfg_key) | {"preferred": None}
+    _, vjp = jax.vjp(lambda x_, w_: _conv_call(x_, w_, cfg), x, w)
+    return vjp(g.astype(x.dtype))
+
+
+_conv_f32acc.defvjp(_conv_f32acc_fwd, _conv_f32acc_bwd)
+
+
+def _conv(x, w, stride, pad, lhs_dilation, rhs_dilation, groups):
+    cfg_key = (("stride", tuple(stride)), ("pad", tuple(pad)),
+               ("lhs_dilation", tuple(lhs_dilation) if lhs_dilation else None),
+               ("rhs_dilation", tuple(rhs_dilation)), ("groups", groups))
+    return _conv_f32acc(x, w, cfg_key)
+
+
 def conv_output_size(in_size, filter_size, stride, padding):
     """Reference math/MathUtils.cpp outputSize (caffeMode=True):
     (in + 2*pad - filter) / stride + 1."""
@@ -32,12 +73,7 @@ def conv2d(x, w, b=None, stride=(1, 1), padding=(0, 0), groups=1,
     """x: [B, H, W, Cin], w: [kh, kw, Cin/groups, Cout] -> [B, H', W', Cout]."""
     cd = dtypes.compute_dtype()
     pad = ((padding[0], padding[0]), (padding[1], padding[1]))
-    y = jax.lax.conv_general_dilated(
-        x.astype(cd), w.astype(cd),
-        window_strides=stride, padding=pad,
-        rhs_dilation=dilation, dimension_numbers=_DN,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32)
+    y = _conv(x.astype(cd), w.astype(cd), stride, pad, None, dilation, groups)
     if b is not None:
         y = y + b
     return activations.get(act)(y)
@@ -50,11 +86,8 @@ def conv2d_transpose(x, w, b=None, stride=(1, 1), padding=(0, 0), act=None):
     kh, kw = w.shape[0], w.shape[1]
     pad = ((kh - 1 - padding[0], kh - 1 - padding[0]),
            (kw - 1 - padding[1], kw - 1 - padding[1]))
-    y = jax.lax.conv_general_dilated(
-        x.astype(cd), jnp.flip(w, (0, 1)).swapaxes(2, 3).astype(cd),
-        window_strides=(1, 1), padding=pad,
-        lhs_dilation=stride, dimension_numbers=_DN,
-        preferred_element_type=jnp.float32)
+    y = _conv(x.astype(cd), jnp.flip(w, (0, 1)).swapaxes(2, 3).astype(cd),
+              (1, 1), pad, stride, (1, 1), 1)
     if b is not None:
         y = y + b
     return activations.get(act)(y)

@@ -81,6 +81,8 @@ def valid_spec(spec: P, shape, mesh: Mesh) -> P:
         axes = axis if isinstance(axis, tuple) else (axis,)
         size = int(np.prod([mesh.shape[a] for a in axes]))
         out.append(axis if (shape[i] % size == 0 and shape[i] >= size) else None)
+    while out and out[-1] is None:
+        out.pop()
     return P(*out)
 
 

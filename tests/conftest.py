@@ -5,12 +5,22 @@ replacing the reference's localhost pservers in test_CompareSparse.cpp)."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU unconditionally: the ambient environment may point JAX at a
+# remote single-chip TPU (e.g. JAX_PLATFORMS=axon through a tunnel), which
+# would serialize every test through that link — and a sitecustomize hook may
+# set the jax_platforms *config* at interpreter startup, which overrides the
+# env var. So set both the env var and the config explicitly before any
+# backend is initialized. Tests always run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

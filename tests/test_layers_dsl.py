@@ -72,12 +72,12 @@ def test_conv_pool_shapes(rng, np_rng):
                             act="relu")
     assert conv.img_shape == (24, 24)
     pool = L.img_pool_layer(conv, pool_size=2, stride=2)
-    assert pool.img_shape == (13, 13)  # ceil mode
+    assert pool.img_shape == (12, 12)  # (24-2+2-1)//2+1, MathUtils.cpp:75
     topo = Topology(pool)
     params = topo.init(rng)
     out = topo.apply(params, {"img": jnp.asarray(
         np_rng.randn(2, 784), jnp.float32)})
-    assert out.shape == (2, 4 * 13 * 13)
+    assert out.shape == (2, 4 * 12 * 12)
 
 
 def test_batch_norm_train_updates_state(rng, np_rng):

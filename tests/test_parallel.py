@@ -28,7 +28,7 @@ def test_mesh_shapes():
 def test_valid_spec_fallback():
     mesh = make_mesh(MeshConfig(data=4, model=2))
     # dim 5 not divisible by model=2 -> replicated
-    assert valid_spec(P(None, AXIS_MODEL), (3, 5), mesh) == P(None, None)
+    assert valid_spec(P(None, AXIS_MODEL), (3, 5), mesh) == P()
     assert valid_spec(P(None, AXIS_MODEL), (3, 6), mesh) == P(None, AXIS_MODEL)
 
 
@@ -38,11 +38,11 @@ def test_megatron_rules_shard_embeddings():
     params = {"emb": jnp.zeros((64, 16)), "fc": {"w": jnp.zeros((16, 32))},
               "bias": jnp.zeros((7,))}
     sh = param_shardings(params, mesh, megatron_rules())
-    assert sh["emb"].spec == P(AXIS_MODEL, None)
+    assert sh["emb"].spec == P(AXIS_MODEL)
     assert sh["fc"]["w"].spec == P(None, AXIS_MODEL)
     assert sh["bias"].spec == P()  # odd size -> replicated
     placed = shard_params(params, mesh, megatron_rules())
-    assert placed["emb"].sharding.spec == P(AXIS_MODEL, None)
+    assert placed["emb"].sharding.spec == P(AXIS_MODEL)
 
 
 @needs_8
