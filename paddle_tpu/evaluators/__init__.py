@@ -13,9 +13,11 @@ from paddle_tpu.evaluators.evaluators import (
     Evaluator, ClassificationError, Auc, PrecisionRecall, PnPair, RankAuc,
     SumEvaluator, ColumnSum, ChunkEvaluator, CTCError, get,
 )
+from paddle_tpu.evaluators.dsl import *          # noqa: F401,F403
+from paddle_tpu.evaluators import dsl as _dsl
 
 __all__ = [
     "Evaluator", "ClassificationError", "Auc", "PrecisionRecall", "PnPair",
     "RankAuc", "SumEvaluator", "ColumnSum", "ChunkEvaluator", "CTCError",
     "get",
-]
+] + _dsl.__all__

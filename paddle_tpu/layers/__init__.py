@@ -4,10 +4,46 @@ from paddle_tpu.layers.graph import LayerOutput, Topology, Context
 from paddle_tpu.layers.api import *          # noqa: F401,F403
 from paddle_tpu.layers.vision import *       # noqa: F401,F403
 from paddle_tpu.layers.recurrent import *    # noqa: F401,F403
+from paddle_tpu.layers.generation import *   # noqa: F401,F403
 from paddle_tpu.layers import networks
+from paddle_tpu.layers.networks import *     # noqa: F401,F403
 from paddle_tpu.layers import api as _api
 from paddle_tpu.layers import vision as _vision
 from paddle_tpu.layers import recurrent as _recurrent
+from paddle_tpu.layers import generation as _generation
 
-__all__ = (["LayerOutput", "Topology", "Context", "networks"]
-           + _api.__all__ + _vision.__all__ + _recurrent.__all__)
+
+class LayerType:
+    """Reference LayerType string constants (trainer_config_helpers
+    layers.py); config compatibility only — the functional IR dispatches on
+    these type strings directly."""
+    DATA = "data"
+    FC_LAYER = "fc"
+    MIXED_LAYER = "mixed"
+    EMBEDDING_LAYER = "embedding"
+    LSTMEMORY = "lstmemory"
+    GRUMEMORY = "grumemory"
+    RECURRENT_LAYER = "recurrent"
+    CONV_LAYER = "conv"
+    CONVTRANS_LAYER = "conv"
+    CUDNNCONV_LAYER = "conv"        # plain/cudnn variants collapse into XLA
+    POOL_LAYER = "pool"
+    BATCH_NORM_LAYER = "batch_norm"
+    CRF_LAYER = "crf"
+    CTC_LAYER = "ctc"
+    COST = "classification_cost"
+
+
+def layer_support(*attrs):
+    """Reference layer_support decorator (declares ERROR_CLIPPING/DROPOUT
+    support per ctor); attribute plumbing is handled by layer_attr cfg here,
+    so this is an identity decorator kept for config compatibility."""
+    def deco(fn):
+        return fn
+    return deco
+
+
+__all__ = (["LayerOutput", "Topology", "Context", "networks", "LayerType",
+            "layer_support"]
+           + _api.__all__ + _vision.__all__ + _recurrent.__all__
+           + _generation.__all__ + networks.__all__)

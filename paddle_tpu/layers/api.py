@@ -44,6 +44,7 @@ __all__ = [
     "huber_cost", "smooth_l1_cost", "sum_cost", "crf_layer",
     "crf_decoding_layer", "ctc_layer", "warp_ctc_layer", "nce_layer",
     "hsigmoid", "pooling", "slice_projection",
+    "AggregateLevel", "ExpandLevel", "repeat_layer",
 ]
 
 
@@ -218,13 +219,18 @@ class _MixedImpl:
                 pad_rows = max(0, -spec["context_start"]) + max(
                     0, spec["context_start"] + spec["context_len"] - 1)
                 p[f"w{k}"] = _winit(spec.get("param_attr"))(rngs[k], (pad_rows, isz))
-            idx += 2 if kind in ("dotmul_op",) else 1
+            elif kind == "conv_proj":
+                fh, fw = spec["filter_size"]
+                p[f"w{k}"] = _winit(spec.get("param_attr"))(
+                    rngs[k], (fh, fw, spec["channels"], spec["num_filters"]))
+            idx += 2 if kind in ("dotmul_op", "conv_op") else 1
         b = _maybe_bias(rngs[-1], cfg.get("bias_attr", False), cfg["size"])
         if b is not None:
             p["b"] = b
         return p
 
     def apply(self, ctx, cfg, params, *inputs):
+        from paddle_tpu.ops import conv as conv_ops
         total = None
         idx = 0
         for k, (kind, spec) in enumerate(cfg["parts"]):
@@ -232,6 +238,24 @@ class _MixedImpl:
                 a, b2 = inputs[idx], inputs[idx + 1]
                 part = map_rows(lambda x, y: spec.get("scale", 1.0) * x * y, a, b2)
                 idx += 2
+            elif kind == "conv_op":
+                # reference ConvOperator.cpp:58-83: per-sample conv, each
+                # row of input(1) is that sample's own filter -> vmap
+                img, filt = inputs[idx], inputs[idx + 1]
+                idx += 2
+                c, (h, w) = spec["channels"], spec["in_shape"]
+                fh, fw = spec["filter_size"]
+                nf = spec["num_filters"]
+
+                def one(img_row, filt_row):
+                    x = img_row.reshape(c, h, w).transpose(1, 2, 0)[None]
+                    wgt = filt_row.reshape(nf, c, fh, fw).transpose(2, 3, 1, 0)
+                    y = conv_ops.conv2d(x, wgt, stride=spec["stride"],
+                                        padding=spec["padding"])
+                    return y.transpose(0, 3, 1, 2).reshape(-1)
+
+                part = map_rows(
+                    lambda im, fl: jax.vmap(one)(im, fl), img, filt)
             else:
                 v = inputs[idx]
                 idx += 1
@@ -255,6 +279,17 @@ class _MixedImpl:
                     part = seq_ops.context_projection(
                         as_seq(v), spec["context_len"], spec["context_start"],
                         params.get(f"w{k}"))
+                elif kind == "conv_proj":
+                    c, (h, w) = spec["channels"], spec["in_shape"]
+
+                    def conv_rows(d):
+                        x = d.reshape(d.shape[0], c, h, w).transpose(0, 2, 3, 1)
+                        y = conv_ops.conv2d(x, params[f"w{k}"],
+                                            stride=spec["stride"],
+                                            padding=spec["padding"])
+                        return y.transpose(0, 3, 1, 2).reshape(d.shape[0], -1)
+
+                    part = map_rows(conv_rows, v)
                 else:
                     raise ConfigError(f"unknown mixed part {kind}")
             total = part if total is None else map_rows(
@@ -552,6 +587,33 @@ def featmap_expand_layer(input, num_filters, as_row_vector=True, name=None):
 
 _simple_layer("resize", lambda cfg, s: cfg["size"],
               lambda ctx, cfg, x: math_ops.resize(value_data(x), cfg["size"]))
+
+
+_simple_layer("repeat", lambda cfg, s: s[0] * cfg["n"],
+              lambda ctx, cfg, v: map_rows(
+                  lambda d: jnp.tile(d, (1,) * (d.ndim - 1) + (cfg["n"],)), v))
+
+
+class AggregateLevel:
+    """Reference AggregateLevel (layers.py:227)."""
+    EACH_TIMESTEP = "non-seq"
+    EACH_SEQUENCE = "seq"
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+
+
+class ExpandLevel:
+    """Reference ExpandLevel (layers.py:1456)."""
+    FROM_TIMESTEP = AggregateLevel.EACH_TIMESTEP
+    FROM_SEQUENCE = AggregateLevel.EACH_SEQUENCE
+    FROM_NO_SEQUENCE = AggregateLevel.EACH_TIMESTEP
+
+
+def repeat_layer(input, num_repeats, name=None, layer_attr=None):
+    """Reference repeat_layer: y = [x, x, ..., x] (concat num_repeats
+    copies, layers.py:1514)."""
+    return LayerOutput(name or auto_name("repeat"), "repeat",
+                       input.size * num_repeats, [input], {"n": num_repeats})
 
 
 def resize_layer(input, size, name=None):

@@ -1,0 +1,258 @@
+"""Sequence-generation DSL: GeneratedInput + beam_search layer.
+
+Reference: the v1 DSL's beam_search/GeneratedInput sugar
+(trainer_config_helpers/layers.py BaseGeneratedInput/GeneratedInput and
+beam_search), lowered there to a recurrent layer group in generation mode and
+executed by RecurrentGradientMachine::generateSequence/beamSearch
+(gserver/gradientmachines/RecurrentGradientMachine.cpp:823,1248).
+
+TPU design: the step sub-graph is traced once (like recurrent_group) and
+driven by the functional beam decoder in ops/beam.py — one lax.scan with
+static beam_size/max_length, finished-lane masking, and state reordering by
+take_along_axis instead of the reference's machineIdVec scatter copies.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.layers.graph import (
+    LayerOutput, Topology, auto_name, register_layer, value_data)
+from paddle_tpu.layers import recurrent as rec
+from paddle_tpu.layers.api import _winit
+from paddle_tpu.ops import beam as beam_ops
+from paddle_tpu.ops import embedding as emb_ops
+from paddle_tpu.utils.error import ConfigError
+
+__all__ = ["BaseGeneratedInput", "GeneratedInput", "SubsequenceInput",
+           "beam_search", "greedy_generation"]
+
+
+class BaseGeneratedInput:
+    pass
+
+
+class GeneratedInput(BaseGeneratedInput):
+    """The previously generated token, embedded (reference GeneratedInput:
+    size = vocab, embedding_name/embedding_size select the lookup table,
+    shared by name with the training graph's target embedding)."""
+
+    def __init__(self, size, embedding_name, embedding_size,
+                 bos_id=0, eos_id=1):
+        self.size = size                      # vocab
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+
+
+class SubsequenceInput:
+    """Marks a two-level sequence input for a nested recurrent_group
+    (reference SubsequenceInput). The outer group iterates subsequences."""
+
+    def __init__(self, input):
+        self.input = input
+
+
+class _SharedTableImpl:
+    """Parameter-only node holding the generated-word embedding table, keyed
+    by GeneratedInput.embedding_name via cfg['param_name'] — so the training
+    graph's target embedding (embedding_layer with
+    param_attr={'name': embedding_name}) and the decoder share one table."""
+
+    def infer(self, cfg, in_sizes):
+        return cfg["emb_size"]
+
+    def init(self, rng, cfg, in_sizes):
+        return {"w": _winit(cfg.get("param_attr"),
+                            1.0 / cfg["vocab"] ** 0.5)(
+            rng, (cfg["vocab"], cfg["emb_size"]))}
+
+    def apply(self, ctx, cfg, params):
+        return params["w"]
+
+
+register_layer("shared_table")(_SharedTableImpl)
+
+
+class _BeamSearchImpl:
+    def infer(self, cfg, in_sizes):
+        return 1   # value rows are generated token ids
+
+    def init(self, rng, cfg, in_sizes):
+        return {"__sub__": cfg["sub_topo"].init(rng)}
+
+    def apply(self, ctx, cfg, params, emb_w, *inputs):
+        gen: GeneratedInput = cfg["gen"]
+        sub_topo: Topology = cfg["sub_topo"]
+        statics = list(inputs[:cfg["n_static"]])
+        boots = list(inputs[cfg["n_static"]:])
+        sub_params = params["__sub__"]
+
+        if statics:
+            bsz = value_data(statics[0]).shape[0]
+        elif boots:
+            bsz = value_data(boots[0]).shape[0]
+        else:
+            raise ConfigError("beam_search needs at least one StaticInput or "
+                              "boot memory to derive the batch size")
+        k = cfg["beam_size"]
+
+        def tile(v):
+            if isinstance(v, SequenceBatch):
+                return SequenceBatch(
+                    data=jnp.repeat(v.data, k, axis=0),
+                    lengths=jnp.repeat(v.lengths, k, axis=0))
+            return jnp.repeat(v, k, axis=0)
+
+        statics_t = [tile(s) for s in statics]
+
+        boot_vals = []
+        bi = 0
+        for ph, link_node, boot, boot_const in cfg["links"]:
+            if isinstance(boot, LayerOutput):
+                boot_vals.append(tile(value_data(boots[bi])))
+                bi += 1
+            elif boot_const is not None:
+                boot_vals.append(jnp.full((bsz * k, ph.size),
+                                          float(boot_const)))
+            else:
+                boot_vals.append(jnp.zeros((bsz * k, ph.size)))
+
+        mode, rng_ = ctx.mode, ctx.rng
+
+        def step_fn(mems, prev_ids):
+            word_emb = emb_ops.embedding_lookup(emb_w, prev_ids)
+            feed = {cfg["gen_ph"].name: word_emb}
+            for ph, s in zip(cfg["static_phs"], statics_t):
+                feed[ph.name] = s
+            for (ph, _, _, _), m in zip(cfg["links"], mems):
+                feed[ph.name] = m
+            out = sub_topo.apply(sub_params, feed, mode=mode, rng=rng_)
+            outs = out if isinstance(out, tuple) else (out,)
+            cache = dict(zip((o.name for o in cfg["outs"]), outs))
+            new_mems = rec.new_memory_values(cfg["links"], cache, sub_params,
+                                             feed, mode, rng_)
+            probs = value_data(outs[0])
+            log_probs = jnp.log(jnp.maximum(probs, 1e-20))
+            return log_probs, tuple(new_mems)
+
+        # adapt to beam_ops signature: step(state, prev) -> (logp, state)
+        def beam_step(state, prev_ids):
+            lp, new_state = step_fn(state, prev_ids)
+            return lp, new_state
+
+        result = beam_ops.beam_search(
+            beam_step, tuple(boot_vals), batch_size=bsz, beam_size=k,
+            max_len=cfg["max_length"], bos_id=gen.bos_id, eos_id=gen.eos_id,
+            length_penalty=cfg.get("length_penalty", 0.0))
+        ctx.aux[cfg["self_name"] + "/result"] = result
+        return result
+
+
+register_layer("beam_search_gen")(_BeamSearchImpl)
+
+
+def _trace_step(step, input, bos_id, eos_id):
+    """Shared step-graph tracing for beam_search/greedy_generation."""
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    gen = None
+    static_inputs, step_args = [], []
+    gen_ph = None
+    for item in ins:
+        if isinstance(item, BaseGeneratedInput):
+            if gen is not None:
+                raise ConfigError("beam_search takes exactly one GeneratedInput")
+            gen = item
+            gen_ph = LayerOutput(auto_name("gen_word"), "__step_input__",
+                                 item.embedding_size, [], {}, is_seq=False)
+            step_args.append(gen_ph)
+        elif isinstance(item, rec.StaticInput):
+            ph = LayerOutput(auto_name("static_in"), "__static__",
+                             item.input.size, [], {}, is_seq=item.is_seq)
+            static_inputs.append((ph, item))
+            step_args.append(ph)
+        else:  # bare layer = static
+            ph = LayerOutput(auto_name("static_in"), "__static__",
+                             item.size, [], {}, is_seq=item.is_seq)
+            static_inputs.append((ph, rec.StaticInput(item, item.is_seq)))
+            step_args.append(ph)
+    if gen is None:
+        raise ConfigError("beam_search needs a GeneratedInput")
+    # explicit beam_search(bos_id=/eos_id=) overrides; None keeps the
+    # GeneratedInput's own ids (do not clobber with wrapper defaults)
+    if bos_id is not None:
+        gen.bos_id = bos_id
+    if eos_id is not None:
+        gen.eos_id = eos_id
+
+    g = rec._GroupBuildCtx()
+    prev = rec._GroupBuildCtx.current
+    rec._GroupBuildCtx.current = g
+    try:
+        outs = step(*step_args)
+    finally:
+        rec._GroupBuildCtx.current = prev
+    outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+    sub_topo = Topology(outs)
+    links = rec.resolve_memory_links(sub_topo, g.memories)
+
+    # first input = the shared embedding table node (param keyed by
+    # embedding_name so trained weights flow into decoding)
+    table = LayerOutput(auto_name(f"table_{gen.embedding_name}"),
+                        "shared_table", gen.embedding_size, [],
+                        {"vocab": gen.size, "emb_size": gen.embedding_size,
+                         "param_name": gen.embedding_name})
+    group_inputs = ([table]
+                    + [s.input for _, s in static_inputs]
+                    + [b for _, _, b, _ in links if isinstance(b, LayerOutput)])
+    return {
+        "gen": gen, "gen_ph": gen_ph, "sub_topo": sub_topo, "outs": outs,
+        "static_phs": [ph for ph, _ in static_inputs],
+        "links": links, "n_static": len(static_inputs),
+    }, group_inputs
+
+
+def beam_search(step, input, bos_id=None, eos_id=None, beam_size=5,
+                max_length=100, length_penalty=0.0, name=None):
+    """DSL beam search (reference layers.py beam_search).
+
+    step(generated_word_embedding, *statics) -> softmax LayerOutput over the
+    vocab; decoder state carried with L.memory links, exactly as in
+    recurrent_group.  Returns a layer whose value is a BeamResult
+    (tokens [B, K, T] best-first, scores, lengths); its .size is 1 (token-id
+    rows).  bos/eos default to the GeneratedInput's ids.
+    """
+    cfg, group_inputs = _trace_step(step, input, bos_id, eos_id)
+    cfg.update({"beam_size": beam_size, "max_length": max_length,
+                "length_penalty": length_penalty})
+    node = LayerOutput(name or auto_name("beam_search"), "beam_search_gen",
+                       1, group_inputs, cfg, is_seq=True)
+    node.cfg["self_name"] = node.name
+    return node
+
+
+class _GreedyGenImpl(_BeamSearchImpl):
+    def apply(self, ctx, cfg, params, emb_w, *inputs):
+        cfg = dict(cfg)
+        cfg["beam_size"] = 1
+        res = super().apply(ctx, cfg, params, emb_w, *inputs)
+        return SequenceBatch(data=res.tokens[:, 0, :],
+                             lengths=res.lengths[:, 0])
+
+
+register_layer("greedy_gen")(_GreedyGenImpl)
+
+
+def greedy_generation(step, input, bos_id=None, eos_id=None, max_length=100,
+                      name=None):
+    """Reference oneWaySearch (greedy) as a layer; value is a SequenceBatch
+    of generated token ids (layer .size = 1); bos/eos default to the
+    GeneratedInput's ids."""
+    cfg, group_inputs = _trace_step(step, input, bos_id, eos_id)
+    cfg.update({"max_length": max_length})
+    node = LayerOutput(name or auto_name("greedy_gen"), "greedy_gen",
+                       1, group_inputs, cfg, is_seq=True)
+    node.cfg["self_name"] = node.name
+    return node

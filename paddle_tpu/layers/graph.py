@@ -221,13 +221,21 @@ class Topology:
             rng, sub = jax.random.split(rng)
             p = impl.init(sub, node.cfg, in_sizes)
             if p:
-                key = node.cfg.get("param_name", node.name)
+                key = self._param_key(node)
                 if key not in params:
                     params[key] = p
         return params
 
     def _param_key(self, node):
-        return node.cfg.get("param_name", node.name)
+        """Parameter-sharing key: explicit cfg['param_name'], else a
+        ParamAttr name (the reference's ParameterAttribute(name=...) sharing
+        mechanism), else the layer name."""
+        if "param_name" in node.cfg:
+            return node.cfg["param_name"]
+        pa = node.cfg.get("param_attr")
+        if isinstance(pa, dict) and pa.get("name"):
+            return pa["name"]
+        return node.name
 
     def apply(self, params, feed, mode="train", rng=None, state=None,
               return_state=False, extra_outputs=()):

@@ -16,9 +16,12 @@ from paddle_tpu.layers.vision import img_conv_layer, img_pool_layer, batch_norm_
 from paddle_tpu.layers.recurrent import lstmemory, grumemory
 
 __all__ = [
-    "simple_img_conv_pool", "img_conv_group", "vgg_16_network",
-    "simple_lstm", "simple_gru", "bidirectional_lstm", "simple_attention",
-    "text_conv_pool", "sequence_conv_pool",
+    "simple_img_conv_pool", "img_conv_group", "img_conv_bn_pool",
+    "vgg_16_network", "small_vgg",
+    "simple_lstm", "simple_gru", "simple_gru2", "gru_unit", "gru_group",
+    "lstmemory_unit", "lstmemory_group",
+    "bidirectional_lstm", "bidirectional_gru", "simple_attention",
+    "text_conv_pool", "sequence_conv_pool", "inputs", "outputs",
 ]
 
 
@@ -161,3 +164,132 @@ def attention_context_layer(encoded_sequence, encoded_proj, decoder_proj,
                        [encoded_sequence, encoded_proj, decoder_proj],
                        {"att_size": encoded_proj.size, "param_attr": param_attr},
                        is_seq=False)
+
+
+# ------------------------------------------------- remaining reference
+# composites (networks.py:41-1410)
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size,
+                     num_channels=None, pool_stride=1, act="relu",
+                     conv_padding=0, pool_type=None, name=None):
+    """conv -> batch_norm -> pool (reference img_conv_bn_pool)."""
+    conv = img_conv_layer(input, filter_size=filter_size,
+                          num_filters=num_filters, num_channels=num_channels,
+                          padding=conv_padding, act=None, bias_attr=False,
+                          name=name and f"{name}_conv")
+    bn = batch_norm_layer(conv, act=act, name=name and f"{name}_bn")
+    return img_pool_layer(bn, pool_size=pool_size, stride=pool_stride,
+                          pool_type=pool_type, name=name and f"{name}_pool")
+
+
+def small_vgg(input_image, num_channels, num_classes=10):
+    """Reference small_vgg (CIFAR configs): 4 conv groups then fc."""
+    def group(ipt, num_filter, times):
+        return img_conv_group(ipt, conv_num_filter=[num_filter] * times,
+                              pool_size=2, num_channels=None,
+                              conv_filter_size=3, conv_act="relu",
+                              conv_with_batchnorm=True, pool_stride=2)
+    tmp = img_conv_group(input_image, conv_num_filter=[64, 64], pool_size=2,
+                         num_channels=num_channels, conv_filter_size=3,
+                         conv_act="relu", conv_with_batchnorm=True,
+                         pool_stride=2)
+    tmp = group(tmp, 128, 2)
+    tmp = group(tmp, 256, 3)
+    tmp = group(tmp, 512, 3)
+    tmp = dropout_layer(tmp, 0.5)
+    tmp = fc_layer(tmp, size=512, act=None)
+    tmp = batch_norm_layer(tmp, act="relu")
+    tmp = fc_layer(tmp, size=512, act="relu")
+    return fc_layer(tmp, size=num_classes, act="softmax")
+
+
+def simple_gru2(input, size, reverse=False, act="tanh", gate_act="sigmoid",
+                name=None, mixed_param_attr=None, gru_param_attr=None):
+    """Reference simple_gru2: same math as simple_gru with the reference's
+    original parameter layout/attr split."""
+    mix = fc_layer(input, size=size * 3, act=None, bias_attr=False,
+                   param_attr=mixed_param_attr,
+                   name=name and f"{name}_transform")
+    return grumemory(mix, size=size, reverse=reverse, act=act,
+                     gate_act=gate_act, param_attr=gru_param_attr, name=name)
+
+
+def gru_unit(input, size=None, name=None, act="tanh", gate_act="sigmoid",
+             memory_boot=None):
+    """One GRU step for custom recurrent groups (reference gru_unit):
+    creates the output memory link itself."""
+    size = size or input.size // 3
+    mem = recurrent.memory(name=name or "gru_unit_out", size=size,
+                           boot_layer=memory_boot)
+    return recurrent.gru_step_layer(input, mem, size=size, act=act,
+                                    gate_act=gate_act,
+                                    name=name or "gru_unit_out")
+
+
+def gru_group(input, size=None, name=None, reverse=False, act="tanh",
+              gate_act="sigmoid", memory_boot=None):
+    """GRU as an explicit recurrent_group (reference gru_group) — same
+    numbers as grumemory, built from the step primitive."""
+    def step(x3):
+        return gru_unit(x3, size=size, name=name and f"{name}_out",
+                        act=act, gate_act=gate_act, memory_boot=memory_boot)
+    return recurrent.recurrent_group(step, input=input, reverse=reverse,
+                                     name=name)
+
+
+def lstmemory_unit(input, size=None, name=None, act="tanh",
+                   gate_act="sigmoid", state_act="tanh", memory_boot=None):
+    """One LSTM step for custom groups (reference lstmemory_unit); the
+    [h|c] pair rides in one memory of width 2*size.  A reference-style
+    memory_boot of width `size` boots h; c boots to zero (matching the
+    reference, whose state memory boots zero unless given its own layer)."""
+    size = size or input.size // 4
+    state_name = (name or "lstm_unit") + "_state"
+    if memory_boot is not None and memory_boot.size == size:
+        # widen [B, size] h-boot to [B, 2*size] = [h | 0]
+        zeros = api.slope_intercept_layer(memory_boot, slope=0.0,
+                                          intercept=0.0)
+        memory_boot = concat_layer([memory_boot, zeros])
+    state = recurrent.memory(name=state_name, size=2 * size,
+                             boot_layer=memory_boot)
+    hc = recurrent.lstm_step_layer(input, state, size=size, act=act,
+                                   gate_act=gate_act, state_act=state_act,
+                                   name=state_name)
+    return mixed_layer(size=size,
+                       input=[api.identity_projection(hc, offset=0,
+                                                      size=size)],
+                       act=None, bias_attr=False, name=name or "lstm_unit")
+
+
+def lstmemory_group(input, size=None, name=None, reverse=False, act="tanh",
+                    gate_act="sigmoid", state_act="tanh", memory_boot=None):
+    """LSTM as an explicit recurrent_group (reference lstmemory_group)."""
+    def step(x4):
+        return lstmemory_unit(x4, size=size, name=name and f"{name}_unit",
+                              act=act, gate_act=gate_act,
+                              state_act=state_act, memory_boot=memory_boot)
+    return recurrent.recurrent_group(step, input=input, reverse=reverse,
+                                     name=name)
+
+
+def bidirectional_gru(input, size, name=None, return_seq=False):
+    """Reference bidirectional_gru: concat(fwd gru, bwd gru)."""
+    fwd = simple_gru(input, size, reverse=False, name=name and f"{name}_fwd")
+    bwd = simple_gru(input, size, reverse=True, name=name and f"{name}_bwd")
+    if return_seq:
+        return concat_layer([fwd, bwd])
+    return concat_layer([api.last_seq(fwd), api.first_seq(bwd)])
+
+
+def inputs(layers, *args):
+    """Reference inputs(): declares data-layer order; with the functional
+    feed-dict API this is a no-op kept for config compatibility."""
+    return None
+
+
+def outputs(layers, *args):
+    """Reference outputs(): marks output layers; return them so configs can
+    end with `return outputs(...)`."""
+    out = list(layers if isinstance(layers, (list, tuple)) else [layers])
+    out += list(args)
+    return out[0] if len(out) == 1 else out

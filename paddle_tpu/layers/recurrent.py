@@ -29,7 +29,8 @@ from paddle_tpu.utils.error import ConfigError
 
 __all__ = [
     "lstmemory", "grumemory", "recurrent_layer", "recurrent_group", "memory",
-    "StaticInput", "lstm_step_layer", "gru_step_layer", "get_output_layer",
+    "StaticInput", "lstm_step_layer", "gru_step_layer",
+    "gru_step_naive_layer", "get_output_layer",
 ]
 
 
@@ -171,6 +172,35 @@ class _GroupBuildCtx:
         self.memories = []  # list of (placeholder, link_name, boot, init_zero)
 
 
+def resolve_memory_links(sub_topo, memories):
+    """Match memory() links to step-graph layers by name (shared by
+    recurrent_group and the generation DSL)."""
+    by_name = {n.name: n for n in sub_topo.order}
+    links = []
+    for ph, link_name, boot, boot_const in memories:
+        if link_name not in by_name:
+            raise ConfigError(
+                f"memory(name={link_name!r}) has no matching layer in the "
+                f"step function (have {sorted(by_name)})")
+        links.append((ph, by_name[link_name], boot, boot_const))
+    return links
+
+
+def new_memory_values(links, cache, sub_params, feed, mode, rng):
+    """Next-step memory values: the linked layer's value from this step's
+    outputs, re-evaluating the sub-graph only for links that aren't already
+    step outputs (shared by recurrent_group and the generation DSL)."""
+    new_mems = []
+    for ph, link_node, _, _ in links:
+        if link_node.name in cache:
+            new_mems.append(value_data(cache[link_node.name]))
+        else:
+            v = Topology([link_node]).apply(sub_params, feed, mode=mode,
+                                            rng=rng)
+            new_mems.append(value_data(v))
+    return new_mems
+
+
 def memory(name, size, boot_layer=None, boot_with_const_id=None,
            is_seq=False):
     """Previous-step output of the layer called `name` (reference memory()
@@ -221,14 +251,7 @@ def recurrent_group(step, input, reverse=False, name=None):
     # resolve memory links: each memory's `link` names a layer in the step
     # graph; collect all step nodes to find them
     sub_topo = Topology(outs)
-    by_name = {n.name: n for n in sub_topo.order}
-    links = []
-    for ph, link_name, boot, boot_const in g.memories:
-        if link_name not in by_name:
-            raise ConfigError(
-                f"memory(name={link_name!r}) has no matching layer in the "
-                f"step function (have {sorted(by_name)})")
-        links.append((ph, by_name[link_name], boot, boot_const))
+    links = resolve_memory_links(sub_topo, g.memories)
 
     group_inputs = ([real for _, real in seq_inputs]
                     + [s.input for _, s in static_inputs]
@@ -294,17 +317,8 @@ class _RecurrentGroupImpl:
             out_vals = sub_topo.apply(sub_params, feed, mode=mode, rng=rng_)
             out_vals = out_vals if isinstance(out_vals, tuple) else (out_vals,)
             cache = dict(zip((o.name for o in cfg["outs"]), out_vals))
-            # recompute memory-link values: links name step-graph layers;
-            # get them via extra outputs
-            new_mems = []
-            for ph, link_node, _, _ in cfg["links"]:
-                if link_node.name in cache:
-                    new_mems.append(value_data(cache[link_node.name]))
-                else:
-                    # link to an intermediate layer: evaluate with it as output
-                    v = Topology([link_node]).apply(sub_params, feed,
-                                                    mode=mode, rng=rng_)
-                    new_mems.append(value_data(v))
+            new_mems = new_memory_values(cfg["links"], cache, sub_params,
+                                         feed, mode, rng_)
             return tuple(new_mems), tuple(value_data(v) for v in out_vals)
 
         outs, _ = rnn_ops.recurrent_group(step_fn, tuple(seqs),
@@ -442,3 +456,14 @@ def gru_step_layer(input, output_mem, size=None, act="tanh",
                        [input, output_mem],
                        {"size": d, "act": act, "gate_act": gate_act,
                         "bias_attr": bias_attr, "param_attr": param_attr})
+
+
+def gru_step_naive_layer(input, output_mem, size=None, act="tanh",
+                         gate_act="sigmoid", name=None, bias_attr=True,
+                         param_attr=None, layer_attr=None):
+    """Reference gru_step_naive_layer: gru_step built from mixed layers so
+    error-clipping/dropout attrs apply.  XLA fuses the fused and naive
+    formulations identically, so this is the same computation here."""
+    return gru_step_layer(input, output_mem, size=size, act=act,
+                          gate_act=gate_act, name=name, bias_attr=bias_attr,
+                          param_attr=param_attr)

@@ -28,7 +28,7 @@ __all__ = [
     "img_conv_layer", "img_pool_layer", "batch_norm_layer",
     "img_cmrnorm_layer", "cross_channel_norm_layer", "maxout_layer",
     "bilinear_interp_layer", "block_expand_layer", "spp_layer", "pad_layer",
-    "priorbox_layer", "data_norm_layer",
+    "priorbox_layer", "data_norm_layer", "conv_projection", "conv_operator",
 ]
 
 
@@ -500,3 +500,41 @@ register_layer("data_norm")(_DataNormImpl)
 def data_norm_layer(input, strategy="z-score", name=None):
     return LayerOutput(name or auto_name("data_norm"), "data_norm", input.size,
                        [input], {"strategy": strategy})
+
+
+# ----------------------------------------------- conv projection/operator
+# (mixed_layer parts; reference ConvProjection / ConvOperator.cpp:58)
+
+def _conv_part_spec(img, filter_size, num_filters, num_channels, stride,
+                    padding):
+    from paddle_tpu.layers.api import _Part  # local: avoid import cycle
+    channels = num_channels or (img.num_filters or 1)
+    in_shape = _img_shape(img, channels)
+    fh, fw = _pair(filter_size)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    oh = conv_ops.conv_output_size(in_shape[0], fh, sh, ph)
+    ow = conv_ops.conv_output_size(in_shape[1], fw, sw, pw)
+    spec = {"filter_size": (fh, fw), "stride": (sh, sw), "padding": (ph, pw),
+            "channels": channels, "num_filters": num_filters,
+            "in_shape": in_shape}
+    return _Part, spec, num_filters * oh * ow
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, param_attr=None):
+    """Learned-filter conv as a mixed_layer projection (reference
+    ConvProjection)."""
+    _Part, spec, out = _conv_part_spec(input, filter_size, num_filters,
+                                       num_channels, stride, padding)
+    spec["param_attr"] = param_attr
+    return _Part("conv_proj", [input], spec, out)
+
+
+def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
+                  stride=1, padding=0):
+    """Per-sample conv where each row of `filter` is that sample's own
+    filter bank (reference ConvOperator.cpp:58-83 loops over batchId)."""
+    _Part, spec, out = _conv_part_spec(img, filter_size, num_filters,
+                                       num_channels, stride, padding)
+    return _Part("conv_op", [img, filter], spec, out)

@@ -46,9 +46,27 @@ class SGD:
 
     def __init__(self, cost, parameters=None, update_equation=None,
                  extra_layers=None, is_local=True, mesh=None,
-                 sharding_rules=None, seed=1, donate=True):
+                 sharding_rules=None, seed=1, donate=True, evaluators=None):
         self.costs = cost if isinstance(cost, (list, tuple)) else [cost]
         self.extra_layers = list(extra_layers or [])
+        # evaluator specs (evaluators.dsl): fetch their bound layers as
+        # extra outputs; labels/weights come straight from the feed
+        self.evaluators = list(evaluators or [])
+        self._eval_slots = []
+        self._eval_extra_slots = []   # per spec: {kw: ('feed', name)|('extra', i)}
+
+        def slot_for(layer):
+            if layer.layer_type == "data":
+                return ("feed", layer.name)
+            if layer in self.extra_layers:
+                return ("extra", self.extra_layers.index(layer))
+            self.extra_layers.append(layer)
+            return ("extra", len(self.extra_layers) - 1)
+
+        for spec in self.evaluators:
+            self._eval_slots.append(slot_for(spec.input))
+            self._eval_extra_slots.append(
+                {kw: slot_for(l) for kw, l in spec.extra_inputs.items()})
         self.topology = Topology(list(self.costs) + self.extra_layers)
         if update_equation is None:
             raise ValueError(
@@ -128,8 +146,33 @@ class SGD:
         feeder = feeding if isinstance(feeding, DataFeeder) else (
             DataFeeder(feeding) if feeding else None)
 
+        def resolve(slot, extras, feed):
+            kind, key = slot
+            return feed.get(key) if kind == "feed" else extras[key]
+
+        def update_evaluators(extras, feed):
+            for spec, slot, eslots in zip(self.evaluators, self._eval_slots,
+                                          self._eval_extra_slots):
+                lab = feed.get(spec.label.name) if spec.label is not None else None
+                wgt = feed.get(spec.weight.name) if spec.weight is not None else None
+                extra = {kw: resolve(s, extras, feed)
+                         for kw, s in eslots.items()}
+                spec.update(resolve(slot, extras, feed), lab, wgt,
+                            extra=extra)
+
+        def eval_log_suffix():
+            parts = []
+            for spec in self.evaluators:
+                r = spec.result()
+                if r is not None:
+                    parts.append(f"{spec.name}={r:.5f}" if isinstance(r, float)
+                                 else f"{spec.name}={r}")
+            return (" Eval: " + " ".join(parts)) if parts else ""
+
         for pass_id in range(num_passes):
             event_handler(events.BeginPass(pass_id))
+            for spec in self.evaluators:
+                spec.reset()
             batch_reader = reader
             if buffered_batches:
                 batch_reader = reader_mod.buffered(reader, buffered_batches)
@@ -157,19 +200,23 @@ class SGD:
                 cost_sum = cost_sum + cost
                 n_batches += 1
                 window.append(cost)
+                if self.evaluators:
+                    update_evaluators(extras, feed)
                 if log_period and (batch_id + 1) % log_period == 0:
                     c = float(jnp.mean(jnp.stack(window)))
                     window = []
                     dt = (time.time() - t0) / log_period
-                    logger.info("Pass %d Batch %d Cost %.5f (%.1f ms/batch)",
-                                pass_id, batch_id + 1, c, dt * 1e3)
+                    logger.info("Pass %d Batch %d Cost %.5f (%.1f ms/batch)%s",
+                                pass_id, batch_id + 1, c, dt * 1e3,
+                                eval_log_suffix())
                     t0 = time.time()
                 event_handler(events.EndIteration(
                     pass_id, batch_id, cost=cost,
                     evaluator_results={f"extra_{i}": e
                                        for i, e in enumerate(extras)}))
             pass_cost = float(cost_sum) / n_batches if n_batches else float("nan")
-            logger.info("Pass %d done, mean cost %.5f", pass_id, pass_cost)
+            logger.info("Pass %d done, mean cost %.5f%s", pass_id, pass_cost,
+                        eval_log_suffix())
             if test_reader is not None and (
                     not test_period or (pass_id + 1) % test_period == 0):
                 tc = self.test(test_reader, feeding=feeder)
