@@ -1,0 +1,144 @@
+"""Python side of the C inference API (reference paddle/capi/).
+
+The reference's C API wraps GradientMachine for embedding into C/C++ apps
+(capi/gradient_machine.h:36-59); its trainer embeds Python for config
+parsing (utils/PythonUtil.cpp).  The TPU-native C API mirrors both ideas:
+libpaddle_tpu_capi.so (native/src/capi.cpp) embeds CPython and calls into
+this module, which builds the topology from a Python config file and runs
+jitted inference on the default JAX device.
+
+The config file is executed and must expose the output layer(s) as a
+module-level `predict` LayerOutput (or set `__outputs__` = [layers]).  The
+parameter file is a merged model (trainer.checkpoint.merge_model).
+"""
+
+import os
+import traceback
+
+import numpy as np
+
+
+def _honor_jax_platforms_env():
+    """A sitecustomize hook may pin jax_platforms at interpreter start (e.g.
+    to a remote TPU); for the embedded C API the JAX_PLATFORMS env var is
+    authoritative, so re-assert it at the config level."""
+    plats = os.environ.get("JAX_PLATFORMS")
+    if plats:
+        try:
+            import jax
+            jax.config.update("jax_platforms", plats)
+        except Exception:
+            pass
+
+
+_machines = {}
+_next_id = [1]
+_last_error = [""]
+
+
+def last_error():
+    return _last_error[0]
+
+
+def _store_error(e):
+    _last_error[0] = "".join(
+        traceback.format_exception(type(e), e, e.__traceback__))
+    return -1
+
+
+def create(config_path, params_path):
+    """Build an inference machine; returns handle id (>0) or -1."""
+    try:
+        _honor_jax_platforms_env()
+        import jax.numpy as jnp
+        from paddle_tpu.layers.graph import LayerOutput
+        from paddle_tpu.trainer.checkpoint import load_merged
+        from paddle_tpu.trainer.trainer import Inferencer
+
+        ns = {"__name__": "__paddle_tpu_config__"}
+        with open(config_path) as f:
+            exec(compile(f.read(), config_path, "exec"), ns)
+        outs = ns.get("__outputs__")
+        if outs is None:
+            outs = ns.get("predict")
+        if outs is None:
+            outs = [v for v in ns.values() if isinstance(v, LayerOutput)][-1:]
+        if not outs:
+            raise ValueError(
+                f"{config_path} defines no output layer (set `predict = "
+                "<LayerOutput>` or `__outputs__ = [...]`)")
+        params, model_state, _meta = load_merged(params_path)
+        inf = Inferencer(outs, params, model_state)
+        mid = _next_id[0]
+        _next_id[0] += 1
+        _machines[mid] = {"inf": inf, "feed": {}, "outs": None}
+        return mid
+    except Exception as e:  # noqa: BLE001 - crosses the C ABI
+        return _store_error(e)
+
+
+def set_input_dense(mid, name, arr):
+    try:
+        _machines[mid]["feed"][name] = np.asarray(arr, np.float32)
+        return 0
+    except Exception as e:
+        return _store_error(e)
+
+
+def set_input_ids(mid, name, ids, lengths=None):
+    try:
+        ids = np.asarray(ids, np.int32)
+        if lengths is not None:
+            from paddle_tpu.core.sequence import SequenceBatch
+            import jax.numpy as jnp
+            _machines[mid]["feed"][name] = SequenceBatch(
+                data=jnp.asarray(ids), lengths=jnp.asarray(
+                    np.asarray(lengths, np.int32)))
+        else:
+            _machines[mid]["feed"][name] = ids
+        return 0
+    except Exception as e:
+        return _store_error(e)
+
+
+def run(mid):
+    """Run forward; returns number of outputs or -1."""
+    try:
+        m = _machines[mid]
+        out = m["inf"].infer(dict(m["feed"]))
+        outs = out if isinstance(out, tuple) else (out,)
+        arrs = []
+        for o in outs:
+            data = o.data if hasattr(o, "data") else o
+            arrs.append(np.asarray(data, np.float32))
+        m["outs"] = arrs
+        return len(arrs)
+    except Exception as e:
+        return _store_error(e)
+
+
+def output_shape(mid, idx):
+    """[rows, cols] with trailing dims flattened; 0-d outputs are [1, 1]."""
+    try:
+        a = _machines[mid]["outs"][idx]
+        if a.ndim == 0:
+            return [1, 1]
+        return [int(a.shape[0]), int(np.prod(a.shape[1:], dtype=np.int64))]
+    except Exception as e:
+        _store_error(e)
+        return [-1, -1]
+
+
+def get_output(mid, idx):
+    """Returns the output as flat float32 bytes."""
+    try:
+        a = _machines[mid]["outs"][idx]
+        return np.ascontiguousarray(a, np.float32).tobytes()
+    except Exception as e:
+        _store_error(e)
+        return b""
+
+
+def destroy(mid):
+    _machines.pop(mid, None)
+    return 0

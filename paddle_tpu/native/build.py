@@ -18,7 +18,38 @@ def build(verbose=True):
     return out
 
 
+def _python_flags():
+    """Embed flags for THE RUNNING interpreter (a PATH python3-config could
+    belong to a different version/ABI than the one importing paddle_tpu)."""
+    import sysconfig
+    inc = ["-I" + sysconfig.get_path("include")]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        f"{sys.version_info.major}.{sys.version_info.minor}"
+    return inc, ([f"-L{libdir}"] if libdir else []) + [f"-lpython{ver}",
+                                                      "-ldl", "-lm"]
+
+
+def build_capi(verbose=True):
+    """C inference API (embeds CPython; reference paddle/capi role)."""
+    src = os.path.join(_DIR, "src", "capi.cpp")
+    out = os.path.join(_DIR, "libpaddle_tpu_capi.so")
+    inc, ld = _python_flags()
+    cmd = (["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-Wall", src]
+           + inc + ["-o", out] + ld)
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.check_call(cmd)
+    return out
+
+
+def capi_header_dir():
+    return os.path.join(_DIR, "include")
+
+
 if __name__ == "__main__":
     path = build()
+    print("built", path)
+    path = build_capi()
     print("built", path)
     sys.exit(0)

@@ -1,0 +1,50 @@
+/* Minimal C deployment example (reference capi/examples/model_inference/
+ * dense): load a trained model and classify one batch.
+ *
+ * Build:
+ *   gcc infer_dense.c -I../include -L.. -lpaddle_tpu_capi \
+ *       -Wl,-rpath,.. -o infer_dense
+ * Run:
+ *   ./infer_dense <repo_root> <config.py> <model.npz>
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <repo_root> <config.py> <model.npz>\n",
+            argv[0]);
+    return 2;
+  }
+  if (pt_capi_init(argv[1]) != 0) {
+    fprintf(stderr, "init failed: %s\n", pt_capi_last_error());
+    return 1;
+  }
+  int64_t m = pt_capi_create(argv[2], argv[3]);
+  if (m < 0) {
+    fprintf(stderr, "create failed: %s\n", pt_capi_last_error());
+    return 1;
+  }
+
+  float input[2 * 4] = {1.f, 0.f, 0.f, 0.f,
+                        0.f, 0.f, 0.f, 1.f};
+  if (pt_capi_set_input_dense(m, "x", input, 2, 4) != 0 ||
+      pt_capi_run(m) < 1) {
+    fprintf(stderr, "forward failed: %s\n", pt_capi_last_error());
+    return 1;
+  }
+  int64_t rows = 0, cols = 0;
+  pt_capi_output_shape(m, 0, &rows, &cols);
+  float* out = (float*)malloc(sizeof(float) * rows * cols);
+  pt_capi_get_output(m, 0, out, rows * cols);
+  for (int64_t i = 0; i < rows; ++i) {
+    printf("row %lld:", (long long)i);
+    for (int64_t j = 0; j < cols; ++j) printf(" %.4f", out[i * cols + j]);
+    printf("\n");
+  }
+  free(out);
+  pt_capi_destroy(m);
+  return 0;
+}

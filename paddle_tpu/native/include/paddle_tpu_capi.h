@@ -1,0 +1,63 @@
+/* C inference API for paddle_tpu (reference: paddle/capi/
+ * gradient_machine.h, matrix.h, arguments.h, error.h — collapsed to a
+ * handle-based create/set/run/get surface; the compute runs on the default
+ * JAX/XLA device behind an embedded CPython).
+ *
+ * Usage (see native/examples/infer_dense.c):
+ *   pt_capi_init("/path/to/repo");            // adds repo to sys.path
+ *   int64_t m = pt_capi_create("config.py", "model.npz");
+ *   pt_capi_set_input_dense(m, "img", data, rows, cols);
+ *   int n_out = pt_capi_run(m);
+ *   int64_t r, c; pt_capi_output_shape(m, 0, &r, &c);
+ *   pt_capi_get_output(m, 0, buf, r * c);
+ *   pt_capi_destroy(m);
+ */
+#ifndef PADDLE_TPU_CAPI_H
+#define PADDLE_TPU_CAPI_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Initialize the embedded interpreter; extra_sys_path (may be NULL) is
+ * prepended to sys.path.  Returns 0 on success. */
+int pt_capi_init(const char* extra_sys_path);
+
+/* Human-readable description of the last failure. */
+const char* pt_capi_last_error(void);
+
+/* Build an inference machine from a Python config file (defines `predict`
+ * or `__outputs__`) and a merged model file (trainer.checkpoint.
+ * merge_model).  Returns a handle > 0, or -1. */
+int64_t pt_capi_create(const char* config_path, const char* params_path);
+
+/* Set a dense float32 input [rows, cols] for data layer `name`. */
+int pt_capi_set_input_dense(int64_t h, const char* name, const float* data,
+                            int64_t rows, int64_t cols);
+
+/* Set integer ids: cols == 0 -> plain [rows] ids; cols > 0 -> padded
+ * sequence batch [rows, cols] with per-row lengths (lengths may be NULL
+ * for full-length rows). */
+int pt_capi_set_input_ids(int64_t h, const char* name, const int32_t* ids,
+                          int64_t rows, int64_t cols,
+                          const int32_t* lengths);
+
+/* Run forward.  Returns the number of outputs, or -1. */
+int pt_capi_run(int64_t h);
+
+/* Output idx shape as [rows, cols] (trailing dims flattened into cols). */
+int pt_capi_output_shape(int64_t h, int idx, int64_t* rows, int64_t* cols);
+
+/* Copy output idx (float32) into buf; capacity in floats.  Returns the
+ * number of floats written, or -1. */
+int pt_capi_get_output(int64_t h, int idx, float* buf, int64_t capacity);
+
+int pt_capi_destroy(int64_t h);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_CAPI_H */

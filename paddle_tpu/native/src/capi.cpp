@@ -1,0 +1,279 @@
+// C inference API: embed CPython, drive paddle_tpu.capi_backend.
+//
+// TPU-native counterpart of the reference's deployment C API
+// (capi/gradient_machine.h:36-59 paddle_gradient_machine_create_for_
+// inference/forward, capi/matrix.h, capi/error.h), combined with the
+// reference's own embedded-Python precedent (utils/PythonUtil.cpp:48
+// callPythonFunc).  The XLA runtime stays behind JAX; this shim gives
+// C/C++ applications a stable ABI: create(config, merged_params) ->
+// set inputs -> run -> read outputs.
+//
+// Thread-safety: every entry point takes the GIL (PyGILState_Ensure), so
+// the library is safe to call from multiple native threads; compute runs
+// on the default JAX device.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::mutex g_init_mu;
+bool g_we_initialized = false;
+std::string g_last_error;
+
+PyObject* backend() {  // borrowed-style cached module ref (owned here)
+  static PyObject* mod = nullptr;
+  if (!mod) {
+    mod = PyImport_ImportModule("paddle_tpu.capi_backend");
+    if (!mod) {
+      PyErr_Print();
+      g_last_error = "cannot import paddle_tpu.capi_backend (is the repo "
+                     "root on PYTHONPATH?)";
+    }
+  }
+  return mod;
+}
+
+void capture_py_error() {
+  PyObject* mod = backend();
+  if (!mod) return;
+  PyObject* fn = PyObject_GetAttrString(mod, "last_error");
+  if (!fn) return;
+  PyObject* s = PyObject_CallObject(fn, nullptr);
+  Py_DECREF(fn);
+  if (s && PyUnicode_Check(s)) g_last_error = PyUnicode_AsUTF8(s);
+  Py_XDECREF(s);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Initialize the embedded interpreter (no-op when the host process already
+// runs Python, e.g. tests).  extra_sys_path: repo root, may be NULL.
+int pt_capi_init(const char* extra_sys_path) {
+  std::lock_guard<std::mutex> lock(g_init_mu);
+  bool just_initialized = false;
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = just_initialized = true;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 0;
+  if (extra_sys_path && *extra_sys_path) {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* p = PyUnicode_FromString(extra_sys_path);
+    if (!sys_path || !p || PyList_Insert(sys_path, 0, p) != 0) rc = -1;
+    Py_XDECREF(p);
+  }
+  if (rc == 0 && !backend()) rc = -1;
+  PyGILState_Release(gil);
+  if (just_initialized) {
+    // Py_InitializeEx leaves this thread owning the GIL; release it so
+    // other native threads' PyGILState_Ensure can acquire it (the
+    // multi-thread guarantee in the file header).
+    PyEval_SaveThread();
+  }
+  return rc;
+}
+
+const char* pt_capi_last_error() { return g_last_error.c_str(); }
+
+// Build a machine from a Python config file + merged params file.
+// Returns handle > 0, or -1 (see pt_capi_last_error).
+int64_t pt_capi_create(const char* config_path, const char* params_path) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int64_t handle = -1;
+  PyObject* mod = backend();
+  if (mod) {
+    PyObject* r = PyObject_CallMethod(mod, "create", "ss", config_path,
+                                      params_path);
+    if (r && PyLong_Check(r)) handle = PyLong_AsLongLong(r);
+    if (!r) PyErr_Print();
+    Py_XDECREF(r);
+    if (handle < 0) capture_py_error();
+  }
+  PyGILState_Release(gil);
+  return handle;
+}
+
+// Dense input [rows, cols] float32 for data layer `name`.
+int pt_capi_set_input_dense(int64_t h, const char* name, const float* data,
+                            int64_t rows, int64_t cols) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mod = backend();
+  if (mod) {
+    PyObject* bytes = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(data),
+        static_cast<Py_ssize_t>(rows * cols * sizeof(float)));
+    PyObject* np = PyImport_ImportModule("numpy");
+    PyObject* arr = nullptr;
+    if (np && bytes) {
+      PyObject* flat = PyObject_CallMethod(np, "frombuffer", "Os", bytes,
+                                           "float32");
+      if (flat) {
+        arr = PyObject_CallMethod(flat, "reshape", "(LL)",
+                                  static_cast<long long>(rows),
+                                  static_cast<long long>(cols));
+        Py_DECREF(flat);
+      }
+    }
+    if (arr) {
+      PyObject* r = PyObject_CallMethod(mod, "set_input_dense", "LsO",
+                                        static_cast<long long>(h), name, arr);
+      if (r && PyLong_Check(r)) rc = static_cast<int>(PyLong_AsLong(r));
+      if (!r) PyErr_Print();
+      Py_XDECREF(r);
+    }
+    Py_XDECREF(arr);
+    Py_XDECREF(np);
+    Py_XDECREF(bytes);
+    if (rc != 0) capture_py_error();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// Integer-id input [rows] (lengths == NULL) or a padded id sequence batch
+// [rows, cols] with per-row lengths.
+int pt_capi_set_input_ids(int64_t h, const char* name, const int32_t* ids,
+                          int64_t rows, int64_t cols,
+                          const int32_t* lengths) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mod = backend();
+  if (mod) {
+    PyObject* np = PyImport_ImportModule("numpy");
+    PyObject* bytes = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(ids),
+        static_cast<Py_ssize_t>(rows * (cols > 0 ? cols : 1) *
+                                sizeof(int32_t)));
+    PyObject* arr = nullptr;
+    if (np && bytes) {
+      PyObject* flat = PyObject_CallMethod(np, "frombuffer", "Os", bytes,
+                                           "int32");
+      if (flat) {
+        if (cols > 0) {
+          arr = PyObject_CallMethod(flat, "reshape", "(LL)",
+                                    static_cast<long long>(rows),
+                                    static_cast<long long>(cols));
+          Py_DECREF(flat);
+        } else {
+          arr = flat;
+        }
+      }
+    }
+    PyObject* lens = Py_None;
+    Py_INCREF(Py_None);
+    if (lengths && cols > 0) {
+      PyObject* lb = PyBytes_FromStringAndSize(
+          reinterpret_cast<const char*>(lengths),
+          static_cast<Py_ssize_t>(rows * sizeof(int32_t)));
+      if (np && lb) {
+        Py_DECREF(lens);
+        lens = PyObject_CallMethod(np, "frombuffer", "Os", lb, "int32");
+      }
+      Py_XDECREF(lb);
+    }
+    if (arr && lens) {
+      PyObject* r = PyObject_CallMethod(mod, "set_input_ids", "LsOO",
+                                        static_cast<long long>(h), name, arr,
+                                        lens);
+      if (r && PyLong_Check(r)) rc = static_cast<int>(PyLong_AsLong(r));
+      if (!r) PyErr_Print();
+      Py_XDECREF(r);
+    }
+    Py_XDECREF(lens);
+    Py_XDECREF(arr);
+    Py_XDECREF(np);
+    Py_XDECREF(bytes);
+    if (rc != 0) capture_py_error();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// Run forward.  Returns the number of outputs, or -1.
+int pt_capi_run(int64_t h) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mod = backend();
+  if (mod) {
+    PyObject* r = PyObject_CallMethod(mod, "run", "L",
+                                      static_cast<long long>(h));
+    if (r && PyLong_Check(r)) rc = static_cast<int>(PyLong_AsLong(r));
+    if (!r) PyErr_Print();
+    Py_XDECREF(r);
+    if (rc < 0) capture_py_error();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// Shape of output idx as [rows, cols] (row-flattened trailing dims).
+int pt_capi_output_shape(int64_t h, int idx, int64_t* rows, int64_t* cols) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mod = backend();
+  if (mod) {
+    PyObject* r = PyObject_CallMethod(mod, "output_shape", "Li",
+                                      static_cast<long long>(h), idx);
+    if (r && PySequence_Check(r) && PySequence_Size(r) == 2) {
+      PyObject* a = PySequence_GetItem(r, 0);
+      PyObject* b = PySequence_GetItem(r, 1);
+      *rows = PyLong_AsLongLong(PyNumber_Long(a));
+      *cols = PyLong_AsLongLong(PyNumber_Long(b));
+      Py_XDECREF(a);
+      Py_XDECREF(b);
+      rc = (*rows >= 0) ? 0 : -1;
+    }
+    if (!r) PyErr_Print();
+    Py_XDECREF(r);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// Copy output idx into buf (float32, rows*cols elements).
+int pt_capi_get_output(int64_t h, int idx, float* buf, int64_t capacity) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mod = backend();
+  if (mod) {
+    PyObject* r = PyObject_CallMethod(mod, "get_output", "Li",
+                                      static_cast<long long>(h), idx);
+    if (r && PyBytes_Check(r)) {
+      Py_ssize_t n = PyBytes_Size(r);
+      if (n <= capacity * static_cast<Py_ssize_t>(sizeof(float))) {
+        std::memcpy(buf, PyBytes_AsString(r), n);
+        rc = static_cast<int>(n / sizeof(float));
+      } else {
+        g_last_error = "output buffer too small";
+      }
+    }
+    if (!r) PyErr_Print();
+    Py_XDECREF(r);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int pt_capi_destroy(int64_t h) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* mod = backend();
+  if (mod) {
+    PyObject* r = PyObject_CallMethod(mod, "destroy", "L",
+                                      static_cast<long long>(h));
+    Py_XDECREF(r);
+  }
+  PyGILState_Release(gil);
+  return 0;
+}
+
+}  // extern "C"

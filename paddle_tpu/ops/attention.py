@@ -37,11 +37,22 @@ def attention_context(scores, values: SequenceBatch):
     return jnp.einsum("bt,btd->bd", w, values.data)
 
 
-def dot_product_attention(q, k, v, mask=None, scale=None, causal=False):
+def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
+                          use_flash=None):
     """q: [B, H, Tq, Dh], k/v: [B, H, Tk, Dh] -> [B, H, Tq, Dh].
 
     Softmax in f32 (TPU numerics), logits computed on the MXU in bf16.
+    On TPU, unmasked block-aligned shapes route to the Pallas flash
+    kernel (ops.pallas.flash_attention) — O(T) HBM instead of O(T^2).
     """
+    if use_flash is None:
+        from paddle_tpu.ops import pallas as pk
+        use_flash = (pk.use_pallas() and mask is None
+                     and q.shape[2] % 128 == 0 and k.shape[2] % 128 == 0
+                     and (not causal or q.shape[2] == k.shape[2]))
+    if use_flash:
+        from paddle_tpu.ops.pallas import flash_attention
+        return flash_attention(q, k, v, scale=scale, causal=causal)
     dh = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(float(dh))
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,

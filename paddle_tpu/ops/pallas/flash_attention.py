@@ -1,0 +1,306 @@
+"""Flash attention for TPU (Pallas): forward + backward kernels, custom_vjp.
+
+Replaces the materialized [Tq, Tk] softmax of ops.attention.dot_product_
+attention for long sequences: logits are computed block-by-block in VMEM
+with a running (max, sum) softmax, so HBM traffic is O(T*D) not O(T^2)
+(the reference's CUDA layer has no equivalent — pre-transformer era; this
+is the TPU-native hot-op treatment its hl_lstm fused kernels got).
+
+Streaming layout: grid (B*H, Tq/BLK_Q, Tk/BLK_K) with the kv dimension
+innermost — TPU grids run sequentially per core, so Pallas pipelines the
+per-block HBM->VMEM copies while VMEM scratch (acc, running max/sum)
+persists across the kv iterations of one q block; only one (q, k, v)
+block triple is resident at a time, so VMEM use is O(BLK^2) independent
+of sequence length.  Causal blocks entirely above the diagonal are
+skipped with @pl.when.  f32 accumulation throughout.
+
+Backward = FlashAttention-2: delta = rowsum(do * o) precomputed (XLA);
+one kernel streams q blocks per kv block for dk/dv, one streams kv blocks
+per q block for dq, both recomputing p from (q, k, lse).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+
+
+# ------------------------------------------------------------------ forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, blk_q, blk_k, scale, causal):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: the block intersects the lower triangle iff
+    # qi*blk_q + blk_q - 1 >= ki*blk_k
+    needed = (qi * blk_q + blk_q - 1 >= ki * blk_k) if causal else True
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [blk_q, blk_k]
+        if causal:
+            rows = jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0) + qi * blk_q
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1) + ki * blk_k
+            s = jnp.where(rows >= cols, s, _NEG)
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+
+
+def _fwd(q, k, v, scale, causal, blk_q, blk_k, interpret):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+
+    kernel = functools.partial(_fwd_kernel, blk_q=blk_q, blk_k=blk_k,
+                               scale=scale, causal=causal)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, tq // blk_q, tk // blk_k),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ----------------------------------------------------------------- backward
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, blk_q, blk_k, scale,
+                    causal):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    needed = (qi * blk_q + blk_q - 1 >= ki * blk_k) if causal else True
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [blk_q, blk_k]
+        if causal:
+            rows = jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0) + qi * blk_q
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1) + ki * blk_k
+            s = jnp.where(rows >= cols, s, _NEG)
+        p = jnp.exp(s - lse[:, None])
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [blk_k, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [blk_q, blk_k]
+        ds = p * (dp - delta[:, None]) * scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [blk_k, d]
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, blk_q, blk_k, scale, causal):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    needed = (qi * blk_q + blk_q - 1 >= ki * blk_k) if causal else True
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0) + qi * blk_q
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1) + ki * blk_k
+            s = jnp.where(rows >= cols, s, _NEG)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd(scale, causal, blk_q, blk_k, interpret, res, g):
+    q, k, v, o, lse = res
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, blk_q=blk_q,
+                                   blk_k=blk_k, scale=scale, causal=causal)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, tk // blk_k, tq // blk_q),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, blk_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, d), jnp.float32),
+            pltpu.VMEM((blk_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, blk_q=blk_q, blk_k=blk_k,
+                                  scale=scale, causal=causal)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, tq // blk_q, tk // blk_k),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# -------------------------------------------------------------- public API
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhtd(q, k, v, scale, causal, blk_q, blk_k, interpret):
+    o, _ = _fwd(q, k, v, scale, causal, blk_q, blk_k, interpret)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, blk_q, blk_k, interpret):
+    o, lse = _fwd(q, k, v, scale, causal, blk_q, blk_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+_flash_bhtd.defvjp(_flash_fwd_rule, _bwd)
+
+
+def flash_attention(q, k, v, scale=None, causal=False, block_q=128,
+                    block_k=128, interpret=None):
+    """q: [B, H, Tq, D], k/v: [B, H, Tk, D] -> [B, H, Tq, D].
+
+    Fast path requires Tq/Tk to be multiples of the block size (the model
+    zoo pads/buckets sequences to 128-multiples for exactly this reason);
+    other shapes fall back to the masked XLA implementation.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    blk_q = min(block_q, tq)
+    blk_k = min(block_k, tk)
+    # causal block indexing assumes aligned sequence starts (tq == tk)
+    if (causal and tq != tk) or tq % blk_q or tk % blk_k:
+        from paddle_tpu.ops import attention as attn
+        return attn.dot_product_attention(q, k, v, scale=scale,
+                                          causal=causal, use_flash=False)
+
+    qf = q.reshape(b * h, tq, d)
+    kf = k.reshape(b * h, tk, d)
+    vf = v.reshape(b * h, tk, d)
+    o = _flash_bhtd(qf, kf, vf, scale, causal, blk_q, blk_k, interpret)
+    return o.reshape(b, h, tq, d)

@@ -12,10 +12,14 @@ from paddle_tpu.parallel.sharding import (
     ShardingRules, megatron_rules, param_shardings, shard_params,
     batch_shardings, replicated_shardings, valid_spec,
 )
+from paddle_tpu.parallel.distributed import (
+    init_distributed, is_coordinator, global_mesh, barrier,
+)
 
 __all__ = [
     "Mesh", "MeshConfig", "make_mesh", "single_device_mesh",
     "AXIS_DATA", "AXIS_MODEL", "AXIS_SEQ", "AXIS_EXPERT", "ALL_AXES",
     "ShardingRules", "megatron_rules", "param_shardings", "shard_params",
     "batch_shardings", "replicated_shardings", "valid_spec",
+    "init_distributed", "is_coordinator", "global_mesh", "barrier",
 ]

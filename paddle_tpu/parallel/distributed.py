@@ -1,0 +1,97 @@
+"""Multi-host distributed runtime.
+
+Replaces the reference's distributed backend bring-up — paddle_pserver
+processes + --pservers/--trainer_id/--num_gradient_servers wiring
+(pserver/ParameterServerController.cpp:65, trainer/TrainerMain.cpp:39-44,
+scripts/cluster_train/paddle.py:101-176) — with JAX's multi-controller
+SPMD runtime: every host runs the same program, jax.distributed.initialize
+connects them, and the global mesh spans all hosts' devices.  Gradient
+exchange is the psum XLA inserts from shardings: over ICI within a slice,
+over DCN between slices — no parameter server, no sockets to manage.
+
+Env-var contract (also used by the cluster launcher):
+  PADDLE_TPU_COORDINATOR   host:port of process 0
+  PADDLE_TPU_NUM_PROCESSES world size
+  PADDLE_TPU_PROCESS_ID    this process's rank
+(standard TPU-pod deployments can omit all three: jax.distributed.
+initialize() autodetects from the TPU metadata server.)
+"""
+
+import os
+from typing import Optional
+
+import numpy as np
+import jax
+
+from paddle_tpu.parallel.mesh import ALL_AXES, MeshConfig, Mesh
+from paddle_tpu.utils.logging import logger
+
+_initialized = [False]
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids=None):
+    """Connect this host into the multi-host runtime (idempotent).
+
+    With no arguments, reads the PADDLE_TPU_* env vars; with none set on a
+    TPU pod, defers to JAX's autodetection."""
+    if _initialized[0]:
+        return
+    coordinator = coordinator or os.environ.get("PADDLE_TPU_COORDINATOR")
+    if num_processes is None and "PADDLE_TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["PADDLE_TPU_NUM_PROCESSES"])
+    if process_id is None and "PADDLE_TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PADDLE_TPU_PROCESS_ID"])
+    kw = {}
+    if coordinator:
+        kw["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    if local_device_ids is not None:
+        kw["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kw)
+    _initialized[0] = True
+    logger.info("distributed: process %d/%d, %d local + %d global devices",
+                jax.process_index(), jax.process_count(),
+                jax.local_device_count(), jax.device_count())
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0
+
+
+def global_mesh(config: Optional[MeshConfig] = None,
+                dcn_data_parallel: Optional[int] = None) -> Mesh:
+    """Mesh over ALL hosts' devices.
+
+    dcn_data_parallel: number of slices connected by DCN (defaults to
+    jax.process_count() on multi-slice deployments when set); the 'data'
+    axis is laid out so its outer factor crosses DCN and everything else
+    stays on ICI (hybrid mesh, scaling-book recipe).
+    """
+    config = config or MeshConfig()
+    if dcn_data_parallel and dcn_data_parallel > 1:
+        from jax.experimental import mesh_utils
+        n = jax.device_count()
+        shape = config.resolve(n)
+        ici_shape = (shape[0] // dcn_data_parallel,) + shape[1:]
+        dcn_shape = (dcn_data_parallel, 1, 1, 1)
+        devices = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape)
+        return Mesh(devices, ALL_AXES)
+    shape = config.resolve(jax.device_count())
+    arr = np.asarray(jax.devices()).reshape(shape)
+    return Mesh(arr, ALL_AXES)
+
+
+def barrier(name: str = "barrier"):
+    """Host-level sync point (the reference's waitPassStart/Finish RPCs,
+    ParameterService.proto:90-114)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)

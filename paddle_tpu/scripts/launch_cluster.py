@@ -1,0 +1,80 @@
+"""Multi-host cluster launcher.
+
+Reference: paddle/scripts/cluster_train/paddle.py:101-176 — a fabric/SSH
+launcher that started `paddle pserver` on every node then `paddle train
+--trainer_id=i --pservers=...`.  The TPU-native launcher has no pserver
+role: it starts the SAME training command on every host with the
+PADDLE_TPU_* rendezvous env vars (parallel.distributed contract); host 0
+is the coordinator.  On Cloud-TPU-style deployments where each host
+already knows the pod topology, prefer the platform's own fan-out
+(gcloud ... --worker=all / GKE JobSet) and skip this launcher entirely —
+jax.distributed autodetects there.
+
+Usage:
+  python -m paddle_tpu.scripts.launch_cluster \
+      --hosts host1,host2,host3,host4 --port 8476 \
+      -- python -m paddle_tpu.trainer.cli train --config conf.py ...
+
+Requires passwordless ssh to each host and the repo available at the same
+path everywhere (reference conf.py HOSTS assumption).
+"""
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+
+
+def build_ssh_cmd(host, rank, args, command):
+    env = {
+        "PADDLE_TPU_COORDINATOR": f"{args.hosts[0]}:{args.port}",
+        "PADDLE_TPU_NUM_PROCESSES": str(len(args.hosts)),
+        "PADDLE_TPU_PROCESS_ID": str(rank),
+    }
+    env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+    remote = f"cd {shlex.quote(args.workdir)} && {env_str} {command}"
+    return ["ssh", "-o", "BatchMode=yes", host, remote]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.launch_cluster",
+        usage="%(prog)s --hosts h1,h2 [--port P] [--workdir D] -- command…")
+    parser.add_argument("--hosts", required=True,
+                        help="comma-separated host list; first = coordinator")
+    parser.add_argument("--port", type=int, default=8476)
+    parser.add_argument("--workdir", default=os.getcwd())
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="training command to run on every host")
+    args = parser.parse_args(argv)
+    args.hosts = [h.strip() for h in args.hosts.split(",") if h.strip()]
+    cmd_parts = list(args.command)
+    if cmd_parts and cmd_parts[0] == "--":
+        cmd_parts = cmd_parts[1:]
+    command = " ".join(shlex.quote(c) for c in cmd_parts)
+    if not command:
+        parser.error("missing training command after --")
+
+    procs = []
+    try:
+        for rank, host in enumerate(args.hosts):
+            cmd = build_ssh_cmd(host, rank, args, command)
+            print(f"[launch] rank {rank} @ {host}: {command}", flush=True)
+            procs.append(subprocess.Popen(cmd))
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        return rc
+    except KeyboardInterrupt:
+        # reference launcher killed jobs over SSH (paddle.py:52-60)
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            p.wait()
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())

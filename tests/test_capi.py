@@ -1,0 +1,113 @@
+"""C inference API tests (reference capi/tests + capi/examples role):
+train tiny model -> merge_model -> drive libpaddle_tpu_capi.so from an
+actual C program (subprocess), and in-process via ctypes."""
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu.layers as L
+from paddle_tpu import optim
+from paddle_tpu.layers.graph import Topology, reset_names
+from paddle_tpu.trainer.checkpoint import save_checkpoint, merge_model
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE = os.path.join(_ROOT, "paddle_tpu", "native")
+_LIB = os.path.join(_NATIVE, "libpaddle_tpu_capi.so")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(_LIB),
+    reason="capi lib not built (python -m paddle_tpu.native.build)")
+
+
+_CONFIG = """
+import paddle_tpu.layers as L
+from paddle_tpu.layers.graph import reset_names
+reset_names()
+x = L.data_layer("x", size=4)
+h = L.fc_layer(x, size=8, act="tanh", name="h0")
+predict = L.fc_layer(h, size=2, act="softmax", name="out")
+"""
+
+
+@pytest.fixture(scope="module")
+def merged_model(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("capi")
+    reset_names()
+    x = L.data_layer("x", size=4)
+    h = L.fc_layer(x, size=8, act="tanh", name="h0")
+    y = L.fc_layer(h, size=2, act="softmax", name="out")
+    topo = Topology(y)
+    params = topo.init(jax.random.PRNGKey(0))
+    save_dir = str(tmp / "ckpt")
+    save_checkpoint(save_dir, 0, params, None, {})
+    model_path = str(tmp / "model.npz")
+    merge_model(save_dir, model_path)
+    config_path = str(tmp / "config.py")
+    with open(config_path, "w") as f:
+        f.write(_CONFIG)
+    # reference outputs for the C program's fixed input
+    import jax.numpy as jnp
+    inp = np.array([[1, 0, 0, 0], [0, 0, 0, 1]], np.float32)
+    ref = np.asarray(topo.apply(params, {"x": jnp.asarray(inp)},
+                                mode="test"))
+    return config_path, model_path, inp, ref
+
+
+def test_capi_ctypes_roundtrip(merged_model):
+    config_path, model_path, inp, ref = merged_model
+    lib = ctypes.CDLL(_LIB)
+    lib.pt_capi_create.restype = ctypes.c_int64
+    lib.pt_capi_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.pt_capi_last_error.restype = ctypes.c_char_p
+    assert lib.pt_capi_init(_ROOT.encode()) == 0
+    h = lib.pt_capi_create(config_path.encode(), model_path.encode())
+    assert h > 0, lib.pt_capi_last_error().decode()
+    flat = np.ascontiguousarray(inp)
+    rc = lib.pt_capi_set_input_dense(
+        ctypes.c_int64(h), b"x",
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int64(2), ctypes.c_int64(4))
+    assert rc == 0, lib.pt_capi_last_error().decode()
+    n = lib.pt_capi_run(ctypes.c_int64(h))
+    assert n == 1, lib.pt_capi_last_error().decode()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    assert lib.pt_capi_output_shape(ctypes.c_int64(h), 0,
+                                    ctypes.byref(rows),
+                                    ctypes.byref(cols)) == 0
+    assert (rows.value, cols.value) == ref.shape
+    buf = np.zeros(ref.shape, np.float32)
+    wrote = lib.pt_capi_get_output(
+        ctypes.c_int64(h), 0,
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ctypes.c_int64(buf.size))
+    assert wrote == buf.size
+    np.testing.assert_allclose(buf, ref, rtol=1e-5, atol=1e-6)
+    lib.pt_capi_destroy(ctypes.c_int64(h))
+
+
+def test_capi_from_c_program(merged_model, tmp_path):
+    """Compile and run the shipped C example against the trained model —
+    the reference's capi/examples/model_inference flow."""
+    config_path, model_path, inp, ref = merged_model
+    exe = str(tmp_path / "infer_dense")
+    src = os.path.join(_NATIVE, "examples", "infer_dense.c")
+    subprocess.check_call(
+        ["gcc", src, "-I" + os.path.join(_NATIVE, "include"),
+         "-L" + _NATIVE, "-lpaddle_tpu_capi",
+         "-Wl,-rpath," + _NATIVE, "-o", exe])
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([exe, _ROOT, config_path, model_path],
+                         capture_output=True, text=True, env=env,
+                         timeout=240)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.splitlines() if l.startswith("row")]
+    assert len(lines) == 2
+    got = np.array([[float(v) for v in l.split(":")[1].split()]
+                    for l in lines])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)

@@ -1,0 +1,75 @@
+"""Pallas kernel tests (interpret mode on CPU — the dual-backend
+differential discipline of SURVEY.md §4: kernel vs XLA reference)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.attention import dot_product_attention
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _qkv(np_rng, b=1, h=2, t=128, d=32, dtype=jnp.float32):
+    return (jnp.asarray(np_rng.randn(b, h, t, d), dtype),
+            jnp.asarray(np_rng.randn(b, h, t, d), dtype),
+            jnp.asarray(np_rng.randn(b, h, t, d), dtype))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference_fwd(np_rng, causal):
+    q, k, v = _qkv(np_rng)
+    ref = dot_product_attention(q, k, v, causal=causal, use_flash=False)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference_grads(np_rng, causal):
+    q, k, v = _qkv(np_rng)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention(
+            q, k, v, causal=causal, use_flash=False) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=causal, interpret=True) ** 2)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        scale = max(1e-9, float(jnp.max(jnp.abs(a))))
+        np.testing.assert_allclose(np.asarray(b) / scale,
+                                   np.asarray(a) / scale,
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_flash_multiblock_kv_loop(np_rng):
+    """T > block forces the in-kernel kv loop (multiple blocks each way)."""
+    q, k, v = _qkv(np_rng, t=256, d=16)
+    ref = dot_product_attention(q, k, v, causal=True, use_flash=False)
+    out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_fallback_on_ragged_shapes(np_rng):
+    """Non-block-multiple T falls back to the XLA path (still correct)."""
+    q, k, v = _qkv(np_rng, t=100)
+    ref = dot_product_attention(q, k, v, use_flash=False)
+    out = flash_attention(q, k, v, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_bf16_inputs(np_rng):
+    q, k, v = _qkv(np_rng, dtype=jnp.bfloat16)
+    ref = dot_product_attention(q.astype(jnp.float32),
+                                k.astype(jnp.float32),
+                                v.astype(jnp.float32), use_flash=False)
+    out = flash_attention(q, k, v, interpret=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=2e-2, atol=2e-2)
