@@ -1,0 +1,48 @@
+"""Linear regression introduction (reference demo/introduction
+trainer_config.py: y = wx + b on synthetic y = 2x + 0.3)."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import paddle_tpu.layers as L
+from paddle_tpu import optim
+from paddle_tpu.data import dense_vector
+from paddle_tpu.data import reader as reader_mod
+
+
+def _synthetic(n=1024, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, (n, 1)).astype(np.float32)
+    y = (2.0 * x + 0.3 + 0.05 * rng.randn(n, 1)).astype(np.float32)
+
+    def reader():
+        for i in range(n):
+            yield x[i], y[i]
+    return reader
+
+
+def get_config():
+    x = L.data_layer("x", size=1)
+    y = L.data_layer("y", size=1)
+    pred = L.fc_layer(x, size=1, act=None, name="fc")
+    cost = L.regression_cost(pred, y)
+    return {
+        "cost": cost,
+        "output": pred,
+        "optimizer": optim.Momentum(learning_rate=0.1, momentum=0.9),
+        "train_reader": reader_mod.batch(_synthetic(), 64),
+        "feeding": {"x": dense_vector(1), "y": dense_vector(1)},
+    }
+
+
+if __name__ == "__main__":
+    from paddle_tpu.trainer import SGD
+    cfg = get_config()
+    tr = SGD(cost=cfg["cost"], update_equation=cfg["optimizer"])
+    tr.train(cfg["train_reader"], num_passes=8, feeding=cfg["feeding"],
+             log_period=10)
+    w = np.asarray(tr.parameters["fc"]["w0"]).ravel()[0]
+    b = np.asarray(tr.parameters["fc"]["b"]).ravel()[0]
+    print(f"learned y = {w:.3f}x + {b:.3f} (target 2x + 0.3)")

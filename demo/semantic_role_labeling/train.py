@@ -1,0 +1,69 @@
+"""Semantic role labeling with a deep bidirectional LSTM + CRF (reference
+demo/semantic_role_labeling db_lstm: 8-layer alternating-direction LSTM
+over word/predicate/context features, CRF cost)."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu.layers as L
+from paddle_tpu import optim
+from paddle_tpu.data import integer_value_sequence
+from paddle_tpu.data import reader as reader_mod
+from paddle_tpu.data.datasets import conll05
+
+EMB = 32
+HID = 64
+DEPTH = 4   # reference uses 8; 4 keeps the demo quick
+
+
+def get_config():
+    num_labels = conll05.NUM_LABELS
+    words = L.data_layer("words", size=conll05.WORD_DICT, is_seq=True)
+    preds = L.data_layer("preds", size=conll05.PRED_DICT, is_seq=True)
+    label = L.data_layer("label", size=1, is_seq=True)
+
+    word_emb = L.embedding_layer(words, size=EMB)
+    pred_emb = L.embedding_layer(preds, size=EMB)
+    feats = L.mixed_layer(size=4 * HID, input=[
+        L.full_matrix_projection(word_emb),
+        L.full_matrix_projection(pred_emb),
+    ], act=None)
+
+    # alternating-direction stacked LSTM (db-LSTM)
+    lstm = L.lstmemory(feats, size=HID, reverse=False)
+    inputs = [feats, lstm]
+    for depth in range(1, DEPTH):
+        mix = L.mixed_layer(size=4 * HID, input=[
+            L.full_matrix_projection(inputs[-1]),
+            L.full_matrix_projection(inputs[-2]),
+        ], act=None)
+        lstm = L.lstmemory(mix, size=HID, reverse=(depth % 2 == 1))
+        inputs.append(mix)
+        inputs.append(lstm)
+
+    emission = L.mixed_layer(size=num_labels, input=[
+        L.full_matrix_projection(inputs[-2]),
+        L.full_matrix_projection(inputs[-1]),
+    ], act=None)
+    crf_cost = L.crf_layer(emission, label, size=num_labels, name="crf")
+    decoded = L.crf_decoding_layer(emission, size=num_labels,
+                                   param_name=crf_cost.cfg["param_name"])
+    return {
+        "cost": crf_cost,
+        "output": decoded,
+        "optimizer": optim.Adam(learning_rate=1e-3, clip_threshold=5.0),
+        "train_reader": reader_mod.batch(conll05.train(), 16),
+        "feeding": {
+            "words": integer_value_sequence(conll05.WORD_DICT),
+            "preds": integer_value_sequence(conll05.PRED_DICT),
+            "label": integer_value_sequence(num_labels),
+        },
+    }
+
+
+if __name__ == "__main__":
+    from paddle_tpu.trainer import SGD
+    cfg = get_config()
+    SGD(cost=cfg["cost"], update_equation=cfg["optimizer"]).train(
+        cfg["train_reader"], num_passes=2, feeding=cfg["feeding"],
+        log_period=20)

@@ -1,0 +1,49 @@
+"""word2vec / neural n-gram LM (reference demo/word2vec + imikolov
+dataset): 4-gram context -> shared embeddings -> hidden -> hsigmoid or
+softmax over the vocab."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import paddle_tpu.layers as L
+from paddle_tpu import optim
+from paddle_tpu.data import integer_value
+from paddle_tpu.data import reader as reader_mod
+from paddle_tpu.data.datasets import imikolov
+
+EMB = 32
+N = 5   # n-gram order
+
+
+def get_config(use_hsigmoid=True):
+    vocab = imikolov.WORD_DIM
+    words = [L.data_layer(f"w{i}", size=vocab) for i in range(N - 1)]
+    target = L.data_layer("target", size=1)
+    embs = [L.embedding_layer(w, size=EMB,
+                              param_attr={"name": "emb"}) for w in words]
+    ctx = L.concat_layer(embs)
+    hidden = L.fc_layer(ctx, size=128, act="sigmoid")
+    if use_hsigmoid:
+        cost = L.hsigmoid(hidden, target, num_classes=vocab)
+        output = hidden
+    else:
+        pred = L.fc_layer(hidden, size=vocab, act="softmax")
+        cost = L.classification_cost(pred, target)
+        output = pred
+    feeding = {f"w{i}": integer_value(vocab) for i in range(N - 1)}
+    feeding["target"] = integer_value(vocab)
+    return {
+        "cost": cost,
+        "output": output,
+        "optimizer": optim.AdaGrad(learning_rate=0.1),
+        "train_reader": reader_mod.batch(imikolov.train(n=N), 64),
+        "feeding": feeding,
+    }
+
+
+if __name__ == "__main__":
+    from paddle_tpu.trainer import SGD
+    cfg = get_config()
+    SGD(cost=cfg["cost"], update_equation=cfg["optimizer"]).train(
+        cfg["train_reader"], num_passes=2, feeding=cfg["feeding"],
+        log_period=50)

@@ -256,7 +256,16 @@ class Topology:
             impl = get_impl(node.layer_type)
             ins = [cache[id(i)] for i in node.inputs]
             p = params.get(self._param_key(node), {})
-            cache[id(node)] = impl.apply(ctx, node.cfg, p, *ins)
+            try:
+                cache[id(node)] = impl.apply(ctx, node.cfg, p, *ins)
+            except Exception as e:
+                # the reference dumps the active layer-name stack on FATAL
+                # (utils/CustomStackTrace.h, pushed NeuralNetwork.cpp:247);
+                # name the failing layer the same way
+                if hasattr(e, "add_note"):
+                    e.add_note(f"while applying layer {node.name!r} "
+                               f"(type {node.layer_type!r})")
+                raise
         outs = [cache[id(o)] for o in self.outputs]
         outs += [cache[id(o)] for o in extra_outputs if id(o) in cache]
         result = outs[0] if len(outs) == 1 else tuple(outs)

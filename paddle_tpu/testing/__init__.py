@@ -1,0 +1,6 @@
+"""Testing utilities (reference paddle/testing/ + the --job=checkgrad
+trainer mode and gserver/tests/LayerGradUtil.h discipline)."""
+
+from paddle_tpu.testing.gradcheck import check_topology_grads, check_grads
+
+__all__ = ["check_topology_grads", "check_grads"]

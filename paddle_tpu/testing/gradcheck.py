@@ -1,0 +1,79 @@
+"""Finite-difference gradient checking.
+
+Reference: the `--job=checkgrad` trainer mode (trainer/Trainer.cpp
+checkGradient) and the per-layer numerical sweeps of
+gserver/tests/test_LayerGrad.cpp + LayerGradUtil.{h,cpp} testLayerGrad:266
+(perturb parameters, compare analytic vs (f(x+h)-f(x-h))/2h).
+
+Here autodiff replaces hand-written backward passes, so this is a sanity
+harness for custom kernels/custom_vjp rules rather than a per-layer
+necessity — but the capability (and CLI job) is preserved.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def check_grads(loss_fn, params, eps=1e-3, rtol=2e-2, atol=1e-4,
+                max_elems_per_leaf=4, rng=None, raise_on_fail=True):
+    """Compare jax.grad(loss_fn)(params) against central differences on a
+    random subset of elements per parameter leaf.
+
+    Returns [(path, max_rel_err, ok)] covering EVERY leaf (the reference
+    checkgrad reports diffs across the whole model); with raise_on_fail an
+    AssertionError listing all failures is raised at the end."""
+    rng = rng or np.random.RandomState(0)
+    analytic = jax.grad(loss_fn)(params)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    aflat = jax.tree_util.tree_leaves(analytic)
+    results = []
+    failures = []
+    for (path, leaf), g in zip(flat, aflat):
+        leaf = np.asarray(leaf, np.float64)
+        g = np.asarray(g)
+        n = leaf.size
+        idxs = rng.choice(n, size=min(max_elems_per_leaf, n), replace=False)
+        max_err = 0.0
+        for idx in idxs:
+            delta = np.zeros(n)
+            delta[idx] = eps
+            delta = delta.reshape(leaf.shape)
+
+            # rebuild params with this leaf perturbed
+            def with_leaf(value):
+                return jax.tree_util.tree_unflatten(
+                    treedef, [value if p2 == path else l2
+                              for (p2, l2) in flat])
+
+            plus = with_leaf(jnp.asarray(leaf + delta, jnp.float32))
+            minus = with_leaf(jnp.asarray(leaf - delta, jnp.float32))
+            num = (float(loss_fn(plus)) - float(loss_fn(minus))) / (2 * eps)
+            ana = float(g.reshape(-1)[idx])
+            err = abs(num - ana) / max(abs(num), abs(ana), atol)
+            max_err = max(max_err, err)
+            if not (err < rtol or abs(num - ana) < atol):
+                failures.append(
+                    f"{jax.tree_util.keystr(path)}[{idx}]: "
+                    f"analytic={ana:.6g} numeric={num:.6g} rel={err:.3g}")
+        ok = not any(f.startswith(jax.tree_util.keystr(path) + "[")
+                     for f in failures)
+        results.append((jax.tree_util.keystr(path), max_err, ok))
+    if failures and raise_on_fail:
+        raise AssertionError("gradient mismatches:\n  "
+                             + "\n  ".join(failures))
+    return results
+
+
+def check_topology_grads(topology, feed, rng_key=None, **kw):
+    """checkgrad over a Topology's mean cost (the --job=checkgrad flow)."""
+    rng_key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
+    params = topology.init(rng_key)
+
+    def loss_fn(p):
+        out = topology.apply(p, feed, mode="test")
+        outs = out if isinstance(out, tuple) else (out,)
+        return sum(jnp.mean(o if not hasattr(o, "data") else o.data)
+                   for o in outs)
+
+    return check_grads(loss_fn, params, **kw)

@@ -59,11 +59,20 @@ def main(argv=None):
     t.add_argument("--start_pass", type=int, default=0)
     t.add_argument("--log_period", type=int, default=100)
     t.add_argument("--test_period", type=int, default=0)
+    t.add_argument("--show_parameter_stats_period", type=int, default=0)
 
     te = sub.add_parser("test")
     add_common(te)
     te.add_argument("--model_dir", required=True)
     te.add_argument("--test_pass", type=int, default=None)
+
+    cg = sub.add_parser("checkgrad",
+                        help="finite-difference gradient check "
+                             "(reference --job=checkgrad; single-device, "
+                             "parallel flags are ignored)")
+    cg.add_argument("--config", required=True)
+    cg.add_argument("--config_args", default="")
+    cg.add_argument("--eps", type=float, default=1e-3)
 
     m = sub.add_parser("merge_model")
     m.add_argument("--model_dir", required=True)
@@ -88,6 +97,28 @@ def main(argv=None):
         return 0
 
     cfg = _load_config(args.config, _parse_config_args(args.config_args))
+
+    if args.job == "checkgrad":
+        from paddle_tpu.data.feeder import DataFeeder
+        from paddle_tpu.layers.graph import Topology
+        from paddle_tpu.testing import check_topology_grads
+        feeding = cfg.get("feeding")
+        feeder = feeding if isinstance(feeding, DataFeeder) else (
+            DataFeeder(feeding) if feeding else None)
+        batch = next(iter(cfg["train_reader"]()))
+        feed = feeder(batch) if feeder else batch
+        costs = cfg["cost"]
+        topo = Topology(costs if isinstance(costs, (list, tuple))
+                        else [costs])
+        results = check_topology_grads(topo, feed, eps=args.eps,
+                                       raise_on_fail=False)
+        bad = False
+        for path, err, ok in results:
+            print(f"  {path}: max rel err {err:.3g}"
+                  + ("" if ok else "  MISMATCH"))
+            bad = bad or not ok
+        print("checkgrad FAILED" if bad else "checkgrad PASSED")
+        return 1 if bad else 0
 
     from paddle_tpu.trainer import SGD
     mesh = None
@@ -115,7 +146,9 @@ def main(argv=None):
                       save_only_one=args.save_only_one,
                       test_reader=cfg.get("test_reader"),
                       test_period=args.test_period,
-                      log_period=args.log_period)
+                      log_period=args.log_period,
+                      show_parameter_stats_period=
+                      args.show_parameter_stats_period)
         return 0
 
     if args.job == "test":
@@ -124,6 +157,7 @@ def main(argv=None):
                             feeding=cfg.get("feeding"))
         print(f"test cost: {cost:.5f}")
         return 0
+
 
 
 if __name__ == "__main__":

@@ -136,10 +136,19 @@ class SGD:
 
     # ------------------------------------------------------------ train
 
+    def log_parameter_stats(self):
+        """Per-parameter value abs-max/avg dump (the reference's
+        --show_parameter_stats_period, TrainerInternal.cpp:210-214)."""
+        for path, leaf in jax.tree_util.tree_leaves_with_path(self.parameters):
+            a = jnp.abs(leaf)
+            logger.info("  param %s shape=%s absmax=%.5g absavg=%.5g",
+                        jax.tree_util.keystr(path), tuple(leaf.shape),
+                        float(jnp.max(a)), float(jnp.mean(a)))
+
     def train(self, reader, num_passes=1, event_handler=None, feeding=None,
               save_dir=None, saving_period=1, save_only_one=False,
               test_reader=None, test_period=0, log_period=100,
-              buffered_batches=4):
+              buffered_batches=4, show_parameter_stats_period=0):
         """reader: callable -> iterator of batches (lists of samples).
         feeding: {data_layer_name: InputType} or a DataFeeder."""
         event_handler = event_handler or (lambda e: None)
@@ -210,6 +219,9 @@ class SGD:
                                 pass_id, batch_id + 1, c, dt * 1e3,
                                 eval_log_suffix())
                     t0 = time.time()
+                if (show_parameter_stats_period
+                        and (batch_id + 1) % show_parameter_stats_period == 0):
+                    self.log_parameter_stats()
                 event_handler(events.EndIteration(
                     pass_id, batch_id, cost=cost,
                     evaluator_results={f"extra_{i}": e

@@ -1,0 +1,49 @@
+"""paddle.v2-compatible namespace.
+
+Reference: python/paddle/v2/ (layer.py, trainer.py:30 SGD, parameters.py,
+optimizer.py, event.py, inference.py, reader/, dataset/, minibatch.py).
+A reference user's `import paddle.v2 as paddle` script maps to
+`import paddle_tpu.v2 as paddle` with the same module shapes:
+
+    paddle.init(use_gpu=False, trainer_count=1)
+    y = paddle.layer.fc(input=x, size=10, act=...)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params, paddle.optimizer.Adam(...))
+    trainer.train(reader=paddle.batch(paddle.dataset.mnist.train(), 128),
+                  event_handler=..., num_passes=5)
+"""
+
+from paddle_tpu.trainer.api import init
+from paddle_tpu.v2.inference import infer
+from paddle_tpu.data.reader import batch as minibatch_batch
+
+from paddle_tpu.v2 import layer
+from paddle_tpu.v2 import activation
+from paddle_tpu.v2 import pooling
+from paddle_tpu.v2 import attr
+from paddle_tpu.v2 import networks
+from paddle_tpu.v2 import optimizer
+from paddle_tpu.v2 import parameters
+from paddle_tpu.v2 import trainer
+from paddle_tpu.v2 import event
+from paddle_tpu.v2 import inference
+from paddle_tpu.v2 import reader
+from paddle_tpu.v2 import dataset
+from paddle_tpu.v2 import evaluator
+from paddle_tpu.data import feeder as data_feeder
+# NB: paddle_tpu.data re-binds the name `provider` to the decorator
+# *function*, which shadows the submodule for `import ... as` — resolve the
+# module through sys.modules instead
+import importlib as _importlib
+data_type = _importlib.import_module("paddle_tpu.data.provider")
+
+
+def batch(reader_fn, batch_size, drop_last=False):
+    """paddle.v2.minibatch.batch"""
+    return minibatch_batch(reader_fn, batch_size, drop_last=drop_last)
+
+
+__all__ = ["init", "infer", "batch", "layer", "activation", "pooling",
+           "attr", "networks", "optimizer", "parameters", "trainer",
+           "event", "inference", "reader", "dataset", "evaluator",
+           "data_feeder", "data_type"]

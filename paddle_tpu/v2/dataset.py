@@ -1,0 +1,7 @@
+"""paddle.v2.dataset (reference v2/dataset/: mnist, cifar, imdb, imikolov,
+movielens, conll05, uci_housing, wmt14 with auto-download+cache; this
+image has zero egress so loaders fall back to deterministic synthetic data
+with the real schemas — see data/datasets/_synth.py)."""
+
+from paddle_tpu.data.datasets import (      # noqa: F401
+    mnist, cifar, imdb, imikolov, movielens, conll05, uci_housing, wmt14)

@@ -1,0 +1,5 @@
+"""paddle.v2.evaluator (reference v2/evaluator.py re-exporting the
+evaluator ctors)."""
+
+from paddle_tpu.evaluators.dsl import *     # noqa: F401,F403
+from paddle_tpu.evaluators.dsl import __all__  # noqa: F401

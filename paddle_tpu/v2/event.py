@@ -1,0 +1,7 @@
+"""paddle.v2.event (reference v2/event.py)."""
+
+from paddle_tpu.trainer.events import (      # noqa: F401
+    BeginPass, EndPass, BeginIteration, EndIteration, EndTesting)
+
+# the reference calls the test-result event TestResult
+TestResult = EndTesting

@@ -1,0 +1,10 @@
+"""paddle.v2.inference (reference v2/inference.py:11-73)."""
+
+from paddle_tpu.trainer.trainer import Inferencer
+
+
+def infer(output_layer, parameters, input, feeding=None):
+    from paddle_tpu.v2.parameters import Parameters
+    tree = parameters.tree if isinstance(parameters, Parameters) \
+        else parameters
+    return Inferencer(output_layer, tree).infer(input, feeding=feeding)

@@ -1,0 +1,5 @@
+"""paddle.v2.networks (reference v2/networks.py re-exporting
+trainer_config_helpers.networks)."""
+
+from paddle_tpu.layers.networks import *          # noqa: F401,F403
+from paddle_tpu.layers.networks import __all__    # noqa: F401

@@ -1,0 +1,101 @@
+"""paddle.v2.parameters (reference v2/parameters.py): a numpy-dict view of
+the parameter pytree with create(cost) and to_tar/from_tar serialization."""
+
+import io
+import json
+import tarfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.layers.graph import Topology
+
+
+class Parameters:
+    """Dict-like over flattened 'layer.param' names (the reference exposed
+    flat parameter names like '___fc_layer_0__.w0')."""
+
+    def __init__(self, tree):
+        self.tree = tree
+
+    # -------------------------------------------------- dict-like access
+    def _flat(self):
+        out = {}
+        for path, leaf in jax.tree_util.tree_leaves_with_path(self.tree):
+            name = ".".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path)
+            out[name] = leaf
+        return out
+
+    def names(self):
+        return list(self._flat())
+
+    def keys(self):
+        return self.names()
+
+    def __contains__(self, name):
+        return name in self._flat()
+
+    def __getitem__(self, name):
+        return np.asarray(self._flat()[name])
+
+    def __setitem__(self, name, value):
+        parts = name.split(".")
+
+        def setter(node, remaining):
+            key = remaining[0]
+            if isinstance(node, list):
+                key = int(key)
+            if len(remaining) == 1:
+                node[key] = jnp.asarray(value)
+            else:
+                setter(node[key], remaining[1:])
+        setter(self.tree, parts)
+
+    def get_shape(self, name):
+        return tuple(self._flat()[name].shape)
+
+    # -------------------------------------------------- serialization
+    def to_tar(self, f):
+        """Reference v2/parameters.py to_tar: tar of raw arrays + meta."""
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            flat = self._flat()
+            meta = {}
+            for name, arr in flat.items():
+                a = np.asarray(arr)
+                meta[name] = {"shape": list(a.shape), "dtype": str(a.dtype)}
+                data = a.tobytes()
+                info = tarfile.TarInfo(name=name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+            mb = json.dumps(meta).encode()
+            info = tarfile.TarInfo(name="__meta__.json")
+            info.size = len(mb)
+            tar.addfile(info, io.BytesIO(mb))
+
+    @classmethod
+    def from_tar(cls, f, like=None):
+        """Returns a flat {name: np.ndarray}; with like= (a Parameters or
+        pytree) the arrays are written into a copy of that tree."""
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            meta = json.loads(tar.extractfile("__meta__.json").read())
+            flat = {}
+            for name, m in meta.items():
+                raw = tar.extractfile(name).read()
+                flat[name] = np.frombuffer(raw, m["dtype"]).reshape(
+                    m["shape"])
+        if like is None:
+            return flat
+        tree = like.tree if isinstance(like, Parameters) else like
+        params = cls(jax.tree_util.tree_map(jnp.asarray, tree))
+        for name, arr in flat.items():
+            params[name] = arr
+        return params
+
+
+def create(cost, seed=1):
+    """paddle.v2.parameters.create(cost) -> Parameters."""
+    outs = cost if isinstance(cost, (list, tuple)) else [cost]
+    topo = Topology(list(outs))
+    return Parameters(topo.init(jax.random.PRNGKey(seed)))

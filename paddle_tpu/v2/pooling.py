@@ -1,0 +1,8 @@
+"""paddle.v2.pooling (reference v2/pooling.py)."""
+
+from paddle_tpu.layers import pooling as _p
+
+Max = _p.Max
+Avg = _p.Avg
+Sum = _p.Sum
+SquareRootN = getattr(_p, "SquareRootN", _p.Avg)

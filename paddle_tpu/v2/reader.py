@@ -1,0 +1,4 @@
+"""paddle.v2.reader (reference v2/reader/decorator.py)."""
+
+from paddle_tpu.data.reader import (        # noqa: F401
+    map_readers, shuffle, buffered, batch, compose, chain, firstn)
