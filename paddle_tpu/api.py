@@ -1,0 +1,131 @@
+"""Runtime object API (reference paddle/api/PaddleAPI.h SWIG surface:
+GradientMachine, SequenceGenerator, Arguments, Trainer — the classes
+`py_paddle`/gan_trainer drove directly).
+
+The SWIG layer existed to reach the C++ runtime from Python; here the
+runtime is jitted JAX, so these are thin stateful wrappers over
+Topology/optim that keep the reference's imperative call shapes:
+
+    gm = GradientMachine.createFromTopology(cost)
+    outs = gm.forward(feed)                       # inference
+    cost, outs = gm.forwardBackward(feed)         # accumulate grads
+    gm.updateParameters(optimizer_state_applied_internally)
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.layers.graph import LayerOutput, Topology
+
+
+class GradientMachine:
+    """Reference GradientMachine::forward/backward/forwardBackward
+    (gserver/gradientmachines/GradientMachine.h:72-129) as a stateful
+    wrapper: holds params, caches jitted fwd / value_and_grad fns, and
+    accumulates gradients until updateParameters."""
+
+    def __init__(self, topology: Topology, params, seed=1):
+        self.topology = topology
+        self.parameters = params
+        self._grads = None
+        self._fwd = jax.jit(
+            lambda p, feed: topology.apply(p, feed, mode="test"))
+
+        def loss_fn(p, feed):
+            out = topology.apply(p, feed, mode="test")
+            outs = out if isinstance(out, tuple) else (out,)
+            total = sum(jnp.mean(o.data if hasattr(o, "data") else o)
+                        for o in outs)
+            return total, outs
+        self._vag = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+
+    @classmethod
+    def createFromTopology(cls, outputs, seed=1):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        topo = Topology(list(outs))
+        return cls(topo, topo.init(jax.random.PRNGKey(seed)))
+
+    createFromConfigProto = createFromTopology  # reference-name alias
+
+    def _feedify(self, feed):
+        return {k: v if isinstance(v, SequenceBatch) else jnp.asarray(v)
+                for k, v in feed.items()}
+
+    def forward(self, feed):
+        return self._fwd(self.parameters, self._feedify(feed))
+
+    forwardTest = forward
+
+    def forwardBackward(self, feed):
+        """Accumulates gradients (reference PASS_TRAIN forwardBackward);
+        returns (cost, outputs)."""
+        (cost, outs), grads = self._vag(self.parameters,
+                                        self._feedify(feed))
+        if self._grads is None:
+            self._grads = grads
+        else:
+            self._grads = jax.tree_util.tree_map(jnp.add, self._grads,
+                                                 grads)
+        return float(cost), outs
+
+    def getGradients(self):
+        return self._grads
+
+    def resetGradients(self):
+        self._grads = None
+
+    def getParameters(self):
+        return self.parameters
+
+    def setParameters(self, params):
+        self.parameters = params
+
+    def randParameters(self, seed=1):
+        self.parameters = self.topology.init(jax.random.PRNGKey(seed))
+
+    def applyOptimizer(self, optimizer, opt_state):
+        """One update from the accumulated gradients; returns new state."""
+        if self._grads is None:
+            raise RuntimeError("no gradients accumulated; call "
+                               "forwardBackward first")
+        self.parameters, opt_state = optimizer.update(
+            self._grads, opt_state, self.parameters)
+        self._grads = None
+        return opt_state
+
+
+class SequenceGenerator:
+    """Reference api/SequenceGenerator.cpp: beam-search wrapper over a
+    generation layer (layers.beam_search node) with dict decoding."""
+
+    def __init__(self, gen_layer: LayerOutput, params, vocab=None):
+        self.topology = Topology(gen_layer)
+        self.params = params
+        self.vocab = vocab
+        self._fn = jax.jit(
+            lambda p, feed: self.topology.apply(p, feed, mode="test"))
+
+    def setDict(self, words):
+        self.vocab = list(words)
+
+    def generate(self, feed, num_results=1):
+        """-> per input row: [(score, [tokens or words])] best-first."""
+        res = self._fn(self.params, {
+            k: v if isinstance(v, SequenceBatch) else jnp.asarray(v)
+            for k, v in feed.items()})
+        tokens = np.asarray(res.tokens)
+        scores = np.asarray(res.scores)
+        lengths = np.asarray(res.lengths)
+        out = []
+        for b in range(tokens.shape[0]):
+            rows = []
+            for k in range(min(num_results, tokens.shape[1])):
+                ids = list(tokens[b, k, :lengths[b, k]])
+                if self.vocab is not None:
+                    ids = [self.vocab[t] if 0 <= t < len(self.vocab)
+                           else str(t) for t in ids]
+                rows.append((float(scores[b, k]), ids))
+            out.append(rows)
+        return out

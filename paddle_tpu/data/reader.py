@@ -109,3 +109,28 @@ def cache(reader):
             filled.append(True)
         yield from data
     return new_reader
+
+
+def mix(readers_and_ratios, seed=0):
+    """Interleave readers with given sampling ratios (reference
+    MultiDataProvider, gserver/dataproviders/MultiDataProvider.cpp: mixes
+    sub-providers by config ratio).  readers_and_ratios: [(reader, ratio)].
+    Exhausted readers drop out; stops when all are exhausted."""
+    import numpy as np
+
+    def new_reader():
+        rng = np.random.RandomState(seed)
+        iters = [iter(r()) for r, _ in readers_and_ratios]
+        weights = np.asarray([float(w) for _, w in readers_and_ratios])
+        alive = [True] * len(iters)
+        while any(alive):
+            w = np.where(alive, weights, 0.0)
+            total = w.sum()
+            if total <= 0:
+                break
+            i = int(rng.choice(len(iters), p=w / total))
+            try:
+                yield next(iters[i])
+            except StopIteration:
+                alive[i] = False
+    return new_reader

@@ -30,7 +30,7 @@ from paddle_tpu.utils.error import ConfigError
 __all__ = [
     "lstmemory", "grumemory", "recurrent_layer", "recurrent_group", "memory",
     "StaticInput", "lstm_step_layer", "gru_step_layer",
-    "gru_step_naive_layer", "get_output_layer",
+    "gru_step_naive_layer", "get_output_layer", "mdlstmemory",
 ]
 
 
@@ -467,3 +467,62 @@ def gru_step_naive_layer(input, output_mem, size=None, act="tanh",
     return gru_step_layer(input, output_mem, size=size, act=act,
                           gate_act=gate_act, name=name, bias_attr=bias_attr,
                           param_attr=param_attr)
+
+
+class _MDLstmImpl:
+    """2-D multi-dimensional LSTM over image-shaped sequences (reference
+    MDLstmLayer, REGISTER_LAYER(mdlstmemory); config_parser.py:3018)."""
+
+    def infer(self, cfg, in_sizes):
+        return cfg["size"] * cfg["h"] * cfg["w"]
+
+    def init(self, rng, cfg, in_sizes):
+        d = cfg["size"]
+        r1, r2 = jax.random.split(rng)
+        wi = _winit(cfg.get("param_attr"), 1.0 / math.sqrt(d))
+        p = {"w_row": wi(r1, (d, 5 * d)), "w_col": wi(r2, (d, 5 * d))}
+        if cfg.get("bias_attr", True) is not False:
+            # 5d gate bias + 5d peepholes (i_row, i_col, f_row, f_col, o)
+            p["b"] = jnp.zeros((10 * d,), dtypes.param_dtype())
+        return p
+
+    def apply(self, ctx, cfg, params, x):
+        d, h, w = cfg["size"], cfg["h"], cfg["w"]
+        xd = value_data(x).reshape(-1, h, w, 5 * d)
+        b5 = params.get("b")
+        checks = [None] * 5
+        if b5 is not None:
+            xd = xd + b5[:5 * d]
+            checks = [b5[5 * d + k * d: 5 * d + (k + 1) * d]
+                      for k in range(5)]
+        out = rnn_ops.md_lstm_2d(
+            xd, params["w_row"], params["w_col"],
+            check_i_row=checks[0], check_i_col=checks[1],
+            check_f_row=checks[2], check_f_col=checks[3], check_o=checks[4],
+            act=cfg.get("act", "tanh"), gate_act=cfg.get("gate_act",
+                                                         "sigmoid"),
+            state_act=cfg.get("state_act", "tanh"))
+        return out.reshape(out.shape[0], -1)
+
+
+register_layer("mdlstmemory")(_MDLstmImpl)
+
+
+def mdlstmemory(input, size=None, height=None, width=None, act="tanh",
+                gate_act="sigmoid", state_act="tanh", name=None,
+                bias_attr=True, param_attr=None):
+    """input: image-shaped layer of 5*size channels (pre-projected gates);
+    height/width default to the input's img_shape."""
+    if height is None or width is None:
+        if input.img_shape is None:
+            raise ConfigError("mdlstmemory needs height/width (or an input "
+                              "with img_shape)")
+        height, width = input.img_shape
+    d = size or input.size // (5 * height * width)
+    node = LayerOutput(name or auto_name("mdlstm"), "mdlstmemory",
+                       d * height * width, [input],
+                       {"size": d, "h": height, "w": width, "act": act,
+                        "gate_act": gate_act, "state_act": state_act,
+                        "bias_attr": bias_attr, "param_attr": param_attr},
+                       is_seq=False, num_filters=d, img_shape=(height, width))
+    return node

@@ -209,3 +209,70 @@ def bidirectional(fwd_out: SequenceBatch, bwd_out: SequenceBatch) -> SequenceBat
     return SequenceBatch(
         data=jnp.concatenate([fwd_out.data, bwd_out.data], axis=-1),
         lengths=fwd_out.lengths)
+
+
+# ------------------------------------------------- multi-dimensional LSTM
+
+def md_lstm_2d(x5, w_r_row, w_r_col, check_i_row=None, check_i_col=None,
+               check_f_row=None, check_f_col=None, check_o=None,
+               act="tanh", gate_act="sigmoid", state_act="tanh"):
+    """2-D multi-dimensional LSTM (reference MDLstmLayer.cpp:158-178,
+    REGISTER_LAYER(mdlstmemory)): each cell sees two predecessors (top and
+    left), each with its own forget gate and recurrent weights:
+
+      state = actIn(a)*actGate(ig) + sum_j actGate(fg_j)*state_prev_j
+      gates = x5 + sum_j h_prev_j @ w_r_j (+ peepholes)
+
+    x5: [B, H, W, 5*D] pre-projected (a, ig, fg_row, fg_col, og — the
+    reference's size*(3+numDims) IG layout for numDims=2).
+    w_r_row/w_r_col: [D, 5*D] recurrent weights for the top/left neighbor.
+
+    TPU mapping: scan over rows carrying the previous row's (h, c)
+    [B, W, D]; the inner column scan carries (h_left, c_left).  XLA
+    unrolls both into static-shape loops (no dynamic control flow).
+    """
+    b, h, w, d5 = x5.shape
+    d = d5 // 5
+    act_f, gate_f, state_f = (activations.get(act), activations.get(gate_act),
+                              activations.get(state_act))
+    zeros_bd = jnp.zeros((b, d), x5.dtype)
+
+    def cell(x, h_top, c_top, h_left, c_left):
+        gates = (x + matmul(h_top, w_r_row) + matmul(h_left, w_r_col))
+        a, ig, fg_r, fg_c, og = jnp.split(gates, 5, axis=-1)
+        if check_i_row is not None:
+            ig = ig + c_top * check_i_row
+        if check_i_col is not None:
+            ig = ig + c_left * check_i_col
+        if check_f_row is not None:
+            fg_r = fg_r + c_top * check_f_row
+        if check_f_col is not None:
+            fg_c = fg_c + c_left * check_f_col
+        c = (act_f(a) * gate_f(ig) + gate_f(fg_r) * c_top
+             + gate_f(fg_c) * c_left)
+        if check_o is not None:
+            og = og + c * check_o
+        hh = gate_f(og) * state_f(c)
+        return hh, c
+
+    def row_step(prev_row, x_row):
+        # prev_row: (h_top [B, W, D], c_top [B, W, D]); x_row: [B, W, 5D]
+        h_top, c_top = prev_row
+
+        def col_step(carry, inp):
+            h_left, c_left = carry
+            x, ht, ct = inp
+            hh, cc = cell(x, ht, ct, h_left, c_left)
+            return (hh, cc), (hh, cc)
+
+        xs = (x_row.transpose(1, 0, 2), h_top.transpose(1, 0, 2),
+              c_top.transpose(1, 0, 2))
+        _, (hs, cs) = jax.lax.scan(col_step, (zeros_bd, zeros_bd), xs)
+        h_row = hs.transpose(1, 0, 2)       # [B, W, D]
+        c_row = cs.transpose(1, 0, 2)
+        return (h_row, c_row), h_row
+
+    zeros_row = jnp.zeros((b, w, d), x5.dtype)
+    _, out = jax.lax.scan(row_step, (zeros_row, zeros_row),
+                          x5.transpose(1, 0, 2, 3))
+    return out.transpose(1, 0, 2, 3)        # [B, H, W, D]

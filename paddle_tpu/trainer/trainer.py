@@ -201,11 +201,16 @@ class SGD:
                 self.rng, step_rng = jax.random.split(self.rng)
                 if self._step_fn is None:
                     self._build_step(feed)
-                with timer("train_step"):
+                with timer("train_step") as st:
                     (self.parameters, self.opt_state, self.model_state,
                      cost, extras) = self._step_fn(
                         self.parameters, self.opt_state, self.model_state,
                         feed, step_rng)
+                # per-step distribution (BarrierStat skew-profiling role)
+                from paddle_tpu.utils.stats import step_histogram
+                if st.count:
+                    step_histogram.add(st.total / st.count if st.count == 1
+                                       else 0.0)
                 cost_sum = cost_sum + cost
                 n_batches += 1
                 window.append(cost)

@@ -90,3 +90,38 @@ def timer(name: str, registry: StatRegistry = None):
 
 def print_all_stats():
     global_stats.print_all()
+
+
+class Histogram:
+    """Step-duration histogram with percentile summary (TPU-native stand-in
+    for the reference's BarrierStat worker-skew profiling,
+    utils/BarrierStat.h:196-273 — in synchronous SPMD the interesting skew
+    is the per-step duration distribution)."""
+
+    def __init__(self, name, max_samples=10000):
+        self.name = name
+        self.samples = []
+        self.max_samples = max_samples
+
+    def add(self, seconds):
+        if len(self.samples) < self.max_samples:
+            self.samples.append(seconds)
+
+    def percentiles(self, qs=(50, 90, 99)):
+        import numpy as np
+        if not self.samples:
+            return {q: 0.0 for q in qs}
+        arr = np.asarray(self.samples)
+        return {q: float(np.percentile(arr, q)) for q in qs}
+
+    def summary(self):
+        p = self.percentiles()
+        return (f"{self.name}: n={len(self.samples)} "
+                f"p50={p[50]*1e3:.2f}ms p90={p[90]*1e3:.2f}ms "
+                f"p99={p[99]*1e3:.2f}ms")
+
+    def reset(self):
+        self.samples = []
+
+
+step_histogram = Histogram("train_step")
