@@ -9,12 +9,23 @@ on 1x K40m: 184 ms/batch (including parameter update; BASELINE.md line
 value = our ms/batch for the full train step (fwd+bwd+momentum update) on
 one TPU chip; vs_baseline = 184 / value (speedup, >1 is better).
 
-Env overrides: BENCH_MODEL=lstm|resnet50, BENCH_STEPS, BENCH_BATCH.
+Hardened (round-2): every phase — backend init, input build, compile,
+timed steps — runs under a watchdog deadline and logs progress to stderr.
+On any failure the harness still prints ONE JSON line whose "error" field
+distinguishes backend-unavailable from compile-fail from slow-steps, so a
+broken chip is distinguishable from a broken framework.  MFU is estimated
+from analytic model FLOPs and the chip's peak (device_kind table below).
+
+Env overrides: BENCH_MODEL=lstm|lstm256|lstm1280|resnet50|alexnet|googlenet|
+smallnet, BENCH_STEPS, BENCH_BATCH, BENCH_INIT_TIMEOUT, BENCH_COMPILE_TIMEOUT,
+BENCH_STEP_TIMEOUT (seconds), BENCH_PEAK_TFLOPS (override peak), and
+BENCH_PLATFORM (e.g. cpu to force a platform for local testing).
 """
 
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -22,7 +33,95 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
 
 
-def bench_lstm(steps, batch=64, seq_len=100, hidden=512, vocab=30000):
+def _log(msg):
+    print(f"[bench +{time.perf_counter() - _T0:8.2f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
+# Peak dense bf16 TFLOP/s per JAX device, keyed by substring of device_kind
+# (lowercased).  Sources: public TPU spec sheets / jax-ml scaling book.
+_PEAK_TFLOPS = [
+    ("v6", 918.0), ("trillium", 918.0),
+    ("v5p", 459.0),
+    ("v5 lite", 197.0), ("v5e", 197.0), ("v5litepod", 197.0),
+    ("v4", 275.0),
+    ("v3", 61.5),       # per core (JAX device = core on v2/v3)
+    ("v2", 22.5),
+]
+
+
+class Watchdog:
+    """Daemon thread that force-exits with a diagnostic JSON line if a phase
+    exceeds its deadline.  Needed because a wedged backend hangs inside C++
+    where no Python exception can interrupt."""
+
+    def __init__(self, result_stub):
+        self._lock = threading.Lock()
+        self._phase = None
+        self._deadline = None
+        self._stub = result_stub
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def phase(self, name, timeout_s):
+        with self._lock:
+            self._phase = name
+            self._deadline = time.perf_counter() + timeout_s
+        _log(f"phase={name} (timeout {timeout_s:.0f}s)")
+
+    def clear(self):
+        with self._lock:
+            self._phase, self._deadline = None, None
+
+    def _run(self):
+        while True:
+            time.sleep(1.0)
+            with self._lock:
+                phase, deadline = self._phase, self._deadline
+            if deadline is not None and time.perf_counter() > deadline:
+                out = dict(self._stub)
+                out["value"] = None
+                out["vs_baseline"] = None
+                out["error"] = {
+                    "init": "backend_unavailable_timeout",
+                    "build": "input_build_timeout",
+                    "compile": "compile_timeout",
+                    "steps": "steps_timeout",
+                }.get(phase, f"{phase}_timeout")
+                out["phase"] = phase
+                out["detail"] = (f"watchdog: phase '{phase}' exceeded its "
+                                 f"deadline; see stderr timeline")
+                _log(f"WATCHDOG FIRED in phase={phase}")
+                print(json.dumps(out), flush=True)
+                os._exit(3)
+
+
+def _device_info():
+    import jax
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "unknown")
+    n = len(jax.devices())
+    peak = None
+    if os.environ.get("BENCH_PEAK_TFLOPS"):
+        peak = float(os.environ["BENCH_PEAK_TFLOPS"]) * 1e12
+    else:
+        lk = str(kind).lower()
+        for sub, tf in _PEAK_TFLOPS:
+            if sub in lk:
+                peak = tf * 1e12
+                break
+    return dev.platform, str(kind), n, peak
+
+
+# ---------------------------------------------------------------------------
+# Benchmarks.  Each returns (setup_fn) -> (step, args, flops_per_step,
+# baseline_ms_or_None, metric_name, unit, to_value).
+
+
+def bench_lstm(batch=64, seq_len=100, hidden=512, vocab=30000,
+               baseline_ms=184.0):
     import jax
     import jax.numpy as jnp
     from paddle_tpu.core.sequence import SequenceBatch
@@ -47,22 +146,22 @@ def bench_lstm(steps, batch=64, seq_len=100, hidden=512, vocab=30000):
         new_params, new_opt = opt.update(grads, opt_state, params)
         return new_params, new_opt, loss
 
-    # compile + warmup
-    params, opt_state, loss = step(params, opt_state, ids, labels)
-    jax.block_until_ready(loss)
-    for _ in range(3):
+    # Matmul FLOPs per train step: fwd = 2*B*T*4H*(emb + H + H + H) for the
+    # two layers' input+recurrent projections; train ~= 3x fwd (bwd ~= 2x).
+    emb_dim = 128
+    fwd = 2.0 * batch * seq_len * 4 * hidden * (emb_dim + hidden + 2 * hidden)
+    flops = 3.0 * fwd
+
+    def run(s):
+        nonlocal params, opt_state
         params, opt_state, loss = step(params, opt_state, ids, labels)
-    jax.block_until_ready(loss)
+        return loss
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state, ids, labels)
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / steps
-    return dt * 1e3, 184.0, "LSTM-textclass h=512 bs=64 len=100 ms/batch"
+    return run, flops, baseline_ms, (
+        f"LSTM-textclass h={hidden} bs={batch} len={seq_len} ms/batch")
 
 
-def bench_resnet50(steps, batch=32):
+def bench_resnet50(batch=32):
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models import resnet
@@ -83,31 +182,166 @@ def bench_resnet50(steps, batch=32):
         new_params, new_opt = opt.update(grads, opt_state, params)
         return new_params, new_state, new_opt, loss
 
-    params, state, opt_state, loss = step(params, state, opt_state, images, labels)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, state, opt_state, loss = step(params, state, opt_state,
-                                              images, labels)
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / steps
-    imgs_per_sec = batch / dt
-    return imgs_per_sec, None, "ResNet-50 images/sec/chip bs=32"
+    st = {"params": params, "state": state, "opt": opt_state}
+
+    def run(s):
+        st["params"], st["state"], st["opt"], loss = step(
+            st["params"], st["state"], st["opt"], images, labels)
+        return loss
+
+    flops = 3.0 * 4.1e9 * batch      # ~4.1 GFLOP fwd per 224x224 image
+    return run, flops, None, f"ResNet-50 train ms/batch bs={batch}"
+
+
+def bench_image(model_name, batch, baseline_ms, fwd_flops_per_image,
+                image_hw, num_classes):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import optim
+    from paddle_tpu.models import alexnet, googlenet, smallnet
+    mod = {"alexnet": alexnet, "googlenet": googlenet,
+           "smallnet": smallnet}[model_name]
+
+    params, state = mod.init(jax.random.PRNGKey(0), num_classes=num_classes)
+    opt = optim.Momentum(learning_rate=0.01, momentum=0.9)
+    opt_state = opt.init(params)
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(batch, image_hw, image_hw, 3), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, num_classes, (batch,)), jnp.int32)
+
+    @jax.jit
+    def step(params, state, opt_state, images, labels):
+        (loss, new_state), grads = jax.value_and_grad(
+            mod.loss, has_aux=True)(params, state, images, labels)
+        new_params, new_opt = opt.update(grads, opt_state, params)
+        return new_params, new_state, new_opt, loss
+
+    st = {"params": params, "state": state, "opt": opt_state}
+
+    def run(s):
+        st["params"], st["state"], st["opt"], loss = step(
+            st["params"], st["state"], st["opt"], images, labels)
+        return loss
+
+    flops = 3.0 * fwd_flops_per_image * batch
+    return run, flops, baseline_ms, (
+        f"{model_name} train ms/batch bs={batch} ({image_hw}x{image_hw})")
+
+
+_BENCHES = {
+    # name: (factory, default_batch)
+    "lstm": (lambda b: bench_lstm(batch=b, hidden=512, baseline_ms=184.0), 64),
+    "lstm256": (lambda b: bench_lstm(batch=b, hidden=256, baseline_ms=83.0), 64),
+    "lstm1280": (lambda b: bench_lstm(batch=b, hidden=1280, baseline_ms=641.0), 64),
+    "resnet50": (lambda b: bench_resnet50(batch=b), 32),
+    # BASELINE.md rows: AlexNet bs=64 195ms; GoogleNet bs=64 613ms;
+    # SmallNet (CIFAR quick) bs=64 10.463ms — all 1x K40m including update.
+    "alexnet": (lambda b: bench_image("alexnet", b, 195.0, 1.4e9, 227, 1000), 64),
+    "googlenet": (lambda b: bench_image("googlenet", b, 613.0, 3.0e9, 224, 1000), 64),
+    "smallnet": (lambda b: bench_image("smallnet", b, 10.463, 2.5e7, 32, 10), 64),
+}
 
 
 def main():
     model = os.environ.get("BENCH_MODEL", "lstm")
     steps = int(os.environ.get("BENCH_STEPS", "20"))
-    if model == "resnet50":
-        value, baseline, metric = bench_resnet50(steps)
-        out = {"metric": metric, "value": round(value, 2),
-               "unit": "images/sec",
-               "vs_baseline": None}
-    else:
-        value, baseline, metric = bench_lstm(steps)
-        out = {"metric": metric, "value": round(value, 3), "unit": "ms/batch",
-               "vs_baseline": round(baseline / value, 2)}
-    print(json.dumps(out))
+    t_init = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
+    t_compile = float(os.environ.get("BENCH_COMPILE_TIMEOUT", "600"))
+    t_steps = float(os.environ.get("BENCH_STEP_TIMEOUT", "600"))
+    if os.environ.get("BENCH_PLATFORM"):
+        os.environ["JAX_PLATFORMS"] = os.environ["BENCH_PLATFORM"]
+
+    factory, default_batch = _BENCHES[model]
+    batch = int(os.environ.get("BENCH_BATCH", str(default_batch)))
+
+    stub = {"metric": f"{model} (pending)", "value": None, "unit": "ms/batch",
+            "vs_baseline": None}
+    dog = Watchdog(stub)
+
+    # -- phase 1: backend init (this is where a wedged TPU tunnel hangs) --
+    dog.phase("init", t_init)
+    try:
+        import jax
+        if os.environ.get("BENCH_PLATFORM"):
+            # env var alone is not enough: a sitecustomize hook may pin the
+            # jax_platforms *config* at interpreter startup
+            jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+        import jax.numpy as jnp
+        platform, kind, ndev, peak = _device_info()
+        # touch the device with a tiny op so init failures surface here,
+        # not inside the model build
+        jnp.zeros((8, 8)).block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        dog.clear()
+        stub.update(error="backend_unavailable", phase="init",
+                    detail=f"{type(e).__name__}: {e}"[:800])
+        _log(f"backend init FAILED: {e}")
+        print(json.dumps(stub), flush=True)
+        sys.exit(2)
+    _log(f"backend up: platform={platform} device_kind={kind} n={ndev} "
+         f"peak={'%.0f TF/s' % (peak / 1e12) if peak else 'unknown'}")
+
+    # -- phase 2: build model + inputs (host-side) --
+    dog.phase("build", t_init)
+    try:
+        run, flops, baseline_ms, metric = factory(batch)
+    except Exception as e:  # noqa: BLE001
+        dog.clear()
+        stub.update(error="build_failed", phase="build",
+                    detail=f"{type(e).__name__}: {e}"[:800])
+        _log(f"model build FAILED: {e}")
+        print(json.dumps(stub), flush=True)
+        sys.exit(2)
+    stub["metric"] = metric
+    _log(f"model built: {metric}, analytic {flops / 1e9:.1f} GFLOP/step")
+
+    # -- phase 3: compile + warmup --
+    dog.phase("compile", t_compile)
+    try:
+        t0 = time.perf_counter()
+        loss = run(0)
+        jax.block_until_ready(loss)
+        compile_s = time.perf_counter() - t0
+        for i in range(3):
+            loss = run(i)
+        jax.block_until_ready(loss)
+    except Exception as e:  # noqa: BLE001
+        dog.clear()
+        stub.update(error="compile_failed", phase="compile",
+                    detail=f"{type(e).__name__}: {e}"[:800])
+        _log(f"compile FAILED: {e}")
+        print(json.dumps(stub), flush=True)
+        sys.exit(2)
+    _log(f"compiled + warm in {compile_s:.1f}s, loss={float(loss):.4f}")
+
+    # -- phase 4: timed steps --
+    dog.phase("steps", t_steps)
+    try:
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss = run(i)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / steps
+    except Exception as e:  # noqa: BLE001
+        dog.clear()
+        stub.update(error="step_failed", phase="steps",
+                    detail=f"{type(e).__name__}: {e}"[:800])
+        _log(f"steps FAILED: {e}")
+        print(json.dumps(stub), flush=True)
+        sys.exit(2)
+    dog.clear()
+
+    ms = dt * 1e3
+    mfu = (flops / dt / peak) if peak else None
+    _log(f"{steps} steps, {ms:.3f} ms/batch"
+         + (f", MFU={mfu * 100:.1f}%" if mfu is not None else ""))
+    out = {"metric": metric, "value": round(ms, 3), "unit": "ms/batch",
+           "vs_baseline": round(baseline_ms / ms, 2) if baseline_ms else None,
+           "mfu": round(mfu, 4) if mfu is not None else None,
+           "device": kind, "platform": platform,
+           "compile_s": round(compile_s, 1), "steps": steps,
+           "flops_per_step": flops}
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

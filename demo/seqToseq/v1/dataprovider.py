@@ -1,0 +1,70 @@
+"""WMT14-style seqToseq data provider (v1-config port of the reference
+demo/seqToseq/dataprovider.py — py3 syntax; same slot names and semantics)."""
+
+from paddle.trainer.PyDataProvider2 import *
+
+UNK_IDX = 2
+START = "<s>"
+END = "<e>"
+
+
+def hook(settings, src_dict_path, trg_dict_path, is_generating, file_list,
+         **kwargs):
+    settings.job_mode = not is_generating
+
+    def load_dict(path):
+        with open(path) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    settings.src_dict = load_dict(src_dict_path)
+    settings.trg_dict = load_dict(trg_dict_path)
+
+    if settings.job_mode:
+        settings.input_types = {
+            "source_language_word":
+                integer_value_sequence(len(settings.src_dict)),
+            "target_language_word":
+                integer_value_sequence(len(settings.trg_dict)),
+            "target_language_next_word":
+                integer_value_sequence(len(settings.trg_dict)),
+        }
+    else:
+        settings.input_types = {
+            "source_language_word":
+                integer_value_sequence(len(settings.src_dict)),
+            "sent_id":
+                integer_value_sequence(
+                    len(open(file_list[0]).readlines()) if file_list else 1),
+        }
+
+
+def _ids(sentence, dictionary):
+    return ([dictionary[START]]
+            + [dictionary.get(w, UNK_IDX) for w in sentence.strip().split()]
+            + [dictionary[END]])
+
+
+@provider(init_hook=hook, pool_size=50000)
+def process(settings, file_name):
+    with open(file_name) as f:
+        for line_count, line in enumerate(f):
+            fields = line.strip().split("\t")
+            if settings.job_mode:
+                if len(fields) != 2:
+                    continue
+                src_ids = _ids(fields[0], settings.src_dict)
+                trg_ids = [settings.trg_dict.get(w, UNK_IDX)
+                           for w in fields[1].split()]
+                if len(src_ids) > 80 or len(trg_ids) > 80:
+                    continue
+                yield {
+                    "source_language_word": src_ids,
+                    "target_language_word":
+                        [settings.trg_dict[START]] + trg_ids,
+                    "target_language_next_word":
+                        trg_ids + [settings.trg_dict[END]],
+                }
+            else:
+                yield {"source_language_word": _ids(fields[0],
+                                                    settings.src_dict),
+                       "sent_id": [line_count]}

@@ -1,0 +1,101 @@
+"""Attention GRU encoder-decoder network in the v1 config DSL (py3 port of
+the reference demo/seqToseq/seqToseq_net.py — the framework-parity demo for
+recurrent_group + memory + beam_search generation).
+
+Structure (reference :78-205): bidirectional GRU encoder, Bahdanau attention
+inside a recurrent_group decoder (memory-linked gru_step), softmax over the
+target vocabulary; generation mode swaps the target input for a
+GeneratedInput and runs beam_search with shared step-layer names.
+"""
+
+import os
+
+from paddle.trainer_config_helpers import *
+
+
+def seq_to_seq_data(data_dir, is_generating, dict_size=None,
+                    train_list="train.list", test_list="test.list",
+                    gen_list="gen.list", gen_result="gen_result"):
+    src_dict_path = os.path.join(data_dir, "src.dict")
+    trg_dict_path = os.path.join(data_dir, "trg.dict")
+    define_py_data_sources2(
+        train_list=None if is_generating else os.path.join(data_dir,
+                                                           train_list),
+        test_list=os.path.join(data_dir,
+                               gen_list if is_generating else test_list),
+        module="dataprovider",
+        obj="process",
+        args={"src_dict_path": src_dict_path,
+              "trg_dict_path": trg_dict_path,
+              "is_generating": is_generating})
+    return {"src_dict_path": src_dict_path, "trg_dict_path": trg_dict_path,
+            "gen_result": gen_result}
+
+
+def gru_encoder_decoder(data_conf, is_generating, word_vector_dim=512,
+                        encoder_size=512, decoder_size=512, beam_size=3,
+                        max_length=250, error_clipping=50):
+    src_dict_dim = len(open(data_conf["src_dict_path"]).readlines())
+    trg_dict_dim = len(open(data_conf["trg_dict_path"]).readlines())
+    clip = ExtraLayerAttribute(error_clipping_threshold=error_clipping)
+
+    src_word = data_layer(name="source_language_word", size=src_dict_dim)
+    src_emb = embedding_layer(
+        input=src_word, size=word_vector_dim,
+        param_attr=ParamAttr(name="_source_language_embedding"))
+    enc_fwd = simple_gru(input=src_emb, size=encoder_size, naive=True,
+                         gru_layer_attr=clip)
+    enc_bwd = simple_gru(input=src_emb, size=encoder_size, reverse=True,
+                         naive=True, gru_layer_attr=clip)
+    encoded_vector = concat_layer(input=[enc_fwd, enc_bwd])
+
+    with mixed_layer(size=decoder_size) as encoded_proj:
+        encoded_proj += full_matrix_projection(input=encoded_vector)
+
+    with mixed_layer(size=decoder_size, act=TanhActivation()) as decoder_boot:
+        decoder_boot += full_matrix_projection(
+            input=first_seq(input=enc_bwd))
+
+    def gru_decoder_with_attention(enc_vec, enc_proj, current_word):
+        decoder_mem = memory(name="gru_decoder", size=decoder_size,
+                             boot_layer=decoder_boot)
+        context = simple_attention(encoded_sequence=enc_vec,
+                                   encoded_proj=enc_proj,
+                                   decoder_state=decoder_mem)
+        with mixed_layer(size=decoder_size * 3) as decoder_inputs:
+            decoder_inputs += full_matrix_projection(input=context)
+            decoder_inputs += full_matrix_projection(input=current_word)
+        gru_step = gru_step_naive_layer(name="gru_decoder",
+                                        input=decoder_inputs,
+                                        output_mem=decoder_mem,
+                                        size=decoder_size, layer_attr=clip)
+        with mixed_layer(size=trg_dict_dim, bias_attr=True,
+                         act=SoftmaxActivation()) as out:
+            out += full_matrix_projection(input=gru_step)
+        return out
+
+    group_inputs = [StaticInput(input=encoded_vector, is_seq=True),
+                    StaticInput(input=encoded_proj, is_seq=True)]
+
+    if not is_generating:
+        trg_emb = embedding_layer(
+            input=data_layer(name="target_language_word", size=trg_dict_dim),
+            size=word_vector_dim,
+            param_attr=ParamAttr(name="_target_language_embedding"))
+        decoder = recurrent_group(name="decoder_group",
+                                  step=gru_decoder_with_attention,
+                                  input=group_inputs + [trg_emb])
+        label = data_layer(name="target_language_next_word",
+                           size=trg_dict_dim)
+        outputs(classification_cost(input=decoder, label=label))
+    else:
+        trg_emb = GeneratedInput(
+            size=trg_dict_dim,
+            embedding_name="_target_language_embedding",
+            embedding_size=word_vector_dim)
+        beam_gen = beam_search(name="decoder_group",
+                               step=gru_decoder_with_attention,
+                               input=group_inputs + [trg_emb],
+                               bos_id=0, eos_id=1, beam_size=beam_size,
+                               max_length=max_length)
+        outputs(beam_gen)

@@ -1,0 +1,33 @@
+"""`paddle` import-path shim: maps the reference's import surface
+(paddle.trainer_config_helpers, paddle.trainer.PyDataProvider2, paddle.v2,
+paddle.utils.*) onto paddle_tpu, so reference config scripts and
+dataproviders run UNCHANGED (`from paddle.trainer_config_helpers import *`).
+
+Reference: python/paddle/ package layout.  This is compatibility plumbing
+only — every implementation lives in paddle_tpu.
+"""
+
+import sys
+
+import paddle_tpu.v2 as v2  # noqa: F401
+
+# alias paddle.v2 (and its submodules) so `import paddle.v2 as paddle`
+# scripts work
+sys.modules[__name__ + ".v2"] = v2
+for _sub in ("activation", "attr", "dataset", "evaluator", "event",
+             "inference", "layer", "networks", "optimizer", "parameters",
+             "pooling", "reader", "trainer"):
+    try:
+        _m = __import__(f"paddle_tpu.v2.{_sub}", fromlist=[_sub])
+        sys.modules[f"{__name__}.v2.{_sub}"] = _m
+    except ImportError:
+        pass
+
+# dataset sub-submodules (paddle.v2.dataset.uci_housing etc.)
+for _ds in ("mnist", "cifar", "imdb", "imikolov", "movielens", "conll05",
+            "uci_housing", "wmt14"):
+    try:
+        _m = __import__(f"paddle_tpu.data.datasets.{_ds}", fromlist=[_ds])
+        sys.modules[f"{__name__}.v2.dataset.{_ds}"] = _m
+    except ImportError:
+        pass

@@ -1,0 +1,10 @@
+"""Shim for `from paddle.trainer.config_parser import parse_config, logger`
+(reference python/paddle/trainer/config_parser.py)."""
+
+import logging
+
+from paddle_tpu.compat.config_parser import parse_config  # noqa: F401
+
+logger = logging.getLogger("paddle_tpu.config_parser")
+
+__all__ = ["parse_config", "logger"]

@@ -1,0 +1,114 @@
+"""Shim for `from paddle.trainer_config_helpers import *` — the surface every
+reference v1 config script imports (reference
+python/paddle/trainer_config_helpers/__init__.py re-exporting layers,
+networks, activations, poolings, attrs, optimizers, data_sources,
+evaluators).
+
+Layer ctors come straight from paddle_tpu.layers; a few are wrapped here to
+RECORD into the active parse context (paddle_tpu.compat.config_parser):
+data_layer notes declaration order and takes sequence-ness from the data
+provider's input_types (reference semantics — seq-ness lives in the
+provider, not the layer config), and outputs()/evaluators register what the
+trainer should optimize/track.
+"""
+
+import inspect as _inspect
+
+from paddle_tpu.layers import *              # noqa: F401,F403
+import paddle_tpu.layers as _L
+import paddle_tpu.evaluators as _E
+from paddle_tpu.compat import config_parser as _cp
+from paddle_tpu.compat.v1 import *           # noqa: F401,F403
+from paddle_tpu.compat import v1 as _v1
+from paddle_tpu.data.provider import SeqType as _SeqType
+
+
+def _adapt_layer_attr(ctor):
+    """v1 configs pass layer_attr=ExtraAttr(...) to nearly every ctor; for
+    ctors without that kwarg, merge the attr dict into the node's cfg after
+    construction (drop_rate etc. are read from cfg at apply time)."""
+    try:
+        sig = _inspect.signature(ctor)
+    except (TypeError, ValueError):
+        return ctor
+    if "layer_attr" in sig.parameters or any(
+            p.kind == _inspect.Parameter.VAR_KEYWORD
+            for p in sig.parameters.values()):
+        return ctor
+
+    def wrapped(*a, **kw):
+        la = kw.pop("layer_attr", None)
+        node = ctor(*a, **kw)
+        if la and hasattr(node, "cfg"):
+            node.cfg.update(la)
+        return node
+    wrapped.__name__ = getattr(ctor, "__name__", "layer")
+    wrapped.__doc__ = ctor.__doc__
+    return wrapped
+
+
+for _name in dir(_L):
+    if _name.startswith("_"):
+        continue
+    _obj = getattr(_L, _name)
+    if callable(_obj) and not isinstance(_obj, type) \
+            and getattr(_obj, "__module__", "").startswith("paddle_tpu.layers"):
+        globals()[_name] = _adapt_layer_attr(_obj)
+del _name, _obj
+
+
+def data_layer(name, size, is_seq=False, height=None, width=None, **_kw):
+    """Wrapped data_layer: sequence-ness comes from the provider's declared
+    input_types when parsing a config (reference PyDataProvider2 owns the
+    seq/non-seq distinction); declaration order is recorded for positional
+    input_types pairing."""
+    if _cp.in_parse():
+        ctx = _cp.active_context()
+        types = _cp.resolve_input_types(ctx)
+        itype = None
+        if isinstance(types, dict):
+            itype = types.get(name)
+        elif isinstance(types, (list, tuple)):
+            idx = len(ctx.input_order)
+            if idx < len(types):
+                itype = types[idx]
+        if itype is not None and itype.seq_type != _SeqType.NO_SEQUENCE:
+            is_seq = True
+        ctx.input_order.append(name)
+    return _L.data_layer(name, size, is_seq=is_seq, height=height,
+                         width=width)
+
+
+def outputs(layers, *args):
+    """Wrapped outputs(): records the output layers on the parse context."""
+    out = list(layers if isinstance(layers, (list, tuple)) else [layers])
+    out += list(args)
+    if _cp.in_parse():
+        _cp.active_context().outputs = out
+    return out[0] if len(out) == 1 else out
+
+
+def inputs(layers, *args):
+    """Wrapped inputs(): explicit data-layer ordering."""
+    ins = list(layers if isinstance(layers, (list, tuple)) else [layers])
+    ins += list(args)
+    if _cp.in_parse():
+        _cp.active_context().input_order = [l.name for l in ins]
+    return None
+
+
+def _wrap_evaluator(ctor):
+    def wrapped(*a, **kw):
+        spec = ctor(*a, **kw)
+        if _cp.in_parse():
+            _cp.active_context().evaluators.append(spec)
+        return spec
+    wrapped.__name__ = ctor.__name__
+    wrapped.__doc__ = ctor.__doc__
+    return wrapped
+
+
+_eval_names = [n for n in getattr(_E, "__all__", []) if n.endswith("_evaluator")]
+for _n in _eval_names:
+    globals()[_n] = _wrap_evaluator(getattr(_E, _n))
+del _n

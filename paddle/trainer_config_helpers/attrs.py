@@ -1,0 +1,4 @@
+"""Shim: re-export of the trainer_config_helpers surface (see package
+__init__)."""
+
+from paddle.trainer_config_helpers import *  # noqa: F401,F403

@@ -29,16 +29,25 @@ class GradientMachine:
     def __init__(self, topology: Topology, params, seed=1):
         self.topology = topology
         self.parameters = params
+        self.model_state = topology.init_state()
+        self._rng = jax.random.PRNGKey(seed)
         self._grads = None
+        # inference must see the moving BN stats accumulated by
+        # forwardBackward, so state threads through here too
         self._fwd = jax.jit(
-            lambda p, feed: topology.apply(p, feed, mode="test"))
+            lambda p, feed, state: topology.apply(p, feed, mode="test",
+                                                  state=state))
 
-        def loss_fn(p, feed):
-            out = topology.apply(p, feed, mode="test")
+        # the reference GradientMachine::forwardBackward runs PASS_TRAIN:
+        # dropout active, batch-norm stats updated — so thread mode='train'
+        # with an rng and the mutable model state here too
+        def loss_fn(p, feed, state, rng):
+            out, new_state = topology.apply(p, feed, mode="train", rng=rng,
+                                            state=state, return_state=True)
             outs = out if isinstance(out, tuple) else (out,)
             total = sum(jnp.mean(o.data if hasattr(o, "data") else o)
                         for o in outs)
-            return total, outs
+            return total, (outs, new_state)
         self._vag = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
 
     @classmethod
@@ -54,15 +63,18 @@ class GradientMachine:
                 for k, v in feed.items()}
 
     def forward(self, feed):
-        return self._fwd(self.parameters, self._feedify(feed))
+        return self._fwd(self.parameters, self._feedify(feed),
+                         self.model_state)
 
     forwardTest = forward
 
     def forwardBackward(self, feed):
         """Accumulates gradients (reference PASS_TRAIN forwardBackward);
         returns (cost, outputs)."""
-        (cost, outs), grads = self._vag(self.parameters,
-                                        self._feedify(feed))
+        self._rng, step_rng = jax.random.split(self._rng)
+        (cost, (outs, new_state)), grads = self._vag(
+            self.parameters, self._feedify(feed), self.model_state, step_rng)
+        self.model_state = new_state
         if self._grads is None:
             self._grads = grads
         else:

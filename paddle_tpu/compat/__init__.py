@@ -1,0 +1,12 @@
+"""v1 config-file compatibility (the reference's config compiler).
+
+`paddle_tpu.compat.v1` exports the trainer_config_helpers surface the
+reference's demo/benchmark config scripts import (`from
+paddle.trainer_config_helpers import *`); `paddle_tpu.compat.config_parser`
+executes such a script (reference config_parser.py:3558 parse_config) and
+lowers it to the runtime contract the CLI trainer consumes.  The root-level
+`paddle/` shim package maps the reference import paths onto these modules so
+reference configs run UNCHANGED.
+"""
+
+from paddle_tpu.compat.config_parser import parse_config, config_to_runtime

@@ -1,0 +1,308 @@
+"""Execute reference-style v1 trainer config scripts.
+
+Reference: python/paddle/trainer/config_parser.py:3558 `parse_config(
+config_file, config_arg_str)` executes the user's config script against the
+trainer_config_helpers DSL and returns the assembled proto; the C++ trainer
+then builds data providers from the recorded PyDataProvider2 sources
+(TrainerConfigHelper.cpp:33-54).
+
+Here the script executes against the SAME paddle_tpu DSL the native API
+uses (the layer ctors build LayerOutput graphs directly), so "parsing" a
+config yields a ready Topology + optimizer + reader spec — there is no
+intermediate proto.  parse_config returns a ParsedConfig; config_to_runtime
+lowers it to the {cost, optimizer, train_reader, feeding, ...} contract the
+CLI trainer consumes.
+"""
+
+import builtins
+import importlib
+import os
+import sys
+
+from paddle_tpu.utils.error import ConfigError
+
+# The reference config scripts/providers are python-2 era; give them the py2
+# builtins they expect (only where py3 doesn't define them already).
+for _name, _val in (("xrange", range), ("unicode", str),
+                    ("basestring", (str, bytes))):
+    if not hasattr(builtins, _name):
+        setattr(builtins, _name, _val)
+
+
+class ParseContext:
+    def __init__(self, config_args=None, config_dir="."):
+        self.config_args = dict(config_args or {})
+        self.config_dir = config_dir
+        self.settings = {"batch_size": 256, "learning_rate": 1e-3}
+        self.data_sources = {}
+        self.outputs = []
+        self.input_order = []       # data layers in declaration order
+        self.evaluators = []
+
+
+_ACTIVE = []
+
+
+def active_context() -> ParseContext:
+    if not _ACTIVE:
+        raise ConfigError(
+            "no active config parse (settings()/define_py_data_sources2 must "
+            "run inside parse_config, i.e. from a --config script)")
+    return _ACTIVE[-1]
+
+
+def in_parse():
+    return bool(_ACTIVE)
+
+
+class ParsedConfig:
+    def __init__(self, ctx: ParseContext, namespace):
+        self.settings = ctx.settings
+        self.data_sources = ctx.data_sources
+        self.outputs = ctx.outputs
+        self.input_order = ctx.input_order
+        self.evaluators = ctx.evaluators
+        self.config_dir = ctx.config_dir
+        self.namespace = namespace   # the script's globals (for tooling)
+
+
+def _import_provider(module, config_dir):
+    """Import a data-provider module from the config's directory.  Loaded
+    under a config-dir-qualified module key so same-named providers from
+    different demos (every demo calls its module 'dataprovider') don't
+    collide in sys.modules; the config dir goes on sys.path during exec so
+    sibling imports (mnist_provider -> mnist_util) resolve."""
+    path = os.path.join(config_dir, module.replace(".", os.sep) + ".py")
+    if os.path.exists(path):
+        key = f"_ptpu_provider_{abs(hash(config_dir))}_{module}"
+        if key in sys.modules:
+            return sys.modules[key]
+        spec = importlib.util.spec_from_file_location(key, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[key] = mod
+        added = False
+        if config_dir not in sys.path:
+            sys.path.insert(0, config_dir)
+            added = True
+        try:
+            spec.loader.exec_module(mod)
+        finally:
+            if added:
+                sys.path.remove(config_dir)
+        return mod
+    added = False
+    if config_dir not in sys.path:
+        sys.path.insert(0, config_dir)
+        added = True
+    try:
+        return importlib.import_module(module)
+    finally:
+        if added:
+            sys.path.remove(config_dir)
+
+
+def resolve_input_types(ctx: ParseContext):
+    """Input types from the recorded data sources, resolved AT PARSE TIME so
+    data_layer can infer sequence-ness (the reference carries seq-ness in the
+    provider's input_types, not the layer config).  Builds the provider with
+    an empty file list — generators are lazy, only init_hook runs."""
+    if hasattr(ctx, "_resolved_types"):
+        return ctx._resolved_types
+    types = None
+    for key in ("train", "test"):
+        src = ctx.data_sources.get(key)
+        if not src:
+            continue
+        try:
+            mod = _import_provider(src["module"], ctx.config_dir)
+            factory = getattr(mod, src["obj"]) if isinstance(src["obj"], str) \
+                else src["obj"]
+            reader = factory([], **(src.get("args") or {}))
+            types = getattr(reader, "input_types", None)
+            if types:
+                break
+        except Exception:   # noqa: BLE001 — provider may need real files
+            continue
+    ctx._resolved_types = types
+    return types
+
+
+def _parse_config_arg_str(s):
+    out = {}
+    if not s:
+        return out
+    for kv in s.split(","):
+        if not kv.strip():
+            continue
+        k, _, v = kv.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def parse_config(config_file, config_arg_str="") -> ParsedConfig:
+    """Execute a v1 config script (reference parse_config signature).
+
+    config_arg_str: "k=v,k2=v2" (or an already-parsed dict)."""
+    args = (config_arg_str if isinstance(config_arg_str, dict)
+            else _parse_config_arg_str(config_arg_str))
+    config_dir = os.path.dirname(os.path.abspath(config_file))
+    ctx = ParseContext(args, config_dir)
+    from paddle_tpu.layers.graph import reset_names
+    reset_names()
+    _ACTIVE.append(ctx)
+    added_path = False
+    try:
+        # the provider module named by define_py_data_sources2 lives next to
+        # the config script (reference trainer behavior)
+        if config_dir not in sys.path:
+            sys.path.insert(0, config_dir)
+            added_path = True
+        src = open(config_file).read()
+        ns = {"__file__": os.path.abspath(config_file),
+              "__name__": "__paddle_tpu_config__"}
+        code = compile(src, config_file, "exec")
+        exec(code, ns)
+    finally:
+        _ACTIVE.pop()
+        if added_path:
+            sys.path.remove(config_dir)
+    if not ctx.outputs:
+        raise ConfigError(f"{config_file} declared no outputs(); nothing to "
+                          "train or infer")
+    return ParsedConfig(ctx, ns)
+
+
+# ------------------------------------------------------------ lowering
+
+
+def _make_optimizer(settings):
+    from paddle_tpu import optim
+    from paddle_tpu.compat import v1
+
+    method = settings.get("learning_method") or v1.MomentumOptimizer(0.0)
+    lr = settings.get("learning_rate", 1e-3)
+    kw = dict(method.kw)
+
+    reg = settings.get("regularization")
+    if reg is not None:
+        if getattr(reg, "l2", 0.0):
+            kw["l2"] = reg.l2
+        if getattr(reg, "l1", 0.0):
+            kw["l1"] = reg.l1
+    clip = settings.get("gradient_clipping_threshold")
+    if clip:
+        clip = clip.threshold if hasattr(clip, "threshold") else clip
+        kw["clip_threshold"] = clip
+
+    # reference LearningRateScheduler: 'poly' with decay_a/b == 0 is constant
+    sched_name = settings.get("learning_rate_schedule", "poly")
+    da = settings.get("learning_rate_decay_a", 0.0)
+    db = settings.get("learning_rate_decay_b", 0.0)
+    schedule = None
+    if sched_name and sched_name != "constant" and (da or db):
+        from paddle_tpu.optim import schedules
+        fns = {"poly": schedules.poly, "exp": schedules.exp,
+               "discexp": schedules.discexp, "linear": schedules.linear}
+        if sched_name in fns:
+            schedule = fns[sched_name](lr, da, db)
+
+    names = {"momentum": optim.Momentum, "adam": optim.Adam,
+             "adamax": optim.AdaMax, "adagrad": optim.AdaGrad,
+             "decayed_adagrad": optim.DecayedAdaGrad,
+             "adadelta": optim.AdaDelta, "rmsprop": optim.RMSProp}
+    ctor = names[method.optim_name]
+    if schedule is not None:
+        kw["learning_rate_schedule"] = schedule
+    return ctor(learning_rate=lr, **kw)
+
+
+def _expand_file_list(file_list, config_dir):
+    """A train/test list is a text file of data-file paths (one per line,
+    reference convention), resolved against the cwd then the config dir; a
+    list/tuple of paths is passed through."""
+    if isinstance(file_list, (list, tuple)):
+        return list(file_list)
+    path = file_list
+    if not os.path.exists(path):
+        alt = os.path.join(config_dir, file_list)
+        if os.path.exists(alt):
+            path = alt
+        else:
+            raise ConfigError(f"data source list file not found: {file_list}")
+    base = os.path.dirname(os.path.abspath(path))
+    files = []
+    for line in open(path):
+        line = line.strip()
+        if not line:
+            continue
+        if not os.path.exists(line):
+            alt = os.path.join(base, line)
+            line = alt if os.path.exists(alt) else line
+        files.append(line)
+    return files
+
+
+def _make_reader(src, config_dir, batch_size):
+    """Build a batched reader + feeding dict from a recorded data source."""
+    mod = _import_provider(src["module"], config_dir)
+    factory = getattr(mod, src["obj"]) if isinstance(src["obj"], str) \
+        else src["obj"]
+    files = _expand_file_list(src["file_list"], config_dir)
+    sample_reader = factory(files, **(src.get("args") or {}))
+    input_types = getattr(sample_reader, "input_types", None)
+
+    def batched():
+        batch = []
+        for sample in sample_reader():
+            batch.append(sample)
+            if len(batch) == batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+    return batched, input_types
+
+
+def _feeding_dict(input_types, input_order):
+    """{name: InputType} in data-layer declaration order (list-style
+    input_types pair positionally with the declared data layers, the
+    reference's inputs() ordering)."""
+    if input_types is None:
+        return None
+    if isinstance(input_types, dict):
+        if input_order:
+            ordered = {n: input_types[n] for n in input_order
+                       if n in input_types}
+            if len(ordered) == len(input_types):
+                return ordered
+        return dict(input_types)
+    pairs = zip(input_order, list(input_types))
+    return dict(pairs)
+
+
+def config_to_runtime(parsed: ParsedConfig, for_test=False):
+    """Lower a ParsedConfig to the CLI trainer's cfg-dict contract."""
+    batch_size = parsed.settings.get("batch_size", 256)
+    cfg = {
+        "cost": (parsed.outputs[0] if len(parsed.outputs) == 1
+                 else list(parsed.outputs)),
+        "optimizer": _make_optimizer(parsed.settings),
+        "batch_size": batch_size,
+        "evaluators": list(parsed.evaluators),
+    }
+    feeding = None
+    if "train" in parsed.data_sources:
+        reader, input_types = _make_reader(parsed.data_sources["train"],
+                                           parsed.config_dir, batch_size)
+        cfg["train_reader"] = reader
+        feeding = _feeding_dict(input_types, parsed.input_order)
+    if "test" in parsed.data_sources:
+        reader, input_types = _make_reader(parsed.data_sources["test"],
+                                           parsed.config_dir, batch_size)
+        cfg["test_reader"] = reader
+        if feeding is None:
+            feeding = _feeding_dict(input_types, parsed.input_order)
+    if feeding:
+        cfg["feeding"] = feeding
+    return cfg

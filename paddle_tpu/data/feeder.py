@@ -46,6 +46,10 @@ class DataFeeder:
         self.pad_batch_to = pad_batch_to
 
     def _convert_one(self, name, itype: InputType, columns):
+        # py2-era providers yield lazy iterables (map objects etc.)
+        columns = [list(c) if not isinstance(c, (list, tuple, np.ndarray,
+                                                 int, float, np.integer))
+                   and hasattr(c, "__iter__") else c for c in columns]
         if itype.seq_type == SeqType.NO_SEQUENCE:
             if itype.kind == "index":
                 return np.asarray(columns, dtype=np.int32).reshape(len(columns))

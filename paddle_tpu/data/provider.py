@@ -68,12 +68,20 @@ class CacheType(Enum):
 
 
 def provider(input_types=None, cache=CacheType.NO_CACHE, should_shuffle=None,
-             min_pool_size=-1, **outer_kwargs):
+             init_hook=None, min_pool_size=-1, pool_size=-1,
+             calc_batch_size=None, check=False, check_fail_continue=False,
+             **outer_kwargs):
     """@provider(input_types={'word': integer_value_sequence(dict_len), ...})
 
     The wrapped generator has signature gen(settings, filename) and yields
     dicts keyed by input name (or tuples in declaration order).  Returns a
     reader factory: fn(filenames) -> reader compatible with trainer.SGD.
+
+    init_hook (reference PyDataProvider2.py provider(init_hook=...)): called
+    as init_hook(settings, **args) before reading, and may fill
+    settings.input_types itself (the quick_start dataprovider_bow pattern).
+    pool_size/calc_batch_size/check* are accepted for config compatibility;
+    shuffling/pooling is the reader pipeline's job here.
     """
     def deco(gen):
         @functools.wraps(gen)
@@ -86,8 +94,13 @@ def provider(input_types=None, cache=CacheType.NO_CACHE, should_shuffle=None,
             settings = Settings()
             settings.input_types = input_types
             settings.logger = __import__("logging").getLogger("provider")
-            for k, v in {**outer_kwargs, **kw}.items():
-                setattr(settings, k, v)
+            if init_hook is not None:
+                # reference PyDataProvider2 passes file_list to the hook
+                init_hook(settings, file_list=files,
+                          **{**outer_kwargs, **kw})
+            else:
+                for k, v in {**outer_kwargs, **kw}.items():
+                    setattr(settings, k, v)
 
             cached = []
 
@@ -100,7 +113,10 @@ def provider(input_types=None, cache=CacheType.NO_CACHE, should_shuffle=None,
                         if cache == CacheType.CACHE_PASS_IN_MEM:
                             cached.append(sample)
                         yield sample
-            reader.input_types = input_types
+            # init_hook may have replaced settings.input_types ('slots' is
+            # the reference's legacy alias for the same field)
+            reader.input_types = (getattr(settings, "input_types", None)
+                                  or getattr(settings, "slots", None))
             return reader
         make_reader.input_types = input_types
         return make_reader

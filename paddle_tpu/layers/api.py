@@ -126,8 +126,14 @@ class _FcImpl:
     def init(self, rng, cfg, in_sizes):
         p = {}
         rngs = jax.random.split(rng, len(in_sizes) + 1)
+        pa = cfg.get("param_attr")
+        # reference fc_layer accepts one ParamAttr per input (sentiment's
+        # stacked_lstm_net passes [fc_attr, lstm_attr])
+        pas = (list(pa) if isinstance(pa, (list, tuple))
+               else [pa] * len(in_sizes))
         for i, isz in enumerate(in_sizes):
-            p[f"w{i}"] = _winit(cfg.get("param_attr"))(rngs[i], (isz, cfg["size"]))
+            p[f"w{i}"] = _winit(pas[i % len(pas)])(rngs[i],
+                                                   (isz, cfg["size"]))
         b = _maybe_bias(rngs[-1], cfg.get("bias_attr", True), cfg["size"])
         if b is not None:
             p["b"] = b
@@ -358,8 +364,7 @@ def dotmul_operator(a, b, scale=1.0):
     return _Part("dotmul_op", [a, b], {"scale": scale}, a.size)
 
 
-def mixed_layer(size=0, input=None, name=None, act=None, bias_attr=False,
-                layer_attr=None):
+def _collect_parts(input):
     parts = []
     for item in _inputs_list(input):
         if isinstance(item, list):
@@ -370,6 +375,10 @@ def mixed_layer(size=0, input=None, name=None, act=None, bias_attr=False,
             parts.append(identity_projection(item))
         else:
             raise ConfigError(f"bad mixed_layer input {item!r}")
+    return parts
+
+
+def _finalize_mixed(node, parts, size):
     if size == 0:
         size = max(p.out_size for p in parts)
     nodes = []
@@ -380,9 +389,60 @@ def mixed_layer(size=0, input=None, name=None, act=None, bias_attr=False,
             spec["vocab"] = p.inputs[0].size
         cfg_parts.append((p.kind, spec))
         nodes.extend(p.inputs)
-    cfg = {"size": size, "act": act, "bias_attr": bias_attr, "parts": cfg_parts}
+    node.size = int(size)
+    node.inputs = nodes
+    node.cfg.update({"size": size, "parts": cfg_parts})
+    return node
+
+
+class MixedLayer(LayerOutput):
+    """Deferred mixed layer supporting the reference's builder protocol:
+
+        with mixed_layer(size=d) as m:
+            m += full_matrix_projection(input=a)
+            m += identity_projection(input=b)
+
+    The `as` target IS the LayerOutput (used downstream after the with);
+    projections accumulate via += and the node finalizes on __exit__."""
+
+    def __init__(self, size, name, act, bias_attr, layer_attr):
+        super().__init__(name or auto_name("mixed"), "mixed", max(size, 1),
+                         [], {"size": size, "act": act,
+                              "bias_attr": bias_attr, "parts": []})
+        self.cfg.update(layer_attr or {})
+        self._parts = []
+        self._decl_size = size
+        self._finalized = False
+
+    def __iadd__(self, part):
+        if self._finalized:
+            raise ConfigError("mixed_layer already finalized")
+        self._parts.extend(_collect_parts(part))
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            if not self._parts:
+                raise ConfigError("empty mixed_layer: add projections "
+                                  "with += inside the with block")
+            _finalize_mixed(self, self._parts, self._decl_size)
+            self._finalized = True
+        return False
+
+
+def mixed_layer(size=0, input=None, name=None, act=None, bias_attr=False,
+                layer_attr=None):
+    if input is None:
+        return MixedLayer(size, name, act, bias_attr, layer_attr)
+    parts = _collect_parts(input)
+    cfg = {"size": size, "act": act, "bias_attr": bias_attr, "parts": []}
     cfg.update(layer_attr or {})
-    return LayerOutput(name or auto_name("mixed"), "mixed", size, nodes, cfg)
+    node = LayerOutput(name or auto_name("mixed"), "mixed", max(size, 1),
+                       [], cfg)
+    return _finalize_mixed(node, parts, size)
 
 
 # ------------------------------------------------------- elementwise layers
@@ -929,7 +989,15 @@ def _logits_view(node):
         return None
     cfg = dict(node.cfg)
     cfg["act"] = None
-    cfg["param_name"] = node.cfg.get("param_name", node.name)
+    # alias key must match Topology._param_key exactly (explicit param_name,
+    # else param_attr name, else layer name) or the alias layer inits and
+    # trains a second parameter set while prediction reads the original
+    if "param_name" in node.cfg:
+        key = node.cfg["param_name"]
+    else:
+        pa = node.cfg.get("param_attr")
+        key = pa["name"] if isinstance(pa, dict) and pa.get("name") else node.name
+    cfg["param_name"] = key
     return LayerOutput(auto_name(node.name + "_logits"), node.layer_type,
                        node.size, node.inputs, cfg, is_seq=node.is_seq,
                        num_filters=node.num_filters, img_shape=node.img_shape)

@@ -80,14 +80,20 @@ class _BeamSearchImpl:
         return 1   # value rows are generated token ids
 
     def init(self, rng, cfg, in_sizes):
-        return {"__sub__": cfg["sub_topo"].init(rng)}
+        # step-layer params are hoisted to the top level by
+        # Topology._init_into, keyed by their own param-sharing names — a
+        # decoder trained via recurrent_group feeds its weights straight
+        # into generation when the step layers share names (the reference's
+        # RecurrentGradientMachine generation mode shares all step-layer
+        # params by name the same way)
+        return {}
 
     def apply(self, ctx, cfg, params, emb_w, *inputs):
         gen: GeneratedInput = cfg["gen"]
         sub_topo: Topology = cfg["sub_topo"]
         statics = list(inputs[:cfg["n_static"]])
         boots = list(inputs[cfg["n_static"]:])
-        sub_params = params["__sub__"]
+        sub_params = ctx.params
 
         if statics:
             bsz = value_data(statics[0]).shape[0]
@@ -120,6 +126,8 @@ class _BeamSearchImpl:
                 boot_vals.append(jnp.zeros((bsz * k, ph.size)))
 
         mode, rng_ = ctx.mode, ctx.rng
+        link_nodes = [ln for _, ln, _, _ in cfg["links"]]
+        n_out = len(cfg["outs"])
 
         def step_fn(mems, prev_ids):
             word_emb = emb_ops.embedding_lookup(emb_w, prev_ids)
@@ -128,11 +136,13 @@ class _BeamSearchImpl:
                 feed[ph.name] = s
             for (ph, _, _, _), m in zip(cfg["links"], mems):
                 feed[ph.name] = m
-            out = sub_topo.apply(sub_params, feed, mode=mode, rng=rng_)
-            outs = out if isinstance(out, tuple) else (out,)
-            cache = dict(zip((o.name for o in cfg["outs"]), outs))
-            new_mems = rec.new_memory_values(cfg["links"], cache, sub_params,
-                                             feed, mode, rng_)
+            # memory-link values come back as extra outputs of the SAME
+            # apply — no per-link re-evaluation of the sub-graph
+            vals = sub_topo.apply(sub_params, feed, mode=mode, rng=rng_,
+                                  extra_outputs=link_nodes)
+            vals = vals if isinstance(vals, tuple) else (vals,)
+            outs = vals[:n_out]
+            new_mems = [value_data(v) for v in vals[n_out:]]
             probs = value_data(outs[0])
             log_probs = jnp.log(jnp.maximum(probs, 1e-20))
             return log_probs, tuple(new_mems)

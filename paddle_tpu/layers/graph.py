@@ -125,12 +125,18 @@ class Context:
     """Per-apply execution context: mode, rng, mutable-state collection
     (batch-norm moving stats thread through here, functionally)."""
 
-    def __init__(self, mode="train", rng=None, state=None):
+    def __init__(self, mode="train", rng=None, state=None, params=None):
         self.mode = mode                  # "train" | "test"
         self.rng = rng
         self.state_in = state or {}       # {layer_name: pytree} (e.g. BN stats)
         self.state_out = {}
         self.aux = {}                     # scratch (e.g. recurrent_group outputs)
+        # full top-level params dict: container layers (recurrent_group,
+        # beam_search) apply their step sub-graphs against this, so step-layer
+        # params live at top level under their own param-sharing keys and flow
+        # between training groups and generation (reference shares by layer
+        # name across sub-models the same way, config_parser.py sub_models)
+        self.params = params
 
     def is_train(self):
         return self.mode == "train"
@@ -152,6 +158,28 @@ class Context:
 
 
 # ---------------------------------------------------------------- helpers
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _error_clip(x, threshold):
+    """Identity forward; backward clips the incoming gradient to
+    [-threshold, threshold] elementwise (reference ExtraLayerAttribute
+    error_clipping_threshold, Layer.cpp backwardActivation clipping)."""
+    return x
+
+
+def _error_clip_fwd(x, threshold):
+    return x, None
+
+
+def _error_clip_bwd(threshold, _, g):
+    return (jnp.clip(g, -threshold, threshold),)
+
+
+_error_clip.defvjp(_error_clip_fwd, _error_clip_bwd)
+
 
 def value_data(v):
     return v.data if isinstance(v, SequenceBatch) else v
@@ -213,18 +241,30 @@ class Topology:
         """Initialize all parameters: {layer_name: {param_name: array}}.
 
         Layers with shared parameters (cfg['param_name']) alias the same
-        entry keyed by that shared name."""
+        entry keyed by that shared name.  Step sub-graphs of container layers
+        (recurrent_group / beam_search) are initialized INTO the same
+        top-level dict under their own param-sharing keys, so a decoder
+        trained via recurrent_group and its generation-mode beam_search read
+        the same weights when their step layers share names."""
         params = {}
+        self._init_into(params, rng)
+        return params
+
+    def _init_into(self, params, rng):
         for node in self.order:
+            sub = node.cfg.get("sub_topo")
+            if isinstance(sub, Topology):
+                rng, sk = jax.random.split(rng)
+                sub._init_into(params, sk)
             impl = get_impl(node.layer_type)
             in_sizes = [i.size for i in node.inputs]
-            rng, sub = jax.random.split(rng)
-            p = impl.init(sub, node.cfg, in_sizes)
+            rng, sub_rng = jax.random.split(rng)
+            p = impl.init(sub_rng, node.cfg, in_sizes)
             if p:
                 key = self._param_key(node)
                 if key not in params:
                     params[key] = p
-        return params
+        return rng
 
     def _param_key(self, node):
         """Parameter-sharing key: explicit cfg['param_name'], else a
@@ -240,7 +280,7 @@ class Topology:
     def apply(self, params, feed, mode="train", rng=None, state=None,
               return_state=False, extra_outputs=()):
         """Run the graph.  feed: {data_layer_name: array|SequenceBatch}."""
-        ctx = Context(mode=mode, rng=rng, state=state)
+        ctx = Context(mode=mode, rng=rng, state=state, params=params)
         cache = {}
         for node in self.order:
             if node.layer_type == "data":
@@ -257,7 +297,22 @@ class Topology:
             ins = [cache[id(i)] for i in node.inputs]
             p = params.get(self._param_key(node), {})
             try:
-                cache[id(node)] = impl.apply(ctx, node.cfg, p, *ins)
+                val = impl.apply(ctx, node.cfg, p, *ins)
+                # reference ExtraLayerAttribute(drop_rate=...) applies to any
+                # layer's output; fc/mixed/dropout handle it inside their
+                # impls, everything else gets it here
+                rate = node.cfg.get("drop_rate", 0.0)
+                if (rate and ctx.is_train()
+                        and node.layer_type not in ("fc", "mixed", "dropout")):
+                    def _drop(x, rate=rate):
+                        keep = jax.random.bernoulli(ctx.next_rng(),
+                                                    1.0 - rate, x.shape)
+                        return jnp.where(keep, x / (1.0 - rate), 0.0)
+                    val = map_rows(_drop, val)
+                ect = node.cfg.get("error_clipping_threshold")
+                if ect:
+                    val = map_rows(lambda d: _error_clip(d, float(ect)), val)
+                cache[id(node)] = val
             except Exception as e:
                 # the reference dumps the active layer-name stack on FATAL
                 # (utils/CustomStackTrace.h, pushed NeuralNetwork.cpp:247);

@@ -84,11 +84,24 @@ def simple_lstm(input, size, reverse=False, act="tanh", gate_act="sigmoid",
 
 
 def simple_gru(input, size, reverse=False, act="tanh", gate_act="sigmoid",
-               name=None):
-    mix = fc_layer(input, size=size * 3, act=None, bias_attr=False,
+               name=None, mixed_param_attr=None, mixed_bias_param_attr=None,
+               mixed_layer_attr=None, gru_bias_attr=True,
+               gru_param_attr=None, gru_layer_attr=None, naive=False):
+    """Reference simple_gru: fc (3*size) -> grumemory.  `naive` selects the
+    reference's gru_step_naive (mixed-layer formulation so attrs apply); XLA
+    fuses both formulations identically, so it only affects attrs here."""
+    mix = fc_layer(input, size=size * 3, act=None,
+                   bias_attr=(mixed_bias_param_attr
+                              if mixed_bias_param_attr is not None else False),
+                   param_attr=mixed_param_attr,
+                   layer_attr=mixed_layer_attr,
                    name=name and f"{name}_transform")
-    return grumemory(mix, size=size, reverse=reverse, act=act,
-                     gate_act=gate_act, name=name)
+    node = grumemory(mix, size=size, reverse=reverse, act=act or "tanh",
+                     gate_act=gate_act or "sigmoid", name=name,
+                     bias_attr=gru_bias_attr, param_attr=gru_param_attr)
+    if gru_layer_attr:
+        node.cfg.update(gru_layer_attr)
+    return node
 
 
 def bidirectional_lstm(input, size, name=None, return_seq=False):

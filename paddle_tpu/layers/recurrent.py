@@ -186,21 +186,6 @@ def resolve_memory_links(sub_topo, memories):
     return links
 
 
-def new_memory_values(links, cache, sub_params, feed, mode, rng):
-    """Next-step memory values: the linked layer's value from this step's
-    outputs, re-evaluating the sub-graph only for links that aren't already
-    step outputs (shared by recurrent_group and the generation DSL)."""
-    new_mems = []
-    for ph, link_node, _, _ in links:
-        if link_node.name in cache:
-            new_mems.append(value_data(cache[link_node.name]))
-        else:
-            v = Topology([link_node]).apply(sub_params, feed, mode=mode,
-                                            rng=rng)
-            new_mems.append(value_data(v))
-    return new_mems
-
-
 def memory(name, size, boot_layer=None, boot_with_const_id=None,
            is_seq=False):
     """Previous-step output of the layer called `name` (reference memory()
@@ -279,7 +264,9 @@ class _RecurrentGroupImpl:
         return cfg["outs"][0].size
 
     def init(self, rng, cfg, in_sizes):
-        return {"__sub__": cfg["sub_topo"].init(rng)}
+        # step-layer params are hoisted to the top level by
+        # Topology._init_into (shared with generation mode by name)
+        return {}
 
     def apply(self, ctx, cfg, params, *inputs):
         sub_topo: Topology = cfg["sub_topo"]
@@ -287,7 +274,7 @@ class _RecurrentGroupImpl:
         seqs = [as_seq(v) for v in inputs[:n_seq]]
         statics = list(inputs[n_seq:n_seq + n_static])
         boots = list(inputs[n_seq + n_static:])
-        sub_params = params["__sub__"]
+        sub_params = ctx.params
 
         ref = seqs[0]
         bsz = ref.data.shape[0]
@@ -304,9 +291,14 @@ class _RecurrentGroupImpl:
             else:
                 boot_vals.append(jnp.zeros((bsz, ph.size)))
 
-        mode, rng_ = ctx.mode, ctx.rng
+        mode = ctx.mode
+        # independent key per scan step (folded in by rnn_ops.recurrent_group)
+        # so per-step dropout masks decorrelate across time
+        group_rng = ctx.next_rng() if ctx.rng is not None else None
+        link_nodes = [ln for _, ln, _, _ in cfg["links"]]
+        n_out = len(cfg["outs"])
 
-        def step_fn(mems, frames):
+        def step_fn(mems, frames, step_rng=None):
             feed = {}
             for ph, frame in zip(cfg["seq_phs"], frames):
                 feed[ph.name] = frame
@@ -314,16 +306,23 @@ class _RecurrentGroupImpl:
                 feed[ph.name] = s
             for (ph, _, _, _), m in zip(cfg["links"], mems):
                 feed[ph.name] = m
-            out_vals = sub_topo.apply(sub_params, feed, mode=mode, rng=rng_)
-            out_vals = out_vals if isinstance(out_vals, tuple) else (out_vals,)
-            cache = dict(zip((o.name for o in cfg["outs"]), out_vals))
-            new_mems = new_memory_values(cfg["links"], cache, sub_params,
-                                         feed, mode, rng_)
+            # memory-link values come back as extra outputs of the SAME
+            # apply — no per-link re-evaluation of the sub-graph
+            vals = sub_topo.apply(sub_params, feed, mode=mode, rng=step_rng,
+                                  extra_outputs=link_nodes)
+            vals = vals if isinstance(vals, tuple) else (vals,)
+            out_vals = vals[:n_out]
+            new_mems = [value_data(v) for v in vals[n_out:]]
             return tuple(new_mems), tuple(value_data(v) for v in out_vals)
 
-        outs, _ = rnn_ops.recurrent_group(step_fn, tuple(seqs),
+        if group_rng is None:
+            step = lambda mems, frames: step_fn(mems, frames)  # noqa: E731
+        else:
+            step = step_fn
+        outs, _ = rnn_ops.recurrent_group(step, tuple(seqs),
                                           tuple(boot_vals),
-                                          reverse=cfg["reverse"])
+                                          reverse=cfg["reverse"],
+                                          rng=group_rng)
         # rnn_ops.recurrent_group maps over the input pytree; our step_fn
         # consumed a tuple of SequenceBatches and returned a tuple of outputs.
         # NB: SequenceBatch is itself a (named) tuple — test explicitly.

@@ -6,12 +6,15 @@ The DSL-based demo scripts (v1-config parity) live in /demo; these modules
 are the fast path used by bench.py and __graft_entry__.py.
 """
 
+from paddle_tpu.models import alexnet
+from paddle_tpu.models import googlenet
 from paddle_tpu.models import lenet
 from paddle_tpu.models import resnet
+from paddle_tpu.models import smallnet
 from paddle_tpu.models import text_lstm
 from paddle_tpu.models import seq2seq
 from paddle_tpu.models import transformer
 from paddle_tpu.models import recommendation
 
-__all__ = ["lenet", "resnet", "text_lstm", "seq2seq", "transformer",
-           "recommendation"]
+__all__ = ["alexnet", "googlenet", "lenet", "resnet", "smallnet",
+           "text_lstm", "seq2seq", "transformer", "recommendation"]

@@ -1,0 +1,75 @@
+"""AlexNet — the reference's image benchmark config
+(benchmark/paddle/image/alexnet.py: 3x227x227, conv1 96@11s4p1 + cmrnorm +
+pool, conv2 256@5p2 + cmrnorm + pool, conv3/4 384@3p1, conv5 256@3p1 + pool,
+fc4096 x2 with dropout 0.5, fc1000 softmax; BASELINE.md AlexNet bs=64 ->
+195 ms/batch on K40m).
+
+Functional NHWC implementation; LRN is the cross-map variant the reference's
+img_cmrnorm_layer uses.  Dropout only applies when an rng is passed.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import linear, losses
+
+
+_CONVS = [
+    # name, k, cin, cout, stride, pad, lrn_after, pool_after
+    ("c1", 11, 3, 96, 4, 1, True, True),
+    ("c2", 5, 96, 256, 1, 2, True, True),
+    ("c3", 3, 256, 384, 1, 1, False, False),
+    ("c4", 3, 384, 384, 1, 1, False, False),
+    ("c5", 3, 384, 256, 1, 1, False, True),
+]
+
+
+def _conv_init(rng, k, cin, cout):
+    fan = k * k * cin
+    return (2.0 / fan) ** 0.5 * jax.random.normal(
+        rng, (k, k, cin, cout), jnp.float32)
+
+
+def init(rng, num_classes=1000, fc_dim=4096):
+    keys = iter(jax.random.split(rng, 16))
+    params = {}
+    for name, k, cin, cout, *_ in _CONVS:
+        params[name] = {"w": _conv_init(next(keys), k, cin, cout),
+                        "b": jnp.zeros((cout,))}
+    # 227 -> conv s4 p1 -> 55 -> pool3s2 -> 27 -> pool -> 13 -> pool -> 6
+    flat = 6 * 6 * 256
+    params["fc1"] = {"w": 0.01 * jax.random.normal(next(keys), (flat, fc_dim)),
+                     "b": jnp.zeros((fc_dim,))}
+    params["fc2"] = {"w": 0.01 * jax.random.normal(next(keys), (fc_dim, fc_dim)),
+                     "b": jnp.zeros((fc_dim,))}
+    params["out"] = {"w": 0.01 * jax.random.normal(next(keys),
+                                                   (fc_dim, num_classes)),
+                     "b": jnp.zeros((num_classes,))}
+    return params, {}
+
+
+def forward(params, state, images, train=True, rng=None, drop_rate=0.5):
+    """images: [B, 227, 227, 3] NHWC.  Returns (logits, state)."""
+    x = images
+    for name, k, cin, cout, stride, pad, lrn, pool in _CONVS:
+        p = params[name]
+        x = conv_ops.conv2d(x, p["w"], p["b"], stride=(stride, stride),
+                            padding=(pad, pad), act="relu")
+        if lrn:
+            x = conv_ops.lrn_cross_map(x, size=5, scale=1e-4, power=0.75)
+        if pool:
+            x = conv_ops.max_pool2d(x, (3, 3), (2, 2))
+    x = x.reshape(x.shape[0], -1)
+    for fc in ("fc1", "fc2"):
+        x = linear.fc(x, params[fc]["w"], params[fc]["b"], act="relu")
+        if train and rng is not None:
+            rng, sub = jax.random.split(rng)
+            keep = jax.random.bernoulli(sub, 1.0 - drop_rate, x.shape)
+            x = jnp.where(keep, x / (1.0 - drop_rate), 0.0)
+    return linear.fc(x, params["out"]["w"], params["out"]["b"]), state
+
+
+def loss(params, state, images, labels, train=True, rng=None):
+    logits, new_state = forward(params, state, images, train=train, rng=rng)
+    return jnp.mean(losses.classification_cost(logits, labels)), new_state

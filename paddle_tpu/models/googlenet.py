@@ -1,0 +1,102 @@
+"""GoogleNet (Inception v1) — the reference's heaviest image benchmark
+(benchmark/paddle/image/googlenet.py: 3x224x224, stem 7x7s2 + pools, nine
+inception modules 3a..5b, global avg pool, fc1000; BASELINE.md GoogleNet
+bs=64 -> 613 ms/batch on K40m).
+
+Functional NHWC implementation.  The four inception branches are independent
+convs concatenated on the channel axis — XLA fuses the elementwise tails and
+the MXU takes the (large, batched) conv contractions.  No batch norm, as in
+the reference config (Inception v1 predates BN); the auxiliary classifiers
+the paper describes (and the reference omits) are likewise omitted.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import linear, losses
+
+# name -> (1x1, 3x3reduce, 3x3, 5x5reduce, 5x5, poolproj)
+_INCEPTION = [
+    ("3a", 64, 96, 128, 16, 32, 32),
+    ("3b", 128, 128, 192, 32, 96, 64),
+    ("pool3",),
+    ("4a", 192, 96, 208, 16, 48, 64),
+    ("4b", 160, 112, 224, 24, 64, 64),
+    ("4c", 128, 128, 256, 24, 64, 64),
+    ("4d", 112, 144, 288, 32, 64, 64),
+    ("4e", 256, 160, 320, 32, 128, 128),
+    ("pool4",),
+    ("5a", 256, 160, 320, 32, 128, 128),
+    ("5b", 384, 192, 384, 48, 128, 128),
+]
+
+
+def _conv_init(rng, k, cin, cout):
+    fan = k * k * cin
+    return {"w": (2.0 / fan) ** 0.5 * jax.random.normal(
+        rng, (k, k, cin, cout), jnp.float32), "b": jnp.zeros((cout,))}
+
+
+def init(rng, num_classes=1000):
+    keys = iter(jax.random.split(rng, 128))
+    params = {
+        "stem1": _conv_init(next(keys), 7, 3, 64),
+        "stem2": _conv_init(next(keys), 1, 64, 64),
+        "stem3": _conv_init(next(keys), 3, 64, 192),
+    }
+    cin = 192
+    for row in _INCEPTION:
+        if len(row) == 1:
+            continue
+        name, c1, c3r, c3, c5r, c5, cp = row
+        params[name] = {
+            "b1": _conv_init(next(keys), 1, cin, c1),
+            "b3r": _conv_init(next(keys), 1, cin, c3r),
+            "b3": _conv_init(next(keys), 3, c3r, c3),
+            "b5r": _conv_init(next(keys), 1, cin, c5r),
+            "b5": _conv_init(next(keys), 5, c5r, c5),
+            "bp": _conv_init(next(keys), 1, cin, cp),
+        }
+        cin = c1 + c3 + c5 + cp
+    params["head"] = {"w": 0.01 * jax.random.normal(next(keys),
+                                                    (cin, num_classes)),
+                      "b": jnp.zeros((num_classes,))}
+    return params, {}
+
+
+def _cv(x, p, stride=1, pad=0):
+    return conv_ops.conv2d(x, p["w"], p["b"], stride=(stride, stride),
+                           padding=(pad, pad), act="relu")
+
+
+def _inception(x, p):
+    b1 = _cv(x, p["b1"])
+    b3 = _cv(_cv(x, p["b3r"]), p["b3"], pad=1)
+    b5 = _cv(_cv(x, p["b5r"]), p["b5"], pad=2)
+    bp = _cv(conv_ops.max_pool2d(x, (3, 3), (1, 1), (1, 1)), p["bp"])
+    return jnp.concatenate([b1, b3, b5, bp], axis=-1)
+
+
+def forward(params, state, images, train=True, rng=None, drop_rate=0.4):
+    """images: [B, 224, 224, 3] NHWC.  Returns (logits, state)."""
+    x = _cv(images, params["stem1"], stride=2, pad=3)
+    x = conv_ops.max_pool2d(x, (3, 3), (2, 2), (1, 1))
+    x = _cv(x, params["stem2"])
+    x = _cv(x, params["stem3"], pad=1)
+    x = conv_ops.max_pool2d(x, (3, 3), (2, 2), (1, 1))
+    for row in _INCEPTION:
+        if len(row) == 1:
+            x = conv_ops.max_pool2d(x, (3, 3), (2, 2), (1, 1))
+        else:
+            x = _inception(x, params[row[0]])
+    x = jnp.mean(x, axis=(1, 2))
+    if train and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - drop_rate, x.shape)
+        x = jnp.where(keep, x / (1.0 - drop_rate), 0.0)
+    return linear.fc(x, params["head"]["w"], params["head"]["b"]), state
+
+
+def loss(params, state, images, labels, train=True, rng=None):
+    logits, new_state = forward(params, state, images, train=train, rng=rng)
+    return jnp.mean(losses.classification_cost(logits, labels)), new_state

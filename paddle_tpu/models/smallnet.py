@@ -1,0 +1,53 @@
+"""SmallNet — the reference's CIFAR-quick benchmark config
+(benchmark/paddle/image/smallnet_mnist_cifar.py: 3x32x32, conv 32@5p2 +
+maxpool3s2p1, conv 32@5p2 + avgpool3s2p1, conv 64@3p1 + avgpool3s2p1,
+fc64, fc10 softmax; BASELINE.md SmallNet bs=64 -> 10.463 ms/batch on K40m).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import linear, losses
+
+
+def _conv_init(rng, k, cin, cout):
+    fan = k * k * cin
+    return {"w": (2.0 / fan) ** 0.5 * jax.random.normal(
+        rng, (k, k, cin, cout), jnp.float32), "b": jnp.zeros((cout,))}
+
+
+def init(rng, num_classes=10, in_channels=3):
+    keys = iter(jax.random.split(rng, 8))
+    params = {
+        "c1": _conv_init(next(keys), 5, in_channels, 32),
+        "c2": _conv_init(next(keys), 5, 32, 32),
+        "c3": _conv_init(next(keys), 3, 32, 64),
+        # 32 -> pool s2 p1 -> 16 -> 8 -> 4
+        "fc1": {"w": 0.1 * jax.random.normal(next(keys), (4 * 4 * 64, 64)),
+                "b": jnp.zeros((64,))},
+        "out": {"w": 0.1 * jax.random.normal(next(keys), (64, num_classes)),
+                "b": jnp.zeros((num_classes,))},
+    }
+    return params, {}
+
+
+def forward(params, state, images, train=True, rng=None):
+    """images: [B, 32, 32, 3] NHWC.  Returns (logits, state)."""
+    x = conv_ops.conv2d(images, params["c1"]["w"], params["c1"]["b"],
+                        padding=(2, 2), act="relu")
+    x = conv_ops.max_pool2d(x, (3, 3), (2, 2), (1, 1))
+    x = conv_ops.conv2d(x, params["c2"]["w"], params["c2"]["b"],
+                        padding=(2, 2), act="relu")
+    x = conv_ops.avg_pool2d(x, (3, 3), (2, 2), (1, 1))
+    x = conv_ops.conv2d(x, params["c3"]["w"], params["c3"]["b"],
+                        padding=(1, 1), act="relu")
+    x = conv_ops.avg_pool2d(x, (3, 3), (2, 2), (1, 1))
+    x = x.reshape(x.shape[0], -1)
+    x = linear.fc(x, params["fc1"]["w"], params["fc1"]["b"], act="relu")
+    return linear.fc(x, params["out"]["w"], params["out"]["b"]), state
+
+
+def loss(params, state, images, labels, train=True, rng=None):
+    logits, new_state = forward(params, state, images, train=train, rng=rng)
+    return jnp.mean(losses.classification_cost(logits, labels)), new_state

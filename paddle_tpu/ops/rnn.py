@@ -161,13 +161,16 @@ def simple_rnn(seq: SequenceBatch, w_r, bias=None, reverse=False, act="tanh",
     return SequenceBatch(data=out, lengths=seq.lengths), final
 
 
-def recurrent_group(step_fn, inputs, boot_memories, reverse=False):
+def recurrent_group(step_fn, inputs, boot_memories, reverse=False, rng=None):
     """The generic dynamic-RNN engine (reference RecurrentGradientMachine
     forward :379 / createInFrameInfo :642).
 
     step_fn(memories, frame_inputs) -> (new_memories, frame_outputs), where
     `memories` is any pytree of [B, ...] arrays (the reference's memory()
     links with boot layers) and frame_inputs is a pytree of per-step slices.
+    With rng= given, step_fn is called as step_fn(memories, frame_inputs,
+    step_rng) where step_rng is an INDEPENDENT key per timestep (so dropout
+    masks inside the step decorrelate across time).
 
     inputs: pytree of SequenceBatch sharing lengths; scanned time-major.
     Returns (pytree of SequenceBatch outputs, final memories).
@@ -184,17 +187,30 @@ def recurrent_group(step_fn, inputs, boot_memories, reverse=False):
         lambda sb: sb.data.transpose((1, 0) + tuple(range(2, sb.data.ndim))),
         inputs, is_leaf=lambda x: isinstance(x, SequenceBatch))
 
-    def body(mem, scanned):
-        x, m = scanned
-        new_mem, out = step_fn(mem, x)
-        merged = jax.tree_util.tree_map(
+    def merge(mem, new_mem, m):
+        return jax.tree_util.tree_map(
             lambda new, old: jnp.where(
                 m.reshape((-1,) + (1,) * (new.ndim - 1)) > 0, new, old),
             new_mem, mem)
-        return merged, out
 
-    final_mem, outs_tm = jax.lax.scan(body, boot_memories, (xs_tm, mask_tm),
-                                      reverse=reverse)
+    if rng is not None:
+        keys_tm = jax.random.split(rng, ref.data.shape[1])   # [T, 2]
+
+        def body(mem, scanned):
+            x, m, k = scanned
+            new_mem, out = step_fn(mem, x, k)
+            return merge(mem, new_mem, m), out
+
+        final_mem, outs_tm = jax.lax.scan(
+            body, boot_memories, (xs_tm, mask_tm, keys_tm), reverse=reverse)
+    else:
+        def body(mem, scanned):
+            x, m = scanned
+            new_mem, out = step_fn(mem, x)
+            return merge(mem, new_mem, m), out
+
+        final_mem, outs_tm = jax.lax.scan(
+            body, boot_memories, (xs_tm, mask_tm), reverse=reverse)
     outs = jax.tree_util.tree_map(
         lambda o: SequenceBatch(
             data=o.transpose((1, 0) + tuple(range(2, o.ndim)))

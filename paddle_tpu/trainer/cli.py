@@ -23,10 +23,16 @@ import sys
 
 
 def _load_config(path, config_args):
-    ns = runpy.run_path(path, init_globals={"CONFIG_ARGS": config_args})
-    if "get_config" not in ns:
-        raise SystemExit(f"{path} must define get_config()")
-    return ns["get_config"]()
+    """Native configs define get_config(); reference-style v1 configs
+    (`from paddle.trainer_config_helpers import *` + settings/outputs) run
+    through the config compiler (paddle_tpu.compat) unchanged."""
+    src = open(path).read()
+    if "def get_config" in src:
+        ns = runpy.run_path(path, init_globals={"CONFIG_ARGS": config_args})
+        if "get_config" in ns:
+            return ns["get_config"]()
+    from paddle_tpu.compat import parse_config, config_to_runtime
+    return config_to_runtime(parse_config(path, config_args))
 
 
 def _parse_config_args(s):
@@ -83,6 +89,12 @@ def main(argv=None):
 
     args = parser.parse_args(argv)
 
+    # honor JAX_PLATFORMS even where a sitecustomize hook pins the
+    # jax_platforms *config* at interpreter startup (env var alone loses)
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     if args.job == "version":
         from paddle_tpu.version import __version__
         import jax
@@ -129,7 +141,8 @@ def main(argv=None):
                                     seq=args.seq_parallel))
     trainer = SGD(cost=cfg["cost"], update_equation=cfg["optimizer"],
                   mesh=mesh,
-                  sharding_rules=cfg.get("sharding_rules"))
+                  sharding_rules=cfg.get("sharding_rules"),
+                  evaluators=cfg.get("evaluators"))
 
     if args.job == "train":
         save_dir = args.save_dir or cfg.get("save_dir")

@@ -201,16 +201,16 @@ class SGD:
                 self.rng, step_rng = jax.random.split(self.rng)
                 if self._step_fn is None:
                     self._build_step(feed)
-                with timer("train_step") as st:
+                t_step = time.perf_counter()
+                with timer("train_step"):
                     (self.parameters, self.opt_state, self.model_state,
                      cost, extras) = self._step_fn(
                         self.parameters, self.opt_state, self.model_state,
                         feed, step_rng)
-                # per-step distribution (BarrierStat skew-profiling role)
+                # per-step distribution (BarrierStat skew-profiling role):
+                # record this step's own delta, not the cumulative timer
                 from paddle_tpu.utils.stats import step_histogram
-                if st.count:
-                    step_histogram.add(st.total / st.count if st.count == 1
-                                       else 0.0)
+                step_histogram.add(time.perf_counter() - t_step)
                 cost_sum = cost_sum + cost
                 n_batches += 1
                 window.append(cost)

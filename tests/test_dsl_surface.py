@@ -3,9 +3,12 @@ network composites (gru_group vs grumemory equivalence — the reference's
 test_RecurrentGradientMachine discipline), conv projection/operator, and
 evaluator DSL wired through SGD.train."""
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 import paddle_tpu.layers as L
 from paddle_tpu import optim
@@ -21,13 +24,19 @@ def setup_function(_):
     reset_names()
 
 
+_REFERENCE = os.environ.get("PADDLE_REFERENCE_DIR", "/root/reference")
+
+
+@pytest.mark.skipif(
+    not os.path.exists(f"{_REFERENCE}/python/paddle/trainer_config_helpers"),
+    reason="reference checkout not available")
 def test_layer_surface_covers_reference_all():
     """Every name in the reference trainer_config_helpers __all__ lists
     (layers + networks) resolves on paddle_tpu.layers."""
     import re
     missing = []
     for rel in ("layers.py", "networks.py"):
-        src = open(f"/root/reference/python/paddle/"
+        src = open(f"{_REFERENCE}/python/paddle/"
                    f"trainer_config_helpers/{rel}").read()
         m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
         for name in re.findall(r"['\"]([^'\"]+)['\"]", m.group(1)):
@@ -45,7 +54,7 @@ def test_gru_group_matches_grumemory(rng, np_rng):
     grouped = N.gru_group(mix, size=4, name="gru_grp")
     topo = Topology([whole, grouped])
     params = topo.init(rng)
-    gp = params[grouped.name]["__sub__"]["gru_grp_out"]
+    gp = params["gru_grp_out"]
     wp = params["gru_whole"]
     gp["w_gate"], gp["w_state"], gp["b"] = (wp["w_gate"], wp["w_state"],
                                             wp["b"])

@@ -142,7 +142,8 @@ def test_recurrent_group_matches_grumemory(rng, np_rng):
     topo = Topology([whole, grouped])
     params = topo.init(rng)
     # share weights: copy whole-seq params into the group's step params
-    gp = params[grouped.name]["__sub__"]["gru_out"]
+    # (step-layer params live at top level under their own keys)
+    gp = params["gru_out"]
     wp = params["gru_whole"]
     gp["w_gate"] = wp["w_gate"]
     gp["w_state"] = wp["w_state"]
