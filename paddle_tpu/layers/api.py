@@ -182,12 +182,24 @@ class _EmbeddingImpl:
 register_layer("embedding")(_EmbeddingImpl)
 
 
-def embedding_layer(input, size, name=None, param_attr=None):
-    """input: a data layer of integer ids (its .size = vocab size)."""
+def embedding_layer(input, size, name=None, param_attr=None,
+                    sparse_update=None, sparse_budget=None):
+    """input: a data layer of integer ids (its .size = vocab size).
+
+    sparse_update=True (reference ParameterAttribute sparse_update /
+    SparseRowMatrix): the trainer gathers only the rows touched this batch,
+    differentiates and optimizer-updates that [budget, D] block, and
+    scatters it back — step cost scales with touched rows, not vocab.
+    sparse_budget: static unique-row cap (default: batch token count rounded
+    up to a power of two)."""
+    if sparse_update is None and isinstance(param_attr, dict):
+        sparse_update = param_attr.get("sparse_update", False)
     return LayerOutput(name or auto_name("embedding"), "embedding", size,
                        [input],
                        cfg={"size": size, "vocab": input.size,
-                            "param_attr": param_attr})
+                            "param_attr": param_attr,
+                            "sparse_update": bool(sparse_update),
+                            "sparse_budget": sparse_budget})
 
 
 def table_projection(input, size, param_attr=None):

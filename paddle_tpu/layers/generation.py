@@ -46,12 +46,9 @@ class GeneratedInput(BaseGeneratedInput):
         self.eos_id = eos_id
 
 
-class SubsequenceInput:
-    """Marks a two-level sequence input for a nested recurrent_group
-    (reference SubsequenceInput). The outer group iterates subsequences."""
-
-    def __init__(self, input):
-        self.input = input
+from paddle_tpu.layers.recurrent import SubsequenceInput  # noqa: E402,F401
+# (re-exported here for the reference's import shape; the class lives with
+# the recurrent_group engine)
 
 
 class _SharedTableImpl:

@@ -27,7 +27,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.core.sequence import NestedSequenceBatch, SequenceBatch
 from paddle_tpu.utils.error import ConfigError
 
 _LAYER_IMPLS: Dict[str, "LayerImpl"] = {}
@@ -182,16 +182,23 @@ _error_clip.defvjp(_error_clip_fwd, _error_clip_bwd)
 
 
 def value_data(v):
-    return v.data if isinstance(v, SequenceBatch) else v
+    return v.data if isinstance(v, (SequenceBatch, NestedSequenceBatch)) \
+        else v
 
 
 def map_rows(fn, *values):
-    """Apply a row-wise fn to values that may be SequenceBatch or arrays.
-    If any input is a sequence, output is a SequenceBatch with its lengths."""
-    seq = next((v for v in values if isinstance(v, SequenceBatch)), None)
+    """Apply a row-wise fn to values that may be SequenceBatch,
+    NestedSequenceBatch, or arrays.  If any input is a (nested) sequence,
+    output keeps its lengths structure."""
+    seq = next((v for v in values
+                if isinstance(v, (SequenceBatch, NestedSequenceBatch))), None)
     datas = [value_data(v) for v in values]
     out = fn(*datas)
-    if seq is not None:
+    if isinstance(seq, NestedSequenceBatch):
+        return NestedSequenceBatch(data=out,
+                                   outer_lengths=seq.outer_lengths,
+                                   inner_lengths=seq.inner_lengths)
+    if isinstance(seq, SequenceBatch):
         return SequenceBatch(data=out, lengths=seq.lengths)
     return out
 

@@ -29,7 +29,7 @@ from paddle_tpu.utils.error import ConfigError
 
 __all__ = [
     "lstmemory", "grumemory", "recurrent_layer", "recurrent_group", "memory",
-    "StaticInput", "lstm_step_layer", "gru_step_layer",
+    "StaticInput", "SubsequenceInput", "lstm_step_layer", "gru_step_layer",
     "gru_step_naive_layer", "get_output_layer", "mdlstmemory",
 ]
 
@@ -165,6 +165,17 @@ class StaticInput:
         self.is_seq = is_seq  # True: the step sees the whole sequence
 
 
+class SubsequenceInput:
+    """Marks a two-level sequence input for a nested recurrent_group
+    (reference SubsequenceInput, RecurrentGradientMachine.cpp:642-712): the
+    outer group iterates SUBSEQUENCES — the step function sees each
+    subsequence as a whole SequenceBatch and can run an inner
+    recurrent_group over it."""
+
+    def __init__(self, input):
+        self.input = input
+
+
 class _GroupBuildCtx:
     current = None
 
@@ -206,13 +217,19 @@ def recurrent_group(step, input, reverse=False, name=None):
     step: fn(*step_inputs) -> LayerOutput or tuple of LayerOutputs.
     """
     ins = input if isinstance(input, (list, tuple)) else [input]
-    seq_inputs, static_inputs = [], []
+    seq_inputs, static_inputs, sub_inputs = [], [], []
     step_args = []
     for item in ins:
         if isinstance(item, StaticInput):
             ph = LayerOutput(auto_name("static_in"), "__static__",
                              item.input.size, [], {}, is_seq=item.is_seq)
             static_inputs.append((ph, item))
+            step_args.append(ph)
+        elif isinstance(item, SubsequenceInput):
+            # the step sees each SUBSEQUENCE as a whole SequenceBatch
+            ph = LayerOutput(auto_name("subseq_in"), "__step_input__",
+                             item.input.size, [], {}, is_seq=True)
+            sub_inputs.append((ph, item))
             step_args.append(ph)
         else:
             if not item.is_seq:
@@ -223,6 +240,10 @@ def recurrent_group(step, input, reverse=False, name=None):
                              item.size, [], {}, is_seq=False)
             seq_inputs.append((ph, item))
             step_args.append(ph)
+    if sub_inputs and seq_inputs:
+        raise ConfigError("recurrent_group cannot mix SubsequenceInput with "
+                          "flat sequence inputs (reference nested groups "
+                          "iterate subsequences only)")
 
     g = _GroupBuildCtx()
     prev = _GroupBuildCtx.current

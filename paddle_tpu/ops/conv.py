@@ -37,8 +37,10 @@ def _conv_f32acc(x, w, cfg_key):
     """conv with f32 accumulation (preferred_element_type=f32) whose backward
     keeps operand dtypes uniform: JAX's conv transpose rule rejects mixed
     (f32 cotangent, bf16 operand) pairs, so the bwd casts the cotangent to
-    the operand dtype and differentiates a same-dtype conv instead."""
-    return _conv_call(x, w, dict(cfg_key) | {"preferred": jnp.float32})
+    the operand dtype and differentiates a same-dtype conv instead.  f64
+    operands (gradient-check mode) keep their own precision."""
+    preferred = None if x.dtype == jnp.float64 else jnp.float32
+    return _conv_call(x, w, dict(cfg_key) | {"preferred": preferred})
 
 
 def _conv_f32acc_fwd(x, w, cfg_key):

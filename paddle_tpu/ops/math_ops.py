@@ -92,7 +92,8 @@ def multiplex(index, *xs):
     """Per-row select among K same-shaped inputs (reference MultiplexLayer).
     index: int [B]; xs: K arrays [B, D]."""
     stacked = jnp.stack(xs, axis=1)          # [B, K, D]
-    idx = jnp.clip(index.astype(jnp.int32), 0, len(xs) - 1)
+    idx = index.reshape(index.shape[0])      # accept [B] or [B, 1] columns
+    idx = jnp.clip(idx.astype(jnp.int32), 0, len(xs) - 1)
     return jnp.take_along_axis(stacked, idx[:, None, None], axis=1)[:, 0]
 
 

@@ -1,0 +1,55 @@
+"""Sparse-row embedding machinery (the large-vocab path).
+
+Reference: math/SparseRowMatrix.h:204 (rows touched this batch gathered into
+a dense buffer, updated, scattered back), trainer/RemoteParameterUpdater.h:265
+(sparse push/pull of touched rows only), parameter/FirstOrderOptimizer
+sparse update hooks.
+
+TPU-native design: the touched-id set is computed with `jnp.unique(size=K)`
+— a STATIC row budget keeps shapes XLA-compilable — and the train step
+differentiates with respect to the GATHERED [K, D] row block instead of the
+[V, D] table, so the gradient, the optimizer math, and the scatter-back all
+cost O(K·D) regardless of vocab size.  Unused budget slots get index == vocab
+and are dropped by out-of-bounds scatter (`mode='drop'`), so no dummy row or
+masking pass is needed.
+"""
+
+import jax.numpy as jnp
+
+
+def default_row_budget(n_ids):
+    """Static unique-row budget for a batch of n_ids tokens (next power of
+    two, capped at n_ids: a batch can't touch more rows than it has ids)."""
+    b = 1
+    while b < n_ids:
+        b *= 2
+    return b
+
+
+def unique_touched(ids, budget, vocab):
+    """ids: int array (any shape) -> (uids [budget], inv ids.shape).
+
+    uids lists the distinct ids touched this batch; slots beyond the actual
+    unique count hold `vocab` (out of range on purpose).  inv re-expresses
+    ids as positions into uids, so `rows[inv]` == `table[ids]` after
+    `rows = gather_rows(table, uids)`.  If the batch touches more than
+    `budget` distinct ids, jnp.unique truncates — pick the budget >= the
+    worst-case distinct count (`default_row_budget(ids.size)` is always
+    safe)."""
+    flat = ids.reshape(-1).astype(jnp.int32)
+    uids, inv = jnp.unique(flat, return_inverse=True, size=budget,
+                           fill_value=vocab)
+    return uids, inv.reshape(ids.shape).astype(jnp.int32)
+
+
+def gather_rows(table, uids):
+    """[V, D] x [K] -> [K, D]; out-of-range uids (the fill slots) clip to the
+    last row — their values are never consumed and their updates are dropped
+    by scatter_rows."""
+    return table[jnp.clip(uids, 0, table.shape[0] - 1)]
+
+
+def scatter_rows(table, uids, new_rows):
+    """Write updated rows back; fill-slot indices (== vocab) fall out of
+    bounds and are DROPPED, touching nothing."""
+    return table.at[uids].set(new_rows, mode="drop")

@@ -28,6 +28,13 @@ from paddle_tpu.optim import schedules
 class Optimizer(NamedTuple):
     init: Callable[[Any], Any]
     update: Callable[[Any, Any, Any], Any]
+    # sparse-row interface (reference SparseRowMatrix / sgdUpdateSparse
+    # semantics): the same update rule applied to a GATHERED subtree of
+    # touched embedding rows only — step time scales with touched rows, not
+    # vocab.  row_init(rows_tree) -> slot subtree; row_update(grads, slots,
+    # rows, step) -> (new_rows, new_slots).
+    row_init: Callable[[Any], Any] = None
+    row_update: Callable[[Any, Any, Any, Any], Any] = None
 
 
 def _tmap(f, *trees):
@@ -86,7 +93,14 @@ def _make(update_one, extra_state_fn, learning_rate, learning_rate_schedule,
                                            step)
         return new_params, {"step": step + 1, "slots": new_slots}
 
-    return Optimizer(init=init, update=update)
+    def row_update(grads, slot_rows, rows, step):
+        lr = sched(step)
+        grads = _clip(grads, clip_threshold, clip_norm)
+        grads = _apply_decay(None, rows, grads, l2=l2, l1=l1)
+        return update_one(grads, slot_rows, rows, lr, step)
+
+    return Optimizer(init=init, update=update, row_init=extra_state_fn,
+                     row_update=row_update)
 
 
 # ---------------------------------------------------------------- momentum

@@ -30,6 +30,7 @@ def check_grads(loss_fn, params, eps=1e-3, rtol=2e-2, atol=1e-4,
     results = []
     failures = []
     for (path, leaf), g in zip(flat, aflat):
+        orig_dtype = np.asarray(leaf).dtype
         leaf = np.asarray(leaf, np.float64)
         g = np.asarray(g)
         n = leaf.size
@@ -40,14 +41,15 @@ def check_grads(loss_fn, params, eps=1e-3, rtol=2e-2, atol=1e-4,
             delta[idx] = eps
             delta = delta.reshape(leaf.shape)
 
-            # rebuild params with this leaf perturbed
+            # rebuild params with this leaf perturbed (keep the leaf's own
+            # dtype: f64 sweeps stay f64, f32 models stay f32)
             def with_leaf(value):
                 return jax.tree_util.tree_unflatten(
                     treedef, [value if p2 == path else l2
                               for (p2, l2) in flat])
 
-            plus = with_leaf(jnp.asarray(leaf + delta, jnp.float32))
-            minus = with_leaf(jnp.asarray(leaf - delta, jnp.float32))
+            plus = with_leaf(jnp.asarray(leaf + delta, orig_dtype))
+            minus = with_leaf(jnp.asarray(leaf - delta, orig_dtype))
             num = (float(loss_fn(plus)) - float(loss_fn(minus))) / (2 * eps)
             ana = float(g.reshape(-1)[idx])
             err = abs(num - ana) / max(abs(num), abs(ana), atol)

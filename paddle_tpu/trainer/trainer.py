@@ -79,8 +79,19 @@ class SGD:
         self.rng, init_rng = jax.random.split(rng)
         self.parameters = parameters if parameters is not None \
             else self.topology.init(init_rng)
-        self.opt_state = self.optimizer.init(self.parameters) \
+        self._sparse_specs = self._find_sparse_specs()
+        dense_params = {k: v for k, v in self.parameters.items()
+                        if k not in self._sparse_specs}
+        self.opt_state = self.optimizer.init(dense_params) \
             if self.optimizer else None
+        if self._sparse_specs and self.optimizer:
+            # full-table optimizer slots for sparse embeddings; only touched
+            # rows are gathered/updated/scattered each step (reference
+            # SparseRowMatrix semantics)
+            self.opt_state = {
+                "dense": self.opt_state,
+                "sparse": {k: self.optimizer.row_init(self.parameters[k])
+                           for k in self._sparse_specs}}
         self.model_state = self.topology.init_state()
         if mesh is not None:
             rules = sharding_rules
@@ -90,6 +101,37 @@ class SGD:
         self._donate = donate
 
     # ------------------------------------------------------------ build
+
+    def _find_sparse_specs(self):
+        """Embedding layers flagged sparse_update=True whose ids come
+        straight from a data layer (reference sparse-remote-update
+        constraint: the sparse table's input slot).  Returns
+        {param_key: {"feeds": [...], "vocab": V, "budget": K}}."""
+        from paddle_tpu.ops.sparse import default_row_budget
+        from paddle_tpu.utils.error import ConfigError
+        specs = {}
+        for node in self.topology.order:
+            if node.layer_type != "embedding" \
+                    or not node.cfg.get("sparse_update"):
+                continue
+            src = node.inputs[0]
+            if src.layer_type != "data":
+                raise ConfigError(
+                    f"sparse_update embedding {node.name!r} needs a data "
+                    "layer input (ids straight from the feed)")
+            consumers = [n for n in self.topology.order
+                         if src in n.inputs and n is not node]
+            if consumers:
+                raise ConfigError(
+                    f"sparse_update embedding {node.name!r}: its id input "
+                    f"{src.name!r} also feeds {consumers[0].name!r}; the "
+                    "sparse path rewrites that feed and would corrupt it")
+            key = self.topology._param_key(node)
+            spec = specs.setdefault(
+                key, {"feeds": [], "vocab": node.cfg["vocab"],
+                      "budget": node.cfg.get("sparse_budget")})
+            spec["feeds"].append(src.name)
+        return specs
 
     def _loss_and_extras(self, params, state, feed, rng):
         out, new_state = self.topology.apply(
@@ -103,12 +145,90 @@ class SGD:
         return total, (new_state, extra_vals)
 
     def _build_step(self, feed_example):
-        def step(params, opt_state, state, feed, rng):
+        specs = self._sparse_specs
+        if specs:
+            from paddle_tpu.ops import sparse as sparse_ops
+            budgets = {}
+            for k, spec in specs.items():
+                if spec["budget"]:
+                    budgets[k] = spec["budget"]
+                else:
+                    n = 0
+                    for f in spec["feeds"]:
+                        v = feed_example[f]
+                        d = v.data if isinstance(v, SequenceBatch) else v
+                        n += int(np.prod(d.shape))
+                    budgets[k] = sparse_ops.default_row_budget(n)
+
+        def dense_step(params, opt_state, state, feed, rng):
             (loss, (new_state, extras)), grads = jax.value_and_grad(
                 self._loss_and_extras, has_aux=True)(params, state, feed, rng)
             new_params, new_opt = self.optimizer.update(grads, opt_state, params)
             merged_state = {**state, **new_state}
             return new_params, new_opt, merged_state, loss, extras
+
+        def sparse_step(params, opt_state, state, feed, rng):
+            """The large-vocab path: differentiate w.r.t. the gathered
+            touched-row blocks, not the [V, D] tables — the id feeds are
+            rewritten to positions into those blocks so the graph runs
+            unchanged (reference SparseRowMatrix + sparse remote update,
+            RemoteParameterUpdater.h:265)."""
+            feed = dict(feed)
+            uids_map, rows_map = {}, {}
+            for k, spec in specs.items():
+                flats, places = [], []
+                for f in spec["feeds"]:
+                    v = feed[f]
+                    d = v.data if isinstance(v, SequenceBatch) else v
+                    flats.append(d.reshape(-1))
+                    places.append((f, v, d.shape))
+                allids = (jnp.concatenate(flats) if len(flats) > 1
+                          else flats[0])
+                uids, inv = sparse_ops.unique_touched(
+                    allids, budgets[k], spec["vocab"])
+                off = 0
+                for f, v, shp in places:
+                    n = int(np.prod(shp))
+                    iv = inv[off:off + n].reshape(shp)
+                    off += n
+                    feed[f] = (SequenceBatch(data=iv, lengths=v.lengths)
+                               if isinstance(v, SequenceBatch) else iv)
+                uids_map[k] = uids
+                rows_map[k] = jax.tree_util.tree_map(
+                    lambda t, u=uids: sparse_ops.gather_rows(t, u),
+                    params[k])
+
+            dense_params = {k2: v for k2, v in params.items()
+                            if k2 not in specs}
+
+            def loss_fn(dp, rp):
+                return self._loss_and_extras({**dp, **rp}, state, feed, rng)
+
+            (loss, (new_state, extras)), (dg, rg) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(dense_params, rows_map)
+            dstate = opt_state["dense"]
+            new_dense, new_dstate = self.optimizer.update(dg, dstate,
+                                                          dense_params)
+            new_params = dict(new_dense)
+            new_sparse = {}
+            for k in specs:
+                u = uids_map[k]
+                slot_rows = jax.tree_util.tree_map(
+                    lambda t, u=u: sparse_ops.gather_rows(t, u),
+                    opt_state["sparse"][k])
+                new_rows, new_slot_rows = self.optimizer.row_update(
+                    rg[k], slot_rows, rows_map[k], dstate["step"])
+                new_params[k] = jax.tree_util.tree_map(
+                    lambda t, nr, u=u: sparse_ops.scatter_rows(t, u, nr),
+                    params[k], new_rows)
+                new_sparse[k] = jax.tree_util.tree_map(
+                    lambda t, nr, u=u: sparse_ops.scatter_rows(t, u, nr),
+                    opt_state["sparse"][k], new_slot_rows)
+            merged_state = {**state, **new_state}
+            return (new_params, {"dense": new_dstate, "sparse": new_sparse},
+                    merged_state, loss, extras)
+
+        step = sparse_step if specs else dense_step
 
         if self.mesh is None:
             self._step_fn = jax.jit(
@@ -119,10 +239,22 @@ class SGD:
         # optimizer slots are params-shaped: inherit the param shardings
         # (the reference keeps momentum etc. sharded in the pserver the same
         # way, ParameterServer2 block-indexed buffers)
-        os_ = {"step": replicated_shardings(self.opt_state["step"], self.mesh),
-               "slots": {k: ps for k in self.opt_state["slots"]}} \
-            if isinstance(self.opt_state, dict) and "slots" in self.opt_state \
-            else replicated_shardings(self.opt_state, self.mesh)
+        def dense_state_shardings(dstate, dense_ps):
+            if isinstance(dstate, dict) and "slots" in dstate:
+                return {"step": replicated_shardings(dstate["step"],
+                                                     self.mesh),
+                        "slots": {k: dense_ps for k in dstate["slots"]}}
+            return replicated_shardings(dstate, self.mesh)
+
+        if specs:
+            dense_ps = {k: v for k, v in ps.items() if k not in specs}
+            os_ = {"dense": dense_state_shardings(self.opt_state["dense"],
+                                                  dense_ps),
+                   "sparse": {k: {slot: ps[k]
+                                  for slot in self.opt_state["sparse"][k]}
+                              for k in specs}}
+        else:
+            os_ = dense_state_shardings(self.opt_state, ps)
         ss = replicated_shardings(self.model_state, self.mesh)
         fs = batch_shardings(feed_example, self.mesh)
         rs = replicated_shardings(jnp.zeros(2, jnp.uint32), self.mesh)

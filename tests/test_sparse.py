@@ -1,0 +1,156 @@
+"""Sparse/large-vocab embedding path (VERDICT r1 item 4; reference
+SparseRowMatrix.h:204 + RemoteParameterUpdater.h:265 sparse push/pull).
+
+- unique/gather/scatter primitives honor the static row budget
+- sparse_update=True training matches the dense path exactly (plain SGD)
+  and under momentum when every row is touched every batch
+- step time scales with touched rows, not vocab (the capability the dense
+  path can't provide)
+- the sparse step compiles and runs on a device mesh"""
+
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.layers as L
+from paddle_tpu import optim
+from paddle_tpu.core.sequence import pad_sequences
+from paddle_tpu.layers.graph import reset_names
+from paddle_tpu.ops import sparse as sparse_ops
+from paddle_tpu.trainer import SGD
+
+
+def test_unique_touched_budget_and_inverse():
+    ids = jnp.asarray([[3, 7, 3], [9, 7, 0]], jnp.int32)
+    uids, inv = sparse_ops.unique_touched(ids, budget=8, vocab=100)
+    assert uids.shape == (8,)
+    # fill slots carry the out-of-range sentinel
+    assert int((uids == 100).sum()) == 4
+    table = jnp.arange(100 * 2, dtype=jnp.float32).reshape(100, 2)
+    rows = sparse_ops.gather_rows(table, uids)
+    np.testing.assert_array_equal(np.asarray(rows[inv]),
+                                  np.asarray(table[ids]))
+
+
+def test_scatter_rows_drops_fill_slots():
+    table = jnp.zeros((10, 3))
+    uids = jnp.asarray([2, 5, 10, 10], jnp.int32)   # two fill slots (== V)
+    new_rows = jnp.ones((4, 3))
+    out = sparse_ops.scatter_rows(table, uids, new_rows)
+    touched = np.zeros((10,), bool)
+    touched[[2, 5]] = True
+    np.testing.assert_array_equal(np.asarray(out[touched]), 1.0)
+    np.testing.assert_array_equal(np.asarray(out[~touched]), 0.0)
+
+
+def _build_model(vocab, sparse, budget=None, emb_dim=8):
+    reset_names()
+    w = L.data_layer("w", size=vocab, is_seq=True)
+    emb = L.embedding_layer(w, size=emb_dim, sparse_update=sparse,
+                            sparse_budget=budget,
+                            param_attr={"initial_std": 0.1, "name": "emb"})
+    pooled = L.pooling_layer(emb, pooling_type="sum")
+    out = L.fc_layer(pooled, size=2, act="softmax",
+                     param_attr={"initial_std": 0.1})
+    lab = L.data_layer("lab", size=1)
+    return L.classification_cost(input=out, label=lab)
+
+
+def _batches(np_rng, vocab, n=3, b=6, t=5):
+    out = []
+    for _ in range(n):
+        seqs = [np_rng.randint(0, vocab, (np_rng.randint(2, t + 1),))
+                for _ in range(b)]
+        out.append({"w": pad_sequences(seqs, max_len=t),
+                    "lab": np_rng.randint(0, 2, (b, 1)).astype(np.int32)})
+    return out
+
+
+def _train(cost, opt, batches):
+    tr = SGD(cost=cost, update_equation=opt, seed=3, donate=False)
+    tr.train(lambda: iter(batches), num_passes=2, log_period=0)
+    return tr
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adagrad"])
+def test_sparse_matches_dense(np_rng, opt_name):
+    """Touched-rows-only updates == dense updates for history-free rules
+    (plain SGD) and row-local accumulators (adagrad): untouched rows have
+    zero grad, so the dense path leaves them unchanged too."""
+    vocab = 50
+    batches = _batches(np_rng, vocab)
+
+    def make_opt():
+        return (optim.Momentum(learning_rate=0.1, momentum=0.0)
+                if opt_name == "sgd"
+                else optim.AdaGrad(learning_rate=0.1))
+
+    dense = _train(_build_model(vocab, sparse=False), make_opt(), batches)
+    sparse = _train(_build_model(vocab, sparse=True), make_opt(), batches)
+    for key in ("emb", "__fc_0__"):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            dense.parameters[key], sparse.parameters[key])
+
+
+def test_sparse_momentum_matches_dense_when_all_rows_touched(np_rng):
+    """With momentum, sparse == dense only when every row is touched every
+    batch (otherwise dense momentum keeps decaying untouched rows — the
+    reference's catch-up problem); construct batches covering the vocab."""
+    vocab = 8
+    batches = []
+    for _ in range(3):
+        perm = np_rng.permutation(vocab)
+        seqs = [perm[:4], perm[4:]]
+        batches.append({"w": pad_sequences(seqs),
+                        "lab": np.asarray([[0], [1]], np.int32)})
+    dense = _train(_build_model(vocab, sparse=False),
+                   optim.Momentum(learning_rate=0.1, momentum=0.9), batches)
+    sparse = _train(_build_model(vocab, sparse=True),
+                    optim.Momentum(learning_rate=0.1, momentum=0.9), batches)
+    np.testing.assert_allclose(np.asarray(dense.parameters["emb"]["w"]),
+                               np.asarray(sparse.parameters["emb"]["w"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_step_scales_with_touched_rows_not_vocab(np_rng):
+    """The capability test: at vocab 300k the sparse step beats the dense
+    step by a wide margin because it never materializes a [V, D] gradient
+    or updates [V, D] momentum (reference sparse-update raison d'etre)."""
+    vocab = 1_000_000
+    batches = _batches(np_rng, vocab, n=1, b=8, t=8)
+
+    def steps_per_sec(sparse):
+        # donate=True (the default) so the touched-row scatter runs in
+        # place; without donation XLA must copy the [V, D] table each step
+        tr = SGD(cost=_build_model(vocab, sparse=sparse, emb_dim=32),
+                 update_equation=optim.Momentum(learning_rate=0.1,
+                                                momentum=0.9),
+                 seed=3)
+        tr.train(lambda: iter(batches), num_passes=1, log_period=0)  # compile
+        t0 = time.perf_counter()
+        tr.train(lambda: iter(batches * 20), num_passes=1, log_period=0)
+        return 20 / (time.perf_counter() - t0)
+
+    sparse_rate = steps_per_sec(True)
+    dense_rate = steps_per_sec(False)
+    assert sparse_rate > 2.0 * dense_rate, (
+        f"sparse {sparse_rate:.1f} steps/s vs dense {dense_rate:.1f}")
+
+
+def test_sparse_step_on_mesh(np_rng):
+    """Sparse gather/update/scatter compiles and runs under a data-parallel
+    mesh (per-shard state: slots inherit the table's sharding)."""
+    from paddle_tpu.parallel import MeshConfig, make_mesh
+    vocab = 64
+    mesh = make_mesh(MeshConfig(data=len(jax.devices())))
+    batches = _batches(np_rng, vocab, n=2, b=8, t=4)
+    tr = SGD(cost=_build_model(vocab, sparse=True),
+             update_equation=optim.Momentum(learning_rate=0.1, momentum=0.9),
+             seed=3, mesh=mesh, donate=False)
+    tr.train(lambda: iter(batches), num_passes=1, log_period=0)
+    assert np.isfinite(np.asarray(tr.parameters["emb"]["w"])).all()
