@@ -214,7 +214,24 @@ class _MixedImpl:
     (one input per part; operators consume two)."""
 
     def infer(self, cfg, in_sizes):
-        return cfg["size"]
+        # config-time width check (reference MixedLayer asserts every
+        # projection's output height/width against the layer size)
+        size, idx = cfg["size"], 0
+        for kind, spec in cfg["parts"]:
+            isz = in_sizes[idx] if idx < len(in_sizes) else None
+            out = None
+            if kind == "identity":
+                out = spec.get("size") or isz
+            elif kind in ("dotmul", "scaling", "dotmul_op"):
+                out = isz
+            elif kind == "context":
+                out = isz * spec["context_len"]
+            if out is not None and out != size:
+                raise ConfigError(
+                    f"mixed_layer(size={size}): {kind} projection yields "
+                    f"size {out} — all parts must produce the layer size")
+            idx += 2 if kind in ("dotmul_op", "conv_op") else 1
+        return size
 
     def init(self, rng, cfg, in_sizes):
         p = {}

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core import dtypes
-from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.core.sequence import NestedSequenceBatch, SequenceBatch
 from paddle_tpu.layers.graph import (
     LayerOutput, Topology, register_layer, auto_name, as_seq, value_data,
     Context, get_impl)
@@ -260,6 +260,7 @@ def recurrent_group(step, input, reverse=False, name=None):
     links = resolve_memory_links(sub_topo, g.memories)
 
     group_inputs = ([real for _, real in seq_inputs]
+                    + [s.input for _, s in sub_inputs]
                     + [s.input for _, s in static_inputs]
                     + [b for _, _, b, _ in links if isinstance(b, LayerOutput)])
 
@@ -267,10 +268,12 @@ def recurrent_group(step, input, reverse=False, name=None):
         "sub_topo": sub_topo,
         "outs": outs,
         "seq_phs": [ph for ph, _ in seq_inputs],
+        "sub_phs": [ph for ph, _ in sub_inputs],
         "static_phs": [ph for ph, _ in static_inputs],
         "links": links,
         "reverse": reverse,
         "n_seq": len(seq_inputs),
+        "n_sub": len(sub_inputs),
         "n_static": len(static_inputs),
     }
     node = LayerOutput(name or auto_name("recurrent_group"),
@@ -292,12 +295,25 @@ class _RecurrentGroupImpl:
     def apply(self, ctx, cfg, params, *inputs):
         sub_topo: Topology = cfg["sub_topo"]
         n_seq, n_static = cfg["n_seq"], cfg["n_static"]
-        seqs = [as_seq(v) for v in inputs[:n_seq]]
-        statics = list(inputs[n_seq:n_seq + n_static])
-        boots = list(inputs[n_seq + n_static:])
+        n_sub = cfg.get("n_sub", 0)
+        nested = n_sub > 0
+        if nested:
+            subs = []
+            for v in inputs[:n_sub]:
+                if not isinstance(v, NestedSequenceBatch):
+                    raise ConfigError(
+                        "SubsequenceInput needs a NestedSequenceBatch feed "
+                        f"(got {type(v).__name__})")
+                subs.append(v)
+            n_lead = n_sub
+        else:
+            seqs = [as_seq(v) for v in inputs[:n_seq]]
+            n_lead = n_seq
+        statics = list(inputs[n_lead:n_lead + n_static])
+        boots = list(inputs[n_lead + n_static:])
         sub_params = ctx.params
 
-        ref = seqs[0]
+        ref = subs[0] if nested else seqs[0]
         bsz = ref.data.shape[0]
 
         # boot memories
@@ -319,9 +335,11 @@ class _RecurrentGroupImpl:
         link_nodes = [ln for _, ln, _, _ in cfg["links"]]
         n_out = len(cfg["outs"])
 
+        frame_phs = cfg["sub_phs"] if nested else cfg["seq_phs"]
+
         def step_fn(mems, frames, step_rng=None):
             feed = {}
-            for ph, frame in zip(cfg["seq_phs"], frames):
+            for ph, frame in zip(frame_phs, frames):
                 feed[ph.name] = frame
             for ph, s in zip(cfg["static_phs"], statics):
                 feed[ph.name] = s
@@ -331,24 +349,39 @@ class _RecurrentGroupImpl:
             # apply — no per-link re-evaluation of the sub-graph
             vals = sub_topo.apply(sub_params, feed, mode=mode, rng=step_rng,
                                   extra_outputs=link_nodes)
-            vals = vals if isinstance(vals, tuple) else (vals,)
+            # NB: SequenceBatch/NestedSequenceBatch are NamedTuples — a
+            # single sequence-valued output must not be unpacked fieldwise
+            if not isinstance(vals, tuple) or isinstance(
+                    vals, (SequenceBatch, NestedSequenceBatch)):
+                vals = (vals,)
             out_vals = vals[:n_out]
             new_mems = [value_data(v) for v in vals[n_out:]]
-            return tuple(new_mems), tuple(value_data(v) for v in out_vals)
+            # nested groups keep sequence-valued step outputs whole so the
+            # engine can stack them into a NestedSequenceBatch; flat groups
+            # emit per-step rows
+            if nested:
+                outs_keep = tuple(v if isinstance(v, SequenceBatch)
+                                  else value_data(v) for v in out_vals)
+            else:
+                outs_keep = tuple(value_data(v) for v in out_vals)
+            return tuple(new_mems), outs_keep
 
         if group_rng is None:
             step = lambda mems, frames: step_fn(mems, frames)  # noqa: E731
         else:
             step = step_fn
-        outs, _ = rnn_ops.recurrent_group(step, tuple(seqs),
-                                          tuple(boot_vals),
-                                          reverse=cfg["reverse"],
-                                          rng=group_rng)
+        engine = (rnn_ops.nested_recurrent_group if nested
+                  else rnn_ops.recurrent_group)
+        outs, _ = engine(step, tuple(subs if nested else seqs),
+                         tuple(boot_vals),
+                         reverse=cfg["reverse"], rng=group_rng)
         # rnn_ops.recurrent_group maps over the input pytree; our step_fn
         # consumed a tuple of SequenceBatches and returned a tuple of outputs.
         # NB: SequenceBatch is itself a (named) tuple — test explicitly.
         def is_plain_tuple(v):
-            return isinstance(v, tuple) and not isinstance(v, SequenceBatch)
+            return (isinstance(v, tuple)
+                    and not isinstance(v, (SequenceBatch,
+                                           NestedSequenceBatch)))
 
         result = outs[0] if (is_plain_tuple(outs) and len(outs) == 1) else outs
         ctx.aux[cfg["self_name"] + "/outputs"] = result

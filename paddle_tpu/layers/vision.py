@@ -44,6 +44,21 @@ def _to_rows(x):
     return x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)
 
 
+def _channels(input, num_channels):
+    """Channel inference (reference v1 semantics: num_channels defaults to
+    the input layer's num_filters; a raw data layer with height/width set
+    implies channels = size / (h*w))."""
+    if num_channels:
+        return num_channels
+    if input.num_filters:
+        return input.num_filters
+    if input.img_shape:
+        h, w = input.img_shape
+        if h * w and input.size % (h * w) == 0:
+            return input.size // (h * w)
+    return 1
+
+
 def _img_shape(node, channels):
     if node.img_shape is not None:
         return node.img_shape
@@ -96,7 +111,7 @@ def img_conv_layer(input, filter_size, num_filters, num_channels=None,
                    layer_attr=None):
     """Reference img_conv_layer (ExpandConvLayer/CudnnConvLayer merged —
     one XLA conv path)."""
-    channels = num_channels or (input.num_filters or 1)
+    channels = _channels(input, num_channels)
     in_shape = _img_shape(input, channels)
     fh, fw = filter_size, filter_size_y or filter_size
     sh, sw = stride, stride_y or stride
@@ -142,7 +157,7 @@ def img_pool_layer(input, pool_size, stride=1, num_channels=None,
                    stride_y=None, padding_y=None, ceil_mode=True):
     """Reference img_pool_layer.  ceil_mode matches the reference's
     outputSize with caffeMode=False (ceil division)."""
-    channels = num_channels or (input.num_filters or 1)
+    channels = _channels(input, num_channels)
     in_shape = _img_shape(input, channels)
     wh, ww = pool_size, pool_size_y or pool_size
     sh, sw = stride, stride_y or stride
@@ -256,7 +271,7 @@ def img_cmrnorm_layer(input, size=5, scale=0.0128, power=0.75,
                       num_channels=None, name=None):
     """Reference img_cmrnorm_layer (cross-map LRN; default scale matches
     trainer_config_helpers)."""
-    channels = num_channels or (input.num_filters or 1)
+    channels = _channels(input, num_channels)
     in_shape = _img_shape(input, channels)
     cfg = {"norm_size": size, "scale": scale, "power": power,
            "channels": channels, "in_shape": in_shape}
@@ -285,7 +300,7 @@ register_layer("cross_channel_norm")(_CrossChannelNormImpl)
 
 def cross_channel_norm_layer(input, num_channels=None, name=None,
                              param_attr=None):
-    channels = num_channels or (input.num_filters or 1)
+    channels = _channels(input, num_channels)
     in_shape = _img_shape(input, channels)
     return LayerOutput(name or auto_name("ccn"), "cross_channel_norm",
                        input.size, [input],
@@ -310,7 +325,7 @@ register_layer("maxout")(_MaxoutImpl)
 
 
 def maxout_layer(input, groups, num_channels=None, name=None):
-    channels = num_channels or (input.num_filters or 1)
+    channels = _channels(input, num_channels)
     in_shape = _img_shape(input, channels)
     out_size = input.size // groups
     return LayerOutput(name or auto_name("maxout"), "maxout", out_size,
@@ -337,7 +352,7 @@ register_layer("bilinear_interp")(_BilinearImpl)
 
 def bilinear_interp_layer(input, out_size_x, out_size_y, num_channels=None,
                           name=None):
-    channels = num_channels or (input.num_filters or 1)
+    channels = _channels(input, num_channels)
     in_shape = _img_shape(input, channels)
     out_size = channels * out_size_x * out_size_y
     return LayerOutput(name or auto_name("bilinear"), "bilinear_interp",
@@ -371,7 +386,7 @@ def block_expand_layer(input, block_x, block_y, stride_x=1, stride_y=1,
                        padding_x=0, padding_y=0, num_channels=None, name=None):
     """im2col as a sequence: output is a sequence of patch rows (reference
     BlockExpandLayer -> OCR pipelines feeding CTC)."""
-    channels = num_channels or (input.num_filters or 1)
+    channels = _channels(input, num_channels)
     in_shape = _img_shape(input, channels)
     out_size = block_x * block_y * channels
     return LayerOutput(name or auto_name("block_expand"), "block_expand",
@@ -402,7 +417,7 @@ register_layer("spp")(_SppImpl)
 
 def spp_layer(input, pyramid_height, num_channels=None, pool_type="max",
               name=None):
-    channels = num_channels or (input.num_filters or 1)
+    channels = _channels(input, num_channels)
     in_shape = _img_shape(input, channels)
     pt = "avg" if "avg" in str(getattr(pool_type, "name", pool_type)) else "max"
     out_size = channels * sum(4 ** i for i in range(pyramid_height))
@@ -431,7 +446,7 @@ register_layer("pad")(_PadImpl)
 
 def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, num_channels=None,
               name=None):
-    channels = num_channels or (input.num_filters or 1)
+    channels = _channels(input, num_channels)
     in_shape = _img_shape(input, channels)
     pc, ph, pw = tuple(pad_c or (0, 0)), tuple(pad_h or (0, 0)), tuple(pad_w or (0, 0))
     oc = channels + pc[0] + pc[1]
@@ -461,7 +476,7 @@ register_layer("priorbox")(_PriorBoxImpl)
 def priorbox_layer(input, image, min_size, max_size=None, aspect_ratio=(2.0,),
                    variance=(0.1, 0.1, 0.2, 0.2), num_channels=None,
                    name=None):
-    channels = num_channels or (input.num_filters or 1)
+    channels = _channels(input, num_channels)
     in_shape = _img_shape(input, channels)
     img_channels = image.num_filters or 3
     image_shape = _img_shape(image, img_channels)
@@ -508,7 +523,7 @@ def data_norm_layer(input, strategy="z-score", name=None):
 def _conv_part_spec(img, filter_size, num_filters, num_channels, stride,
                     padding):
     from paddle_tpu.layers.api import _Part  # local: avoid import cycle
-    channels = num_channels or (img.num_filters or 1)
+    channels = _channels(img, num_channels)
     in_shape = _img_shape(img, channels)
     fh, fw = _pair(filter_size)
     sh, sw = _pair(stride)

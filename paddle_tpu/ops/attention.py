@@ -55,8 +55,9 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
         return flash_attention(q, k, v, scale=scale, causal=causal)
     dh = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(float(dh))
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k,
+        preferred_element_type=jnp.promote_types(q.dtype, jnp.float32)) * scale
     if causal:
         tq, tk = logits.shape[-2:]
         cm = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)

@@ -14,10 +14,12 @@ from paddle_tpu.ops import activations
 
 
 def matmul(x, w):
-    """MXU-friendly matmul: bf16 inputs, f32 accumulation."""
+    """MXU-friendly matmul: bf16 inputs, >=f32 accumulation (f64 stays f64
+    for the checkgrad sweeps)."""
     cd = dtypes.compute_dtype()
+    acc = jnp.promote_types(cd, jnp.float32)
     return jnp.matmul(x.astype(cd), w.astype(cd),
-                      preferred_element_type=jnp.float32)
+                      preferred_element_type=acc)
 
 
 def fc(x, w, b=None, act=None):

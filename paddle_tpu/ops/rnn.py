@@ -22,7 +22,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.core.sequence import NestedSequenceBatch, SequenceBatch
 from paddle_tpu.ops import activations
 from paddle_tpu.ops.linear import matmul
 
@@ -217,6 +217,86 @@ def recurrent_group(step_fn, inputs, boot_memories, reverse=False, rng=None):
             * ref.mask(o.dtype).reshape(ref.mask().shape + (1,) * (o.ndim - 2)),
             lengths=ref.lengths),
         outs_tm)
+    return outs, final_mem
+
+
+def nested_recurrent_group(step_fn, inputs, boot_memories, reverse=False,
+                           rng=None):
+    """Two-level (sub-sequence) recurrent engine: the OUTER scan iterates
+    subsequences (reference RecurrentGradientMachine createInFrameInfo with
+    subsequence inputs, RecurrentGradientMachine.cpp:642-712); at outer step
+    j, step_fn sees each input's j-th subsequence as a whole SequenceBatch —
+    an inner recurrent_group inside the step scans it as usual, so the pair
+    compiles to a nested lax.scan with fully static shapes.
+
+    step_fn(memories, frames[, step_rng]) -> (new_memories, outputs) where
+    frames is a tuple of SequenceBatch (one per NestedSequenceBatch input).
+    Outer memories are [B, ...] arrays frozen at padded outer steps (the
+    masking equivalent of the reference's batch shrinking).
+
+    Step outputs that are [B, ...] arrays stack into a SequenceBatch over the
+    outer axis (one row per subsequence); step outputs that are themselves
+    SequenceBatch stack into a NestedSequenceBatch — the reference's
+    seq-level-output-in-nested-group semantics.
+    """
+    inputs = tuple(inputs)
+    ref = inputs[0]
+    outer_mask_sm = ref.outer_mask().transpose(1, 0)          # [S, B]
+    datas_sm = tuple(
+        n.data.transpose((1, 0) + tuple(range(2, n.data.ndim)))
+        for n in inputs)                                       # each [S, B, T, ...]
+    ilens_sm = tuple(n.inner_lengths.transpose(1, 0) for n in inputs)
+
+    def merge(mem, new_mem, m):
+        return jax.tree_util.tree_map(
+            lambda new, old: jnp.where(
+                m.reshape((-1,) + (1,) * (new.ndim - 1)) > 0, new, old),
+            new_mem, mem)
+
+    def body(mem, scanned):
+        if rng is not None:
+            datas, ilens, m, k = scanned
+            frames = tuple(SequenceBatch(data=d, lengths=l)
+                           for d, l in zip(datas, ilens))
+            new_mem, out = step_fn(mem, frames, k)
+        else:
+            datas, ilens, m = scanned
+            frames = tuple(SequenceBatch(data=d, lengths=l)
+                           for d, l in zip(datas, ilens))
+            new_mem, out = step_fn(mem, frames)
+        return merge(mem, new_mem, m), out
+
+    S = ref.data.shape[1]
+    if rng is not None:
+        xs = (datas_sm, ilens_sm, outer_mask_sm, jax.random.split(rng, S))
+    else:
+        xs = (datas_sm, ilens_sm, outer_mask_sm)
+    final_mem, outs_sm = jax.lax.scan(body, boot_memories, xs,
+                                      reverse=reverse)
+
+    omask = ref.outer_mask()                                   # [B, S]
+
+    def collect(o):
+        # after scan-stacking, a per-step SequenceBatch output has fields
+        # data [S, B, T, ...], lengths [S, B]
+        if isinstance(o, SequenceBatch):
+            data = o.data.transpose((1, 0) + tuple(range(2, o.data.ndim)))
+            inner = (o.lengths.transpose(1, 0)
+                     * ref.outer_mask(o.lengths.dtype))
+            nsb = NestedSequenceBatch(data=data,
+                                      outer_lengths=ref.outer_lengths,
+                                      inner_lengths=inner)
+            return NestedSequenceBatch(
+                data=data * nsb.inner_mask(data.dtype).reshape(
+                    nsb.inner_mask().shape + (1,) * (data.ndim - 3)),
+                outer_lengths=ref.outer_lengths, inner_lengths=inner)
+        data = o.transpose((1, 0) + tuple(range(2, o.ndim)))   # [B, S, ...]
+        data = data * omask.astype(data.dtype).reshape(
+            omask.shape + (1,) * (data.ndim - 2))
+        return SequenceBatch(data=data, lengths=ref.outer_lengths)
+
+    outs = jax.tree_util.tree_map(
+        collect, outs_sm, is_leaf=lambda x: isinstance(x, SequenceBatch))
     return outs, final_mem
 
 

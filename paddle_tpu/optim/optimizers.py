@@ -35,6 +35,11 @@ class Optimizer(NamedTuple):
     # rows, step) -> (new_rows, new_slots).
     row_init: Callable[[Any], Any] = None
     row_update: Callable[[Any, Any, Any, Any], Any] = None
+    # clip config, exposed so a caller splitting the grad tree (the sparse
+    # path) can compute ONE global norm and pass clip_scale= to both update
+    # calls instead of letting each clip its own partition
+    clip_norm: float = None
+    clip_threshold: float = None
 
 
 def _tmap(f, *trees):
@@ -62,13 +67,17 @@ def _apply_decay(updates, params, grads, l2=0.0, l1=0.0):
     return _tmap(fold, grads, params)
 
 
-def _clip(grads, clip_threshold=None, clip_norm=None):
+def _clip(grads, clip_threshold=None, clip_norm=None, clip_scale=None):
     """Reference OptimizerWithGradientClipping: per-element value clip at
     gradient_clipping_threshold.  clip_norm additionally offers global-norm
-    clipping (TPU-era standard for RNN/transformer training)."""
+    clipping (TPU-era standard for RNN/transformer training).  clip_scale
+    overrides the norm computation with a caller-supplied global scale (used
+    when the grad tree is split across update calls)."""
     if clip_threshold:
         grads = _tmap(lambda g: jnp.clip(g, -clip_threshold, clip_threshold), grads)
-    if clip_norm:
+    if clip_scale is not None:
+        grads = _tmap(lambda g: g * clip_scale, grads)
+    elif clip_norm:
         gn = jnp.sqrt(sum(jnp.sum(jnp.square(g))
                           for g in jax.tree_util.tree_leaves(grads)) + 1e-12)
         scale = jnp.minimum(1.0, clip_norm / gn)
@@ -84,23 +93,24 @@ def _make(update_one, extra_state_fn, learning_rate, learning_rate_schedule,
         return {"step": jnp.zeros((), jnp.int32),
                 "slots": extra_state_fn(params)}
 
-    def update(grads, state, params):
+    def update(grads, state, params, clip_scale=None):
         step = state["step"]
         lr = sched(step)
-        grads = _clip(grads, clip_threshold, clip_norm)
+        grads = _clip(grads, clip_threshold, clip_norm, clip_scale)
         grads = _apply_decay(None, params, grads, l2=l2, l1=l1)
         new_params, new_slots = update_one(grads, state["slots"], params, lr,
                                            step)
         return new_params, {"step": step + 1, "slots": new_slots}
 
-    def row_update(grads, slot_rows, rows, step):
+    def row_update(grads, slot_rows, rows, step, clip_scale=None):
         lr = sched(step)
-        grads = _clip(grads, clip_threshold, clip_norm)
+        grads = _clip(grads, clip_threshold, clip_norm, clip_scale)
         grads = _apply_decay(None, rows, grads, l2=l2, l1=l1)
         return update_one(grads, slot_rows, rows, lr, step)
 
     return Optimizer(init=init, update=update, row_init=extra_state_fn,
-                     row_update=row_update)
+                     row_update=row_update, clip_norm=clip_norm,
+                     clip_threshold=clip_threshold)
 
 
 # ---------------------------------------------------------------- momentum

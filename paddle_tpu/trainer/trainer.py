@@ -20,7 +20,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.core.sequence import (NestedSequenceBatch,
+                                      SequenceBatch)
 from paddle_tpu.data.feeder import DataFeeder
 from paddle_tpu.data import reader as reader_mod
 from paddle_tpu.layers.graph import Topology, LayerOutput
@@ -129,8 +130,22 @@ class SGD:
             key = self.topology._param_key(node)
             spec = specs.setdefault(
                 key, {"feeds": [], "vocab": node.cfg["vocab"],
-                      "budget": node.cfg.get("sparse_budget")})
+                      "budget": node.cfg.get("sparse_budget"),
+                      "_nodes": set()})
             spec["feeds"].append(src.name)
+            spec["_nodes"].add(id(node))
+        # a sparse param key must not be shared with any NON-sparse layer:
+        # sparse_step swaps params[key] for the gathered row block, which
+        # would silently corrupt another reader of the full table
+        for node in self.topology.order:
+            key = self.topology._param_key(node)
+            if key in specs and id(node) not in specs[key]["_nodes"] \
+                    and node.layer_type != "data":
+                raise ConfigError(
+                    f"sparse_update table {key!r} is shared with layer "
+                    f"{node.name!r} ({node.layer_type}), which would read "
+                    "the gathered row block instead of the full table; "
+                    "share only among sparse_update embeddings")
         return specs
 
     def _loss_and_extras(self, params, state, feed, rng):
@@ -148,17 +163,20 @@ class SGD:
         specs = self._sparse_specs
         if specs:
             from paddle_tpu.ops import sparse as sparse_ops
-            budgets = {}
-            for k, spec in specs.items():
-                if spec["budget"]:
-                    budgets[k] = spec["budget"]
-                else:
-                    n = 0
-                    for f in spec["feeds"]:
-                        v = feed_example[f]
-                        d = v.data if isinstance(v, SequenceBatch) else v
-                        n += int(np.prod(d.shape))
-                    budgets[k] = sparse_ops.default_row_budget(n)
+
+            def budget_for(k, feed):
+                """Static row budget derived from the TRACED feed shapes —
+                jit retraces per batch shape, so a later, larger batch gets
+                a larger budget instead of silently truncating the
+                jnp.unique id set."""
+                if specs[k]["budget"]:
+                    return specs[k]["budget"]
+                n = 0
+                for f in specs[k]["feeds"]:
+                    v = feed[f]
+                    d = v.data if isinstance(v, SequenceBatch) else v
+                    n += int(np.prod(d.shape))
+                return sparse_ops.default_row_budget(n)
 
         def dense_step(params, opt_state, state, feed, rng):
             (loss, (new_state, extras)), grads = jax.value_and_grad(
@@ -185,7 +203,7 @@ class SGD:
                 allids = (jnp.concatenate(flats) if len(flats) > 1
                           else flats[0])
                 uids, inv = sparse_ops.unique_touched(
-                    allids, budgets[k], spec["vocab"])
+                    allids, budget_for(k, feed), spec["vocab"])
                 off = 0
                 for f, v, shp in places:
                     n = int(np.prod(shp))
@@ -207,8 +225,16 @@ class SGD:
             (loss, (new_state, extras)), (dg, rg) = jax.value_and_grad(
                 loss_fn, argnums=(0, 1), has_aux=True)(dense_params, rows_map)
             dstate = opt_state["dense"]
-            new_dense, new_dstate = self.optimizer.update(dg, dstate,
-                                                          dense_params)
+            # global-norm clipping must see ONE norm across the split grad
+            # tree (dense + row blocks) or sparse/dense training diverge
+            clip_scale = None
+            if getattr(self.optimizer, "clip_norm", None):
+                gsq = sum(jnp.sum(jnp.square(g)) for g in
+                          jax.tree_util.tree_leaves((dg, rg)))
+                gn = jnp.sqrt(gsq + 1e-12)
+                clip_scale = jnp.minimum(1.0, self.optimizer.clip_norm / gn)
+            new_dense, new_dstate = self.optimizer.update(
+                dg, dstate, dense_params, clip_scale=clip_scale)
             new_params = dict(new_dense)
             new_sparse = {}
             for k in specs:
@@ -217,7 +243,8 @@ class SGD:
                     lambda t, u=u: sparse_ops.gather_rows(t, u),
                     opt_state["sparse"][k])
                 new_rows, new_slot_rows = self.optimizer.row_update(
-                    rg[k], slot_rows, rows_map[k], dstate["step"])
+                    rg[k], slot_rows, rows_map[k], dstate["step"],
+                    clip_scale=clip_scale)
                 new_params[k] = jax.tree_util.tree_map(
                     lambda t, nr, u=u: sparse_ops.scatter_rows(t, u, nr),
                     params[k], new_rows)
@@ -327,7 +354,9 @@ class SGD:
             t0 = time.time()
             for batch_id, batch in enumerate(batch_reader()):
                 feed = feeder(batch) if feeder else batch
-                feed = {k: v if isinstance(v, SequenceBatch) else jnp.asarray(v)
+                feed = {k: v if isinstance(v, (SequenceBatch,
+                               NestedSequenceBatch))
+        else jnp.asarray(v)
                         for k, v in feed.items()}
                 event_handler(events.BeginIteration(pass_id, batch_id))
                 self.rng, step_rng = jax.random.split(self.rng)
@@ -395,7 +424,9 @@ class SGD:
         total, n = 0.0, 0
         for batch in reader():
             feed = feeder(batch) if feeder else batch
-            feed = {k: v if isinstance(v, SequenceBatch) else jnp.asarray(v)
+            feed = {k: v if isinstance(v, (SequenceBatch,
+                               NestedSequenceBatch))
+        else jnp.asarray(v)
                     for k, v in feed.items()}
             cost, _ = self._eval_fn(self.parameters, self.model_state, feed)
             total += float(cost)
@@ -440,7 +471,9 @@ class Inferencer:
             feed = feeder(feed_or_batch)
         else:
             feed = feed_or_batch
-        feed = {k: v if isinstance(v, SequenceBatch) else jnp.asarray(v)
+        feed = {k: v if isinstance(v, (SequenceBatch,
+                               NestedSequenceBatch))
+        else jnp.asarray(v)
                 for k, v in feed.items()}
         return self._fn(self.parameters, self.model_state, feed)
 

@@ -10,6 +10,8 @@ Each case runs at two (batch, seq_len) variants; gradients are checked for
 ALL parameter leaves AND all float inputs (the reference checks both
 parameter and input gradients)."""
 
+import zlib
+
 import numpy as np
 import pytest
 import jax
@@ -39,6 +41,13 @@ EXCLUDED = {
 }
 
 B0, T0 = 3, 4
+
+# float inputs that are LABELS: the reference computes no input gradient for
+# these (e.g. LambdaCost backward only writes to the score input), and our
+# impls stop_gradient them on purpose — exclude from finite differencing
+NONDIFF_INPUTS = {
+    "regress_costs": {"srel"},
+}
 
 
 def _r(np_rng, *shape):
@@ -105,10 +114,15 @@ def _(r, B, T):
 def _(r, B, T):
     w = L.data_layer("w", size=9, is_seq=True)
     s = L.data_layer("s", size=4, is_seq=True)
-    m = L.mixed_layer(size=4, input=[
-        L.table_projection(w, 4), L.context_projection(s, context_len=3)],
+    # projections of one mixed layer must share its width (the reference
+    # MixedLayer asserts this) — context (4*3=12) gets its own mixed
+    m1 = L.mixed_layer(size=4, input=[
+        L.table_projection(w, 4), L.trans_full_matrix_projection(s)],
         act=None)
-    return (L.pooling_layer(m, pooling_type="sum"),
+    m2 = L.mixed_layer(size=12, input=[L.context_projection(s, context_len=3)],
+                       act=None)
+    return ([L.pooling_layer(m1, pooling_type="sum"),
+             L.pooling_layer(m2, pooling_type="sum")],
             {"w": _ids(r, B, T, 9), "s": _seq(r, B, T, 4)})
 
 
@@ -425,7 +439,10 @@ def _loss_over(topo, outs, feed_rebuild):
         total = 0.0
         for v in vals:
             d = value_data(v)
-            total = total + jnp.mean(d.astype(jnp.float32))
+            # promote (never downcast): f64 sweeps must stay f64 or the
+            # central differences drown in f32 rounding noise
+            total = total + jnp.mean(d.astype(jnp.result_type(d.dtype,
+                                                              jnp.float32)))
         return total
     return loss_fn
 
@@ -433,7 +450,9 @@ def _loss_over(topo, outs, feed_rebuild):
 def run_sweep_case(name, B, T):
     build, _ = CASES[name]
     reset_names()
-    r = np.random.RandomState(hash(name) % (2 ** 31))
+    # deterministic digest: str hash() is salted per interpreter, which made
+    # failures non-reproducible across pytest runs
+    r = np.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
     outs, feed = build(r, B, T)
     outs = outs if isinstance(outs, list) else [outs]
     topo = Topology(outs)
@@ -448,8 +467,11 @@ def run_sweep_case(name, B, T):
     # split feed: float arrays (and SequenceBatch float data) are
     # differentiable inputs; ints and lengths stay static
     diff_inp, static = {}, {}
+    nondiff = NONDIFF_INPUTS.get(name, set())
     for k, v in feed.items():
-        if isinstance(v, SequenceBatch):
+        if k in nondiff:
+            static[k] = ("const", v)
+        elif isinstance(v, SequenceBatch):
             if np.issubdtype(np.asarray(v.data).dtype, np.floating):
                 diff_inp[k] = jnp.asarray(v.data, jnp.float64)
                 static[k] = ("seq", v.lengths)

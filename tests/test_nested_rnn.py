@@ -1,0 +1,176 @@
+"""Nested (sub-sequence) recurrent groups: the two-level scan engine.
+
+Reference: RecurrentGradientMachine.cpp:642-712 (createInFrameInfo with
+subsequence inputs), gserver/tests/test_RecurrentGradientMachine.cpp and its
+sequence_nest_rnn.conf vs sequence_rnn.conf equivalence pair — an outer
+group iterating subsequences, an inner group iterating words, the inner
+memory booted from the outer memory so the state chains across subsequence
+boundaries exactly like a flat scan over the concatenated words.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu.layers as L
+from paddle_tpu.core.sequence import (NestedSequenceBatch, SequenceBatch,
+                                      pad_nested_sequences, pad_sequences)
+from paddle_tpu.layers.graph import Topology, reset_names, value_data
+
+DIM, HID = 4, 6
+
+
+def _nested_data(seed=0):
+    r = np.random.RandomState(seed)
+    subs = [[r.randn(int(t), DIM).astype(np.float32)
+             for t in r.randint(1, 5, size=int(s))]
+            for s in [2, 3, 1]]
+    nested = pad_nested_sequences(subs)
+    flat = pad_sequences([np.concatenate(s, axis=0) for s in subs])
+    return subs, nested, flat
+
+
+def _build_nested():
+    x = L.data_layer("x", size=DIM, is_seq=True)
+
+    def outer_step(subseq):
+        outer_mem = L.memory(name="outer_state", size=HID)
+
+        def inner_step(y):
+            inner_mem = L.memory(name="inner_state", size=HID,
+                                 boot_layer=outer_mem)
+            return L.fc_layer([y, inner_mem], size=HID, act="tanh",
+                              name="inner_state",
+                              param_attr={"name": "rnnfc"})
+
+        inner_out = L.recurrent_group(inner_step, subseq)
+        last = L.last_seq(inner_out, name="outer_state")
+        return last
+
+    out = L.recurrent_group(outer_step, L.SubsequenceInput(x))
+    return Topology([out]), out
+
+
+def _build_flat():
+    xf = L.data_layer("xf", size=DIM, is_seq=True)
+
+    def step(y):
+        mem = L.memory(name="state", size=HID)
+        return L.fc_layer([y, mem], size=HID, act="tanh", name="state",
+                          param_attr={"name": "rnnfc"})
+
+    out = L.recurrent_group(step, xf)
+    return Topology([out]), out
+
+
+def test_nested_matches_flat_forward():
+    subs, nested, flat = _nested_data()
+    reset_names()
+    topo_n, _ = _build_nested()
+    reset_names()
+    topo_f, _ = _build_flat()
+    params = topo_n.init(jax.random.PRNGKey(0))
+    assert "rnnfc" in params
+
+    out_n = topo_n.apply(params, {"x": nested}, mode="test")
+    out_f = topo_f.apply(params, {"xf": flat}, mode="test")
+
+    # nested group output: one row per SUBSEQUENCE = the inner state at each
+    # subsequence's end; flat output at the matching concatenated positions
+    dn = np.asarray(value_data(out_n))          # [B, S, HID]
+    df = np.asarray(value_data(out_f))          # [B, sumT, HID]
+    for b, sample in enumerate(subs):
+        ends = np.cumsum([len(t) for t in sample]) - 1
+        for j, e in enumerate(ends):
+            np.testing.assert_allclose(dn[b, j], df[b, e], rtol=1e-5,
+                                       atol=1e-6)
+    # padding slots are zero-masked
+    assert isinstance(out_n, SequenceBatch)
+    S = dn.shape[1]
+    for b, sample in enumerate(subs):
+        if len(sample) < S:
+            assert np.all(dn[b, len(sample):] == 0.0)
+
+
+def test_nested_matches_flat_gradients():
+    subs, nested, flat = _nested_data(seed=1)
+    reset_names()
+    topo_n, _ = _build_nested()
+    reset_names()
+    topo_f, _ = _build_flat()
+    params = topo_n.init(jax.random.PRNGKey(1))
+
+    def loss_n(p):
+        out = topo_n.apply(p, {"x": nested}, mode="test")
+        # final state = last valid subsequence row
+        d = value_data(out)
+        idx = out.lengths - 1
+        fin = jnp.take_along_axis(d, idx[:, None, None], axis=1)[:, 0]
+        return jnp.sum(fin ** 2)
+
+    def loss_f(p):
+        out = topo_f.apply(p, {"xf": flat}, mode="test")
+        d = value_data(out)
+        idx = out.lengths - 1
+        fin = jnp.take_along_axis(d, idx[:, None, None], axis=1)[:, 0]
+        return jnp.sum(fin ** 2)
+
+    ln, gn = jax.value_and_grad(loss_n)(params)
+    lf, gf = jax.value_and_grad(loss_f)(params)
+    np.testing.assert_allclose(float(ln), float(lf), rtol=1e-5)
+    for k in gn:
+        leaves_n = jax.tree_util.tree_leaves(gn[k])
+        leaves_f = jax.tree_util.tree_leaves(gf[k])
+        for a, b in zip(leaves_n, leaves_f):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+
+def test_nested_seq_output_is_nested_batch():
+    """A step returning the inner group's sequence output stacks into a
+    NestedSequenceBatch (reference: nested groups may output sequences)."""
+    subs, nested, _ = _nested_data(seed=2)
+    reset_names()
+    x = L.data_layer("x", size=DIM, is_seq=True)
+
+    def outer_step(subseq):
+        def inner_step(y):
+            mem = L.memory(name="s", size=HID)
+            return L.fc_layer([y, mem], size=HID, act="tanh", name="s")
+
+        return L.recurrent_group(inner_step, subseq)
+
+    out = L.recurrent_group(outer_step, L.SubsequenceInput(x))
+    topo = Topology([out])
+    params = topo.init(jax.random.PRNGKey(0))
+    val = topo.apply(params, {"x": nested}, mode="test")
+    assert isinstance(val, NestedSequenceBatch)
+    B = len(subs)
+    assert val.data.shape[0] == B and val.data.shape[-1] == HID
+    np.testing.assert_array_equal(np.asarray(val.outer_lengths),
+                                  [len(s) for s in subs])
+    # inner lengths match per-subsequence lengths; padding fully zeroed
+    inner = np.asarray(val.inner_lengths)
+    mask = np.asarray(val.inner_mask())
+    d = np.asarray(val.data)
+    assert np.all(d * (1 - mask[..., None]) == 0.0)
+    for b, sample in enumerate(subs):
+        for j, t in enumerate(sample):
+            assert inner[b, j] == len(t)
+
+
+def test_nested_jit_compiles():
+    subs, nested, _ = _nested_data(seed=3)
+    reset_names()
+    topo, _ = _build_nested()
+    params = topo.init(jax.random.PRNGKey(0))
+
+    @jax.jit
+    def f(p, n):
+        out = topo.apply(p, {"x": n}, mode="test")
+        return jnp.sum(value_data(out))
+
+    v1 = f(params, nested)
+    v2 = f(params, nested)
+    assert np.isfinite(float(v1)) and float(v1) == float(v2)

@@ -1,0 +1,308 @@
+"""Multi-chip numerics matrix (SURVEY.md §4 patterns 3-4): sharded train
+steps must be numerically equivalent to single-device, leaf-wise, across
+model-parallel and data×model meshes, for both a seq model (seq2seq with
+attention) and a conv model (resnet) — the reference proves the analogous
+claims with test_CompareTwoNets / test_CompareSparse over in-process
+pservers; here XLA collectives replace the pserver plane so equivalence of
+the jitted step under shardings IS the test.
+
+Plus a real 2-process multi-controller run (jax.distributed over local TCP,
+gloo CPU collectives) exercising parallel/distributed.py, which the
+reference covers with its localhost --pservers tests.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.sequence import SequenceBatch, pad_sequences
+from paddle_tpu.parallel import (MeshConfig, make_mesh, megatron_rules,
+                                 param_shardings, batch_shardings,
+                                 replicated_shardings)
+from paddle_tpu import optim
+
+needs_8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                             reason="needs 8 virtual devices")
+
+
+def _assert_tree_close(a, b, rtol=1e-4, atol=1e-5, what="leaf"):
+    fa = jax.tree_util.tree_flatten_with_path(a)[0]
+    fb = jax.tree_util.tree_leaves(b)
+    assert len(fa) == len(fb)
+    for (path, la), lb in zip(fa, fb):
+        np.testing.assert_allclose(
+            np.asarray(la), np.asarray(lb), rtol=rtol, atol=atol,
+            err_msg=f"{what} {jax.tree_util.keystr(path)}")
+
+
+def _seq_feed(rng, b, t, vocab):
+    return pad_sequences([rng.randint(1, vocab, (rng.randint(2, t + 1),))
+                          for _ in range(b)], max_len=t)
+
+
+def _seq2seq_case(np_rng, b=8):
+    from paddle_tpu.models import seq2seq
+    params = seq2seq.init(jax.random.PRNGKey(0), src_vocab=64, trg_vocab=64,
+                          emb_dim=16, hidden=16)
+    src = _seq_feed(np_rng, b, 6, 64)
+    trg_in = _seq_feed(np_rng, b, 5, 64)
+    trg_next = SequenceBatch(np.roll(np.asarray(trg_in.data), -1, axis=1),
+                             trg_in.lengths)
+
+    def loss_fn(p, feed):
+        return seq2seq.loss(p, feed["src"], feed["trg_in"], feed["trg_next"])
+
+    return params, {"src": src, "trg_in": trg_in, "trg_next": trg_next}, loss_fn
+
+
+def _resnet_case(np_rng, b=8):
+    # f64: conv reduction order differs between sharded and unsharded
+    # layouts, so f32 accumulation noise (up to ~1e-2 relative on
+    # cancelling sums) would swamp a tight equivalence check
+    from paddle_tpu.models import resnet
+    f64 = lambda t: jax.tree_util.tree_map(          # noqa: E731
+        lambda x: x.astype(jnp.float64)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, t)
+    params, state = resnet.init(jax.random.PRNGKey(0), depth=50,
+                                num_classes=16)
+    params, state = f64(params), f64(state)
+    images = jnp.asarray(np_rng.randn(b, 32, 32, 3), jnp.float64)
+    labels = jnp.asarray(np_rng.randint(0, 16, (b,)))
+
+    def loss_fn(p, feed):
+        l, _ = resnet.loss(p, state, feed["im"], feed["lab"], depth=50,
+                           train=False)
+        return l
+
+    return params, {"im": images, "lab": labels}, loss_fn
+
+
+def _grad_step(loss_fn):
+    def step(p, feed):
+        return jax.value_and_grad(loss_fn)(p, feed)
+    return step
+
+
+def _run_sharded_vs_single(case, mesh_cfg, rules=None, rtol=1e-4, atol=1e-5):
+    np_rng = np.random.RandomState(0)
+    params, feed, loss_fn = case(np_rng)
+    step = _grad_step(loss_fn)
+
+    l1, g1 = jax.jit(step)(params, feed)
+
+    mesh = make_mesh(mesh_cfg)
+    ps = param_shardings(params, mesh, rules)
+    fs = batch_shardings(feed, mesh)
+    scalar = NamedSharding(mesh, P())
+    stepj = jax.jit(step, in_shardings=(ps, fs), out_shardings=(scalar, ps))
+    lN, gN = stepj(jax.device_put(params, ps), jax.device_put(feed, fs))
+
+    np.testing.assert_allclose(float(l1), float(lN), rtol=rtol)
+    _assert_tree_close(g1, gN, rtol=rtol, atol=atol, what="grad")
+
+
+@needs_8
+def test_seq2seq_model_parallel():
+    """Megatron tensor parallelism over 'model' (8-way) == single device."""
+    _run_sharded_vs_single(_seq2seq_case, MeshConfig(data=1, model=8),
+                           megatron_rules())
+
+
+@needs_8
+def test_seq2seq_data_model_mesh():
+    """Hybrid 2-way data x 4-way model mesh == single device."""
+    _run_sharded_vs_single(_seq2seq_case, MeshConfig(data=2, model=4),
+                           megatron_rules())
+
+
+def _in_f64(fn):
+    from paddle_tpu.core import dtypes
+    jax.config.update("jax_enable_x64", True)
+    dtypes.set_policy("float64", "float64")
+    try:
+        fn()
+    finally:
+        dtypes.set_policy("float32", None)
+        jax.config.update("jax_enable_x64", False)
+
+
+@needs_8
+def test_resnet_data_parallel():
+    _in_f64(lambda: _run_sharded_vs_single(
+        _resnet_case, MeshConfig(data=8, model=1), rtol=1e-8, atol=1e-10))
+
+
+@needs_8
+def test_resnet_data_model_mesh():
+    """Conv kernels replicate (megatron rules only hit [in,out] mats); the
+    fc head shards over model — still must match exactly."""
+    _in_f64(lambda: _run_sharded_vs_single(
+        _resnet_case, MeshConfig(data=4, model=2), megatron_rules(),
+        rtol=1e-8, atol=1e-10))
+
+
+@needs_8
+def test_optimizer_update_sharded_seq2seq():
+    """Full train step (fwd+bwd+Adam update) under data x model sharding
+    matches single device leaf-wise — optimizer slots inherit param specs."""
+    np_rng = np.random.RandomState(1)
+    params, feed, loss_fn = _seq2seq_case(np_rng)
+    opt = optim.Adam(learning_rate=1e-2)
+
+    def train_step(p, s, feed):
+        l, g = jax.value_and_grad(loss_fn)(p, feed)
+        p2, s2 = opt.update(g, s, p)
+        return l, p2, s2
+
+    s0 = opt.init(params)
+    l1, p1, _ = jax.jit(train_step)(params, s0, feed)
+
+    mesh = make_mesh(MeshConfig(data=2, model=4))
+    rules = megatron_rules()
+    ps = param_shardings(params, mesh, rules)
+    fs = batch_shardings(feed, mesh)
+    # optimizer state: replicate the step counter, shard slots like params
+    ss = _opt_state_shardings(s0, ps, mesh)
+    scalar = NamedSharding(mesh, P())
+    stepj = jax.jit(train_step, in_shardings=(ps, ss, fs),
+                    out_shardings=(scalar, ps, ss))
+    lN, pN, _ = stepj(jax.device_put(params, ps), jax.device_put(s0, ss),
+                      jax.device_put(feed, fs))
+    np.testing.assert_allclose(float(l1), float(lN), rtol=1e-4)
+    _assert_tree_close(p1, pN, rtol=1e-4, atol=1e-5, what="param")
+
+
+def _opt_state_shardings(state, param_sh, mesh):
+    """Optimizer state sharding: replicate the step counter, give each slot
+    tree (params-shaped) the parameters' own shardings."""
+    scalar = NamedSharding(mesh, P())
+    return {"step": scalar, "slots": {k: param_sh for k in state["slots"]}}
+
+
+@needs_8
+def test_two_process_distributed_cpu():
+    """Real multi-controller run: 2 processes x 4 CPU devices, gloo
+    collectives, one data-parallel Momentum step; both ranks must see the
+    same loss/params, equal to the single-process result."""
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WORKER, str(rank), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+        for rank in range(2)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=420)
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    # both ranks agree
+    np.testing.assert_allclose(outs[0]["loss"], outs[1]["loss"], rtol=1e-6)
+    np.testing.assert_allclose(outs[0]["wsum"], outs[1]["wsum"], rtol=1e-6)
+
+    # equals the single-process reference computed here
+    ref = _single_process_reference()
+    np.testing.assert_allclose(outs[0]["loss"], ref[0], rtol=1e-5)
+    np.testing.assert_allclose(outs[0]["wsum"], ref[1], rtol=1e-5)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _toy_data():
+    r = np.random.RandomState(7)
+    x = r.randn(16, 8).astype(np.float32)
+    y = r.randint(0, 4, (16,))
+    return x, y
+
+
+def _toy_model():
+    from paddle_tpu.ops import losses
+
+    def loss_fn(p, x, y):
+        h = jnp.tanh(x @ p["w1"])
+        logits = h @ p["w2"]
+        return jnp.mean(losses.classification_cost(logits, y))
+
+    r = np.random.RandomState(3)
+    params = {"w1": jnp.asarray(r.randn(8, 16) * 0.1, jnp.float32),
+              "w2": jnp.asarray(r.randn(16, 4) * 0.1, jnp.float32)}
+    return params, loss_fn
+
+
+def _single_process_reference():
+    params, loss_fn = _toy_model()
+    x, y = _toy_data()
+    opt = optim.Momentum(learning_rate=0.1, momentum=0.9)
+
+    def step(p, s, x, y):
+        l, g = jax.value_and_grad(loss_fn)(p, x, y)
+        p2, s2 = opt.update(g, s, p)
+        return l, p2
+
+    l, p2 = jax.jit(step)(params, opt.init(params), x, y)
+    return float(l), float(sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(p2)))
+
+
+_WORKER = textwrap.dedent("""
+    import json, sys
+    import numpy as np
+    rank, port = int(sys.argv[1]), int(sys.argv[2])
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.parallel import distributed, MeshConfig
+    from paddle_tpu.parallel import batch_shardings, param_shardings
+    from paddle_tpu import optim
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    distributed.init_distributed(coordinator=f"127.0.0.1:{port}",
+                                 num_processes=2, process_id=rank)
+    assert jax.device_count() == 8 and jax.local_device_count() == 4
+    mesh = distributed.global_mesh(MeshConfig(data=8))
+    distributed.barrier("start")
+
+    sys.path.insert(0, ".")
+    from tests.test_parallel_matrix import _toy_model, _toy_data
+    params, loss_fn = _toy_model()
+    x, y = _toy_data()
+    opt = optim.Momentum(learning_rate=0.1, momentum=0.9)
+
+    def step(p, s, x, y):
+        l, g = jax.value_and_grad(loss_fn)(p, x, y)
+        p2, s2 = opt.update(g, s, p)
+        return l, p2
+
+    xsh = NamedSharding(mesh, P("data"))
+    ssh = NamedSharding(mesh, P())
+    # each process owns half the batch: global [16] over 8 devices
+    lo = 8 * rank
+    gx = jax.make_array_from_process_local_data(xsh, x[lo:lo + 8], (16, 8))
+    gy = jax.make_array_from_process_local_data(xsh, y[lo:lo + 8], (16,))
+    psh = param_shardings(params, mesh)
+    st = opt.init(params)
+    osh = {"step": ssh, "slots": {"mom": psh}}
+    stepj = jax.jit(step, in_shardings=(psh, osh, xsh, xsh),
+                    out_shardings=(ssh, psh))
+    l, p2 = stepj(jax.device_put(params, psh),
+                  jax.device_put(st, osh), gx, gy)
+    wsum = float(sum(jnp.sum(v) for v in jax.tree_util.tree_leaves(p2)))
+    distributed.barrier("end")
+    print(json.dumps({"loss": float(l), "wsum": wsum}))
+""")
