@@ -1,0 +1,100 @@
+"""Model-zoo feature extraction (reference demo/model_zoo/resnet/classify.py
++ embedding/extract_para.py): load a trained checkpoint, run images through
+ResNet and dump an intermediate feature layer, or pull an embedding table
+out of a checkpoint into .npz/text.
+
+Usage:
+  python extract_features.py resnet  --model_dir DIR --out feats.npz \
+      [--layer pool] [--depth 50]
+  python extract_features.py embedding --model_dir DIR --param src_emb \
+      --out emb.npz [--text emb.txt]
+
+With no --model_dir, randomly-initialized weights are used so the demo runs
+end-to-end without a download (the reference ships get_model.sh instead)."""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.utils import logger
+
+
+def load_params(model_dir, pass_id=None):
+    from paddle_tpu.trainer.checkpoint import load_checkpoint
+    params, _opt, model_state, _meta = load_checkpoint(model_dir, pass_id)
+    return params, model_state
+
+
+def run_resnet(args):
+    from paddle_tpu.models import resnet
+    if args.model_dir:
+        # model_state carries the BN running stats — required in test mode
+        params, state = load_params(args.model_dir, args.pass_id)
+    else:
+        logger.info("no --model_dir: using random init")
+        params, state = resnet.init(jax.random.PRNGKey(0), depth=args.depth)
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.rand(args.batch, 224, 224, 3), jnp.float32) \
+        if not args.images else jnp.asarray(np.load(args.images))
+
+    if args.layer == "pool":
+        feats = resnet.features(params, state, images, depth=args.depth)
+    else:
+        feats, _ = resnet.forward(params, state, images, depth=args.depth,
+                                  train=False)
+    np.savez(args.out, features=np.asarray(feats))
+    logger.info("wrote %s: %s", args.out, np.asarray(feats).shape)
+
+
+def run_embedding(args):
+    params, _ = load_params(args.model_dir, args.pass_id)
+    node = params
+    for part in args.param.split("/"):
+        node = node[part]
+    table = np.asarray(node["w"] if isinstance(node, dict) and "w" in node
+                       else node)
+    np.savez(args.out, embedding=table)
+    logger.info("wrote %s: vocab=%d dim=%d", args.out, *table.shape)
+    if args.text:
+        # reference extract_para.py text format: one row per word
+        with open(args.text, "w") as f:
+            f.write(f"{table.shape[0]} {table.shape[1]}\n")
+            for row in table:
+                f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+        logger.info("wrote %s", args.text)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    sub = p.add_subparsers(dest="what", required=True)
+    r = sub.add_parser("resnet")
+    r.add_argument("--model_dir", default=None)
+    r.add_argument("--pass_id", type=int, default=None)
+    r.add_argument("--depth", type=int, default=50)
+    r.add_argument("--layer", default="logits", choices=["logits", "pool"])
+    r.add_argument("--images", default=None,
+                   help=".npy of [N,224,224,3] floats")
+    r.add_argument("--batch", type=int, default=2)
+    r.add_argument("--out", default="features.npz")
+    e = sub.add_parser("embedding")
+    e.add_argument("--model_dir", required=True)
+    e.add_argument("--pass_id", type=int, default=None)
+    e.add_argument("--param", required=True,
+                   help="params path to the table, e.g. src_emb or emb/w")
+    e.add_argument("--out", default="embedding.npz")
+    e.add_argument("--text", default=None)
+    args = p.parse_args(argv)
+    if args.what == "resnet":
+        run_resnet(args)
+    else:
+        run_embedding(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Traffic-speed prediction (reference demo/traffic_prediction): multi-task
+training — 24 forecasting heads over a shared link embedding, each head a
+4-class speed-bucket classifier; the embedding fc is SHARED across tasks via
+a named ParamAttr (reference trainer_config.py `_link_vec.w`).
+
+Data: the reference reads road-sensor CSV speed series; here a deterministic
+synthetic series with the same windowing (TERM_NUM past points -> next
+FORECASTING_NUM bucketized speeds) so the demo trains out of the box.  Point
+PADDLE_TPU_DATA_DIR/traffic/speeds.csv at a real file (rows of
+"id,speed,speed,...") to use real data."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import jax
+
+import paddle_tpu.layers as L
+from paddle_tpu import optim
+from paddle_tpu.data import dense_vector, integer_value
+from paddle_tpu.data import reader as reader_mod
+from paddle_tpu.data.datasets._synth import local_path, rng_for
+from paddle_tpu.trainer import SGD
+from paddle_tpu.utils import logger
+
+TERM_NUM = 24          # past time points fed as the feature window
+FORECASTING_NUM = 24   # future points to predict (multi-task heads)
+LABEL_VALUE_NUM = 4    # speed buckets
+EMB_SIZE = 16
+
+
+def speed_rows():
+    """Real CSV rows if present, else synthetic periodic-plus-noise series
+    bucketized into 1..4 like the reference provider's expectations."""
+    path = local_path("traffic", "speeds.csv")
+    if os.path.exists(path):
+        with open(path) as f:
+            next(f)  # header
+            for line in f:
+                yield [int(v) for v in line.rstrip("\r\n").split(",")[1:]]
+        return
+    rng = rng_for("traffic", "train")
+    for _ in range(24):
+        t = np.arange(400)
+        base = 2.5 + 1.4 * np.sin(2 * np.pi * t / 96.0 + rng.rand() * 6.28)
+        noisy = np.clip(np.round(base + 0.3 * rng.randn(t.size)), 1,
+                        LABEL_VALUE_NUM)
+        yield [int(v) for v in noisy]
+
+
+def samples():
+    """Sliding windows (reference dataprovider.process): feature = previous
+    TERM_NUM speeds (float), labels = next FORECASTING_NUM buckets - 1."""
+    for speeds in speed_rows():
+        for i in range(TERM_NUM, len(speeds) - FORECASTING_NUM):
+            feat = [float(v) for v in speeds[i - TERM_NUM:i]]
+            labels = [v - 1 for v in speeds[i:i + FORECASTING_NUM]]
+            yield tuple([feat] + labels)
+
+
+def get_config():
+    link_encode = L.data_layer("link_encode", size=TERM_NUM)
+    costs, outputs, feeding = [], [], {"link_encode": dense_vector(TERM_NUM)}
+    for i in range(FORECASTING_NUM):
+        # every task shares the same link embedding weight (reference
+        # ParamAttr(name='_link_vec.w'))
+        link_vec = L.fc_layer(link_encode, size=EMB_SIZE, act="tanh",
+                              param_attr={"name": "_link_vec.w"})
+        score = L.fc_layer(link_vec, size=LABEL_VALUE_NUM, act="softmax",
+                           name=f"score_{(i + 1) * 5}min")
+        lab_name = f"label_{(i + 1) * 5}min"
+        label = L.data_layer(lab_name, size=1)
+        feeding[lab_name] = integer_value(LABEL_VALUE_NUM)
+        costs.append(L.classification_cost(input=score, label=label,
+                                           name=f"cost_{(i + 1) * 5}min"))
+        outputs.append(score)
+    return {
+        "cost": costs,
+        "outputs": outputs,
+        "optimizer": optim.RMSProp(learning_rate=1e-3),
+        "train_reader": reader_mod.batch(
+            reader_mod.shuffle(samples, 4096, seed=0), 128),
+        "feeding": feeding,
+    }
+
+
+def main(num_passes=2):
+    cfg = get_config()
+    trainer = SGD(cost=cfg["cost"], update_equation=cfg["optimizer"], seed=0)
+    trainer.train(cfg["train_reader"], num_passes=num_passes,
+                  feeding=cfg["feeding"], log_period=20)
+    return trainer
+
+
+if __name__ == "__main__":
+    main()

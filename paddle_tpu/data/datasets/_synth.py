@@ -1,16 +1,24 @@
 """Synthetic data helpers shared by the dataset modules."""
 
 import os
+import zlib
 
 import numpy as np
 
-DATA_DIR = os.environ.get("PADDLE_TPU_DATA_DIR",
+
+def data_dir():
+    """Resolved at call time so tests (and late exports) can set
+    PADDLE_TPU_DATA_DIR after import."""
+    return os.environ.get("PADDLE_TPU_DATA_DIR",
                           os.path.expanduser("~/.cache/paddle_tpu/dataset"))
 
 
 def rng_for(name, split):
-    return np.random.RandomState(abs(hash((name, split))) % (2 ** 31))
+    # crc32, not hash(): str hash is salted per process and synthetic
+    # datasets must be reproducible across runs
+    key = f"{name}:{split!r}".encode()
+    return np.random.RandomState(zlib.crc32(key) % (2 ** 31))
 
 
 def local_path(*parts):
-    return os.path.join(DATA_DIR, *parts)
+    return os.path.join(data_dir(), *parts)

@@ -200,45 +200,97 @@ class RankAuc(Auc):
 
 
 class ChunkEvaluator(Evaluator):
-    """Reference ChunkEvaluator.cpp: chunk (NER span) F1 over IOB/IOE/IOBES
-    tagging.  Host-side decode of spans."""
+    """Reference ChunkEvaluator.cpp: chunk (NER span) F1 over plain/IOB/IOE/
+    IOBES tagging — the exact isChunkBegin/isChunkEnd state machine
+    (ChunkEvaluator.cpp:186-245).  Host-side decode of spans.
+
+    Label encoding (reference :33-35): tag = label % numTagTypes,
+    chunk type = label // numTagTypes; label == numChunkTypes*numTagTypes
+    is the 'other' (O) tag."""
     name = "chunk"
 
-    def __init__(self, scheme="IOB", num_chunk_types=None):
+    _SCHEMES = {
+        #            nTag  B   I   E   S
+        "IOB":       (2,   0,  1, -1, -1),
+        "IOE":       (2,  -1,  0,  1, -1),
+        "IOBES":     (4,   0,  1,  2,  3),
+        "plain":     (1,  -1, -1, -1, -1),
+    }
+
+    def __init__(self, scheme="IOB", num_chunk_types=None,
+                 excluded_chunk_types=()):
+        if scheme not in self._SCHEMES:
+            raise ValueError(f"unknown chunk scheme {scheme!r} "
+                             f"(have {sorted(self._SCHEMES)})")
         self.scheme = scheme
+        self.num_chunk_types = num_chunk_types
+        self.excluded = set(excluded_chunk_types)
 
     def init(self):
         return {"correct": 0, "pred": 0, "gold": 0}
 
-    @staticmethod
-    def _spans_iob(tags):
-        """tags: list of (is 2*type + {0:B,1:I}) per reference encoding."""
-        spans, start, cur_type = [], None, None
-        for i, t in enumerate(tags):
-            if t < 0:
+    def _segments(self, tags, num_chunk_types):
+        n_tag, t_b, t_i, t_e, t_s = self._SCHEMES[self.scheme]
+        other = num_chunk_types
+
+        def is_end(ptag, ptype, tag, typ):
+            if ptype == other:
+                return False
+            if typ == other or typ != ptype:
+                return True
+            if ptag in (t_e, t_s):
+                return True
+            if ptag in (t_b, t_i):
+                return tag in (t_b, t_s)
+            return False
+
+        def is_begin(ptag, ptype, tag, typ):
+            if ptype == other:
+                return typ != other
+            if typ == other:
+                return False
+            if typ != ptype or tag == t_b or tag == t_s:
+                return True
+            if tag in (t_i, t_e):
+                return ptag in (t_e, t_s)
+            return False
+
+        segments = []
+        start, in_chunk = 0, False
+        tag, typ = -1, other
+        for i, lab in enumerate(tags):
+            if lab < 0:        # negative padding without lengths=: stop
+                tags = tags[:i]
                 break
-            ttype, pos = t // 2, t % 2
-            if pos == 0:  # B
-                if start is not None:
-                    spans.append((start, i, cur_type))
-                start, cur_type = i, ttype
-            elif start is None or ttype != cur_type:
-                # I without matching B: treat as start (reference tolerant mode)
-                if start is not None:
-                    spans.append((start, i, cur_type))
-                start, cur_type = i, ttype
-        if start is not None:
-            spans.append((start, len(tags), cur_type))
-        return set(spans)
+            ptag, ptype = tag, typ
+            tag, typ = lab % n_tag, lab // n_tag
+            if in_chunk and is_end(ptag, ptype, tag, typ):
+                segments.append((start, i - 1, ptype))
+                in_chunk = False
+            if is_begin(ptag, ptype, tag, typ):
+                start, in_chunk = i, True
+        if in_chunk:
+            segments.append((start, len(tags) - 1, typ))
+        return {s for s in segments if s[2] not in self.excluded}
+
+    def _num_types(self, *arrays):
+        if self.num_chunk_types is None:
+            # the reference REQUIRES num_chunk_types (ChunkEvaluator.cpp:108
+            # CHECK); inferring it from data is ambiguous because the same
+            # max label can be a typed tag or the O tag
+            raise ValueError("ChunkEvaluator needs num_chunk_types= "
+                             "(reference chunk evaluator config field)")
+        return self.num_chunk_types
 
     def update(self, state, pred=None, label=None, lengths=None, **_):
         p = np.asarray(pred)
         l = np.asarray(label)
         lens = np.asarray(lengths) if lengths is not None else \
             np.full(p.shape[0], p.shape[1])
+        nct = self._num_types(p, l)
         for i in range(p.shape[0]):
-            ps = self._spans_iob(list(p[i, :lens[i]]))
-            gs = self._spans_iob(list(l[i, :lens[i]]))
+            ps = self._segments(list(p[i, :lens[i]]), nct)
+            gs = self._segments(list(l[i, :lens[i]]), nct)
             state["correct"] += len(ps & gs)
             state["pred"] += len(ps)
             state["gold"] += len(gs)

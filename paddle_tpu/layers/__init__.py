@@ -7,6 +7,8 @@ from paddle_tpu.layers.recurrent import *    # noqa: F401,F403
 from paddle_tpu.layers.generation import *   # noqa: F401,F403
 from paddle_tpu.layers import networks
 from paddle_tpu.layers.networks import *     # noqa: F401,F403
+from paddle_tpu.layers import recurrent_units
+from paddle_tpu.layers.recurrent_units import *  # noqa: F401,F403
 from paddle_tpu.layers import api as _api
 from paddle_tpu.layers import vision as _vision
 from paddle_tpu.layers import recurrent as _recurrent
@@ -46,4 +48,5 @@ def layer_support(*attrs):
 __all__ = (["LayerOutput", "Topology", "Context", "networks", "LayerType",
             "layer_support"]
            + _api.__all__ + _vision.__all__ + _recurrent.__all__
-           + _generation.__all__ + networks.__all__)
+           + _generation.__all__ + networks.__all__
+           + recurrent_units.__all__)

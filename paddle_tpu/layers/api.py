@@ -420,6 +420,8 @@ def _finalize_mixed(node, parts, size):
         nodes.extend(p.inputs)
     node.size = int(size)
     node.inputs = nodes
+    # inputs arrive after construction: recompute sequence-ness propagation
+    node.is_seq = any(getattr(n, "is_seq", False) for n in nodes)
     node.cfg.update({"size": size, "parts": cfg_parts})
     return node
 

@@ -152,7 +152,9 @@ class _BeamSearchImpl:
         result = beam_ops.beam_search(
             beam_step, tuple(boot_vals), batch_size=bsz, beam_size=k,
             max_len=cfg["max_length"], bos_id=gen.bos_id, eos_id=gen.eos_id,
-            length_penalty=cfg.get("length_penalty", 0.0))
+            length_penalty=cfg.get("length_penalty", 0.0),
+            candidate_adjust=cfg.get("candidate_adjust"),
+            drop_callback=cfg.get("drop_callback"))
         ctx.aux[cfg["self_name"] + "/result"] = result
         return result
 
@@ -222,7 +224,8 @@ def _trace_step(step, input, bos_id, eos_id):
 
 
 def beam_search(step, input, bos_id=None, eos_id=None, beam_size=5,
-                max_length=100, length_penalty=0.0, name=None):
+                max_length=100, length_penalty=0.0, name=None,
+                candidate_adjust=None, drop_callback=None):
     """DSL beam search (reference layers.py beam_search).
 
     step(generated_word_embedding, *statics) -> softmax LayerOutput over the
@@ -230,10 +233,17 @@ def beam_search(step, input, bos_id=None, eos_id=None, beam_size=5,
     recurrent_group.  Returns a layer whose value is a BeamResult
     (tokens [B, K, T] best-first, scores, lengths); its .size is 1 (token-id
     rows).  bos/eos default to the GeneratedInput's ids.
+
+    candidate_adjust(log_probs) and drop_callback(tokens, t, cand) are the
+    reference RecurrentGradientMachine user hooks
+    (RecurrentGradientMachine.h:87-177): per-step score rewriting and
+    per-node drop/renormalize over the expanded candidates.
     """
     cfg, group_inputs = _trace_step(step, input, bos_id, eos_id)
     cfg.update({"beam_size": beam_size, "max_length": max_length,
-                "length_penalty": length_penalty})
+                "length_penalty": length_penalty,
+                "candidate_adjust": candidate_adjust,
+                "drop_callback": drop_callback})
     node = LayerOutput(name or auto_name("beam_search"), "beam_search_gen",
                        1, group_inputs, cfg, is_seq=True)
     node.cfg["self_name"] = node.name

@@ -132,9 +132,12 @@ def _basic(x, blk, st, stride, train):
     return jax.nn.relu(x + y), new_st
 
 
-def forward(params, state, images, depth=50, train=True, imagenet=None):
+def forward(params, state, images, depth=50, train=True, imagenet=None,
+            return_pool=False):
     """images: NHWC float.  depth/imagenet are static config (must match
-    init).  Returns (logits, new_state)."""
+    init).  Returns (logits, new_state); with return_pool=True the first
+    element is instead the global-average-pooled features [N, D] (the layer
+    the reference model_zoo classify.py --job=extract dumps)."""
     imagenet = imagenet if imagenet is not None else depth in (50, 101, 152)
     new_state = {}
     x = images
@@ -166,8 +169,19 @@ def forward(params, state, images, depth=50, train=True, imagenet=None):
                 x, new_state[nm] = _basic(x, params[nm], state[nm], stride,
                                           train)
     x = jnp.mean(x, axis=(1, 2))
+    if return_pool:
+        return x, new_state
     logits = linear.fc(x, params["head"]["w"], params["head"]["b"])
     return logits, new_state
+
+
+def features(params, state, images, depth=50, imagenet=None):
+    """Global-average-pooled features before the classifier head (reference
+    demo/model_zoo/resnet/classify.py --job=extract): the exact pooled
+    tensor, no head matmul, no compute-dtype round trip."""
+    feats, _ = forward(params, state, images, depth, train=False,
+                       imagenet=imagenet, return_pool=True)
+    return feats
 
 
 def loss(params, state, images, labels, depth=50, train=True, imagenet=None):

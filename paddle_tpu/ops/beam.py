@@ -29,12 +29,20 @@ class BeamResult(NamedTuple):
 def beam_search(step_fn: Callable, init_state: Any, batch_size: int,
                 beam_size: int, max_len: int, bos_id: int, eos_id: int,
                 length_penalty: float = 0.0,
-                candidate_adjust: Optional[Callable] = None):
+                candidate_adjust: Optional[Callable] = None,
+                drop_callback: Optional[Callable] = None):
     """step_fn(state, prev_ids [B*K]) -> (log_probs [B*K, V], new_state).
 
     State leaves are [B*K, ...] (lane-major).  candidate_adjust(log_probs)
     optionally rewrites per-step candidate scores (the reference's
     calc_id_interest / candidate adjust hook).
+
+    drop_callback(tokens [B, K, T], t, cand [B, K, V]) -> cand: the
+    reference's per-node NormOrDropNodeCallback
+    (RecurrentGradientMachine.h:87-177) — sees each lane's decoded prefix
+    and the expanded candidate scores at step t, and may renormalize them
+    or drop nodes by writing -inf; dropped expansions never enter top-k
+    (the static-shape equivalent of removing the Path in beamExpand).
 
     Returns BeamResult sorted best-first per batch row.
     """
@@ -69,6 +77,13 @@ def beam_search(step_fn: Callable, init_state: Any, batch_size: int,
         lp = jnp.where(finished[..., None], eos_only[None, None, :], lp)
 
         cand = scores[..., None] + lp                       # [B, K, V]
+        if drop_callback is not None:
+            # never drop the eos continuation of an already-finished lane
+            # (it carries the lane's final score, not a real expansion)
+            adjusted = drop_callback(tokens, t, cand)
+            keep_eos = finished[..., None] & (
+                jnp.arange(v)[None, None, :] == eos_id)
+            cand = jnp.where(keep_eos, cand, adjusted)
         flat = cand.reshape(batch_size, beam_size * v)
         top_scores, top_idx = jax.lax.top_k(flat, beam_size)  # [B, K]
         src_lane = (top_idx // v).astype(jnp.int32)

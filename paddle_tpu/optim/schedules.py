@@ -48,14 +48,35 @@ def linear(learning_rate, decay_a, decay_b):
 
 
 def manual(learning_rate, segments):
-    """Piecewise-constant by sample/batch count (reference 'manual' /
-    'pass_manual'): segments = [(boundary, multiplier), ...]."""
+    """Piecewise-constant by sample/batch count (reference 'manual',
+    LearningRateScheduler.cpp ManualLRS: lr * rate of the first segment
+    whose boundary >= progress): segments = [(boundary, multiplier), ...].
+    The reference keys on samples processed; here the optimizer's step
+    counter (batches) is the progress unit."""
     bounds = jnp.asarray([b for b, _ in segments], jnp.float32)
     mults = jnp.asarray([m for _, m in segments] + [segments[-1][1]], jnp.float32)
 
     def sched(step):
-        idx = jnp.searchsorted(bounds, jnp.asarray(step, jnp.float32), side="right")
+        # reference: num <= boundary keeps the segment -> side="left"
+        idx = jnp.searchsorted(bounds, jnp.asarray(step, jnp.float32),
+                               side="left")
         return learning_rate * mults[idx]
+    return sched
+
+
+def pass_manual(learning_rate, segments, steps_per_pass):
+    """Piecewise-constant by PASS number (reference 'pass_manual',
+    LearningRateScheduler.cpp PassManualLRS: calc(pass)): segments =
+    [(pass_boundary, multiplier), ...].  The jitted step only carries a
+    batch counter, so the pass index is derived as step // steps_per_pass —
+    pass steps_per_pass = ceil(len(dataset) / batch_size)."""
+    if not steps_per_pass or steps_per_pass < 1:
+        raise ValueError("pass_manual needs steps_per_pass >= 1 (batches "
+                         "per pass) to derive the pass index under jit")
+    base = manual(learning_rate, segments)
+
+    def sched(step):
+        return base(jnp.asarray(step, jnp.int32) // steps_per_pass)
     return sched
 
 
@@ -82,8 +103,11 @@ def get(name, learning_rate, decay_a=0.0, decay_b=0.0, segments=None, **kw):
         return discexp(learning_rate, decay_a, decay_b)
     if name == "linear":
         return linear(learning_rate, decay_a, decay_b)
-    if name in ("manual", "pass_manual"):
+    if name == "manual":
         return manual(learning_rate, segments)
+    if name == "pass_manual":
+        return pass_manual(learning_rate, segments,
+                           kw.get("steps_per_pass"))
     if name == "warmup_cosine":
         return warmup_cosine(learning_rate, **kw)
     raise KeyError(f"unknown lr schedule {name!r}")

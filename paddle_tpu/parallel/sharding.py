@@ -66,11 +66,15 @@ def megatron_rules(extra=()):
     return ShardingRules(rules)
 
 
-def valid_spec(spec: P, shape, mesh: Mesh) -> P:
+def valid_spec(spec: P, shape, mesh: Mesh, path: str = None) -> P:
     """Drop axis assignments that don't evenly divide the dim (that dim
     falls back to replication) — keeps tiny/odd params replicated instead of
     erroring, like the reference's block-size threshold in
-    ParameterClient2::calcParameterBlockSize."""
+    ParameterClient2::calcParameterBlockSize.
+
+    Every fallback on a non-trivial dim is logged: a fat embedding silently
+    replicated onto every chip is exactly the OOM you want a warning for."""
+    from paddle_tpu.utils.logging import logger
     ndim = len(shape)
     entries = list(tuple(spec)) + [None] * (ndim - len(tuple(spec)))
     out = []
@@ -80,7 +84,14 @@ def valid_spec(spec: P, shape, mesh: Mesh) -> P:
             continue
         axes = axis if isinstance(axis, tuple) else (axis,)
         size = int(np.prod([mesh.shape[a] for a in axes]))
-        out.append(axis if (shape[i] % size == 0 and shape[i] >= size) else None)
+        ok = shape[i] % size == 0 and shape[i] >= size
+        if not ok and int(np.prod(shape)) >= 65536:
+            logger.warning(
+                "sharding: %sdim %d of shape %s not divisible by %s=%d -> "
+                "REPLICATED (%.1f MB per device)",
+                f"{path}: " if path else "", i, tuple(shape), axes, size,
+                np.prod(shape) * 4 / 2 ** 20)
+        out.append(axis if ok else None)
     while out and out[-1] is None:
         out.pop()
     return P(*out)
@@ -92,7 +103,7 @@ def param_shardings(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
     return jax.tree_util.tree_map_with_path(
         lambda path, leaf: NamedSharding(
             mesh, valid_spec(rules.spec_for(_path_str(path)),
-                             np.shape(leaf), mesh)),
+                             np.shape(leaf), mesh, path=_path_str(path))),
         params)
 
 

@@ -55,6 +55,13 @@ def main(argv=None):
         p.add_argument("--data_parallel", type=int, default=0)
         p.add_argument("--model_parallel", type=int, default=1)
         p.add_argument("--seq_parallel", type=int, default=1)
+        p.add_argument("--profile_dir", default=None,
+                       help="capture an xprof device trace of the run")
+        p.add_argument("--debug_nans", action="store_true",
+                       help="fail fast on the op producing a NaN "
+                            "(reference feenableexcept)")
+        p.add_argument("--comment", default="",
+                       help="freeform run annotation, logged once")
 
     t = sub.add_parser("train")
     add_common(t)
@@ -108,6 +115,13 @@ def main(argv=None):
         print("wrote", out)
         return 0
 
+    if getattr(args, "debug_nans", False):
+        import jax
+        jax.config.update("jax_debug_nans", True)
+    if getattr(args, "comment", ""):
+        from paddle_tpu.utils.logging import logger
+        logger.info("comment: %s", args.comment)
+
     cfg = _load_config(args.config, _parse_config_args(args.config_args))
 
     if args.job == "checkgrad":
@@ -151,17 +165,27 @@ def main(argv=None):
                 raise SystemExit("--start_pass needs --save_dir (or a "
                                  "save_dir in the config)")
             trainer.load(save_dir, args.start_pass - 1)
-        trainer.train(cfg["train_reader"],
-                      num_passes=args.num_passes,
-                      feeding=cfg.get("feeding"),
-                      save_dir=save_dir,
-                      saving_period=args.saving_period,
-                      save_only_one=args.save_only_one,
-                      test_reader=cfg.get("test_reader"),
-                      test_period=args.test_period,
-                      log_period=args.log_period,
-                      show_parameter_stats_period=
-                      args.show_parameter_stats_period)
+        if args.profile_dir:
+            from paddle_tpu.utils import profiler
+            profiler.start(args.profile_dir)
+        try:
+            trainer.train(cfg["train_reader"],
+                          num_passes=args.num_passes,
+                          feeding=cfg.get("feeding"),
+                          save_dir=save_dir,
+                          saving_period=args.saving_period,
+                          save_only_one=args.save_only_one,
+                          test_reader=cfg.get("test_reader"),
+                          test_period=args.test_period,
+                          log_period=args.log_period,
+                          show_parameter_stats_period=
+                          args.show_parameter_stats_period)
+        finally:
+            # flush the trace even on a mid-pass failure — crashed runs are
+            # the ones you most want a profile of
+            if args.profile_dir:
+                from paddle_tpu.utils import profiler
+                profiler.stop()
         return 0
 
     if args.job == "test":

@@ -35,6 +35,15 @@ from paddle_tpu.parallel import (
     shard_params)
 
 
+
+def _normalize_feed(feed):
+    """Device-ready feed: (Nested)SequenceBatch pass through, everything
+    else becomes a jnp array."""
+    return {k: v if isinstance(v, (SequenceBatch, NestedSequenceBatch))
+            else jnp.asarray(v)
+            for k, v in feed.items()}
+
+
 class SGD:
     """paddle.v2.trainer.SGD equivalent.
 
@@ -226,12 +235,17 @@ class SGD:
                 loss_fn, argnums=(0, 1), has_aux=True)(dense_params, rows_map)
             dstate = opt_state["dense"]
             # global-norm clipping must see ONE norm across the split grad
-            # tree (dense + row blocks) or sparse/dense training diverge
+            # tree (dense + row blocks) or sparse/dense training diverge;
+            # and like the dense path it measures AFTER the elementwise
+            # clip_threshold (optim._clip applies threshold before norm)
             clip_scale = None
             if getattr(self.optimizer, "clip_norm", None):
-                gsq = sum(jnp.sum(jnp.square(g)) for g in
-                          jax.tree_util.tree_leaves((dg, rg)))
-                gn = jnp.sqrt(gsq + 1e-12)
+                ct = getattr(self.optimizer, "clip_threshold", None)
+                leaves = jax.tree_util.tree_leaves((dg, rg))
+                if ct:
+                    leaves = [jnp.clip(g, -ct, ct) for g in leaves]
+                gn = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                  for g in leaves) + 1e-12)
                 clip_scale = jnp.minimum(1.0, self.optimizer.clip_norm / gn)
             new_dense, new_dstate = self.optimizer.update(
                 dg, dstate, dense_params, clip_scale=clip_scale)
@@ -353,11 +367,8 @@ class SGD:
             window = []
             t0 = time.time()
             for batch_id, batch in enumerate(batch_reader()):
-                feed = feeder(batch) if feeder else batch
-                feed = {k: v if isinstance(v, (SequenceBatch,
-                               NestedSequenceBatch))
-        else jnp.asarray(v)
-                        for k, v in feed.items()}
+                feed = _normalize_feed(feeder(batch) if feeder
+                                       else batch)
                 event_handler(events.BeginIteration(pass_id, batch_id))
                 self.rng, step_rng = jax.random.split(self.rng)
                 if self._step_fn is None:
@@ -395,6 +406,12 @@ class SGD:
             pass_cost = float(cost_sum) / n_batches if n_batches else float("nan")
             logger.info("Pass %d done, mean cost %.5f%s", pass_id, pass_cost,
                         eval_log_suffix())
+            # per-pass step-time distribution (the BarrierStat successor:
+            # in synchronous SPMD the skew diagnostic is p99/p50 spread)
+            from paddle_tpu.utils.stats import step_histogram
+            if step_histogram.samples:
+                logger.info("  %s", step_histogram.summary())
+                step_histogram.reset()
             if test_reader is not None and (
                     not test_period or (pass_id + 1) % test_period == 0):
                 tc = self.test(test_reader, feeding=feeder)
@@ -423,11 +440,7 @@ class SGD:
             self._build_eval()
         total, n = 0.0, 0
         for batch in reader():
-            feed = feeder(batch) if feeder else batch
-            feed = {k: v if isinstance(v, (SequenceBatch,
-                               NestedSequenceBatch))
-        else jnp.asarray(v)
-                    for k, v in feed.items()}
+            feed = _normalize_feed(feeder(batch) if feeder else batch)
             cost, _ = self._eval_fn(self.parameters, self.model_state, feed)
             total += float(cost)
             n += 1
@@ -471,10 +484,7 @@ class Inferencer:
             feed = feeder(feed_or_batch)
         else:
             feed = feed_or_batch
-        feed = {k: v if isinstance(v, (SequenceBatch,
-                               NestedSequenceBatch))
-        else jnp.asarray(v)
-                for k, v in feed.items()}
+        feed = _normalize_feed(feed)
         return self._fn(self.parameters, self.model_state, feed)
 
 

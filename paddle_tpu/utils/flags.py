@@ -1,7 +1,12 @@
 """Global runtime flags.
 
-TPU-native equivalent of the reference's ~60 gflags (paddle/utils/Flags.cpp:18-110);
-multi-GPU/pserver topology flags become mesh-shape flags here.
+TPU-native equivalent of the reference's gflags surface
+(paddle/utils/Flags.cpp:18-110 and the trainer's DEFINE_* in
+trainer/Trainer.cpp / TrainerMain.cpp, documented under
+doc/howto/usage/cmd_parameter).  Every reference flag is either carried
+over under its own name, renamed to its TPU equivalent, or listed in
+`SUBSUMED` with the mechanism that replaces it — so a reference user can
+look any flag up here and learn its fate.
 """
 
 import argparse
@@ -11,33 +16,70 @@ from typing import Optional
 
 @dataclasses.dataclass
 class Flags:
-    # device / precision
-    use_tpu: bool = True
+    # ---- device / precision (reference: use_gpu, gpu_id, trainer_count)
+    use_tpu: bool = True            # use_gpu analog; False pins CPU
     dtype: str = "float32"          # parameter dtype ("real" in the reference)
     compute_dtype: str = "bfloat16"  # matmul/conv compute dtype on TPU
+    seed: int = 1                   # reference: --seed (0 = time-based)
 
-    # training loop (reference: --log_period, --saving_period, --test_period)
+    # ---- jobs / config (reference: job, config, config_args)
+    job: str = "train"              # train | test | checkgrad | merge_model
+    config: Optional[str] = None
+    config_args: str = ""
+    comment: str = ""               # freeform run annotation, logged once
+
+    # ---- training loop (reference names kept)
     log_period: int = 100
+    dot_period: int = 1             # reference --dot_period ('.' cadence);
+    #                                 kept for config compat, logging is the
+    #                                 real progress channel here
     saving_period: int = 1
+    saving_period_by_batches: int = 0   # 0 = off (save per pass only)
     test_period: int = 0
+    test_pass: Optional[int] = None
+    average_test_period: int = 0    # Polyak-averaged eval cadence
     num_passes: int = 1
     start_pass: int = 0
     save_dir: Optional[str] = None
     save_only_one: bool = False
-    seed: int = 1
+    init_model_path: Optional[str] = None
+    load_missing_parameter_strategy: str = "fail"  # fail | rand | zero
+    show_parameter_stats_period: int = 0
+    show_layer_stat: bool = False   # per-layer output stats each log_period
+    checkgrad_eps: float = 1e-3
+    prev_batch_state: bool = False  # carry RNN state across batches
+    with_cost: bool = True
 
-    # parallelism (replaces --trainer_count / pserver topology)
+    # ---- prediction outputs (reference: predict_file, predict_output_dir)
+    predict_file: Optional[str] = None
+    predict_output_dir: Optional[str] = None
+
+    # ---- parallelism: mesh shape replaces trainer_count/ports/pserver
+    # topology (reference: trainer_count, parallel_nn, num_gradient_servers)
     data_parallel: int = 0   # 0 = all devices
     model_parallel: int = 1
     seq_parallel: int = 1
     expert_parallel: int = 1
+    # multi-host rendezvous (reference: port/ports_num/nics/trainer_id ->
+    # one coordinator address + process indices, parallel/distributed.py)
+    coordinator: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    dcn_data_parallel: int = 1      # slices joined over DCN (hybrid mesh)
 
-    # decoding
+    # ---- decoding
     beam_size: int = 1
 
-    # data
-    async_load_data: bool = True
+    # ---- data
+    async_load_data: bool = True    # reference DoubleBuffer
     prefetch_depth: int = 2
+
+    # ---- observability (new floor; reference had host timers only)
+    profile_dir: Optional[str] = None   # capture an xprof trace of training
+    debug_nans: bool = False            # NaN -> immediate error with op
+    #                                     location (reference feenableexcept
+    #                                     in TrainerMain.cpp:49)
+    memory_profile_path: Optional[str] = None  # dump device memory profile
 
     def update_from_args(self, args):
         for field in dataclasses.fields(self):
@@ -50,9 +92,47 @@ class Flags:
             if field.type is bool or isinstance(field.default, bool):
                 parser.add_argument(name, type=lambda v: v.lower() in ("1", "true", "yes"),
                                     default=None)
+            elif isinstance(field.default, float):
+                parser.add_argument(name, type=float, default=None)
             else:
                 typ = int if isinstance(field.default, int) else str
                 parser.add_argument(name, type=typ, default=None)
+
+    def apply(self):
+        """Push flag values into the runtime (dtype policy, debug_nans)."""
+        from paddle_tpu.core import dtypes
+        import jax
+        dtypes.set_policy(self.dtype,
+                          None if self.compute_dtype in (None, "", "auto")
+                          else self.compute_dtype)
+        if self.debug_nans:
+            jax.config.update("jax_debug_nans", True)
+
+
+# Reference flags with no runtime role here, and why — the lookup table for
+# migrating users (reference Flags.cpp names):
+SUBSUMED = {
+    "use_gpu": "use_tpu (XLA backend selection)",
+    "gpu_id": "device choice is XLA's; use JAX_PLATFORMS / mesh flags",
+    "trainer_count": "data_parallel mesh axis",
+    "parallel_nn": "model_parallel mesh axis (sharding rules)",
+    "port": "coordinator (jax.distributed rendezvous)",
+    "ports_num": "single coordinator address suffices",
+    "ports_num_for_sparse": "sparse tables shard over the mesh like any param",
+    "nics": "ICI/DCN routing is platform-managed",
+    "rdma_tcp": "ICI/DCN routing is platform-managed",
+    "trainer_id": "process_id",
+    "num_gradient_servers": "num_processes",
+    "start_pserver": "no parameter server exists",
+    "loadsave_parameters_in_pserver": "checkpoints are sharded pytrees",
+    "log_period_server": "no parameter server exists",
+    "enable_parallel_vector": "XLA vectorizes",
+    "distribute_test": "test() runs under the same mesh",
+    "test_all_data_in_one_period": "test() always consumes the full reader",
+    "test_wait": "no async pserver to wait for",
+    "local": "mesh with one host",
+    "model_list / feat_file": "model zoo APIs replace the predict drivers",
+}
 
 
 FLAGS = Flags()

@@ -1,0 +1,76 @@
+"""Device-side profiling: jax.profiler wiring.
+
+TPU-native successor of the reference's host timer dumps (utils/Stat.h,
+--log_period prints) for DEVICE time: captures an xprof/TensorBoard trace of
+XLA execution — per-op device time, HBM traffic, fusion boundaries — which
+is where all the information the reference's REGISTER_TIMER blocks carried
+now lives.  Host-side wall timers remain in paddle_tpu.utils.stats.
+
+Usage:
+    from paddle_tpu.utils import profiler
+    profiler.start("/tmp/xprof")       # or --profile_dir on the CLI
+    ... train ...
+    profiler.stop()
+
+    with profiler.trace("/tmp/xprof"):     # scoped capture
+        trainer.train(...)
+
+    with profiler.annotate("decode_step"):  # named region inside a capture
+        ...
+
+View with: tensorboard --logdir /tmp/xprof (Profile tab), or the xprof CLI.
+"""
+
+import contextlib
+
+from paddle_tpu.utils.logging import logger
+
+_active_dir = [None]
+
+
+def start(profile_dir: str):
+    """Begin a trace capture writing to profile_dir (idempotent)."""
+    import jax
+    if _active_dir[0]:
+        logger.warning("profiler already tracing to %s", _active_dir[0])
+        return
+    jax.profiler.start_trace(profile_dir)
+    _active_dir[0] = profile_dir
+    logger.info("profiler: tracing to %s", profile_dir)
+
+
+def stop():
+    import jax
+    if not _active_dir[0]:
+        return
+    jax.profiler.stop_trace()
+    logger.info("profiler: trace written to %s", _active_dir[0])
+    _active_dir[0] = None
+
+
+def is_tracing() -> bool:
+    return _active_dir[0] is not None
+
+
+@contextlib.contextmanager
+def trace(profile_dir: str):
+    start(profile_dir)
+    try:
+        yield
+    finally:
+        stop()
+
+
+def annotate(name: str):
+    """Named region that shows up on the trace timeline (the REGISTER_TIMER
+    name, device-side)."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)
+
+
+def save_device_memory_profile(path: str):
+    """Snapshot the device memory profile (pprof format) — the reference's
+    closest analog is the GPU memory stat logs."""
+    import jax
+    jax.profiler.save_device_memory_profile(path)
+    logger.info("profiler: device memory profile -> %s", path)

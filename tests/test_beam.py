@@ -83,3 +83,55 @@ def test_beam_eos_freezes_score(np_rng):
                                bos_id=0, eos_id=eos)
     np.testing.assert_allclose(float(res.scores[0, 0]), -0.1, rtol=1e-5)
     assert int(res.lengths[0, 0]) == 0  # eos-terminated immediately
+
+
+def test_drop_callback_bans_token(np_rng):
+    """The per-node drop hook (reference NormOrDropNodeCallback,
+    RecurrentGradientMachine.h:87-177): dropping every expansion to token 3
+    must keep 3 out of all decoded lanes, and the result must equal the
+    brute-force optimum over the 3-free vocabulary."""
+    v, max_len, eos = 5, 4, 1
+    banned = 3
+    logits = np_rng.randn(v, v).astype(np.float32)
+    trans = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+
+    def drop(tokens, t, cand):
+        return cand.at[..., banned].set(-1e30)
+
+    res = beam_ops.beam_search(make_step(trans), jnp.zeros((1 * 8, 1)),
+                               batch_size=1, beam_size=8, max_len=max_len,
+                               bos_id=0, eos_id=eos, drop_callback=drop)
+    toks = np.asarray(res.tokens[0])
+    lens = np.asarray(res.lengths[0])
+    for k in range(toks.shape[0]):
+        assert banned not in toks[k, :lens[k]]
+
+    # brute force with the banned token removed from transitions
+    trans_banned = trans.copy()
+    trans_banned[:, banned] = -1e30
+    best, _ = brute_best(trans_banned, 0, eos, max_len)
+    np.testing.assert_allclose(float(res.scores[0, 0]), best, rtol=1e-5)
+
+
+def test_drop_callback_sees_prefix(np_rng):
+    """The hook receives each lane's decoded prefix: ban immediate token
+    repetition (cand[prev] = -inf) and check no lane repeats."""
+    v, max_len, eos = 6, 5, 0
+    logits = np_rng.randn(v, v).astype(np.float32)
+    # make repetition attractive so the test bites
+    logits[np.arange(v), np.arange(v)] += 3.0
+    trans = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+
+    def drop(tokens, t, cand):
+        prev = jnp.where(t > 0, tokens[:, :, jnp.maximum(t - 1, 0)], -1)
+        mask = jax.nn.one_hot(prev, v, dtype=bool)
+        return jnp.where(mask, -1e30, cand)
+
+    res = beam_ops.beam_search(make_step(trans), jnp.zeros((1 * 4, 1)),
+                               batch_size=1, beam_size=4, max_len=max_len,
+                               bos_id=1, eos_id=eos, drop_callback=drop)
+    toks = np.asarray(res.tokens[0])
+    lens = np.asarray(res.lengths[0])
+    for k in range(toks.shape[0]):
+        seq = toks[k, :lens[k]]
+        assert all(seq[i] != seq[i + 1] for i in range(len(seq) - 1)), seq

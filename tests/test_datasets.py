@@ -1,0 +1,166 @@
+"""Real dataset parsers against fixture files in PADDLE_TPU_DATA_DIR
+(VERDICT r1 item 9; reference python/paddle/v2/dataset/* + its
+tests/common_test.py fixture pattern).  Each dataset keeps a deterministic
+synthetic fallback for air-gapped runs — tested too."""
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def data_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DATA_DIR", str(tmp_path))
+    return tmp_path
+
+
+# ------------------------------------------------------------------ mnist
+
+def test_mnist_real_idx(data_dir):
+    from paddle_tpu.data.datasets import mnist
+    d = data_dir / "mnist"
+    d.mkdir()
+    imgs = (np.arange(3 * 784) % 256).astype(np.uint8).reshape(3, 784)
+    labs = np.asarray([5, 0, 9], np.uint8)
+    with gzip.open(d / "train-images-idx3-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 3, 28, 28) + imgs.tobytes())
+    with gzip.open(d / "train-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">II", 2049, 3) + labs.tobytes())
+    rows = list(mnist.train()())
+    assert len(rows) == 3
+    assert [y for _, y in rows] == [5, 0, 9]
+    x0 = rows[0][0]
+    assert x0.shape == (784,) and -1.0 <= x0.min() and x0.max() <= 1.0
+
+
+# ------------------------------------------------------------------ cifar
+
+def test_cifar_real_pickle(data_dir):
+    from paddle_tpu.data.datasets import cifar
+    d = data_dir / "cifar" / "cifar-10-batches-py"
+    d.mkdir(parents=True)
+    rng = np.random.RandomState(0)
+    for name, n in [("data_batch_1", 4), ("test_batch", 2)]:
+        batch = {b"data": rng.randint(0, 256, (n, 3072)).astype(np.uint8),
+                 b"labels": list(rng.randint(0, 10, n))}
+        with open(d / name, "wb") as f:
+            pickle.dump(batch, f)
+    for i in range(2, 6):
+        with open(d / f"data_batch_{i}", "wb") as f:
+            pickle.dump({b"data": np.zeros((1, 3072), np.uint8),
+                         b"labels": [0]}, f)
+    rows = list(cifar.train10()())
+    assert len(rows) == 4 + 4      # 4 real + 4 one-row filler batches
+    x, y = rows[0]
+    assert x.shape == (3072,) and 0.0 <= x.min() and x.max() <= 1.0
+    assert 0 <= y < 10
+    assert len(list(cifar.test10()())) == 2
+
+
+# ------------------------------------------------------------------- imdb
+
+def test_imdb_real_acl_layout(data_dir):
+    from paddle_tpu.data.datasets import imdb
+    for split in ("train", "test"):
+        for pol, texts in [("pos", ["a great movie", "great fun film"]),
+                           ("neg", ["terrible boring movie"])]:
+            d = data_dir / "aclImdb" / split / pol
+            d.mkdir(parents=True)
+            for i, t in enumerate(texts):
+                (d / f"{i}_7.txt").write_text(t)
+    wd = imdb.word_dict()
+    # frequency-ordered: 'great' (3) and 'movie' (2) before singletons
+    assert wd["great"] == 0 and wd["movie"] == 1
+    assert "<unk>" in wd
+    rows = list(imdb.train(wd)())
+    assert len(rows) == 3
+    labels = [y for _, y in rows]
+    assert labels == [0, 1, 0]     # interleaved pos/neg
+    ids, _ = rows[0]
+    assert all(isinstance(i, int) and 0 <= i < len(wd) for i in ids)
+
+
+# ----------------------------------------------------------------- conll05
+
+def test_conll05_real_props(data_dir):
+    from paddle_tpu.data.datasets import conll05
+    d = data_dir / "conll05"
+    d.mkdir()
+    words = "The\ncat\nsat\ndown\n\nDogs\nbark\n\n"
+    # sentence 1: one predicate 'sat' (row 2): A0 spans rows 0-1, V row 2,
+    # A2 row 3; sentence 2: predicate 'bark' row 1
+    props = ("-\t(A0*\n-\t*)\nsit\t(V*)\n-\t(A2*)\n\n"
+             "-\t(A0*)\nbark\t(V*)\n\n")
+    with gzip.open(d / "test.wsj.words.gz", "wt") as f:
+        f.write(words)
+    with gzip.open(d / "test.wsj.props.gz", "wt") as f:
+        f.write(props)
+    wd, vd, td = conll05.get_dict()
+    assert "cat" in wd and "sit" in vd and "bark" in vd
+    assert "B-A0" in td and "I-A0" in td and "O" in td
+    rows = list(conll05.train()())
+    assert len(rows) == 2          # one per (sentence, predicate)
+    w1, p1, l1 = rows[0]
+    assert len(w1) == len(p1) == len(l1) == 4
+    assert p1 == [vd["sit"]] * 4
+    assert l1 == [td["B-A0"], td["I-A0"], td["B-V"], td["B-A2"]]
+    w2, p2, l2 = rows[1]
+    assert l2 == [td["B-A0"], td["B-V"]]
+
+
+# --------------------------------------------------------------- movielens
+
+def test_movielens_real_ml1m(data_dir):
+    from paddle_tpu.data.datasets import movielens
+    d = data_dir / "ml-1m"
+    d.mkdir()
+    (d / "users.dat").write_text(
+        "1::F::1::10::48067\n2::M::25::16::70072\n")
+    (d / "movies.dat").write_text(
+        "1::Toy Story (1995)::Animation|Children's|Comedy\n"
+        "2::Jumanji (1995)::Adventure|Children's\n")
+    (d / "ratings.dat").write_text(
+        "1::1::5::978300760\n2::2::3::978298151\n1::2::4::978302109\n")
+    rows = list(movielens.train()())
+    assert len(rows) == 3          # 9:1 split keeps first 3 of 3 lines
+    uid, gender, age, job, mid, cats, title, score = rows[0]
+    assert (uid, gender, job, mid, score) == (1, 0, 10, 1, 5.0)
+    assert age == 0                # age bucket '1' -> index 0
+    assert len(cats) == 3 and len(title) == 3
+    # shared genre vocabulary across movies
+    _, _, _, _, _, cats2, _, _ = rows[1]
+    assert set(cats) & set(cats2)  # Children's shared
+
+
+# ------------------------------------------------------- synthetic fallback
+
+@pytest.mark.parametrize("mod,reader_args", [
+    ("mnist", ()), ("cifar", ()), ("imdb", ()), ("conll05", ()),
+    ("movielens", ()), ("uci_housing", ()), ("imikolov", ()), ("wmt14", ()),
+])
+def test_synthetic_fallback_deterministic(data_dir, mod, reader_args):
+    import importlib
+    m = importlib.import_module(f"paddle_tpu.data.datasets.{mod}")
+    train = getattr(m, "train10", None) or m.train
+    r1 = list(__import__("itertools").islice(train(*reader_args)(), 5))
+    r2 = list(__import__("itertools").islice(train(*reader_args)(), 5))
+    assert len(r1) == 5
+
+    def flat(rows):
+        out = []
+        for row in rows:
+            row = row if isinstance(row, tuple) else (row,)
+            for item in row:
+                out.append(np.asarray(item, dtype=object)
+                           if isinstance(item, list) else item)
+        return out
+
+    for a, b in zip(flat(r1), flat(r2)):
+        np.testing.assert_array_equal(np.asarray(a, dtype=float)
+                                      if not isinstance(a, np.ndarray)
+                                      else a, np.asarray(b, dtype=float)
+                                      if not isinstance(b, np.ndarray) else b)

@@ -198,7 +198,7 @@ def test_ctc_and_chunk_evaluator_adapters(np_rng):
               SequenceBatch(data=jnp.asarray([[2, 3]]),
                             lengths=jnp.asarray([2])))
     assert ev.result() == 0.0  # perfect decode
-    ev2 = chunk_evaluator(out, lab)
+    ev2 = chunk_evaluator(out, lab, num_chunk_types=2)
     tags = np.array([[0, 1, 2, 3]])  # B-0 I-0 B-1 I-1 -> two spans
     ev2.update(SequenceBatch(data=jnp.asarray(tags), lengths=jnp.asarray([4])),
                SequenceBatch(data=jnp.asarray(tags), lengths=jnp.asarray([4])))

@@ -1,6 +1,7 @@
 """Evaluator correctness vs sklearn-free hand computations."""
 
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 from paddle_tpu import evaluators as E
@@ -41,7 +42,7 @@ def test_precision_recall_binary():
 
 
 def test_chunk_f1_exact_match():
-    ev = E.ChunkEvaluator()
+    ev = E.ChunkEvaluator(num_chunk_types=2)
     st = ev.init()
     # tags: B-0 I-0 B-1 -> spans (0,2,type0),(2,3,type1)
     tags = np.asarray([[0, 1, 2]])
@@ -51,13 +52,51 @@ def test_chunk_f1_exact_match():
 
 
 def test_chunk_f1_partial():
-    ev = E.ChunkEvaluator()
+    ev = E.ChunkEvaluator(num_chunk_types=2)
     st = ev.init()
     pred = np.asarray([[0, 0, 2]])   # spans (0,1),(1,2),(2,3)
     gold = np.asarray([[0, 1, 2]])   # spans (0,2),(2,3)
     st = ev.update(st, pred=pred, label=gold, lengths=np.asarray([3]))
     r = ev.result(st)
     assert 0 < r["f1"] < 1
+
+
+def test_chunk_schemes_ioe_iobes_plain():
+    """Reference tag tables (ChunkEvaluator.cpp:44-48): each scheme decodes
+    the same two spans from its own encoding."""
+    # two chunks: type0 covering tokens 0-1, type1 at token 2, O at 3
+    cases = {
+        # IOE: I=0 E=1; O = 2*2=4
+        "IOE": [0, 1, 3, 4],          # I-0 E-0 E-1(single via E) O
+        # IOBES: B,I,E,S = 0..3; type0 tags 0-3, type1 tags 4-7; O = 8
+        "IOBES": [0, 2, 7, 8],        # B-0 E-0 S-1 O
+        # plain: one tag per type; O = 2
+        "plain": [0, 0, 1, 2],        # 0 0 1 O
+    }
+    for scheme, tags in cases.items():
+        ev = E.ChunkEvaluator(scheme=scheme, num_chunk_types=2)
+        st = ev.init()
+        arr = np.asarray([tags])
+        st = ev.update(st, pred=arr, label=arr,
+                       lengths=np.asarray([len(tags)]))
+        assert st["gold"] == 2, (scheme, st)
+        np.testing.assert_allclose(ev.result(st)["f1"], 1.0, rtol=1e-6,
+                                   err_msg=scheme)
+
+
+def test_chunk_requires_num_types():
+    ev = E.ChunkEvaluator()
+    with pytest.raises(ValueError, match="num_chunk_types"):
+        ev.update(ev.init(), pred=np.asarray([[0]]),
+                  label=np.asarray([[0]]), lengths=np.asarray([1]))
+
+
+def test_chunk_excluded_types():
+    ev = E.ChunkEvaluator(num_chunk_types=2, excluded_chunk_types=(1,))
+    st = ev.init()
+    tags = np.asarray([[0, 1, 2]])    # spans type0 (counted), type1 (excluded)
+    st = ev.update(st, pred=tags, label=tags, lengths=np.asarray([3]))
+    assert st["gold"] == 1 and st["pred"] == 1 and st["correct"] == 1
 
 
 def test_ctc_error_edit_distance():

@@ -121,3 +121,27 @@ def test_averaging_apply():
         st = averaging.accumulate(st, {"w": jnp.asarray([v])})
     avg = averaging.apply(st, params)
     np.testing.assert_allclose(np.asarray(avg["w"]), [2.0], rtol=1e-6)
+
+
+def test_manual_and_pass_manual_schedules():
+    """Reference LearningRateScheduler.cpp ManualLRS (boundary-inclusive
+    piecewise by progress) and PassManualLRS (same table keyed on the pass
+    index)."""
+    from paddle_tpu.optim import schedules
+    m = schedules.manual(1.0, [(10, 1.0), (20, 0.5), (30, 0.1)])
+    import numpy.testing as npt
+    npt.assert_allclose(float(m(0)), 1.0, rtol=1e-6)
+    npt.assert_allclose(float(m(10)), 1.0, rtol=1e-6)  # inclusive boundary
+    npt.assert_allclose(float(m(11)), 0.5, rtol=1e-6)
+    npt.assert_allclose(float(m(30)), 0.1, rtol=1e-6)
+    npt.assert_allclose(float(m(99)), 0.1, rtol=1e-6)  # last rate persists
+
+    pm = schedules.pass_manual(1.0, [(0, 1.0), (1, 0.5), (2, 0.1)],
+                               steps_per_pass=5)
+    for step, want in [(0, 1.0), (4, 1.0), (5, 0.5), (9, 0.5),
+                       (10, 0.1), (42, 0.1)]:
+        npt.assert_allclose(float(pm(step)), want, rtol=1e-6)
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="steps_per_pass"):
+        schedules.get("pass_manual", 1.0, segments=[(0, 1.0)])
