@@ -16,6 +16,7 @@ import inspect as _inspect
 
 from paddle_tpu.layers import *              # noqa: F401,F403
 import paddle_tpu.layers as _L
+from paddle_tpu.layers import layer_math     # noqa: F401
 import paddle_tpu.evaluators as _E
 from paddle_tpu.compat import config_parser as _cp
 from paddle_tpu.compat.v1 import *           # noqa: F401,F403

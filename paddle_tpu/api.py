@@ -108,6 +108,81 @@ class GradientMachine:
         return opt_state
 
 
+class MultiNetwork:
+    """Several sub-networks trained jointly with parameters shared by name
+    (reference gserver/gradientmachines/MultiNetwork.{h,cpp}: model_type
+    'multi_nn' holding sub-NeuralNetworks; forward runs every sub-net, the
+    cost is their sum).
+
+    Functionally each sub-net is a Topology; one merged params dict is
+    initialized across all of them (Topology's name-keyed param sharing
+    makes cross-network weight tying automatic, like the reference's
+    parameter sharing across sub-models), and forward/forwardBackward fan
+    out to every sub-net — or to one selected sub-net, the GAN-style
+    alternating-update pattern the reference drove through the API."""
+
+    def __init__(self, sub_outputs, seed=1):
+        """sub_outputs: list of per-subnetwork outputs (LayerOutput or
+        list)."""
+        self.topologies = [
+            Topology(list(o) if isinstance(o, (list, tuple)) else [o])
+            for o in sub_outputs]
+        rng = jax.random.PRNGKey(seed)
+        params = {}
+        for topo in self.topologies:
+            rng = topo._init_into(params, rng)
+        self.parameters = params
+        self.machines = [GradientMachine(t, self.parameters, seed=seed)
+                         for t in self.topologies]
+        for m in self.machines:   # all share ONE params dict view
+            m.parameters = self.parameters
+
+    def getSubNetworks(self):
+        return self.machines
+
+    def forward(self, feed, subnet=None):
+        if subnet is not None:
+            return self.machines[subnet].forward(feed)
+        return [m.forward(feed) for m in self.machines]
+
+    def forwardBackward(self, feed, subnet=None):
+        """Accumulate grads on one sub-net (GAN alternation) or all
+        (joint training: costs sum, like the reference's combined
+        backward)."""
+        if subnet is not None:
+            m = self.machines[subnet]
+            m.parameters = self.parameters
+            return m.forwardBackward(feed)
+        results = []
+        for m in self.machines:
+            m.parameters = self.parameters
+            results.append(m.forwardBackward(feed))
+        return results
+
+    def applyOptimizer(self, optimizer, opt_state, subnet=None):
+        """One update of the shared parameters: with subnet given, from that
+        machine's grads alone (GAN alternation); otherwise from the SUM of
+        every machine's accumulated grads (the reference's joint backward —
+        sub-net costs add)."""
+        machines = ([self.machines[subnet]] if subnet is not None
+                    else self.machines)
+        grads = None
+        for m in machines:
+            if m._grads is None:
+                continue
+            grads = m._grads if grads is None else jax.tree_util.tree_map(
+                jnp.add, grads, m._grads)
+            m._grads = None
+        if grads is None:
+            raise RuntimeError("no gradients accumulated; call "
+                               "forwardBackward first")
+        self.parameters, opt_state = optimizer.update(grads, opt_state,
+                                                      self.parameters)
+        for m in self.machines:
+            m.parameters = self.parameters
+        return opt_state
+
+
 class SequenceGenerator:
     """Reference api/SequenceGenerator.cpp: beam-search wrapper over a
     generation layer (layers.beam_search node) with dict decoding."""

@@ -169,7 +169,11 @@ class BasePoolingType:
 
 
 def _pool(clsname, value):
-    return type(clsname, (BasePoolingType,), {"name": value})
+    # reference pooling types take optional args (MaxPooling
+    # (output_max_index=...), SquareRootNPooling()) — accept and ignore
+    return type(clsname, (BasePoolingType,),
+                {"name": value,
+                 "__init__": lambda self, *a, **kw: None})
 
 
 MaxPooling = _pool("MaxPooling", "max")

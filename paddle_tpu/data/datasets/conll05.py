@@ -159,6 +159,9 @@ def _synth_reader(split, n):
 
 def _reader(split, n):
     if os.path.exists(os.path.join(_dir(), "test.wsj.words.gz")):
+        # the reference's own quirk (v2/dataset/conll05.py:202): the CoNLL05
+        # train set is not freely distributable, so the TEST set serves for
+        # both train() and test()
         return _real_reader(*_load_or_build_dicts())
     return _synth_reader(split, n)
 

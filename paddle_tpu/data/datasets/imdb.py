@@ -34,12 +34,18 @@ def _review_files(split, polarity):
                   if f.endswith(".txt"))
 
 
+_dict_cache = {}
+
+
 def word_dict(cutoff=1):
     """Frequency-ordered word dict over the train split (reference
     imdb.word_dict(): ids ordered by descending frequency).  Synthetic
-    fallback: identity vocab."""
+    fallback: identity vocab.  Built once per data dir (full corpus scan)."""
     if not os.path.isdir(_acl_dir()):
         return {f"w{i}": i for i in range(WORD_DIM)}
+    key = (_acl_dir(), cutoff)
+    if key in _dict_cache:
+        return _dict_cache[key]
     freq = {}
     for pol in ("pos", "neg"):
         for path in _review_files("train", pol):
@@ -50,6 +56,7 @@ def word_dict(cutoff=1):
              if c >= cutoff]
     d = {w: i for i, w in enumerate(words)}
     d["<unk>"] = len(d)
+    _dict_cache[key] = d
     return d
 
 

@@ -126,7 +126,7 @@ def _seq_parts(v):
 
 
 def chunk_evaluator(input, label, chunk_scheme="IOB", num_chunk_types=None,
-                    name=None, **_):
+                    excluded_chunk_types=(), name=None, **_):
     def adapt(pred, label, weight, extra):
         p, plens = _seq_parts(pred)
         l, _ = _seq_parts(label)
@@ -138,7 +138,8 @@ def chunk_evaluator(input, label, chunk_scheme="IOB", num_chunk_types=None,
     return EvaluatorSpec(
         name or "chunk",
         ev_impls.ChunkEvaluator(scheme=chunk_scheme,
-                                num_chunk_types=num_chunk_types),
+                                num_chunk_types=num_chunk_types,
+                                excluded_chunk_types=excluded_chunk_types),
         input, label, adapter=adapt)
 
 

@@ -9,6 +9,8 @@ from paddle_tpu.layers import networks
 from paddle_tpu.layers.networks import *     # noqa: F401,F403
 from paddle_tpu.layers import recurrent_units
 from paddle_tpu.layers.recurrent_units import *  # noqa: F401,F403
+# installs the LayerOutput arithmetic operators (reference layer_math.py)
+from paddle_tpu.layers import layer_math
 from paddle_tpu.layers import api as _api
 from paddle_tpu.layers import vision as _vision
 from paddle_tpu.layers import recurrent as _recurrent

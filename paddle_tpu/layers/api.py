@@ -202,8 +202,10 @@ def embedding_layer(input, size, name=None, param_attr=None,
                             "sparse_budget": sparse_budget})
 
 
-def table_projection(input, size, param_attr=None):
-    return embedding_layer(input, size, param_attr=param_attr)
+def table_projection(input, size=0, param_attr=None):
+    """size=0 takes the enclosing mixed layer's width (reference
+    table_projection default)."""
+    return _Part("table", [input], {"param_attr": param_attr}, size)
 
 
 # ---------------------------------------------------------------- mixed
@@ -506,7 +508,11 @@ _simple_layer("concat", lambda cfg, s: sum(s),
 
 
 def concat_layer(input, act=None, name=None):
-    ins = _inputs_list(input)
+    # the reference concat accepts projections too (concat_layer(input=
+    # [identity_projection(a), ...])) — realize each as a one-part mixed
+    ins = [mixed_layer(size=item.out_size, input=[item], act=None)
+           if isinstance(item, _Part) else item
+           for item in _inputs_list(input)]
     return LayerOutput(name or auto_name("concat"), "concat",
                        sum(i.size for i in ins), ins, {"act": act})
 
@@ -557,7 +563,11 @@ _simple_layer("linear_comb", lambda cfg, s: cfg["size"],
 
 def linear_comb_layer(weights, vectors, size=None, name=None):
     if size is None:
-        raise ConfigError("linear_comb_layer needs size")
+        # reference default: vectors holds `weights.size` rows of width size
+        if vectors.size % max(weights.size, 1) == 0:
+            size = vectors.size // weights.size
+        else:
+            raise ConfigError("linear_comb_layer needs size")
     return LayerOutput(name or auto_name("linear_comb"), "linear_comb", size,
                        [weights, vectors], {"size": size})
 
@@ -802,6 +812,10 @@ class _SeqPoolImpl:
         return in_sizes[0]
 
     def apply(self, ctx, cfg, params, x):
+        stride = cfg.get("stride", -1)
+        if stride and stride > 0:
+            return seq_ops.seq_strided_pool(as_seq(x), cfg["pooling"],
+                                            int(stride))
         return seq_ops.seq_pool(as_seq(x), cfg["pooling"])
 
 
@@ -829,14 +843,18 @@ def pooling_layer(input, pooling_type=None, name=None, agg_level=None):
                        [input], {"pooling": pt}, is_seq=False)
 
 
-def last_seq(input, name=None, agg_level=None):
+def last_seq(input, name=None, agg_level=None, stride=-1):
+    """stride > 0 (reference seqlastins stride): last instance of each
+    non-overlapping stride window — output stays a (shorter) sequence."""
     return LayerOutput(name or auto_name("last_seq"), "seq_pool", input.size,
-                       [input], {"pooling": "last"}, is_seq=False)
+                       [input], {"pooling": "last", "stride": stride},
+                       is_seq=stride > 0)
 
 
-def first_seq(input, name=None, agg_level=None):
+def first_seq(input, name=None, agg_level=None, stride=-1):
     return LayerOutput(name or auto_name("first_seq"), "seq_pool", input.size,
-                       [input], {"pooling": "first"}, is_seq=False)
+                       [input], {"pooling": "first", "stride": stride},
+                       is_seq=stride > 0)
 
 
 _simple_layer("expand", lambda cfg, s: s[0],
@@ -991,6 +1009,14 @@ def _register_cost(type_name, fn):
             return 1
 
         def apply(self, ctx, cfg, params, *ins):
+            if cfg.get("weighted"):
+                # reference: cost layers accept a per-sample weight input
+                # (CostLayer::forward weights_, e.g. classification_cost
+                # (input, label, weight))
+                *core, w = ins
+                val = fn(ctx, cfg, *core)
+                wd = value_data(w)
+                return val * wd.reshape(wd.shape[0], -1)[:, 0]
             return fn(ctx, cfg, *ins)
     register_layer(type_name)(Impl)
 
@@ -1034,18 +1060,21 @@ def _logits_view(node):
                        num_filters=node.num_filters, img_shape=node.img_shape)
 
 
-def classification_cost(input, label, name=None, evaluator=None,
+def classification_cost(input, label, weight=None, name=None, evaluator=None,
                         from_logits=False):
     """Reference classification_cost: input is softmax output; here the
     graph usually ends with act='softmax', so from_logits defaults False.
     When the input is a softmax layer we rewire onto its logits (see
-    _logits_view) for a numerically exact fused gradient."""
+    _logits_view) for a numerically exact fused gradient.  weight: optional
+    per-sample cost weight layer."""
     if not from_logits:
         logits = _logits_view(input)
         if logits is not None:
             input, from_logits = logits, True
+    ins = [input, label] + ([weight] if weight is not None else [])
     return LayerOutput(name or auto_name("cost"), "classification_cost", 1,
-                       [input, label], {"from_logits": from_logits},
+                       ins, {"from_logits": from_logits,
+                             "weighted": weight is not None},
                        is_seq=False)
 
 
@@ -1057,9 +1086,10 @@ _register_cost("mse", lambda ctx, cfg, p, l: _seq_or_row_mean(
     losses.square_error(value_data(p), value_data(l)), p))
 
 
-def regression_cost(input, label, name=None):
-    return LayerOutput(name or auto_name("mse"), "mse", 1, [input, label], {},
-                       is_seq=False)
+def regression_cost(input, label, weight=None, name=None):
+    ins = [input, label] + ([weight] if weight is not None else [])
+    return LayerOutput(name or auto_name("mse"), "mse", 1, ins,
+                       {"weighted": weight is not None}, is_seq=False)
 
 
 mse_cost = regression_cost

@@ -96,29 +96,8 @@ class LayerOutput:
         return (f"LayerOutput({self.name}, {self.layer_type}, size={self.size}"
                 f"{', seq' if self.is_seq else ''})")
 
-    # arithmetic sugar (reference layer_math.py monkeypatches +,-,*)
-    def __add__(self, other):
-        from paddle_tpu.layers import api
-        if isinstance(other, LayerOutput):
-            return api.addto_layer(input=[self, other])
-        return api.slope_intercept_layer(input=self, slope=1.0, intercept=other)
-
-    __radd__ = __add__
-
-    def __mul__(self, other):
-        from paddle_tpu.layers import api
-        if isinstance(other, (int, float)):
-            return api.slope_intercept_layer(input=self, slope=other, intercept=0.0)
-        raise TypeError("LayerOutput * LayerOutput needs dotmul")
-
-    __rmul__ = __mul__
-
-    def __sub__(self, other):
-        from paddle_tpu.layers import api
-        if isinstance(other, (int, float)):
-            return api.slope_intercept_layer(input=self, slope=1.0, intercept=-other)
-        neg = api.slope_intercept_layer(input=other, slope=-1.0, intercept=0.0)
-        return api.addto_layer(input=[self, neg])
+    # arithmetic operators are installed by paddle_tpu.layers.layer_math
+    # (the reference layer_math.py monkeypatches +,-,* the same way)
 
 
 class Context:
@@ -285,11 +264,17 @@ class Topology:
         return node.name
 
     def apply(self, params, feed, mode="train", rng=None, state=None,
-              return_state=False, extra_outputs=()):
-        """Run the graph.  feed: {data_layer_name: array|SequenceBatch}."""
+              return_state=False, extra_outputs=(), precomputed=None):
+        """Run the graph.  feed: {data_layer_name: array|SequenceBatch}.
+        precomputed: {node_name: value} — nodes whose values were computed
+        elsewhere (the recurrent_group scan-invariant hoist) are taken as-is
+        instead of re-applied."""
         ctx = Context(mode=mode, rng=rng, state=state, params=params)
         cache = {}
         for node in self.order:
+            if precomputed and node.name in precomputed:
+                cache[id(node)] = precomputed[node.name]
+                continue
             if node.layer_type == "data":
                 if node.name not in feed:
                     raise ConfigError(f"missing feed for data layer {node.name!r}")

@@ -228,36 +228,48 @@ def simple_gru2(input, size, reverse=False, act="tanh", gate_act="sigmoid",
 
 
 def gru_unit(input, size=None, name=None, act="tanh", gate_act="sigmoid",
-             memory_boot=None):
+             memory_boot=None, gru_bias_attr=None, gru_param_attr=None,
+             naive=False, gru_layer_attr=None):
     """One GRU step for custom recurrent groups (reference gru_unit):
     creates the output memory link itself."""
     size = size or input.size // 3
     mem = recurrent.memory(name=name or "gru_unit_out", size=size,
                            boot_layer=memory_boot)
-    return recurrent.gru_step_layer(input, mem, size=size, act=act,
-                                    gate_act=gate_act,
-                                    name=name or "gru_unit_out")
+    step = (recurrent.gru_step_naive_layer if naive
+            else recurrent.gru_step_layer)
+    return step(input, mem, size=size, act=act, gate_act=gate_act,
+                bias_attr=True if gru_bias_attr is None else gru_bias_attr,
+                param_attr=gru_param_attr, name=name or "gru_unit_out")
 
 
 def gru_group(input, size=None, name=None, reverse=False, act="tanh",
-              gate_act="sigmoid", memory_boot=None):
+              gate_act="sigmoid", memory_boot=None, gru_bias_attr=None,
+              gru_param_attr=None, naive=False, gru_layer_attr=None):
     """GRU as an explicit recurrent_group (reference gru_group) — same
     numbers as grumemory, built from the step primitive."""
     def step(x3):
         return gru_unit(x3, size=size, name=name and f"{name}_out",
-                        act=act, gate_act=gate_act, memory_boot=memory_boot)
+                        act=act, gate_act=gate_act, memory_boot=memory_boot,
+                        gru_bias_attr=gru_bias_attr,
+                        gru_param_attr=gru_param_attr, naive=naive)
     return recurrent.recurrent_group(step, input=input, reverse=reverse,
                                      name=name)
 
 
 def lstmemory_unit(input, size=None, name=None, act="tanh",
-                   gate_act="sigmoid", state_act="tanh", memory_boot=None):
-    """One LSTM step for custom groups (reference lstmemory_unit); the
+                   gate_act="sigmoid", state_act="tanh", memory_boot=None,
+                   param_attr=None, mixed_bias_attr=None,
+                   lstm_bias_attr=None, mixed_layer_attr=None,
+                   lstm_layer_attr=None, get_output_layer_attr=None):
+    """One LSTM step for custom groups (reference lstmemory_unit,
+    networks.py:616-723): gates = identity(input) + W_r @ h_prev via a step
+    mixed layer (param_attr names/shares W_r), then one lstm_step.  The
     [h|c] pair rides in one memory of width 2*size.  A reference-style
     memory_boot of width `size` boots h; c boots to zero (matching the
     reference, whose state memory boots zero unless given its own layer)."""
     size = size or input.size // 4
-    state_name = (name or "lstm_unit") + "_state"
+    nm = name or "lstm_unit"
+    state_name = nm + "_state"
     if memory_boot is not None and memory_boot.size == size:
         # widen [B, size] h-boot to [B, 2*size] = [h | 0]
         zeros = api.slope_intercept_layer(memory_boot, slope=0.0,
@@ -265,22 +277,43 @@ def lstmemory_unit(input, size=None, name=None, act="tanh",
         memory_boot = concat_layer([memory_boot, zeros])
     state = recurrent.memory(name=state_name, size=2 * size,
                              boot_layer=memory_boot)
-    hc = recurrent.lstm_step_layer(input, state, size=size, act=act,
-                                   gate_act=gate_act, state_act=state_act,
-                                   name=state_name)
+    h_prev = mixed_layer(size=size,
+                         input=[api.identity_projection(state, offset=0,
+                                                        size=size)],
+                         act=None, bias_attr=False, name=nm + "_prev_h")
+    # the recurrent projection the reference puts in "%s_input_recurrent"
+    gates = mixed_layer(
+        size=4 * size,
+        input=[api.identity_projection(input),
+               api.full_matrix_projection(h_prev, param_attr=param_attr)],
+        act=None,
+        bias_attr=False if mixed_bias_attr is None else mixed_bias_attr,
+        name=nm + "_input_recurrent")
+    hc = recurrent.lstm_step_layer(
+        gates, state, size=size, act=act, gate_act=gate_act,
+        state_act=state_act,
+        bias_attr=True if lstm_bias_attr is None else lstm_bias_attr,
+        name=state_name)
     return mixed_layer(size=size,
                        input=[api.identity_projection(hc, offset=0,
                                                       size=size)],
-                       act=None, bias_attr=False, name=name or "lstm_unit")
+                       act=None, bias_attr=False, name=nm)
 
 
 def lstmemory_group(input, size=None, name=None, reverse=False, act="tanh",
-                    gate_act="sigmoid", state_act="tanh", memory_boot=None):
-    """LSTM as an explicit recurrent_group (reference lstmemory_group)."""
+                    gate_act="sigmoid", state_act="tanh", memory_boot=None,
+                    param_attr=None, mixed_bias_attr=None,
+                    lstm_bias_attr=None, mixed_layer_attr=None,
+                    lstm_layer_attr=None, get_output_layer_attr=None):
+    """LSTM as an explicit recurrent_group (reference lstmemory_group) —
+    exactly the lstmemory math with per-step state access."""
     def step(x4):
         return lstmemory_unit(x4, size=size, name=name and f"{name}_unit",
                               act=act, gate_act=gate_act,
-                              state_act=state_act, memory_boot=memory_boot)
+                              state_act=state_act, memory_boot=memory_boot,
+                              param_attr=param_attr,
+                              mixed_bias_attr=mixed_bias_attr,
+                              lstm_bias_attr=lstm_bias_attr)
     return recurrent.recurrent_group(step, input=input, reverse=reverse,
                                      name=name)
 

@@ -36,6 +36,27 @@ __all__ = [
 
 # ----------------------------------------------------- whole-sequence RNNs
 
+def _prev_batch_carry(ctx, cfg):
+    """Reference --prev_batch_state (Flags.cpp:73: "batch is continue with
+    next batch"): carry the RNN's final state into the next batch via the
+    trainer's functional model_state thread (same channel as BN stats)."""
+    if not cfg.get("prev_batch_state"):
+        from paddle_tpu.utils.flags import FLAGS
+        if not FLAGS.prev_batch_state:
+            return False
+    return not cfg.get("reverse", False)
+
+
+def _prev_batch_init(ctx, cfg):
+    if not _prev_batch_carry(ctx, cfg):
+        return None
+    return ctx.state_in.get(cfg["name"] + "/carry")
+
+
+def _prev_batch_save(ctx, cfg, final):
+    if _prev_batch_carry(ctx, cfg):
+        ctx.put_state(cfg["name"] + "/carry", final)
+
 class _LstmImpl:
     def infer(self, cfg, in_sizes):
         return cfg["size"]
@@ -61,12 +82,18 @@ class _LstmImpl:
         ci = b[4 * d:5 * d] if b is not None else None
         cf = b[5 * d:6 * d] if b is not None else None
         co = b[6 * d:] if b is not None else None
-        out, _ = rnn_ops.lstm(as_seq(x), params["w"], bias=bias,
-                              check_i=ci, check_f=cf, check_o=co,
-                              reverse=cfg.get("reverse", False),
-                              act=cfg.get("act", "tanh"),
-                              gate_act=cfg.get("gate_act", "sigmoid"),
-                              state_act=cfg.get("state_act", "tanh"))
+        init = _prev_batch_init(ctx, cfg)
+        if init is not None:
+            init = rnn_ops.LstmState(h=init[..., :d], c=init[..., d:])
+        out, final = rnn_ops.lstm(as_seq(x), params["w"], bias=bias,
+                                  check_i=ci, check_f=cf, check_o=co,
+                                  init_state=init,
+                                  reverse=cfg.get("reverse", False),
+                                  act=cfg.get("act", "tanh"),
+                                  gate_act=cfg.get("gate_act", "sigmoid"),
+                                  state_act=cfg.get("state_act", "tanh"))
+        _prev_batch_save(ctx, cfg,
+                         jnp.concatenate([final.h, final.c], axis=-1))
         return out
 
 
@@ -75,12 +102,15 @@ register_layer("lstmemory")(_LstmImpl)
 
 def lstmemory(input, size=None, reverse=False, act="tanh",
               gate_act="sigmoid", state_act="tanh", name=None,
-              bias_attr=True, param_attr=None):
+              bias_attr=True, param_attr=None, prev_batch_state=False):
     d = size or input.size // 4
-    return LayerOutput(name or auto_name("lstmemory"), "lstmemory", d, [input],
-                       {"size": d, "reverse": reverse, "act": act,
-                        "gate_act": gate_act, "state_act": state_act,
-                        "bias_attr": bias_attr, "param_attr": param_attr},
+    nm = name or auto_name("lstmemory")
+    return LayerOutput(nm, "lstmemory", d, [input],
+                       {"size": d, "name": nm, "reverse": reverse,
+                        "act": act, "gate_act": gate_act,
+                        "state_act": state_act, "bias_attr": bias_attr,
+                        "param_attr": param_attr,
+                        "prev_batch_state": prev_batch_state},
                        is_seq=True)
 
 
@@ -101,11 +131,13 @@ class _GruImpl:
         return p
 
     def apply(self, ctx, cfg, params, x):
-        out, _ = rnn_ops.gru(as_seq(x), params["w_gate"], params["w_state"],
-                             bias=params.get("b"),
-                             reverse=cfg.get("reverse", False),
-                             act=cfg.get("act", "tanh"),
-                             gate_act=cfg.get("gate_act", "sigmoid"))
+        out, final = rnn_ops.gru(as_seq(x), params["w_gate"],
+                                 params["w_state"], bias=params.get("b"),
+                                 init_state=_prev_batch_init(ctx, cfg),
+                                 reverse=cfg.get("reverse", False),
+                                 act=cfg.get("act", "tanh"),
+                                 gate_act=cfg.get("gate_act", "sigmoid"))
+        _prev_batch_save(ctx, cfg, final)
         return out
 
 
@@ -113,12 +145,15 @@ register_layer("grumemory")(_GruImpl)
 
 
 def grumemory(input, size=None, reverse=False, act="tanh",
-              gate_act="sigmoid", name=None, bias_attr=True, param_attr=None):
+              gate_act="sigmoid", name=None, bias_attr=True, param_attr=None,
+              prev_batch_state=False):
     d = size or input.size // 3
-    return LayerOutput(name or auto_name("grumemory"), "grumemory", d, [input],
-                       {"size": d, "reverse": reverse, "act": act,
-                        "gate_act": gate_act, "bias_attr": bias_attr,
-                        "param_attr": param_attr}, is_seq=True)
+    nm = name or auto_name("grumemory")
+    return LayerOutput(nm, "grumemory", d, [input],
+                       {"size": d, "name": nm, "reverse": reverse,
+                        "act": act, "gate_act": gate_act,
+                        "bias_attr": bias_attr, "param_attr": param_attr,
+                        "prev_batch_state": prev_batch_state}, is_seq=True)
 
 
 class _SimpleRnnImpl:
@@ -133,10 +168,12 @@ class _SimpleRnnImpl:
         return p
 
     def apply(self, ctx, cfg, params, x):
-        out, _ = rnn_ops.simple_rnn(as_seq(x), params["w"],
-                                    bias=params.get("b"),
-                                    reverse=cfg.get("reverse", False),
-                                    act=cfg.get("act", "tanh"))
+        out, final = rnn_ops.simple_rnn(as_seq(x), params["w"],
+                                        bias=params.get("b"),
+                                        init_state=_prev_batch_init(ctx, cfg),
+                                        reverse=cfg.get("reverse", False),
+                                        act=cfg.get("act", "tanh"))
+        _prev_batch_save(ctx, cfg, final)
         return out
 
 
@@ -144,12 +181,14 @@ register_layer("recurrent")(_SimpleRnnImpl)
 
 
 def recurrent_layer(input, act="tanh", reverse=False, name=None,
-                    bias_attr=True, param_attr=None):
+                    bias_attr=True, param_attr=None, prev_batch_state=False):
     """Reference RecurrentLayer: h_t = act(x_t + W h_{t-1})."""
-    return LayerOutput(name or auto_name("recurrent"), "recurrent",
-                       input.size, [input],
-                       {"size": input.size, "act": act, "reverse": reverse,
-                        "bias_attr": bias_attr, "param_attr": param_attr},
+    nm = name or auto_name("recurrent")
+    return LayerOutput(nm, "recurrent", input.size, [input],
+                       {"size": input.size, "name": nm, "act": act,
+                        "reverse": reverse, "bias_attr": bias_attr,
+                        "param_attr": param_attr,
+                        "prev_batch_state": prev_batch_state},
                        is_seq=True)
 
 
@@ -176,6 +215,28 @@ class SubsequenceInput:
         self.input = input
 
 
+def _in_v1_parse():
+    """True while a reference v1 config script is being executed by the
+    config compiler (there sequence-ness is a DataProvider property, not a
+    layer property)."""
+    try:
+        from paddle_tpu.compat import config_parser
+        return config_parser.in_parse()
+    except Exception:
+        return False
+
+
+def _promote_seq(node, _seen=None):
+    """Mark a layer chain as sequence-valued (v1 compat promotion)."""
+    _seen = _seen if _seen is not None else set()
+    if id(node) in _seen:
+        return
+    _seen.add(id(node))
+    node.is_seq = True
+    for dep in node.inputs:
+        _promote_seq(dep, _seen)
+
+
 class _GroupBuildCtx:
     current = None
 
@@ -197,15 +258,32 @@ def resolve_memory_links(sub_topo, memories):
     return links
 
 
+class _MemoryPlaceholder(LayerOutput):
+    """memory() return value; supports the reference's late-link form
+    `m = memory(name=None, size=...); ...; m.set_input(layer)`."""
+
+    def set_input(self, layer):
+        g = _GroupBuildCtx.current
+        if g is None:
+            raise ConfigError("set_input() must be called inside the step")
+        for i, (ph, link, boot, boot_const) in enumerate(g.memories):
+            if ph is self:
+                g.memories[i] = (ph, layer.name, boot, boot_const)
+                return
+        raise ConfigError("set_input on a memory not in this group")
+
+
 def memory(name, size, boot_layer=None, boot_with_const_id=None,
            is_seq=False):
     """Previous-step output of the layer called `name` (reference memory()
-    with boot layers, RecurrentGradientMachine memory frames :715)."""
+    with boot layers, RecurrentGradientMachine memory frames :715).  With
+    name=None the link is bound later via .set_input(layer) (reference
+    memory(name=None) + set_input)."""
     g = _GroupBuildCtx.current
     if g is None:
         raise ConfigError("memory() must be called inside recurrent_group's step")
-    ph = LayerOutput(auto_name(f"mem_{name}"), "__memory__", size, [],
-                     {"link": name}, is_seq=False)
+    ph = _MemoryPlaceholder(auto_name(f"mem_{name}"), "__memory__", size, [],
+                            {"link": name}, is_seq=False)
     g.memories.append((ph, name, boot_layer, boot_with_const_id))
     return ph
 
@@ -233,9 +311,17 @@ def recurrent_group(step, input, reverse=False, name=None):
             step_args.append(ph)
         else:
             if not item.is_seq:
-                raise ConfigError(
-                    f"recurrent_group input {item.name} is not a sequence; "
-                    "wrap non-sequence inputs in StaticInput")
+                if _in_v1_parse():
+                    # v1 configs declare sequence-ness in the DataProvider,
+                    # not on the layer (reference defers to runtime): a
+                    # layer fed to a recurrent_group IS a sequence there.
+                    # The native DSL keeps the strict check — its data
+                    # layers carry is_seq explicitly.
+                    _promote_seq(item)
+                else:
+                    raise ConfigError(
+                        f"recurrent_group input {item.name} is not a "
+                        "sequence; wrap non-sequence inputs in StaticInput")
             ph = LayerOutput(auto_name("step_in"), "__step_input__",
                              item.size, [], {}, is_seq=False)
             seq_inputs.append((ph, item))
@@ -281,6 +367,63 @@ def recurrent_group(step, input, reverse=False, name=None):
                        is_seq=True)
     node.cfg["self_name"] = node.name
     return node
+
+
+# scan-invariant hoisting: step-graph layers that depend only on the
+# per-step sequence inputs (not on memories/statics) and are row-wise can
+# be computed ONCE over the whole padded sequence before the scan — one big
+# MXU matmul instead of T small ones (the same trick the reference's
+# SequenceToBatch plays for whole-sequence RNN layers, generalized to
+# arbitrary step graphs).  Disable for A/B testing via this flag.
+HOIST_SCAN_INVARIANTS = True
+
+# layer types whose apply maps rows independently (safe on [B, T, ...] data
+# exactly as on [B, ...] rows).  Anything sequence-aware (pooling, context,
+# seq ops) must stay inside the scan.
+_ROW_WISE_TYPES = {"fc", "embedding", "mixed", "addto", "concat",
+                   "slope_intercept"}
+_ROW_WISE_MIXED_PARTS = {"full_matrix", "trans_full_matrix", "identity",
+                         "dotmul", "scaling", "table"}
+
+
+def _hoistable_frontier(sub_topo, seq_phs, mode):
+    """Maximal step-graph nodes computable before the scan: every ancestor
+    path bottoms out in a per-step sequence placeholder and every node on it
+    is row-wise (and dropout-free in train mode, so randomness stays
+    per-step)."""
+    seq_ph_ids = {id(ph) for ph in seq_phs}
+    ok = {}
+    for node in sub_topo.order:
+        if id(node) in seq_ph_ids:
+            ok[id(node)] = True
+            continue
+        if node.layer_type.startswith("__") or node.layer_type == "data":
+            ok[id(node)] = False
+            continue
+        if not node.inputs or not all(ok.get(id(i), False)
+                                      for i in node.inputs):
+            ok[id(node)] = False
+            continue
+        row_wise = node.layer_type in _ROW_WISE_TYPES
+        if node.layer_type == "mixed":
+            row_wise = all(kind in _ROW_WISE_MIXED_PARTS
+                           for kind, _ in node.cfg["parts"])
+        if mode == "train" and (node.cfg.get("drop_rate")
+                                or node.layer_type == "dropout"):
+            row_wise = False
+        ok[id(node)] = row_wise
+    # frontier: hoistable nodes consumed by a non-hoistable node (or an
+    # output) — computing deeper ancestors too would be redundant
+    consumed_by_live = set()
+    for node in sub_topo.order:
+        if not ok.get(id(node), False):
+            for i in node.inputs:
+                consumed_by_live.add(id(i))
+    for out in sub_topo.outputs:
+        consumed_by_live.add(id(out))
+    return [n for n in sub_topo.order
+            if ok.get(id(n), False) and id(n) in consumed_by_live
+            and id(n) not in seq_ph_ids]
 
 
 class _RecurrentGroupImpl:
@@ -337,10 +480,34 @@ class _RecurrentGroupImpl:
 
         frame_phs = cfg["sub_phs"] if nested else cfg["seq_phs"]
 
+        # scan-invariant hoist (flat groups): compute the memory-free,
+        # row-wise prefix of the step graph over the WHOLE padded sequence
+        # before the scan — big MXU matmuls instead of T small ones
+        hoisted_names = []
+        if not nested and HOIST_SCAN_INVARIANTS and seqs:
+            frontier = _hoistable_frontier(sub_topo, cfg["seq_phs"], mode)
+            if frontier:
+                pre_topo = Topology(frontier)
+                full_feed = {ph.name: s
+                             for ph, s in zip(cfg["seq_phs"], seqs)}
+                # no rng: the frontier is dropout-free by construction, and
+                # skipping the split keeps the per-step rng stream identical
+                # to the unhoisted graph
+                pre_vals = pre_topo.apply(sub_params, full_feed, mode=mode)
+                pre_vals = (pre_vals if isinstance(pre_vals, tuple)
+                            and not isinstance(pre_vals, SequenceBatch)
+                            else (pre_vals,))
+                hoisted_names = [n.name for n in frontier]
+                # hoisted values join the scanned inputs (engine slices
+                # their time axis alongside the placeholders)
+                seqs = list(seqs) + [as_seq(v) for v in pre_vals]
+
         def step_fn(mems, frames, step_rng=None):
             feed = {}
             for ph, frame in zip(frame_phs, frames):
                 feed[ph.name] = frame
+            pre = {name: frame for name, frame in
+                   zip(hoisted_names, frames[len(frame_phs):])}
             for ph, s in zip(cfg["static_phs"], statics):
                 feed[ph.name] = s
             for (ph, _, _, _), m in zip(cfg["links"], mems):
@@ -348,7 +515,7 @@ class _RecurrentGroupImpl:
             # memory-link values come back as extra outputs of the SAME
             # apply — no per-link re-evaluation of the sub-graph
             vals = sub_topo.apply(sub_params, feed, mode=mode, rng=step_rng,
-                                  extra_outputs=link_nodes)
+                                  extra_outputs=link_nodes, precomputed=pre)
             # NB: SequenceBatch/NestedSequenceBatch are NamedTuples — a
             # single sequence-valued output must not be unpacked fieldwise
             if not isinstance(vals, tuple) or isinstance(

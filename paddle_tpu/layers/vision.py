@@ -113,9 +113,18 @@ def img_conv_layer(input, filter_size, num_filters, num_channels=None,
     one XLA conv path)."""
     channels = _channels(input, num_channels)
     in_shape = _img_shape(input, channels)
-    fh, fw = filter_size, filter_size_y or filter_size
-    sh, sw = stride, stride_y or stride
-    ph, pw = padding, padding_y if padding_y is not None else padding
+    # reference semantics (layers.py:2085-2136): filter_size/stride/padding
+    # are the X (width) dimension — tuple form is (x, y) — and *_y is the
+    # height
+    if isinstance(filter_size, (tuple, list)):
+        filter_size, filter_size_y = filter_size
+    if isinstance(stride, (tuple, list)):
+        stride, stride_y = stride
+    if isinstance(padding, (tuple, list)):
+        padding, padding_y = padding
+    fh, fw = filter_size_y or filter_size, filter_size
+    sh, sw = stride_y or stride, stride
+    ph, pw = (padding_y if padding_y is not None else padding), padding
     if trans:
         oh = (in_shape[0] - 1) * sh - 2 * ph + fh
         ow = (in_shape[1] - 1) * sw - 2 * pw + fw
@@ -159,9 +168,16 @@ def img_pool_layer(input, pool_size, stride=1, num_channels=None,
     outputSize with caffeMode=False (ceil division)."""
     channels = _channels(input, num_channels)
     in_shape = _img_shape(input, channels)
-    wh, ww = pool_size, pool_size_y or pool_size
-    sh, sw = stride, stride_y or stride
-    ph, pw = padding, padding_y if padding_y is not None else padding
+    # same (x, y) convention as img_conv_layer
+    if isinstance(pool_size, (tuple, list)):
+        pool_size, pool_size_y = pool_size
+    if isinstance(stride, (tuple, list)):
+        stride, stride_y = stride
+    if isinstance(padding, (tuple, list)):
+        padding, padding_y = padding
+    wh, ww = pool_size_y or pool_size, pool_size
+    sh, sw = stride_y or stride, stride
+    ph, pw = (padding_y if padding_y is not None else padding), padding
     pt = getattr(pool_type, "name", pool_type)
     pt = "avg" if "avg" in str(pt) else "max"
 
@@ -537,9 +553,16 @@ def _conv_part_spec(img, filter_size, num_filters, num_channels, stride,
 
 
 def conv_projection(input, filter_size, num_filters, num_channels=None,
-                    stride=1, padding=0, param_attr=None):
+                    stride=1, padding=0, param_attr=None,
+                    filter_size_y=None, stride_y=None, padding_y=None,
+                    groups=1, trans=False):
     """Learned-filter conv as a mixed_layer projection (reference
-    ConvProjection)."""
+    ConvProjection / ConvTransProjection via trans=)."""
+    if trans:
+        from paddle_tpu.utils.logging import logger
+        logger.warning("conv_projection(trans=True): transposed projection "
+                       "runs as a standard conv projection; numerics differ "
+                       "until ConvTransProjection lands")
     _Part, spec, out = _conv_part_spec(input, filter_size, num_filters,
                                        num_channels, stride, padding)
     spec["param_attr"] = param_attr
@@ -547,9 +570,17 @@ def conv_projection(input, filter_size, num_filters, num_channels=None,
 
 
 def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
-                  stride=1, padding=0):
+                  stride=1, padding=0, filter_size_y=None, stride_y=None,
+                  padding_y=None, trans=False):
     """Per-sample conv where each row of `filter` is that sample's own
-    filter bank (reference ConvOperator.cpp:58-83 loops over batchId)."""
+    filter bank (reference ConvOperator.cpp:58-83 loops over batchId).
+    trans=True is accepted for config parity (ConvTransOperator); the
+    transposed per-sample path is not yet implemented."""
+    if trans:
+        from paddle_tpu.utils.logging import logger
+        logger.warning("conv_operator(trans=True): transposed per-sample "
+                       "conv runs as a standard conv_operator graph node; "
+                       "numerics differ until ConvTransOperator lands")
     _Part, spec, out = _conv_part_spec(img, filter_size, num_filters,
                                        num_channels, stride, padding)
     return _Part("conv_op", [img, filter], spec, out)

@@ -40,6 +40,7 @@ register("tanh")(jnp.tanh)
 register("abs")(jnp.abs)
 register("square")(jnp.square)
 register("exponential")(jnp.exp)
+register("sqrt")(lambda x: jnp.sqrt(jnp.maximum(x, 0.0)))
 
 
 @register("softmax")

@@ -83,6 +83,14 @@ def simple_rnn_cell(x, h_prev, w_r, act="tanh"):
     return activations.get(act)(x + matmul(h_prev, w_r))
 
 
+# lax.scan unroll factor for the sequence loops: >1 lets XLA pipeline
+# consecutive steps (fewer loop-carried syncs on the TPU scalar core) at the
+# cost of compile time.  Overridable via PADDLE_TPU_SCAN_UNROLL.
+import os as _os
+
+SCAN_UNROLL = int(_os.environ.get("PADDLE_TPU_SCAN_UNROLL", "1"))
+
+
 def _masked_scan(step, init_carry, xs_time_major, mask_time_major, reverse=False):
     """Scan over time; where mask==0 the carry passes through unchanged."""
     def body(carry, inp):
@@ -94,7 +102,7 @@ def _masked_scan(step, init_carry, xs_time_major, mask_time_major, reverse=False
             new_carry, carry)
         return merged, merged
     return jax.lax.scan(body, init_carry, (xs_time_major, mask_time_major),
-                        reverse=reverse)
+                        reverse=reverse, unroll=SCAN_UNROLL)
 
 
 def lstm(seq: SequenceBatch, w_r, bias=None, check_i=None, check_f=None,
@@ -202,7 +210,8 @@ def recurrent_group(step_fn, inputs, boot_memories, reverse=False, rng=None):
             return merge(mem, new_mem, m), out
 
         final_mem, outs_tm = jax.lax.scan(
-            body, boot_memories, (xs_tm, mask_tm, keys_tm), reverse=reverse)
+            body, boot_memories, (xs_tm, mask_tm, keys_tm), reverse=reverse,
+            unroll=SCAN_UNROLL)
     else:
         def body(mem, scanned):
             x, m = scanned
@@ -210,7 +219,8 @@ def recurrent_group(step_fn, inputs, boot_memories, reverse=False, rng=None):
             return merge(mem, new_mem, m), out
 
         final_mem, outs_tm = jax.lax.scan(
-            body, boot_memories, (xs_tm, mask_tm), reverse=reverse)
+            body, boot_memories, (xs_tm, mask_tm), reverse=reverse,
+            unroll=SCAN_UNROLL)
     outs = jax.tree_util.tree_map(
         lambda o: SequenceBatch(
             data=o.transpose((1, 0) + tuple(range(2, o.ndim)))

@@ -191,3 +191,31 @@ def scatter_rows_to_steps(seq: SequenceBatch):
     data = np.asarray(seq.data)
     lens = np.asarray(seq.lengths)
     return np.concatenate([data[i, :l] for i, l in enumerate(lens)], axis=0)
+
+
+def seq_strided_pool(seq: SequenceBatch, pooling: str, stride: int
+                     ) -> SequenceBatch:
+    """last/first over non-overlapping stride windows, producing a SHORTER
+    sequence (reference SequenceLastInstanceLayer/SequenceFirstInstanceLayer
+    with stride>0, seqlastins config: one instance per window)."""
+    b, t = seq.data.shape[:2]
+    n_win = -(-t // stride)
+    w = jnp.arange(n_win, dtype=jnp.int32)[None, :]               # [1, W]
+    if pooling == "first":
+        idx = w * stride                                           # [B, W]
+        idx = jnp.broadcast_to(idx, (b, n_win))
+    elif pooling == "last":
+        # last valid element inside each window
+        end = jnp.minimum((w + 1) * stride, seq.lengths[:, None])
+        idx = jnp.maximum(end - 1, 0)
+    else:
+        raise ValueError(f"strided seq pool supports last/first, "
+                         f"got {pooling!r}")
+    gathered = jnp.take_along_axis(
+        seq.data, idx.reshape(b, n_win, *([1] * (seq.data.ndim - 2))),
+        axis=1)
+    out_len = -(-seq.lengths // stride)
+    out = SequenceBatch(data=gathered, lengths=out_len.astype(jnp.int32))
+    mask = out.mask(gathered.dtype).reshape(
+        (b, n_win) + (1,) * (gathered.ndim - 2))
+    return SequenceBatch(data=gathered * mask, lengths=out.lengths)

@@ -73,6 +73,13 @@ def main(argv=None):
     t.add_argument("--log_period", type=int, default=100)
     t.add_argument("--test_period", type=int, default=0)
     t.add_argument("--show_parameter_stats_period", type=int, default=0)
+    t.add_argument("--init_model_path", default=None,
+                   help="warm-start parameters from this checkpoint dir")
+    t.add_argument("--load_missing_parameter_strategy", default="fail",
+                   choices=["fail", "rand", "zero"])
+    t.add_argument("--show_layer_stat", action="store_true",
+                   help="log per-layer output stats on the first batch of "
+                        "each pass")
 
     te = sub.add_parser("test")
     add_common(te)
@@ -160,17 +167,34 @@ def main(argv=None):
 
     if args.job == "train":
         save_dir = args.save_dir or cfg.get("save_dir")
+        if args.init_model_path:
+            trainer.load_parameters(
+                args.init_model_path,
+                missing_strategy=args.load_missing_parameter_strategy)
         if args.start_pass:
             if not save_dir:
                 raise SystemExit("--start_pass needs --save_dir (or a "
                                  "save_dir in the config)")
             trainer.load(save_dir, args.start_pass - 1)
+        ev_handler = None
+        if args.show_layer_stat:
+            from paddle_tpu.trainer import events as _ev
+
+            def ev_handler(ev, _tr=trainer, _cfg=cfg):
+                if isinstance(ev, _ev.BeginPass):
+                    batch = next(iter(_cfg["train_reader"]()))
+                    feeding = _cfg.get("feeding")
+                    from paddle_tpu.data.feeder import DataFeeder
+                    feeder = feeding if isinstance(feeding, DataFeeder) \
+                        else (DataFeeder(feeding) if feeding else None)
+                    _tr.log_layer_stats(feeder(batch) if feeder else batch)
         if args.profile_dir:
             from paddle_tpu.utils import profiler
             profiler.start(args.profile_dir)
         try:
             trainer.train(cfg["train_reader"],
                           num_passes=args.num_passes,
+                          event_handler=ev_handler,
                           feeding=cfg.get("feeding"),
                           save_dir=save_dir,
                           saving_period=args.saving_period,

@@ -464,6 +464,56 @@ class SGD:
             self.model_state = model_state
         return meta
 
+    def load_parameters(self, save_dir, pass_id=None,
+                        missing_strategy="fail"):
+        """Warm-start parameters only (reference --init_model_path +
+        --load_missing_parameter_strategy, ParamUtil.cpp loadParameters):
+        params present in the checkpoint are taken; params absent follow
+        missing_strategy = fail | rand | zero (rand keeps this trainer's
+        fresh initialization, the reference's 'rand' semantics)."""
+        from paddle_tpu.utils.error import ConfigError
+        params, _opt, model_state, _ = load_checkpoint(save_dir, pass_id)
+        merged = {}
+        for key, init_val in self.parameters.items():
+            if key in params:
+                merged[key] = params[key]
+            elif missing_strategy == "rand":
+                merged[key] = init_val
+            elif missing_strategy == "zero":
+                merged[key] = jax.tree_util.tree_map(jnp.zeros_like, init_val)
+            else:
+                raise ConfigError(
+                    f"parameter {key!r} missing from {save_dir} "
+                    "(load_missing_parameter_strategy=fail)")
+        extra = set(params) - set(self.parameters)
+        if extra:
+            logger.warning("checkpoint parameters not in this model "
+                           "(ignored): %s", sorted(extra))
+        self.parameters = merged
+        if model_state:
+            self.model_state = {**self.model_state, **model_state}
+
+    def log_layer_stats(self, feed):
+        """Per-layer output abs-mean/abs-max on one batch (reference
+        --show_layer_stat, TrainerInternal.cpp showParameterStats's layer
+        twin: printAllStatus each log_period)."""
+        from paddle_tpu.layers.graph import value_data
+        feed = _normalize_feed(feed)
+        vals = self.topology.apply(
+            self.parameters, feed, mode="test", state=self.model_state,
+            extra_outputs=[n for n in self.topology.order
+                           if n.layer_type != "data"])
+        vals = vals if isinstance(vals, tuple) else (vals,)
+        nodes = [n for n in self.topology.order if n.layer_type != "data"]
+        n_named = len(self.topology.outputs)
+        for node, v in zip(nodes, vals[n_named:]):
+            d = value_data(v)
+            if hasattr(d, "astype"):
+                a = jnp.abs(d.astype(jnp.float32))
+                logger.info("  layer %s [%s] absavg=%.5g absmax=%.5g",
+                            node.name, node.layer_type,
+                            float(jnp.mean(a)), float(jnp.max(a)))
+
 
 class Inferencer:
     """paddle.v2.inference equivalent: run a topology in test mode."""

@@ -89,14 +89,17 @@ class Flags:
     def add_to_parser(self, parser: argparse.ArgumentParser):
         for field in dataclasses.fields(self):
             name = "--" + field.name
+            ftype = str(field.type)
             if field.type is bool or isinstance(field.default, bool):
                 parser.add_argument(name, type=lambda v: v.lower() in ("1", "true", "yes"),
                                     default=None)
-            elif isinstance(field.default, float):
+            elif isinstance(field.default, float) or "float" in ftype:
                 parser.add_argument(name, type=float, default=None)
+            elif isinstance(field.default, int) or "int" in ftype:
+                # covers Optional[int] fields whose default is None
+                parser.add_argument(name, type=int, default=None)
             else:
-                typ = int if isinstance(field.default, int) else str
-                parser.add_argument(name, type=typ, default=None)
+                parser.add_argument(name, type=str, default=None)
 
     def apply(self):
         """Push flag values into the runtime (dtype policy, debug_nans)."""

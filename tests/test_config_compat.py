@@ -214,3 +214,32 @@ def test_benchmark_rnn_config_unchanged(in_tmp):
     cfg = config_to_runtime(parsed)
     costs = _train_batches(cfg, n_batches=2)
     assert np.isfinite(costs).all()
+
+
+# ----------------------------------------------------------------- sweep
+
+_SWEEP_DIR = f"{REFERENCE}/python/paddle/trainer_config_helpers/tests/configs"
+_SWEEP_EXCLUDED = {
+    # a stdin-driven driver script, not a config file
+    "test_config_parser_for_non_file_config.py",
+}
+
+
+def _sweep_configs():
+    if not os.path.isdir(_SWEEP_DIR):
+        return []
+    import glob
+    return sorted(os.path.basename(p)
+                  for p in glob.glob(f"{_SWEEP_DIR}/*.py")
+                  if os.path.basename(p) not in _SWEEP_EXCLUDED)
+
+
+@pytest.mark.skipif(not os.path.isdir(_SWEEP_DIR),
+                    reason="reference checkout not present")
+@pytest.mark.parametrize("cfg_name", _sweep_configs())
+def test_reference_config_sweep(cfg_name):
+    """EVERY reference trainer_config_helpers test config compiles through
+    parse_config unchanged (the golden-config discipline of
+    tests/configs/generate_protostr.sh, minus the protobuf)."""
+    parsed = parse_config(f"{_SWEEP_DIR}/{cfg_name}", "")
+    assert parsed.outputs or parsed.costs, cfg_name

@@ -139,3 +139,51 @@ def test_lstm_grad_flows(np_rng):
     g = jax.grad(loss)(jnp.asarray((np_rng.randn(d, 4 * d) * 0.3).astype(np.float32)))
     assert np.all(np.isfinite(np.asarray(g)))
     assert float(jnp.sum(jnp.abs(g))) > 0
+
+
+def test_prev_batch_state_carries_across_batches(np_rng):
+    """Reference --prev_batch_state (Flags.cpp:73): the RNN's final state
+    boots the next batch.  Split one long sequence into two halves; running
+    them as consecutive 'batches' with the carry must equal one unbroken
+    run."""
+    import paddle_tpu.layers as L
+    from paddle_tpu.layers.graph import Topology, reset_names, value_data
+    from paddle_tpu.core.sequence import SequenceBatch
+
+    reset_names()
+    x = L.data_layer("x", size=12, is_seq=True)
+    out = L.lstmemory(x, size=3, prev_batch_state=True)
+    topo = Topology([out])
+    params = topo.init(jax.random.PRNGKey(0))
+
+    full = jnp.asarray(np_rng.randn(2, 8, 12), jnp.float32)
+    seq_full = SequenceBatch(full, jnp.full((2,), 8, jnp.int32))
+    half = lambda lo, hi: SequenceBatch(   # noqa: E731
+        full[:, lo:hi], jnp.full((2,), hi - lo, jnp.int32))
+
+    ref = value_data(topo.apply(params, {"x": seq_full}, mode="test"))
+
+    o1, st = topo.apply(params, {"x": half(0, 4)}, mode="test",
+                        return_state=True)
+    o2, _ = topo.apply(params, {"x": half(4, 8)}, mode="test", state=st,
+                       return_state=True)
+    got = jnp.concatenate([value_data(o1), value_data(o2)], axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+    # without the carry the halves diverge from the unbroken run
+    reset_names()
+    x2 = L.data_layer("x", size=12, is_seq=True)
+    topo2 = Topology([L.lstmemory(x2, size=3)])
+    o1n = value_data(topo2.apply(params_rename(params), {"x": half(0, 4)},
+                                 mode="test"))
+    o2n = value_data(topo2.apply(params_rename(params), {"x": half(4, 8)},
+                                 mode="test"))
+    got_n = np.concatenate([np.asarray(o1n), np.asarray(o2n)], axis=1)
+    assert not np.allclose(got_n, np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def params_rename(params):
+    """Both topologies auto-name their lstm '__lstmemory_0__' after
+    reset_names, so params transfer as-is."""
+    return params

@@ -1,0 +1,100 @@
+"""Scan-invariant hoisting in recurrent_group: the memory-free row-wise
+prefix of the step graph runs once over the whole sequence before the scan
+(the generalized SequenceToBatch trick).  Must be numerically invisible:
+forward and gradients identical with the optimization on and off."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.layers as L
+from paddle_tpu.core.sequence import pad_sequences
+from paddle_tpu.layers import recurrent as R
+from paddle_tpu.layers.graph import Topology, reset_names, value_data
+
+
+@pytest.fixture
+def toggle():
+    orig = R.HOIST_SCAN_INVARIANTS
+    yield
+    R.HOIST_SCAN_INVARIANTS = orig
+
+
+def _build(np_rng):
+    reset_names()
+    w = L.data_layer("w", size=30, is_seq=True)      # token ids
+    s = L.data_layer("s", size=4, is_seq=True)       # float features
+    ctxv = L.data_layer("ctx", size=6)               # static context
+
+    def step(tok, feat, stat):
+        mem = L.memory(name="h", size=8)
+        emb = L.embedding_layer(tok, size=5)         # hoistable
+        proj = L.fc_layer([emb, feat], size=8, act=None,
+                          bias_attr=False)           # hoistable (multi-in)
+        gate = L.fc_layer([proj, mem, stat], size=8, act="tanh",
+                          name="h")                  # memory-dependent
+        return gate
+
+    out = L.recurrent_group(step, [w, s, L.StaticInput(ctxv)])
+    topo = Topology([L.last_seq(out)])
+    seqs_w = pad_sequences([np_rng.randint(0, 30, (t,))
+                            for t in [3, 5, 2]], max_len=5)
+    seqs_s = pad_sequences([np_rng.randn(t, 4).astype(np.float32)
+                            for t in [3, 5, 2]], max_len=5)
+    feed = {"w": seqs_w, "s": seqs_s,
+            "ctx": np_rng.randn(3, 6).astype(np.float32)}
+    return topo, feed
+
+
+def test_frontier_detection(np_rng, toggle):
+    topo, _ = _build(np_rng)
+    group = next(n for n in topo.order if n.layer_type == "recurrent_group")
+    frontier = R._hoistable_frontier(group.cfg["sub_topo"],
+                                     group.cfg["seq_phs"], "test")
+    # the multi-input fc (emb + feat) is the maximal hoistable node; the
+    # memory-dependent gate is not; the embedding is interior (not frontier)
+    assert len(frontier) == 1
+    assert frontier[0].layer_type == "fc"
+
+
+def test_hoist_matches_unhoisted_forward_and_grad(np_rng, toggle):
+    topo, feed = _build(np_rng)
+    params = topo.init(jax.random.PRNGKey(0))
+
+    def loss(p):
+        out = topo.apply(p, feed, mode="test")
+        return jnp.sum(value_data(out) ** 2)
+
+    R.HOIST_SCAN_INVARIANTS = True
+    l_on, g_on = jax.value_and_grad(loss)(params)
+    R.HOIST_SCAN_INVARIANTS = False
+    l_off, g_off = jax.value_and_grad(loss)(params)
+
+    np.testing.assert_allclose(float(l_on), float(l_off), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g_on),
+                    jax.tree_util.tree_leaves(g_off)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_hoist_respects_dropout_in_train_mode(np_rng, toggle):
+    """Nodes with drop_rate must stay in-scan during training (per-step
+    masks); the frontier excludes them."""
+    reset_names()
+    s = L.data_layer("s", size=4, is_seq=True)
+
+    def step(feat):
+        mem = L.memory(name="h", size=8)
+        proj = L.fc_layer(feat, size=8, act=None, layer_attr={"drop_rate": 0.5})
+        return L.fc_layer([proj, mem], size=8, act="tanh", name="h")
+
+    out = L.recurrent_group(step, s)
+    group = next(n for n in Topology([out]).order
+                 if n.layer_type == "recurrent_group")
+    front_train = R._hoistable_frontier(group.cfg["sub_topo"],
+                                        group.cfg["seq_phs"], "train")
+    front_test = R._hoistable_frontier(group.cfg["sub_topo"],
+                                       group.cfg["seq_phs"], "test")
+    assert front_train == []          # dropout stays per-step
+    assert len(front_test) == 1       # inactive in test mode -> hoistable
