@@ -159,11 +159,22 @@ class MultiNetwork:
             results.append(m.forwardBackward(feed))
         return results
 
+    def _subnet_keys(self, subnet):
+        topo = self.topologies[subnet]
+        return {topo._param_key(n) for n in topo.order
+                if topo._param_key(n) in self.parameters}
+
     def applyOptimizer(self, optimizer, opt_state, subnet=None):
         """One update of the shared parameters: with subnet given, from that
         machine's grads alone (GAN alternation); otherwise from the SUM of
         every machine's accumulated grads (the reference's joint backward —
-        sub-net costs add)."""
+        sub-net costs add).
+
+        A subnet update touches ONLY that sub-net's parameter keys: the
+        optimizer step runs on the full tree (one jit signature) but
+        momentum decay / weight decay on the other sub-nets' zero-grad
+        params is discarded — a frozen discriminator must not drift while
+        the generator trains."""
         machines = ([self.machines[subnet]] if subnet is not None
                     else self.machines)
         grads = None
@@ -176,11 +187,24 @@ class MultiNetwork:
         if grads is None:
             raise RuntimeError("no gradients accumulated; call "
                                "forwardBackward first")
-        self.parameters, opt_state = optimizer.update(grads, opt_state,
-                                                      self.parameters)
+        new_params, new_state = optimizer.update(grads, opt_state,
+                                                 self.parameters)
+        if subnet is not None:
+            keep = self._subnet_keys(subnet)
+            new_params = {k: (v if k in keep else self.parameters[k])
+                          for k, v in new_params.items()}
+            if isinstance(new_state, dict) and "slots" in new_state \
+                    and isinstance(opt_state, dict):
+                new_state = dict(new_state)
+                new_state["slots"] = {
+                    slot: {k: (v if k in keep
+                               else opt_state["slots"][slot][k])
+                           for k, v in tree.items()}
+                    for slot, tree in new_state["slots"].items()}
+        self.parameters = new_params
         for m in self.machines:
             m.parameters = self.parameters
-        return opt_state
+        return new_state
 
 
 class SequenceGenerator:

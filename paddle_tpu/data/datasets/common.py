@@ -1,0 +1,74 @@
+"""Dataset download + cache (reference python/paddle/v2/dataset/common.py:
+DATA_HOME, download(url, module, md5), md5file).
+
+DATA_HOME here is PADDLE_TPU_DATA_DIR (the same root every loader reads
+local files from), so a successful download drops files exactly where the
+real parsers look.  In an air-gapped environment download() raises a clear
+DownloadError naming the file to place manually — the loaders themselves
+then fall back to deterministic synthetic data."""
+
+import hashlib
+import os
+
+from paddle_tpu.data.datasets._synth import data_dir
+from paddle_tpu.utils.logging import logger
+
+__all__ = ["DATA_HOME", "data_home", "download", "md5file", "DownloadError"]
+
+
+def data_home():
+    d = data_dir()
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def __getattr__(name):
+    # DATA_HOME resolves lazily: no import-time mkdir (a read-only HOME
+    # must not break the synthetic-fallback path), and PADDLE_TPU_DATA_DIR
+    # set after import is honored (same contract as _synth.data_dir)
+    if name == "DATA_HOME":
+        return data_home()
+    raise AttributeError(name)
+
+
+class DownloadError(RuntimeError):
+    pass
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, timeout=60):
+    """Fetch url into DATA_HOME/module_name (cached by md5).  Returns the
+    local path; raises DownloadError when the network is unreachable, with
+    instructions for manual placement."""
+    dirname = os.path.join(data_home(), module_name)
+    os.makedirs(dirname, exist_ok=True)
+    filename = os.path.join(dirname, url.split("/")[-1])
+    if os.path.exists(filename) and (md5sum is None
+                                     or md5file(filename) == md5sum):
+        return filename
+    logger.info("downloading %s -> %s", url, filename)
+    try:
+        import urllib.request
+        tmp = filename + ".part"
+        with urllib.request.urlopen(url, timeout=timeout) as r, \
+                open(tmp, "wb") as f:
+            while True:
+                chunk = r.read(1 << 20)
+                if not chunk:
+                    break
+                f.write(chunk)
+        os.replace(tmp, filename)
+    except Exception as e:
+        raise DownloadError(
+            f"cannot download {url} ({e}); place the file manually at "
+            f"{filename} (PADDLE_TPU_DATA_DIR={data_home()})") from e
+    if md5sum is not None and md5file(filename) != md5sum:
+        raise DownloadError(f"{filename}: md5 mismatch after download")
+    return filename

@@ -258,8 +258,10 @@ class _MixedImpl:
                 p[f"w{k}"] = _winit(spec.get("param_attr"))(rngs[k], (pad_rows, isz))
             elif kind == "conv_proj":
                 fh, fw = spec["filter_size"]
+                g = spec.get("groups", 1) or 1
                 p[f"w{k}"] = _winit(spec.get("param_attr"))(
-                    rngs[k], (fh, fw, spec["channels"], spec["num_filters"]))
+                    rngs[k], (fh, fw, spec["channels"] // g,
+                              spec["num_filters"]))
             idx += 2 if kind in ("dotmul_op", "conv_op") else 1
         b = _maybe_bias(rngs[-1], cfg.get("bias_attr", False), cfg["size"])
         if b is not None:
@@ -323,7 +325,8 @@ class _MixedImpl:
                         x = d.reshape(d.shape[0], c, h, w).transpose(0, 2, 3, 1)
                         y = conv_ops.conv2d(x, params[f"w{k}"],
                                             stride=spec["stride"],
-                                            padding=spec["padding"])
+                                            padding=spec["padding"],
+                                            groups=spec.get("groups", 1) or 1)
                         return y.transpose(0, 3, 1, 2).reshape(d.shape[0], -1)
 
                     part = map_rows(conv_rows, v)

@@ -44,7 +44,16 @@ def _prev_batch_carry(ctx, cfg):
         from paddle_tpu.utils.flags import FLAGS
         if not FLAGS.prev_batch_state:
             return False
-    return not cfg.get("reverse", False)
+    if cfg.get("reverse", False):
+        if cfg.get("prev_batch_state"):
+            # explicit per-layer request on a reversed scan is a config
+            # contradiction — fail loudly instead of silently dropping it
+            raise ConfigError(
+                f"{cfg.get('name', '?')}: prev_batch_state cannot carry "
+                "state for a reverse RNN (the final state of a reversed "
+                "scan is the sequence START)")
+        return False  # global flag: skip reversed layers, carry the rest
+    return True
 
 
 def _prev_batch_init(ctx, cfg):

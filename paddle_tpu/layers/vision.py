@@ -536,14 +536,23 @@ def data_norm_layer(input, strategy="z-score", name=None):
 # ----------------------------------------------- conv projection/operator
 # (mixed_layer parts; reference ConvProjection / ConvOperator.cpp:58)
 
+def _xy(x_val, y_val):
+    """Reference conv-geometry convention: the scalar/first-tuple-element is
+    the X (width) dimension, *_y (or second element) the height -> (h, w)."""
+    if isinstance(x_val, (tuple, list)):
+        x_val, y_val = x_val
+    return (y_val if y_val is not None else x_val), x_val
+
+
 def _conv_part_spec(img, filter_size, num_filters, num_channels, stride,
-                    padding):
+                    padding, filter_size_y=None, stride_y=None,
+                    padding_y=None):
     from paddle_tpu.layers.api import _Part  # local: avoid import cycle
     channels = _channels(img, num_channels)
     in_shape = _img_shape(img, channels)
-    fh, fw = _pair(filter_size)
-    sh, sw = _pair(stride)
-    ph, pw = _pair(padding)
+    fh, fw = _xy(filter_size, filter_size_y)
+    sh, sw = _xy(stride, stride_y)
+    ph, pw = _xy(padding, padding_y)
     oh = conv_ops.conv_output_size(in_shape[0], fh, sh, ph)
     ow = conv_ops.conv_output_size(in_shape[1], fw, sw, pw)
     spec = {"filter_size": (fh, fw), "stride": (sh, sw), "padding": (ph, pw),
@@ -564,8 +573,10 @@ def conv_projection(input, filter_size, num_filters, num_channels=None,
                        "runs as a standard conv projection; numerics differ "
                        "until ConvTransProjection lands")
     _Part, spec, out = _conv_part_spec(input, filter_size, num_filters,
-                                       num_channels, stride, padding)
+                                       num_channels, stride, padding,
+                                       filter_size_y, stride_y, padding_y)
     spec["param_attr"] = param_attr
+    spec["groups"] = groups
     return _Part("conv_proj", [input], spec, out)
 
 
@@ -582,5 +593,6 @@ def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
                        "conv runs as a standard conv_operator graph node; "
                        "numerics differ until ConvTransOperator lands")
     _Part, spec, out = _conv_part_spec(img, filter_size, num_filters,
-                                       num_channels, stride, padding)
+                                       num_channels, stride, padding,
+                                       filter_size_y, stride_y, padding_y)
     return _Part("conv_op", [img, filter], spec, out)

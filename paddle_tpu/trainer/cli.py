@@ -35,6 +35,14 @@ def _load_config(path, config_args):
     return config_to_runtime(parse_config(path, config_args))
 
 
+def _resolve_feeder(feeding):
+    """feeding may be a DataFeeder, an input-types dict, or None."""
+    from paddle_tpu.data.feeder import DataFeeder
+    if isinstance(feeding, DataFeeder):
+        return feeding
+    return DataFeeder(feeding) if feeding else None
+
+
 def _parse_config_args(s):
     out = {}
     if s:
@@ -132,12 +140,9 @@ def main(argv=None):
     cfg = _load_config(args.config, _parse_config_args(args.config_args))
 
     if args.job == "checkgrad":
-        from paddle_tpu.data.feeder import DataFeeder
         from paddle_tpu.layers.graph import Topology
         from paddle_tpu.testing import check_topology_grads
-        feeding = cfg.get("feeding")
-        feeder = feeding if isinstance(feeding, DataFeeder) else (
-            DataFeeder(feeding) if feeding else None)
+        feeder = _resolve_feeder(cfg.get("feeding"))
         batch = next(iter(cfg["train_reader"]()))
         feed = feeder(batch) if feeder else batch
         costs = cfg["cost"]
@@ -179,15 +184,14 @@ def main(argv=None):
         ev_handler = None
         if args.show_layer_stat:
             from paddle_tpu.trainer import events as _ev
+            feeder = _resolve_feeder(cfg.get("feeding"))
 
-            def ev_handler(ev, _tr=trainer, _cfg=cfg):
+            def ev_handler(ev, _tr=trainer, _cfg=cfg, _feeder=feeder):
                 if isinstance(ev, _ev.BeginPass):
-                    batch = next(iter(_cfg["train_reader"]()))
-                    feeding = _cfg.get("feeding")
-                    from paddle_tpu.data.feeder import DataFeeder
-                    feeder = feeding if isinstance(feeding, DataFeeder) \
-                        else (DataFeeder(feeding) if feeding else None)
-                    _tr.log_layer_stats(feeder(batch) if feeder else batch)
+                    batch = next(iter(_cfg["train_reader"]()), None)
+                    if batch is None:   # empty (or one-shot, drained) reader
+                        return
+                    _tr.log_layer_stats(_feeder(batch) if _feeder else batch)
         if args.profile_dir:
             from paddle_tpu.utils import profiler
             profiler.start(args.profile_dir)

@@ -1,0 +1,6 @@
+"""User tooling (reference python/paddle/utils/): plotcurve,
+make_model_diagram, preprocess_img, torch import."""
+
+from paddle_tpu.utils.tools.plotcurve import plot_curves  # noqa: F401
+from paddle_tpu.utils.tools.diagram import make_diagram, topology_dot  # noqa: F401
+from paddle_tpu.utils.tools.torch_import import from_torch_state_dict  # noqa: F401

@@ -1,0 +1,33 @@
+"""Graphviz model diagrams from a Topology (reference
+python/paddle/utils/make_model_diagram.py, which walked the config proto).
+
+  from paddle_tpu.utils.tools import make_diagram
+  make_diagram(topology_or_cost_layer, "model.dot")
+  # dot -Tpng model.dot -o model.png
+"""
+
+
+def topology_dot(topology, name="model"):
+    from paddle_tpu.layers.graph import LayerOutput, Topology
+    if isinstance(topology, LayerOutput):
+        topology = Topology([topology])
+    lines = [f"digraph {name} {{", "  rankdir=BT;",
+             '  node [shape=box, fontsize=10];']
+    for node in topology.order:
+        shape = "ellipse" if node.layer_type == "data" else "box"
+        style = ', style=filled, fillcolor="#e8f0fe"' \
+            if node.layer_type == "data" else ""
+        label = f"{node.name}\\n{node.layer_type} [{node.size}]"
+        lines.append(f'  "{node.name}" [label="{label}", shape={shape}{style}];')
+    for node in topology.order:
+        for src in node.inputs:
+            lines.append(f'  "{src.name}" -> "{node.name}";')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def make_diagram(topology, out_path, name="model"):
+    dot = topology_dot(topology, name=name)
+    with open(out_path, "w") as f:
+        f.write(dot + "\n")
+    return out_path

@@ -1,0 +1,69 @@
+"""Plot train/test cost curves from trainer logs (reference
+python/paddle/utils/plotcurve.py: parse 'Pass N ... cost C' lines, plot
+keys with matplotlib).
+
+Usage:
+  python -m paddle_tpu.utils.tools.plotcurve -i train.log -o curve.png [keys]
+
+Our log lines: "Pass 3 done, mean cost 0.12854" and
+"Pass 3 Batch 40 Cost 0.15887 ..." plus "Eval: name=value" suffixes.
+Default key: the per-pass mean cost."""
+
+import argparse
+import re
+import sys
+
+PASS_RE = re.compile(r"Pass (\d+) done, mean cost ([-\d.eE]+)")
+EVAL_RE = re.compile(r"(\w+)=([-\d.eE]+)")
+
+
+def parse_log(lines, keys=("cost",)):
+    """-> {key: [(pass_id, value), ...]}"""
+    out = {k: [] for k in keys}
+    for line in lines:
+        m = PASS_RE.search(line)
+        if m:
+            pass_id, cost = int(m.group(1)), float(m.group(2))
+            if "cost" in out:
+                out["cost"].append((pass_id, cost))
+            for k, v in EVAL_RE.findall(line):
+                if k in out and k != "cost":
+                    out[k].append((pass_id, float(v)))
+    return out
+
+
+def plot_curves(lines, output, keys=("cost",), fmt="png"):
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    data = parse_log(lines, keys)
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for k, pts in data.items():
+        if pts:
+            xs, ys = zip(*pts)
+            ax.plot(xs, ys, marker="o", markersize=3, label=k)
+    ax.set_xlabel("pass")
+    ax.legend()
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(output, format=fmt)
+    return data
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-i", "--input", default=None)
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("--format", default="png")
+    p.add_argument("keys", nargs="*", default=["cost"])
+    args = p.parse_args(argv)
+    lines = open(args.input) if args.input else sys.stdin
+    plot_curves(lines, args.output, keys=tuple(args.keys) or ("cost",),
+                fmt=args.format)
+    if args.input:
+        lines.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""Image-folder preprocessing into the native record format (reference
+python/paddle/utils/preprocess_img.py: resize + split + per-channel mean
+into batched pickles; here records stream through native.RecordReader and
+the mean rides in a sidecar .meta.npz).
+
+  python -m paddle_tpu.utils.tools.preprocess_img \
+      --in_dir images/ --out_dir data/ --size 64 [--test_ratio 0.1]
+
+in_dir layout: one subdirectory per class (label = sorted subdir index).
+"""
+
+import argparse
+import io
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _iter_images(in_dir):
+    classes = sorted(d for d in os.listdir(in_dir)
+                     if os.path.isdir(os.path.join(in_dir, d)))
+    for label, cls in enumerate(classes):
+        cdir = os.path.join(in_dir, cls)
+        for fname in sorted(os.listdir(cdir)):
+            yield os.path.join(cdir, fname), label
+    return
+
+
+def preprocess(in_dir, out_dir, size=64, test_ratio=0.1, seed=0):
+    from PIL import Image
+    from paddle_tpu import native
+    os.makedirs(out_dir, exist_ok=True)
+    classes = sorted(d for d in os.listdir(in_dir)
+                     if os.path.isdir(os.path.join(in_dir, d)))
+    rng = np.random.RandomState(seed)
+    writers = {
+        "train": native.RecordWriter(os.path.join(out_dir, "train.rec")),
+        "test": native.RecordWriter(os.path.join(out_dir, "test.rec")),
+    }
+    mean_acc = np.zeros((3,), np.float64)
+    n_train = 0
+    counts = {"train": 0, "test": 0}
+    for path, label in _iter_images(in_dir):
+        try:
+            img = Image.open(path).convert("RGB").resize((size, size))
+        except Exception:
+            continue
+        arr = np.asarray(img, np.uint8)               # [H, W, 3]
+        split = "test" if rng.rand() < test_ratio else "train"
+        payload = io.BytesIO()
+        np.savez_compressed(payload, img=arr, label=np.int32(label))
+        writers[split].put(payload.getvalue())
+        counts[split] += 1
+        if split == "train":
+            mean_acc += arr.reshape(-1, 3).mean(axis=0)
+            n_train += 1
+    for w in writers.values():
+        w.close()
+    mean = (mean_acc / max(n_train, 1)).astype(np.float32)
+    np.savez(os.path.join(out_dir, "meta.npz"), mean=mean,
+             size=np.int32(size))
+    with open(os.path.join(out_dir, "labels.json"), "w") as f:
+        json.dump(classes, f)
+    return counts, mean
+
+
+def record_reader(rec_path, meta_path=None):
+    """Reader over a preprocessed .rec: yields (normalized [H*W*3] float
+    rows, label) like the reference's batched-pickle provider."""
+    from paddle_tpu import native
+    mean = None
+    if meta_path and os.path.exists(meta_path):
+        mean = np.load(meta_path)["mean"]
+
+    def reader():
+        for payload in native.RecordReader(rec_path):
+            z = np.load(io.BytesIO(payload))
+            arr = z["img"].astype(np.float32)
+            if mean is not None:
+                arr = arr - mean
+            yield arr.reshape(-1) / 255.0, int(z["label"])
+    return reader
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--in_dir", required=True)
+    p.add_argument("--out_dir", required=True)
+    p.add_argument("--size", type=int, default=64)
+    p.add_argument("--test_ratio", type=float, default=0.1)
+    args = p.parse_args(argv)
+    counts, mean = preprocess(args.in_dir, args.out_dir, args.size,
+                              args.test_ratio)
+    print(f"wrote {counts} mean={mean}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,58 @@
+"""Import torch parameters into a paddle_tpu params pytree (the modern
+counterpart of python/paddle/utils/torch2paddle.py, which converted torch7
+binary weight files).
+
+Matching is by explicit mapping {params_path: tensor_name} or, with
+mapping=None, positionally over leaves in declaration order with automatic
+transposition of 2-D kernels (torch nn.Linear stores [out, in]; our fc
+kernels are [in, out])."""
+
+import numpy as np
+
+
+def _leaf_paths(tree, prefix=()):
+    if isinstance(tree, dict):
+        for k in tree:
+            yield from _leaf_paths(tree[k], prefix + (k,))
+    else:
+        yield prefix, tree
+
+
+def from_torch_state_dict(params, state_dict, mapping=None,
+                          transpose_linear=True):
+    """Return a copy of `params` with values taken from the torch
+    state_dict.  Shapes must match exactly (after the optional [out,in] ->
+    [in,out] linear transposition)."""
+    import copy
+    import jax.numpy as jnp
+    out = copy.deepcopy(params)
+
+    def to_np(t):
+        return t.detach().cpu().numpy() if hasattr(t, "detach") \
+            else np.asarray(t)
+
+    if mapping is not None:
+        items = [(tuple(k.split("/")), state_dict[v])
+                 for k, v in mapping.items()]
+    else:
+        keys = list(state_dict.keys())
+        paths = list(_leaf_paths(out))
+        if len(keys) != len(paths):
+            raise ValueError(f"positional import needs equal counts: "
+                             f"{len(paths)} params vs {len(keys)} tensors")
+        items = [(p, state_dict[k]) for (p, _), k in zip(paths, keys)]
+
+    for path, tensor in items:
+        arr = to_np(tensor)
+        target = out
+        for p in path[:-1]:
+            target = target[p]
+        cur = np.asarray(target[path[-1]])
+        if arr.shape != cur.shape and transpose_linear and arr.ndim == 2 \
+                and arr.T.shape == cur.shape:
+            arr = arr.T
+        if arr.shape != cur.shape:
+            raise ValueError(f"shape mismatch at {'/'.join(path)}: "
+                             f"torch {arr.shape} vs params {cur.shape}")
+        target[path[-1]] = jnp.asarray(arr, cur.dtype)
+    return out

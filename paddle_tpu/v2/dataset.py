@@ -1,7 +1,9 @@
 """paddle.v2.dataset (reference v2/dataset/: mnist, cifar, imdb, imikolov,
-movielens, conll05, uci_housing, wmt14 with auto-download+cache; this
-image has zero egress so loaders fall back to deterministic synthetic data
-with the real schemas — see data/datasets/_synth.py)."""
+movielens, conll05, uci_housing, wmt14 with auto-download+cache via
+common.download).  Real files load from PADDLE_TPU_DATA_DIR; without them
+(or network for common.download) loaders fall back to deterministic
+synthetic data with the real schemas — see data/datasets/_synth.py."""
 
 from paddle_tpu.data.datasets import (      # noqa: F401
-    mnist, cifar, imdb, imikolov, movielens, conll05, uci_housing, wmt14)
+    common, mnist, cifar, imdb, imikolov, movielens, conll05, uci_housing,
+    wmt14)

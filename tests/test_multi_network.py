@@ -71,18 +71,24 @@ def test_joint_update_sums_gradients(np_rng):
 
 def test_alternating_updates_gan_style(np_rng):
     """Alternating per-subnet updates (the reference gan_trainer drove
-    MultiNetwork sub-nets through the API the same way)."""
+    MultiNetwork sub-nets through the API the same way).  momentum=0.9:
+    a frozen sub-net's params must not drift via velocity/decay on its
+    zero-grad leaves."""
     feed = _feed(np_rng)
     mn = MultiNetwork(list(_two_nets()))
-    opt = optim.Momentum(learning_rate=0.1, momentum=0.0)
+    opt = optim.Momentum(learning_rate=0.1, momentum=0.9, l2=0.01)
     st = opt.init(mn.parameters)
     before_b_head = np.asarray(
         jax.tree_util.tree_leaves(mn.parameters["__fc_3__"])[0]).copy()
 
     mn.forwardBackward(feed, subnet=0)
     st = mn.applyOptimizer(opt, st, subnet=0)
+    # twice: with momentum+decay a naive full-tree update would move
+    # subnet 1's params on the second step even with zero grads
+    mn.forwardBackward(feed, subnet=0)
+    st = mn.applyOptimizer(opt, st, subnet=0)
 
-    # subnet 0's update must not touch subnet 1's private head...
+    # subnet 0's updates must not touch subnet 1's private head...
     after_b_head = np.asarray(
         jax.tree_util.tree_leaves(mn.parameters["__fc_3__"])[0])
     np.testing.assert_array_equal(before_b_head, after_b_head)

@@ -1,0 +1,89 @@
+"""User tooling (reference python/paddle/utils/): log curve plotting, model
+diagram emission, torch parameter import."""
+
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu.layers as L
+from paddle_tpu.layers.graph import Topology, reset_names
+
+
+def test_plotcurve_parses_and_writes(tmp_path):
+    from paddle_tpu.utils.tools import plotcurve
+    log = [
+        "I 0729 paddle_tpu] Pass 0 done, mean cost 0.83612 Eval: err=0.5\n",
+        "I 0729 paddle_tpu] Pass 1 done, mean cost 0.51 Eval: err=0.25\n",
+        "I 0729 paddle_tpu] Pass 2 done, mean cost 0.20 Eval: err=0.125\n",
+    ]
+    out = tmp_path / "curve.png"
+    data = plotcurve.plot_curves(log, str(out), keys=("cost", "err"))
+    assert out.exists() and out.stat().st_size > 0
+    assert data["cost"] == [(0, 0.83612), (1, 0.51), (2, 0.20)]
+    assert data["err"] == [(0, 0.5), (1, 0.25), (2, 0.125)]
+
+
+def test_make_diagram_dot(tmp_path):
+    from paddle_tpu.utils.tools import make_diagram, topology_dot
+    reset_names()
+    x = L.data_layer("x", size=4)
+    out = L.fc_layer(x, size=2, act="softmax", name="out")
+    dot = topology_dot(out)
+    assert '"x" -> "out"' in dot and "digraph" in dot
+    p = make_diagram(out, str(tmp_path / "m.dot"))
+    assert open(p).read().startswith("digraph")
+
+
+def test_torch_import_positional_and_mapped():
+    torch = pytest.importorskip("torch")
+    from paddle_tpu.utils.tools import from_torch_state_dict
+    reset_names()
+    x = L.data_layer("x", size=4)
+    out = L.fc_layer(x, size=3, act=None, name="fc")
+    topo = Topology([out])
+    params = topo.init(jax.random.PRNGKey(0))
+
+    lin = torch.nn.Linear(4, 3)
+    sd = lin.state_dict()            # weight [3,4], bias [3]
+    # positional: [w, b] order matches our {'fc': {'w', 'b'}} leaves
+    got = from_torch_state_dict(params, sd)
+    np.testing.assert_allclose(np.asarray(got["fc"]["w0"]),
+                               sd["weight"].numpy().T, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["fc"]["b"]),
+                               sd["bias"].numpy(), rtol=1e-6)
+
+    got2 = from_torch_state_dict(params, sd,
+                                 mapping={"fc/w0": "weight", "fc/b": "bias"})
+    np.testing.assert_allclose(np.asarray(got2["fc"]["w0"]),
+                               sd["weight"].numpy().T, rtol=1e-6)
+
+    # model still runs with imported weights
+    val = topo.apply(got, {"x": np.ones((2, 4), np.float32)}, mode="test")
+    ref = lin(torch.ones(2, 4)).detach().numpy()
+    np.testing.assert_allclose(np.asarray(val), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_preprocess_img_roundtrip(tmp_path):
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+    from paddle_tpu.utils.tools import preprocess_img
+    from paddle_tpu import native
+    if not native.is_available():
+        pytest.skip("native runtime not built")
+    src = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        (src / cls).mkdir(parents=True)
+        for i in range(4):
+            arr = (np.random.RandomState(i).rand(20, 30, 3) * 255
+                   ).astype(np.uint8)
+            Image.fromarray(arr).save(src / cls / f"{i}.png")
+    out = tmp_path / "rec"
+    counts, mean = preprocess_img.preprocess(str(src), str(out), size=16,
+                                             test_ratio=0.25, seed=0)
+    assert counts["train"] + counts["test"] == 8
+    rows = list(preprocess_img.record_reader(
+        str(out / "train.rec"), str(out / "meta.npz"))())
+    assert len(rows) == counts["train"]
+    x, y = rows[0]
+    assert x.shape == (16 * 16 * 3,) and y in (0, 1)
+    assert np.isfinite(x).all()
