@@ -510,7 +510,8 @@ _simple_layer("concat", lambda cfg, s: sum(s),
                   lambda *ds: jnp.concatenate(ds, axis=-1), *ins))
 
 
-def concat_layer(input, act=None, name=None):
+def concat_layer(input, act=None, name=None, bias_attr=False,
+                 layer_attr=None):
     # the reference concat accepts projections too (concat_layer(input=
     # [identity_projection(a), ...])) — realize each as a one-part mixed
     ins = [mixed_layer(size=item.out_size, input=[item], act=None)
@@ -815,6 +816,14 @@ class _SeqPoolImpl:
         return in_sizes[0]
 
     def apply(self, ctx, cfg, params, x):
+        from paddle_tpu.core.sequence import NestedSequenceBatch
+        if isinstance(x, NestedSequenceBatch):
+            # reference sequence levels over sub-sequenced input:
+            # TO_SEQUENCE pools within each sub-sequence (-> sequence of
+            # pooled rows); default pools the whole (flattened) sequence
+            each = cfg.get("agg_level") == "seq"
+            return seq_ops.nested_seq_pool(x, cfg["pooling"],
+                                           each_sequence=each)
         stride = cfg.get("stride", -1)
         if stride and stride > 0:
             return seq_ops.seq_strided_pool(as_seq(x), cfg["pooling"],
@@ -843,21 +852,25 @@ class pooling:
 def pooling_layer(input, pooling_type=None, name=None, agg_level=None):
     pt = getattr(pooling_type, "name", pooling_type) or "max"
     return LayerOutput(name or auto_name("seq_pool"), "seq_pool", input.size,
-                       [input], {"pooling": pt}, is_seq=False)
+                       [input], {"pooling": pt, "agg_level": agg_level},
+                       is_seq=agg_level == "seq")
 
 
 def last_seq(input, name=None, agg_level=None, stride=-1):
     """stride > 0 (reference seqlastins stride): last instance of each
-    non-overlapping stride window — output stays a (shorter) sequence."""
+    non-overlapping stride window — output stays a (shorter) sequence.
+    agg_level='seq' over a nested input pools each sub-sequence."""
     return LayerOutput(name or auto_name("last_seq"), "seq_pool", input.size,
-                       [input], {"pooling": "last", "stride": stride},
-                       is_seq=stride > 0)
+                       [input], {"pooling": "last", "stride": stride,
+                                 "agg_level": agg_level},
+                       is_seq=stride > 0 or agg_level == "seq")
 
 
 def first_seq(input, name=None, agg_level=None, stride=-1):
     return LayerOutput(name or auto_name("first_seq"), "seq_pool", input.size,
-                       [input], {"pooling": "first", "stride": stride},
-                       is_seq=stride > 0)
+                       [input], {"pooling": "first", "stride": stride,
+                                 "agg_level": agg_level},
+                       is_seq=stride > 0 or agg_level == "seq")
 
 
 _simple_layer("expand", lambda cfg, s: s[0],

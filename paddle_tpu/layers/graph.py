@@ -33,6 +33,12 @@ from paddle_tpu.utils.error import ConfigError
 _LAYER_IMPLS: Dict[str, "LayerImpl"] = {}
 _NAME_COUNTERS: Dict[str, int] = {}
 
+# observers notified of every LayerOutput constructed — the recurrent_group
+# tracer uses this to see step-graph nodes that are CONSUMERS of the step
+# outputs (e.g. `last_seq(inner_out, name="outer_rnn_state")` as a memory
+# link target, the reference sequence_nest_rnn.conf pattern)
+_NODE_OBSERVERS: List[Callable] = []
+
 
 @dataclasses.dataclass
 class LayerImpl:
@@ -91,6 +97,8 @@ class LayerOutput:
         self.is_seq = is_seq
         self.num_filters = num_filters      # conv image metadata
         self.img_shape = img_shape          # (h, w) after this layer
+        for obs in _NODE_OBSERVERS:
+            obs(self)
 
     def __repr__(self):
         return (f"LayerOutput({self.name}, {self.layer_type}, size={self.size}"

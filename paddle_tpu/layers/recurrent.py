@@ -253,10 +253,14 @@ class _GroupBuildCtx:
         self.memories = []  # list of (placeholder, link_name, boot, init_zero)
 
 
-def resolve_memory_links(sub_topo, memories):
+def resolve_memory_links(sub_topo, memories, extra_nodes=()):
     """Match memory() links to step-graph layers by name (shared by
-    recurrent_group and the generation DSL)."""
-    by_name = {n.name: n for n in sub_topo.order}
+    recurrent_group and the generation DSL).  extra_nodes: nodes created
+    during step tracing that are NOT ancestors of the step outputs — the
+    reference allows a memory to link a CONSUMER of the output (e.g.
+    last_seq(inner_out, name="outer_rnn_state"), sequence_nest_rnn.conf)."""
+    by_name = {n.name: n for n in extra_nodes}
+    by_name.update({n.name: n for n in sub_topo.order})
     links = []
     for ph, link_name, boot, boot_const in memories:
         if link_name not in by_name:
@@ -340,19 +344,34 @@ def recurrent_group(step, input, reverse=False, name=None):
                           "flat sequence inputs (reference nested groups "
                           "iterate subsequences only)")
 
+    from paddle_tpu.layers import graph as _graph
     g = _GroupBuildCtx()
     prev = _GroupBuildCtx.current
     _GroupBuildCtx.current = g
+    created = []
+    _graph._NODE_OBSERVERS.append(created.append)
     try:
         outs = step(*step_args)
     finally:
         _GroupBuildCtx.current = prev
+        _graph._NODE_OBSERVERS.remove(created.append)
     outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
 
-    # resolve memory links: each memory's `link` names a layer in the step
-    # graph; collect all step nodes to find them
+    # resolve memory links: each memory's `link` names a layer created
+    # during the step trace (ancestor of the outputs or not)
     sub_topo = Topology(outs)
-    links = resolve_memory_links(sub_topo, g.memories)
+    links = resolve_memory_links(sub_topo, g.memories, extra_nodes=created)
+
+    # link targets that are NOT ancestors of the outputs must still be
+    # computed each step: make them additional sub-graph outputs
+    in_graph = {id(n) for n in sub_topo.order}
+    link_nodes = [ln for _, ln, _, _ in links]
+    extra_outs = []
+    for ln in link_nodes:
+        if id(ln) not in in_graph and all(ln is not e for e in extra_outs):
+            extra_outs.append(ln)
+    if extra_outs:
+        sub_topo = Topology(outs + extra_outs)
 
     group_inputs = ([real for _, real in seq_inputs]
                     + [s.input for _, s in sub_inputs]
@@ -530,8 +549,14 @@ class _RecurrentGroupImpl:
             if not isinstance(vals, tuple) or isinstance(
                     vals, (SequenceBatch, NestedSequenceBatch)):
                 vals = (vals,)
+            # layout: [step outputs | consumer-link topo outputs (if any) |
+            # link values appended by extra_outputs] — memories are always
+            # the LAST len(links) entries
+            n_links = len(cfg["links"])
             out_vals = vals[:n_out]
-            new_mems = [value_data(v) for v in vals[n_out:]]
+            new_mems = [value_data(v)
+                        for v in (vals[len(vals) - n_links:]
+                                  if n_links else ())]
             # nested groups keep sequence-valued step outputs whole so the
             # engine can stack them into a NestedSequenceBatch; flat groups
             # emit per-step rows

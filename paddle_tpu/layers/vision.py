@@ -108,7 +108,7 @@ def img_conv_layer(input, filter_size, num_filters, num_channels=None,
                    stride=1, padding=0, groups=1, act="relu", name=None,
                    bias_attr=True, param_attr=None, trans=False,
                    filter_size_y=None, stride_y=None, padding_y=None,
-                   layer_attr=None):
+                   layer_attr=None, shared_biases=True, layer_type=None):
     """Reference img_conv_layer (ExpandConvLayer/CudnnConvLayer merged —
     one XLA conv path)."""
     channels = _channels(input, num_channels)

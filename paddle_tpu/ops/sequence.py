@@ -219,3 +219,52 @@ def seq_strided_pool(seq: SequenceBatch, pooling: str, stride: int
     mask = out.mask(gathered.dtype).reshape(
         (b, n_win) + (1,) * (gathered.ndim - 2))
     return SequenceBatch(data=gathered * mask, lengths=out.lengths)
+
+
+def nested_seq_pool(nested, pooling: str, each_sequence: bool = False):
+    """Sequence pooling over a NestedSequenceBatch (reference sequence
+    levels, Argument subSequenceStartPositions).
+
+    each_sequence=True (AggregateLevel.TO_SEQUENCE): pool WITHIN each
+    sub-sequence -> SequenceBatch [B, S, D] over the outer axis.
+    Otherwise pool over ALL valid elements -> [B, D] (last/first pick the
+    overall last/first element, matching the flat view of the nested
+    data)."""
+    from paddle_tpu.core.sequence import NestedSequenceBatch
+    assert isinstance(nested, NestedSequenceBatch)
+    b, s = nested.data.shape[:2]
+
+    if each_sequence:
+        flat = nested.flatten_outer()          # [B*S, T, ...]
+        pooled = seq_pool(flat, pooling)       # [B*S, D]
+        data = pooled.reshape((b, s) + pooled.shape[1:])
+        data = data * nested.outer_mask(data.dtype).reshape(
+            (b, s) + (1,) * (data.ndim - 2))
+        return SequenceBatch(data=data, lengths=nested.outer_lengths)
+
+    if pooling == "last":
+        outer_idx = jnp.maximum(nested.outer_lengths - 1, 0)      # [B]
+        per_sub = nested_seq_pool(nested, "last", each_sequence=True)
+        return jnp.take_along_axis(
+            per_sub.data, outer_idx[:, None, None].astype(jnp.int32),
+            axis=1)[:, 0]
+    if pooling == "first":
+        return nested.data[:, 0, 0]
+    # max/avg/sum/sqrt over every valid element: flatten both levels
+    flat_data = nested.data.reshape((b, -1) + nested.data.shape[3:])
+    mask = nested.inner_mask().reshape(b, -1)
+    # reuse the flat kernels via a pseudo SequenceBatch sorted mask? the
+    # mask is not a prefix, so compute directly
+    m = mask.reshape(mask.shape + (1,) * (flat_data.ndim - 2))
+    if pooling == "max":
+        out = jnp.max(jnp.where(m > 0, flat_data, _NEG), axis=1)
+        return jnp.where((jnp.sum(mask, 1) > 0)[:, None], out, 0.0)
+    total = jnp.sum(flat_data * m, axis=1)
+    n = jnp.maximum(jnp.sum(mask, axis=1), 1.0)[:, None]
+    if pooling in ("avg", "average"):
+        return total / n
+    if pooling == "sqrt":
+        return total / jnp.sqrt(n)
+    if pooling == "sum":
+        return total
+    raise ValueError(f"unsupported nested pooling {pooling!r}")

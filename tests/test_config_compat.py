@@ -310,3 +310,26 @@ def test_protostr_golden_interface(cfg_name):
     # cost layers: golden size 1 == ours 1; feature outputs match exactly
     assert our_sizes == golden_sizes, (
         f"output sizes {our_sizes} != golden {golden_sizes}")
+
+
+_GSERVER_DIR = f"{REFERENCE}/paddle/gserver/tests"
+
+
+def _gserver_configs():
+    if not os.path.isdir(_GSERVER_DIR):
+        return []
+    import glob
+    return sorted(os.path.basename(p)
+                  for p in glob.glob(f"{_GSERVER_DIR}/*.conf"))
+
+
+@pytest.mark.skipif(not os.path.isdir(_GSERVER_DIR),
+                    reason="reference checkout not present")
+@pytest.mark.parametrize("cfg_name", _gserver_configs())
+def test_gserver_config_sweep(cfg_name, monkeypatch):
+    """The reference's gserver C++-test configs (concat pairs, conv/pool
+    pairs, layer groups, hierarchical RNNs) also compile unchanged; their
+    provider paths are relative to the reference's paddle/ dir."""
+    monkeypatch.chdir(f"{REFERENCE}/paddle")
+    parsed = parse_config(f"{_GSERVER_DIR}/{cfg_name}", "")
+    assert parsed.outputs, cfg_name

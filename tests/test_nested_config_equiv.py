@@ -1,0 +1,151 @@
+"""The reference's own hierarchical-RNN equivalence suite, end to end:
+gserver/tests/sequence_nest_rnn.conf vs sequence_rnn.conf executed UNCHANGED
+through the config compiler + PyDataProvider2 shim + nested scan engine,
+with outputs and gradients compared — the test
+gserver/tests/test_RecurrentGradientMachine.cpp runs against the C++
+machine, reproduced against ours."""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.compat import parse_config
+from paddle_tpu.core.sequence import (NestedSequenceBatch, SequenceBatch,
+                                      pad_nested_sequences, pad_sequences)
+from paddle_tpu.layers.graph import Topology, value_data
+
+REFERENCE = os.environ.get("PADDLE_REFERENCE_DIR", "/root/reference")
+GSERVER = f"{REFERENCE}/paddle/gserver/tests"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(f"{GSERVER}/sequence_nest_rnn.conf"),
+    reason="reference checkout not present")
+
+# the provider's fixture data (rnn_data_provider.py): two samples of
+# sub-sequences of word ids + a class label
+DATA = [
+    [[[1, 3, 2], [4, 5, 2]], 0],
+    [[[0, 2], [2, 5], [0, 1, 2]], 1],
+]
+
+
+def _nested_feed():
+    nested = pad_nested_sequences(
+        [[np.asarray(sub, np.int32) for sub in d[0]] for d in DATA])
+    labels = np.asarray([[d[1]] for d in DATA], np.int32)
+    return {"word": nested, "label": labels}
+
+
+def _flat_feed():
+    flat = pad_sequences(
+        [np.concatenate([np.asarray(s, np.int32) for s in d[0]])
+         for d in DATA])
+    labels = np.asarray([[d[1]] for d in DATA], np.int32)
+    return {"word": flat, "label": labels}
+
+
+def _load(conf):
+    # the configs name provider paths relative to the reference's paddle/
+    # dir (the reference trainer's cwd)
+    cwd = os.getcwd()
+    os.chdir(f"{REFERENCE}/paddle")
+    try:
+        parsed = parse_config(f"{GSERVER}/{conf}", "")
+    finally:
+        os.chdir(cwd)
+    return Topology(list(parsed.outputs))
+
+
+def _map_params(nested_params, flat_params):
+    """Same math, different layer names: inner_rnn_state <-> rnn_state."""
+    out = dict(flat_params)
+    for fk in flat_params:
+        nk = fk.replace("rnn_state", "inner_rnn_state") \
+            if "rnn_state" in fk else fk
+        assert nk in nested_params, (fk, sorted(nested_params))
+        out[fk] = nested_params[nk]
+    return out
+
+
+def test_nest_rnn_conf_matches_flat_conf():
+    topo_n = _load("sequence_nest_rnn.conf")
+    topo_f = _load("sequence_rnn.conf")
+    params_n = topo_n.init(jax.random.PRNGKey(0))
+    params_f = _map_params(params_n, topo_f.init(jax.random.PRNGKey(1)))
+
+    def loss_n(p):
+        out = topo_n.apply(p, _nested_feed(), mode="test")
+        return jnp.mean(value_data(out))
+
+    def loss_f(p):
+        out = topo_f.apply(p, _flat_feed(), mode="test")
+        return jnp.mean(value_data(out))
+
+    ln, gn = jax.value_and_grad(loss_n)(params_n)
+    lf, gf = jax.value_and_grad(loss_f)(params_f)
+    np.testing.assert_allclose(float(ln), float(lf), rtol=1e-5)
+
+    for fk in gf:
+        nk = fk.replace("rnn_state", "inner_rnn_state") \
+            if "rnn_state" in fk else fk
+        for a, b in zip(jax.tree_util.tree_leaves(gn[nk]),
+                        jax.tree_util.tree_leaves(gf[fk])):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6,
+                err_msg=f"grad mismatch {nk} vs {fk}")
+
+
+def test_nest_rnn_conf_trains_through_cli_stack():
+    """The nested config trains through the SGD trainer with the provider's
+    own data (define_py_data_sources2 -> PyDataProvider2 sub-sequence
+    slots)."""
+    from paddle_tpu.compat import config_to_runtime
+    from paddle_tpu.trainer import SGD
+    os.chdir(f"{REFERENCE}/paddle")  # provider paths are cwd-relative
+    try:
+        parsed = parse_config(f"{GSERVER}/sequence_nest_rnn.conf", "")
+        cfg = config_to_runtime(parsed)
+        tr = SGD(cost=cfg["cost"], update_equation=cfg["optimizer"],
+                 seed=0, donate=False)
+        losses = []
+        tr.train(cfg["train_reader"], num_passes=8, log_period=0,
+                 feeding=cfg.get("feeding"),
+                 event_handler=lambda ev: losses.append(float(ev.cost))
+                 if type(ev).__name__ == "EndIteration" else None)
+    finally:
+        os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert losses and np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_nest_rnn_multi_input_matches_flat():
+    """sequence_nest_rnn_multi_input.conf vs sequence_rnn_multi_input.conf:
+    two SubsequenceInputs (raw ids + pre-embedded), in-step embedding, same
+    forward/grads as the flat twin."""
+    topo_n = _load("sequence_nest_rnn_multi_input.conf")
+    topo_f = _load("sequence_rnn_multi_input.conf")
+    params_n = topo_n.init(jax.random.PRNGKey(0))
+    params_f = _map_params(params_n, topo_f.init(jax.random.PRNGKey(1)))
+
+    def loss_n(p):
+        return jnp.mean(value_data(
+            topo_n.apply(p, _nested_feed(), mode="test")))
+
+    def loss_f(p):
+        return jnp.mean(value_data(
+            topo_f.apply(p, _flat_feed(), mode="test")))
+
+    ln, gn = jax.value_and_grad(loss_n)(params_n)
+    lf, gf = jax.value_and_grad(loss_f)(params_f)
+    np.testing.assert_allclose(float(ln), float(lf), rtol=1e-5)
+    for fk in gf:
+        nk = fk.replace("rnn_state", "inner_rnn_state") \
+            if "rnn_state" in fk else fk
+        for a, b in zip(jax.tree_util.tree_leaves(gn[nk]),
+                        jax.tree_util.tree_leaves(gf[fk])):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6,
+                err_msg=f"grad mismatch {nk} vs {fk}")
