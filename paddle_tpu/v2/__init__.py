@@ -30,6 +30,7 @@ from paddle_tpu.v2 import inference
 from paddle_tpu.v2 import reader
 from paddle_tpu.v2 import dataset
 from paddle_tpu.v2 import evaluator
+from paddle_tpu.v2 import plot
 from paddle_tpu.data import feeder as data_feeder
 # NB: paddle_tpu.data re-binds the name `provider` to the decorator
 # *function*, which shadows the submodule for `import ... as` — resolve the

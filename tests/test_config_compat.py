@@ -333,3 +333,74 @@ def test_gserver_config_sweep(cfg_name, monkeypatch):
     monkeypatch.chdir(f"{REFERENCE}/paddle")
     parsed = parse_config(f"{_GSERVER_DIR}/{cfg_name}", "")
     assert parsed.outputs, cfg_name
+
+
+# ------------------------------------------------- network-compare pairs
+
+_COMPARE_PAIRS = [
+    ("concat_dotmul_a.conf", "concat_dotmul_b.conf"),
+    ("concat_fullmatrix_a.conf", "concat_fullmatrix_b.conf"),
+    ("concat_table_a.conf", "concat_table_b.conf"),
+    ("img_pool_a.conf", "img_pool_b.conf"),
+    # img_conv_a/b excluded: the b-side realizes conv biases as a
+    # full-width mixed bias while the a-side conv uses per-channel shared
+    # biases — same math family, different parameter layout by design
+]
+
+
+@pytest.mark.skipif(not os.path.isdir(_GSERVER_DIR),
+                    reason="reference checkout not present")
+@pytest.mark.parametrize("conf_a,conf_b", _COMPARE_PAIRS,
+                         ids=[a.replace("_a.conf", "") for a, _ in
+                              _COMPARE_PAIRS])
+def test_network_compare_pairs(conf_a, conf_b, monkeypatch, np_rng):
+    """The reference's test_NetworkCompare discipline: each a/b config pair
+    expresses the same computation two ways (layers vs projections, cudnn
+    vs plain); with shared parameter values their outputs must match."""
+    import jax
+    from paddle_tpu.layers.graph import Topology, value_data
+
+    monkeypatch.chdir(f"{REFERENCE}/paddle")
+
+    def build(conf):
+        parsed = parse_config(f"{_GSERVER_DIR}/{conf}", "")
+        return Topology(list(parsed.outputs))
+
+    topo_a, topo_b = build(conf_a), build(conf_b)
+    params_a = topo_a.init(jax.random.PRNGKey(0))
+    params_b = topo_b.init(jax.random.PRNGKey(1))
+    # the two formulations name layers differently (fc vs one-part mixed):
+    # map parameter values POSITIONALLY over same-shaped leaves, the way
+    # the reference's compareNetwork copies para_a -> para_b by index
+    leaves_a = [l for _, l in sorted(
+        jax.tree_util.tree_flatten_with_path(params_a)[0],
+        key=lambda kv: jax.tree_util.keystr(kv[0]))]
+    flat_b = sorted(jax.tree_util.tree_flatten_with_path(params_b)[0],
+                    key=lambda kv: jax.tree_util.keystr(kv[0]))
+    assert len(leaves_a) == len(flat_b), (conf_a, conf_b)
+    mapped = {}
+    for (path, leaf_b), leaf_a in zip(flat_b, leaves_a):
+        assert leaf_a.shape == leaf_b.shape, (
+            f"{jax.tree_util.keystr(path)}: {leaf_a.shape} vs {leaf_b.shape}")
+        mapped[path] = leaf_a
+    params_b = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: mapped[path], params_b)
+
+    feed = {}
+    for name, node in topo_a.data_layers.items():
+        if node.is_seq:
+            from paddle_tpu.core.sequence import pad_sequences
+            feed[name] = pad_sequences(
+                [np_rng.randint(0, node.size, (4,)) for _ in range(2)])
+        else:
+            feed[name] = np_rng.randn(2, node.size).astype(np.float32)
+
+    out_a = topo_a.apply(params_a, feed, mode="test")
+    out_b = topo_b.apply(params_b, feed, mode="test")
+    fa = [np.asarray(value_data(v)) for v in
+          (out_a if isinstance(out_a, tuple) else (out_a,))]
+    fb = [np.asarray(value_data(v)) for v in
+          (out_b if isinstance(out_b, tuple) else (out_b,))]
+    assert len(fa) == len(fb)
+    for a, b in zip(fa, fb):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)

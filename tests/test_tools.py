@@ -87,3 +87,20 @@ def test_preprocess_img_roundtrip(tmp_path):
     x, y = rows[0]
     assert x.shape == (16 * 16 * 3,) and y in (0, 1)
     assert np.isfinite(x).all()
+
+
+def test_v2_ploter(tmp_path, monkeypatch):
+    """paddle.v2.plot.Ploter (reference v2/plot/plot.py): append named
+    curves, plot to a file headless, DISABLE_PLOT short-circuits."""
+    from paddle_tpu.v2.plot import Ploter
+    p = Ploter("train_cost", "test_cost")
+    for i in range(5):
+        p.append("train_cost", i, 1.0 / (i + 1))
+        p.append("test_cost", i, 1.2 / (i + 1))
+    out = tmp_path / "curves.png"
+    p.plot(path=str(out))
+    assert out.exists() and out.stat().st_size > 0
+    monkeypatch.setenv("DISABLE_PLOT", "True")
+    p.plot()          # prints instead of plotting; no error
+    p.reset()
+    assert not p.__plot_data__["train_cost"].step
