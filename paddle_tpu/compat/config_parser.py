@@ -29,6 +29,51 @@ for _name, _val in (("xrange", range), ("unicode", str),
         setattr(builtins, _name, _val)
 
 
+def _py2_rewrite(src: str) -> str:
+    """Textual py2 idioms the reference demo helpers use (dict.iteritems in
+    seqToseq_net.py:83 etc.); py3 equivalents are drop-in here."""
+    return (src.replace(".iteritems()", ".items()")
+               .replace(".itervalues()", ".values()")
+               .replace(".iterkeys()", ".keys()"))
+
+
+class _Py2SourceLoader(importlib.machinery.SourceFileLoader):
+    def get_data(self, path):
+        if str(path).endswith(".py"):
+            with open(path, "r") as f:
+                return _py2_rewrite(f.read()).encode()
+        return super().get_data(path)
+
+    def get_code(self, fullname):
+        # bypass the bytecode cache (it would hold the UN-rewritten code)
+        source = self.get_data(self.get_filename(fullname))
+        return compile(source, self.get_filename(fullname), "exec")
+
+
+class _Py2ConfigDirFinder:
+    """While a v1 config parses, sibling imports from its directory
+    (`from seqToseq_net import *`) load through the py2 rewrite.  Loaded
+    names are recorded so parse_config can evict them afterwards — two
+    demos both importing a sibling called `seqToseq_net` must not share a
+    cached module."""
+
+    def __init__(self, config_dir):
+        self.config_dir = config_dir
+        self.loaded = []
+
+    def find_spec(self, name, path=None, target=None):
+        # config dir first, then its parent (the reference demos do
+        # sys.path.append('..') to share helpers like seqToseq_net.py)
+        base = name.split(".")[-1] + ".py"
+        for d in (self.config_dir, os.path.dirname(self.config_dir)):
+            cand = os.path.join(d, base)
+            if os.path.exists(cand):
+                self.loaded.append(name)
+                return importlib.util.spec_from_file_location(
+                    name, cand, loader=_Py2SourceLoader(name, cand))
+        return None
+
+
 class ParseContext:
     def __init__(self, config_args=None, config_dir="."):
         self.config_args = dict(config_args or {})
@@ -72,12 +117,19 @@ def _import_provider(module, config_dir):
     different demos (every demo calls its module 'dataprovider') don't
     collide in sys.modules; the config dir goes on sys.path during exec so
     sibling imports (mnist_provider -> mnist_util) resolve."""
-    path = os.path.join(config_dir, module.replace(".", os.sep) + ".py")
-    if os.path.exists(path):
-        key = f"_ptpu_provider_{abs(hash(config_dir))}_{module}"
+    rel = module.replace(".", os.sep) + ".py"
+    # config dir, then its parent (demos share providers one level up via
+    # sys.path.append('..'), e.g. seqToseq/translation -> seqToseq)
+    path = next((p for p in (os.path.join(config_dir, rel),
+                             os.path.join(os.path.dirname(config_dir), rel))
+                 if os.path.exists(p)), None)
+    if path is not None:
+        key = f"_ptpu_provider_{abs(hash(os.path.dirname(path)))}_{module}"
         if key in sys.modules:
             return sys.modules[key]
-        spec = importlib.util.spec_from_file_location(key, path)
+        # providers are py2-era too: load through the rewrite
+        spec = importlib.util.spec_from_file_location(
+            key, path, loader=_Py2SourceLoader(key, path))
         mod = importlib.util.module_from_spec(spec)
         sys.modules[key] = mod
         added = False
@@ -157,12 +209,20 @@ def parse_config(config_file, config_arg_str="") -> ParsedConfig:
         if config_dir not in sys.path:
             sys.path.insert(0, config_dir)
             added_path = True
-        src = open(config_file).read()
+        finder = _Py2ConfigDirFinder(config_dir)
+        sys.meta_path.insert(0, finder)
+        src = _py2_rewrite(open(config_file).read())
         ns = {"__file__": os.path.abspath(config_file),
               "__name__": "__paddle_tpu_config__"}
         code = compile(src, config_file, "exec")
         exec(code, ns)
     finally:
+        try:
+            sys.meta_path.remove(finder)
+            for name in finder.loaded:
+                sys.modules.pop(name, None)
+        except ValueError:
+            pass
         _ACTIVE.pop()
         if added_path:
             sys.path.remove(config_dir)
