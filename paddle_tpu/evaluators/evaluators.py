@@ -55,6 +55,13 @@ class SumEvaluator(Evaluator):
         return {"sum": jnp.zeros(()), "total": jnp.zeros(())}
 
     def update(self, state, value=None, weight=None, **_):
+        # sequence-valued inputs (e.g. crf_decoding error indicators): sum
+        # valid positions only
+        if hasattr(value, "lengths"):
+            d = value.data.reshape(value.data.shape[0],
+                                   value.data.shape[1], -1)
+            d = d * value.mask(d.dtype)[..., None]
+            value = d.reshape(d.shape[0], -1).sum(-1)
         w = jnp.ones(value.shape[0]) if weight is None else weight.reshape(-1)
         return {"sum": state["sum"] + jnp.sum(value.reshape(value.shape[0], -1).sum(-1) * w),
                 "total": state["total"] + jnp.sum(w)}

@@ -1214,11 +1214,15 @@ class _CrfImpl:
 register_layer("crf")(_CrfImpl)
 
 
-def crf_layer(input, label, size=None, param_attr=None, name=None):
+def crf_layer(input, label, size=None, param_attr=None, name=None,
+              weight=None, layer_attr=None):
     n = size or input.size
+    # transition weights share by ParamAttr(name=...) like any layer
+    # (reference: crf + crf_decoding share 'crfw')
+    pa_name = param_attr.get("name") if isinstance(param_attr, dict) else None
     return LayerOutput(name or auto_name("crf"), "crf", 1, [input, label],
                        {"size": n, "param_attr": param_attr,
-                        "param_name": name or auto_name("crf_w")},
+                        "param_name": pa_name or name or auto_name("crf_w")},
                        is_seq=False)
 
 
@@ -1231,9 +1235,17 @@ class _CrfDecodingImpl:
         return {"w": _winit(cfg.get("param_attr"), default_std=0.1)(
             rng, (n + 2, n))}
 
-    def apply(self, ctx, cfg, params, emissions):
+    def apply(self, ctx, cfg, params, emissions, label=None):
         sb = as_seq(emissions)
         tags, _ = crf_ops.crf_decode(sb.data, sb.lengths, params["w"])
+        if label is not None:
+            # reference CRFDecodingLayer with a label input emits the
+            # per-position 0/1 error indicator instead of the tags
+            lab = as_seq(label)
+            ld = lab.data.reshape(lab.data.shape[0], lab.data.shape[1], -1)
+            err = (tags != ld[..., 0]).astype(jnp.float32)
+            err = err * sb.mask(jnp.float32)
+            return SequenceBatch(data=err[..., None], lengths=sb.lengths)
         return SequenceBatch(data=tags[..., None], lengths=sb.lengths)
 
 
@@ -1241,14 +1253,17 @@ register_layer("crf_decoding")(_CrfDecodingImpl)
 
 
 def crf_decoding_layer(input, size=None, label=None, param_attr=None,
-                       name=None, param_name=None):
-    """param_name lets decode share the CRF weight learned by crf_layer."""
+                       name=None, param_name=None, layer_attr=None):
+    """param_name (or ParamAttr(name=...)) lets decode share the CRF weight
+    learned by crf_layer."""
     n = size or input.size
     cfg = {"size": n, "param_attr": param_attr}
-    if param_name:
-        cfg["param_name"] = param_name
+    pa_name = param_attr.get("name") if isinstance(param_attr, dict) else None
+    if param_name or pa_name:
+        cfg["param_name"] = param_name or pa_name
+    ins = [input] + ([label] if label is not None else [])
     return LayerOutput(name or auto_name("crf_decoding"), "crf_decoding", 1,
-                       [input], cfg, is_seq=True)
+                       ins, cfg, is_seq=True)
 
 
 def _ctc_cost(ctx, cfg, probs, label):
