@@ -28,13 +28,43 @@ for _name, _val in (("xrange", range), ("unicode", str),
     if not hasattr(builtins, _name):
         setattr(builtins, _name, _val)
 
+# py2 module names some providers import
+import pickle as _pickle  # noqa: E402
+
+sys.modules.setdefault("cPickle", _pickle)
+
+
+def _py2_map(*a):
+    return list(map(*a))
+
+
+def _py2_filter(*a):
+    return list(filter(*a))
+
+
+_PY2_PRELUDE = ("from paddle_tpu.compat.config_parser import "
+                "_py2_map as map, _py2_filter as filter\n")
+
 
 def _py2_rewrite(src: str) -> str:
     """Textual py2 idioms the reference demo helpers use (dict.iteritems in
-    seqToseq_net.py:83 etc.); py3 equivalents are drop-in here."""
-    return (src.replace(".iteritems()", ".items()")
-               .replace(".itervalues()", ".values()")
-               .replace(".iterkeys()", ".keys()"))
+    seqToseq_net.py:83, f.next(), sys.maxint, list-returning map/filter in
+    traffic_prediction/dataprovider.py); py3 equivalents are drop-in.  The
+    prelude shadows map/filter with list-returning versions — a strict
+    superset of the py3 behavior for these scripts."""
+    out = (src.replace(".iteritems()", ".items()")
+              .replace(".itervalues()", ".values()")
+              .replace(".iterkeys()", ".keys()")
+              .replace(".next()", ".__next__()")
+              .replace("sys.maxint", "sys.maxsize"))
+    if "__future__" in out:
+        # __future__ imports must stay first: inject after the last one
+        lines = out.split("\n")
+        last = max(i for i, ln in enumerate(lines)
+                   if ln.lstrip().startswith("from __future__"))
+        lines.insert(last + 1, _PY2_PRELUDE.rstrip("\n"))
+        return "\n".join(lines)
+    return _PY2_PRELUDE + out
 
 
 class _Py2SourceLoader(importlib.machinery.SourceFileLoader):

@@ -529,3 +529,85 @@ def test_srl_db_lstm_config_unchanged(in_tmp):
     cfg = config_to_runtime(parsed)
     costs = _train_batches(cfg, n_batches=1, num_passes=1)
     assert np.isfinite(costs).all()
+
+
+@pytest.mark.skipif(
+    not os.path.exists(
+        f"{REFERENCE}/demo/image_classification/vgg_16_cifar.py"),
+    reason="reference checkout not present")
+def test_cifar_vgg_config_parses_and_steps(in_tmp, np_rng):
+    """demo/image_classification/vgg_16_cifar.py builds its graph verbatim
+    (small_vgg over 3x32x32) and takes a fwd+bwd step on synthetic images.
+    (The demo's jpeg/cPickle provider is py2+PIL legacy; data comes from a
+    fixture feed.)"""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.layers.graph import Topology, value_data
+
+    parsed = parse_config(
+        f"{REFERENCE}/demo/image_classification/vgg_16_cifar.py", "")
+    assert parsed.settings["batch_size"] == 128
+    topo = Topology(list(parsed.outputs))
+    params = topo.init(jax.random.PRNGKey(0))
+    feed = {"image": np_rng.randn(4, 3 * 32 * 32).astype(np.float32),
+            "label": np_rng.randint(0, 10, (4, 1)).astype(np.int32)}
+
+    def loss(p):
+        return jnp.mean(value_data(topo.apply(p, feed, mode="test")))
+
+    l, g = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(l))
+    assert all(np.isfinite(np.asarray(x)).all()
+               for x in jax.tree_util.tree_leaves(g))
+
+    # predict mode: graph ends in softmax probabilities
+    pred = parse_config(
+        f"{REFERENCE}/demo/image_classification/vgg_16_cifar.py",
+        "is_predict=true")
+    assert len(pred.outputs) == 1
+
+
+@pytest.mark.skipif(
+    not os.path.exists(f"{REFERENCE}/demo/introduction/trainer_config.py"),
+    reason="reference checkout not present")
+def test_introduction_config_learns_line(in_tmp):
+    """demo/introduction: y = 2x - 0.3 linear regression — the reference's
+    hello-world — trains verbatim with its own dataprovider and converges
+    toward the true weights."""
+    import shutil
+    shutil.copy(f"{REFERENCE}/demo/introduction/dataprovider.py",
+                in_tmp / "dataprovider.py")
+    parsed = parse_config(
+        f"{REFERENCE}/demo/introduction/trainer_config.py", "")
+    # hack: provider module lives in cwd; config_dir is the reference dir —
+    # copy above puts it where the parse context's sys.path covers? the
+    # reference keeps dataprovider NEXT TO the config, so parse from a
+    # local copy instead:
+    shutil.copy(f"{REFERENCE}/demo/introduction/trainer_config.py",
+                in_tmp / "trainer_config.py")
+    parsed = parse_config(str(in_tmp / "trainer_config.py"), "")
+    cfg = config_to_runtime(parsed)
+    costs = _train_batches(cfg, n_batches=60, num_passes=4)
+    assert costs[-1] < costs[0]
+
+
+@pytest.mark.skipif(
+    not os.path.exists(
+        f"{REFERENCE}/demo/traffic_prediction/trainer_config.py"),
+    reason="reference checkout not present")
+def test_traffic_prediction_config_unchanged(in_tmp):
+    """demo/traffic_prediction/trainer_config.py: 24 shared-weight
+    multi-task heads over speed windows — trains verbatim with its own
+    provider (f.next() py2-ism shimmed) on fixture CSV."""
+    rng = np.random.RandomState(0)
+    speeds = ",".join(str(int(v)) for v in rng.randint(1, 5, 120))
+    _write(in_tmp / "data" / "speeds.csv",
+           "link_id,speeds\n" + f"1,{speeds}\n2,{speeds}\n")
+    _write(in_tmp / "data" / "train.list", "data/speeds.csv\n")
+    _write(in_tmp / "data" / "test.list", "data/speeds.csv\n")
+    parsed = parse_config(
+        f"{REFERENCE}/demo/traffic_prediction/trainer_config.py", "")
+    cfg = config_to_runtime(parsed)
+    costs = _train_batches(cfg, n_batches=1, num_passes=1)
+    assert costs, "provider yielded no batches"
+    assert np.isfinite(costs).all()
