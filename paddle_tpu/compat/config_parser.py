@@ -42,29 +42,22 @@ def _py2_filter(*a):
     return list(filter(*a))
 
 
-_PY2_PRELUDE = ("from paddle_tpu.compat.config_parser import "
-                "_py2_map as map, _py2_filter as filter\n")
+# Module-global shadows for the py2 list-returning builtins; seeded into the
+# executing namespace (globals win over builtins) rather than injected into
+# the source, so line numbers and the module docstring are untouched.
+_PY2_GLOBALS = {"map": _py2_map, "filter": _py2_filter}
 
 
 def _py2_rewrite(src: str) -> str:
     """Textual py2 idioms the reference demo helpers use (dict.iteritems in
     seqToseq_net.py:83, f.next(), sys.maxint, list-returning map/filter in
-    traffic_prediction/dataprovider.py); py3 equivalents are drop-in.  The
-    prelude shadows map/filter with list-returning versions — a strict
-    superset of the py3 behavior for these scripts."""
-    out = (src.replace(".iteritems()", ".items()")
-              .replace(".itervalues()", ".values()")
-              .replace(".iterkeys()", ".keys()")
-              .replace(".next()", ".__next__()")
-              .replace("sys.maxint", "sys.maxsize"))
-    if "__future__" in out:
-        # __future__ imports must stay first: inject after the last one
-        lines = out.split("\n")
-        last = max(i for i, ln in enumerate(lines)
-                   if ln.lstrip().startswith("from __future__"))
-        lines.insert(last + 1, _PY2_PRELUDE.rstrip("\n"))
-        return "\n".join(lines)
-    return _PY2_PRELUDE + out
+    traffic_prediction/dataprovider.py); py3 equivalents are drop-in.  Pure
+    same-length-line replaces: tracebacks still point at the file on disk."""
+    return (src.replace(".iteritems()", ".items()")
+               .replace(".itervalues()", ".values()")
+               .replace(".iterkeys()", ".keys()")
+               .replace(".next()", ".__next__()")
+               .replace("sys.maxint", "sys.maxsize"))
 
 
 class _Py2SourceLoader(importlib.machinery.SourceFileLoader):
@@ -78,6 +71,10 @@ class _Py2SourceLoader(importlib.machinery.SourceFileLoader):
         # bypass the bytecode cache (it would hold the UN-rewritten code)
         source = self.get_data(self.get_filename(fullname))
         return compile(source, self.get_filename(fullname), "exec")
+
+    def exec_module(self, module):
+        module.__dict__.update(_PY2_GLOBALS)
+        super().exec_module(module)
 
 
 class _Py2ConfigDirFinder:
@@ -243,7 +240,7 @@ def parse_config(config_file, config_arg_str="") -> ParsedConfig:
         sys.meta_path.insert(0, finder)
         src = _py2_rewrite(open(config_file).read())
         ns = {"__file__": os.path.abspath(config_file),
-              "__name__": "__paddle_tpu_config__"}
+              "__name__": "__paddle_tpu_config__", **_PY2_GLOBALS}
         code = compile(src, config_file, "exec")
         exec(code, ns)
     finally:

@@ -29,6 +29,22 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30
 
+# Mosaic requires the last block dim be a multiple of 128 (the VPU lane
+# count) or the whole array dim; per-row statistics (running max/sum, lse,
+# delta) therefore live lane-REPLICATED in [rows, _LANES] tiles — the same
+# layout jax.experimental.pallas.ops.tpu.flash_attention uses.
+_LANES = 128
+
+
+def _lanes(x, n):
+    """[rows, _LANES] lane-replicated -> [rows, n] (n <= _LANES slices,
+    multiples of _LANES tile)."""
+    if n == _LANES:
+        return x
+    if n < _LANES:
+        return x[:, :n]
+    return jnp.tile(x, (1, n // _LANES))
+
 
 # ------------------------------------------------------------------ forward
 
@@ -37,6 +53,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
+    d = q_ref.shape[-1]
 
     @pl.when(ki == 0)
     def _():
@@ -62,21 +79,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             cols = jax.lax.broadcasted_iota(
                 jnp.int32, (blk_q, blk_k), 1) + ki * blk_k
             s = jnp.where(rows >= cols, s, _NEG)
-        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_prev, l_prev = m_scr[:], l_scr[:]          # [blk_q, _LANES]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - _lanes(m_new, blk_k))
+        alpha = jnp.exp(m_prev - m_new)              # [blk_q, _LANES]
         m_scr[:] = m_new
         l_scr[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        acc_scr[:] = acc_scr[:] * _lanes(alpha, d) + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
     def _():
         l = jnp.maximum(l_scr[:], 1e-30)
-        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+        o_ref[0] = (acc_scr[:] / _lanes(l, d)).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l)           # lane-replicated
 
 
 def _fwd(q, k, v, scale, causal, blk_q, blk_k, interpret):
@@ -95,20 +112,20 @@ def _fwd(q, k, v, scale, causal, blk_q, blk_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, blk_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq, _LANES), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((blk_q, 1), jnp.float32),
-            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, _LANES), jnp.float32),
+            pltpu.VMEM((blk_q, _LANES), jnp.float32),
             pltpu.VMEM((blk_q, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
-    return o, lse
+    return o, lse[:, :, 0]
 
 
 # ----------------------------------------------------------------- backward
@@ -133,8 +150,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0]                              # [blk_q, _LANES]
+        delta = delta_ref[0]                          # [blk_q, _LANES]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [blk_q, blk_k]
@@ -144,14 +161,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = jax.lax.broadcasted_iota(
                 jnp.int32, (blk_q, blk_k), 1) + ki * blk_k
             s = jnp.where(rows >= cols, s, _NEG)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - _lanes(lse, blk_k))
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # [blk_k, d]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [blk_q, blk_k]
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - _lanes(delta, blk_k)) * scale
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # [blk_k, d]
@@ -180,8 +197,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0]                              # [blk_q, _LANES]
+        delta = delta_ref[0]                          # [blk_q, _LANES]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -191,11 +208,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             cols = jax.lax.broadcasted_iota(
                 jnp.int32, (blk_q, blk_k), 1) + ki * blk_k
             s = jnp.where(rows >= cols, s, _NEG)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - _lanes(lse, blk_k))
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - _lanes(delta, blk_k)) * scale
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -211,6 +228,9 @@ def _bwd(scale, causal, blk_q, blk_k, interpret, res, g):
     tk = k.shape[1]
     do = g
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # lane-replicated [bh, t, _LANES] views for the kernels (see _LANES note)
+    lse_r = jnp.broadcast_to(lse[:, :, None], (bh, tq, _LANES))
+    delta_r = jnp.broadcast_to(delta[:, :, None], (bh, tq, _LANES))
 
     dkv_kernel = functools.partial(_bwd_dkv_kernel, blk_q=blk_q,
                                    blk_k=blk_k, scale=scale, causal=causal)
@@ -222,8 +242,8 @@ def _bwd(scale, causal, blk_q, blk_k, interpret, res, g):
             pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, blk_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, blk_q, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, blk_q, _LANES), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0)),
@@ -238,7 +258,7 @@ def _bwd(scale, causal, blk_q, blk_k, interpret, res, g):
             pltpu.VMEM((blk_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse_r, delta_r)
 
     dq_kernel = functools.partial(_bwd_dq_kernel, blk_q=blk_q, blk_k=blk_k,
                                   scale=scale, causal=causal)
@@ -250,14 +270,14 @@ def _bwd(scale, causal, blk_q, blk_k, interpret, res, g):
             pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, blk_q, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse_r, delta_r)
     return dq, dk, dv
 
 
@@ -277,13 +297,17 @@ def _flash_fwd_rule(q, k, v, scale, causal, blk_q, blk_k, interpret):
 _flash_bhtd.defvjp(_flash_fwd_rule, _bwd)
 
 
-def flash_attention(q, k, v, scale=None, causal=False, block_q=128,
-                    block_k=128, interpret=None):
+def flash_attention(q, k, v, scale=None, causal=False, block_q=512,
+                    block_k=512, interpret=None):
     """q: [B, H, Tq, D], k/v: [B, H, Tk, D] -> [B, H, Tq, D].
 
     Fast path requires Tq/Tk to be multiples of the block size (the model
     zoo pads/buckets sequences to 128-multiples for exactly this reason);
     other shapes fall back to the masked XLA implementation.
+
+    Default 512x512 blocks: measured on a v5e chip at T=8192 causal they
+    run 5x faster than 128x128 (grid-overhead-bound) and 2.1x faster than
+    XLA's materialized attention — see docs/perf.md.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -291,10 +315,29 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=128,
     tk = k.shape[2]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
-    blk_q = min(block_q, tq)
-    blk_k = min(block_k, tk)
-    # causal block indexing assumes aligned sequence starts (tq == tk)
-    if (causal and tq != tk) or tq % blk_q or tk % blk_k:
+    def _tileable(n):
+        # _lanes() can slice (n < _LANES) or tile (n % _LANES == 0)
+        return n <= _LANES or n % _LANES == 0
+
+    def _pick_block(want, n):
+        """Largest b <= want that divides n, is 8-sublane-divisible and
+        lane-tileable; halve from `want` so a 128-multiple sequence that is
+        not a 512-multiple (e.g. T=640) still gets the flash path with
+        smaller blocks instead of the materialized-O(T^2) fallback."""
+        b = min(want, n)
+        while b >= 8:
+            if n % b == 0 and b % 8 == 0 and _tileable(b):
+                return b
+            b //= 2
+        return None
+
+    blk_q = _pick_block(block_q, tq)
+    blk_k = _pick_block(block_k, tk)
+
+    # causal block indexing assumes aligned sequence starts (tq == tk);
+    # head width must be lane-tileable for the replicated-stat layout
+    if (causal and tq != tk) or blk_q is None or blk_k is None \
+            or not _tileable(d):
         from paddle_tpu.ops import attention as attn
         return attn.dot_product_attention(q, k, v, scale=scale,
                                           causal=causal, use_flash=False)

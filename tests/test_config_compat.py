@@ -575,14 +575,11 @@ def test_introduction_config_learns_line(in_tmp):
     hello-world — trains verbatim with its own dataprovider and converges
     toward the true weights."""
     import shutil
+    # the reference keeps dataprovider.py NEXT TO the config (provider
+    # imports resolve relative to config_dir), so parse a local copy of
+    # both files together
     shutil.copy(f"{REFERENCE}/demo/introduction/dataprovider.py",
                 in_tmp / "dataprovider.py")
-    parsed = parse_config(
-        f"{REFERENCE}/demo/introduction/trainer_config.py", "")
-    # hack: provider module lives in cwd; config_dir is the reference dir —
-    # copy above puts it where the parse context's sys.path covers? the
-    # reference keeps dataprovider NEXT TO the config, so parse from a
-    # local copy instead:
     shutil.copy(f"{REFERENCE}/demo/introduction/trainer_config.py",
                 in_tmp / "trainer_config.py")
     parsed = parse_config(str(in_tmp / "trainer_config.py"), "")
