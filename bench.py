@@ -17,7 +17,8 @@ broken chip is distinguishable from a broken framework.  MFU is estimated
 from analytic model FLOPs and the chip's peak (device_kind table below).
 
 Env overrides: BENCH_MODEL=lstm|lstm256|lstm1280|resnet50|alexnet|googlenet|
-smallnet, BENCH_STEPS, BENCH_BATCH, BENCH_INIT_TIMEOUT, BENCH_COMPILE_TIMEOUT,
+smallnet|seq2seq (seq2seq reports tokens/sec — the reference never shipped
+its NMT row), BENCH_STEPS, BENCH_BATCH, BENCH_INIT_TIMEOUT, BENCH_COMPILE_TIMEOUT,
 BENCH_STEP_TIMEOUT (seconds), BENCH_PEAK_TFLOPS (override peak), and
 BENCH_PLATFORM (e.g. cpu to force a platform for local testing).
 """
@@ -245,8 +246,57 @@ def bench_image(model_name, batch, baseline_ms, fwd_flops_per_image,
         f"{model_name} train ms/batch bs={batch} ({image_hw}x{image_hw})")
 
 
+def bench_seq2seq(batch=64, src_len=30, trg_len=30, vocab=30000, hidden=512):
+    """Attention-NMT train step (demo/seqToseq scale: vocab 30k, emb=h=512).
+    The reference's benchmark README declares this row 'will be added later'
+    (benchmark/README.md:141,168) and never shipped it — no baseline_ms;
+    tokens/sec is the headline number here."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models import seq2seq
+    from paddle_tpu import optim
+
+    h = e = hidden
+    params = seq2seq.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                          trg_vocab=vocab, emb_dim=e, hidden=h)
+    opt = optim.Momentum(learning_rate=0.01, momentum=0.9)
+    opt_state = opt.init(params)
+
+    rng = np.random.RandomState(0)
+    src = SequenceBatch(
+        data=jnp.asarray(rng.randint(3, vocab, (batch, src_len)), jnp.int32),
+        lengths=jnp.full((batch,), src_len, jnp.int32))
+    trg = SequenceBatch(
+        data=jnp.asarray(rng.randint(3, vocab, (batch, trg_len)), jnp.int32),
+        lengths=jnp.full((batch,), trg_len, jnp.int32))
+
+    @jax.jit
+    def step(params, opt_state, src, trg):
+        loss, grads = jax.value_and_grad(seq2seq.loss)(params, src, trg, trg)
+        new_params, new_opt = opt.update(grads, opt_state, params)
+        return new_params, new_opt, loss
+
+    def run(s):
+        nonlocal params, opt_state
+        params, opt_state, loss = step(params, opt_state, src, trg)
+        return loss
+
+    # analytic matmul FLOPs, fwd (see models/seq2seq.py dims); train ~= 3x
+    B, Ts, Tt, V = batch, src_len, trg_len, vocab
+    enc = 2 * 2.0 * B * Ts * (3 * e * h + 3 * h * h) + 4.0 * B * Ts * h * h
+    dec = 2.0 * B * Tt * ((e + 2 * h) * 3 * h + 4 * h * h
+                          + (4 * h + e) * h + h * V) \
+        + 2.0 * B * Tt * Ts * (h + 2 * h)
+    flops = 3.0 * (enc + dec)
+    return run, flops, None, (
+        f"seq2seq attention-NMT train ms/batch bs={batch} "
+        f"len={src_len} vocab={vocab}"), {"tokens_per_step": B * Tt}
+
+
 _BENCHES = {
     # name: (factory, default_batch)
+    "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),
     "lstm": (lambda b: bench_lstm(batch=b, hidden=512, baseline_ms=184.0), 64),
     "lstm256": (lambda b: bench_lstm(batch=b, hidden=256, baseline_ms=83.0), 64),
     "lstm1280": (lambda b: bench_lstm(batch=b, hidden=1280, baseline_ms=641.0), 64),
@@ -301,7 +351,9 @@ def main():
     # -- phase 2: build model + inputs (host-side) --
     dog.phase("build", t_init)
     try:
-        run, flops, baseline_ms, metric = factory(batch)
+        built = factory(batch)
+        run, flops, baseline_ms, metric = built[:4]
+        extras = built[4] if len(built) > 4 else {}
     except Exception as e:  # noqa: BLE001
         dog.clear()
         stub.update(error="build_failed", phase="build",
@@ -358,6 +410,8 @@ def main():
            "device": kind, "platform": platform,
            "compile_s": round(compile_s, 1), "steps": steps,
            "flops_per_step": flops}
+    if extras.get("tokens_per_step"):
+        out["tokens_per_s"] = round(extras["tokens_per_step"] / dt)
     print(json.dumps(out), flush=True)
 
 

@@ -1,0 +1,262 @@
+"""Dual-backend differential runner (SURVEY §4 pattern 1).
+
+The reference's strongest numeric tool runs every op on CpuMatrix and
+GpuMatrix and compares results within epsilon (math/tests/
+test_matrixCompare.cpp, TensorCheck.h).  The TPU-native equivalent: execute
+the SAME jitted forward + gradient for every case in the registry-driven
+layer sweep (tests/test_layer_grad_sweep.py CASES) on one backend per
+process and dump the arrays; a comparing test diffs a CPU dump against a
+TPU dump.
+
+Run (one process per platform — the platform must be pinned before any
+backend touch, and a sitecustomize hook on dev boxes overrides the env var,
+hence the explicit jax.config.update):
+
+    python -m paddle_tpu.testing.tpu_diff cpu /tmp/diff_cpu.npz
+    python -m paddle_tpu.testing.tpu_diff tpu /tmp/diff_tpu.npz
+
+Determinism across platforms: param init uses jax.random (threefry —
+platform-invariant), case inputs use seeded numpy, and matmul precision is
+forced to HIGHEST so the MXU does full-f32 passes instead of bf16x3.
+"""
+
+import os
+import sys
+import zlib
+
+
+def _pin_platform(platform):
+    # "default" = let the environment route (on axon-tunneled boxes that IS
+    # the TPU; the plugin's platform name is not "tpu", so an explicit pin
+    # would fail to init)
+    import jax
+    if platform != "default":
+        os.environ["JAX_PLATFORMS"] = platform
+        try:
+            jax.config.update("jax_platforms", platform)
+        except Exception:
+            pass
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def run_cases(only=None, out_dir=None):
+    """Build every sweep case, run forward (mode='test') + grads of the
+    scalar loss wrt all float params, return {name: {label: np.ndarray}}.
+    With out_dir, each case is written to <out_dir>/<case>.npz as it
+    completes and already-present cases are skipped (resumable — remote TPU
+    compiles make a full cold sweep take tens of minutes)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sys.path.insert(0, repo)
+    from tests.test_layer_grad_sweep import CASES, B0, T0
+    from paddle_tpu.layers.graph import Topology, reset_names, value_data
+
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    results = {}
+    for name in sorted(CASES):
+        if only and name not in only:
+            continue
+        if out_dir and os.path.exists(os.path.join(out_dir, name + ".npz")):
+            print(f"[tpu_diff] {name}: cached", file=sys.stderr, flush=True)
+            continue
+        build, _ = CASES[name]
+        reset_names()
+        r = np.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
+        outs, feed = build(r, B0, T0)
+        outs = outs if isinstance(outs, list) else [outs]
+        topo = Topology(outs)
+        params = topo.init(jax.random.PRNGKey(0))
+        # device arrays, not numpy: a numpy feed closed over by jit breaks
+        # ops that numpy-index the feed with a traced array (conv_shift)
+        feed = jax.tree_util.tree_map(jnp.asarray, feed)
+
+        def fwd(p):
+            out = topo.apply(p, feed, mode="test", rng=jax.random.PRNGKey(7))
+            vals = out if isinstance(out, tuple) else (out,)
+            return [value_data(v) for v in vals]
+
+        def loss(p):
+            return sum(jnp.mean(d.astype(jnp.float32)) for d in fwd(p))
+
+        rec = {}
+        try:
+            vals = jax.jit(fwd)(params)
+            for i, v in enumerate(vals):
+                rec[f"out{i}"] = np.asarray(v, np.float32)
+            grads = jax.jit(jax.grad(loss))(params)
+            flat, _ = jax.tree_util.tree_flatten_with_path(grads)
+            for path, g in flat:
+                if np.issubdtype(np.asarray(g).dtype, np.floating):
+                    rec["grad" + jax.tree_util.keystr(path)] = (
+                        np.asarray(g, np.float32))
+        except Exception as e:   # record, don't abort the sweep
+            rec["__error__"] = np.frombuffer(
+                f"{type(e).__name__}: {e}"[:500].encode(), np.uint8)
+        results[name] = rec
+        if out_dir:
+            np.savez_compressed(os.path.join(out_dir, name + ".npz"), **rec)
+        print(f"[tpu_diff] {name}: {len(rec)} arrays", file=sys.stderr,
+              flush=True)
+    return results
+
+
+def run_optimizer_cases(out_dir=None):
+    """Differential coverage for the optimizer zoo (reference
+    math/tests/test_TrainingAlgorithm.cpp compares each update kernel
+    CPU-vs-GPU): run 5 chained updates of every optimizer on seeded
+    params/grads and dump the resulting params + slots."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import optim
+
+    mk = {
+        "momentum": lambda: optim.Momentum(0.1, momentum=0.9),
+        "nesterov": lambda: optim.Momentum(0.1, momentum=0.9, nesterov=True),
+        "adagrad": lambda: optim.AdaGrad(0.1),
+        "adadelta": lambda: optim.AdaDelta(rho=0.95),
+        "rmsprop": lambda: optim.RMSProp(0.01),
+        "decayed_adagrad": lambda: optim.DecayedAdaGrad(0.1),
+        "adam": lambda: optim.Adam(0.01),
+        "adamax": lambda: optim.AdaMax(0.01),
+    }
+    r = np.random.RandomState(11)
+    params = {"w": jnp.asarray(r.randn(17, 9), jnp.float32),
+              "b": jnp.asarray(r.randn(9), jnp.float32)}
+    grad_seq = [jax.tree_util.tree_map(
+        lambda x, i=i: jnp.asarray(
+            np.random.RandomState(100 + i).randn(*x.shape), jnp.float32),
+        params) for i in range(5)]
+
+    results = {}
+    for name, ctor in sorted(mk.items()):
+        cname = f"optim_{name}"
+        if out_dir and os.path.exists(os.path.join(out_dir, cname + ".npz")):
+            print(f"[tpu_diff] {cname}: cached", file=sys.stderr, flush=True)
+            continue
+        rec = {}
+        try:
+            opt = ctor()
+            state = opt.init(params)
+
+            @jax.jit
+            def chain(p, s):
+                for g in grad_seq:
+                    p, s = opt.update(g, s, p)
+                return p, s
+
+            p, s = chain(params, state)
+            for k, v in jax.tree_util.tree_flatten_with_path(
+                    {"p": p, "s": s})[0]:
+                if np.issubdtype(np.asarray(v).dtype, np.floating):
+                    rec[jax.tree_util.keystr(k)] = np.asarray(v, np.float32)
+        except Exception as e:  # noqa: BLE001
+            rec["__error__"] = np.frombuffer(
+                f"{type(e).__name__}: {e}"[:500].encode(), np.uint8)
+        results[cname] = rec
+        if out_dir:
+            np.savez_compressed(os.path.join(out_dir, cname + ".npz"), **rec)
+        print(f"[tpu_diff] {cname}: {len(rec)} arrays", file=sys.stderr,
+              flush=True)
+    return results
+
+
+def consolidate(out_dir, out_path):
+    import numpy as np
+    flat = {}
+    for fn in sorted(os.listdir(out_dir)):
+        if not fn.endswith(".npz"):
+            continue
+        case = fn[:-4]
+        with np.load(os.path.join(out_dir, fn)) as z:
+            for label in z.files:
+                flat[f"{case}::{label}"] = z[label]
+    np.savez_compressed(out_path, **flat)
+    return len(flat)
+
+
+def _case_names():
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sys.path.insert(0, repo)
+    from tests.test_layer_grad_sweep import CASES
+    return sorted(CASES)
+
+
+def supervise(platform, out_path, case_timeout=150.0, max_consec_fail=4):
+    """One worker subprocess per case with a hard timeout — a wedged remote
+    TPU compile can only be killed from outside the process (it blocks in
+    C++ where no Python signal lands).  Consecutive-failure cap aborts the
+    sweep when the chip/tunnel itself is down rather than one case."""
+    import subprocess
+    import numpy as np
+    out_dir = out_path + ".d"
+    os.makedirs(out_dir, exist_ok=True)
+    consec = 0
+    names = _case_names() + ["__optim__"]
+    for name in names:
+        # marker must be the LAST file the worker writes (sorted order), or
+        # a mid-sweep kill would make resume skip the remainder
+        marker = os.path.join(
+            out_dir, (name if name != "__optim__" else "optim_rmsprop")
+            + ".npz")
+        if os.path.exists(marker):
+            continue
+        cmd = [sys.executable, "-m", "paddle_tpu.testing.tpu_diff",
+               platform, out_path, name, "--worker"]
+        try:
+            subprocess.run(cmd, timeout=case_timeout, check=True,
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL)
+            consec = 0
+        except subprocess.TimeoutExpired:
+            np.savez_compressed(
+                os.path.join(out_dir, name + ".npz"),
+                __error__=np.frombuffer(
+                    f"TimeoutExpired: worker exceeded {case_timeout}s "
+                    f"(wedged backend?)".encode(), np.uint8))
+            consec += 1
+            print(f"[tpu_diff] {name}: TIMEOUT ({case_timeout}s)",
+                  file=sys.stderr, flush=True)
+        except subprocess.CalledProcessError as e:
+            consec += 1
+            print(f"[tpu_diff] {name}: worker rc={e.returncode}",
+                  file=sys.stderr, flush=True)
+        else:
+            print(f"[tpu_diff] {name}: done", file=sys.stderr, flush=True)
+        if consec >= max_consec_fail:
+            print(f"[tpu_diff] aborting: {consec} consecutive failures "
+                  "(backend down?)", file=sys.stderr, flush=True)
+            return False
+    n = consolidate(out_dir, out_path)
+    print(f"[tpu_diff] wrote {n} arrays to {out_path}", file=sys.stderr)
+    return True
+
+
+def main():
+    platform, out_path = sys.argv[1], sys.argv[2]
+    rest = [a for a in sys.argv[3:] if a != "--worker"]
+    worker = "--worker" in sys.argv
+    only = set(rest[0].split(",")) if rest else None
+
+    if not worker:
+        ok = supervise(platform, out_path,
+                       case_timeout=float(
+                           os.environ.get("TPU_DIFF_CASE_TIMEOUT", "150")))
+        sys.exit(0 if ok else 3)
+
+    _pin_platform(platform)
+    out_dir = out_path + ".d"
+    if only == {"__optim__"}:
+        run_optimizer_cases(out_dir=out_dir)
+    else:
+        run_cases(only, out_dir=out_dir)
+
+
+if __name__ == "__main__":
+    main()
