@@ -1,0 +1,297 @@
+"""Fused whole-sequence LSTM (Pallas) — the TPU-native answer to the
+reference's fused CUDA LSTM kernels (cuda/src/hl_cuda_lstm.cu +
+hl_lstm_ops.cuh:46-66, dispatched from LstmCompute).
+
+Why a kernel when lax.scan works: XLA's scan round-trips the carry (h, c)
+and the per-step gate tensor through HBM every timestep and re-fetches the
+recurrent weights.  Here the grid IS the time loop (TPU grids execute
+sequentially per core, the same property the flash-attention kernel uses):
+w_r and the peephole vectors stay resident in VMEM across all T steps,
+h/c live in VMEM scratch, and each step streams only its [B, 4D] gate
+input in and its [B, D] output out.
+
+Semantics match ops.rnn.lstm exactly (reference gate order
+[a, in_gate, forget_gate, out_gate], peepholes on i/f from c_prev and on o
+from c_new, masked steps freeze the carry): tests/test_pallas_lstm.py
+proves forward+grad equality against the scan path.
+
+Backward is a second time-reversed kernel (BPTT): recomputes nothing,
+reads the forward-saved activations, accumulates dW_r in a VMEM f32
+accumulator and the peephole/bias-free input grads as streamed outputs.
+Per-batch peephole partials are reduced outside the kernel.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+
+
+def _lanes(x, n):
+    if n == _LANES:
+        return x
+    if n < _LANES:
+        return x[:, :n]
+    return jnp.tile(x, (1, n // _LANES))
+
+
+def _fwd_kernel(xs_ref, wr_ref, chk_ref, mask_ref,
+                hs_ref, cfin_ref, cs_ref, acts_ref, h_scr, c_scr,
+                *, d, nt, save_residuals):
+    """cs_ref/acts_ref are None in the lean (inference) variant — the
+    residual tensors are ~5x the HBM traffic of the h output, so
+    forward-only calls must not pay for them."""
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = jnp.zeros_like(h_scr)
+        c_scr[:] = jnp.zeros_like(c_scr)
+
+    h, c = h_scr[:], c_scr[:]
+    x4 = xs_ref[0].astype(jnp.float32)
+    wr = wr_ref[:].astype(jnp.float32)
+    gates = x4 + jax.lax.dot_general(
+        h, wr, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    a, ig, fg, og = (gates[:, 0:d], gates[:, d:2 * d],
+                     gates[:, 2 * d:3 * d], gates[:, 3 * d:4 * d])
+    ci, cf, co = chk_ref[0:1], chk_ref[1:2], chk_ref[2:3]   # [1, D]
+    a = jnp.tanh(a)
+    i = jax.nn.sigmoid(ig + c * ci)
+    f = jax.nn.sigmoid(fg + c * cf)
+    c_new = a * i + c * f
+    o = jax.nn.sigmoid(og + c_new * co)
+    h_new = o * jnp.tanh(c_new)
+
+    m = _lanes(mask_ref[0], d)                               # [B, D] 0/1
+    h = m * h_new + (1.0 - m) * h
+    c = m * c_new + (1.0 - m) * c
+    h_scr[:], c_scr[:] = h, c
+
+    hs_ref[0] = h.astype(hs_ref.dtype)
+    if save_residuals:
+        cs_ref[0] = c.astype(cs_ref.dtype)
+        acts_ref[0, :, 0:d] = a
+        acts_ref[0, :, d:2 * d] = i
+        acts_ref[0, :, 2 * d:3 * d] = f
+        acts_ref[0, :, 3 * d:4 * d] = o
+
+    @pl.when(t == nt - 1)
+    def _():
+        cfin_ref[0] = c_scr[:].astype(cfin_ref.dtype)
+
+
+def _bwd_kernel(acts_ref, cs_ref, csp_ref, hsp_ref, wr_ref, chk_ref,
+                mask_ref, dh_out_ref, dcfin_ref,
+                dxs_ref, dwr_ref, dchk_ref,
+                dh_scr, dc_scr, dwr_scr, dchk_scr, *, d, nt):
+    j = pl.program_id(0)          # reversed: actual time t = nt - 1 - j
+    t = nt - 1 - j
+
+    @pl.when(j == 0)
+    def _():
+        dh_scr[:] = jnp.zeros_like(dh_scr)
+        # final-cell cotangent enters the chain at the last (first-reversed)
+        # step, exactly where the scan's carry cotangent starts
+        dc_scr[:] = dcfin_ref[0].astype(jnp.float32)
+        dwr_scr[:] = jnp.zeros_like(dwr_scr)
+        dchk_scr[:] = jnp.zeros_like(dchk_scr)
+
+    a = acts_ref[0, :, 0:d]
+    i = acts_ref[0, :, d:2 * d]
+    f = acts_ref[0, :, 2 * d:3 * d]
+    o = acts_ref[0, :, 3 * d:4 * d]
+    c_t = cs_ref[0].astype(jnp.float32)
+    zero_prev = (t == 0)
+    c_prev = jnp.where(zero_prev, 0.0, csp_ref[0].astype(jnp.float32))
+    h_prev = jnp.where(zero_prev, 0.0, hsp_ref[0].astype(jnp.float32))
+    ci, cf, co = chk_ref[0:1], chk_ref[1:2], chk_ref[2:3]
+    m = _lanes(mask_ref[0], d)
+
+    dh = dh_scr[:] + dh_out_ref[0].astype(jnp.float32)
+    dc_merged = dc_scr[:]
+    tc = jnp.tanh(c_t)
+    do_ = dh * tc
+    dog = do_ * o * (1.0 - o)
+    dc = dh * o * (1.0 - tc * tc) + dc_merged + dog * co
+    da = dc * i
+    di = dc * a
+    dag = da * (1.0 - a * a)
+    dig = di * i * (1.0 - i)
+    dfg = dc * c_prev * f * (1.0 - f)
+    # masked step: carry passes through untouched
+    dgates = (jnp.concatenate([dag, dig, dfg, dog], axis=1)
+              * _lanes(mask_ref[0], 4 * d))
+    wr = wr_ref[:].astype(jnp.float32)
+    dh_prev = jax.lax.dot_general(
+        dgates, wr, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dc_prev = dc * f + dig * ci + dfg * cf
+
+    # pass-through on masked steps carries the MERGED cotangents (the cell
+    # terms in dc only exist on active steps)
+    dh_scr[:] = m * dh_prev + (1.0 - m) * dh
+    dc_scr[:] = m * dc_prev + (1.0 - m) * dc_merged
+    dwr_scr[:] = dwr_scr[:] + jax.lax.dot_general(
+        h_prev, dgates, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dchk_scr[:, 0:d] = dchk_scr[:, 0:d] + m * dig * c_prev
+    dchk_scr[:, d:2 * d] = dchk_scr[:, d:2 * d] + m * dfg * c_prev
+    dchk_scr[:, 2 * d:3 * d] = dchk_scr[:, 2 * d:3 * d] + m * dog * c_t
+
+    dxs_ref[0] = dgates.astype(dxs_ref.dtype)
+
+    @pl.when(j == nt - 1)
+    def _():
+        dwr_ref[:] = dwr_scr[:]
+        dchk_ref[:] = dchk_scr[:]
+
+
+def _fwd(xs, w_r, checks, mask, interpret, save_residuals):
+    nt, b, g = xs.shape
+    d = g // 4
+    out_specs = [
+        pl.BlockSpec((1, b, d), lambda t: (t, 0, 0)),      # hs
+        pl.BlockSpec((1, b, d), lambda t: (0, 0, 0)),      # c_final
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((nt, b, d), xs.dtype),
+        jax.ShapeDtypeStruct((1, b, d), jnp.float32),
+    ]
+    if save_residuals:
+        out_specs += [
+            pl.BlockSpec((1, b, d), lambda t: (t, 0, 0)),  # cs
+            pl.BlockSpec((1, b, g), lambda t: (t, 0, 0)),  # acts
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct((nt, b, d), jnp.float32),
+            jax.ShapeDtypeStruct((nt, b, g), jnp.float32),
+        ]
+
+    def kernel(xs_ref, wr_ref, chk_ref, mask_ref, hs_ref, cfin_ref,
+               *rest):
+        if save_residuals:
+            cs_ref, acts_ref, h_scr, c_scr = rest
+        else:
+            (h_scr, c_scr), cs_ref, acts_ref = rest, None, None
+        _fwd_kernel(xs_ref, wr_ref, chk_ref, mask_ref, hs_ref, cfin_ref,
+                    cs_ref, acts_ref, h_scr, c_scr,
+                    d=d, nt=nt, save_residuals=save_residuals)
+
+    outs = pl.pallas_call(
+        kernel,
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((1, b, g), lambda t: (t, 0, 0)),
+            pl.BlockSpec((d, g), lambda t: (0, 0)),
+            pl.BlockSpec((3, d), lambda t: (0, 0)),
+            pl.BlockSpec((1, b, _LANES), lambda t: (t, 0, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((b, d), jnp.float32),
+            pltpu.VMEM((b, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xs, w_r, checks, mask)
+    if save_residuals:
+        hs, cfin, cs, acts = outs
+        return hs, cfin, cs, acts
+    hs, cfin = outs
+    return hs, cfin, None, None
+
+
+def _bwd(interpret, res, g_out):
+    w_r, checks, mask, hs, cs, acts = res
+    dh_out, dcfin = g_out
+    xs_dtype = hs.dtype              # hs was emitted in xs.dtype
+    nt, b, dd = dh_out.shape
+    d = dd
+    gcols = 4 * d
+
+    dxs, dwr, dchk = pl.pallas_call(
+        functools.partial(_bwd_kernel, d=d, nt=nt),
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((1, b, gcols), lambda j: (nt - 1 - j, 0, 0)),
+            pl.BlockSpec((1, b, d), lambda j: (nt - 1 - j, 0, 0)),
+            pl.BlockSpec((1, b, d),
+                         lambda j: (jnp.maximum(nt - 2 - j, 0), 0, 0)),
+            pl.BlockSpec((1, b, d),
+                         lambda j: (jnp.maximum(nt - 2 - j, 0), 0, 0)),
+            pl.BlockSpec((d, gcols), lambda j: (0, 0)),
+            pl.BlockSpec((3, d), lambda j: (0, 0)),
+            pl.BlockSpec((1, b, _LANES), lambda j: (nt - 1 - j, 0, 0)),
+            pl.BlockSpec((1, b, d), lambda j: (nt - 1 - j, 0, 0)),
+            pl.BlockSpec((1, b, d), lambda j: (0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, gcols), lambda j: (nt - 1 - j, 0, 0)),
+            pl.BlockSpec((d, gcols), lambda j: (0, 0)),
+            pl.BlockSpec((b, 3 * d), lambda j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nt, b, gcols), xs_dtype),
+            jax.ShapeDtypeStruct((d, gcols), jnp.float32),
+            jax.ShapeDtypeStruct((b, 3 * d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, d), jnp.float32),
+            pltpu.VMEM((b, d), jnp.float32),
+            pltpu.VMEM((d, gcols), jnp.float32),
+            pltpu.VMEM((b, 3 * d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(acts, cs, cs, hs, w_r, checks, mask, dh_out,
+      dcfin.astype(jnp.float32))
+
+    dchecks = dchk.sum(axis=0).reshape(3, d).astype(checks.dtype)
+    return dxs, dwr.astype(w_r.dtype), dchecks, None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused(xs, w_r, checks, mask, interpret):
+    hs, cfin, _, _ = _fwd(xs, w_r, checks, mask, interpret,
+                          save_residuals=False)
+    return hs, cfin
+
+
+def _fused_fwd_rule(xs, w_r, checks, mask, interpret):
+    hs, cfin, cs, acts = _fwd(xs, w_r, checks, mask, interpret,
+                              save_residuals=True)
+    return (hs, cfin), (w_r, checks, mask, hs, cs, acts)
+
+
+_fused.defvjp(_fused_fwd_rule, _bwd)
+
+
+def supported(b, d, act, gate_act, state_act, reverse, init_state):
+    """Kernel path preconditions; callers fall back to the scan otherwise."""
+    return (act == "tanh" and gate_act == "sigmoid" and state_act == "tanh"
+            and not reverse and init_state is None
+            and b % 8 == 0 and d % _LANES == 0)
+
+
+def lstm_fused(xs_tm, mask_tm, w_r, check_i, check_f, check_o,
+               interpret=None):
+    """Whole-sequence fused LSTM.
+
+    xs_tm: [T, B, 4D] time-major pre-projected gate inputs (bias included).
+    mask_tm: [T, B] float 0/1.  Returns (hs_tm [T, B, D], final (h, c)).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nt, b, g = xs_tm.shape
+    d = g // 4
+    checks = jnp.stack([
+        jnp.zeros((d,), jnp.float32) if v is None else v.astype(jnp.float32)
+        for v in (check_i, check_f, check_o)])
+    mask_r = jnp.broadcast_to(
+        mask_tm.astype(jnp.float32)[:, :, None], (nt, b, _LANES))
+    hs, cfin = _fused(xs_tm, w_r, checks, mask_r, interpret)
+    return hs, (hs[-1], cfin[0].astype(hs.dtype))

@@ -90,6 +90,26 @@ import os as _os
 
 SCAN_UNROLL = int(_os.environ.get("PADDLE_TPU_SCAN_UNROLL", "1"))
 
+# Fused whole-sequence Pallas LSTM (ops/pallas/lstm.py): weights + state
+# stay VMEM-resident across the time loop instead of round-tripping HBM
+# every scan step.  Values: "auto" (default; kernel on real TPU, scan
+# elsewhere — interpret mode is slower than the scan and only useful for
+# testing), "always" (kernel everywhere, interpret off-TPU), "0" (scan
+# everywhere).
+FUSED_LSTM = _os.environ.get("PADDLE_TPU_FUSED_LSTM", "auto")
+
+
+def _fused_lstm_enabled():
+    if FUSED_LSTM == "always":
+        return True
+    if FUSED_LSTM in ("0", "off", "false", "no"):
+        return False
+    if FUSED_LSTM not in ("auto", "1", ""):
+        from paddle_tpu.utils.logging import logger
+        logger.warning("PADDLE_TPU_FUSED_LSTM=%r not recognized "
+                       "(auto|always|0); treating as auto", FUSED_LSTM)
+    return jax.default_backend() == "tpu"
+
 
 def _masked_scan(step, init_carry, xs_time_major, mask_time_major, reverse=False):
     """Scan over time; where mask==0 the carry passes through unchanged."""
@@ -119,6 +139,20 @@ def lstm(seq: SequenceBatch, w_r, bias=None, check_i=None, check_f=None,
     x = seq.data if bias is None else seq.data + bias
     xs = x.transpose(1, 0, 2)                       # time-major [T, B, 4D]
     ms = seq.mask().transpose(1, 0)                 # [T, B]
+
+    if _fused_lstm_enabled():
+        # import inside the branch: a broken pallas install must not take
+        # the scan fallback down with it
+        from paddle_tpu.ops.pallas import lstm as pl_lstm
+        if pl_lstm.supported(b, d, act, gate_act, state_act,
+                             reverse, init_state):
+            hs_tm, (fh, fc) = pl_lstm.lstm_fused(xs, ms, w_r,
+                                                 check_i, check_f, check_o)
+            out = (hs_tm.transpose(1, 0, 2)
+                   * seq.mask(hs_tm.dtype)[..., None])
+            return (SequenceBatch(data=out, lengths=seq.lengths),
+                    LstmState(h=fh, c=fc))
+
     if init_state is None:
         init_state = LstmState(h=jnp.zeros((b, d), x.dtype),
                                c=jnp.zeros((b, d), x.dtype))

@@ -103,14 +103,21 @@ def seq_concat(a: SequenceBatch, b: SequenceBatch) -> SequenceBatch:
 
 def seq_reshape(seq: SequenceBatch, new_dim: int) -> SequenceBatch:
     """Re-chunk each sequence's flattened tokens into rows of new_dim
-    (reference SequenceReshapeLayer).  Requires T*D % new_dim == 0."""
+    (reference SequenceReshapeLayer — it reshapes only the VALID ragged
+    tokens, so the last row of a sequence whose len*d is not a multiple of
+    new_dim is deterministically zero-padded, and the batch's padded length
+    must not influence anything)."""
     b, t, d = seq.data.shape
-    assert (t * d) % new_dim == 0
-    data = seq.data.reshape(b, (t * d) // new_dim, new_dim)
+    # zero payload past each sequence's end: without this, garbage in the
+    # padding bleeds into the tail output row (padding-invariance sweep)
+    data = seq.data * seq.mask(seq.data.dtype)[..., None]
+    rows = -(-(t * d) // new_dim)
+    flat = data.reshape(b, t * d)
+    flat = jnp.pad(flat, ((0, 0), (0, rows * new_dim - t * d)))
     # ceil so a sequence whose len*d is not divisible keeps all its tokens
-    # (tail row zero-padded) instead of silently dropping them
     new_len = -(-(seq.lengths * d) // new_dim)
-    return SequenceBatch(data=data, lengths=new_len.astype(jnp.int32))
+    return SequenceBatch(data=flat.reshape(b, rows, new_dim),
+                         lengths=new_len.astype(jnp.int32))
 
 
 def sub_seq(seq: SequenceBatch, offsets, sizes, max_out: int) -> SequenceBatch:

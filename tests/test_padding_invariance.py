@@ -1,0 +1,97 @@
+"""Registry-driven padding-invariance sweep (SURVEY hard part c).
+
+The reference NEVER pads: every sequence op walks
+`Argument::sequenceStartPositions` (parameter/Argument.h:84-93), so its
+results cannot depend on anything past a sequence's end.  The TPU rebuild
+pads to static shapes and masks — meaning every sequence op must produce
+IDENTICAL results when the same sequences are padded longer.  This module
+enforces that property for EVERY sweep case with a sequence input, driven
+off the same CASES registry as the gradient sweep (new layers get the check
+for free).
+
+Method: build the case feed at T, extend every SequenceBatch's data with
+EXTRA garbage timesteps (nonzero, so any op that reads past lengths is
+caught — zeros would hide mean/sum leaks), keep lengths unchanged, and
+compare the scalar loss over all outputs.
+"""
+
+import zlib
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.layers.graph import Topology, reset_names, value_data
+
+from tests.test_layer_grad_sweep import CASES, B0, T0
+
+EXTRA = 3          # appended timesteps
+GARBAGE = 7.5      # pad payload: loud, not zero
+
+# cases whose outputs legitimately depend on the padded length
+EXCLUDED = {
+    # none known — an entry here needs a comment citing the reference
+    # semantics that make the op max_len-dependent
+}
+
+
+def _seq_cases():
+    return sorted(n for n in CASES if n not in EXCLUDED)
+
+
+def _extend(v):
+    """SequenceBatch [B, T, ...] -> [B, T+EXTRA, ...] with garbage pad and
+    unchanged lengths."""
+    data = np.asarray(v.data)
+    pad_shape = (data.shape[0], EXTRA) + data.shape[2:]
+    if np.issubdtype(data.dtype, np.floating):
+        pad = np.full(pad_shape, GARBAGE, data.dtype)
+    else:
+        pad = np.ones(pad_shape, data.dtype)   # in-vocab garbage ids
+    return SequenceBatch(data=jnp.asarray(np.concatenate([data, pad], 1)),
+                         lengths=v.lengths)
+
+
+def _loss(topo, params, feed):
+    out = topo.apply(params, feed, mode="test", rng=jax.random.PRNGKey(7))
+    vals = out if isinstance(out, tuple) else (out,)
+    total = 0.0
+    for v in vals:
+        d = value_data(v)
+        total = total + jnp.sum(jnp.abs(d.astype(jnp.float32)))
+    return total
+
+
+@pytest.mark.parametrize("name", _seq_cases())
+def test_padding_invariant(name):
+    build, _ = CASES[name]
+    reset_names()
+    r = np.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
+    outs, feed = build(r, B0, T0)
+    outs = outs if isinstance(outs, list) else [outs]
+    if not any(isinstance(v, SequenceBatch) for v in feed.values()):
+        pytest.skip("no sequence inputs")
+    topo = Topology(outs)
+    params = topo.init(jax.random.PRNGKey(0))
+
+    base = float(_loss(topo, params, feed))
+    wide = {k: _extend(v) if isinstance(v, SequenceBatch) else v
+            for k, v in feed.items()}
+    padded = float(_loss(topo, params, wide))
+    np.testing.assert_allclose(
+        padded, base, rtol=1e-5,
+        err_msg=f"{name}: output depends on padding beyond lengths")
+
+    # gradient side: d(loss)/d(param) must not see the padding either
+    g_base = jax.grad(lambda p: _loss(topo, p, feed))(params)
+    g_wide = jax.grad(lambda p: _loss(topo, p, wide))(params)
+    for (path, ga), (_, gw) in zip(
+            jax.tree_util.tree_flatten_with_path(g_base)[0],
+            jax.tree_util.tree_flatten_with_path(g_wide)[0]):
+        if np.issubdtype(np.asarray(ga).dtype, np.floating):
+            np.testing.assert_allclose(
+                np.asarray(gw), np.asarray(ga), rtol=1e-4, atol=1e-6,
+                err_msg=f"{name}: param grad {jax.tree_util.keystr(path)} "
+                        "depends on padding")

@@ -1,0 +1,79 @@
+"""Fused Pallas LSTM vs the lax.scan reference path: forward and every
+gradient must agree (the dual-implementation discipline the reference
+applies to its fused CUDA LSTM in test_LayerGrad + test_RecurrentLayer).
+
+Runs the kernel in interpret mode on the CPU mesh; the same code lowers to
+Mosaic on a real chip (exercised by bench.py and the TPU differential
+sweep)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import rnn
+
+B, T, D = 8, 7, 128          # kernel needs B%8==0, D%128==0
+
+
+def _mk(np_rng, ragged=True):
+    x = jnp.asarray(np_rng.randn(B, T, 4 * D) * 0.3, jnp.float32)
+    lengths = (np_rng.randint(1, T + 1, (B,)) if ragged
+               else np.full((B,), T))
+    seq = SequenceBatch(data=x, lengths=jnp.asarray(lengths, jnp.int32))
+    w_r = jnp.asarray(np_rng.randn(D, 4 * D) * 0.1, jnp.float32)
+    checks = [jnp.asarray(np_rng.randn(D) * 0.1, jnp.float32)
+              for _ in range(3)]
+    bias = jnp.asarray(np_rng.randn(4 * D) * 0.1, jnp.float32)
+    return seq, w_r, checks, bias
+
+
+def _run(seq, w_r, checks, bias, fused, use_final=False, peephole=True):
+    rnn.FUSED_LSTM = "always" if fused else "0"
+    try:
+        ci, cf, co = checks if peephole else (None, None, None)
+        out, final = rnn.lstm(seq, w_r, bias=bias,
+                              check_i=ci, check_f=cf, check_o=co)
+        if use_final:
+            return jnp.sum(out.data ** 2) + jnp.sum(final.c ** 2) \
+                + jnp.sum(final.h)
+        return jnp.sum(out.data ** 2)
+    finally:
+        rnn.FUSED_LSTM = "auto"
+
+
+@pytest.mark.parametrize("ragged", [False, True], ids=["full", "ragged"])
+@pytest.mark.parametrize("peephole", [True, False], ids=["peep", "nopeep"])
+def test_fused_matches_scan_forward(np_rng, ragged, peephole):
+    seq, w_r, checks, bias = _mk(np_rng, ragged)
+    a = _run(seq, w_r, checks, bias, fused=True, peephole=peephole)
+    b = _run(seq, w_r, checks, bias, fused=False, peephole=peephole)
+    np.testing.assert_allclose(float(a), float(b), rtol=2e-5)
+
+
+@pytest.mark.parametrize("use_final", [False, True], ids=["hs", "hs+final"])
+def test_fused_matches_scan_grads(np_rng, use_final):
+    seq, w_r, checks, bias = _mk(np_rng, ragged=True)
+
+    def loss(fused, xdata, w_r, checks, bias):
+        s = SequenceBatch(data=xdata, lengths=seq.lengths)
+        return _run(s, w_r, checks, bias, fused, use_final=use_final)
+
+    args = (seq.data, w_r, checks, bias)
+    ga = jax.grad(lambda *a: loss(True, *a), argnums=(0, 1, 2, 3))(*args)
+    gb = jax.grad(lambda *a: loss(False, *a), argnums=(0, 1, 2, 3))(*args)
+    labels = ["dx", "dw_r", "dchecks", "dbias"]
+    for la, (a, b) in zip(labels, zip(jax.tree_util.tree_leaves(ga),
+                                      jax.tree_util.tree_leaves(gb))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5, err_msg=la)
+
+
+def test_fused_zero_length_sequence(np_rng):
+    seq, w_r, checks, bias = _mk(np_rng, ragged=True)
+    seq = SequenceBatch(data=seq.data,
+                        lengths=seq.lengths.at[0].set(0))
+    a = _run(seq, w_r, checks, bias, fused=True)
+    b = _run(seq, w_r, checks, bias, fused=False)
+    np.testing.assert_allclose(float(a), float(b), rtol=2e-5)

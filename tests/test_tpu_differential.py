@@ -51,10 +51,16 @@ def test_case_matches(case):
     assert cpu_keys == tpu_keys, (cpu_keys ^ tpu_keys)
     for k in sorted(cpu_keys):
         if k.endswith("__error__"):
-            # same failure on both platforms is a sweep-harness limitation,
-            # not a numerics divergence — but surface it in the log
-            print(f"{k}: {bytes(cpu[k]).decode()[:120]}")
-            assert bytes(cpu[k])[:80] == bytes(tpu[k])[:80]
+            msg_c = bytes(cpu[k]).decode()
+            msg_t = bytes(tpu[k]).decode()
+            # a timeout means the case was never numerically compared —
+            # that must FAIL, not hide behind the same-error exemption
+            assert not msg_c.startswith("TimeoutExpired"), (k, msg_c)
+            assert not msg_t.startswith("TimeoutExpired"), (k, msg_t)
+            # an identical in-case failure on both platforms is a sweep
+            # harness limitation, not a numerics divergence — surface it
+            print(f"{k}: {msg_c[:120]}")
+            assert msg_c[:80] == msg_t[:80]
             continue
         a, b = cpu[k], tpu[k]
         assert a.shape == b.shape, k
