@@ -1,0 +1,21 @@
+"""Shared Mosaic-layout helpers for the Pallas kernels.
+
+Mosaic requires the last dimension of a block to be a multiple of the VPU
+lane count (128) or the whole array dimension, so per-row statistics
+(softmax running max/sum, sequence masks, saved lse) are stored
+lane-REPLICATED in [rows, LANES] tiles and widened/narrowed with lanes().
+"""
+
+import jax.numpy as jnp
+
+LANES = 128
+
+
+def lanes(x, n):
+    """[rows, LANES] lane-replicated -> [rows, n] (n <= LANES slices,
+    multiples of LANES tile)."""
+    if n == LANES:
+        return x
+    if n < LANES:
+        return x[:, :n]
+    return jnp.tile(x, (1, n // LANES))

@@ -29,21 +29,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30
 
-# Mosaic requires the last block dim be a multiple of 128 (the VPU lane
-# count) or the whole array dim; per-row statistics (running max/sum, lse,
-# delta) therefore live lane-REPLICATED in [rows, _LANES] tiles — the same
-# layout jax.experimental.pallas.ops.tpu.flash_attention uses.
-_LANES = 128
-
-
-def _lanes(x, n):
-    """[rows, _LANES] lane-replicated -> [rows, n] (n <= _LANES slices,
-    multiples of _LANES tile)."""
-    if n == _LANES:
-        return x
-    if n < _LANES:
-        return x[:, :n]
-    return jnp.tile(x, (1, n // _LANES))
+# Per-row statistics (running max/sum, lse, delta) live lane-REPLICATED in
+# [rows, 128] tiles — the same layout
+# jax.experimental.pallas.ops.tpu.flash_attention uses; see pallas/common.py.
+from paddle_tpu.ops.pallas.common import LANES as _LANES, lanes as _lanes
 
 
 # ------------------------------------------------------------------ forward

@@ -1,0 +1,222 @@
+"""Fused whole-sequence GRU (Pallas) — companion to ops/pallas/lstm.py,
+covering the reference's fused GRU kernels (cuda/src/hl_cuda_gru.cu +
+hl_gru_ops.cuh:37-80, dispatched from GruCompute; gate layout
+[update, reset, candidate], h = prev + u*(c~ - prev)).
+
+Same design as the LSTM kernel: the grid is the time loop, w_gate/w_state
+stay VMEM-resident, h lives in VMEM scratch; each step streams one [B, 3D]
+gate input in and one [B, D] output out.  The inference variant emits only
+hs; the VJP variant additionally saves the activated (u, r, c~) for the
+time-reversed BPTT kernel, which accumulates dW_gate/dW_state in VMEM.
+
+Numerics proven equal to the lax.scan path by tests/test_pallas_gru.py.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.ops.pallas.common import LANES as _LANES, lanes as _lanes
+
+
+def _step(x3, h, wg, ws, d):
+    ru = jax.lax.dot_general(h, wg, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    u = jax.nn.sigmoid(x3[:, 0:d] + ru[:, 0:d])
+    r = jax.nn.sigmoid(x3[:, d:2 * d] + ru[:, d:2 * d])
+    s = r * h
+    cc = jnp.tanh(x3[:, 2 * d:3 * d] + jax.lax.dot_general(
+        s, ws, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32))
+    return u, r, cc, h + u * (cc - h)
+
+
+def _fwd_kernel(xs_ref, wg_ref, ws_ref, mask_ref, hs_ref, acts_ref, h_scr,
+                *, d, save_residuals):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = jnp.zeros_like(h_scr)
+
+    h = h_scr[:]
+    x3 = xs_ref[0].astype(jnp.float32)
+    wg = wg_ref[:].astype(jnp.float32)
+    ws = ws_ref[:].astype(jnp.float32)
+    u, r, cc, h_new = _step(x3, h, wg, ws, d)
+    m = _lanes(mask_ref[0], d)
+    h = m * h_new + (1.0 - m) * h
+    h_scr[:] = h
+    hs_ref[0] = h.astype(hs_ref.dtype)
+    if save_residuals:
+        acts_ref[0, :, 0:d] = u
+        acts_ref[0, :, d:2 * d] = r
+        acts_ref[0, :, 2 * d:3 * d] = cc
+
+
+def _bwd_kernel(acts_ref, hsp_ref, wg_ref, ws_ref, mask_ref, dh_out_ref,
+                dxs_ref, dwg_ref, dws_ref,
+                dh_scr, dwg_scr, dws_scr, *, d, nt):
+    j = pl.program_id(0)
+    t = nt - 1 - j
+
+    @pl.when(j == 0)
+    def _():
+        dh_scr[:] = jnp.zeros_like(dh_scr)
+        dwg_scr[:] = jnp.zeros_like(dwg_scr)
+        dws_scr[:] = jnp.zeros_like(dws_scr)
+
+    u = acts_ref[0, :, 0:d]
+    r = acts_ref[0, :, d:2 * d]
+    cc = acts_ref[0, :, 2 * d:3 * d]
+    h_prev = jnp.where(t == 0, 0.0, hsp_ref[0].astype(jnp.float32))
+    wg = wg_ref[:].astype(jnp.float32)
+    ws = ws_ref[:].astype(jnp.float32)
+    m = _lanes(mask_ref[0], d)
+
+    dh = dh_scr[:] + dh_out_ref[0].astype(jnp.float32)
+    du = dh * (cc - h_prev)
+    dug = du * u * (1.0 - u)
+    dcc = dh * u
+    dccg = dcc * (1.0 - cc * cc)
+    ds = jax.lax.dot_general(dccg, ws, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dr = ds * h_prev
+    drg = dr * r * (1.0 - r)
+    dgates = jnp.concatenate([dug, drg], axis=1) * _lanes(mask_ref[0], 2 * d)
+    dccg_m = dccg * m
+    # active-step h_prev grad: direct (1-u) + via s=r*h_prev + via w_gate
+    dh_prev = (dh * (1.0 - u) + ds * r
+               + jax.lax.dot_general(dgates, wg, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32))
+    dh_scr[:] = m * dh_prev + (1.0 - m) * dh
+    dwg_scr[:] = dwg_scr[:] + jax.lax.dot_general(
+        h_prev, dgates, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    s = r * h_prev
+    dws_scr[:] = dws_scr[:] + jax.lax.dot_general(
+        s, dccg_m, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dxs_ref[0, :, 0:d] = dgates[:, 0:d].astype(dxs_ref.dtype)
+    dxs_ref[0, :, d:2 * d] = dgates[:, d:2 * d].astype(dxs_ref.dtype)
+    dxs_ref[0, :, 2 * d:3 * d] = dccg_m.astype(dxs_ref.dtype)
+
+    @pl.when(j == nt - 1)
+    def _():
+        dwg_ref[:] = dwg_scr[:]
+        dws_ref[:] = dws_scr[:]
+
+
+def _fwd(xs, w_gate, w_state, mask, interpret, save_residuals):
+    nt, b, g = xs.shape
+    d = g // 3
+    out_specs = [pl.BlockSpec((1, b, d), lambda t: (t, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((nt, b, d), xs.dtype)]
+    if save_residuals:
+        out_specs.append(pl.BlockSpec((1, b, g), lambda t: (t, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((nt, b, g), jnp.float32))
+
+    def kernel(xs_ref, wg_ref, ws_ref, mask_ref, hs_ref, *rest):
+        if save_residuals:
+            acts_ref, h_scr = rest
+        else:
+            (h_scr,), acts_ref = rest, None
+        _fwd_kernel(xs_ref, wg_ref, ws_ref, mask_ref, hs_ref, acts_ref,
+                    h_scr, d=d, save_residuals=save_residuals)
+
+    outs = pl.pallas_call(
+        kernel,
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((1, b, g), lambda t: (t, 0, 0)),
+            pl.BlockSpec((d, 2 * d), lambda t: (0, 0)),
+            pl.BlockSpec((d, d), lambda t: (0, 0)),
+            pl.BlockSpec((1, b, _LANES), lambda t: (t, 0, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((b, d), jnp.float32)],
+        interpret=interpret,
+    )(xs, w_gate, w_state, mask)
+    if save_residuals:
+        return outs[0], outs[1]
+    return outs[0], None
+
+
+def _bwd(interpret, res, g_out):
+    w_gate, w_state, mask, hs, acts = res
+    dh_out = g_out
+    xs_dtype = hs.dtype
+    nt, b, d = dh_out.shape
+    g = 3 * d
+
+    dxs, dwg, dws = pl.pallas_call(
+        functools.partial(_bwd_kernel, d=d, nt=nt),
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((1, b, g), lambda j: (nt - 1 - j, 0, 0)),
+            pl.BlockSpec((1, b, d),
+                         lambda j: (jnp.maximum(nt - 2 - j, 0), 0, 0)),
+            pl.BlockSpec((d, 2 * d), lambda j: (0, 0)),
+            pl.BlockSpec((d, d), lambda j: (0, 0)),
+            pl.BlockSpec((1, b, _LANES), lambda j: (nt - 1 - j, 0, 0)),
+            pl.BlockSpec((1, b, d), lambda j: (nt - 1 - j, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, g), lambda j: (nt - 1 - j, 0, 0)),
+            pl.BlockSpec((d, 2 * d), lambda j: (0, 0)),
+            pl.BlockSpec((d, d), lambda j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nt, b, g), xs_dtype),
+            jax.ShapeDtypeStruct((d, 2 * d), jnp.float32),
+            jax.ShapeDtypeStruct((d, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, d), jnp.float32),
+            pltpu.VMEM((d, 2 * d), jnp.float32),
+            pltpu.VMEM((d, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(acts, hs, w_gate, w_state, mask, dh_out)
+    return (dxs, dwg.astype(w_gate.dtype), dws.astype(w_state.dtype), None)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused(xs, w_gate, w_state, mask, interpret):
+    hs, _ = _fwd(xs, w_gate, w_state, mask, interpret, save_residuals=False)
+    return hs
+
+
+def _fused_fwd_rule(xs, w_gate, w_state, mask, interpret):
+    hs, acts = _fwd(xs, w_gate, w_state, mask, interpret,
+                    save_residuals=True)
+    return hs, (w_gate, w_state, mask, hs, acts)
+
+
+_fused.defvjp(_fused_fwd_rule, _bwd)
+
+
+def supported(b, d, act, gate_act, init_state):
+    # reverse is handled by time-flipping in the caller (a reverse masked
+    # scan over left-aligned ragged sequences == forward scan over the
+    # time-flipped arrays, flipped back)
+    return (act == "tanh" and gate_act == "sigmoid"
+            and init_state is None
+            and b % 8 == 0 and d % _LANES == 0)
+
+
+def gru_fused(xs_tm, mask_tm, w_gate, w_state, interpret=None):
+    """Whole-sequence fused GRU.
+
+    xs_tm: [T, B, 3D] time-major pre-projected [update|reset|candidate]
+    inputs (bias included).  mask_tm: [T, B].  Returns (hs_tm, final h)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nt, b, g = xs_tm.shape
+    mask_r = jnp.broadcast_to(
+        mask_tm.astype(jnp.float32)[:, :, None], (nt, b, _LANES))
+    hs = _fused(xs_tm, w_gate, w_state, mask_r, interpret)
+    return hs, hs[-1]

@@ -28,15 +28,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_LANES = 128
-
-
-def _lanes(x, n):
-    if n == _LANES:
-        return x
-    if n < _LANES:
-        return x[:, :n]
-    return jnp.tile(x, (1, n // _LANES))
+from paddle_tpu.ops.pallas.common import LANES as _LANES, lanes as _lanes
 
 
 def _fwd_kernel(xs_ref, wr_ref, chk_ref, mask_ref,

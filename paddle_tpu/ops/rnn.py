@@ -90,23 +90,27 @@ import os as _os
 
 SCAN_UNROLL = int(_os.environ.get("PADDLE_TPU_SCAN_UNROLL", "1"))
 
-# Fused whole-sequence Pallas LSTM (ops/pallas/lstm.py): weights + state
-# stay VMEM-resident across the time loop instead of round-tripping HBM
-# every scan step.  Values: "auto" (default; kernel on real TPU, scan
-# elsewhere — interpret mode is slower than the scan and only useful for
-# testing), "always" (kernel everywhere, interpret off-TPU), "0" (scan
-# everywhere).
-FUSED_LSTM = _os.environ.get("PADDLE_TPU_FUSED_LSTM", "auto")
+# Fused whole-sequence Pallas RNN kernels (ops/pallas/{lstm,gru}.py):
+# weights + state stay VMEM-resident across the time loop instead of
+# round-tripping HBM every scan step.  Gates BOTH the LSTM and GRU kernels.
+# Values: "auto" (default; kernels on real TPU, scan elsewhere — interpret
+# mode is slower than the scan and only useful for testing), "always"/"1"
+# (kernels everywhere, interpret off-TPU), "0"/"off" (scan everywhere).
+# PADDLE_TPU_FUSED_RNN is the primary env var; PADDLE_TPU_FUSED_LSTM is an
+# accepted alias from before the GRU kernel existed.
+FUSED_LSTM = _os.environ.get(
+    "PADDLE_TPU_FUSED_RNN",
+    _os.environ.get("PADDLE_TPU_FUSED_LSTM", "auto"))
 
 
 def _fused_lstm_enabled():
-    if FUSED_LSTM == "always":
+    if FUSED_LSTM in ("always", "1"):
         return True
     if FUSED_LSTM in ("0", "off", "false", "no"):
         return False
-    if FUSED_LSTM not in ("auto", "1", ""):
+    if FUSED_LSTM not in ("auto", ""):
         from paddle_tpu.utils.logging import logger
-        logger.warning("PADDLE_TPU_FUSED_LSTM=%r not recognized "
+        logger.warning("PADDLE_TPU_FUSED_RNN=%r not recognized "
                        "(auto|always|0); treating as auto", FUSED_LSTM)
     return jax.default_backend() == "tpu"
 
@@ -177,6 +181,19 @@ def gru(seq: SequenceBatch, w_gate, w_state, bias=None, reverse=False,
     x = seq.data if bias is None else seq.data + bias
     xs = x.transpose(1, 0, 2)
     ms = seq.mask().transpose(1, 0)
+
+    if _fused_lstm_enabled():
+        from paddle_tpu.ops.pallas import gru as pl_gru
+        if pl_gru.supported(b, d, act, gate_act, init_state):
+            xs_k = jnp.flip(xs, 0) if reverse else xs
+            ms_k = jnp.flip(ms, 0) if reverse else ms
+            hs_tm, fh = pl_gru.gru_fused(xs_k, ms_k, w_gate, w_state)
+            if reverse:
+                hs_tm = jnp.flip(hs_tm, 0)
+            out = (hs_tm.transpose(1, 0, 2)
+                   * seq.mask(hs_tm.dtype)[..., None])
+            return SequenceBatch(data=out, lengths=seq.lengths), fh
+
     if init_state is None:
         init_state = jnp.zeros((b, d), x.dtype)
 

@@ -1,0 +1,65 @@
+"""Fused Pallas GRU vs the lax.scan reference path — same dual-path
+discipline as tests/test_pallas_lstm.py, including the time-flip trick for
+the reverse (encoder-backward) direction."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import rnn
+
+B, T, D = 8, 7, 128
+
+
+def _mk(np_rng, ragged=True):
+    x = jnp.asarray(np_rng.randn(B, T, 3 * D) * 0.3, jnp.float32)
+    lengths = (np_rng.randint(1, T + 1, (B,)) if ragged
+               else np.full((B,), T))
+    seq = SequenceBatch(data=x, lengths=jnp.asarray(lengths, jnp.int32))
+    w_gate = jnp.asarray(np_rng.randn(D, 2 * D) * 0.1, jnp.float32)
+    w_state = jnp.asarray(np_rng.randn(D, D) * 0.1, jnp.float32)
+    bias = jnp.asarray(np_rng.randn(3 * D) * 0.1, jnp.float32)
+    return seq, w_gate, w_state, bias
+
+
+def _run(seq, w_gate, w_state, bias, fused, reverse=False, use_final=False):
+    prior = rnn.FUSED_LSTM
+    rnn.FUSED_LSTM = "always" if fused else "0"
+    try:
+        out, final = rnn.gru(seq, w_gate, w_state, bias=bias,
+                             reverse=reverse)
+        tot = jnp.sum(out.data ** 2)
+        if use_final:
+            tot = tot + jnp.sum(final ** 2)
+        return tot
+    finally:
+        rnn.FUSED_LSTM = prior
+
+
+@pytest.mark.parametrize("reverse", [False, True], ids=["fwd", "rev"])
+@pytest.mark.parametrize("ragged", [False, True], ids=["full", "ragged"])
+def test_fused_matches_scan_forward(np_rng, reverse, ragged):
+    seq, wg, ws, bias = _mk(np_rng, ragged)
+    a = _run(seq, wg, ws, bias, fused=True, reverse=reverse)
+    b = _run(seq, wg, ws, bias, fused=False, reverse=reverse)
+    np.testing.assert_allclose(float(a), float(b), rtol=2e-5)
+
+
+@pytest.mark.parametrize("reverse", [False, True], ids=["fwd", "rev"])
+def test_fused_matches_scan_grads(np_rng, reverse):
+    seq, wg, ws, bias = _mk(np_rng, ragged=True)
+
+    def loss(fused, xdata, wg, ws, bias):
+        s = SequenceBatch(data=xdata, lengths=seq.lengths)
+        return _run(s, wg, ws, bias, fused, reverse=reverse,
+                    use_final=True)
+
+    args = (seq.data, wg, ws, bias)
+    ga = jax.grad(lambda *a: loss(True, *a), argnums=(0, 1, 2, 3))(*args)
+    gb = jax.grad(lambda *a: loss(False, *a), argnums=(0, 1, 2, 3))(*args)
+    for la, (a, b) in zip(["dx", "dw_gate", "dw_state", "dbias"],
+                          zip(ga, gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5, err_msg=la)

@@ -30,6 +30,7 @@ def _mk(np_rng, ragged=True):
 
 
 def _run(seq, w_r, checks, bias, fused, use_final=False, peephole=True):
+    prior = rnn.FUSED_LSTM
     rnn.FUSED_LSTM = "always" if fused else "0"
     try:
         ci, cf, co = checks if peephole else (None, None, None)
@@ -40,7 +41,7 @@ def _run(seq, w_r, checks, bias, fused, use_final=False, peephole=True):
                 + jnp.sum(final.h)
         return jnp.sum(out.data ** 2)
     finally:
-        rnn.FUSED_LSTM = "auto"
+        rnn.FUSED_LSTM = prior
 
 
 @pytest.mark.parametrize("ragged", [False, True], ids=["full", "ragged"])
