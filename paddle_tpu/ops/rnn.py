@@ -94,8 +94,9 @@ SCAN_UNROLL = int(_os.environ.get("PADDLE_TPU_SCAN_UNROLL", "1"))
 # weights + state stay VMEM-resident across the time loop instead of
 # round-tripping HBM every scan step.  Gates BOTH the LSTM and GRU kernels.
 # Values: "auto" (default; kernels on real TPU, scan elsewhere — interpret
-# mode is slower than the scan and only useful for testing), "always"/"1"
-# (kernels everywhere, interpret off-TPU), "0"/"off" (scan everywhere).
+# mode is slower than the scan and only useful for testing), "always"
+# (kernels everywhere, interpret off-TPU), "0"/"off" (scan everywhere);
+# "1" is a legacy alias for auto.
 # PADDLE_TPU_FUSED_RNN is the primary env var; PADDLE_TPU_FUSED_LSTM is an
 # accepted alias from before the GRU kernel existed.
 FUSED_LSTM = _os.environ.get(
@@ -104,11 +105,14 @@ FUSED_LSTM = _os.environ.get(
 
 
 def _fused_lstm_enabled():
-    if FUSED_LSTM in ("always", "1"):
+    if FUSED_LSTM == "always":
         return True
     if FUSED_LSTM in ("0", "off", "false", "no"):
         return False
-    if FUSED_LSTM not in ("auto", ""):
+    # "1" keeps its legacy meaning: enabled-with-auto-gating (kernel on
+    # real TPU only) — NOT force-on, which would switch CPU boxes to the
+    # slow interpret path
+    if FUSED_LSTM not in ("auto", "1", ""):
         from paddle_tpu.utils.logging import logger
         logger.warning("PADDLE_TPU_FUSED_RNN=%r not recognized "
                        "(auto|always|0); treating as auto", FUSED_LSTM)

@@ -228,6 +228,7 @@ def supervise(platform, out_path, case_timeout=150.0, max_consec_fail=4):
         marker = os.path.join(
             out_dir, (name if name != "__optim__" else _optim_marker())
             + ".npz")
+        deleted_stale = False
         if retry_errors:
             # drop error-only records so the worker recomputes them; for
             # __optim__ that means ANY optim_* sub-case record, not just
@@ -238,7 +239,10 @@ def supervise(platform, out_path, case_timeout=150.0, max_consec_fail=4):
             for p in stale:
                 if os.path.exists(p) and _is_error_record(p):
                     os.unlink(p)
-        if os.path.exists(marker):
+                    deleted_stale = True
+        # a healthy marker must not suppress the rerun that recomputes a
+        # just-deleted stale record
+        if os.path.exists(marker) and not deleted_stale:
             continue
         cmd = [sys.executable, "-m", "paddle_tpu.testing.tpu_diff",
                platform, out_path, name, "--worker"]

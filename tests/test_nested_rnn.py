@@ -174,3 +174,36 @@ def test_nested_jit_compiles():
     v1 = f(params, nested)
     v2 = f(params, nested)
     assert np.isfinite(float(v1)) and float(v1) == float(v2)
+
+
+def test_nested_padding_invariance():
+    """Outputs and parameter grads must be identical when the nested batch
+    is padded wider (outer) and longer (inner) with loud garbage — the
+    2-level analog of tests/test_padding_invariance.py (the reference
+    never pads: subSequenceStartPositions delimit the real data)."""
+    subs, nested, _ = _nested_data()
+    wide = pad_nested_sequences(
+        subs,
+        max_outer=int(nested.outer_lengths.max()) + 2,
+        max_inner=int(np.asarray(nested.inner_lengths).max()) + 3,
+        pad_value=7.5)
+    reset_names()
+    topo, _ = _build_nested()
+    params = topo.init(jax.random.PRNGKey(0))
+
+    def loss(p, feed):
+        out = topo.apply(p, feed, mode="test")
+        return jnp.sum(jnp.abs(value_data(out).astype(jnp.float32)))
+
+    base = float(loss(params, {"x": nested}))
+    padded = float(loss(params, {"x": wide}))
+    np.testing.assert_allclose(padded, base, rtol=1e-5)
+
+    ga = jax.grad(loss)(params, {"x": nested})
+    gb = jax.grad(loss)(params, {"x": wide})
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(ga)[0],
+            jax.tree_util.tree_flatten_with_path(gb)[0]):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-4, atol=1e-6,
+            err_msg=f"nested grad {jax.tree_util.keystr(path)}")

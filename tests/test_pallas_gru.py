@@ -63,3 +63,11 @@ def test_fused_matches_scan_grads(np_rng, reverse):
                           zip(ga, gb)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5, err_msg=la)
+
+
+def test_fused_zero_length_sequence(np_rng):
+    seq, wg, ws, bias = _mk(np_rng, ragged=True)
+    seq = SequenceBatch(data=seq.data, lengths=seq.lengths.at[0].set(0))
+    a = _run(seq, wg, ws, bias, fused=True)
+    b = _run(seq, wg, ws, bias, fused=False)
+    np.testing.assert_allclose(float(a), float(b), rtol=2e-5)
