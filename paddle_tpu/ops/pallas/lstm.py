@@ -262,10 +262,11 @@ def _fused_fwd_rule(xs, w_r, checks, mask, interpret):
 _fused.defvjp(_fused_fwd_rule, _bwd)
 
 
-def supported(b, d, act, gate_act, state_act, reverse, init_state):
-    """Kernel path preconditions; callers fall back to the scan otherwise."""
+def supported(b, d, act, gate_act, state_act, init_state):
+    """Kernel path preconditions; callers fall back to the scan otherwise.
+    reverse is handled by the caller's time-flip (see rnn._fused_seq_apply)."""
     return (act == "tanh" and gate_act == "sigmoid" and state_act == "tanh"
-            and not reverse and init_state is None
+            and init_state is None
             and b % 8 == 0 and d % _LANES == 0)
 
 

@@ -1,0 +1,147 @@
+"""Fused whole-sequence vanilla RNN (Pallas) — completes the recurrent
+kernel family (reference RecurrentLayer, gserver/layers/RecurrentLayer.cpp:
+h_t = act(x_t + h_{t-1} @ W)).
+
+Same design as ops/pallas/{lstm,gru}.py: the grid is the time loop, W stays
+VMEM-resident, h in VMEM scratch.  tanh only (the reference default);
+other activations use the scan.  Backward is the time-reversed BPTT kernel
+with an in-VMEM dW accumulator; reverse direction via the caller's
+time-flip (see gru.py note).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.ops.pallas.common import LANES as _LANES, lanes as _lanes
+
+
+def _fwd_kernel(xs_ref, w_ref, mask_ref, hs_ref, h_scr, *, d):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = jnp.zeros_like(h_scr)
+
+    h = h_scr[:]
+    x = xs_ref[0].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    h_new = jnp.tanh(x + jax.lax.dot_general(
+        h, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32))
+    m = _lanes(mask_ref[0], d)
+    h = m * h_new + (1.0 - m) * h
+    h_scr[:] = h
+    hs_ref[0] = h.astype(hs_ref.dtype)
+
+
+def _bwd_kernel(hs_ref, hsp_ref, w_ref, mask_ref, dh_out_ref,
+                dxs_ref, dw_ref, dh_scr, dw_scr, *, d, nt):
+    j = pl.program_id(0)
+    t = nt - 1 - j
+
+    @pl.when(j == 0)
+    def _():
+        dh_scr[:] = jnp.zeros_like(dh_scr)
+        dw_scr[:] = jnp.zeros_like(dw_scr)
+
+    h_t = hs_ref[0].astype(jnp.float32)
+    h_prev = jnp.where(t == 0, 0.0, hsp_ref[0].astype(jnp.float32))
+    w = w_ref[:].astype(jnp.float32)
+    m = _lanes(mask_ref[0], d)
+
+    dh = dh_scr[:] + dh_out_ref[0].astype(jnp.float32)
+    # h_t on active steps is tanh(pre); (1 - h^2) is its derivative.  On
+    # masked steps h_t is the frozen carry, but dg is masked out anyway.
+    dg = dh * (1.0 - h_t * h_t) * m
+    dh_prev = jax.lax.dot_general(dg, w, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+    dh_scr[:] = m * dh_prev + (1.0 - m) * dh
+    dw_scr[:] = dw_scr[:] + jax.lax.dot_general(
+        h_prev, dg, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dxs_ref[0] = dg.astype(dxs_ref.dtype)
+
+    @pl.when(j == nt - 1)
+    def _():
+        dw_ref[:] = dw_scr[:]
+
+
+def _fwd(xs, w, mask, interpret):
+    nt, b, d = xs.shape
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, d=d),
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((1, b, d), lambda t: (t, 0, 0)),
+            pl.BlockSpec((d, d), lambda t: (0, 0)),
+            pl.BlockSpec((1, b, _LANES), lambda t: (t, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, b, d), lambda t: (t, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nt, b, d), xs.dtype),
+        scratch_shapes=[pltpu.VMEM((b, d), jnp.float32)],
+        interpret=interpret,
+    )(xs, w, mask)
+
+
+def _bwd(interpret, res, dh_out):
+    w, mask, hs = res
+    nt, b, d = dh_out.shape
+    dxs, dw = pl.pallas_call(
+        functools.partial(_bwd_kernel, d=d, nt=nt),
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec((1, b, d), lambda j: (nt - 1 - j, 0, 0)),
+            pl.BlockSpec((1, b, d),
+                         lambda j: (jnp.maximum(nt - 2 - j, 0), 0, 0)),
+            pl.BlockSpec((d, d), lambda j: (0, 0)),
+            pl.BlockSpec((1, b, _LANES), lambda j: (nt - 1 - j, 0, 0)),
+            pl.BlockSpec((1, b, d), lambda j: (nt - 1 - j, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, d), lambda j: (nt - 1 - j, 0, 0)),
+            pl.BlockSpec((d, d), lambda j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nt, b, d), hs.dtype),
+            jax.ShapeDtypeStruct((d, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, d), jnp.float32),
+            pltpu.VMEM((d, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(hs, hs, w, mask, dh_out)
+    return dxs, dw.astype(w.dtype), None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused(xs, w, mask, interpret):
+    return _fwd(xs, w, mask, interpret)
+
+
+def _fused_fwd_rule(xs, w, mask, interpret):
+    hs = _fwd(xs, w, mask, interpret)
+    return hs, (w, mask, hs)
+
+
+_fused.defvjp(_fused_fwd_rule, _bwd)
+
+
+def supported(b, d, act, init_state):
+    return (act == "tanh" and init_state is None
+            and b % 8 == 0 and d % _LANES == 0)
+
+
+def simple_rnn_fused(xs_tm, mask_tm, w, interpret=None):
+    """xs_tm: [T, B, D] pre-projected inputs (bias included); mask [T, B].
+    Returns (hs_tm, final h)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nt, b, d = xs_tm.shape
+    mask_r = jnp.broadcast_to(
+        mask_tm.astype(jnp.float32)[:, :, None], (nt, b, _LANES))
+    hs = _fused(xs_tm, w, mask_r, interpret)
+    return hs, hs[-1]

@@ -90,9 +90,9 @@ import os as _os
 
 SCAN_UNROLL = int(_os.environ.get("PADDLE_TPU_SCAN_UNROLL", "1"))
 
-# Fused whole-sequence Pallas RNN kernels (ops/pallas/{lstm,gru}.py):
-# weights + state stay VMEM-resident across the time loop instead of
-# round-tripping HBM every scan step.  Gates BOTH the LSTM and GRU kernels.
+# Fused whole-sequence Pallas RNN kernels (ops/pallas/{lstm,gru,
+# simple_rnn}.py): weights + state stay VMEM-resident across the time loop
+# instead of round-tripping HBM every scan step.  Gates ALL THREE kernels.
 # Values: "auto" (default; kernels on real TPU, scan elsewhere — interpret
 # mode is slower than the scan and only useful for testing), "always"
 # (kernels everywhere, interpret off-TPU), "0"/"off" (scan everywhere);
@@ -117,6 +117,20 @@ def _fused_lstm_enabled():
         logger.warning("PADDLE_TPU_FUSED_RNN=%r not recognized "
                        "(auto|always|0); treating as auto", FUSED_LSTM)
     return jax.default_backend() == "tpu"
+
+
+def _fused_seq_apply(seq, xs, ms, reverse, kernel_fn):
+    """Shared fused-kernel dispatch: reverse = forward kernel over
+    time-flipped arrays, flipped back (valid because sequences are
+    left-aligned; masked steps freeze the carry identically either way).
+    Returns (SequenceBatch, final-state) from kernel_fn(xs_tm, ms_tm)."""
+    xs_k = jnp.flip(xs, 0) if reverse else xs
+    ms_k = jnp.flip(ms, 0) if reverse else ms
+    hs_tm, final = kernel_fn(xs_k, ms_k)
+    if reverse:
+        hs_tm = jnp.flip(hs_tm, 0)
+    out = hs_tm.transpose(1, 0, 2) * seq.mask(hs_tm.dtype)[..., None]
+    return SequenceBatch(data=out, lengths=seq.lengths), final
 
 
 def _masked_scan(step, init_carry, xs_time_major, mask_time_major, reverse=False):
@@ -152,14 +166,12 @@ def lstm(seq: SequenceBatch, w_r, bias=None, check_i=None, check_f=None,
         # import inside the branch: a broken pallas install must not take
         # the scan fallback down with it
         from paddle_tpu.ops.pallas import lstm as pl_lstm
-        if pl_lstm.supported(b, d, act, gate_act, state_act,
-                             reverse, init_state):
-            hs_tm, (fh, fc) = pl_lstm.lstm_fused(xs, ms, w_r,
-                                                 check_i, check_f, check_o)
-            out = (hs_tm.transpose(1, 0, 2)
-                   * seq.mask(hs_tm.dtype)[..., None])
-            return (SequenceBatch(data=out, lengths=seq.lengths),
-                    LstmState(h=fh, c=fc))
+        if pl_lstm.supported(b, d, act, gate_act, state_act, init_state):
+            sb, (fh, fc) = _fused_seq_apply(
+                seq, xs, ms, reverse,
+                lambda x, m: pl_lstm.lstm_fused(x, m, w_r, check_i,
+                                                check_f, check_o))
+            return sb, LstmState(h=fh, c=fc)
 
     if init_state is None:
         init_state = LstmState(h=jnp.zeros((b, d), x.dtype),
@@ -189,14 +201,9 @@ def gru(seq: SequenceBatch, w_gate, w_state, bias=None, reverse=False,
     if _fused_lstm_enabled():
         from paddle_tpu.ops.pallas import gru as pl_gru
         if pl_gru.supported(b, d, act, gate_act, init_state):
-            xs_k = jnp.flip(xs, 0) if reverse else xs
-            ms_k = jnp.flip(ms, 0) if reverse else ms
-            hs_tm, fh = pl_gru.gru_fused(xs_k, ms_k, w_gate, w_state)
-            if reverse:
-                hs_tm = jnp.flip(hs_tm, 0)
-            out = (hs_tm.transpose(1, 0, 2)
-                   * seq.mask(hs_tm.dtype)[..., None])
-            return SequenceBatch(data=out, lengths=seq.lengths), fh
+            return _fused_seq_apply(
+                seq, xs, ms, reverse,
+                lambda x, m: pl_gru.gru_fused(x, m, w_gate, w_state))
 
     if init_state is None:
         init_state = jnp.zeros((b, d), x.dtype)
@@ -216,6 +223,14 @@ def simple_rnn(seq: SequenceBatch, w_r, bias=None, reverse=False, act="tanh",
     x = seq.data if bias is None else seq.data + bias
     xs = x.transpose(1, 0, 2)
     ms = seq.mask().transpose(1, 0)
+
+    if _fused_lstm_enabled():
+        from paddle_tpu.ops.pallas import simple_rnn as pl_rnn
+        if pl_rnn.supported(b, d, act, init_state):
+            return _fused_seq_apply(
+                seq, xs, ms, reverse,
+                lambda x, m: pl_rnn.simple_rnn_fused(x, m, w_r))
+
     if init_state is None:
         init_state = jnp.zeros((b, d), x.dtype)
     final, hs = _masked_scan(lambda h, xt: simple_rnn_cell(xt, h, w_r, act),

@@ -78,3 +78,26 @@ def test_fused_zero_length_sequence(np_rng):
     a = _run(seq, w_r, checks, bias, fused=True)
     b = _run(seq, w_r, checks, bias, fused=False)
     np.testing.assert_allclose(float(a), float(b), rtol=2e-5)
+
+
+def test_fused_reverse_matches_scan(np_rng):
+    seq, w_r, checks, bias = _mk(np_rng, ragged=True)
+
+    def loss(fused, xdata):
+        s = SequenceBatch(data=xdata, lengths=seq.lengths)
+        prior = rnn.FUSED_LSTM
+        rnn.FUSED_LSTM = "always" if fused else "0"
+        try:
+            out, final = rnn.lstm(s, w_r, bias=bias, check_i=checks[0],
+                                  check_f=checks[1], check_o=checks[2],
+                                  reverse=True)
+            return (jnp.sum(out.data ** 2) + jnp.sum(final.c ** 2)
+                    + jnp.sum(final.h))
+        finally:
+            rnn.FUSED_LSTM = prior
+
+    a, ga = jax.value_and_grad(lambda x: loss(True, x))(seq.data)
+    b, gb = jax.value_and_grad(lambda x: loss(False, x))(seq.data)
+    np.testing.assert_allclose(float(a), float(b), rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                               rtol=2e-4, atol=2e-5)

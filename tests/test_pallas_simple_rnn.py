@@ -1,0 +1,65 @@
+"""Fused Pallas vanilla RNN vs the lax.scan path — same discipline as the
+LSTM/GRU twins."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import rnn
+
+B, T, D = 8, 7, 128
+
+
+def _mk(np_rng, ragged=True):
+    x = jnp.asarray(np_rng.randn(B, T, D) * 0.3, jnp.float32)
+    lengths = (np_rng.randint(1, T + 1, (B,)) if ragged
+               else np.full((B,), T))
+    seq = SequenceBatch(data=x, lengths=jnp.asarray(lengths, jnp.int32))
+    w = jnp.asarray(np_rng.randn(D, D) * 0.1, jnp.float32)
+    bias = jnp.asarray(np_rng.randn(D) * 0.1, jnp.float32)
+    return seq, w, bias
+
+
+def _run(seq, w, bias, fused, reverse=False):
+    prior = rnn.FUSED_LSTM
+    rnn.FUSED_LSTM = "always" if fused else "0"
+    try:
+        out, final = rnn.simple_rnn(seq, w, bias=bias, reverse=reverse)
+        return jnp.sum(out.data ** 2) + jnp.sum(final ** 2)
+    finally:
+        rnn.FUSED_LSTM = prior
+
+
+@pytest.mark.parametrize("reverse", [False, True], ids=["fwd", "rev"])
+@pytest.mark.parametrize("ragged", [False, True], ids=["full", "ragged"])
+def test_fused_matches_scan_forward(np_rng, reverse, ragged):
+    seq, w, bias = _mk(np_rng, ragged)
+    a = _run(seq, w, bias, fused=True, reverse=reverse)
+    b = _run(seq, w, bias, fused=False, reverse=reverse)
+    np.testing.assert_allclose(float(a), float(b), rtol=2e-5)
+
+
+@pytest.mark.parametrize("reverse", [False, True], ids=["fwd", "rev"])
+def test_fused_matches_scan_grads(np_rng, reverse):
+    seq, w, bias = _mk(np_rng, ragged=True)
+
+    def loss(fused, xdata, w, bias):
+        s = SequenceBatch(data=xdata, lengths=seq.lengths)
+        return _run(s, w, bias, fused, reverse=reverse)
+
+    args = (seq.data, w, bias)
+    ga = jax.grad(lambda *a: loss(True, *a), argnums=(0, 1, 2))(*args)
+    gb = jax.grad(lambda *a: loss(False, *a), argnums=(0, 1, 2))(*args)
+    for la, (a, b) in zip(["dx", "dw", "dbias"], zip(ga, gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5, err_msg=la)
+
+
+def test_fused_zero_length_sequence(np_rng):
+    seq, w, bias = _mk(np_rng, ragged=True)
+    seq = SequenceBatch(data=seq.data, lengths=seq.lengths.at[0].set(0))
+    a = _run(seq, w, bias, fused=True)
+    b = _run(seq, w, bias, fused=False)
+    np.testing.assert_allclose(float(a), float(b), rtol=2e-5)
