@@ -60,6 +60,10 @@ def megatron_rules(extra=()):
     embeddings (tensor parallelism over the 'model' axis)."""
     rules = list(extra) + [
         (r"emb|embedding|table", P(AXIS_MODEL, None)),
+        # attention: q/k/v in-projections column-parallel (head sharding),
+        # out-projection row-parallel — megatron's attention split
+        (r"(^|/)w[qkv]$|wqkv$", P(None, AXIS_MODEL)),
+        (r"(^|/)wo$", P(AXIS_MODEL, None)),
         (r"(w_out|proj_out|o_proj|fc2|down)(/|$)", P(AXIS_MODEL, None)),
         (r"(^|/)(w|w\d+|kernel)$", P(None, AXIS_MODEL)),
     ]

@@ -64,6 +64,24 @@ def _seq2seq_case(np_rng, b=8):
     return params, {"src": src, "trg_in": trg_in, "trg_next": trg_next}, loss_fn
 
 
+def _transformer_case(np_rng, b=8):
+    from paddle_tpu.models import transformer
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=64,
+                              trg_vocab=64, d_model=16, num_heads=2, dff=32,
+                              enc_layers=2, dec_layers=2, max_len=8)
+    src = _seq_feed(np_rng, b, 6, 64)
+    trg_in = _seq_feed(np_rng, b, 5, 64)
+    trg_next = SequenceBatch(np.roll(np.asarray(trg_in.data), -1, axis=1),
+                             trg_in.lengths)
+
+    def loss_fn(p, feed):
+        return transformer.loss(p, feed["src"], feed["trg_in"],
+                                feed["trg_next"], num_heads=2)
+
+    return params, {"src": src, "trg_in": trg_in, "trg_next": trg_next}, \
+        loss_fn
+
+
 def _resnet_case(np_rng, b=8):
     # f64: conv reduction order differs between sharded and unsharded
     # layouts, so f32 accumulation noise (up to ~1e-2 relative on
@@ -114,6 +132,27 @@ def _run_sharded_vs_single(case, mesh_cfg, rules=None, rtol=1e-4, atol=1e-5):
 def test_seq2seq_model_parallel():
     """Megatron tensor parallelism over 'model' (8-way) == single device."""
     _run_sharded_vs_single(_seq2seq_case, MeshConfig(data=1, model=8),
+                           megatron_rules())
+
+
+@needs_8
+def test_transformer_model_parallel():
+    """Attention-stack tensor parallelism (qkv column / out row shards via
+    the megatron rules) == single device — covers the MHA path under GSPMD
+    partitioning (XLA attention on the CPU mesh)."""
+    rules = megatron_rules()
+    # the rules must actually shard the attention projections (a prior
+    # version replicated them, silently weakening this test)
+    from paddle_tpu.parallel.sharding import AXIS_MODEL
+    assert tuple(rules.spec_for("enc/0/attn/wq")) == (None, AXIS_MODEL)
+    assert tuple(rules.spec_for("enc/0/attn/wo")) == (AXIS_MODEL, None)
+    _run_sharded_vs_single(_transformer_case, MeshConfig(data=1, model=8),
+                           rules)
+
+
+@needs_8
+def test_transformer_data_model_mesh():
+    _run_sharded_vs_single(_transformer_case, MeshConfig(data=2, model=4),
                            megatron_rules())
 
 
