@@ -120,8 +120,28 @@ def main(argv=None):
     if args.job == "version":
         from paddle_tpu.version import __version__
         import jax
-        print(f"paddle_tpu {__version__} (jax {jax.__version__}, "
-              f"devices: {jax.devices()})")
+        print(f"paddle_tpu {__version__} (jax {jax.__version__})",
+              flush=True)
+        # device discovery can hang indefinitely when a remote TPU backend
+        # is wedged — version must still answer (bounded probe, reference
+        # `paddle version` prints with no device touch at all)
+        import threading
+        res = {}
+
+        def _probe():
+            try:
+                res["devices"] = jax.devices()
+            except Exception as e:   # noqa: BLE001
+                res["devices"] = f"unavailable: {type(e).__name__}"
+
+        try:
+            t_probe = float(os.environ.get("PADDLE_TPU_PROBE_TIMEOUT", "20"))
+        except ValueError:
+            t_probe = 20.0
+        th = threading.Thread(target=_probe, daemon=True)
+        th.start()
+        th.join(timeout=t_probe)
+        print(f"devices: {res.get('devices', 'probe timed out (backend wedged?)')}")
         return 0
 
     if args.job == "merge_model":
