@@ -138,6 +138,8 @@ def main(argv=None):
             t_probe = float(os.environ.get("PADDLE_TPU_PROBE_TIMEOUT", "20"))
         except ValueError:
             t_probe = 20.0
+        if not (t_probe > 0):          # rejects <=0 and NaN
+            t_probe = 20.0
         th = threading.Thread(target=_probe, daemon=True)
         th.start()
         th.join(timeout=t_probe)
