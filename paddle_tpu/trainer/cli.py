@@ -187,7 +187,14 @@ def main(argv=None):
         mesh = make_mesh(MeshConfig(data=args.data_parallel,
                                     model=args.model_parallel,
                                     seq=args.seq_parallel))
-    trainer = SGD(cost=cfg["cost"], update_equation=cfg["optimizer"],
+    optimizer = cfg.get("optimizer")
+    if optimizer is None:
+        # same default as the v1 settings() compat path (compat/v1.py:
+        # MomentumOptimizer(momentum=0) at learning_rate=1e-3) so the two
+        # config styles train identically when no optimizer is named
+        from paddle_tpu import optim
+        optimizer = optim.Momentum(learning_rate=1e-3, momentum=0.0)
+    trainer = SGD(cost=cfg["cost"], update_equation=optimizer,
                   mesh=mesh,
                   sharding_rules=cfg.get("sharding_rules"),
                   evaluators=cfg.get("evaluators"))
