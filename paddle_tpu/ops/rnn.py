@@ -49,6 +49,7 @@ def lstm_cell(x4, state: LstmState, w_r, check_i=None, check_f=None,
     a = act_f(a)
     if check_i is not None:
         ig = ig + state.c * check_i
+    if check_f is not None:
         fg = fg + state.c * check_f
     i = gate_f(ig)
     f = gate_f(fg)

@@ -62,7 +62,7 @@ def megatron_rules(extra=()):
         (r"emb|embedding|table", P(AXIS_MODEL, None)),
         # attention: q/k/v in-projections column-parallel (head sharding),
         # out-projection row-parallel — megatron's attention split
-        (r"(^|/)w[qkv]$|wqkv$", P(None, AXIS_MODEL)),
+        (r"(^|/)(w[qkv]|wqkv)$", P(None, AXIS_MODEL)),
         (r"(^|/)wo$", P(AXIS_MODEL, None)),
         (r"(w_out|proj_out|o_proj|fc2|down)(/|$)", P(AXIS_MODEL, None)),
         (r"(^|/)(w|w\d+|kernel)$", P(None, AXIS_MODEL)),

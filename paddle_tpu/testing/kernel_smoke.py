@@ -1,0 +1,142 @@
+"""Pallas kernel smoke checks: compile every kernel on the LIVE backend and
+verify numerics against the pure-XLA oracle.
+
+Motivation (round-2 verdict): interpret-mode passing is not a compile proof —
+round 1's flash-attention lse layout was rejected by Mosaic only on first
+real-TPU contact.  This module gives `bench.py --smoke-kernels` (and
+tests/test_kernel_smoke.py) a seconds-long canary that exercises every
+custom kernel's forward AND backward through a real Mosaic compile.
+
+Each case returns the max abs error vs the oracle and raises AssertionError
+if it exceeds the case tolerance.  Mirrors the reference's per-kernel unit
+tests (test_LstmLayer / test_MatrixCompare pattern, SURVEY §4), but backend-
+aware: on CPU the kernels run in interpret mode, on TPU through Mosaic.
+"""
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.sequence import SequenceBatch
+
+
+@contextlib.contextmanager
+def _fused_mode(mode):
+    """Temporarily force the fused-RNN dispatch mode ('always' | '0')."""
+    from paddle_tpu.ops import rnn
+    old = rnn.FUSED_LSTM
+    rnn.FUSED_LSTM = mode
+    try:
+        yield
+    finally:
+        rnn.FUSED_LSTM = old
+
+
+def _max_err(a, b):
+    return float(jnp.max(jnp.abs(jnp.asarray(a, jnp.float32)
+                                 - jnp.asarray(b, jnp.float32))))
+
+
+def _rnn_case(kind, tol=1e-2):
+    """Fused-vs-scan equality (fwd + full BPTT grads) through the public
+    rnn.{lstm,gru,simple_rnn} dispatch, on whatever backend is live."""
+    from paddle_tpu.ops import rnn
+
+    b, t, d = 8, 12, 128
+    gates = {"lstm": 4, "gru": 3, "simple_rnn": 1}[kind]
+    rng = np.random.RandomState(7)
+    data = jnp.asarray(rng.randn(b, t, gates * d) * 0.3, jnp.float32)
+    lengths = jnp.asarray(rng.randint(1, t + 1, (b,)), jnp.int32)
+    probe = jnp.asarray(rng.randn(b, t, d), jnp.float32)
+
+    if kind == "lstm":
+        w = jnp.asarray(rng.randn(d, 4 * d) * 0.05, jnp.float32)
+        checks = [jnp.asarray(rng.randn(d) * 0.1, jnp.float32)
+                  for _ in range(3)]
+
+        def loss(data, w):
+            out, final = rnn.lstm(SequenceBatch(data=data, lengths=lengths),
+                                  w, check_i=checks[0], check_f=checks[1],
+                                  check_o=checks[2])
+            return (jnp.sum(out.data * probe) + jnp.sum(final.h)
+                    + jnp.sum(final.c))
+    elif kind == "gru":
+        wg = jnp.asarray(rng.randn(d, 2 * d) * 0.05, jnp.float32)
+        ws = jnp.asarray(rng.randn(d, d) * 0.05, jnp.float32)
+
+        def loss(data, w):
+            out, final = rnn.gru(SequenceBatch(data=data, lengths=lengths),
+                                 w, ws)
+            return jnp.sum(out.data * probe) + jnp.sum(final)
+        w = wg
+    else:
+        w = jnp.asarray(rng.randn(d, d) * 0.05, jnp.float32)
+
+        def loss(data, w):
+            out, final = rnn.simple_rnn(
+                SequenceBatch(data=data, lengths=lengths), w)
+            return jnp.sum(out.data * probe) + jnp.sum(final)
+
+    # fresh jit wrapper per mode: the dispatch flag is read at TRACE time,
+    # so a shared wrapper would silently reuse the first mode's trace
+    with _fused_mode("always"):
+        l_k, (gx_k, gw_k) = jax.jit(
+            jax.value_and_grad(loss, argnums=(0, 1)))(data, w)
+        jax.block_until_ready(l_k)
+    with _fused_mode("0"):
+        l_o, (gx_o, gw_o) = jax.jit(
+            jax.value_and_grad(loss, argnums=(0, 1)))(data, w)
+        jax.block_until_ready(l_o)
+
+    err = max(_max_err(l_k, l_o),
+              _max_err(gx_k, gx_o),
+              _max_err(gw_k, gw_o) / max(1.0, float(jnp.abs(gw_o).max())))
+    assert err <= tol, f"{kind} fused-vs-scan max err {err:.3e} > tol {tol}"
+    return err
+
+
+def _flash_case(causal, tol=0.05):
+    """Flash attention fwd+bwd vs materialized-softmax oracle."""
+    import importlib
+    # the pallas package re-exports the flash_attention FUNCTION under the
+    # module's name; import the module itself explicitly
+    fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+    from paddle_tpu.ops import attention as attn
+
+    b, h, t, d = 2, 2, 512, 128
+    rng = np.random.RandomState(3)
+    q, k, v = (jnp.asarray(rng.randn(b, h, t, d) * 0.5, jnp.float32)
+               for _ in range(3))
+    probe = jnp.asarray(rng.randn(b, h, t, d), jnp.float32)
+
+    def loss_flash(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=causal,
+                               block_q=256, block_k=256)
+        return jnp.sum(o * probe)
+
+    def loss_oracle(q, k, v):
+        o = attn.dot_product_attention(q, k, v, scale=1.0 / np.sqrt(d),
+                                       causal=causal, use_flash=False)
+        return jnp.sum(o * probe)
+
+    lf, gf = jax.jit(jax.value_and_grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    jax.block_until_ready(lf)
+    lo, go = jax.jit(jax.value_and_grad(loss_oracle, argnums=(0, 1, 2)))(q, k, v)
+    jax.block_until_ready(lo)
+
+    err = max(_max_err(lf, lo) / max(1.0, abs(float(lo))),
+              max(_max_err(a, b) for a, b in zip(gf, go)))
+    assert err <= tol, (f"flash(causal={causal}) max err {err:.3e} "
+                        f"> tol {tol}")
+    return err
+
+
+CASES = {
+    "lstm_fused": lambda: _rnn_case("lstm"),
+    "gru_fused": lambda: _rnn_case("gru"),
+    "simple_rnn_fused": lambda: _rnn_case("simple_rnn"),
+    "flash_attention": lambda: _flash_case(causal=False),
+    "flash_attention_causal": lambda: _flash_case(causal=True),
+}

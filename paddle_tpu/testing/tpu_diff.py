@@ -257,7 +257,11 @@ def supervise(platform, out_path, case_timeout=150.0, max_consec_fail=4):
             # __optim__: write NOTHING — completed sub-cases are cached, so
             # the next run resumes the group from where the kill landed
             # instead of a marker-file record hiding the missing tail.
-            if name != "__optim__":
+            # If the worker already wrote a healthy .npz and only wedged on
+            # exit, keep the real result — don't overwrite it with a
+            # timeout record.
+            if name != "__optim__" and not (
+                    os.path.exists(marker) and not _is_error_record(marker)):
                 np.savez_compressed(
                     marker,
                     __error__=np.frombuffer(

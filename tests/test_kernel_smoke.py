@@ -1,0 +1,17 @@
+"""The bench.py --smoke-kernels cases run (interpret mode) on CPU.
+
+The same CASES dict is what runs through a real Mosaic compile on TPU; this
+test keeps the harness itself honest (oracle wiring, fresh-trace dispatch,
+tolerances) so an on-chip failure can only mean a lowering/numerics problem.
+"""
+
+import pytest
+
+from paddle_tpu.testing import kernel_smoke
+
+
+@pytest.mark.parametrize("name", sorted(kernel_smoke.CASES))
+def test_kernel_smoke_case(name):
+    err = kernel_smoke.CASES[name]()
+    assert err == err  # not NaN
+    assert err < 0.05
