@@ -87,7 +87,8 @@ class ParameterAttribute(dict):
                  initial_mean=None, initial_max=None, initial_min=None,
                  l1_rate=None, l2_rate=None, learning_rate=None,
                  momentum=None, gradient_clipping_threshold=None,
-                 sparse_update=False, initial_strategy=0):
+                 sparse_update=False, initial_strategy=0,
+                 update_hooks=None):
         d = {}
         if name is not None:
             d["name"] = name
@@ -116,6 +117,8 @@ class ParameterAttribute(dict):
             d["gradient_clipping_threshold"] = gradient_clipping_threshold
         if sparse_update:
             d["sparse_update"] = True
+        if update_hooks is not None:
+            d["update_hooks"] = update_hooks
         super().__init__(d)
 
     @staticmethod
@@ -150,10 +153,24 @@ ExtraAttr = ExtraLayerAttribute
 
 
 class HookAttribute(dict):
-    """Reference HookAttribute (e.g. pruning hooks); accepted, inert."""
+    """Static pruning hook (reference ParameterUpdaterHook.cpp:36).
 
-    def __init__(self, type="pruning", sparsity_ratio=None):
-        super().__init__(type=type, sparsity_ratio=sparsity_ratio)
+    sparsity_ratio=r prunes the r fraction of smallest-|w| weights at init;
+    mask_filename loads the reference's packed-bit mask file.  Attach via
+    ParameterAttribute(update_hooks=...); the trainer masks the parameter
+    value at init and its gradient every step (trainer/hooks.py)."""
+
+    def __init__(self, type="pruning", sparsity_ratio=None,
+                 mask_filename=None):
+        d = dict(type=type)
+        if sparsity_ratio is not None:
+            if not 0.0 <= sparsity_ratio <= 1.0:
+                raise ValueError(
+                    f"sparsity_ratio must be in [0, 1], got {sparsity_ratio}")
+            d["sparsity_ratio"] = sparsity_ratio
+        if mask_filename is not None:
+            d["mask_filename"] = mask_filename
+        super().__init__(d)
 
 
 HookAttr = HookAttribute

@@ -27,7 +27,9 @@ from paddle_tpu.data import reader as reader_mod
 from paddle_tpu.layers.graph import Topology, LayerOutput
 from paddle_tpu.optim.optimizers import Optimizer
 from paddle_tpu.trainer import events
+from paddle_tpu.trainer import hooks as param_hooks
 from paddle_tpu.trainer.checkpoint import save_checkpoint, load_checkpoint
+from paddle_tpu.utils.error import ConfigError
 from paddle_tpu.utils.logging import logger
 from paddle_tpu.utils.stats import timer, global_stats
 from paddle_tpu.parallel import (
@@ -90,6 +92,18 @@ class SGD:
         self.parameters = parameters if parameters is not None \
             else self.topology.init(init_rng)
         self._sparse_specs = self._find_sparse_specs()
+        # static pruning hooks (reference ParameterUpdaterHook.cpp:36):
+        # mask values once at init, mask grads every step
+        self._prune_masks = param_hooks.build_masks(
+            self.topology, self.parameters)
+        for k in self._prune_masks:
+            if k in self._sparse_specs:
+                raise ConfigError(
+                    f"pruning hook on {k!r}: sparse_update tables can't be "
+                    "statically pruned (the row path rewrites the table)")
+        if self._prune_masks:
+            self.parameters = param_hooks.apply_masks(
+                self.parameters, self._prune_masks)
         dense_params = {k: v for k, v in self.parameters.items()
                         if k not in self._sparse_specs}
         self.opt_state = self.optimizer.init(dense_params) \
@@ -187,9 +201,13 @@ class SGD:
                     n += int(np.prod(d.shape))
                 return sparse_ops.default_row_budget(n)
 
+        prune_masks = self._prune_masks
+
         def dense_step(params, opt_state, state, feed, rng):
             (loss, (new_state, extras)), grads = jax.value_and_grad(
                 self._loss_and_extras, has_aux=True)(params, state, feed, rng)
+            if prune_masks:
+                grads = param_hooks.apply_masks(grads, prune_masks)
             new_params, new_opt = self.optimizer.update(grads, opt_state, params)
             merged_state = {**state, **new_state}
             return new_params, new_opt, merged_state, loss, extras
@@ -462,6 +480,7 @@ class SGD:
             self.opt_state = opt_state
         if model_state is not None:
             self.model_state = model_state
+        self._refresh_prune_masks()
         return meta
 
     def load_parameters(self, save_dir, pass_id=None,
@@ -471,7 +490,6 @@ class SGD:
         params present in the checkpoint are taken; params absent follow
         missing_strategy = fail | rand | zero (rand keeps this trainer's
         fresh initialization, the reference's 'rand' semantics)."""
-        from paddle_tpu.utils.error import ConfigError
         params, _opt, model_state, _ = load_checkpoint(save_dir, pass_id)
         merged = {}
         for key, init_val in self.parameters.items():
@@ -492,6 +510,22 @@ class SGD:
         self.parameters = merged
         if model_state:
             self.model_state = {**self.model_state, **model_state}
+        self._refresh_prune_masks()
+
+    def _refresh_prune_masks(self):
+        """Re-derive pruning masks after self.parameters was replaced
+        (checkpoint load / warm start): a sparsity_ratio mask must reflect
+        the LOADED weights, not the discarded random init (a resumed pruned
+        model re-masks to exactly its checkpointed zeros), and the value
+        mask is re-applied.  The cached step closure holds the old masks,
+        so it is invalidated too."""
+        if not self._prune_masks:
+            return
+        self._prune_masks = param_hooks.build_masks(
+            self.topology, self.parameters)
+        self.parameters = param_hooks.apply_masks(
+            self.parameters, self._prune_masks)
+        self._step_fn = None
 
     def log_layer_stats(self, feed):
         """Per-layer output abs-mean/abs-max on one batch (reference

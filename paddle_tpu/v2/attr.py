@@ -39,3 +39,7 @@ def Extra(drop_rate=None, **kw):
 
 
 ExtraAttr = ExtraLayerAttribute = Extra
+
+from paddle_tpu.compat.v1 import HookAttribute  # noqa: E402
+
+Hook = HookAttr = HookAttribute

@@ -1,0 +1,198 @@
+"""Static pruning hooks (reference ParameterUpdaterHook.cpp StaticPruningHook):
+value masked at init, gradient masked every update, so pruned weights stay
+exactly zero through real training."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu import optim
+from paddle_tpu.compat.v1 import HookAttribute, ParameterAttribute
+from paddle_tpu.core.sequence import SequenceBatch  # noqa: F401 (feed types)
+from paddle_tpu.layers import api as L
+from paddle_tpu.trainer import hooks
+from paddle_tpu.trainer.trainer import SGD
+from paddle_tpu.utils.error import ConfigError
+
+
+def _toy_net(ratio=0.5, hook=None):
+    x = L.data_layer("x", size=16)
+    y = L.data_layer("y", size=1)
+    hook = hook or HookAttribute(type="pruning", sparsity_ratio=ratio)
+    h = L.fc_layer(input=x, size=32, act="tanh", name="hidden",
+                   param_attr=ParameterAttribute(update_hooks=hook))
+    out = L.fc_layer(input=h, size=1, act="sigmoid", name="out")
+    from paddle_tpu.layers.api import mse_cost
+    cost = mse_cost(input=out, label=y)
+    return cost
+
+
+def _feed(rng, n=64):
+    x = rng.randn(n, 16).astype(np.float32)
+    y = (x[:, :4].sum(1, keepdims=True) > 0).astype(np.float32)
+    return {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+
+
+def _sparsity(arr):
+    a = np.asarray(arr)
+    return float((a == 0).mean())
+
+
+def test_ratio_mask_applied_at_init_and_through_training():
+    ratio = 0.5
+    tr = SGD(cost=_toy_net(ratio),
+             update_equation=optim.Momentum(learning_rate=0.1, momentum=0.9))
+    w0 = np.asarray(tr.parameters["hidden"]["w0"])
+    assert _sparsity(w0) >= ratio - 0.02
+    # bias is a separate parameter: its mask is all-ones (never pruned)
+    assert (np.asarray(tr._prune_masks["hidden"]["b"]) == 1).all()
+
+    rng = np.random.RandomState(0)
+    reader = lambda: iter([_feed(rng) for _ in range(20)])
+    losses = []
+    tr.train(reader, num_passes=1,
+             event_handler=lambda e: losses.append(e.cost)
+             if hasattr(e, "cost") else None)
+    w1 = np.asarray(tr.parameters["hidden"]["w0"])
+    # pruned positions stayed exactly zero; kept positions trained
+    assert _sparsity(w1) >= ratio - 0.02
+    assert (w1[w0 == 0] == 0).all()
+    assert np.abs(w1 - w0).max() > 0
+    assert losses[-1] < losses[0]
+
+
+def test_gradients_masked_in_step():
+    tr = SGD(cost=_toy_net(0.7),
+             update_equation=optim.Adam(learning_rate=0.01))
+    mask = np.asarray(tr._prune_masks["hidden"]["w0"])
+    rng = np.random.RandomState(1)
+    tr.train(lambda: iter([_feed(rng)]), num_passes=1)
+    w = np.asarray(tr.parameters["hidden"]["w0"])
+    assert (w[mask == 0] == 0).all()
+    # adam moves every unmasked weight off its init on step one
+    assert np.abs(w[mask == 1]).min() > 0
+
+
+def test_mask_file_round_trip(tmp_path):
+    rng = np.random.RandomState(2)
+    bits = rng.randint(0, 2, (16 * 32,)).astype(np.float32)
+    path = str(tmp_path / "mask.bin")
+    hooks.write_mask_file(path, bits)
+    back = hooks.load_mask_file(path, expect_size=bits.size)
+    np.testing.assert_array_equal(back, bits)
+    # odd (non-multiple-of-8) size exercises the padded tail byte
+    hooks.write_mask_file(path, bits[:13])
+    np.testing.assert_array_equal(hooks.load_mask_file(path), bits[:13])
+
+
+def test_mask_file_drives_training(tmp_path):
+    rng = np.random.RandomState(3)
+    bits = rng.randint(0, 2, (16 * 32,)).astype(np.float32)
+    path = str(tmp_path / "mask.bin")
+    hooks.write_mask_file(path, bits)
+
+    x = L.data_layer("x", size=16)
+    y = L.data_layer("y", size=1)
+    h = L.fc_layer(input=x, size=32, act="tanh", name="hidden",
+                   bias_attr=False,
+                   param_attr=ParameterAttribute(
+                       update_hooks=HookAttribute(mask_filename=path)))
+    out = L.fc_layer(input=h, size=1, act="sigmoid", name="out")
+    from paddle_tpu.layers.api import mse_cost
+    tr = SGD(cost=mse_cost(input=out, label=y),
+             update_equation=optim.Momentum(learning_rate=0.1, momentum=0.9))
+    w = np.asarray(tr.parameters["hidden"]["w0"])
+    assert (w.reshape(-1)[bits == 0] == 0).all()
+    tr.train(lambda: iter([_feed(np.random.RandomState(4))]), num_passes=1)
+    w1 = np.asarray(tr.parameters["hidden"]["w0"])
+    assert (w1.reshape(-1)[bits == 0] == 0).all()
+
+
+def test_param_attr_list_hooks():
+    """fc_layer accepts one ParamAttr per input; a hook on one input's attr
+    masks only that input's weight."""
+    a = L.data_layer("a", size=8)
+    b = L.data_layer("b", size=8)
+    y = L.data_layer("y", size=1)
+    h = L.fc_layer(input=[a, b], size=32, act="tanh", name="h2",
+                   param_attr=[
+                       ParameterAttribute(update_hooks=HookAttribute(
+                           type="pruning", sparsity_ratio=0.5)),
+                       ParameterAttribute()])
+    out = L.fc_layer(input=h, size=1, act="sigmoid")
+    from paddle_tpu.layers.api import mse_cost
+    tr = SGD(cost=mse_cost(input=out, label=y),
+             update_equation=optim.Momentum(learning_rate=0.1, momentum=0.9))
+    assert _sparsity(tr.parameters["h2"]["w0"]) >= 0.48
+    assert _sparsity(tr.parameters["h2"]["w1"]) < 0.1
+    assert (np.asarray(tr._prune_masks["h2"]["w1"]) == 1).all()
+
+
+def test_projection_hooks_in_mixed_layer():
+    from paddle_tpu.layers.api import full_matrix_projection, mixed_layer
+    x = L.data_layer("x", size=16)
+    y = L.data_layer("y", size=1)
+    m = mixed_layer(
+        input=[full_matrix_projection(
+            x, param_attr=ParameterAttribute(update_hooks=HookAttribute(
+                type="pruning", sparsity_ratio=0.6)))],
+        size=32, act="tanh", name="mx")
+    out = L.fc_layer(input=m, size=1, act="sigmoid")
+    from paddle_tpu.layers.api import mse_cost
+    tr = SGD(cost=mse_cost(input=out, label=y),
+             update_equation=optim.Momentum(learning_rate=0.1, momentum=0.9))
+    assert _sparsity(tr.parameters["mx"]["w0"]) >= 0.58
+
+
+def test_masks_rebuilt_on_checkpoint_load(tmp_path):
+    """Resume keeps the checkpointed zeros pinned: masks re-derive from the
+    LOADED weights, not the fresh random init."""
+    ratio = 0.5
+    tr = SGD(cost=_toy_net(ratio),
+             update_equation=optim.Momentum(learning_rate=0.1, momentum=0.9))
+    rng = np.random.RandomState(5)
+    tr.train(lambda: iter([_feed(rng) for _ in range(5)]), num_passes=1)
+    w_saved = np.asarray(tr.parameters["hidden"]["w0"])
+    tr.save(str(tmp_path), pass_id=0)
+
+    tr2 = SGD(cost=_toy_net(ratio), seed=99,
+              update_equation=optim.Momentum(learning_rate=0.1, momentum=0.9))
+    tr2.load(str(tmp_path))
+    np.testing.assert_array_equal(
+        np.asarray(tr2.parameters["hidden"]["w0"]), w_saved)
+    # masks now match the checkpoint's zeros, and training keeps them zero
+    mask = np.asarray(tr2._prune_masks["hidden"]["w0"])
+    assert ((w_saved == 0) == (mask == 0)).all()
+    tr2.train(lambda: iter([_feed(rng) for _ in range(5)]), num_passes=1)
+    w_after = np.asarray(tr2.parameters["hidden"]["w0"])
+    assert (w_after[w_saved == 0] == 0).all()
+    assert np.abs(w_after - w_saved).max() > 0
+
+
+def test_mask_file_truncated_payload(tmp_path):
+    path = str(tmp_path / "mask.bin")
+    hooks.write_mask_file(path, np.ones(64))
+    data = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(data[:-3])
+    with pytest.raises(ConfigError, match="truncated"):
+        hooks.load_mask_file(path)
+
+
+def test_mask_file_size_mismatch(tmp_path):
+    path = str(tmp_path / "mask.bin")
+    hooks.write_mask_file(path, np.ones(10))
+    with pytest.raises(ConfigError, match="size"):
+        hooks.load_mask_file(path, expect_size=11)
+
+
+def test_unknown_hook_type_errors():
+    with pytest.raises(ConfigError, match="hook type"):
+        SGD(cost=_toy_net(hook={"type": "quantize"}),
+            update_equation=optim.Momentum(learning_rate=0.1, momentum=0.9))
+
+
+def test_hook_without_spec_errors():
+    with pytest.raises(ConfigError, match="sparsity_ratio"):
+        SGD(cost=_toy_net(hook={"type": "pruning"}),
+            update_equation=optim.Momentum(learning_rate=0.1, momentum=0.9))
