@@ -17,6 +17,10 @@ Usage:
 
 Requires passwordless ssh to each host and the repo available at the same
 path everywhere (reference conf.py HOSTS assumption).
+
+`--local N` fans out N ranks as plain subprocesses on THIS machine instead
+of ssh — the single-machine bring-up / debugging mode (and what the
+multi-process distributed test drives).
 """
 
 import argparse
@@ -27,12 +31,16 @@ import subprocess
 import sys
 
 
-def build_ssh_cmd(host, rank, args, command):
-    env = {
-        "PADDLE_TPU_COORDINATOR": f"{args.hosts[0]}:{args.port}",
-        "PADDLE_TPU_NUM_PROCESSES": str(len(args.hosts)),
+def rendezvous_env(coordinator_host, port, world_size, rank):
+    return {
+        "PADDLE_TPU_COORDINATOR": f"{coordinator_host}:{port}",
+        "PADDLE_TPU_NUM_PROCESSES": str(world_size),
         "PADDLE_TPU_PROCESS_ID": str(rank),
     }
+
+
+def build_ssh_cmd(host, rank, args, command):
+    env = rendezvous_env(args.hosts[0], args.port, len(args.hosts), rank)
     env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
     remote = f"cd {shlex.quote(args.workdir)} && {env_str} {command}"
     return ["ssh", "-o", "BatchMode=yes", host, remote]
@@ -42,14 +50,19 @@ def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="paddle_tpu.launch_cluster",
         usage="%(prog)s --hosts h1,h2 [--port P] [--workdir D] -- command…")
-    parser.add_argument("--hosts", required=True,
+    parser.add_argument("--hosts",
                         help="comma-separated host list; first = coordinator")
+    parser.add_argument("--local", type=int, metavar="N",
+                        help="run N ranks as local subprocesses (no ssh)")
     parser.add_argument("--port", type=int, default=8476)
     parser.add_argument("--workdir", default=os.getcwd())
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command to run on every host")
     args = parser.parse_args(argv)
-    args.hosts = [h.strip() for h in args.hosts.split(",") if h.strip()]
+    if (args.hosts is None) == (args.local is None):
+        parser.error("exactly one of --hosts / --local N is required")
+    if args.local is not None and args.local < 1:
+        parser.error(f"--local needs a positive rank count, got {args.local}")
     cmd_parts = list(args.command)
     if cmd_parts and cmd_parts[0] == "--":
         cmd_parts = cmd_parts[1:]
@@ -58,11 +71,34 @@ def main(argv=None):
         parser.error("missing training command after --")
 
     procs = []
+
+    def _terminate(signum, frame):
+        # SIGTERM must reap the ranks like ^C does, or a killed launcher
+        # orphans every worker (they re-parent and hold the coordinator port)
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            p.wait()
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _terminate)
     try:
-        for rank, host in enumerate(args.hosts):
-            cmd = build_ssh_cmd(host, rank, args, command)
-            print(f"[launch] rank {rank} @ {host}: {command}", flush=True)
-            procs.append(subprocess.Popen(cmd))
+        if args.local:
+            for rank in range(args.local):
+                env = dict(os.environ)
+                env.update(rendezvous_env("127.0.0.1", args.port,
+                                          args.local, rank))
+                print(f"[launch] local rank {rank}: {command}", flush=True)
+                procs.append(subprocess.Popen(
+                    cmd_parts, env=env, cwd=args.workdir))
+        else:
+            args.hosts = [h.strip() for h in args.hosts.split(",")
+                          if h.strip()]
+            for rank, host in enumerate(args.hosts):
+                cmd = build_ssh_cmd(host, rank, args, command)
+                print(f"[launch] rank {rank} @ {host}: {command}",
+                      flush=True)
+                procs.append(subprocess.Popen(cmd))
         rc = 0
         for p in procs:
             rc = p.wait() or rc

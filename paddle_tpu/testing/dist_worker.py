@@ -1,0 +1,93 @@
+"""Multi-process distributed bring-up worker (test fixture).
+
+Run as `python -m paddle_tpu.testing.dist_worker OUT_DIR` under the
+PADDLE_TPU_* rendezvous env vars (parallel/distributed.py:12-18).  Each
+process connects through jax.distributed.initialize, builds a global mesh
+over every process's devices, and trains a tiny data-parallel model where
+each process feeds ONLY its own shard of the global batch — the
+multi-controller SPMD shape of a real multi-host TPU job.  The final loss
+and a parameter checksum are written to OUT_DIR/rank{i}.json so the test
+can assert 2-process == 1-process numerics (the reference proved its
+distributed plane the same way: test_CompareSparse.cpp:66-87 trains
+against in-process pservers and compares with local training).
+"""
+
+import json
+import os
+import sys
+
+
+def main(out_dir):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    # a sitecustomize hook may pin jax_platforms to the TPU tunnel at
+    # interpreter startup; the env var alone does not override it
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.parallel import distributed as dist
+    dist.init_distributed()
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    nproc = jax.process_count()
+    rank = jax.process_index()
+    assert nproc == int(os.environ["PADDLE_TPU_NUM_PROCESSES"])
+
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    repl = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P("data"))
+
+    # identical init on every process (replicated params)
+    rng = np.random.RandomState(0)
+    params = {
+        "w1": jnp.asarray(rng.randn(8, 16) * 0.5, jnp.float32),
+        "b1": jnp.zeros((16,), jnp.float32),
+        "w2": jnp.asarray(rng.randn(16, 1) * 0.5, jnp.float32),
+    }
+    params = jax.device_put(params, repl)
+
+    B, STEPS = 32, 20
+    xs = rng.randn(STEPS, B, 8).astype(np.float32)
+    ys = (xs[..., :3].sum(-1, keepdims=True) > 0).astype(np.float32)
+
+    def loss_fn(p, x, y):
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        pred = jax.nn.sigmoid(h @ p["w2"])
+        return jnp.mean((pred - y) ** 2)
+
+    @jax.jit
+    def step(p, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        p = jax.tree_util.tree_map(lambda w, gw: w - 0.5 * gw, p, g)
+        return p, loss
+
+    per = B // nproc
+    loss = first_loss = None
+    for t in range(STEPS):
+        # each process contributes ONLY its slice of the global batch
+        lo = rank * per
+        x = jax.make_array_from_process_local_data(
+            shard, xs[t, lo:lo + per], (B, 8))
+        y = jax.make_array_from_process_local_data(
+            shard, ys[t, lo:lo + per], (B, 1))
+        params, loss = step(params, x, y)
+        if first_loss is None:
+            first_loss = float(loss)
+
+    dist.barrier("final")
+    checksum = float(sum(jnp.sum(jnp.abs(v)) for v in
+                         jax.tree_util.tree_leaves(params)))
+    out = {"rank": rank, "nproc": nproc, "loss": float(loss),
+           "first_loss": first_loss, "checksum": checksum,
+           "global_devices": jax.device_count(),
+           "coordinator": dist.is_coordinator()}
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(out, f)
+    print(f"[dist_worker] rank {rank}/{nproc} loss={out['loss']:.6f} "
+          f"checksum={checksum:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -35,3 +35,13 @@ def rng():
 @pytest.fixture
 def np_rng():
     return np.random.RandomState(0)
+
+
+def free_port():
+    """An OS-assigned free TCP port for multi-process rendezvous tests."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -232,7 +232,8 @@ def test_two_process_distributed_cpu():
     """Real multi-controller run: 2 processes x 4 CPU devices, gloo
     collectives, one data-parallel Momentum step; both ranks must see the
     same loss/params, equal to the single-process result."""
-    port = _free_port()
+    from conftest import free_port
+    port = free_port()
     procs = [subprocess.Popen(
         [sys.executable, "-c", _WORKER, str(rank), str(port)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -254,14 +255,6 @@ def test_two_process_distributed_cpu():
     ref = _single_process_reference()
     np.testing.assert_allclose(outs[0]["loss"], ref[0], rtol=1e-5)
     np.testing.assert_allclose(outs[0]["wsum"], ref[1], rtol=1e-5)
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def _toy_data():
