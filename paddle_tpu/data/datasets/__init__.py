@@ -9,7 +9,7 @@ training pipelines runnable end-to-end anywhere.
 """
 
 from paddle_tpu.data.datasets import mnist, cifar, imdb, uci_housing, \
-    movielens, imikolov, wmt14, conll05
+    movielens, imikolov, wmt14, conll05, sentiment
 
 __all__ = ["mnist", "cifar", "imdb", "uci_housing", "movielens", "imikolov",
-           "wmt14", "conll05"]
+           "wmt14", "conll05", "sentiment"]

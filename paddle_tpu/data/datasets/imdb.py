@@ -7,23 +7,17 @@ air-gapped runs working: two token distributions, learnable and
 deterministic."""
 
 import os
-import re
 
 import numpy as np
 
-from paddle_tpu.data.datasets._synth import local_path, rng_for
+from paddle_tpu.data.datasets._synth import local_path, rng_for, \
+    tokenize as _tokenize
 
 WORD_DIM = 5147  # compact synthetic vocab
-
-_TOKEN = re.compile(r"[A-Za-z0-9']+")
 
 
 def _acl_dir():
     return local_path("aclImdb")
-
-
-def _tokenize(text):
-    return [t.lower() for t in _TOKEN.findall(text)]
 
 
 def _review_files(split, polarity):

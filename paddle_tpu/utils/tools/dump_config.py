@@ -1,0 +1,75 @@
+"""Dump a compiled v1 config (reference python/paddle/utils/dump_config.py:1).
+
+The reference printed the parsed TrainerConfig/ModelConfig protobuf; here
+parse_config compiles to the graph IR, and this tool prints it in the same
+text-proto style (layers/input_layer_names/output_layer_names/parameters)
+so config diffs remain greppable.  `--whole` adds the trainer settings and
+data sources, like the reference's whole-conf mode.
+
+Usage:
+  python -m paddle_tpu.utils.tools.dump_config CONF [CONFIG_ARGS] [--whole]
+"""
+
+import sys
+
+
+def format_model(topology, outputs):
+    lines = []
+    input_names = [n.name for n in topology.order if n.layer_type == "data"]
+    output_names = [o.name for o in outputs]
+    for node in topology.order:
+        lines.append("layers {")
+        lines.append(f'  name: "{node.name}"')
+        lines.append(f'  type: "{node.layer_type}"')
+        if node.size is not None:
+            lines.append(f"  size: {node.size}")
+        for src in node.inputs:
+            lines.append("  inputs {")
+            lines.append(f'    input_layer_name: "{src.name}"')
+            lines.append("  }")
+        key = topology._param_key(node)
+        if node.cfg.get("param_attr") or node.cfg.get("param_name"):
+            lines.append(f'  param_key: "{key}"')
+        lines.append("}")
+    for name in input_names:
+        lines.append(f'input_layer_names: "{name}"')
+    for name in output_names:
+        lines.append(f'output_layer_names: "{name}"')
+    return "\n".join(lines)
+
+
+def format_settings(settings, data_sources):
+    lines = ["settings {"]
+    for k, v in sorted(settings.items()):
+        if v is not None and not k.startswith("_"):
+            lines.append(f"  {k}: {v!r}")
+    lines.append("}")
+    if data_sources:
+        lines.append(f"data_sources: {data_sources!r}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    whole = "--whole" in argv
+    argv = [a for a in argv if a != "--whole"]
+    if not 1 <= len(argv) <= 2:
+        raise SystemExit(
+            "usage: dump_config CONF [CONFIG_ARGS] [--whole]")
+    conf_path = argv[0]
+    config_args = argv[1] if len(argv) > 1 else ""
+
+    from paddle_tpu.compat.config_parser import parse_config
+    from paddle_tpu.layers.graph import Topology
+    parsed = parse_config(conf_path, config_args)
+    outs = list(parsed.outputs or [])
+    topo = Topology(outs)
+    out = format_model(topo, outs)
+    if whole:
+        out = format_settings(parsed.settings, parsed.data_sources) \
+            + "\n" + out
+    print(out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""Image preprocessing utilities (reference python/paddle/utils/image_util.py:1).
+
+Same function surface — resize/flip/crop/oversample/mean-subtract and the
+ImageTransformer pipeline used by the image demos' providers and the model
+zoo's feature extractor.  NHWC note: these helpers keep the reference's CHW
+array convention at the boundary (providers emit flat vectors); the layer
+stack converts to NHWC internally (layers/vision.py).
+"""
+
+import io
+
+import numpy as np
+from PIL import Image
+
+
+def resize_image(img, target_size):
+    """Resize a PIL image so the SHORTER edge is target_size."""
+    scale = target_size / float(min(img.size))
+    new_size = (int(round(img.size[0] * scale)),
+                int(round(img.size[1] * scale)))
+    return img.resize(new_size, Image.LANCZOS)
+
+
+def flip(im):
+    """Horizontal flip; im is (K, H, W) color or (H, W) gray."""
+    return im[..., ::-1]
+
+
+def crop_img(im, inner_size, color=True, test=True):
+    """Crop to inner_size x inner_size: center crop in test mode, random
+    crop + random horizontal flip in train mode.  Images smaller than
+    inner_size are zero-padded to it first (reference crop_img)."""
+    im = im.astype("float32")
+    h_axis, w_axis = (1, 2) if color else (0, 1)
+    height = max(inner_size, im.shape[h_axis])
+    width = max(inner_size, im.shape[w_axis])
+    shape = (3, height, width) if color else (height, width)
+    padded = np.zeros(shape, "float32")
+    y0 = (height - im.shape[h_axis]) // 2
+    x0 = (width - im.shape[w_axis]) // 2
+    sl = (slice(y0, y0 + im.shape[h_axis]), slice(x0, x0 + im.shape[w_axis]))
+    padded[(slice(None),) + sl if color else sl] = im
+    if test:
+        y, x = (height - inner_size) // 2, (width - inner_size) // 2
+    else:
+        y = np.random.randint(0, height - inner_size + 1)
+        x = np.random.randint(0, width - inner_size + 1)
+    sl = (slice(y, y + inner_size), slice(x, x + inner_size))
+    pic = padded[(slice(None),) + sl if color else sl]
+    if not test and np.random.randint(2) == 0:
+        pic = flip(pic)
+    return pic
+
+
+def decode_jpeg(jpeg_string):
+    """JPEG bytes -> (K, H, W) ndarray (color) or (H, W) (gray)."""
+    arr = np.array(Image.open(io.BytesIO(jpeg_string)))
+    if arr.ndim == 3:
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+def preprocess_img(im, img_mean, crop_size, is_train, color=True):
+    """Crop (+augment when training), subtract the dataset mean, flatten."""
+    pic = crop_img(im.astype("float32"), crop_size, color, test=not is_train)
+    return (pic - img_mean).flatten()
+
+
+def load_meta(meta_path, mean_img_size, crop_size, color=True):
+    """Load the dataset-mean .npz ('data_mean' key) and center-crop it to
+    crop_size (reference load_meta)."""
+    mean = np.load(meta_path)["data_mean"]
+    border = (mean_img_size - crop_size) // 2
+    if color:
+        assert mean_img_size * mean_img_size * 3 == mean.shape[0]
+        mean = mean.reshape(3, mean_img_size, mean_img_size)
+        sl = (slice(None), slice(border, border + crop_size),
+              slice(border, border + crop_size))
+    else:
+        assert mean_img_size * mean_img_size == mean.shape[0]
+        mean = mean.reshape(mean_img_size, mean_img_size)
+        sl = (slice(border, border + crop_size),
+              slice(border, border + crop_size))
+    return mean[sl].astype("float32")
+
+
+def load_image(img_path, is_color=True):
+    img = Image.open(img_path)
+    img.load()
+    return img.convert("RGB" if is_color else "L")
+
+
+def oversample(img, crop_dims):
+    """Ten crops per image: 4 corners + center, each with its mirror.
+    img: iterable of (H, W, K) ndarrays; returns [10*N, ch, cw, K]."""
+    im_shape = np.array(img[0].shape)
+    crop_dims = np.array(crop_dims)
+    center = im_shape[:2] / 2.0
+    corners = []
+    for i in (0, im_shape[0] - crop_dims[0]):
+        for j in (0, im_shape[1] - crop_dims[1]):
+            corners.append((i, j, i + crop_dims[0], j + crop_dims[1]))
+    corners.append(tuple(np.concatenate(
+        [center - crop_dims / 2.0, center + crop_dims / 2.0]).astype(int)))
+    crops_ix = np.tile(np.asarray(corners, int), (2, 1))
+    crops = np.empty((10 * len(img), crop_dims[0], crop_dims[1],
+                      im_shape[-1]), np.float32)
+    ix = 0
+    for im in img:
+        for y0, x0, y1, x1 in crops_ix:
+            crops[ix] = im[y0:y1, x0:x1, :]
+            ix += 1
+        crops[ix - 5:ix] = crops[ix - 5:ix, :, ::-1, :]   # mirrors
+    return crops
+
+
+class ImageTransformer:
+    """Channel-order / mean-subtraction pipeline (reference
+    image_util.py:183)."""
+
+    def __init__(self, transpose=None, channel_swap=None, mean=None,
+                 is_color=True):
+        self.is_color = is_color
+        self.set_transpose(transpose)
+        self.set_channel_swap(channel_swap)
+        self.set_mean(mean)
+
+    def set_transpose(self, order):
+        if order is not None and self.is_color:
+            assert len(order) == 3
+        self.transpose = order
+
+    def set_channel_swap(self, order):
+        if order is not None and self.is_color:
+            assert len(order) == 3
+        self.channel_swap = order
+
+    def set_mean(self, mean):
+        if mean is not None:
+            if mean.ndim == 1:
+                mean = mean[:, np.newaxis, np.newaxis]
+            elif self.is_color:
+                assert mean.ndim == 3
+        self.mean = mean
+
+    def transformer(self, data):
+        if self.transpose is not None:
+            data = data.transpose(self.transpose)
+        if self.channel_swap is not None:
+            data = data[self.channel_swap, :, :]
+        if self.mean is not None:
+            data = data - self.mean
+        return data

@@ -5,5 +5,5 @@ common.download).  Real files load from PADDLE_TPU_DATA_DIR; without them
 synthetic data with the real schemas — see data/datasets/_synth.py."""
 
 from paddle_tpu.data.datasets import (      # noqa: F401
-    common, mnist, cifar, imdb, imikolov, movielens, conll05, uci_housing,
-    wmt14)
+    common, mnist, cifar, imdb, imikolov, movielens, conll05, sentiment,
+    uci_housing, wmt14)

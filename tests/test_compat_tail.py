@@ -1,0 +1,199 @@
+"""Round-2 verdict compat tail: sentiment dataset, dump_config, image_util,
+and the reference binary proto data format."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.utils.error import ConfigError
+
+
+# ------------------------------------------------------------- sentiment
+
+def test_sentiment_synthetic_reader():
+    from paddle_tpu.data.datasets import sentiment
+    it = sentiment.train()
+    first = next(it)
+    words, label = first
+    assert isinstance(words, list) and words
+    assert label in (0, 1)
+    train = list(sentiment.train())
+    test = list(sentiment.test())
+    assert len(train) + 1 == sentiment.NUM_TRAINING_INSTANCES \
+        or len(train) == sentiment.NUM_TRAINING_INSTANCES
+    assert len(train) + len(test) == sentiment.NUM_TOTAL_INSTANCES
+    # interleaved neg/pos for balanced batches
+    assert {train[0][1], train[1][1]} == {0, 1}
+
+
+def test_sentiment_word_dict_freq_sorted():
+    from paddle_tpu.data.datasets import sentiment
+    wd = sentiment.get_word_dict()
+    assert wd[0][1] == 0 and wd[1][1] == 1
+    ids = dict(wd)
+    assert len(ids) == len(wd)
+
+
+def test_sentiment_real_corpus_layout(tmp_path, monkeypatch):
+    d = tmp_path / "corpora" / "movie_reviews"
+    for cat, texts in [("neg", ["terrible awful film", "bad bad plot"]),
+                       ("pos", ["great wonderful film", "good fine plot"])]:
+        (d / cat).mkdir(parents=True)
+        for i, t in enumerate(texts):
+            (d / cat / f"cv{i}.txt").write_text(t)
+    monkeypatch.setenv("PADDLE_TPU_DATA_DIR", str(tmp_path))
+    from paddle_tpu.data.datasets import sentiment
+    data = sentiment.load_sentiment_data()
+    assert len(data) == 4
+    labels = [l for _, l in data]
+    assert labels == [0, 1, 0, 1]           # interleaved
+    ids = dict(sentiment.get_word_dict())
+    assert "film" in ids and "bad" in ids
+
+
+# ----------------------------------------------------------- dump_config
+
+def test_dump_config_prints_layers(tmp_path, capsys):
+    conf = tmp_path / "conf.py"
+    conf.write_text(
+        "from paddle.trainer_config_helpers import *\n"
+        "settings(batch_size=32, learning_rate=0.1)\n"
+        "d = data_layer(name='x', size=8)\n"
+        "h = fc_layer(input=d, size=16, act=TanhActivation())\n"
+        "outputs(fc_layer(input=h, size=4, act=SoftmaxActivation()))\n")
+    from paddle_tpu.utils.tools import dump_config
+    dump_config.main([str(conf)])
+    out = capsys.readouterr().out
+    assert 'name: "x"' in out and 'type: "data"' in out
+    assert "size: 8" in out
+    assert 'input_layer_names: "x"' in out
+    assert out.count("layers {") == 3
+    dump_config.main([str(conf), "", "--whole"])
+    whole = capsys.readouterr().out
+    assert "batch_size" in whole and "layers {" in whole
+
+
+# ------------------------------------------------------------ image_util
+
+def test_image_util_crop_and_flip():
+    from paddle_tpu.utils.tools import image_util as iu
+    im = np.arange(3 * 8 * 8, dtype=np.float32).reshape(3, 8, 8)
+    center = iu.crop_img(im, 4, color=True, test=True)
+    assert center.shape == (3, 4, 4)
+    np.testing.assert_array_equal(center, im[:, 2:6, 2:6])
+    gray = iu.crop_img(im[0], 4, color=False, test=True)
+    assert gray.shape == (4, 4)
+    # undersized image gets zero-padded
+    small = iu.crop_img(im[:, :2, :2], 4, color=True, test=True)
+    assert small.shape == (3, 4, 4)
+    np.testing.assert_array_equal(iu.flip(im), im[:, :, ::-1])
+
+
+def test_image_util_preprocess_and_transformer():
+    from paddle_tpu.utils.tools import image_util as iu
+    im = np.random.RandomState(0).rand(3, 10, 10).astype(np.float32)
+    mean = np.zeros((3, 6, 6), np.float32)
+    flat = iu.preprocess_img(im, mean, 6, is_train=False)
+    assert flat.shape == (3 * 6 * 6,)
+    tr = iu.ImageTransformer(transpose=(2, 0, 1), channel_swap=(2, 1, 0),
+                             mean=np.asarray([1.0, 2.0, 3.0]))
+    hwc = np.random.RandomState(1).rand(6, 6, 3).astype(np.float32)
+    out = tr.transformer(hwc)
+    assert out.shape == (3, 6, 6)
+    np.testing.assert_allclose(
+        out[0], hwc[:, :, 2] - 1.0, rtol=1e-6)
+
+
+def test_image_util_oversample_and_jpeg():
+    from paddle_tpu.utils.tools import image_util as iu
+    from PIL import Image
+    imgs = [np.random.RandomState(2).rand(8, 8, 3).astype(np.float32)]
+    crops = iu.oversample(imgs, (4, 4))
+    assert crops.shape == (10, 4, 4, 3)
+    # mirrors: second five are flips of first five
+    np.testing.assert_array_equal(crops[5], crops[0][:, ::-1, :])
+    buf = io.BytesIO()
+    Image.fromarray((imgs[0] * 255).astype(np.uint8)).save(buf, "JPEG")
+    arr = iu.decode_jpeg(buf.getvalue())
+    assert arr.shape == (3, 8, 8)
+
+
+def test_image_util_load_meta(tmp_path):
+    from paddle_tpu.utils.tools import image_util as iu
+    mean = np.arange(3 * 6 * 6, dtype=np.float32)
+    path = str(tmp_path / "meta.npz")
+    np.savez(path, data_mean=mean)
+    m = iu.load_meta(path, 6, 4, color=True)
+    assert m.shape == (3, 4, 4)
+
+
+# ----------------------------------------------------- proto data format
+
+def _sample_slot_defs():
+    from paddle_tpu.data import proto_format as pf
+    return [(pf.VECTOR_DENSE, 4), (pf.VECTOR_SPARSE_NON_VALUE, 100),
+            (pf.VECTOR_SPARSE_VALUE, 50), (pf.STRING, 0), (pf.INDEX, 10)]
+
+
+def _sample_rows():
+    return [
+        ((np.asarray([1.0, 2.0, 3.5, -1.0], np.float32), [3, 7, 99],
+          ([1, 4], [0.5, 2.5]), "hello", 7), True),
+        ((np.asarray([0.0, 0.5, 0.25, 8.0], np.float32), [], ([], []),
+          "world", 2), False),
+    ]
+
+
+@pytest.mark.parametrize("suffix", ["bin", "gz"])
+def test_proto_format_round_trip(tmp_path, suffix):
+    from paddle_tpu.data import proto_format as pf
+    path = str(tmp_path / f"data.{suffix}")
+    pf.write_proto_data(path, _sample_slot_defs(), _sample_rows())
+    f = pf.ProtoDataFile(path)
+    assert f.slot_defs == _sample_slot_defs()
+    rows = list(f)
+    assert len(rows) == 2
+    (dense, sp, spv, s, idx), beg = rows[0]
+    np.testing.assert_allclose(dense, [1.0, 2.0, 3.5, -1.0])
+    assert sp == [3, 7, 99]
+    assert spv[0] == [1, 4]
+    np.testing.assert_allclose(spv[1], [0.5, 2.5])
+    assert s == "hello" and idx == 7 and beg is True
+    (_, sp2, _, s2, idx2), beg2 = rows[1]
+    assert sp2 == [] and s2 == "world" and idx2 == 2 and beg2 is False
+
+
+def test_proto_format_reader_creator(tmp_path):
+    from paddle_tpu.data import proto_format as pf
+    path = str(tmp_path / "data.bin")
+    pf.write_proto_data(path, _sample_slot_defs(), _sample_rows())
+    rows = list(pf.reader_creator(path)())
+    assert len(rows) == 2 and rows[0][4] == 7
+
+
+def test_proto_format_var_mdim(tmp_path):
+    from paddle_tpu.data import proto_format as pf
+    defs = [(pf.VAR_MDIM_DENSE, 0), (pf.VAR_MDIM_INDEX, 1000)]
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    path = str(tmp_path / "md.bin")
+    pf.write_proto_data(path, defs, [((arr, [5, 500, 999]), True)])
+    (got, ids), _ = next(iter(pf.ProtoDataFile(path)))
+    np.testing.assert_array_equal(got, arr)
+    assert ids == [5, 500, 999]
+
+
+def test_proto_format_truncated_errors(tmp_path):
+    from paddle_tpu.data import proto_format as pf
+    path = str(tmp_path / "data.bin")
+    pf.write_proto_data(path, _sample_slot_defs(), _sample_rows())
+    data = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(data[:-5])
+    with pytest.raises(ConfigError, match="truncated"):
+        list(pf.ProtoDataFile(path))
+    with open(path, "wb") as f:
+        f.write(b"")
+    with pytest.raises(ConfigError, match="empty"):
+        pf.ProtoDataFile(path)
