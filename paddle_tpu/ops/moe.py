@@ -1,0 +1,85 @@
+"""Mixture-of-experts FFN with expert parallelism.
+
+A post-reference capability (the reference predates MoE) backing the mesh's
+'expert' axis (parallel/mesh.py AXIS_EXPERT).  TPU-first shape: experts are
+one batched [E, D, F] einsum, so sharding the E dim over the 'expert' axis
+makes every device compute ONLY its local experts over all tokens and XLA
+inserts the psum that combines partial expert outputs — expert parallelism
+derived from shardings, no hand-written all-to-all.  Gating is dense
+top-k with renormalization (Switch/GShard style): no dynamic shapes, no
+scatter — everything stays MXU-friendly einsums under jit.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def init_moe(rng, d_model, d_ff, n_experts, dtype=jnp.float32):
+    kg, k1, k2 = jax.random.split(rng, 3)
+    scale = d_model ** -0.5
+    return {
+        "wg": (jax.random.normal(kg, (d_model, n_experts)) * scale
+               ).astype(dtype),
+        "w1": (jax.random.normal(k1, (n_experts, d_model, d_ff)) * scale
+               ).astype(dtype),
+        "w2": (jax.random.normal(k2, (n_experts, d_ff, d_model))
+               * d_ff ** -0.5).astype(dtype),
+    }
+
+
+def router_probs(x, wg):
+    """Softmax router probabilities: x [..., D], wg [D, E] -> [..., E]."""
+    return jax.nn.softmax(x @ wg, axis=-1)
+
+
+def moe_gates(probs, top_k):
+    """Top-k gates from router probs, renormalized over the kept experts;
+    EXACTLY top_k experts stay nonzero even on tied probabilities (index-
+    based mask, not a >=threshold)."""
+    e = probs.shape[-1]
+    if top_k >= e:
+        return probs
+    _, idx = jax.lax.top_k(probs, top_k)            # [..., top_k]
+    mask = jax.nn.one_hot(idx, e, dtype=probs.dtype).sum(-2)
+    kept = probs * mask
+    return kept / jnp.maximum(kept.sum(-1, keepdims=True), 1e-9)
+
+
+def aux_load_balance_loss(probs, gates, top_k):
+    """GShard/Switch auxiliary loss over precomputed router tensors:
+    E * sum_e(frac_tokens_picking_e * mean_prob_e); minimized (=1) at
+    uniform expert utilization."""
+    e = probs.shape[-1]
+    picked = (gates > 0).astype(probs.dtype)
+    frac = picked.reshape(-1, e).mean(0) / max(top_k, 1)
+    mean_prob = probs.reshape(-1, e).mean(0)
+    return e * jnp.sum(frac * mean_prob)
+
+
+def moe_ffn(x, params, top_k=2, act=jax.nn.gelu, return_aux=False):
+    """x: [B, T, D] -> [B, T, D] through E gated FFN experts.
+
+    All experts run as one batched einsum over the E dim; under a mesh with
+    w1/w2 sharded P('expert', ...) each device computes its local experts'
+    partial output and the gate-weighted combine psums across the axis.
+    The router runs ONCE; return_aux=True additionally returns the
+    load-balance loss built from the same probs/gates."""
+    probs = router_probs(x, params["wg"])              # [B, T, E]
+    gates = moe_gates(probs, top_k)
+    h = act(jnp.einsum("btd,edf->btef", x, params["w1"]))
+    y = jnp.einsum("btef,efd->bted", h, params["w2"])
+    out = jnp.einsum("bted,bte->btd", y, gates)
+    if return_aux:
+        return out, aux_load_balance_loss(probs, gates, top_k)
+    return out
+
+
+def expert_shardings(mesh, axis="expert"):
+    """NamedShardings for an init_moe params dict: experts sharded over the
+    expert axis, gate replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return {
+        "wg": NamedSharding(mesh, P(None, None)),
+        "w1": NamedSharding(mesh, P(axis, None, None)),
+        "w2": NamedSharding(mesh, P(axis, None, None)),
+    }

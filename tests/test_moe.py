@@ -1,0 +1,102 @@
+"""MoE FFN + expert parallelism (ops/moe.py, the 'expert' mesh axis)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.ops import moe
+
+
+def _params(d=8, f=16, e=4, seed=0):
+    return moe.init_moe(jax.random.PRNGKey(seed), d, f, e)
+
+
+def test_gates_topk_renormalized():
+    p = _params()
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 5, 8), jnp.float32)
+    probs = moe.router_probs(x, p["wg"])
+    g = np.asarray(moe.moe_gates(probs, top_k=2))
+    assert ((g > 0).sum(-1) == 2).all()
+    np.testing.assert_allclose(g.sum(-1), 1.0, rtol=1e-5)
+    # top_k >= E degrades to plain softmax
+    g_all = np.asarray(moe.moe_gates(probs, top_k=4))
+    assert (g_all > 0).all()
+
+
+def test_gates_exactly_topk_on_ties():
+    # uniform router: every prob tied — the index mask must STILL keep
+    # exactly top_k experts
+    probs = jnp.full((3, 7, 4), 0.25, jnp.float32)
+    g = np.asarray(moe.moe_gates(probs, top_k=2))
+    assert ((g > 0).sum(-1) == 2).all()
+    np.testing.assert_allclose(g.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_moe_ffn_matches_per_expert_loop():
+    """The batched-einsum formulation == explicit per-expert computation."""
+    p = _params()
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 6, 8), jnp.float32)
+    out = np.asarray(moe.moe_ffn(x, p, top_k=2))
+
+    gates = np.asarray(moe.moe_gates(moe.router_probs(x, p["wg"]), top_k=2))
+    ref = np.zeros_like(out)
+    for e in range(4):
+        h = jax.nn.gelu(x @ p["w1"][e])
+        ye = np.asarray(h @ p["w2"][e])
+        ref += ye * gates[..., e:e + 1]
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_aux_loss_uniform_is_one():
+    """Perfectly uniform router -> aux loss == 1 (its minimum), at any
+    top_k now that tied probs keep exactly top_k experts."""
+    d, e = 8, 4
+    wg = jnp.zeros((d, e), jnp.float32)    # uniform probs everywhere
+    x = jnp.asarray(np.random.RandomState(2).randn(2, 10, d), jnp.float32)
+    probs = moe.router_probs(x, wg)
+    for k in (1, 2, e):
+        gates = moe.moe_gates(probs, k)
+        val = float(moe.aux_load_balance_loss(probs, gates, k))
+        assert val == pytest.approx(1.0, rel=1e-5), k
+
+
+def test_expert_parallel_matches_single_device():
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    p = _params(e=4)
+    x = jnp.asarray(np.random.RandomState(3).randn(4, 6, 8), jnp.float32)
+    single = np.asarray(moe.moe_ffn(x, p, top_k=2))
+
+    mesh = Mesh(np.asarray(devs[:4]).reshape(2, 2), ("data", "expert"))
+    psh = moe.expert_shardings(mesh)
+    xsh = NamedSharding(mesh, P("data", None, None))
+    f = jax.jit(lambda p, x: moe.moe_ffn(x, p, top_k=2),
+                in_shardings=(psh, xsh), out_shardings=xsh)
+    with mesh:
+        sharded = np.asarray(f(jax.device_put(p, psh),
+                               jax.device_put(x, xsh)))
+    np.testing.assert_allclose(single, sharded, rtol=2e-5, atol=2e-5)
+
+
+def test_moe_trains():
+    p = _params()
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(8, 6, 8), jnp.float32)
+    y = jnp.asarray(rng.randn(8, 6, 8) * 0.1, jnp.float32)
+
+    @jax.jit
+    def step(p):
+        def loss_fn(p):
+            out, aux = moe.moe_ffn(x, p, top_k=2, return_aux=True)
+            return jnp.mean((out - y) ** 2) + 0.01 * aux
+        l, g = jax.value_and_grad(loss_fn)(p)
+        return jax.tree_util.tree_map(lambda w, gw: w - 0.2 * gw, p, g), l
+
+    losses = []
+    for _ in range(40):
+        p, l = step(p)
+        losses.append(float(l))
+    assert losses[-1] < 0.6 * losses[0]
