@@ -293,10 +293,16 @@ def bench_resnet50(batch=32):
     images = jnp.asarray(rng.randn(batch, 224, 224, 3), jnp.float32)
     labels = jnp.asarray(rng.randint(0, 1000, (batch,)), jnp.int32)
 
+    # BENCH_REMAT=1/0 overrides; default: recompute activations once the
+    # batch is too big to keep them resident (bs>=512)
+    env_remat = os.environ.get("BENCH_REMAT", "")
+    remat = env_remat == "1" if env_remat in ("0", "1") else batch >= 512
+
     @jax.jit
     def step(params, state, opt_state, images, labels):
         (loss, new_state), grads = jax.value_and_grad(
-            resnet.loss, has_aux=True)(params, state, images, labels, 50)
+            resnet.loss, has_aux=True)(params, state, images, labels, 50,
+                                       remat=remat)
         new_params, new_opt = opt.update(grads, opt_state, params)
         return new_params, new_state, new_opt, loss
 
@@ -308,7 +314,8 @@ def bench_resnet50(batch=32):
         return loss
 
     flops = 3.0 * 4.1e9 * batch      # ~4.1 GFLOP fwd per 224x224 image
-    return run, flops, None, f"ResNet-50 train ms/batch bs={batch}"
+    return run, flops, None, f"ResNet-50 train ms/batch bs={batch}", \
+        {"remat": remat}
 
 
 def bench_image(model_name, batch, baseline_ms, fwd_flops_per_image,
@@ -506,10 +513,13 @@ def main():
     else:
         factory, default_batch = _BENCHES[model]
     batch = int(os.environ.get("BENCH_BATCH", str(default_batch or 0)))
+    # scaling-sweep runs cache under their own key so e.g. resnet50@bs256
+    # coexists with the default-batch headline row
+    cache_key = model if batch == default_batch else f"{model}@bs{batch}"
 
     stub = {"metric": f"{model} (pending)", "value": None, "unit": "ms/batch",
             "vs_baseline": None}
-    dog = Watchdog(stub, model)
+    dog = Watchdog(stub, cache_key)
 
     # -- phase 1: backend init (this is where a wedged TPU tunnel hangs) --
     dog.phase("init", t_init)
@@ -529,7 +539,7 @@ def main():
         stub.update(error="backend_unavailable", phase="init",
                     detail=f"{type(e).__name__}: {e}"[:800])
         _log(f"backend init FAILED: {e}")
-        sys.exit(_emit_failure(stub, model))
+        sys.exit(_emit_failure(stub, cache_key))
     _log(f"backend up: platform={platform} device_kind={kind} n={ndev} "
          f"peak={'%.0f TF/s' % (peak / 1e12) if peak else 'unknown'}")
 
@@ -549,7 +559,7 @@ def main():
         stub.update(error="build_failed", phase="build",
                     detail=f"{type(e).__name__}: {e}"[:800])
         _log(f"model build FAILED: {e}")
-        sys.exit(_emit_failure(stub, model))
+        sys.exit(_emit_failure(stub, cache_key))
     stub["metric"] = metric
     _log(f"model built: {metric}, analytic {flops / 1e9:.1f} GFLOP/step")
 
@@ -592,7 +602,7 @@ def main():
         stub.update(error="compile_failed", phase="compile",
                     detail=f"{type(e).__name__}: {e}"[:800])
         _log(f"compile FAILED: {e}")
-        sys.exit(_emit_failure(stub, model))
+        sys.exit(_emit_failure(stub, cache_key))
     _log(f"compiled + warm in {compile_s:.1f}s, loss={float(loss):.4f}")
 
     # -- phase 4: timed steps --
@@ -608,7 +618,7 @@ def main():
         stub.update(error="step_failed", phase="steps",
                     detail=f"{type(e).__name__}: {e}"[:800])
         _log(f"steps FAILED: {e}")
-        sys.exit(_emit_failure(stub, model))
+        sys.exit(_emit_failure(stub, cache_key))
     dog.clear()
 
     ms = dt * 1e3
@@ -623,10 +633,12 @@ def main():
            "flops_per_step": flops}
     if extras.get("tokens_per_step"):
         out["tokens_per_s"] = round(extras["tokens_per_step"] / dt)
+    if "remat" in extras:
+        out["remat"] = extras["remat"]
     if fused_rnn_fallback:
         out["fused_rnn_fallback"] = True
         out["fused_rnn_first_error"] = fused_rnn_first_error
-    fam = _families_summary(_cache_store(model, out))
+    fam = _families_summary(_cache_store(cache_key, out))
     if fam:
         out["families"] = fam
     print(json.dumps(out), flush=True)

@@ -133,12 +133,20 @@ def _basic(x, blk, st, stride, train):
 
 
 def forward(params, state, images, depth=50, train=True, imagenet=None,
-            return_pool=False):
+            return_pool=False, remat=False):
     """images: NHWC float.  depth/imagenet are static config (must match
     init).  Returns (logits, new_state); with return_pool=True the first
     element is instead the global-average-pooled features [N, D] (the layer
-    the reference model_zoo classify.py --job=extract dumps)."""
+    the reference model_zoo classify.py --job=extract dumps).
+
+    remat=True checkpoints each residual block (jax.checkpoint): activations
+    are recomputed in the backward pass instead of stored, trading ~33%
+    FLOPs for the HBM that MXU-saturating batches (bs>=512) need."""
     imagenet = imagenet if imagenet is not None else depth in (50, 101, 152)
+    bottleneck, basic = _bottleneck, _basic
+    if remat:
+        bottleneck = jax.checkpoint(_bottleneck, static_argnums=(3, 4))
+        basic = jax.checkpoint(_basic, static_argnums=(3, 4))
     new_state = {}
     x = images
     if imagenet:
@@ -154,8 +162,8 @@ def forward(params, state, images, depth=50, train=True, imagenet=None,
             for bi in range(n):
                 nm = f"s{si}b{bi}"
                 stride = 2 if (bi == 0 and si > 0) else 1
-                x, new_state[nm] = _bottleneck(x, params[nm], state[nm],
-                                               stride, train)
+                x, new_state[nm] = bottleneck(x, params[nm], state[nm],
+                                              stride, train)
     else:
         x = conv_ops.conv2d(x, params["stem"]["w"], padding=(1, 1))
         x, new_state["stem"] = _apply_bn(x, params["stem"]["bn"],
@@ -166,8 +174,8 @@ def forward(params, state, images, depth=50, train=True, imagenet=None,
             for bi in range(n):
                 nm = f"s{si}b{bi}"
                 stride = 2 if (bi == 0 and si > 0) else 1
-                x, new_state[nm] = _basic(x, params[nm], state[nm], stride,
-                                          train)
+                x, new_state[nm] = basic(x, params[nm], state[nm], stride,
+                                         train)
     x = jnp.mean(x, axis=(1, 2))
     if return_pool:
         return x, new_state
@@ -184,6 +192,8 @@ def features(params, state, images, depth=50, imagenet=None):
     return feats
 
 
-def loss(params, state, images, labels, depth=50, train=True, imagenet=None):
-    logits, new_state = forward(params, state, images, depth, train, imagenet)
+def loss(params, state, images, labels, depth=50, train=True, imagenet=None,
+         remat=False):
+    logits, new_state = forward(params, state, images, depth, train, imagenet,
+                                remat=remat)
     return jnp.mean(losses.classification_cost(logits, labels)), new_state

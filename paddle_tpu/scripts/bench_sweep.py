@@ -1,0 +1,97 @@
+"""Scaling sweep driver: run bench.py over (model, batch) combos.
+
+The reference's benchmark table sweeps batch sizes per model
+(benchmark/README.md:33-120); the TPU equivalent sweeps into MXU-saturating
+batches (the round-2 verdict's scaling column: ResNet/GoogleNet at bs
+256-1024, transformer at >=32k tokens/batch).  Each combo runs as its own
+bench.py subprocess (fresh backend, own watchdog) and lands in
+bench_cache.json under model@bsN, so one healthy chip window fills the
+whole table and the round-end bench replays it from cache.
+
+Usage:
+  python -m paddle_tpu.scripts.bench_sweep [--combos m:b,m:b,...]
+      [--steps N] [--timeout S]
+Default combos cover the BASELINE.md families at their reference batch
+plus the TPU scaling points.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEFAULT_COMBOS = [
+    # BASELINE.md reference points
+    "lstm:64", "lstm256:64", "lstm1280:64",
+    "alexnet:64", "googlenet:64", "smallnet:64", "resnet50:32",
+    # TPU scaling column
+    "resnet50:256", "resnet50:512", "resnet50:1024",
+    "googlenet:256", "googlenet:512",
+    "lstm1280:256",
+    "transformer:32", "transformer:128",          # 128*256 = 32768 tok
+    "seq2seq:64",
+]
+
+
+def run_combo(model, batch, steps, timeout):
+    env = dict(os.environ)
+    env["BENCH_MODEL"] = model
+    env["BENCH_BATCH"] = str(batch)
+    if steps:
+        env["BENCH_STEPS"] = str(steps)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        env=env, cwd=_REPO, timeout=timeout, capture_output=True, text=True)
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    try:
+        return json.loads(line)
+    except ValueError:
+        return {"error": "no_json", "rc": proc.returncode,
+                "stderr": proc.stderr[-500:]}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--combos", default=",".join(DEFAULT_COMBOS))
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--timeout", type=float, default=1500)
+    args = ap.parse_args(argv)
+
+    results = {}
+    for combo in args.combos.split(","):
+        combo = combo.strip()
+        if not combo:
+            continue
+        model, sep, batch = combo.partition(":")
+        if not sep or not batch.isdigit() or int(batch) < 1:
+            print(f"[sweep] bad combo {combo!r} (want model:batch) — "
+                  "skipping", file=sys.stderr, flush=True)
+            results[combo] = {"error": "bad_combo"}
+            continue
+        batch = int(batch)
+        print(f"[sweep] {model} bs={batch} ...", file=sys.stderr, flush=True)
+        try:
+            r = run_combo(model, batch, args.steps, args.timeout)
+        except subprocess.TimeoutExpired:
+            r = {"error": "sweep_timeout"}
+        results[combo] = {k: r.get(k) for k in
+                          ("value", "unit", "vs_baseline", "mfu",
+                           "tokens_per_s", "error", "cached")}
+        print(f"[sweep] {combo}: {results[combo]}", file=sys.stderr,
+              flush=True)
+        if r.get("error") == "backend_unavailable_timeout" \
+                and not r.get("cached"):
+            print("[sweep] backend wedged — stopping sweep", file=sys.stderr)
+            break
+    print(json.dumps({"sweep": results}), flush=True)
+    ok = sum(1 for r in results.values()
+             if r.get("value") is not None and not r.get("error"))
+    return 0 if ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

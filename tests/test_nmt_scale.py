@@ -1,0 +1,38 @@
+"""scripts/nmt_scale.py: the reference-scale NMT harness (verbatim
+train.conf + gen.conf) runs end-to-end at toy scale on CPU."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REF = os.environ.get("PADDLE_TPU_REFERENCE", "/root/reference")
+
+
+@pytest.mark.skipif(
+    not os.path.exists(f"{_REF}/demo/seqToseq/translation/train.conf"),
+    reason="reference checkout not present")
+def test_nmt_scale_end_to_end(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.scripts.nmt_scale",
+         "--out-dir", str(tmp_path), "--vocab", "120", "--steps", "4",
+         "--gen-sents", "2", "--beam", "5", "--max-gen-len", "12"],
+        cwd=_ROOT, env=env, timeout=420, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["vocab"] == 120
+    assert out["batch_size"] == 50          # train.conf's own setting
+    assert out["beam_size"] == 5
+    assert out["train_ms_per_batch"] > 0
+    assert out["first_cost"] > 0 and out["last_cost"] > 0
+    golden = out["golden_file"]
+    assert os.path.exists(golden)
+    text = open(golden).read()
+    assert text.count("src:") == 2
+    assert "beam4" in text
