@@ -78,14 +78,23 @@ def main(argv=None):
             r = run_combo(model, batch, args.steps, args.timeout)
         except subprocess.TimeoutExpired:
             r = {"error": "sweep_timeout"}
-        results[combo] = {k: r.get(k) for k in
-                          ("value", "unit", "vs_baseline", "mfu",
-                           "tokens_per_s", "error", "cached")}
-        print(f"[sweep] {combo}: {results[combo]}", file=sys.stderr,
-              flush=True)
-        if r.get("error") == "backend_unavailable_timeout" \
-                and not r.get("cached"):
-            print("[sweep] backend wedged — stopping sweep", file=sys.stderr)
+        row = {k: r.get(k) for k in
+               ("value", "unit", "vs_baseline", "mfu",
+                "tokens_per_s", "error", "cached")}
+        # keep the diagnostics for failed runs — a crashed combo from a
+        # scarce healthy-chip window must stay debuggable
+        if r.get("error"):
+            for k in ("rc", "stderr", "phase", "detail", "live_error"):
+                if r.get(k) is not None:
+                    row[k] = r[k]
+        results[combo] = row
+        print(f"[sweep] {combo}: {row}", file=sys.stderr, flush=True)
+        wedge_errors = {"backend_unavailable_timeout", "backend_unavailable",
+                        "compile_timeout", "steps_timeout",
+                        "input_build_timeout", "sweep_timeout"}
+        if r.get("error") in wedge_errors and not r.get("cached"):
+            print(f"[sweep] backend wedged ({r.get('error')}) — stopping "
+                  "sweep", file=sys.stderr)
             break
     print(json.dumps({"sweep": results}), flush=True)
     ok = sum(1 for r in results.values()

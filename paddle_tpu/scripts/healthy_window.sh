@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Healthy-window playbook: the moment the TPU chip answers, run everything
+# the round-2 verdict wants hardware evidence for, in priority order, and
+# leave committed artifacts behind.  Each phase is independently resumable;
+# a re-wedge mid-run keeps whatever already landed.
+#
+#   bash paddle_tpu/scripts/healthy_window.sh [artifacts_dir]
+#
+# Phases:
+#  1. bench.py --smoke-kernels          (Mosaic compile canary, ~minutes)
+#  2. bench_sweep                       (BASELINE rows + scaling column ->
+#                                        bench_cache.json)
+#  3. tpu_diff TPU dump + differential  (CPU-vs-TPU numerics evidence)
+#  4. nmt_scale                         (verbatim-config NMT row + golden)
+set -u
+cd "$(dirname "$0")/../.."
+ART="${1:-artifacts/r3}"
+mkdir -p "$ART"
+log() { echo "[healthy_window $(date -u +%H:%M:%S)] $*" >&2; }
+
+log "phase 1: pallas kernel smoke"
+timeout 1200 python bench.py --smoke-kernels \
+    > "$ART/smoke_kernels.json" 2> "$ART/smoke_kernels.log"
+log "smoke rc=$? -> $ART/smoke_kernels.json"
+
+log "phase 2: bench sweep (BASELINE + scaling)"
+timeout 14400 python -m paddle_tpu.scripts.bench_sweep \
+    > "$ART/bench_sweep.json" 2> "$ART/bench_sweep.log"
+log "sweep rc=$? (bench_cache.json updated)"
+
+log "phase 3: TPU differential dump + compare"
+# resumable per-case dumps; 'default' platform = the axon-routed TPU
+timeout 7200 python -m paddle_tpu.testing.tpu_diff default \
+    "$ART/diff_tpu.npz" 2> "$ART/diff_tpu.log"
+log "tpu dump rc=$?"
+JAX_PLATFORMS=cpu timeout 3600 python -m paddle_tpu.testing.tpu_diff cpu \
+    "$ART/diff_cpu.npz" 2> "$ART/diff_cpu.log"
+log "cpu dump rc=$?"
+PADDLE_TPU_DIFF="$ART/diff_cpu.npz:$ART/diff_tpu.npz" \
+    python -m pytest tests/test_tpu_differential.py -q \
+    > "$ART/tpu_differential_pytest.log" 2>&1
+log "differential pytest rc=$? -> $ART/tpu_differential_pytest.log"
+
+log "phase 4: reference-scale NMT (verbatim configs, 30k vocab)"
+timeout 7200 python -m paddle_tpu.scripts.nmt_scale \
+    --out-dir "$ART/nmt" --vocab 30000 --steps 300 --gen-sents 32 \
+    --beam 5 --max-gen-len 50 \
+    > "$ART/nmt_scale.json" 2> "$ART/nmt_scale.log"
+log "nmt rc=$? -> $ART/nmt_scale.json"
+
+log "done at $(date -u +%Y%m%dT%H%M%SZ); artifacts in $ART — review, update docs/perf.md, commit"

@@ -154,7 +154,10 @@ def main(argv=None):
                 node.cfg["beam_size"] = args.beam
             if args.max_gen_len:
                 node.cfg["max_length"] = args.max_gen_len
-    gen_keys = set(gen_topo.init(jax.random.PRNGKey(0)))
+    # enumerate gen-graph parameter KEYS without materializing 30k-vocab
+    # weights on device (init would allocate the real arrays)
+    gen_keys = set(jax.eval_shape(
+        lambda k: gen_topo.init(k), jax.random.PRNGKey(0)))
     trained = trainer.parameters
     missing = gen_keys - set(trained)
     if missing:
