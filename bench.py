@@ -216,6 +216,12 @@ class Watchdog:
                 os._exit(_emit_failure(out, self._model))
 
 
+def _env_remat(default):
+    """BENCH_REMAT=1/0 overrides; anything else -> the model's heuristic."""
+    v = os.environ.get("BENCH_REMAT", "")
+    return v == "1" if v in ("0", "1") else default
+
+
 def _device_info():
     import jax
     dev = jax.devices()[0]
@@ -293,10 +299,9 @@ def bench_resnet50(batch=32):
     images = jnp.asarray(rng.randn(batch, 224, 224, 3), jnp.float32)
     labels = jnp.asarray(rng.randint(0, 1000, (batch,)), jnp.int32)
 
-    # BENCH_REMAT=1/0 overrides; default: recompute activations once the
-    # batch is too big to keep them resident (bs>=512)
-    env_remat = os.environ.get("BENCH_REMAT", "")
-    remat = env_remat == "1" if env_remat in ("0", "1") else batch >= 512
+    # default: recompute activations once the batch is too big to keep
+    # them resident (bs>=512)
+    remat = _env_remat(batch >= 512)
 
     @jax.jit
     def step(params, state, opt_state, images, labels):
@@ -425,10 +430,14 @@ def bench_transformer(batch=32, seq_len=256, vocab=32000, d_model=512,
         lengths=jnp.full((batch,), seq_len, jnp.int32))
     src, trg = mk(), mk()
 
+    # default: recompute per block once the token count reaches the 32k
+    # scaling point (batch*seq >= 32768)
+    remat = _env_remat(batch * seq_len >= 32768)
+
     @jax.jit
     def step(params, opt_state, src, trg):
         loss, grads = jax.value_and_grad(transformer.loss)(
-            params, src, trg, trg, heads)
+            params, src, trg, trg, heads, remat=remat)
         new_params, new_opt = opt.update(grads, opt_state, params)
         return new_params, new_opt, loss
 
@@ -447,7 +456,7 @@ def bench_transformer(batch=32, seq_len=256, vocab=32000, d_model=512,
     flops = 3.0 * (2.0 * n_params * tok + 2.0 * vocab * d_model * tok + attn)
     return run, flops, None, (
         f"transformer-base MT train ms/batch bs={batch} len={seq_len}"), \
-        {"tokens_per_step": tok}
+        {"tokens_per_step": tok, "remat": remat}
 
 
 _BENCHES = {

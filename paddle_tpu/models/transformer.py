@@ -71,43 +71,61 @@ def _ln(p, x):
     return layer_norm(x, p["g"], p["b"])
 
 
-def encode(params, src: SequenceBatch, num_heads=8):
+def _enc_block(blk, x, mask, num_heads):
+    x = x + _mha(blk["attn"], _ln(blk["ln1"], x), _ln(blk["ln1"], x),
+                 num_heads, mask=mask)
+    return x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
+
+
+def _dec_block(blk, x, enc_out, self_mask, cross_mask, num_heads):
+    h = _ln(blk["ln1"], x)
+    x = x + _mha(blk["attn"], h, h, num_heads, mask=self_mask, causal=True)
+    x = x + _mha(blk["xattn"], _ln(blk["ln_x"], x), enc_out, num_heads,
+                 mask=cross_mask)
+    return x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
+
+
+def encode(params, src: SequenceBatch, num_heads=8, remat=False):
+    """remat=True checkpoints each block (jax.checkpoint): backward
+    recomputes activations instead of storing them — the HBM headroom for
+    >=32k-token batches."""
     t = src.data.shape[1]
+    block = jax.checkpoint(_enc_block, static_argnums=(3,)) if remat \
+        else _enc_block
     x = emb_ops.embedding_lookup(params["src_emb"], src.data)
     x = x * math.sqrt(x.shape[-1]) + params["pos"][:t][None]
     mask = attn_ops.padding_mask(src.mask(), src.mask())
     for blk in params["enc"]:
-        x = x + _mha(blk["attn"], _ln(blk["ln1"], x), _ln(blk["ln1"], x),
-                     num_heads, mask=mask)
-        x = x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
+        x = block(blk, x, mask, num_heads)
     return x
 
 
 def decode(params, enc_out, src_mask, trg_in: SequenceBatch, num_heads=8,
-           pos_offset=0):
+           pos_offset=0, remat=False):
     t = trg_in.data.shape[1]
+    block = jax.checkpoint(_dec_block, static_argnums=(5,)) if remat \
+        else _dec_block
     x = emb_ops.embedding_lookup(params["trg_emb"], trg_in.data)
     x = x * math.sqrt(x.shape[-1]) + \
         params["pos"][pos_offset:pos_offset + t][None]
     self_mask = attn_ops.padding_mask(trg_in.mask(), trg_in.mask())
     cross_mask = attn_ops.padding_mask(trg_in.mask(), src_mask)
     for blk in params["dec"]:
-        h = _ln(blk["ln1"], x)
-        x = x + _mha(blk["attn"], h, h, num_heads, mask=self_mask, causal=True)
-        x = x + _mha(blk["xattn"], _ln(blk["ln_x"], x), enc_out, num_heads,
-                     mask=cross_mask)
-        x = x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
+        x = block(blk, x, enc_out, self_mask, cross_mask, num_heads)
     x = _ln(params["ln_f"], x)
     return linear.matmul(x, params["out"])
 
 
-def forward(params, src: SequenceBatch, trg_in: SequenceBatch, num_heads=8):
-    enc_out = encode(params, src, num_heads)
-    return decode(params, enc_out, src.mask(), trg_in, num_heads)
+def forward(params, src: SequenceBatch, trg_in: SequenceBatch, num_heads=8,
+            remat=False):
+    enc_out = encode(params, src, num_heads, remat=remat)
+    return decode(params, enc_out, src.mask(), trg_in, num_heads,
+                  remat=remat)
 
 
-def loss(params, src, trg_in, trg_next, num_heads=8, label_smoothing=0.1):
-    logits = forward(params, src, trg_in, num_heads)
+def loss(params, src, trg_in, trg_next, num_heads=8, label_smoothing=0.1,
+         remat=False):
+    logits = forward(params, src, trg_in, num_heads, remat=remat)
     labels = trg_next.data
     if labels.ndim == 3:
         labels = labels[..., 0]

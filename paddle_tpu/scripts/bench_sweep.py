@@ -89,10 +89,11 @@ def main(argv=None):
                     row[k] = r[k]
         results[combo] = row
         print(f"[sweep] {combo}: {row}", file=sys.stderr, flush=True)
-        wedge_errors = {"backend_unavailable_timeout", "backend_unavailable",
-                        "compile_timeout", "steps_timeout",
-                        "input_build_timeout", "sweep_timeout"}
-        if r.get("error") in wedge_errors and not r.get("cached"):
+        # only a true wedge signal stops the sweep; a combo-specific
+        # compile/steps/sweep timeout (e.g. an oversized batch) moves on so
+        # the remaining combos still use the healthy window
+        if r.get("error") in ("backend_unavailable_timeout",
+                              "backend_unavailable") and not r.get("cached"):
             print(f"[sweep] backend wedged ({r.get('error')}) — stopping "
                   "sweep", file=sys.stderr)
             break

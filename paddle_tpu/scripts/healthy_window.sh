@@ -13,8 +13,9 @@
 #  3. tpu_diff TPU dump + differential  (CPU-vs-TPU numerics evidence)
 #  4. nmt_scale                         (verbatim-config NMT row + golden)
 set -u
+# resolve ART against the CALLER's cwd before cd'ing to the repo root
+ART=$(realpath -m "${1:-artifacts/r3}")
 cd "$(dirname "$0")/../.."
-ART="${1:-artifacts/r3}"
 mkdir -p "$ART"
 log() { echo "[healthy_window $(date -u +%H:%M:%S)] $*" >&2; }
 
