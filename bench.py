@@ -40,6 +40,7 @@ shapes and checks numerics vs the scan oracle — a seconds-long canary that
 detects Mosaic lowering regressions independently of a full bench.
 """
 
+import functools
 import json
 import os
 import sys
@@ -263,7 +264,7 @@ def bench_lstm(batch=64, seq_len=100, hidden=512, vocab=30000,
         lengths=jnp.full((batch,), seq_len, jnp.int32))
     labels = jnp.asarray(rng.randint(0, 2, (batch,)), jnp.int32)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, ids, labels):
         loss, grads = jax.value_and_grad(text_lstm.loss)(
             params, ids, labels, 2, hidden)
@@ -303,7 +304,7 @@ def bench_resnet50(batch=32):
     # them resident (bs>=512)
     remat = _env_remat(batch >= 512)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(params, state, opt_state, images, labels):
         (loss, new_state), grads = jax.value_and_grad(
             resnet.loss, has_aux=True)(params, state, images, labels, 50,
@@ -339,7 +340,7 @@ def bench_image(model_name, batch, baseline_ms, fwd_flops_per_image,
     images = jnp.asarray(rng.randn(batch, image_hw, image_hw, 3), jnp.float32)
     labels = jnp.asarray(rng.randint(0, num_classes, (batch,)), jnp.int32)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(params, state, opt_state, images, labels):
         (loss, new_state), grads = jax.value_and_grad(
             mod.loss, has_aux=True)(params, state, images, labels)
@@ -383,7 +384,7 @@ def bench_seq2seq(batch=64, src_len=30, trg_len=30, vocab=30000, hidden=512):
         data=jnp.asarray(rng.randint(3, vocab, (batch, trg_len)), jnp.int32),
         lengths=jnp.full((batch,), trg_len, jnp.int32))
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, src, trg):
         loss, grads = jax.value_and_grad(seq2seq.loss)(params, src, trg, trg)
         new_params, new_opt = opt.update(grads, opt_state, params)
@@ -434,7 +435,7 @@ def bench_transformer(batch=32, seq_len=256, vocab=32000, d_model=512,
     # scaling point (batch*seq >= 32768)
     remat = _env_remat(batch * seq_len >= 32768)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, src, trg):
         loss, grads = jax.value_and_grad(transformer.loss)(
             params, src, trg, trg, heads, remat=remat)

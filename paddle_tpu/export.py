@@ -1,0 +1,105 @@
+"""Portable model export: serialize inference to StableHLO.
+
+The reference's deployment story was `merge_model` (config + weights packed
+into one file, paddle/trainer/MergeModel.cpp) consumed by the C API
+(capi/) from C++ services.  The TPU-native equivalent: `jax.export` lowers
+the jitted inference function — with the trained parameters baked in as
+constants — to serialized StableHLO, a single self-contained artifact any
+XLA runtime (Python, C++, TF serving via PJRT) can load and execute
+without this framework installed.  SURVEY §7 stage 11.
+
+    from paddle_tpu import export as pexport
+    art = pexport.export_inference(out_layer, trainer.parameters,
+                                   feed_spec={"x": np.zeros((1, 784))},
+                                   model_state=trainer.model_state,
+                                   path="model.shlo")
+    run = pexport.load_inference("model.shlo")
+    probs = run({"x": batch})
+
+feed_spec values may be example arrays, ShapeDtypeStructs, or
+SequenceBatch-wrapped versions of either.  Exports are single-platform by
+default (the current backend); pass platforms=("tpu", "cpu") for a
+multi-platform artifact.
+"""
+
+import jax
+from jax import export as _jx
+
+from paddle_tpu.core.sequence import NestedSequenceBatch, SequenceBatch
+from paddle_tpu.layers.graph import Topology
+
+# the serialized artifact must encode the feed pytree structure; register
+# the framework's NamedTuple batch types once (idempotent across reimports)
+for _nt, _name in ((SequenceBatch, "paddle_tpu.SequenceBatch"),
+                   (NestedSequenceBatch, "paddle_tpu.NestedSequenceBatch")):
+    try:
+        _jx.register_namedtuple_serialization(_nt, serialized_name=_name)
+    except ValueError:
+        pass
+
+
+def _as_aval(v):
+    import numpy as np
+    if isinstance(v, (SequenceBatch, NestedSequenceBatch)):
+        return jax.tree_util.tree_map(_as_aval, v)
+    if isinstance(v, jax.ShapeDtypeStruct):
+        return v
+    arr = np.asarray(v)
+    return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+
+def export_inference(output_layer, parameters, feed_spec, path=None,
+                     model_state=None, platforms=None):
+    """Lower test-mode inference of `output_layer` (or a list of outputs)
+    to StableHLO with `parameters` embedded as constants.
+
+    feed_spec: {data_layer_name: example array | ShapeDtypeStruct |
+    SequenceBatch thereof} — fixes the exported input shapes (TPU serving
+    wants static shapes; export one artifact per bucket for ragged input).
+    Returns the jax.export.Exported; with `path`, also writes the
+    serialized bytes there."""
+    outs = list(output_layer) if isinstance(output_layer, (list, tuple)) \
+        else [output_layer]
+    topo = Topology(outs)
+    state = model_state
+    if state is None:
+        state = topo.init_state()
+        if state:
+            # a trained BN model's moving stats live in trainer.model_state;
+            # baking fresh init stats in would silently change predictions
+            from paddle_tpu.utils.logging import logger
+            logger.warning(
+                "export_inference: model has state (%s) but model_state= "
+                "was not passed — exporting with INITIAL statistics. Pass "
+                "trainer.model_state for a trained model.",
+                ", ".join(sorted(state)))
+
+    def fwd(feed):
+        return topo.apply(parameters, feed, mode="test", state=state)
+
+    spec = {k: jax.tree_util.tree_map(_as_aval, v)
+            for k, v in feed_spec.items()}
+    kwargs = {}
+    if platforms:
+        kwargs["platforms"] = tuple(platforms)
+    exp = _jx.export(jax.jit(fwd), **kwargs)(spec)
+    if path:
+        with open(path, "wb") as f:
+            f.write(exp.serialize())
+    return exp
+
+
+def load_inference(path_or_bytes):
+    """Deserialize an exported artifact -> callable(feed_dict)."""
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        data = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
+    exp = _jx.deserialize(data)
+
+    def run(feed):
+        return exp.call(feed)
+
+    run.exported = exp
+    return run

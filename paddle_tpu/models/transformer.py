@@ -72,8 +72,8 @@ def _ln(p, x):
 
 
 def _enc_block(blk, x, mask, num_heads):
-    x = x + _mha(blk["attn"], _ln(blk["ln1"], x), _ln(blk["ln1"], x),
-                 num_heads, mask=mask)
+    h = _ln(blk["ln1"], x)
+    x = x + _mha(blk["attn"], h, h, num_heads, mask=mask)
     return x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
 
 

@@ -13,9 +13,11 @@
 #  3. tpu_diff TPU dump + differential  (CPU-vs-TPU numerics evidence)
 #  4. nmt_scale                         (verbatim-config NMT row + golden)
 set -u
-# resolve ART against the CALLER's cwd before cd'ing to the repo root
-ART=$(realpath -m "${1:-artifacts/r3}")
+# an explicit dir resolves against the CALLER's cwd; the default stays
+# repo-root-relative (resolved after the cd below)
+if [ $# -ge 1 ]; then ART=$(realpath -m "$1"); else ART=""; fi
 cd "$(dirname "$0")/../.."
+ART="${ART:-$PWD/artifacts/r3}"
 mkdir -p "$ART"
 log() { echo "[healthy_window $(date -u +%H:%M:%S)] $*" >&2; }
 
