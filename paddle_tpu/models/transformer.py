@@ -141,13 +141,12 @@ def loss(params, src, trg_in, trg_next, num_heads=8, label_smoothing=0.1,
 # --------------------------------------------------------- cached decode
 
 def init_decode_cache(params, enc_out, max_len):
-    """Per-decoder-layer KV cache for incremental decoding.
-
-    Self-attention K/V buffers are [B, max_len, D] written one position per
-    step; cross-attention K/V are computed ONCE from the encoder output
-    (they never change during decode).  The cache is a plain pytree, so
-    beam search's lane reordering (ops/beam.py gather_state) reindexes it
-    for free."""
+    """Per-decoder-layer self-attention K/V buffers ([B, max_len, D],
+    written one position per step).  A plain pytree, so beam search's lane
+    reordering (ops/beam.py gather_state) reindexes it for free.  The
+    cross-attention K/V are NOT here — they never change during decode, so
+    they stay out of the scan state (see cross_kv) and are closed over
+    instead of being re-gathered every step."""
     if max_len > params["pos"].shape[0]:
         # fail fast like the full-decode oracle would; dynamic_slice would
         # otherwise silently clamp and reuse the last position row
@@ -156,15 +155,16 @@ def init_decode_cache(params, enc_out, max_len):
             f"({params['pos'].shape[0]}); re-init the model with a larger "
             "max_len")
     b, _, d = enc_out.shape
-    cache = []
-    for blk in params["dec"]:
-        cache.append({
-            "k": jnp.zeros((b, max_len, d), enc_out.dtype),
-            "v": jnp.zeros((b, max_len, d), enc_out.dtype),
-            "xk": linear.matmul(enc_out, blk["xattn"]["wk"]),
-            "xv": linear.matmul(enc_out, blk["xattn"]["wv"]),
-        })
-    return cache
+    return [{"k": jnp.zeros((b, max_len, d), enc_out.dtype),
+             "v": jnp.zeros((b, max_len, d), enc_out.dtype)}
+            for _ in params["dec"]]
+
+
+def cross_kv(params, enc_out):
+    """Per-decoder-layer cross-attention K/V, computed once per source."""
+    return [{"xk": linear.matmul(enc_out, blk["xattn"]["wk"]),
+             "xv": linear.matmul(enc_out, blk["xattn"]["wv"])}
+            for blk in params["dec"]]
 
 
 def _attend(q, k, v, num_heads, mask):
@@ -180,12 +180,13 @@ def _attend(q, k, v, num_heads, mask):
     return out.transpose(0, 2, 1, 3).reshape(b, 1, d)
 
 
-def decode_step_cached(params, src_mask, prev_ids, t, cache, num_heads=8):
+def decode_step_cached(params, src_mask, prev_ids, t, cache, cross,
+                       num_heads=8):
     """One incremental decode position.
 
-    prev_ids: [B] token at position t; t: scalar int32; returns
-    (logits [B, V], updated cache).  Equivalent to column t of the full
-    decode() — proven by tests/test_transformer_decode.py."""
+    prev_ids: [B] token at position t; t: scalar int32; cross: cross_kv()
+    output; returns (logits [B, V], updated cache).  Equivalent to column
+    t of the full decode() — proven by tests/test_transformer_decode.py."""
     b = prev_ids.shape[0]
     max_len = cache[0]["k"].shape[1]
     x = emb_ops.embedding_lookup(params["trg_emb"], prev_ids)[:, None]
@@ -194,7 +195,7 @@ def decode_step_cached(params, src_mask, prev_ids, t, cache, num_heads=8):
     pos_mask = jnp.arange(max_len)[None, :] <= t          # [1, max_len]
     pos_mask = jnp.broadcast_to(pos_mask, (b, max_len))
     new_cache = []
-    for blk, c in zip(params["dec"], cache):
+    for blk, c, cx in zip(params["dec"], cache, cross):
         h = _ln(blk["ln1"], x)
         k = jax.lax.dynamic_update_slice_in_dim(
             c["k"], linear.matmul(h, blk["attn"]["wk"]), t, axis=1)
@@ -205,12 +206,21 @@ def decode_step_cached(params, src_mask, prev_ids, t, cache, num_heads=8):
         x = x + linear.matmul(att, blk["attn"]["wo"])
         hx = _ln(blk["ln_x"], x)
         xq = linear.matmul(hx, blk["xattn"]["wq"])
-        xat = _attend(xq, c["xk"], c["xv"], num_heads, src_mask > 0)
+        xat = _attend(xq, cx["xk"], cx["xv"], num_heads, src_mask > 0)
         x = x + linear.matmul(xat, blk["xattn"]["wo"])
         x = x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
-        new_cache.append({"k": k, "v": v, "xk": c["xk"], "xv": c["xv"]})
+        new_cache.append({"k": k, "v": v})
     x = _ln(params["ln_f"], x)
     return linear.matmul(x, params["out"])[:, 0], new_cache
+
+
+def _beam_setup(params, src, beam_size, num_heads):
+    """Shared oracle/serving preamble: encode once, tile lane-major."""
+    b = src.data.shape[0]
+    enc_out = encode(params, src, num_heads)
+    enc_l = jnp.repeat(enc_out, beam_size, axis=0)
+    src_mask_l = jnp.repeat(src.mask(), beam_size, axis=0)
+    return b, b * beam_size, enc_l, src_mask_l
 
 
 def generate_cached(params, src: SequenceBatch, beam_size=4, max_len=64,
@@ -218,19 +228,16 @@ def generate_cached(params, src: SequenceBatch, beam_size=4, max_len=64,
     """Beam decode with KV-cached incremental steps: O(T) attention per new
     token instead of re-running the full decoder stack over the whole
     prefix (O(T^2) per token) — the serving-path decoder."""
-    b = src.data.shape[0]
-    enc_out = encode(params, src, num_heads)
-
-    def tile(x):
-        return jnp.repeat(x, beam_size, axis=0)
-
-    enc_l, src_mask_l = tile(enc_out), tile(src.mask())
-    bk = b * beam_size
+    b, bk, enc_l, src_mask_l = _beam_setup(params, src, beam_size, num_heads)
+    # invariant across steps AND identical across a row's lanes: closed
+    # over, not carried in the scan state (gather_state would re-copy it
+    # per emitted token)
+    cross = cross_kv(params, enc_l)
 
     def step_fn(state, prev_ids):
         cache, step = state
         logits, cache = decode_step_cached(
-            params, src_mask_l, prev_ids, step[0], cache, num_heads)
+            params, src_mask_l, prev_ids, step[0], cache, cross, num_heads)
         return jax.nn.log_softmax(logits, axis=-1), (cache, step + 1)
 
     init_state = (init_decode_cache(params, enc_l, max_len),
@@ -243,14 +250,7 @@ def generate(params, src: SequenceBatch, beam_size=4, max_len=64, bos_id=0,
              eos_id=1, num_heads=8, length_penalty=0.6):
     """Beam decode, full-recompute step (the numerics oracle for
     generate_cached; prefer generate_cached for serving throughput)."""
-    b = src.data.shape[0]
-    enc_out = encode(params, src, num_heads)
-
-    def tile(x):
-        return jnp.repeat(x, beam_size, axis=0)
-
-    enc_l, src_mask_l = tile(enc_out), tile(src.mask())
-    bk = b * beam_size
+    b, bk, enc_l, src_mask_l = _beam_setup(params, src, beam_size, num_heads)
 
     def step_fn(state, prev_ids):
         toks, step = state           # toks: [BK, max_len]; step: [BK] (equal)

@@ -15,7 +15,7 @@ A reference user's `import paddle.v2 as paddle` script maps to
 
 from paddle_tpu.trainer.api import init
 from paddle_tpu.v2.inference import infer
-from paddle_tpu.data.reader import batch as minibatch_batch
+from paddle_tpu.v2 import config_base, minibatch, topology  # noqa: F401
 
 from paddle_tpu.v2 import layer
 from paddle_tpu.v2 import activation
@@ -39,9 +39,8 @@ import importlib as _importlib
 data_type = _importlib.import_module("paddle_tpu.data.provider")
 
 
-def batch(reader_fn, batch_size, drop_last=False):
-    """paddle.v2.minibatch.batch"""
-    return minibatch_batch(reader_fn, batch_size, drop_last=drop_last)
+# paddle.batch IS minibatch.batch (one definition, two reference names)
+batch = minibatch.batch
 
 
 __all__ = ["init", "infer", "batch", "layer", "activation", "pooling",

@@ -1,0 +1,8 @@
+"""paddle.v2.config_base (reference v2/config_base.py:1).
+
+The reference's Layer base class adapted v1 config funcs into v2 graph
+objects; the rebuild's layer ctors already return graph nodes
+(LayerOutput), so that class IS the base surface here.
+"""
+
+from paddle_tpu.layers.graph import LayerOutput as Layer  # noqa: F401

@@ -1,0 +1,3 @@
+"""paddle.v2.minibatch (reference v2/minibatch.py:1): batch(reader, size)."""
+
+from paddle_tpu.data.reader import batch  # noqa: F401

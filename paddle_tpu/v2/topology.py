@@ -1,0 +1,9 @@
+"""paddle.v2.topology (reference v2/topology.py:1).
+
+The reference's Topology wrapped the serialized ModelConfig proto and
+answered get_layer/data_type queries; here the graph IR Topology IS that
+object, re-exported with the reference's name and the proto-era helpers on
+the IR (layer lookup by name, data-layer enumeration via .order).
+"""
+
+from paddle_tpu.layers.graph import Topology  # noqa: F401

@@ -34,9 +34,11 @@ def test_cached_step_matches_full_decode_column():
         params, enc_out, src.mask(), full_trg, heads))    # [B, T, V]
 
     cache = transformer.init_decode_cache(params, enc_out, max_len)
+    cross = transformer.cross_kv(params, enc_out)
     for t in range(max_len):
         logits, cache = transformer.decode_step_cached(
-            params, src.mask(), trg_ids[:, t], jnp.int32(t), cache, heads)
+            params, src.mask(), trg_ids[:, t], jnp.int32(t), cache, cross,
+            heads)
         np.testing.assert_allclose(np.asarray(logits), full_logits[:, t],
                                    rtol=2e-4, atol=2e-4)
 

@@ -86,3 +86,13 @@ def test_checkgrad(np_rng):
             "lab": jnp.asarray(np_rng.randint(0, 3, (4,)))}
     results = check_topology_grads(Topology(cost), feed)
     assert results
+
+
+def test_v2_module_shims():
+    """minibatch/topology/config_base import like the reference v2 pkg."""
+    import paddle_tpu.v2 as v2
+    assert [len(b) for b in v2.minibatch.batch(lambda: iter(range(5)), 2)()] \
+        == [2, 2, 1]
+    from paddle_tpu.layers.graph import LayerOutput, Topology
+    assert v2.topology.Topology is Topology
+    assert v2.config_base.Layer is LayerOutput
