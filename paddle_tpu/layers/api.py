@@ -45,6 +45,7 @@ __all__ = [
     "crf_decoding_layer", "ctc_layer", "warp_ctc_layer", "nce_layer",
     "hsigmoid", "pooling", "slice_projection",
     "AggregateLevel", "ExpandLevel", "repeat_layer",
+    "moe_layer",
 ]
 
 
@@ -151,6 +152,52 @@ class _FcImpl:
 
 
 register_layer("fc")(_FcImpl)
+
+
+# ---------------------------------------------------------------- moe
+
+class _MoeImpl:
+    """Mixture-of-experts FFN over the row dimension (ops/moe.py) — a
+    post-reference capability layer; experts shard over the 'expert' mesh
+    axis under a mesh trainer (moe.expert_shardings)."""
+
+    def infer(self, cfg, in_sizes):
+        return in_sizes[0]
+
+    def init(self, rng, cfg, in_sizes):
+        from paddle_tpu.ops import moe
+        return moe.init_moe(rng, in_sizes[0], cfg["expert_dim"],
+                            cfg["n_experts"])
+
+    def apply(self, ctx, cfg, params, x):
+        from paddle_tpu.ops import moe
+
+        def fn(d):
+            # moe_ffn wants [B, T, D]; flatten any leading dims (dense
+            # [B, D] and nested-sequence [B, S, T, D] included) and restore
+            lead = d.shape[:-1]
+            out = moe.moe_ffn(d.reshape(1, -1, d.shape[-1]), params,
+                              top_k=cfg["top_k"])
+            return out.reshape(*lead, d.shape[-1])
+        return map_rows(fn, x)
+
+
+register_layer("moe")(_MoeImpl)
+
+
+def moe_layer(input, n_experts, expert_dim=None, top_k=2, name=None):
+    """Gated mixture-of-experts FFN: `n_experts` experts of hidden width
+    `expert_dim` (default 4x the input size), top_k-gated, residual-free
+    (compose with addto_layer for a residual block).  Output size ==
+    input size."""
+    ins = _inputs_list(input)
+    if len(ins) != 1:
+        from paddle_tpu.utils.error import ConfigError
+        raise ConfigError("moe_layer takes a single input (got "
+                          f"{len(ins)}); concat upstream if needed")
+    cfg = {"n_experts": n_experts, "top_k": top_k,
+           "expert_dim": expert_dim or 4 * ins[0].size}
+    return LayerOutput(name or auto_name("moe"), "moe", ins[0].size, ins, cfg)
 
 
 def fc_layer(input, size, act="tanh", name=None, bias_attr=True,

@@ -92,6 +92,17 @@ def _(r, B, T):
             {"x": _r(r, B, 5), "y": _r(r, B, 3)})
 
 
+@case("moe_softmax_gate", ["moe"])
+def _(r, B, T):
+    x = L.data_layer("x", size=6)
+    # top_k == n_experts keeps the gate smooth (the top-k cut is piecewise;
+    # finite differences need differentiability) while still exercising the
+    # router grad and both expert einsums; top_k<E forward is covered by
+    # tests/test_moe.py
+    return (L.moe_layer(x, n_experts=3, top_k=3, expert_dim=8),
+            {"x": _r(r, B, 6)})
+
+
 @case("embedding", ["embedding"])
 def _(r, B, T):
     w = L.data_layer("w", size=11, is_seq=True)
