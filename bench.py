@@ -20,8 +20,9 @@ Env overrides: BENCH_MODEL=lstm|lstm256|lstm1280|resnet50|alexnet|googlenet|
 smallnet|seq2seq|transformer (seq2seq/transformer report tokens/sec — the
 reference never shipped an NMT row and predates transformers),
 BENCH_STEPS, BENCH_BATCH, BENCH_INIT_TIMEOUT, BENCH_COMPILE_TIMEOUT,
-BENCH_STEP_TIMEOUT (seconds), BENCH_PEAK_TFLOPS (override peak), and
-BENCH_PLATFORM (e.g. cpu to force a platform for local testing).
+BENCH_STEP_TIMEOUT (seconds), BENCH_PEAK_TFLOPS (override peak),
+BENCH_PLATFORM (e.g. cpu to force a platform for local testing), and
+BENCH_PROFILE_DIR (capture an xprof trace of the timed steps).
 
 Result cache (round-3): every successful run is persisted to
 bench_cache.json (committed) keyed by model name, with measured_at
@@ -617,12 +618,20 @@ def main():
 
     # -- phase 4: timed steps --
     dog.phase("steps", t_steps)
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR")
     try:
+        if profile_dir:
+            # xprof trace of the timed window (the round-2 verdict's MFU
+            # analysis wants per-family profiles); capture is ~free
+            jax.profiler.start_trace(profile_dir)
         t0 = time.perf_counter()
         for i in range(steps):
             loss = run(i)
         jax.block_until_ready(loss)
         dt = (time.perf_counter() - t0) / steps
+        if profile_dir:
+            jax.profiler.stop_trace()
+            _log(f"xprof trace written to {profile_dir}")
     except Exception as e:  # noqa: BLE001
         dog.clear()
         stub.update(error="step_failed", phase="steps",
