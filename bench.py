@@ -93,6 +93,7 @@ def _cache_store(model, result):
         return cache
     entry = dict(result)
     entry["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    prev = cache.get(model)
     cache[model] = entry
     try:
         tmp = _CACHE_PATH + ".tmp"
@@ -101,8 +102,13 @@ def _cache_store(model, result):
             f.write("\n")
         os.replace(tmp, _CACHE_PATH)
     except OSError as e:
+        # report what is actually on disk: the previous entry survives a
+        # failed write; only a brand-new entry disappears
         _log(f"cache write failed (non-fatal): {e}")
-        del cache[model]
+        if prev is None:
+            del cache[model]
+        else:
+            cache[model] = prev
     return cache
 
 
@@ -619,24 +625,33 @@ def main():
     # -- phase 4: timed steps --
     dog.phase("steps", t_steps)
     profile_dir = os.environ.get("BENCH_PROFILE_DIR")
+    tracing = False
     try:
         if profile_dir:
             # xprof trace of the timed window (the round-2 verdict's MFU
             # analysis wants per-family profiles); capture is ~free
             jax.profiler.start_trace(profile_dir)
+            tracing = True
         t0 = time.perf_counter()
         for i in range(steps):
             loss = run(i)
         jax.block_until_ready(loss)
         dt = (time.perf_counter() - t0) / steps
-        if profile_dir:
+        if tracing:
             jax.profiler.stop_trace()
+            tracing = False
             _log(f"xprof trace written to {profile_dir}")
     except Exception as e:  # noqa: BLE001
         dog.clear()
         stub.update(error="step_failed", phase="steps",
                     detail=f"{type(e).__name__}: {e}"[:800])
         _log(f"steps FAILED: {e}")
+        if tracing:
+            # flush the partial trace — it profiles exactly the failing run
+            try:
+                jax.profiler.stop_trace()
+            except Exception:   # noqa: BLE001
+                pass
         sys.exit(_emit_failure(stub, cache_key))
     dog.clear()
 

@@ -63,12 +63,22 @@ def _synth_corpus():
     return docs
 
 
+_docs_cache = {}
+
+
 def _all_docs():
-    """{category: [word list per doc]} from real corpus or synthetic."""
-    if _have_real():
-        return {cat: [_words(p) for p in _category_files(cat)]
+    """{category: [word list per doc]} from real corpus or synthetic —
+    memoized per corpus dir, so get_word_dict() + train() + test() read and
+    tokenize the 2000 documents once, not three times."""
+    key = _corpus_dir() if _have_real() else "<synthetic>"
+    if key not in _docs_cache:
+        if key == "<synthetic>":
+            _docs_cache[key] = _synth_corpus()
+        else:
+            _docs_cache[key] = {
+                cat: [_words(p) for p in _category_files(cat)]
                 for cat in ("neg", "pos")}
-    return _synth_corpus()
+    return _docs_cache[key]
 
 
 def _word_dict_for(docs):

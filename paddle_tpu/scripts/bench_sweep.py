@@ -82,19 +82,24 @@ def main(argv=None):
                ("value", "unit", "vs_baseline", "mfu",
                 "tokens_per_s", "error", "cached")}
         # keep the diagnostics for failed runs — a crashed combo from a
-        # scarce healthy-chip window must stay debuggable
-        if r.get("error"):
-            for k in ("rc", "stderr", "phase", "detail", "live_error"):
+        # scarce healthy-chip window must stay debuggable.  A cached replay
+        # carries its live failure under live_error (bench.py _emit_failure)
+        if r.get("error") or r.get("live_error"):
+            for k in ("rc", "stderr", "phase", "detail", "live_error",
+                      "live_phase"):
                 if r.get(k) is not None:
                     row[k] = r[k]
         results[combo] = row
         print(f"[sweep] {combo}: {row}", file=sys.stderr, flush=True)
         # only a true wedge signal stops the sweep; a combo-specific
         # compile/steps/sweep timeout (e.g. an oversized batch) moves on so
-        # the remaining combos still use the healthy window
-        if r.get("error") in ("backend_unavailable_timeout",
-                              "backend_unavailable") and not r.get("cached"):
-            print(f"[sweep] backend wedged ({r.get('error')}) — stopping "
+        # the remaining combos still use the healthy window.  Cached
+        # replays count: the chip is just as wedged, and each further combo
+        # would burn the full init-retry budget to replay its cache.
+        wedges = ("backend_unavailable_timeout", "backend_unavailable")
+        if r.get("error") in wedges or r.get("live_error") in wedges:
+            print(f"[sweep] backend wedged "
+                  f"({r.get('error') or r.get('live_error')}) — stopping "
                   "sweep", file=sys.stderr)
             break
     print(json.dumps({"sweep": results}), flush=True)
