@@ -17,8 +17,9 @@ broken chip is distinguishable from a broken framework.  MFU is estimated
 from analytic model FLOPs and the chip's peak (device_kind table below).
 
 Env overrides: BENCH_MODEL=lstm|lstm256|lstm1280|resnet50|alexnet|googlenet|
-smallnet|seq2seq|transformer (seq2seq/transformer report tokens/sec — the
-reference never shipped an NMT row and predates transformers),
+smallnet|seq2seq|transformer|transformer_decode (seq2seq/transformer report
+tokens/sec — the reference never shipped an NMT row and predates
+transformers; transformer_decode times the KV-cached serving beam search),
 BENCH_STEPS, BENCH_BATCH, BENCH_INIT_TIMEOUT, BENCH_COMPILE_TIMEOUT,
 BENCH_STEP_TIMEOUT (seconds), BENCH_PEAK_TFLOPS (override peak),
 BENCH_PLATFORM (e.g. cpu to force a platform for local testing), and
@@ -467,9 +468,51 @@ def bench_transformer(batch=32, seq_len=256, vocab=32000, d_model=512,
         {"tokens_per_step": tok, "remat": remat}
 
 
+def bench_transformer_decode(batch=32, src_len=128, max_len=128, vocab=32000,
+                             d_model=512, dff=2048, layers=6, heads=8,
+                             beam=4):
+    """Serving decode throughput: KV-cached beam search on transformer-base
+    (models/transformer.py generate_cached).  No reference baseline (the
+    reference predates transformers); emitted tokens/sec is the headline."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models import transformer
+
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=vocab, d_model=d_model, dff=dff,
+                              enc_layers=layers, dec_layers=layers,
+                              max_len=src_len + max_len)
+    rng = np.random.RandomState(0)
+    src = SequenceBatch(
+        data=jnp.asarray(rng.randint(3, vocab, (batch, src_len)), jnp.int32),
+        lengths=jnp.full((batch,), src_len, jnp.int32))
+
+    decode = jax.jit(lambda s: transformer.generate_cached(
+        params, s, beam_size=beam, max_len=max_len, num_heads=heads))
+
+    def run(s):
+        # the harness float()s the return for its log line: hand it the
+        # mean beam score (scalar) while timing the whole decode
+        return decode(src).scores.mean()
+
+    # decoder stack runs per decoded position per beam lane (incl. the
+    # dominant d_model x vocab output projection); the encoder runs ONCE
+    # per sequence, not per token/lane
+    dec_params = layers * (8 * d_model ** 2 + 2 * d_model * dff) \
+        + d_model * vocab
+    enc_params = layers * (4 * d_model ** 2 + 2 * d_model * dff)
+    flops = 2.0 * (dec_params * batch * beam * max_len
+                   + enc_params * batch * src_len)
+    return run, flops, None, (
+        f"transformer decode ms/batch bs={batch} beam={beam} "
+        f"T={max_len}"), {"tokens_per_step": batch * max_len}
+
+
 _BENCHES = {
     # name: (factory, default_batch)
     "transformer": (lambda b: bench_transformer(batch=b), 32),
+    "transformer_decode": (lambda b: bench_transformer_decode(batch=b), 32),
     "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),
     "lstm": (lambda b: bench_lstm(batch=b, hidden=512, baseline_ms=184.0), 64),
     "lstm256": (lambda b: bench_lstm(batch=b, hidden=256, baseline_ms=83.0), 64),

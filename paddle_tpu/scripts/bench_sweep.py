@@ -33,6 +33,7 @@ DEFAULT_COMBOS = [
     "googlenet:256", "googlenet:512",
     "lstm1280:256",
     "transformer:32", "transformer:128",          # 128*256 = 32768 tok
+    "transformer_decode:32",                      # KV-cached serving path
     "seq2seq:64",
 ]
 
@@ -86,7 +87,7 @@ def main(argv=None):
         # carries its live failure under live_error (bench.py _emit_failure)
         if r.get("error") or r.get("live_error"):
             for k in ("rc", "stderr", "phase", "detail", "live_error",
-                      "live_phase"):
+                      "live_phase", "live_detail"):
                 if r.get(k) is not None:
                     row[k] = r[k]
         results[combo] = row
