@@ -1,0 +1,118 @@
+"""Wire-level protobuf dump (reference python/paddle/utils/show_pb.py:1).
+
+The reference printed a binary ModelConfig with generated bindings; this
+build carries no protoc output, so the dump decodes the raw proto wire
+format instead (reusing data/proto_format's field walker): every field
+prints as `<number>: <value>`, length-delimited payloads are recursively
+decoded as messages when they parse cleanly, else shown as utf-8/hex.
+Works on ANY protobuf file — reference model configs, DataFormat records,
+checkpoints from other tools.
+
+Usage:  python -m paddle_tpu.utils.tools.show_pb FILE [--max-bytes N]
+"""
+
+import sys
+
+from paddle_tpu.data.proto_format import _fields, _WIRE_LEN
+from paddle_tpu.utils.error import ConfigError
+
+
+def _try_message(buf, depth, max_depth):
+    """Decode buf as a message if every field parses; else None."""
+    if depth >= max_depth or len(buf) == 0:
+        return None
+    try:
+        fields = list(_fields(bytes(buf)))
+    except ConfigError:
+        return None
+    return fields or None
+
+
+def format_pb(buf, indent=0, depth=0, max_depth=8, out=None, fields=None):
+    """fields: pre-parsed output of _fields for buf (avoids re-walking
+    payloads the recursion already decoded)."""
+    out = out if out is not None else []
+    pad = "  " * indent
+    if fields is None:
+        try:
+            fields = list(_fields(bytes(buf)))
+        except ConfigError as e:
+            out.append(f"{pad}<unparseable: {e}>")
+            return out
+    for field, wire, val in fields:
+        if wire == _WIRE_LEN:
+            sub = _try_message(val, depth + 1, max_depth)
+            if sub is not None:
+                out.append(f"{pad}{field} {{")
+                format_pb(val, indent + 1, depth + 1, max_depth, out,
+                          fields=sub)
+                out.append(f"{pad}}}")
+                continue
+            raw = bytes(val)
+            try:
+                txt = raw.decode("utf-8")
+                if txt.isprintable() or txt == "":
+                    out.append(f'{pad}{field}: "{txt}"')
+                    continue
+            except UnicodeDecodeError:
+                pass
+            shown = raw[:24].hex()
+            more = f"... ({len(raw)} bytes)" if len(raw) > 24 else ""
+            out.append(f"{pad}{field}: 0x{shown}{more}")
+        elif wire == 5:     # fixed32: show both int and float views
+            import struct
+            i = int.from_bytes(bytes(val), "little")
+            f = struct.unpack("<f", bytes(val))[0]
+            out.append(f"{pad}{field}: {i} (f32 {f:.6g})")
+        elif wire == 1:     # fixed64
+            import struct
+            i = int.from_bytes(bytes(val), "little")
+            d = struct.unpack("<d", bytes(val))[0]
+            out.append(f"{pad}{field}: {i} (f64 {d:.6g})")
+        else:
+            out.append(f"{pad}{field}: {val}")
+    return out
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    usage = "usage: show_pb FILE [--max-bytes N]"
+    max_bytes = None
+    if "--max-bytes" in argv:
+        i = argv.index("--max-bytes")
+        try:
+            max_bytes = int(argv[i + 1])
+        except (IndexError, ValueError):
+            raise SystemExit(usage)
+        del argv[i:i + 2]
+    if len(argv) != 1:
+        raise SystemExit(usage)
+    from paddle_tpu.data.proto_format import _open
+    with _open(argv[0]) as f:       # handles .gz like the data providers
+        data = f.read(max_bytes) if max_bytes else f.read()
+    # a bare serialized message (the reference show_pb case) parses whole;
+    # data FILES are varint-delimited message streams (ProtoReader framing)
+    lines = format_pb(data)
+    if any(l.startswith("<unparseable") for l in lines):
+        import io
+        from paddle_tpu.data.proto_format import _read_messages
+        lines = []
+        try:
+            for i, msg in enumerate(_read_messages(io.BytesIO(data))):
+                lines.append(f"message {i} ({len(msg)} bytes) {{")
+                format_pb(msg, indent=1, out=lines)
+                lines.append("}")
+        except ConfigError as e:
+            lines.append(f"<stream truncated: {e}>")
+    print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BrokenPipeError:
+        # `show_pb file | head` closing the pipe is normal CLI usage;
+        # confined here so library callers keep their stderr
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stderr.fileno())
+        sys.exit(0)
