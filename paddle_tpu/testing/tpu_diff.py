@@ -179,6 +179,31 @@ def run_optimizer_cases(out_dir=None):
     return results
 
 
+def _code_revision():
+    """Current code state: HEAD plus a digest of any uncommitted diff, so
+    local iteration (the common revision-mixing case) changes the stamp
+    too.  'unknown' when git is unavailable — the compare test treats that
+    as unverifiable, not as a match."""
+    import hashlib
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        head = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=repo,
+            capture_output=True, text=True, timeout=10).stdout.strip()
+        if not head:
+            return "unknown"
+        diff = subprocess.run(
+            ["git", "diff", "HEAD"], cwd=repo,
+            capture_output=True, text=True, timeout=30).stdout
+        if diff:
+            return f"{head[:12]}+{hashlib.sha1(diff.encode()).hexdigest()[:8]}"
+        return head[:12]
+    except Exception:   # noqa: BLE001 — no git in deployment images
+        return "unknown"
+
+
 def consolidate(out_dir, out_path):
     import numpy as np
     flat = {}
@@ -189,6 +214,13 @@ def consolidate(out_dir, out_path):
         with np.load(os.path.join(out_dir, fn)) as z:
             for label in z.files:
                 flat[f"{case}::{label}"] = z[label]
+    # stamp with the revision the CACHE was produced at (REVISION is
+    # written by supervise before any case runs) — not the possibly-moved
+    # current HEAD
+    rev_file = os.path.join(out_dir, "REVISION")
+    rev = open(rev_file).read().strip() if os.path.exists(rev_file) \
+        else _code_revision()
+    flat["__revision__"] = np.frombuffer(rev.encode(), np.uint8).copy()
     np.savez_compressed(out_path, **flat)
     return len(flat)
 
@@ -215,10 +247,30 @@ def supervise(platform, out_path, case_timeout=150.0, max_consec_fail=4):
     TPU compile can only be killed from outside the process (it blocks in
     C++ where no Python signal lands).  Consecutive-failure cap aborts the
     sweep when the chip/tunnel itself is down rather than one case."""
+    import shutil
     import subprocess
     import numpy as np
     out_dir = out_path + ".d"
+    # the per-case resume cache is only valid for the code that wrote it:
+    # a resumed dump mixing revisions would make the cross-platform compare
+    # diff two different programs
+    rev = _code_revision()
+    rev_file = os.path.join(out_dir, "REVISION")
+    if os.path.isdir(out_dir):
+        if not os.path.exists(rev_file):
+            # pre-stamping cache: adopt it rather than destroy tens of
+            # minutes of TPU compiles (its provenance is the operator's
+            # responsibility; from now on changes invalidate it properly)
+            print("[tpu_diff] adopting unstamped case cache as current "
+                  "revision", file=sys.stderr, flush=True)
+        elif open(rev_file).read().strip() != rev:
+            old = open(rev_file).read().strip()
+            print(f"[tpu_diff] clearing stale case cache ({old} != "
+                  f"{rev})", file=sys.stderr, flush=True)
+            shutil.rmtree(out_dir)
     os.makedirs(out_dir, exist_ok=True)
+    with open(rev_file, "w") as f:
+        f.write(rev + "\n")
     retry_errors = os.environ.get("TPU_DIFF_RETRY_ERRORS", "0") == "1"
     consec = 0
     names = _case_names() + ["__optim__"]

@@ -40,7 +40,25 @@ def _cases():
     if not _READY:
         return []
     cpu, _ = _load()
-    return sorted({k.split("::")[0] for k in cpu.files})
+    return sorted({k.split("::")[0] for k in cpu.files
+                   if not k.startswith("__")})
+
+
+def test_same_code_revision():
+    """Both dumps must come from the same code state — a resumed cache
+    from an older revision would diff two different programs."""
+    cpu, tpu = _load()
+    revs = []
+    for z in (cpu, tpu):
+        revs.append(bytes(z["__revision__"]).decode()
+                    if "__revision__" in z.files else "<unstamped>")
+    for r in revs:
+        assert r not in ("unknown", "<unstamped>"), (
+            f"dump revision unverifiable ({revs}) — regenerate with git "
+            "available so provenance can be checked")
+    assert revs[0] == revs[1], (
+        f"dump revision mismatch: cpu={revs[0]} tpu={revs[1]} — "
+        "regenerate both dumps at the current revision")
 
 
 @pytest.mark.parametrize("case", _cases())
