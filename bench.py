@@ -225,6 +225,18 @@ class Watchdog:
                 os._exit(_emit_failure(out, self._model))
 
 
+_RNN_MODELS = ("lstm", "lstm256", "lstm1280", "seq2seq")
+_RNN_OFF = ("0", "off", "false", "no")
+
+
+def _fused_rnn_disabled():
+    """Mirror ops/rnn.py's dispatch: PADDLE_TPU_FUSED_RNN with the legacy
+    PADDLE_TPU_FUSED_LSTM alias."""
+    v = os.environ.get("PADDLE_TPU_FUSED_RNN",
+                       os.environ.get("PADDLE_TPU_FUSED_LSTM", ""))
+    return v in _RNN_OFF
+
+
 def _env_remat(default):
     """BENCH_REMAT=1/0 overrides; anything else -> the model's heuristic."""
     v = os.environ.get("BENCH_REMAT", "")
@@ -579,6 +591,11 @@ def main():
     # scaling-sweep runs cache under their own key so e.g. resnet50@bs256
     # coexists with the default-batch headline row
     cache_key = model if batch == default_batch else f"{model}@bs{batch}"
+    # an explicitly-disabled fused-RNN run is the SCAN BASELINE for the
+    # vs-scan kernel column — its own cache row, never overwriting the
+    # fused number (both env spellings, matching ops/rnn.py's dispatch)
+    if _fused_rnn_disabled() and model in _RNN_MODELS:
+        cache_key += "@scan"
 
     stub = {"metric": f"{model} (pending)", "value": None, "unit": "ms/batch",
             "vs_baseline": None}
@@ -641,9 +658,8 @@ def main():
             # losing the benchmark ("fused_rnn_fallback": true marks it).
             # Only meaningful for the RNN-bearing models.
             from paddle_tpu.ops import rnn as _rnn
-            rnn_models = {"lstm", "lstm256", "lstm1280", "seq2seq"}
-            if (model not in rnn_models
-                    or _rnn.FUSED_LSTM in ("0", "off", "false", "no")):
+            if (model not in _RNN_MODELS
+                    or _rnn.FUSED_LSTM in _RNN_OFF):
                 raise
             _log(f"compile failed ({type(first).__name__}); retrying with "
                  f"PADDLE_TPU_FUSED_RNN=0")

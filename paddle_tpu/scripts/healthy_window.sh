@@ -31,6 +31,12 @@ timeout 14400 python -m paddle_tpu.scripts.bench_sweep \
     > "$ART/bench_sweep.json" 2> "$ART/bench_sweep.log"
 log "sweep rc=$? (bench_cache.json updated)"
 
+log "phase 2b: scan baselines for the fused-kernel vs-scan column"
+PADDLE_TPU_FUSED_RNN=0 timeout 3600 python -m paddle_tpu.scripts.bench_sweep \
+    --combos "lstm:64,lstm256:64,lstm1280:64,seq2seq:64" \
+    > "$ART/bench_scan_baselines.json" 2> "$ART/bench_scan_baselines.log"
+log "scan baselines rc=$? (cached under model@scan)"
+
 log "phase 3: TPU differential dump + compare"
 # resumable per-case dumps; 'default' platform = the axon-routed TPU
 timeout 7200 python -m paddle_tpu.testing.tpu_diff default \

@@ -256,21 +256,30 @@ def supervise(platform, out_path, case_timeout=150.0, max_consec_fail=4):
     # diff two different programs
     rev = _code_revision()
     rev_file = os.path.join(out_dir, "REVISION")
+    keep_stamp = None
     if os.path.isdir(out_dir):
-        if not os.path.exists(rev_file):
+        old = open(rev_file).read().strip() \
+            if os.path.exists(rev_file) else None
+        if old is None:
             # pre-stamping cache: adopt it rather than destroy tens of
             # minutes of TPU compiles (its provenance is the operator's
             # responsibility; from now on changes invalidate it properly)
             print("[tpu_diff] adopting unstamped case cache as current "
                   "revision", file=sys.stderr, flush=True)
-        elif open(rev_file).read().strip() != rev:
-            old = open(rev_file).read().strip()
+        elif rev == "unknown":
+            # can't VERIFY the cache ('unknown' means git is unavailable,
+            # not a different revision) — keep it and its concrete stamp
+            print("[tpu_diff] code revision unverifiable (no git); "
+                  "keeping existing case cache", file=sys.stderr,
+                  flush=True)
+            keep_stamp = old
+        elif old != rev:
             print(f"[tpu_diff] clearing stale case cache ({old} != "
                   f"{rev})", file=sys.stderr, flush=True)
             shutil.rmtree(out_dir)
     os.makedirs(out_dir, exist_ok=True)
     with open(rev_file, "w") as f:
-        f.write(rev + "\n")
+        f.write((keep_stamp or rev) + "\n")
     retry_errors = os.environ.get("TPU_DIFF_RETRY_ERRORS", "0") == "1"
     consec = 0
     names = _case_names() + ["__optim__"]
