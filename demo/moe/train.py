@@ -1,0 +1,60 @@
+"""Mixture-of-experts demo (post-reference capability; ops/moe.py +
+layers.moe_layer).  A 4-expert top-2 MoE block classifies which quadrant a
+2-D point is in — a task where different experts naturally specialize per
+region.  Under a mesh trainer the experts shard over the 'expert' axis
+(moe.expert_shardings); see __graft_entry__._dryrun_expert_parallel for
+the sharded training step."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import paddle_tpu.layers as L
+from paddle_tpu import optim
+from paddle_tpu.data import dense_vector, integer_value
+from paddle_tpu.data import reader as reader_mod
+
+
+def _synthetic(n=4096, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.uniform(-1, 1, (n, 2)).astype(np.float32)
+    label = (x[:, 0] > 0).astype(np.int32) * 2 + (x[:, 1] > 0).astype(np.int32)
+
+    def reader():
+        for i in range(n):
+            yield x[i], int(label[i])
+    return reader
+
+
+def get_config():
+    x = L.data_layer("x", size=2)
+    y = L.data_layer("y", size=4)
+    h = L.fc_layer(x, size=32, act="tanh")
+    m = L.moe_layer(h, n_experts=4, top_k=2, expert_dim=64, name="moe")
+    pred = L.fc_layer(m, size=4, act="softmax", name="out")
+    return {
+        "cost": L.classification_cost(pred, y),
+        "output": pred,
+        "optimizer": optim.Adam(learning_rate=0.01),
+        "train_reader": reader_mod.batch(_synthetic(), 64),
+        "feeding": {"x": dense_vector(2), "y": integer_value(4)},
+    }
+
+
+if __name__ == "__main__":
+    from paddle_tpu.trainer import SGD
+    cfg = get_config()
+    tr = SGD(cost=cfg["cost"], update_equation=cfg["optimizer"])
+    tr.train(cfg["train_reader"], num_passes=4, feeding=cfg["feeding"],
+             log_period=20)
+    # report accuracy on fresh points
+    import jax.numpy as jnp
+    from paddle_tpu.layers.graph import Topology
+    rng = np.random.RandomState(1)
+    xq = rng.uniform(-1, 1, (512, 2)).astype(np.float32)
+    want = (xq[:, 0] > 0).astype(np.int32) * 2 + (xq[:, 1] > 0).astype(np.int32)
+    probs = np.asarray(Topology([cfg["output"]]).apply(
+        tr.parameters, {"x": jnp.asarray(xq)}, mode="test"))
+    acc = (probs.argmax(-1) == want).mean()
+    print(f"quadrant accuracy: {acc:.3f}")

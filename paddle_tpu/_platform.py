@@ -1,0 +1,29 @@
+"""Single implementation of the JAX_PLATFORMS env re-assert.
+
+Some deployment images register a TPU plugin from sitecustomize and pin the
+jax_platforms CONFIG at interpreter startup, silently overriding the user's
+JAX_PLATFORMS env var (symptom: CPU-intended runs hang on a remote TPU
+tunnel).  Call this before the first backend use to restore standard JAX
+env semantics.  Kept dependency-free so the package root can import it
+first.
+"""
+
+import os
+import warnings
+
+
+def honor_jax_platforms_env():
+    """Re-assert JAX_PLATFORMS (full priority list, e.g. "tpu,cpu") at the
+    config level.  Failure (backend already initialized) warns instead of
+    silently leaving the user on the wrong platform."""
+    plats = os.environ.get("JAX_PLATFORMS")
+    if not plats:
+        return
+    try:
+        import jax
+        jax.config.update("jax_platforms", plats)
+    except Exception as e:   # noqa: BLE001
+        warnings.warn(
+            f"JAX_PLATFORMS={plats!r} could not be applied to jax config "
+            f"({type(e).__name__}: {e}); the process may be routed to a "
+            "different backend than the env var requests", stacklevel=2)

@@ -18,17 +18,8 @@ import traceback
 import numpy as np
 
 
-def _honor_jax_platforms_env():
-    """A sitecustomize hook may pin jax_platforms at interpreter start (e.g.
-    to a remote TPU); for the embedded C API the JAX_PLATFORMS env var is
-    authoritative, so re-assert it at the config level."""
-    plats = os.environ.get("JAX_PLATFORMS")
-    if plats:
-        try:
-            import jax
-            jax.config.update("jax_platforms", plats)
-        except Exception:
-            pass
+from paddle_tpu._platform import \
+    honor_jax_platforms_env as _honor_jax_platforms_env
 
 
 _machines = {}

@@ -113,9 +113,8 @@ def main(argv=None):
 
     # honor JAX_PLATFORMS even where a sitecustomize hook pins the
     # jax_platforms *config* at interpreter startup (env var alone loses)
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from paddle_tpu._platform import honor_jax_platforms_env
+    honor_jax_platforms_env()
 
     if args.job == "version":
         from paddle_tpu.version import __version__
