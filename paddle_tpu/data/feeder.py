@@ -38,12 +38,14 @@ class DataFeeder:
         """feeding: {name: InputType} or {name: index} paired with types.
 
         bucket_bounds: optional list of allowed padded lengths (per name or
-        shared) to bound XLA recompilation.
+        shared) to bound XLA recompilation.  Stored sorted; sequences
+        LONGER than the largest bound are truncated to it — warned once.
         pad_batch_to: optional fixed batch size (pads short final batches).
         """
         self.feeding = feeding
-        self.bucket_bounds = bucket_bounds
+        self.bucket_bounds = sorted(bucket_bounds) if bucket_bounds else None
         self.pad_batch_to = pad_batch_to
+        self._warned_truncate = False
 
     def _convert_one(self, name, itype: InputType, columns):
         # py2-era providers yield lazy iterables (map objects etc.)
@@ -86,6 +88,15 @@ class DataFeeder:
                     seqs.append(rows)
             max_len = max(len(s) for s in seqs)
             if self.bucket_bounds:
+                if max_len > self.bucket_bounds[-1] \
+                        and not self._warned_truncate:
+                    self._warned_truncate = True
+                    from paddle_tpu.utils.logging import logger
+                    logger.warning(
+                        "DataFeeder: %r sequences of length %d exceed the "
+                        "largest bucket (%d) and are TRUNCATED to it; raise "
+                        "the bucket bounds if this is not intended",
+                        name, max_len, self.bucket_bounds[-1])
                 max_len = bucket_for(max_len, self.bucket_bounds)
             if itype.kind == "index":
                 return _pad_int_seqs(seqs, max_len)

@@ -35,12 +35,62 @@ def _load_config(path, config_args):
     return config_to_runtime(parse_config(path, config_args))
 
 
-def _resolve_feeder(feeding):
-    """feeding may be a DataFeeder, an input-types dict, or None."""
+def _resolve_feeder(feeding, seq_buckets=None, pad_batch=None):
+    """feeding may be a DataFeeder, an input-types dict, or None.
+
+    seq_buckets: allowed padded sequence lengths (XLA compiles one program
+    per bucket instead of one per distinct batch shape — essential for
+    variable-length data on TPU); pad_batch: fixed batch size."""
     from paddle_tpu.data.feeder import DataFeeder
     if isinstance(feeding, DataFeeder):
         return feeding
-    return DataFeeder(feeding) if feeding else None
+    if not feeding:
+        return None
+    return DataFeeder(feeding, bucket_bounds=seq_buckets,
+                      pad_batch_to=pad_batch)
+
+
+def _seq_buckets_arg(value):
+    """argparse type for --seq_buckets: sorted positive ints."""
+    try:
+        bounds = sorted(int(b) for b in value.split(",") if b.strip())
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--seq_buckets wants comma-separated ints, got {value!r}")
+    if not bounds or any(b < 1 for b in bounds):
+        raise argparse.ArgumentTypeError(
+            f"--seq_buckets wants positive lengths, got {value!r}")
+    return bounds
+
+
+def _feeder_from_args(args, cfg, allow_pad=True):
+    """The job's DataFeeder honoring --seq_buckets/--pad_batch (jobs whose
+    parsers don't register the flags fall back to plain resolution).
+
+    allow_pad=False for the test job: batch padding duplicates the last
+    sample, which would bias an unmasked test metric."""
+    from paddle_tpu.data.feeder import DataFeeder
+    from paddle_tpu.utils.logging import logger
+    buckets = getattr(args, "seq_buckets", None)
+    want_pad = getattr(args, "pad_batch", False) and allow_pad
+    if isinstance(cfg.get("feeding"), DataFeeder):
+        if buckets or want_pad:
+            logger.warning(
+                "--seq_buckets/--pad_batch ignored: the config supplies a "
+                "ready-made DataFeeder; set bucket_bounds/pad_batch_to on "
+                "it instead")
+        return cfg["feeding"]
+    pad = None
+    if want_pad:
+        pad = cfg.get("batch_size")
+        if not pad:
+            logger.warning(
+                "--pad_batch ignored: the config declares no batch_size")
+    if getattr(args, "pad_batch", False) and not allow_pad:
+        logger.info("--pad_batch not applied to the test job (padding "
+                    "duplicates samples, biasing the metric)")
+    return _resolve_feeder(cfg.get("feeding"), seq_buckets=buckets,
+                           pad_batch=pad)
 
 
 def _parse_config_args(s):
@@ -70,6 +120,17 @@ def main(argv=None):
                             "(reference feenableexcept)")
         p.add_argument("--comment", default="",
                        help="freeform run annotation, logged once")
+        p.add_argument("--seq_buckets", default=None,
+                       type=_seq_buckets_arg,
+                       help="comma-separated allowed padded sequence "
+                            "lengths, e.g. 32,64,128: bounds XLA "
+                            "recompilation to one program per bucket "
+                            "(recommended for variable-length data on "
+                            "TPU).  Sequences longer than the largest "
+                            "bucket are truncated to it (warned)")
+        p.add_argument("--pad_batch", action="store_true",
+                       help="pad the final short batch to the full batch "
+                            "size (one more shape avoided)")
 
     t = sub.add_parser("train")
     add_common(t)
@@ -163,7 +224,7 @@ def main(argv=None):
     if args.job == "checkgrad":
         from paddle_tpu.layers.graph import Topology
         from paddle_tpu.testing import check_topology_grads
-        feeder = _resolve_feeder(cfg.get("feeding"))
+        feeder = _feeder_from_args(args, cfg)
         batch = next(iter(cfg["train_reader"]()))
         feed = feeder(batch) if feeder else batch
         costs = cfg["cost"]
@@ -212,7 +273,7 @@ def main(argv=None):
         ev_handler = None
         if args.show_layer_stat:
             from paddle_tpu.trainer import events as _ev
-            feeder = _resolve_feeder(cfg.get("feeding"))
+            feeder = _feeder_from_args(args, cfg)
 
             def ev_handler(ev, _tr=trainer, _cfg=cfg, _feeder=feeder):
                 if isinstance(ev, _ev.BeginPass):
@@ -227,7 +288,7 @@ def main(argv=None):
             trainer.train(cfg["train_reader"],
                           num_passes=args.num_passes,
                           event_handler=ev_handler,
-                          feeding=cfg.get("feeding"),
+                          feeding=_feeder_from_args(args, cfg),
                           save_dir=save_dir,
                           saving_period=args.saving_period,
                           save_only_one=args.save_only_one,
@@ -247,7 +308,8 @@ def main(argv=None):
     if args.job == "test":
         trainer.load(args.model_dir, args.test_pass)
         cost = trainer.test(cfg.get("test_reader") or cfg["train_reader"],
-                            feeding=cfg.get("feeding"))
+                            feeding=_feeder_from_args(args, cfg,
+                                                      allow_pad=False))
         print(f"test cost: {cost:.5f}")
         return 0
 

@@ -112,3 +112,52 @@ def test_trainer_resume(tmp_path):
     w1 = np.asarray(t1.parameters[list(t1.parameters)[0]]["w0"])
     w2 = np.asarray(t2.parameters[list(t2.parameters)[0]]["w0"])
     np.testing.assert_allclose(w1, w2)
+
+
+def test_cli_seq_buckets(tmp_path, monkeypatch):
+    """--seq_buckets/--pad_batch plumb into the DataFeeder: every padded
+    batch lands on one static shape (XLA compiles once)."""
+    conf = tmp_path / "conf.py"
+    conf.write_text(
+        "import numpy as np\n"
+        "import paddle_tpu.layers as L\n"
+        "from paddle_tpu import optim\n"
+        "from paddle_tpu.data import integer_value_sequence, integer_value\n"
+        "from paddle_tpu.data import reader as reader_mod\n"
+        "def _samples():\n"
+        "    rng = np.random.RandomState(0)\n"
+        "    for i in range(40):\n"
+        "        n = int(rng.randint(3, 12))\n"
+        "        yield [int(x) for x in rng.randint(0, 20, n)], int(i % 2)\n"
+        "def get_config():\n"
+        "    w = L.data_layer('w', size=20)\n"
+        "    lbl = L.data_layer('lbl', size=2)\n"
+        "    emb = L.embedding_layer(w, size=6)\n"
+        "    p = L.pooling_layer(emb, pooling_type='sum')\n"
+        "    out = L.fc_layer(p, size=2, act='softmax')\n"
+        "    return {'cost': L.classification_cost(out, lbl),\n"
+        "            'optimizer': optim.Momentum(learning_rate=0.1,\n"
+        "                                        momentum=0.9),\n"
+        "            'train_reader': reader_mod.batch(_samples, 16),\n"
+        "            'batch_size': 16,\n"
+        "            'feeding': {'w': integer_value_sequence(20),\n"
+        "                        'lbl': integer_value(2)}}\n")
+    from paddle_tpu.trainer import cli
+    seen_shapes = set()
+    from paddle_tpu.trainer import trainer as trainer_mod
+    orig = trainer_mod._normalize_feed
+
+    def spy(feed):
+        out = orig(feed)
+        from paddle_tpu.core.sequence import SequenceBatch
+        for v in out.values():
+            if isinstance(v, SequenceBatch):
+                seen_shapes.add(tuple(v.data.shape))
+        return out
+    monkeypatch.setattr(trainer_mod, "_normalize_feed", spy)
+    rc = cli.main(["train", "--config", str(conf), "--num_passes", "1",
+                   "--log_period", "0", "--seq_buckets", "16",
+                   "--pad_batch"])
+    assert not rc
+    # one bucket + padded batch = exactly one padded feed shape
+    assert seen_shapes == {(16, 16)}, seen_shapes
