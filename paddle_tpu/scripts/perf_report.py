@@ -1,0 +1,113 @@
+"""Render docs/perf.md tables from bench_cache.json.
+
+After a healthy-window sweep fills the cache, this prints the markdown
+tables the perf doc wants — BASELINE families vs the K40m reference,
+the TPU scaling column, the fused-vs-scan RNN kernel comparison, and the
+serving-decode row — each row carrying its measured_at timestamp so
+provenance survives the paste.
+
+Usage:  python -m paddle_tpu.scripts.perf_report [--cache bench_cache.json]
+"""
+
+import argparse
+import json
+import os
+import re
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_FAMILY_ORDER = ["lstm256", "lstm", "lstm1280", "smallnet", "alexnet",
+                 "googlenet", "resnet50", "seq2seq", "transformer",
+                 "transformer_decode"]
+
+
+def _fmt_mfu(e):
+    return f"{e['mfu'] * 100:.1f}%" if e.get("mfu") is not None else "—"
+
+
+def _fmt_speedup(e):
+    return f"{e['vs_baseline']}×" if e.get("vs_baseline") else "—"
+
+
+def _stamp(e):
+    return (e.get("measured_at") or "")[:16]
+
+
+def families_table(cache):
+    lines = ["| model | batch | ref K40m ms | TPU ms | speedup | MFU | "
+             "tokens/s | measured |",
+             "|---|---|---|---|---|---|---|---|"]
+    for name in _FAMILY_ORDER:
+        e = cache.get(name)
+        if not e or e.get("value") is None:
+            continue
+        m = re.search(r"bs=(\d+)", e.get("metric", ""))
+        batch = m.group(1) if m else "?"
+        # the K40m reference ms is recoverable from the cached speedup —
+        # one source of truth (bench.py's baselines), nothing re-typed here
+        ref = round(e["value"] * e["vs_baseline"], 1) \
+            if e.get("vs_baseline") else None
+        lines.append(
+            f"| {name} | {batch} | {ref if ref else 'n/a'} | "
+            f"{e['value']} | {_fmt_speedup(e)} | {_fmt_mfu(e)} | "
+            f"{e.get('tokens_per_s') or '—'} | {_stamp(e)} |")
+    return "\n".join(lines)
+
+
+def scaling_table(cache):
+    def key(k):
+        m = re.search(r"@bs(\d+)", k)
+        return (k.split("@")[0], int(m.group(1)) if m else 0)
+
+    rows = sorted((k for k in cache if "@bs" in k and "@scan" not in k),
+                  key=key)
+    if not rows:
+        return "(no scaling rows cached yet)"
+    lines = ["| run | TPU ms | MFU | tokens/s | remat | measured |",
+             "|---|---|---|---|---|---|"]
+    for k in rows:
+        e = cache[k]
+        if e.get("value") is None:
+            continue
+        lines.append(f"| {k} | {e['value']} | {_fmt_mfu(e)} | "
+                     f"{e.get('tokens_per_s') or '—'} | "
+                     f"{'yes' if e.get('remat') else 'no'} | {_stamp(e)} |")
+    return "\n".join(lines)
+
+
+def kernel_table(cache):
+    pairs = []
+    for k, e in cache.items():
+        if k.endswith("@scan") and e.get("value") is not None:
+            fused = cache.get(k[:-len("@scan")])
+            if fused and fused.get("value") is not None:
+                pairs.append((k[:-len("@scan")], fused, e))
+    if not pairs:
+        return "(no fused-vs-scan pairs cached yet)"
+    lines = ["| model | fused ms | scan ms | kernel speedup | measured |",
+             "|---|---|---|---|---|"]
+    for name, fused, scan in sorted(pairs):
+        lines.append(
+            f"| {name} | {fused['value']} | {scan['value']} | "
+            f"{scan['value'] / fused['value']:.2f}× | {_stamp(fused)} |")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cache",
+                    default=os.path.join(_REPO, "bench_cache.json"))
+    args = ap.parse_args(argv)
+    with open(args.cache) as f:
+        cache = json.load(f)
+    print("## Benchmark families (vs BASELINE.md K40m)\n")
+    print(families_table(cache))
+    print("\n## TPU scaling column\n")
+    print(scaling_table(cache))
+    print("\n## Fused Pallas RNN kernels vs lax.scan\n")
+    print(kernel_table(cache))
+
+
+if __name__ == "__main__":
+    main()

@@ -88,3 +88,25 @@ def test_no_cache_env_disables(bench, monkeypatch):
     monkeypatch.setenv("BENCH_NO_CACHE", "1")
     bench._cache_store("lstm", _tpu_result())
     assert bench._cache_load() == {}
+
+
+def test_perf_report_renders_tables(tmp_path, capsys):
+    import json
+    from paddle_tpu.scripts import perf_report
+    cache = {
+        "lstm": {"metric": "LSTM h=512 bs=64", "value": 5.0,
+                 "vs_baseline": 36.8, "mfu": 0.13,
+                 "measured_at": "2026-07-30T05:00:00Z"},
+        "lstm@scan": {"metric": "LSTM h=512 bs=64", "value": 15.0,
+                      "measured_at": "2026-07-30T05:00:00Z"},
+        "resnet50@bs512": {"metric": "ResNet-50 bs=512", "value": 99.0,
+                           "mfu": 0.4, "remat": True,
+                           "measured_at": "2026-07-30T06:00:00Z"},
+    }
+    path = tmp_path / "cache.json"
+    path.write_text(json.dumps(cache))
+    perf_report.main(["--cache", str(path)])
+    out = capsys.readouterr().out
+    assert "| lstm | 64 | 184.0 | 5.0 | 36.8× | 13.0% |" in out
+    assert "| resnet50@bs512 | 99.0 | 40.0% | — | yes |" in out
+    assert "| lstm | 5.0 | 15.0 | 3.00× |" in out
