@@ -45,7 +45,7 @@ class DataFeeder:
         self.feeding = feeding
         self.bucket_bounds = sorted(bucket_bounds) if bucket_bounds else None
         self.pad_batch_to = pad_batch_to
-        self._warned_truncate = False
+        self._warned_truncate = set()   # slot names already warned
 
     def _convert_one(self, name, itype: InputType, columns):
         # py2-era providers yield lazy iterables (map objects etc.)
@@ -89,8 +89,8 @@ class DataFeeder:
             max_len = max(len(s) for s in seqs)
             if self.bucket_bounds:
                 if max_len > self.bucket_bounds[-1] \
-                        and not self._warned_truncate:
-                    self._warned_truncate = True
+                        and name not in self._warned_truncate:
+                    self._warned_truncate.add(name)
                     from paddle_tpu.utils.logging import logger
                     logger.warning(
                         "DataFeeder: %r sequences of length %d exceed the "

@@ -19,6 +19,11 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
+    # binaries are not committed; build on first use and rebuild when the
+    # source is newer than the binary (best-effort — ensure() no-ops fast
+    # when the .so is current)
+    from paddle_tpu.native import build as _build
+    _build.ensure("dataio")
     if not os.path.exists(_SO):
         return None
     lib = ctypes.CDLL(_SO)

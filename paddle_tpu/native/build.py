@@ -10,11 +10,13 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 def build(verbose=True):
     src = os.path.join(_DIR, "src", "dataio.cpp")
     out = os.path.join(_DIR, "libpaddle_tpu_dataio.so")
+    tmp = out + f".tmp{os.getpid()}"
     cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-           "-Wall", src, "-o", out]
+           "-Wall", src, "-o", tmp]
     if verbose:
         print(" ".join(cmd))
     subprocess.check_call(cmd)
+    os.replace(tmp, out)   # atomic: concurrent builders never see a torn .so
     return out
 
 
@@ -34,13 +36,36 @@ def build_capi(verbose=True):
     """C inference API (embeds CPython; reference paddle/capi role)."""
     src = os.path.join(_DIR, "src", "capi.cpp")
     out = os.path.join(_DIR, "libpaddle_tpu_capi.so")
+    tmp = out + f".tmp{os.getpid()}"
     inc, ld = _python_flags()
     cmd = (["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-Wall", src]
-           + inc + ["-o", out] + ld)
+           + inc + ["-o", tmp] + ld)
     if verbose:
         print(" ".join(cmd))
     subprocess.check_call(cmd)
+    os.replace(tmp, out)
     return out
+
+
+def ensure(which="dataio", verbose=False):
+    """Build `which` ('dataio' or 'capi') if its .so is missing or older
+    than its source.  Best-effort: returns the .so path on success, None
+    when the toolchain is unavailable or the build fails.  The binaries
+    are intentionally NOT committed — they are rebuilt on demand here.
+    Disable with PADDLE_TPU_NO_NATIVE_BUILD=1 (e.g. images without g++)."""
+    if os.environ.get("PADDLE_TPU_NO_NATIVE_BUILD"):
+        return None
+    name = {"dataio": "libpaddle_tpu_dataio.so",
+            "capi": "libpaddle_tpu_capi.so"}[which]
+    src = os.path.join(_DIR, "src", which + ".cpp")
+    out = os.path.join(_DIR, name)
+    try:
+        if (os.path.exists(out)
+                and os.path.getmtime(out) >= os.path.getmtime(src)):
+            return out
+        return (build if which == "dataio" else build_capi)(verbose=verbose)
+    except Exception:   # noqa: BLE001 — missing g++/headers: fall back
+        return None
 
 
 def capi_header_dir():

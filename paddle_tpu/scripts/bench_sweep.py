@@ -104,9 +104,17 @@ def main(argv=None):
                   "sweep", file=sys.stderr)
             break
     print(json.dumps({"sweep": results}), flush=True)
-    ok = sum(1 for r in results.values()
-             if r.get("value") is not None and not r.get("error"))
-    return 0 if ok else 2
+    # a cached replay over a live failure is NOT a measurement: rc 4
+    # (mirrors bench.py's PADDLE_TPU_BENCH_STRICT_RC contract) so
+    # healthy_window.sh's rc log cannot mistake a wedged-chip sweep for a
+    # live one
+    live_ok = sum(1 for r in results.values()
+                  if r.get("value") is not None and not r.get("error")
+                  and not r.get("live_error"))
+    replays = sum(1 for r in results.values() if r.get("live_error"))
+    if live_ok:
+        return 0
+    return 4 if replays else 2
 
 
 if __name__ == "__main__":

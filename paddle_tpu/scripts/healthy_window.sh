@@ -13,11 +13,14 @@
 #  3. tpu_diff TPU dump + differential  (CPU-vs-TPU numerics evidence)
 #  4. nmt_scale                         (verbatim-config NMT row + golden)
 set -u
+# make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
+# from a live measurement, so the rc=$? logs below mean what they say
+export PADDLE_TPU_BENCH_STRICT_RC=1
 # an explicit dir resolves against the CALLER's cwd; the default stays
 # repo-root-relative (resolved after the cd below)
 if [ $# -ge 1 ]; then ART=$(realpath -m "$1"); else ART=""; fi
 cd "$(dirname "$0")/../.."
-ART="${ART:-$PWD/artifacts/r3}"
+ART="${ART:-$PWD/artifacts/r4}"
 mkdir -p "$ART"
 log() { echo "[healthy_window $(date -u +%H:%M:%S)] $*" >&2; }
 

@@ -81,11 +81,9 @@ def main(argv=None):
 
     # honor JAX_PLATFORMS even where a sitecustomize hook pins the
     # jax_platforms CONFIG at interpreter startup (env var alone is not
-    # enough; same guard as trainer/cli.py)
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        import jax
-        jax.config.update("jax_platforms", plat.split(",")[0])
+    # enough); the shared helper applies the full priority list
+    from paddle_tpu._platform import honor_jax_platforms_env
+    honor_jax_platforms_env()
 
     import itertools
     import numpy as np

@@ -180,28 +180,8 @@ def run_optimizer_cases(out_dir=None):
 
 
 def _code_revision():
-    """Current code state: HEAD plus a digest of any uncommitted diff, so
-    local iteration (the common revision-mixing case) changes the stamp
-    too.  'unknown' when git is unavailable — the compare test treats that
-    as unverifiable, not as a match."""
-    import hashlib
-    import subprocess
-    repo = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    try:
-        head = subprocess.run(
-            ["git", "rev-parse", "HEAD"], cwd=repo,
-            capture_output=True, text=True, timeout=10).stdout.strip()
-        if not head:
-            return "unknown"
-        diff = subprocess.run(
-            ["git", "diff", "HEAD"], cwd=repo,
-            capture_output=True, text=True, timeout=30).stdout
-        if diff:
-            return f"{head[:12]}+{hashlib.sha1(diff.encode()).hexdigest()[:8]}"
-        return head[:12]
-    except Exception:   # noqa: BLE001 — no git in deployment images
-        return "unknown"
+    from paddle_tpu.utils.revision import code_revision
+    return code_revision()
 
 
 def consolidate(out_dir, out_path):

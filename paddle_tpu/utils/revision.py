@@ -1,0 +1,32 @@
+"""Code-revision stamp shared by everything that records provenance
+(differential dumps, bench cache rows).
+
+The stamp is HEAD plus a digest of any uncommitted diff, so local
+iteration (the common revision-mixing case) changes the stamp too.
+'unknown' when git is unavailable — consumers treat that as
+unverifiable, not as a match.
+"""
+
+import hashlib
+import os
+import subprocess
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def code_revision():
+    try:
+        head = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=_REPO,
+            capture_output=True, text=True, timeout=10).stdout.strip()
+        if not head:
+            return "unknown"
+        diff = subprocess.run(
+            ["git", "diff", "HEAD"], cwd=_REPO,
+            capture_output=True, text=True, timeout=30).stdout
+        if diff:
+            return f"{head[:12]}+{hashlib.sha1(diff.encode()).hexdigest()[:8]}"
+        return head[:12]
+    except Exception:   # noqa: BLE001 — no git in deployment images
+        return "unknown"

@@ -19,6 +19,10 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE = os.path.join(_ROOT, "paddle_tpu", "native")
 _LIB = os.path.join(_NATIVE, "libpaddle_tpu_capi.so")
 
+# the .so is not committed; build it on demand from a clean checkout
+from paddle_tpu.native import build as _native_build   # noqa: E402
+_native_build.ensure("capi")
+
 pytestmark = pytest.mark.skipif(
     not os.path.exists(_LIB),
     reason="capi lib not built (python -m paddle_tpu.native.build)")
