@@ -13,6 +13,7 @@ parameter file is a merged model (trainer.checkpoint.merge_model).
 """
 
 import os
+import threading
 import traceback
 
 import numpy as np
@@ -24,15 +25,17 @@ from paddle_tpu._platform import \
 
 _machines = {}
 _next_id = [1]
-_last_error = [""]
+# per-thread error slot: concurrent C threads (pt_capi_clone pattern) must
+# each read their OWN failure, not the last one process-wide
+_tls = threading.local()
 
 
 def last_error():
-    return _last_error[0]
+    return getattr(_tls, "err", "")
 
 
 def _store_error(e):
-    _last_error[0] = "".join(
+    _tls.err = "".join(
         traceback.format_exception(type(e), e, e.__traceback__))
     return -1
 
@@ -72,6 +75,52 @@ def set_input_dense(mid, name, arr):
     try:
         _machines[mid]["feed"][name] = np.asarray(arr, np.float32)
         return 0
+    except Exception as e:
+        return _store_error(e)
+
+
+def set_input_sparse_binary(mid, name, dim, col_ids, row_offsets):
+    """Sparse-binary input in CSR form (reference capi/matrix.h
+    paddle_matrix_create_sparse + paddle_matrix_sparse_copy_from:
+    row_offsets has rows+1 entries; col_ids[row_offsets[i]:row_offsets[i+1]]
+    are the set columns of row i).  Densified to float32 [rows, dim] — the
+    MXU path takes dense rows, same as data/feeder.py's sparse_binary
+    handling."""
+    try:
+        col_ids = np.asarray(col_ids, np.int64)
+        row_offsets = np.asarray(row_offsets, np.int64)
+        rows = len(row_offsets) - 1
+        if (rows < 0 or row_offsets[0] != 0
+                or row_offsets[-1] != len(col_ids)
+                or (rows > 0 and np.any(np.diff(row_offsets) < 0))):
+            raise ValueError(
+                f"bad CSR: offsets {row_offsets.tolist()} for "
+                f"{len(col_ids)} col ids (must start at 0, end at n_cols, "
+                "and be non-decreasing)")
+        out = np.zeros((rows, dim), np.float32)
+        for i in range(rows):
+            cols = col_ids[row_offsets[i]:row_offsets[i + 1]]
+            if len(cols) and (cols.min() < 0 or cols.max() >= dim):
+                raise ValueError(f"col id out of range [0, {dim}) in row {i}")
+            out[i, cols] = 1.0
+        _machines[mid]["feed"][name] = out
+        return 0
+    except Exception as e:
+        return _store_error(e)
+
+
+def clone_shared(mid):
+    """New handle sharing the loaded machine's parameters (reference
+    capi/gradient_machine.h paddle_gradient_machine_create_shared_param:
+    per-thread machines over one parameter set).  The Inferencer — params
+    and jitted fn — is shared; only the feed/output slots are per-handle,
+    so concurrent threads don't race on inputs."""
+    try:
+        m = _machines[mid]
+        nid = _next_id[0]
+        _next_id[0] += 1
+        _machines[nid] = {"inf": m["inf"], "feed": {}, "outs": None}
+        return nid
     except Exception as e:
         return _store_error(e)
 

@@ -55,6 +55,8 @@ def ensure(which="dataio", verbose=False):
     Disable with PADDLE_TPU_NO_NATIVE_BUILD=1 (e.g. images without g++)."""
     if os.environ.get("PADDLE_TPU_NO_NATIVE_BUILD"):
         return None
+    if which in _FAILED:   # a persistent toolchain failure must not be
+        return None        # re-paid per call (e.g. per feeder batch)
     name = {"dataio": "libpaddle_tpu_dataio.so",
             "capi": "libpaddle_tpu_capi.so"}[which]
     src = os.path.join(_DIR, "src", which + ".cpp")
@@ -65,7 +67,11 @@ def ensure(which="dataio", verbose=False):
             return out
         return (build if which == "dataio" else build_capi)(verbose=verbose)
     except Exception:   # noqa: BLE001 — missing g++/headers: fall back
+        _FAILED.add(which)
         return None
+
+
+_FAILED = set()   # libs whose build failed this process; see ensure()
 
 
 def capi_header_dir():

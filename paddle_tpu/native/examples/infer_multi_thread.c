@@ -1,0 +1,112 @@
+/* Multi-threaded C deployment example (reference capi/examples/
+ * model_inference/multi_thread/main.c: N pthreads, each with a machine
+ * created by paddle_gradient_machine_create_shared_param over one loaded
+ * parameter set).  Here each thread runs on its own pt_capi_clone handle —
+ * shared parameters and jitted program, private input/output slots — and
+ * the main thread re-runs every thread's input afterwards to check the
+ * concurrent results bit-for-bit.
+ *
+ * Build:
+ *   gcc infer_multi_thread.c -I../include -L.. -lpaddle_tpu_capi \
+ *       -Wl,-rpath,.. -lpthread -o infer_multi_thread
+ * Run:
+ *   ./infer_multi_thread <repo_root> <config.py> <model.npz>
+ */
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_tpu_capi.h"
+
+enum { NUM_THREAD = 4, NUM_ITER = 25, IN_DIM = 4, OUT_DIM = 2 };
+
+typedef struct {
+  int64_t handle;
+  int tid;
+  float input[IN_DIM];        /* last-iteration input            */
+  float prob[OUT_DIM];        /* last-iteration output           */
+  int failed;
+} thread_ctx;
+
+static void fill_input(float* dst, int tid, int iter) {
+  /* deterministic per-(thread, iter) input so the main thread can replay */
+  for (int i = 0; i < IN_DIM; ++i)
+    dst[i] = (float)((tid * 131 + iter * 17 + i * 7) % 23) / 23.0f - 0.5f;
+}
+
+static void* thread_main(void* p) {
+  thread_ctx* ctx = (thread_ctx*)p;
+  for (int iter = 0; iter < NUM_ITER; ++iter) {
+    fill_input(ctx->input, ctx->tid, iter);
+    if (pt_capi_set_input_dense(ctx->handle, "x", ctx->input, 1, IN_DIM) !=
+            0 ||
+        pt_capi_run(ctx->handle) < 1 ||
+        pt_capi_get_output(ctx->handle, 0, ctx->prob, OUT_DIM) != OUT_DIM) {
+      ctx->failed = 1;
+      return NULL;
+    }
+  }
+  return NULL;
+}
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <repo_root> <config.py> <model.npz>\n",
+            argv[0]);
+    return 2;
+  }
+  if (pt_capi_init(argv[1]) != 0) {
+    fprintf(stderr, "init failed: %s\n", pt_capi_last_error());
+    return 1;
+  }
+  int64_t m = pt_capi_create(argv[2], argv[3]);
+  if (m < 0) {
+    fprintf(stderr, "create failed: %s\n", pt_capi_last_error());
+    return 1;
+  }
+
+  pthread_t threads[NUM_THREAD];
+  thread_ctx ctx[NUM_THREAD];
+  for (int i = 0; i < NUM_THREAD; ++i) {
+    ctx[i].tid = i;
+    ctx[i].failed = 0;
+    ctx[i].handle = pt_capi_clone(m);
+    if (ctx[i].handle < 0) {
+      fprintf(stderr, "clone failed: %s\n", pt_capi_last_error());
+      return 1;
+    }
+    pthread_create(&threads[i], NULL, thread_main, &ctx[i]);
+  }
+  for (int i = 0; i < NUM_THREAD; ++i) pthread_join(threads[i], NULL);
+
+  /* replay each thread's final input on the original machine; the
+   * concurrent result must match the serial one */
+  int rc = 0;
+  for (int i = 0; i < NUM_THREAD; ++i) {
+    if (ctx[i].failed) {
+      fprintf(stderr, "thread %d failed: %s\n", i, pt_capi_last_error());
+      rc = 1;
+      continue;
+    }
+    float ref[OUT_DIM];
+    if (pt_capi_set_input_dense(m, "x", ctx[i].input, 1, IN_DIM) != 0 ||
+        pt_capi_run(m) < 1 ||
+        pt_capi_get_output(m, 0, ref, OUT_DIM) != OUT_DIM) {
+      fprintf(stderr, "replay failed: %s\n", pt_capi_last_error());
+      rc = 1;
+      continue;
+    }
+    int ok = 1;
+    for (int j = 0; j < OUT_DIM; ++j) {
+      float d = ctx[i].prob[j] - ref[j];
+      if (d < -1e-6f || d > 1e-6f) ok = 0;
+    }
+    printf("thread %d %s:", i, ok ? "OK" : "MISMATCH");
+    for (int j = 0; j < OUT_DIM; ++j) printf(" %.4f", ctx[i].prob[j]);
+    printf("\n");
+    if (!ok) rc = 1;
+    pt_capi_destroy(ctx[i].handle);
+  }
+  pt_capi_destroy(m);
+  return rc;
+}

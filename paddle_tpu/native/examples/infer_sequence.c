@@ -1,0 +1,60 @@
+/* Sequence-input C deployment example (reference capi/examples/
+ * model_inference/sequence/main.c: integer-id sentence + explicit
+ * sequence start positions).  The TPU-native API feeds a padded id batch
+ * with per-row lengths instead of start positions — same information,
+ * static shapes for XLA.
+ *
+ * Build:
+ *   gcc infer_sequence.c -I../include -L.. -lpaddle_tpu_capi \
+ *       -Wl,-rpath,.. -o infer_sequence
+ * Run:
+ *   ./infer_sequence <repo_root> <config.py> <model.npz>
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <repo_root> <config.py> <model.npz>\n",
+            argv[0]);
+    return 2;
+  }
+  if (pt_capi_init(argv[1]) != 0) {
+    fprintf(stderr, "init failed: %s\n", pt_capi_last_error());
+    return 1;
+  }
+  int64_t m = pt_capi_create(argv[2], argv[3]);
+  if (m < 0) {
+    fprintf(stderr, "create failed: %s\n", pt_capi_last_error());
+    return 1;
+  }
+
+  /* Two sentences of different length, padded to max_len = 6; the
+   * per-row lengths mark the real tokens (reference: sequence_start_pos
+   * {0, 9} over a flat id vector). */
+  enum { ROWS = 2, MAX_LEN = 6 };
+  int32_t ids[ROWS * MAX_LEN] = {
+      7, 3, 1, 4, 2, 5,  /* full-length sentence            */
+      9, 8, 6, 0, 0, 0}; /* 3 real tokens + 3 padding slots */
+  int32_t lengths[ROWS] = {6, 3};
+
+  if (pt_capi_set_input_ids(m, "ids", ids, ROWS, MAX_LEN, lengths) != 0 ||
+      pt_capi_run(m) < 1) {
+    fprintf(stderr, "forward failed: %s\n", pt_capi_last_error());
+    return 1;
+  }
+  int64_t rows = 0, cols = 0;
+  pt_capi_output_shape(m, 0, &rows, &cols);
+  float* out = (float*)malloc(sizeof(float) * rows * cols);
+  pt_capi_get_output(m, 0, out, rows * cols);
+  for (int64_t i = 0; i < rows; ++i) {
+    printf("row %lld:", (long long)i);
+    for (int64_t j = 0; j < cols; ++j) printf(" %.4f", out[i * cols + j]);
+    printf("\n");
+  }
+  free(out);
+  pt_capi_destroy(m);
+  return 0;
+}

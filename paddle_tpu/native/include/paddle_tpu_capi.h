@@ -44,6 +44,21 @@ int pt_capi_set_input_ids(int64_t h, const char* name, const int32_t* ids,
                           int64_t rows, int64_t cols,
                           const int32_t* lengths);
 
+/* Sparse-binary input in CSR form: row_offsets has rows+1 entries and
+ * col_ids[row_offsets[i]..row_offsets[i+1]) are the set columns of row i
+ * (reference paddle_matrix_create_sparse + sparse_copy_from).  Densified
+ * to float32 [rows, dim] before feeding. */
+int pt_capi_set_input_sparse_binary(int64_t h, const char* name, int64_t dim,
+                                    const int32_t* col_ids, int64_t n_cols,
+                                    const int32_t* row_offsets,
+                                    int64_t n_offsets);
+
+/* New handle sharing h's loaded parameters (reference
+ * paddle_gradient_machine_create_shared_param); every thread should run
+ * on its own clone so inputs/outputs don't race.  Returns handle > 0 or
+ * -1. */
+int64_t pt_capi_clone(int64_t h);
+
 /* Run forward.  Returns the number of outputs, or -1. */
 int pt_capi_run(int64_t h);
 

@@ -24,7 +24,10 @@ namespace {
 
 std::mutex g_init_mu;
 bool g_we_initialized = false;
-std::string g_last_error;
+// thread_local: each native thread reads its own last failure (the clone-
+// based multi-thread pattern makes concurrent failures reachable, and a
+// shared std::string would be a use-after-free race under c_str()).
+thread_local std::string g_last_error;
 
 PyObject* backend() {  // borrowed-style cached module ref (owned here)
   static PyObject* mod = nullptr;
@@ -197,6 +200,68 @@ int pt_capi_set_input_ids(int64_t h, const char* name, const int32_t* ids,
   }
   PyGILState_Release(gil);
   return rc;
+}
+
+// Sparse-binary input in CSR form (row_offsets: rows+1 entries;
+// col_ids[row_offsets[i]..row_offsets[i+1]) = set columns of row i),
+// densified to [rows, dim] on the Python side.
+int pt_capi_set_input_sparse_binary(int64_t h, const char* name, int64_t dim,
+                                    const int32_t* col_ids, int64_t n_cols,
+                                    const int32_t* row_offsets,
+                                    int64_t n_offsets) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mod = backend();
+  if (mod) {
+    PyObject* np = PyImport_ImportModule("numpy");
+    PyObject* cb = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(col_ids),
+        static_cast<Py_ssize_t>(n_cols * sizeof(int32_t)));
+    PyObject* rb = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(row_offsets),
+        static_cast<Py_ssize_t>(n_offsets * sizeof(int32_t)));
+    PyObject* cols = nullptr;
+    PyObject* offs = nullptr;
+    if (np && cb && rb) {
+      cols = PyObject_CallMethod(np, "frombuffer", "Os", cb, "int32");
+      offs = PyObject_CallMethod(np, "frombuffer", "Os", rb, "int32");
+    }
+    if (cols && offs) {
+      PyObject* r = PyObject_CallMethod(
+          mod, "set_input_sparse_binary", "LsLOO", static_cast<long long>(h),
+          name, static_cast<long long>(dim), cols, offs);
+      if (r && PyLong_Check(r)) rc = static_cast<int>(PyLong_AsLong(r));
+      if (!r) PyErr_Print();
+      Py_XDECREF(r);
+    }
+    Py_XDECREF(offs);
+    Py_XDECREF(cols);
+    Py_XDECREF(rb);
+    Py_XDECREF(cb);
+    Py_XDECREF(np);
+    if (rc != 0) capture_py_error();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// New handle sharing h's loaded parameters (reference
+// paddle_gradient_machine_create_shared_param): per-thread machines over
+// one parameter set.  Feed/output slots are per-handle.
+int64_t pt_capi_clone(int64_t h) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int64_t handle = -1;
+  PyObject* mod = backend();
+  if (mod) {
+    PyObject* r = PyObject_CallMethod(mod, "clone_shared", "L",
+                                      static_cast<long long>(h));
+    if (r && PyLong_Check(r)) handle = PyLong_AsLongLong(r);
+    if (!r) PyErr_Print();
+    Py_XDECREF(r);
+    if (handle < 0) capture_py_error();
+  }
+  PyGILState_Release(gil);
+  return handle;
 }
 
 // Run forward.  Returns the number of outputs, or -1.

@@ -115,3 +115,211 @@ def test_capi_from_c_program(merged_model, tmp_path):
     got = np.array([[float(v) for v in l.split(":")[1].split()]
                     for l in lines])
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# sequence / sparse_binary / multi_thread example parity
+# (reference capi/examples/model_inference/{sequence,sparse_binary,
+#  multi_thread}/main.c)
+# ---------------------------------------------------------------------------
+
+_SEQ_CONFIG = """
+import paddle_tpu.layers as L
+from paddle_tpu.layers.graph import reset_names
+reset_names()
+ids = L.data_layer("ids", size=16, is_seq=True)
+emb = L.embedding_layer(ids, size=8, name="emb")
+pooled = L.pooling_layer(emb, pooling_type=L.pooling.Max)
+predict = L.fc_layer(pooled, size=2, act="softmax", name="out")
+"""
+
+_SPARSE_CONFIG = """
+import paddle_tpu.layers as L
+from paddle_tpu.layers.graph import reset_names
+reset_names()
+x = L.data_layer("x", size=64)
+predict = L.fc_layer(x, size=2, act="softmax", name="out")
+"""
+
+
+def _build_model(tmp, config_src, out_layer_fn):
+    reset_names()
+    topo = Topology(out_layer_fn())
+    params = topo.init(jax.random.PRNGKey(7))
+    save_dir = str(tmp / "ckpt")
+    save_checkpoint(save_dir, 0, params, None, {})
+    model_path = str(tmp / "model.npz")
+    merge_model(save_dir, model_path)
+    config_path = str(tmp / "config.py")
+    with open(config_path, "w") as f:
+        f.write(config_src)
+    return config_path, model_path, topo, params
+
+
+def _compile_example(name, tmp_path, extra=()):
+    exe = str(tmp_path / name)
+    src = os.path.join(_NATIVE, "examples", name + ".c")
+    subprocess.check_call(
+        ["gcc", src, "-I" + os.path.join(_NATIVE, "include"),
+         "-L" + _NATIVE, "-lpaddle_tpu_capi",
+         "-Wl,-rpath," + _NATIVE] + list(extra) + ["-o", exe])
+    return exe
+
+
+def _parse_rows(stdout):
+    lines = [l for l in stdout.splitlines() if l.startswith("row")]
+    return np.array([[float(v) for v in l.split(":")[1].split()]
+                     for l in lines])
+
+
+@pytest.fixture(scope="module")
+def seq_model(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("capi_seq")
+
+    def build():
+        import paddle_tpu.layers as LL
+        ids = LL.data_layer("ids", size=16, is_seq=True)
+        emb = LL.embedding_layer(ids, size=8, name="emb")
+        pooled = LL.pooling_layer(emb, pooling_type=LL.pooling.Max)
+        return LL.fc_layer(pooled, size=2, act="softmax", name="out")
+
+    config_path, model_path, topo, params = _build_model(
+        tmp, _SEQ_CONFIG, build)
+    # reference output for the C program's fixed two-sentence batch
+    from paddle_tpu.core.sequence import SequenceBatch
+    import jax.numpy as jnp
+    ids = np.array([[7, 3, 1, 4, 2, 5], [9, 8, 6, 0, 0, 0]], np.int32)
+    lens = np.array([6, 3], np.int32)
+    batch = SequenceBatch(data=jnp.asarray(ids), lengths=jnp.asarray(lens))
+    ref = np.asarray(topo.apply(params, {"ids": batch}, mode="test"))
+    return config_path, model_path, ref
+
+
+def test_capi_sequence_example(seq_model, tmp_path):
+    """Per-row lengths through the C API: padding slots must not leak into
+    the pooled result (the reference sequence example's seq_pos role)."""
+    config_path, model_path, ref = seq_model
+    exe = _compile_example("infer_sequence", tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([exe, _ROOT, config_path, model_path],
+                         capture_output=True, text=True, env=env,
+                         timeout=240)
+    assert out.returncode == 0, out.stderr[-2000:]
+    got = _parse_rows(out.stdout)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_capi_sequence_lengths_matter(seq_model):
+    """ctypes twin of the C example, checking lengths actually gate the
+    pool: growing a row's length over its padding changes the output."""
+    config_path, model_path, ref = seq_model
+    lib = ctypes.CDLL(_LIB)
+    lib.pt_capi_create.restype = ctypes.c_int64
+    lib.pt_capi_last_error.restype = ctypes.c_char_p
+    assert lib.pt_capi_init(_ROOT.encode()) == 0
+    h = lib.pt_capi_create(config_path.encode(), model_path.encode())
+    assert h > 0, lib.pt_capi_last_error().decode()
+    ids = np.array([[7, 3, 1, 4, 2, 5], [9, 8, 6, 0, 0, 0]], np.int32)
+
+    def run_with(lens):
+        lens = np.asarray(lens, np.int32)
+        rc = lib.pt_capi_set_input_ids(
+            ctypes.c_int64(h), b"ids",
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int64(2), ctypes.c_int64(6),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        assert rc == 0, lib.pt_capi_last_error().decode()
+        assert lib.pt_capi_run(ctypes.c_int64(h)) == 1
+        buf = np.zeros((2, 2), np.float32)
+        assert lib.pt_capi_get_output(
+            ctypes.c_int64(h), 0,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(buf.size)) == buf.size
+        return buf
+
+    got = run_with([6, 3])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # treating row-1 padding as real tokens must change row 1 only
+    got_full = run_with([6, 6])
+    np.testing.assert_allclose(got_full[0], ref[0], rtol=1e-5, atol=1e-6)
+    assert not np.allclose(got_full[1], ref[1], atol=1e-6)
+    lib.pt_capi_destroy(ctypes.c_int64(h))
+
+
+@pytest.fixture(scope="module")
+def sparse_model(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("capi_sparse")
+
+    def build():
+        import paddle_tpu.layers as LL
+        x = LL.data_layer("x", size=64)
+        return LL.fc_layer(x, size=2, act="softmax", name="out")
+
+    config_path, model_path, topo, params = _build_model(
+        tmp, _SPARSE_CONFIG, build)
+    import jax.numpy as jnp
+    dense = np.zeros((2, 64), np.float32)
+    dense[0, [9, 13, 47]] = 1.0
+    dense[1, [2, 60]] = 1.0
+    ref = np.asarray(topo.apply(params, {"x": jnp.asarray(dense)},
+                                mode="test"))
+    return config_path, model_path, ref
+
+
+def test_capi_sparse_binary_example(sparse_model, tmp_path):
+    """CSR sparse-binary input through the C API matches the densified
+    Python forward (reference sparse_binary example's copy_from path)."""
+    config_path, model_path, ref = sparse_model
+    exe = _compile_example("infer_sparse_binary", tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([exe, _ROOT, config_path, model_path],
+                         capture_output=True, text=True, env=env,
+                         timeout=240)
+    assert out.returncode == 0, out.stderr[-2000:]
+    got = _parse_rows(out.stdout)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_capi_sparse_binary_bad_csr(sparse_model):
+    """Malformed CSR (offsets not ending at n_cols, col id out of range)
+    must fail cleanly with an error message, not corrupt the feed."""
+    config_path, model_path, _ref = sparse_model
+    lib = ctypes.CDLL(_LIB)
+    lib.pt_capi_create.restype = ctypes.c_int64
+    lib.pt_capi_last_error.restype = ctypes.c_char_p
+    assert lib.pt_capi_init(_ROOT.encode()) == 0
+    h = lib.pt_capi_create(config_path.encode(), model_path.encode())
+    assert h > 0
+
+    def set_csr(cols, offs):
+        cols = np.asarray(cols, np.int32)
+        offs = np.asarray(offs, np.int32)
+        return lib.pt_capi_set_input_sparse_binary(
+            ctypes.c_int64(h), b"x", ctypes.c_int64(64),
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int64(len(cols)),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int64(len(offs)))
+
+    assert set_csr([1, 2, 3], [0, 2]) != 0          # offsets end != n_cols
+    assert b"CSR" in lib.pt_capi_last_error()
+    assert set_csr([1, 99], [0, 2]) != 0            # col id >= dim
+    assert set_csr([1, 2], [0, 2]) == 0             # well-formed recovers
+    lib.pt_capi_destroy(ctypes.c_int64(h))
+
+
+def test_capi_multi_thread_example(merged_model, tmp_path):
+    """Concurrent inference from 4 native threads over pt_capi_clone
+    handles sharing one parameter set; the C program itself verifies the
+    concurrent outputs against serial replays (reference multi_thread
+    example's create_shared_param role)."""
+    config_path, model_path, _inp, _ref = merged_model
+    exe = _compile_example("infer_multi_thread", tmp_path,
+                           extra=("-lpthread",))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([exe, _ROOT, config_path, model_path],
+                         capture_output=True, text=True, env=env,
+                         timeout=300)
+    assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
+    ok_lines = [l for l in out.stdout.splitlines() if " OK:" in l]
+    assert len(ok_lines) == 4, out.stdout
