@@ -46,6 +46,34 @@ def build_ssh_cmd(host, rank, args, command):
     return ["ssh", "-o", "BatchMode=yes", host, remote]
 
 
+def wait_fail_fast(procs, poll_s=0.2):
+    """Wait for every rank; if one dies nonzero, SIGTERM the rest and
+    return its rc.  Without this, a crashed rank leaves the others blocked
+    forever inside a collective (jax.distributed has no dead-peer timeout
+    at this layer) and the launcher would never return — the reference
+    launcher killed the whole job on any node failure too
+    (scripts/cluster_train/paddle.py:52-60)."""
+    import time
+    while True:
+        rcs = [p.poll() for p in procs]
+        bad = [rc for rc in rcs if rc not in (None, 0)]
+        if bad:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            deadline = time.time() + 10
+            for p in procs:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+            return bad[0]
+        if all(rc == 0 for rc in rcs):
+            return 0
+        time.sleep(poll_s)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="paddle_tpu.launch_cluster",
@@ -81,7 +109,7 @@ def main(argv=None):
             p.wait()
         sys.exit(128 + signum)
 
-    signal.signal(signal.SIGTERM, _terminate)
+    prev_sigterm = signal.signal(signal.SIGTERM, _terminate)
     try:
         if args.local:
             for rank in range(args.local):
@@ -99,10 +127,7 @@ def main(argv=None):
                 print(f"[launch] rank {rank} @ {host}: {command}",
                       flush=True)
                 procs.append(subprocess.Popen(cmd))
-        rc = 0
-        for p in procs:
-            rc = p.wait() or rc
-        return rc
+        return wait_fail_fast(procs)
     except KeyboardInterrupt:
         # reference launcher killed jobs over SSH (paddle.py:52-60)
         for p in procs:
@@ -110,6 +135,10 @@ def main(argv=None):
         for p in procs:
             p.wait()
         return 130
+    finally:
+        # don't leak the handler into an embedding process (tests import
+        # main() in-process)
+        signal.signal(signal.SIGTERM, prev_sigterm)
 
 
 if __name__ == "__main__":

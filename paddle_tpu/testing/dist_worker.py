@@ -1,23 +1,53 @@
 """Multi-process distributed bring-up worker (test fixture).
 
-Run as `python -m paddle_tpu.testing.dist_worker OUT_DIR` under the
-PADDLE_TPU_* rendezvous env vars (parallel/distributed.py:12-18).  Each
-process connects through jax.distributed.initialize, builds a global mesh
-over every process's devices, and trains a tiny data-parallel model where
-each process feeds ONLY its own shard of the global batch — the
-multi-controller SPMD shape of a real multi-host TPU job.  The final loss
-and a parameter checksum are written to OUT_DIR/rank{i}.json so the test
-can assert 2-process == 1-process numerics (the reference proved its
+Run as `python -m paddle_tpu.testing.dist_worker OUT_DIR [options]` under
+the PADDLE_TPU_* rendezvous env vars (parallel/distributed.py:12-18).
+Each process connects through jax.distributed.initialize, builds a global
+mesh over every process's devices, and trains a tiny model.  Every
+process materializes the full (deterministically seeded) host batch and
+jax.make_array_from_callback hands each device its addressable shard —
+mesh-shape-agnostic, which the 2x2 data,model mode needs; the stricter
+process-local-ingestion path (jax.make_array_from_process_local_data,
+where a process never holds peers' data) is covered by
+tests/test_parallel_matrix.py.  The final loss
+and a parameter checksum are written to OUT_DIR/rank{i}.json so tests can
+assert multi-process == single-process numerics (the reference proved its
 distributed plane the same way: test_CompareSparse.cpp:66-87 trains
 against in-process pservers and compares with local training).
+
+Modes:
+  --mesh data        1-axis data-parallel mesh over all devices (default)
+  --mesh data,model  2x2 mesh: data axis AND model (tensor) axis both >1
+                     with parameters sharded over `model` — the reference
+                     distributed plane had the same two splits
+                     (num_gradient_servers x parallel_nn model split)
+Failure/restart drill (the reference's fault story was pserver
+checkpointing; here it's coordinator checkpoints + whole-job relaunch):
+  --ckpt-dir D       rank 0 checkpoints params at step --ckpt-step;
+                     on startup, if D holds a checkpoint, RESUME from it
+  --crash-rank R --crash-step S   rank R calls os._exit(3) before
+                     running step S (simulates a dying host mid-pass)
 """
 
+import argparse
 import json
 import os
 import sys
 
 
-def main(out_dir):
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("out_dir")
+    ap.add_argument("--mesh", default="data",
+                    choices=["data", "data,model"])
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--ckpt-step", type=int, default=10)
+    ap.add_argument("--crash-rank", type=int, default=None)
+    ap.add_argument("--crash-step", type=int, default=None)
+    args = ap.parse_args(argv)
+    os.makedirs(args.out_dir, exist_ok=True)
+
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
     # a sitecustomize hook may pin jax_platforms to the TPU tunnel at
@@ -35,22 +65,54 @@ def main(out_dir):
     rank = jax.process_index()
     assert nproc == int(os.environ["PADDLE_TPU_NUM_PROCESSES"])
 
-    mesh = Mesh(np.asarray(jax.devices()), ("data",))
-    repl = NamedSharding(mesh, P())
-    shard = NamedSharding(mesh, P("data"))
+    devices = np.asarray(jax.devices())
+    if args.mesh == "data,model":
+        assert devices.size % 2 == 0, \
+            "data,model mesh needs an even device count"
+        mesh = Mesh(devices.reshape(devices.size // 2, 2),
+                    ("data", "model"))
+        # tensor-parallel parameter layout: hidden dim split over `model`
+        pspec = {"w1": P(None, "model"), "b1": P("model"),
+                 "w2": P("model", None)}
+    else:
+        mesh = Mesh(devices, ("data",))
+        pspec = {"w1": P(), "b1": P(), "w2": P()}
+    param_sh = {k: NamedSharding(mesh, s) for k, s in pspec.items()}
+    batch_sh = NamedSharding(mesh, P("data"))
 
-    # identical init on every process (replicated params)
+    # identical init on every process (SPMD: same program, same params)
     rng = np.random.RandomState(0)
-    params = {
+    init = {
         "w1": jnp.asarray(rng.randn(8, 16) * 0.5, jnp.float32),
         "b1": jnp.zeros((16,), jnp.float32),
         "w2": jnp.asarray(rng.randn(16, 1) * 0.5, jnp.float32),
     }
-    params = jax.device_put(params, repl)
 
-    B, STEPS = 32, 20
+    B, STEPS = 32, args.steps
     xs = rng.randn(STEPS, B, 8).astype(np.float32)
     ys = (xs[..., :3].sum(-1, keepdims=True) > 0).astype(np.float32)
+
+    start_step = 0
+    if args.ckpt_dir and os.path.isdir(args.ckpt_dir) \
+            and any(n.startswith("pass-")
+                    for n in os.listdir(args.ckpt_dir)):
+        from paddle_tpu.trainer.checkpoint import load_checkpoint
+        params_host, _opt, _ms, meta = load_checkpoint(args.ckpt_dir)
+        init = {k: jnp.asarray(v) for k, v in params_host.items()}
+        start_step = int(meta["step"])
+        print(f"[dist_worker] rank {rank} resuming from step {start_step}",
+              flush=True)
+
+    def global_array(sharding, host_value):
+        # every process holds the full host value (deterministic seed /
+        # checkpoint); each device picks its addressable shard via the
+        # callback — works for any mesh shape, unlike the per-process
+        # slice arithmetic a data-only mesh allows
+        return jax.make_array_from_callback(
+            host_value.shape, sharding, lambda idx: host_value[idx])
+
+    params = {k: global_array(param_sh[k], np.asarray(v))
+              for k, v in init.items()}
 
     def loss_fn(p, x, y):
         h = jnp.tanh(x @ p["w1"] + p["b1"])
@@ -63,18 +125,32 @@ def main(out_dir):
         p = jax.tree_util.tree_map(lambda w, gw: w - 0.5 * gw, p, g)
         return p, loss
 
-    per = B // nproc
     loss = first_loss = None
-    for t in range(STEPS):
-        # each process contributes ONLY its slice of the global batch
-        lo = rank * per
-        x = jax.make_array_from_process_local_data(
-            shard, xs[t, lo:lo + per], (B, 8))
-        y = jax.make_array_from_process_local_data(
-            shard, ys[t, lo:lo + per], (B, 1))
+    for t in range(start_step, STEPS):
+        if args.crash_rank == rank and args.crash_step == t:
+            print(f"[dist_worker] rank {rank} CRASHING at step {t}",
+                  flush=True)
+            os._exit(3)
+        x = global_array(batch_sh, xs[t])
+        y = global_array(batch_sh, ys[t])
         params, loss = step(params, x, y)
         if first_loss is None:
             first_loss = float(loss)
+        if args.ckpt_dir and t + 1 == args.ckpt_step:
+            # replicate, then fetch: model-sharded params are not
+            # rank-0-addressable, so rejit to P() makes every process hold
+            # the full value; only rank 0 writes
+            repl = NamedSharding(mesh, P())
+            gather = jax.jit(lambda a: a, out_shardings=repl)
+            host = {k: np.asarray(jax.device_get(gather(v)))
+                    for k, v in params.items()}
+            if rank == 0:
+                from paddle_tpu.trainer.checkpoint import save_checkpoint
+                save_checkpoint(args.ckpt_dir, 0, host,
+                                extra={"step": t + 1})
+            # nobody crosses the checkpoint boundary until it's on disk —
+            # a crash after this barrier can always resume from it
+            dist.barrier(f"ckpt{t}")
 
     dist.barrier("final")
     checksum = float(sum(jnp.sum(jnp.abs(v)) for v in
@@ -82,12 +158,13 @@ def main(out_dir):
     out = {"rank": rank, "nproc": nproc, "loss": float(loss),
            "first_loss": first_loss, "checksum": checksum,
            "global_devices": jax.device_count(),
+           "mesh": args.mesh, "start_step": start_step,
            "coordinator": dist.is_coordinator()}
-    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+    with open(os.path.join(args.out_dir, f"rank{rank}.json"), "w") as f:
         json.dump(out, f)
     print(f"[dist_worker] rank {rank}/{nproc} loss={out['loss']:.6f} "
           f"checksum={checksum:.6f}", flush=True)
 
 
 if __name__ == "__main__":
-    main(sys.argv[1])
+    main()

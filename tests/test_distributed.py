@@ -22,7 +22,8 @@ from conftest import free_port
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(nproc, out_dir, timeout=240):
+def _launch(nproc, out_dir, worker_args=(), timeout=240, expect_rc=0,
+            load_ranks=None):
     """Fan out nproc dist_worker ranks via the cluster launcher."""
     os.makedirs(out_dir, exist_ok=True)
     env = dict(os.environ)
@@ -34,7 +35,7 @@ def _launch(nproc, out_dir, timeout=240):
            "--local", str(nproc), "--port", str(free_port()),
            "--workdir", _ROOT,
            "--", sys.executable, "-m", "paddle_tpu.testing.dist_worker",
-           out_dir]
+           out_dir] + list(worker_args)
     # own process group: a timeout must reap the rank workers too, not just
     # the launcher (orphans would hold the coordinator port + CPU)
     proc = subprocess.Popen(cmd, env=env, cwd=_ROOT, text=True,
@@ -46,11 +47,11 @@ def _launch(nproc, out_dir, timeout=240):
         os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
         proc.wait()
         raise
-    assert proc.returncode == 0, (
-        f"launcher rc={proc.returncode}\nstdout:\n{stdout[-2000:]}\n"
-        f"stderr:\n{stderr[-2000:]}")
+    assert proc.returncode == expect_rc, (
+        f"launcher rc={proc.returncode} (wanted {expect_rc})\n"
+        f"stdout:\n{stdout[-2000:]}\nstderr:\n{stderr[-2000:]}")
     results = []
-    for r in range(nproc):
+    for r in (range(nproc) if load_ranks is None else load_ranks):
         with open(os.path.join(out_dir, f"rank{r}.json")) as f:
             results.append(json.load(f))
     return results
@@ -73,6 +74,107 @@ def test_two_process_data_parallel_matches_single(tmp_path):
     assert two[0]["checksum"] == pytest.approx(one[0]["checksum"], rel=1e-5)
     # and it actually trained
     assert two[0]["loss"] < 0.8 * two[0]["first_loss"]
+
+
+def test_2x2_mesh_matches_single(tmp_path):
+    """4 processes on a 2x2 data×model mesh — both axes >1, parameters
+    tensor-sharded over `model` — must reproduce single-process numerics
+    (the reference's wider matrix: multi-trainer × parallel_nn model
+    split, test_CompareSparse.cpp:66-87 pattern)."""
+    four = _launch(4, str(tmp_path / "p4"), worker_args=["--mesh",
+                                                         "data,model"],
+                   timeout=360)
+    assert [r["global_devices"] for r in four] == [4] * 4
+    assert {r["rank"] for r in four} == {0, 1, 2, 3}
+    # SPMD: all ranks agree bit-for-bit on the state they computed
+    assert len({r["checksum"] for r in four}) == 1
+    assert len({r["loss"] for r in four}) == 1
+
+    one = _launch(1, str(tmp_path / "p1"))
+    assert four[0]["loss"] == pytest.approx(one[0]["loss"], rel=1e-5)
+    assert four[0]["checksum"] == pytest.approx(one[0]["checksum"],
+                                                rel=1e-5)
+    assert four[0]["loss"] < 0.8 * four[0]["first_loss"]
+
+
+def test_crash_midpass_then_resume(tmp_path):
+    """Kill rank 1 mid-pass (after the coordinator checkpointed at step
+    10): the launcher must fail fast with the worker's rc instead of
+    hanging the surviving rank, and a relaunch must resume from the
+    checkpoint and land on uninterrupted-run numerics — the whole-job
+    restart story of a real TPU pod."""
+    ck = str(tmp_path / "ck")
+    # run A: rank 1 dies at step 14 of 20
+    _launch(2, str(tmp_path / "runA"),
+            worker_args=["--ckpt-dir", ck, "--crash-rank", "1",
+                         "--crash-step", "14"],
+            expect_rc=3, load_ranks=[])
+    assert any(n.startswith("pass-") for n in os.listdir(ck)), \
+        "checkpoint missing after crash"
+    # run B: fresh launch resumes from the checkpoint
+    resumed = _launch(2, str(tmp_path / "runB"),
+                      worker_args=["--ckpt-dir", ck])
+    assert [r["start_step"] for r in resumed] == [10, 10]
+    # uninterrupted reference run
+    clean = _launch(2, str(tmp_path / "clean"))
+    assert resumed[0]["loss"] == pytest.approx(clean[0]["loss"], rel=1e-6)
+    assert resumed[0]["checksum"] == pytest.approx(clean[0]["checksum"],
+                                                   rel=1e-6)
+
+
+def test_wait_fail_fast_reaps_survivors():
+    """A rank exiting nonzero must terminate the remaining ranks promptly
+    (they would otherwise block forever in a collective)."""
+    import time
+    from paddle_tpu.scripts.launch_cluster import wait_fail_fast
+    sleeper = subprocess.Popen([sys.executable, "-c",
+                                "import time; time.sleep(600)"])
+    failer = subprocess.Popen([sys.executable, "-c",
+                               "import sys; sys.exit(7)"])
+    t0 = time.time()
+    rc = wait_fail_fast([sleeper, failer])
+    assert rc == 7
+    assert time.time() - t0 < 30, "fail-fast took too long"
+    assert sleeper.poll() is not None, "surviving rank was not reaped"
+
+
+def test_ssh_transport_plumbing(monkeypatch, tmp_path):
+    """--hosts mode wires rank/rendezvous env into ssh commands (mocked
+    transport — no real ssh): coordinator is the first host, each rank
+    gets its id, the command runs in --workdir."""
+    from paddle_tpu.scripts import launch_cluster
+
+    launched = []
+
+    class FakeProc:
+        def __init__(self, cmd, **kw):
+            launched.append(cmd)
+
+        def poll(self):
+            return 0
+
+        def wait(self, timeout=None):
+            return 0
+
+        def send_signal(self, sig):
+            pass
+
+    monkeypatch.setattr(launch_cluster.subprocess, "Popen", FakeProc)
+    rc = launch_cluster.main(["--hosts", "tpu-a,tpu-b,tpu-c",
+                              "--port", "9123", "--workdir", "/srv/repo",
+                              "--", "python", "-m",
+                              "paddle_tpu.trainer.cli", "train"])
+    assert rc == 0
+    assert len(launched) == 3
+    for rank, (cmd, host) in enumerate(zip(launched,
+                                           ["tpu-a", "tpu-b", "tpu-c"])):
+        assert cmd[0] == "ssh" and host in cmd
+        remote = cmd[-1]
+        assert "cd /srv/repo" in remote
+        assert "PADDLE_TPU_COORDINATOR=tpu-a:9123" in remote
+        assert f"PADDLE_TPU_PROCESS_ID={rank}" in remote
+        assert "PADDLE_TPU_NUM_PROCESSES=3" in remote
+        assert "python -m paddle_tpu.trainer.cli train" in remote
 
 
 def test_launcher_arg_validation():
