@@ -542,10 +542,83 @@ def bench_transformer_decode(batch=32, src_len=128, max_len=128, vocab=32000,
         f"T={max_len}"), {"tokens_per_step": batch * max_len}
 
 
+def bench_transformer_serving(batch=16, n_requests=64, src_max=128,
+                              buckets=(32, 64, 128), max_len=128,
+                              vocab=32000, d_model=512, dff=2048, layers=6,
+                              heads=8, beam=4, seed=0):
+    """Serving-reality decode: a stream of requests with MIXED source
+    lengths is bucketed (core.sequence.bucket_for), grouped into fixed
+    batches per bucket, and batch-beam-decoded with the KV cache — one
+    compiled program per bucket shape, padding waste included in the
+    clock.  Headline: emitted tokens/sec over the whole stream.
+
+    BENCH_SERVING_TINY=1 shrinks model + stream to smoke scale (harness
+    canary on CPU, or a first-contact check in a TPU window)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.sequence import SequenceBatch, bucket_for
+    from paddle_tpu.models import transformer
+
+    if os.environ.get("BENCH_SERVING_TINY") == "1":
+        n_requests, src_max, buckets, max_len = 6, 16, (8, 16), 8
+        vocab, d_model, dff, layers, heads = 128, 32, 64, 1, 2
+
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=vocab, d_model=d_model, dff=dff,
+                              enc_layers=layers, dec_layers=layers,
+                              max_len=src_max + max_len)
+    rng = np.random.RandomState(seed)
+    lengths = rng.randint(src_max // 8, src_max + 1, (n_requests,))
+
+    # bucket + batch the stream (short final batches pad by repetition —
+    # what a serving frontend does to keep shapes static)
+    groups = {}
+    for ln in lengths:
+        groups.setdefault(bucket_for(int(ln), list(buckets)), []).append(
+            int(ln))
+    batches = []
+    for blen, lens in sorted(groups.items()):
+        for i in range(0, len(lens), batch):
+            chunk = lens[i:i + batch]
+            chunk = chunk + [chunk[-1]] * (batch - len(chunk))
+            data = rng.randint(3, vocab, (batch, blen)).astype(np.int32)
+            batches.append(SequenceBatch(
+                data=jnp.asarray(data),
+                lengths=jnp.asarray(np.asarray(chunk, np.int32))))
+
+    decode = jax.jit(lambda p, s: transformer.generate_cached(
+        p, s, beam_size=beam, max_len=max_len, num_heads=heads))
+
+    def run(i):
+        score = None
+        for sb in batches:      # one step = serve the whole request stream
+            score = decode(params, sb).scores.mean()
+        return score
+
+    # same per-token/per-seq flop model as bench_transformer_decode,
+    # summed over the stream's actual bucket shapes
+    dec_per_tok = layers * (6 * d_model ** 2 + 2 * d_model * dff) \
+        + d_model * vocab
+    flops = 0.0
+    for sb in batches:
+        blen = int(sb.data.shape[1])
+        per_seq = layers * (4 * d_model ** 2 + 2 * d_model * dff) * blen \
+            + layers * 2 * d_model ** 2 * blen * beam
+        flops += 2.0 * batch * (dec_per_tok * beam * max_len + per_seq)
+    # real requests only: padding-duplicate rows burn clock (serving
+    # reality) but must not be credited as served output
+    emitted = n_requests * max_len
+    return run, flops, None, (
+        f"transformer serving ms/stream bs={batch} beam={beam} "
+        f"{len(batches)} bucketed batches (src {src_max // 8}-{src_max}, "
+        f"buckets {list(buckets)})"), {"tokens_per_step": emitted}
+
+
 _BENCHES = {
     # name: (factory, default_batch)
     "transformer": (lambda b: bench_transformer(batch=b), 32),
     "transformer_decode": (lambda b: bench_transformer_decode(batch=b), 32),
+    "transformer_serving": (lambda b: bench_transformer_serving(batch=b), 16),
     "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),
     "lstm": (lambda b: bench_lstm(batch=b, hidden=512, baseline_ms=184.0), 64),
     "lstm256": (lambda b: bench_lstm(batch=b, hidden=256, baseline_ms=83.0), 64),

@@ -71,6 +71,23 @@ def create(config_path, params_path):
         return _store_error(e)
 
 
+def create_exported(path):
+    """Build an inference machine from a serialized StableHLO artifact
+    (export.export_inference); the C service needs neither the config file
+    nor the merged params — the artifact is self-contained.  Returns
+    handle id (>0) or -1."""
+    try:
+        _honor_jax_platforms_env()
+        from paddle_tpu.export import load_inference
+        run_fn = load_inference(path)
+        mid = _next_id[0]
+        _next_id[0] += 1
+        _machines[mid] = {"call": run_fn, "feed": {}, "outs": None}
+        return mid
+    except Exception as e:  # noqa: BLE001 - crosses the C ABI
+        return _store_error(e)
+
+
 def set_input_dense(mid, name, arr):
     try:
         _machines[mid]["feed"][name] = np.asarray(arr, np.float32)
@@ -117,9 +134,10 @@ def clone_shared(mid):
     so concurrent threads don't race on inputs."""
     try:
         m = _machines[mid]
+        engine = {k: m[k] for k in ("inf", "call") if k in m}
         nid = _next_id[0]
         _next_id[0] += 1
-        _machines[nid] = {"inf": m["inf"], "feed": {}, "outs": None}
+        _machines[nid] = dict(engine, feed={}, outs=None)
         return nid
     except Exception as e:
         return _store_error(e)
@@ -145,7 +163,10 @@ def run(mid):
     """Run forward; returns number of outputs or -1."""
     try:
         m = _machines[mid]
-        out = m["inf"].infer(dict(m["feed"]))
+        if "call" in m:   # StableHLO-exported machine (create_exported)
+            out = m["call"](dict(m["feed"]))
+        else:
+            out = m["inf"].infer(dict(m["feed"]))
         outs = out if isinstance(out, tuple) else (out,)
         arrs = []
         for o in outs:

@@ -1,0 +1,52 @@
+/* StableHLO-artifact C deployment example: serve a model exported with
+ * paddle_tpu.export.export_inference from a C service — no config file,
+ * no merged params, one self-contained compiler-level artifact (the
+ * merge_model -> C-API story of the reference, carried to the XLA era).
+ *
+ * Build:
+ *   gcc infer_exported.c -I../include -L.. -lpaddle_tpu_capi \
+ *       -Wl,-rpath,.. -o infer_exported
+ * Run:
+ *   ./infer_exported <repo_root> <model.shlo>
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_tpu_capi.h"
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    fprintf(stderr, "usage: %s <repo_root> <model.shlo>\n", argv[0]);
+    return 2;
+  }
+  if (pt_capi_init(argv[1]) != 0) {
+    fprintf(stderr, "init failed: %s\n", pt_capi_last_error());
+    return 1;
+  }
+  int64_t m = pt_capi_create_exported(argv[2]);
+  if (m < 0) {
+    fprintf(stderr, "create_exported failed: %s\n", pt_capi_last_error());
+    return 1;
+  }
+
+  /* the artifact in the test is exported with feed_spec x:[2,4] */
+  float input[2 * 4] = {1.f, 0.f, 0.f, 0.f,
+                        0.f, 0.f, 0.f, 1.f};
+  if (pt_capi_set_input_dense(m, "x", input, 2, 4) != 0 ||
+      pt_capi_run(m) < 1) {
+    fprintf(stderr, "forward failed: %s\n", pt_capi_last_error());
+    return 1;
+  }
+  int64_t rows = 0, cols = 0;
+  pt_capi_output_shape(m, 0, &rows, &cols);
+  float* out = (float*)malloc(sizeof(float) * rows * cols);
+  pt_capi_get_output(m, 0, out, rows * cols);
+  for (int64_t i = 0; i < rows; ++i) {
+    printf("row %lld:", (long long)i);
+    for (int64_t j = 0; j < cols; ++j) printf(" %.4f", out[i * cols + j]);
+    printf("\n");
+  }
+  free(out);
+  pt_capi_destroy(m);
+  return 0;
+}

@@ -33,6 +33,11 @@ const char* pt_capi_last_error(void);
  * merge_model).  Returns a handle > 0, or -1. */
 int64_t pt_capi_create(const char* config_path, const char* params_path);
 
+/* Build an inference machine from a serialized StableHLO artifact
+ * (paddle_tpu.export.export_inference) — self-contained, no config or
+ * params file.  Returns a handle > 0, or -1. */
+int64_t pt_capi_create_exported(const char* artifact_path);
+
 /* Set a dense float32 input [rows, cols] for data layer `name`. */
 int pt_capi_set_input_dense(int64_t h, const char* name, const float* data,
                             int64_t rows, int64_t cols);

@@ -105,6 +105,25 @@ int64_t pt_capi_create(const char* config_path, const char* params_path) {
   return handle;
 }
 
+// Build a machine from a serialized StableHLO artifact
+// (paddle_tpu.export.export_inference output) — self-contained: no config
+// file or merged params needed.  Returns handle > 0, or -1.
+int64_t pt_capi_create_exported(const char* artifact_path) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int64_t handle = -1;
+  PyObject* mod = backend();
+  if (mod) {
+    PyObject* r = PyObject_CallMethod(mod, "create_exported", "s",
+                                      artifact_path);
+    if (r && PyLong_Check(r)) handle = PyLong_AsLongLong(r);
+    if (!r) PyErr_Print();
+    Py_XDECREF(r);
+    if (handle < 0) capture_py_error();
+  }
+  PyGILState_Release(gil);
+  return handle;
+}
+
 // Dense input [rows, cols] float32 for data layer `name`.
 int pt_capi_set_input_dense(int64_t h, const char* name, const float* data,
                             int64_t rows, int64_t cols) {

@@ -110,3 +110,18 @@ def test_perf_report_renders_tables(tmp_path, capsys):
     assert "| lstm | 64 | 184.0 | 5.0 | 36.8× | 13.0% |" in out
     assert "| resnet50@bs512 | 99.0 | 40.0% | — | yes |" in out
     assert "| lstm | 5.0 | 15.0 | 3.00× |" in out
+
+
+def test_transformer_serving_bench_buckets(bench):
+    """The serving bench builds one fixed batch per (bucket, chunk) from a
+    mixed-length request stream and a single run() serves them all; tiny
+    dims keep this a CPU-feasible structure check."""
+    run, flops, baseline, metric, extra = bench.bench_transformer_serving(
+        batch=2, n_requests=6, src_max=16, buckets=(8, 16), max_len=4,
+        vocab=64, d_model=16, dff=32, layers=1, heads=2)
+    assert baseline is None and flops > 0
+    assert "bucketed" in metric
+    assert extra["tokens_per_step"] > 0
+    import numpy as np
+    s = run(0)
+    assert np.isfinite(float(s))

@@ -323,3 +323,58 @@ def test_capi_multi_thread_example(merged_model, tmp_path):
     assert out.returncode == 0, (out.stdout, out.stderr[-2000:])
     ok_lines = [l for l in out.stdout.splitlines() if " OK:" in l]
     assert len(ok_lines) == 4, out.stdout
+
+
+def test_capi_exported_stablehlo(merged_model, tmp_path):
+    """merge_model -> StableHLO export -> C service: a C program executes
+    the self-contained artifact through pt_capi_create_exported and
+    reproduces the Python forward (docs/serving.md §1 + §2 end-to-end)."""
+    config_path, model_path, inp, ref = merged_model
+    # re-materialize the topology the config defines and export it
+    ns = {}
+    exec(compile(open(config_path).read(), config_path, "exec"), ns)
+    from paddle_tpu import export as pexport
+    from paddle_tpu.trainer.checkpoint import load_merged
+    params, model_state, _meta = load_merged(model_path)
+    art = str(tmp_path / "model.shlo")
+    # the C client subprocess is pinned to cpu; export for that platform
+    # explicitly so the test also passes when pytest itself runs on TPU
+    pexport.export_inference(ns["predict"], params,
+                             feed_spec={"x": np.zeros((2, 4), np.float32)},
+                             model_state=model_state, path=art,
+                             platforms=("cpu",))
+
+    exe = _compile_example("infer_exported", tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([exe, _ROOT, art], capture_output=True, text=True,
+                         env=env, timeout=240)
+    assert out.returncode == 0, out.stderr[-2000:]
+    got = _parse_rows(out.stdout)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    # ctypes twin: clone of an exported machine serves too (thread pattern)
+    lib = ctypes.CDLL(_LIB)
+    lib.pt_capi_create_exported.restype = ctypes.c_int64
+    lib.pt_capi_clone.restype = ctypes.c_int64
+    lib.pt_capi_last_error.restype = ctypes.c_char_p
+    assert lib.pt_capi_init(_ROOT.encode()) == 0
+    h = lib.pt_capi_create_exported(art.encode())
+    assert h > 0, lib.pt_capi_last_error().decode()
+    h2 = lib.pt_capi_clone(ctypes.c_int64(h))
+    assert h2 > 0, lib.pt_capi_last_error().decode()
+    flat = np.ascontiguousarray(inp)
+    for hh in (h, h2):
+        assert lib.pt_capi_set_input_dense(
+            ctypes.c_int64(hh), b"x",
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(2), ctypes.c_int64(4)) == 0
+        assert lib.pt_capi_run(ctypes.c_int64(hh)) == 1, \
+            lib.pt_capi_last_error().decode()
+        buf = np.zeros((2, 2), np.float32)
+        assert lib.pt_capi_get_output(
+            ctypes.c_int64(hh), 0,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(buf.size)) == buf.size
+        np.testing.assert_allclose(buf, ref, rtol=1e-5, atol=1e-6)
+    lib.pt_capi_destroy(ctypes.c_int64(h2))
+    lib.pt_capi_destroy(ctypes.c_int64(h))
