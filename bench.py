@@ -105,7 +105,10 @@ def _cache_store(model, result):
     except Exception:   # noqa: BLE001
         entry["revision"] = "unknown"
     if model.split("@")[0] in _RNN_MODELS:
-        entry["fused_rnn"] = not _fused_rnn_disabled()
+        # main() sets the dispatch-counter truth; this backstop (direct
+        # _cache_store callers) must at least respect an observed fallback
+        entry.setdefault("fused_rnn", not _fused_rnn_disabled()
+                         and not result.get("fused_rnn_fallback"))
     prev = cache.get(model)
     cache[model] = entry
     try:
@@ -498,6 +501,21 @@ def bench_transformer(batch=32, seq_len=256, vocab=32000, d_model=512,
         {"tokens_per_step": tok, "remat": remat}
 
 
+def _decode_flops(batch, src_len, max_len, vocab, d_model, dff, layers,
+                  beam):
+    """Analytic FLOPs of one KV-cached beam decode of a batch: per decoded
+    position per beam lane self-attn q/k/v/o (4d^2) + cross q/o only (2d^2
+    — cross K/V are hoisted once per sequence by generate_cached) + ffn +
+    the dominant d_model x vocab projection; encoder + cross-KV build run
+    ONCE per sequence.  Shared by the decode and serving families so the
+    model can only be fixed in one place."""
+    dec_per_tok = layers * (6 * d_model ** 2 + 2 * d_model * dff) \
+        + d_model * vocab
+    per_seq = layers * (4 * d_model ** 2 + 2 * d_model * dff) * src_len \
+        + layers * 2 * d_model ** 2 * src_len * beam      # cross-KV build
+    return 2.0 * batch * (dec_per_tok * beam * max_len + per_seq)
+
+
 def bench_transformer_decode(batch=32, src_len=128, max_len=128, vocab=32000,
                              d_model=512, dff=2048, layers=6, heads=8,
                              beam=4):
@@ -528,15 +546,8 @@ def bench_transformer_decode(batch=32, src_len=128, max_len=128, vocab=32000,
         # mean beam score (scalar) while timing the whole decode
         return decode(params, src).scores.mean()
 
-    # per decoded position per beam lane: self-attn q/k/v/o (4d^2) +
-    # cross q/o only (2d^2 — cross K/V are hoisted once per sequence by
-    # generate_cached) + ffn + the dominant d_model x vocab projection;
-    # encoder and the cross-KV build run ONCE per sequence, not per token
-    dec_per_tok = layers * (6 * d_model ** 2 + 2 * d_model * dff) \
-        + d_model * vocab
-    per_seq = layers * (4 * d_model ** 2 + 2 * d_model * dff) * src_len \
-        + layers * 2 * d_model ** 2 * src_len * beam      # cross-KV build
-    flops = 2.0 * batch * (dec_per_tok * beam * max_len + per_seq)
+    flops = _decode_flops(batch, src_len, max_len, vocab, d_model, dff,
+                          layers, beam)
     return run, flops, None, (
         f"transformer decode ms/batch bs={batch} beam={beam} "
         f"T={max_len}"), {"tokens_per_step": batch * max_len}
@@ -595,16 +606,10 @@ def bench_transformer_serving(batch=16, n_requests=64, src_max=128,
             score = decode(params, sb).scores.mean()
         return score
 
-    # same per-token/per-seq flop model as bench_transformer_decode,
-    # summed over the stream's actual bucket shapes
-    dec_per_tok = layers * (6 * d_model ** 2 + 2 * d_model * dff) \
-        + d_model * vocab
-    flops = 0.0
-    for sb in batches:
-        blen = int(sb.data.shape[1])
-        per_seq = layers * (4 * d_model ** 2 + 2 * d_model * dff) * blen \
-            + layers * 2 * d_model ** 2 * blen * beam
-        flops += 2.0 * batch * (dec_per_tok * beam * max_len + per_seq)
+    # decode flop model summed over the stream's actual bucket shapes
+    flops = sum(_decode_flops(batch, int(sb.data.shape[1]), max_len, vocab,
+                              d_model, dff, layers, beam)
+                for sb in batches)
     # real requests only: padding-duplicate rows burn clock (serving
     # reality) but must not be credited as served output
     emitted = n_requests * max_len
@@ -738,6 +743,12 @@ def main():
     dog.phase("compile", t_compile)
     fused_rnn_fallback = False
     fused_rnn_first_error = None
+    # dispatch truth for RNN models: snapshot the dispatcher's fused-path
+    # counter around the SUCCESSFUL compile — whether the kernels actually
+    # ran is read from ops/rnn, never re-derived here (docs/kernels.md
+    # "Dispatch truthfulness")
+    from paddle_tpu.ops import rnn as _rnn_dispatch
+    fused_count0 = _rnn_dispatch.FUSED_DISPATCH_COUNT
     try:
         t0 = time.perf_counter()
         try:
@@ -760,6 +771,9 @@ def main():
             # successful scan-path retry must not mask a non-Mosaic failure
             fused_rnn_first_error = f"{type(first).__name__}: {first}"[:300]
             t0 = time.perf_counter()      # compile_s = the run that worked
+            # the failed attempt may have traced through the fused dispatch
+            # before Mosaic rejected it; only the retry's tracing counts
+            fused_count0 = _rnn_dispatch.FUSED_DISPATCH_COUNT
             run, flops, baseline_ms, metric = factory(batch)[:4]
             loss = run(0)
             jax.block_until_ready(loss)
@@ -825,6 +839,12 @@ def main():
     if fused_rnn_fallback:
         out["fused_rnn_fallback"] = True
         out["fused_rnn_first_error"] = fused_rnn_first_error
+    if model in _RNN_MODELS:
+        # the executed path, from the dispatcher's own counter: tracing the
+        # successful compile entered _fused_seq_apply iff the kernels ran
+        out["fused_rnn"] = (
+            _rnn_dispatch.FUSED_DISPATCH_COUNT > fused_count0
+            and not fused_rnn_fallback)
     fam = _families_summary(_cache_store(cache_key, out))
     if fam:
         out["families"] = fam

@@ -19,3 +19,13 @@ def lanes(x, n):
     if n < LANES:
         return x[:, :n]
     return jnp.tile(x, (1, n // LANES))
+
+
+def vmem_budget_bytes():
+    """Per-core VMEM the kernels may plan against (~16 MB physically; 14 MB
+    default leaves headroom for Mosaic's own buffers).  Override with
+    PADDLE_TPU_KERNEL_VMEM_MB for chips with more (or to force the scan
+    path by setting it tiny)."""
+    import os
+    return int(float(os.environ.get("PADDLE_TPU_KERNEL_VMEM_MB", "14"))
+               * 1024 * 1024)

@@ -199,13 +199,23 @@ def _fused_fwd_rule(xs, w_gate, w_state, mask, interpret):
 _fused.defvjp(_fused_fwd_rule, _bwd)
 
 
+def vmem_bytes(b, d):
+    """Backward-pass VMEM planning estimate: w_gate+w_state + their
+    accumulators (6dd f32) + dh scratch + streamed per-step blocks."""
+    resident = 6 * d * d + b * d
+    streamed = 9 * b * d + _LANES * b
+    return 4 * (resident + streamed)
+
+
 def supported(b, d, act, gate_act, init_state):
     # reverse is handled by time-flipping in the caller (a reverse masked
     # scan over left-aligned ragged sequences == forward scan over the
-    # time-flipped arrays, flipped back)
+    # time-flipped arrays, flipped back).  VMEM guard: see lstm.supported.
+    from paddle_tpu.ops.pallas.common import vmem_budget_bytes
     return (act == "tanh" and gate_act == "sigmoid"
             and init_state is None
-            and b % 8 == 0 and d % _LANES == 0)
+            and b % 8 == 0 and d % _LANES == 0
+            and vmem_bytes(b, d) <= vmem_budget_bytes())
 
 
 def gru_fused(xs_tm, mask_tm, w_gate, w_state, interpret=None):

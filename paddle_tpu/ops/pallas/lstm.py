@@ -262,12 +262,27 @@ def _fused_fwd_rule(xs, w_r, checks, mask, interpret):
 _fused.defvjp(_fused_fwd_rule, _bwd)
 
 
+def vmem_bytes(b, d):
+    """Planning estimate of the BACKWARD kernel's VMEM footprint (the
+    larger pass): resident w_r + dW_r accumulator (4dd each, f32) +
+    dh/dc/dchk scratch + one set of streamed per-step blocks (acts, cs,
+    csp, hsp, dh_out, dxs, mask, dcfin).  docs/kernels.md carries the
+    audit table derived from this."""
+    resident = 8 * d * d + 3 * d + 5 * b * d        # weights+accum+scratch
+    streamed = 13 * b * d + _LANES * b
+    return 4 * (resident + streamed)
+
+
 def supported(b, d, act, gate_act, state_act, init_state):
     """Kernel path preconditions; callers fall back to the scan otherwise.
-    reverse is handled by the caller's time-flip (see rnn._fused_seq_apply)."""
+    reverse is handled by the caller's time-flip (see rnn._fused_seq_apply).
+    The VMEM guard keeps e.g. d=1280 (w_r alone = 26 MB f32) off the
+    kernel path — it cannot be weight-resident on a ~16 MB core."""
+    from paddle_tpu.ops.pallas.common import vmem_budget_bytes
     return (act == "tanh" and gate_act == "sigmoid" and state_act == "tanh"
             and init_state is None
-            and b % 8 == 0 and d % _LANES == 0)
+            and b % 8 == 0 and d % _LANES == 0
+            and vmem_bytes(b, d) <= vmem_budget_bytes())
 
 
 def lstm_fused(xs_tm, mask_tm, w_r, check_i, check_f, check_o,

@@ -130,9 +130,20 @@ def _fused_fwd_rule(xs, w, mask, interpret):
 _fused.defvjp(_fused_fwd_rule, _bwd)
 
 
+def vmem_bytes(b, d):
+    """Backward-pass VMEM planning estimate: W + dW accumulator (2dd f32)
+    + dh scratch + streamed per-step blocks."""
+    resident = 2 * d * d + b * d
+    streamed = 4 * b * d + _LANES * b
+    return 4 * (resident + streamed)
+
+
 def supported(b, d, act, init_state):
+    # VMEM guard rationale: see lstm.supported
+    from paddle_tpu.ops.pallas.common import vmem_budget_bytes
     return (act == "tanh" and init_state is None
-            and b % 8 == 0 and d % _LANES == 0)
+            and b % 8 == 0 and d % _LANES == 0
+            and vmem_bytes(b, d) <= vmem_budget_bytes())
 
 
 def simple_rnn_fused(xs_tm, mask_tm, w, interpret=None):

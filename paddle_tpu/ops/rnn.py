@@ -120,11 +120,20 @@ def _fused_lstm_enabled():
     return jax.default_backend() == "tpu"
 
 
+#: incremented on every fused-kernel dispatch (trace time).  Observers
+#: (bench.py) snapshot it around a compile to learn whether the fused path
+#: was ACTUALLY taken for a given model/shape — the one source of truth,
+#: instead of re-deriving supported()'s decision externally.
+FUSED_DISPATCH_COUNT = 0
+
+
 def _fused_seq_apply(seq, xs, ms, reverse, kernel_fn):
     """Shared fused-kernel dispatch: reverse = forward kernel over
     time-flipped arrays, flipped back (valid because sequences are
     left-aligned; masked steps freeze the carry identically either way).
     Returns (SequenceBatch, final-state) from kernel_fn(xs_tm, ms_tm)."""
+    global FUSED_DISPATCH_COUNT
+    FUSED_DISPATCH_COUNT += 1
     xs_k = jnp.flip(xs, 0) if reverse else xs
     ms_k = jnp.flip(ms, 0) if reverse else ms
     hs_tm, final = kernel_fn(xs_k, ms_k)

@@ -352,7 +352,12 @@ def test_capi_exported_stablehlo(merged_model, tmp_path):
     got = _parse_rows(out.stdout)
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
 
-    # ctypes twin: clone of an exported machine serves too (thread pattern)
+    # ctypes twin: clone of an exported machine serves too (thread
+    # pattern).  This half runs IN the pytest process, so it needs the
+    # process backend to match the artifact's platform.
+    if jax.default_backend() != "cpu":
+        pytest.skip("ctypes twin needs a cpu-backend pytest process "
+                    "(artifact exported for cpu)")
     lib = ctypes.CDLL(_LIB)
     lib.pt_capi_create_exported.restype = ctypes.c_int64
     lib.pt_capi_clone.restype = ctypes.c_int64

@@ -101,3 +101,16 @@ def test_fused_reverse_matches_scan(np_rng):
     np.testing.assert_allclose(float(a), float(b), rtol=2e-5)
     np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_vmem_guard_routes_oversized_to_scan(monkeypatch):
+    """d=1280's w_r (26 MB f32) cannot be VMEM-resident on a ~16 MB core:
+    supported() must say no BEFORE Mosaic discovers it the hard way, and
+    the budget must be overridable for bigger chips."""
+    from paddle_tpu.ops.pallas import lstm as pl
+    assert pl.supported(64, 512, "tanh", "sigmoid", "tanh", None)
+    assert not pl.supported(64, 1280, "tanh", "sigmoid", "tanh", None)
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_VMEM_MB", "128")
+    assert pl.supported(64, 1280, "tanh", "sigmoid", "tanh", None)
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_VMEM_MB", "1")
+    assert not pl.supported(64, 512, "tanh", "sigmoid", "tanh", None)
