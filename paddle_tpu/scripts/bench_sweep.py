@@ -45,6 +45,11 @@ def run_combo(model, batch, steps, timeout):
     env["BENCH_BATCH"] = str(batch)
     if steps:
         env["BENCH_STEPS"] = str(steps)
+    if os.environ.get("BENCH_PROFILE_BASE"):
+        # one xprof trace dir per combo, so scripts/xprof_report.py can
+        # attribute each family's step time separately
+        env["BENCH_PROFILE_DIR"] = os.path.join(
+            os.environ["BENCH_PROFILE_BASE"], f"{model}_bs{batch}")
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
         env=env, cwd=_REPO, timeout=timeout, capture_output=True, text=True)

@@ -29,10 +29,14 @@ timeout 1200 python bench.py --smoke-kernels \
     > "$ART/smoke_kernels.json" 2> "$ART/smoke_kernels.log"
 log "smoke rc=$? -> $ART/smoke_kernels.json"
 
-log "phase 2: bench sweep (BASELINE + scaling)"
-timeout 14400 python -m paddle_tpu.scripts.bench_sweep \
+log "phase 2: bench sweep (BASELINE + scaling; per-combo xprof traces)"
+BENCH_PROFILE_BASE="$ART/xprof" timeout 14400 \
+    python -m paddle_tpu.scripts.bench_sweep \
     > "$ART/bench_sweep.json" 2> "$ART/bench_sweep.log"
 log "sweep rc=$? (bench_cache.json updated)"
+python -m paddle_tpu.scripts.xprof_report "$ART/xprof" \
+    --write "$ART/xprof_report" 2> "$ART/xprof_report.log"
+log "xprof attribution rc=$? -> $ART/xprof_report.{txt,json}"
 
 log "phase 2b: scan baselines for the fused-kernel vs-scan column"
 PADDLE_TPU_FUSED_RNN=0 timeout 3600 python -m paddle_tpu.scripts.bench_sweep \

@@ -108,6 +108,7 @@ def test_vmem_guard_routes_oversized_to_scan(monkeypatch):
     supported() must say no BEFORE Mosaic discovers it the hard way, and
     the budget must be overridable for bigger chips."""
     from paddle_tpu.ops.pallas import lstm as pl
+    monkeypatch.delenv("PADDLE_TPU_KERNEL_VMEM_MB", raising=False)
     assert pl.supported(64, 512, "tanh", "sigmoid", "tanh", None)
     assert not pl.supported(64, 1280, "tanh", "sigmoid", "tanh", None)
     monkeypatch.setenv("PADDLE_TPU_KERNEL_VMEM_MB", "128")
