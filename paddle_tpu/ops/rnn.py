@@ -176,11 +176,22 @@ def lstm(seq: SequenceBatch, w_r, bias=None, check_i=None, check_f=None,
         # import inside the branch: a broken pallas install must not take
         # the scan fallback down with it
         from paddle_tpu.ops.pallas import lstm as pl_lstm
+        from paddle_tpu.ops.pallas import lstm_blocked as pl_lstm_blk
         if pl_lstm.supported(b, d, act, gate_act, state_act, init_state):
             sb, (fh, fc) = _fused_seq_apply(
                 seq, xs, ms, reverse,
                 lambda x, m: pl_lstm.lstm_fused(x, m, w_r, check_i,
                                                 check_f, check_o))
+            return sb, LstmState(h=fh, c=fc)
+        # over-VMEM hidden sizes: the gate-blocked forward keeps the carry
+        # in VMEM and fuses the cell while streaming weight blocks (scan-
+        # equivalent weight traffic; docs/kernels.md blocked-variant notes)
+        if pl_lstm_blk.supported(b, d, act, gate_act, state_act,
+                                 init_state):
+            sb, (fh, fc) = _fused_seq_apply(
+                seq, xs, ms, reverse,
+                lambda x, m: pl_lstm_blk.lstm_fused_blocked(
+                    x, m, w_r, check_i, check_f, check_o))
             return sb, LstmState(h=fh, c=fc)
 
     if init_state is None:

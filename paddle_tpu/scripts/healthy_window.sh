@@ -39,10 +39,14 @@ python -m paddle_tpu.scripts.xprof_report "$ART/xprof" \
 log "xprof attribution rc=$? -> $ART/xprof_report.{txt,json}"
 
 log "phase 2b: scan baselines for the fused-kernel vs-scan column"
-PADDLE_TPU_FUSED_RNN=0 timeout 3600 python -m paddle_tpu.scripts.bench_sweep \
+PADDLE_TPU_FUSED_RNN=0 BENCH_PROFILE_BASE="$ART/xprof_scan" \
+    timeout 3600 python -m paddle_tpu.scripts.bench_sweep \
     --combos "lstm:64,lstm256:64,lstm1280:64,seq2seq:64" \
     > "$ART/bench_scan_baselines.json" 2> "$ART/bench_scan_baselines.log"
 log "scan baselines rc=$? (cached under model@scan)"
+python -m paddle_tpu.scripts.xprof_report "$ART/xprof_scan" \
+    --write "$ART/xprof_scan_report" 2>> "$ART/xprof_report.log"
+log "scan-trace attribution rc=$? (fused-vs-scan comparison inputs ready)"
 
 log "phase 3: TPU differential dump + compare"
 # resumable per-case dumps; 'default' platform = the axon-routed TPU

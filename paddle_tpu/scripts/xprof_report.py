@@ -53,16 +53,22 @@ _CATEGORIES = [
         r"broadcast|iota|convert", re.I)),
 ]
 
+# host-side blocking waits: excluded from the op categories (they nest
+# over real op events) but totted up separately — a large host_wait_us is
+# the H2D-serialization signal docs/perf.md's tuning table points at
+_WAIT = re.compile(
+    r"Await|block_until_ready|try_to_block|wait for", re.I)
+
 # host-runtime bookkeeping events that would double-count over the real op
 # events nested under them (or alongside them on the same track)
 _SKIP = re.compile(
-    r"PjitFunction|ExecuteHelper|PjRtCpu|Await|ParseArguments|"
+    r"PjitFunction|ExecuteHelper|PjRtCpu|ParseArguments|"
     r"CollectGarbage|Handle inputs|holds|ThreadpoolListener|"
     r"CreateOutputs|TransferTo|BufferFromHost|^end: |^Thread |^run_|"
-    # python frames ($file:line fn), blocking waits and executor
-    # bookkeeping nest OVER the real op events — counting both would
-    # double-book the time and drown the categories in "other"
-    r"^\$|block_until_ready|try_to_block|ThunkExecutor|toarray",
+    # python frames ($file:line fn) and executor bookkeeping nest OVER the
+    # real op events — counting both would double-book the time and drown
+    # the categories in "other"
+    r"^\$|ThunkExecutor|toarray",
     re.I)
 
 
@@ -116,8 +122,12 @@ def _merged_busy_us(spans):
 def report_run(run_dir, top=8):
     events, tracks = load_events(run_dir)
     per_track = collections.defaultdict(list)
+    wait_us = collections.Counter()
     for e in events:
         name = e.get("name", "")
+        if _WAIT.search(name):
+            wait_us[e["pid"]] += e["dur"]
+            continue
         if _SKIP.search(name):
             continue
         per_track[e["pid"]].append(e)
@@ -138,6 +148,7 @@ def report_run(run_dir, top=8):
         out["tracks"][tname] = {
             "wall_us": round(wall, 1),
             "busy_us": round(busy, 1),
+            "host_wait_us": round(wait_us.get(pid, 0.0), 1),
             "idle_pct": round(100.0 * max(wall - busy, 0.0)
                               / max(wall, 1e-9), 1),
             "by_category_us": {k: round(v, 1)
@@ -153,7 +164,8 @@ def render(rep):
     for tname, t in rep["tracks"].items():
         lines.append(f"  track {tname}: wall {t['wall_us'] / 1e3:.2f} ms, "
                      f"busy {t['busy_us'] / 1e3:.2f} ms, "
-                     f"idle {t['idle_pct']}%")
+                     f"idle {t['idle_pct']}%, "
+                     f"host waits {t.get('host_wait_us', 0) / 1e3:.2f} ms")
         total = sum(t["by_category_us"].values()) or 1.0
         for cat, us in t["by_category_us"].items():
             lines.append(f"    {cat:<20} {us / 1e3:9.2f} ms "

@@ -133,8 +133,54 @@ def _flash_case(causal, tol=0.05):
     return err
 
 
+def _lstm_blocked_case(tol=1e-2):
+    """Gate-blocked over-VMEM LSTM forward (lstm_blocked.py) + its
+    saved-activation BPTT vs the scan oracle, via direct kernel call (the
+    dispatch would prefer the resident kernel at this small shape)."""
+    from paddle_tpu.ops import rnn
+    from paddle_tpu.ops.pallas import lstm_blocked as blk
+
+    b, t, d = 8, 9, 256          # odd T exercises the parity pad
+    rng = np.random.RandomState(11)
+    data = jnp.asarray(rng.randn(b, t, 4 * d) * 0.3, jnp.float32)
+    lengths = jnp.asarray(rng.randint(1, t + 1, (b,)), jnp.int32)
+    probe = jnp.asarray(rng.randn(b, t, d), jnp.float32)
+    w = jnp.asarray(rng.randn(d, 4 * d) * 0.05, jnp.float32)
+    checks = [jnp.asarray(rng.randn(d) * 0.1, jnp.float32)
+              for _ in range(3)]
+    seq = SequenceBatch(data=data, lengths=lengths)
+    ms = seq.mask().transpose(1, 0)
+
+    def loss_blk(data, w):
+        hs, (fh, fc) = blk.lstm_fused_blocked(
+            data.transpose(1, 0, 2), ms, w, *checks)
+        out = hs.transpose(1, 0, 2) * seq.mask(hs.dtype)[..., None]
+        return jnp.sum(out * probe) + jnp.sum(fh) + jnp.sum(fc)
+
+    def loss_scan(data, w):
+        with _fused_mode("0"):
+            out, final = rnn.lstm(SequenceBatch(data=data, lengths=lengths),
+                                  w, check_i=checks[0], check_f=checks[1],
+                                  check_o=checks[2])
+        return (jnp.sum(out.data * probe) + jnp.sum(final.h)
+                + jnp.sum(final.c))
+
+    l_k, (gx_k, gw_k) = jax.jit(
+        jax.value_and_grad(loss_blk, argnums=(0, 1)))(data, w)
+    jax.block_until_ready(l_k)
+    l_o, (gx_o, gw_o) = jax.jit(
+        jax.value_and_grad(loss_scan, argnums=(0, 1)))(data, w)
+    jax.block_until_ready(l_o)
+    err = max(_max_err(l_k, l_o),
+              _max_err(gx_k, gx_o),
+              _max_err(gw_k, gw_o) / max(1.0, float(jnp.abs(gw_o).max())))
+    assert err <= tol, f"lstm_blocked max err {err:.3e} > tol {tol}"
+    return err
+
+
 CASES = {
     "lstm_fused": lambda: _rnn_case("lstm"),
+    "lstm_blocked": _lstm_blocked_case,
     "gru_fused": lambda: _rnn_case("gru"),
     "simple_rnn_fused": lambda: _rnn_case("simple_rnn"),
     "flash_attention": lambda: _flash_case(causal=False),

@@ -1,0 +1,128 @@
+"""Gate-blocked Pallas LSTM (ops/pallas/lstm_blocked.py) vs the lax.scan
+reference path: the over-VMEM variant must reproduce forward AND every
+gradient, including ragged masks, odd T (parity padding), reverse
+direction, and the saved-activation BPTT."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.ops import rnn
+from paddle_tpu.ops.pallas import lstm_blocked as blk
+
+
+B, D = 8, 256               # 2 gate blocks of 128
+
+
+def _mk(np_rng, t, ragged=True):
+    x = jnp.asarray(np_rng.randn(B, t, 4 * D) * 0.3, jnp.float32)
+    lengths = (np_rng.randint(1, t + 1, (B,)) if ragged
+               else np.full((B,), t))
+    seq = SequenceBatch(data=x, lengths=jnp.asarray(lengths, jnp.int32))
+    w_r = jnp.asarray(np_rng.randn(D, 4 * D) * 0.1, jnp.float32)
+    checks = [jnp.asarray(np_rng.randn(D) * 0.1, jnp.float32)
+              for _ in range(3)]
+    return seq, w_r, checks
+
+
+def _scan(seq, w_r, checks, reverse=False):
+    prior = rnn.FUSED_LSTM
+    rnn.FUSED_LSTM = "0"
+    try:
+        return rnn.lstm(seq, w_r, check_i=checks[0], check_f=checks[1],
+                        check_o=checks[2], reverse=reverse)
+    finally:
+        rnn.FUSED_LSTM = prior
+
+
+def _blocked(seq, w_r, checks, reverse=False):
+    xs = seq.data.transpose(1, 0, 2)
+    ms = seq.mask().transpose(1, 0)
+    if reverse:
+        xs, ms = jnp.flip(xs, 0), jnp.flip(ms, 0)
+    hs, (fh, fc) = blk.lstm_fused_blocked(
+        xs, ms, w_r, checks[0], checks[1], checks[2], interpret=True)
+    if reverse:
+        hs = jnp.flip(hs, 0)
+    out = hs.transpose(1, 0, 2) * seq.mask(hs.dtype)[..., None]
+    return SequenceBatch(data=out, lengths=seq.lengths), (fh, fc)
+
+
+@pytest.mark.parametrize("t", [6, 7], ids=["evenT", "oddT"])
+@pytest.mark.parametrize("ragged", [False, True], ids=["full", "ragged"])
+def test_blocked_matches_scan_forward(np_rng, t, ragged):
+    seq, w_r, checks = _mk(np_rng, t, ragged)
+    got, (gh, gc) = _blocked(seq, w_r, checks)
+    want, fin = _scan(seq, w_r, checks)
+    np.testing.assert_allclose(np.asarray(got.data),
+                               np.asarray(want.data), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gc), np.asarray(fin.c),
+                               atol=2e-5)
+
+
+def test_blocked_matches_scan_reverse(np_rng):
+    seq, w_r, checks = _mk(np_rng, 7, ragged=True)
+    got, _ = _blocked(seq, w_r, checks, reverse=True)
+    want, _ = _scan(seq, w_r, checks, reverse=True)
+    np.testing.assert_allclose(np.asarray(got.data),
+                               np.asarray(want.data), atol=2e-5)
+
+
+@pytest.mark.parametrize("use_final", [False, True], ids=["hs", "hs+final"])
+def test_blocked_matches_scan_grads(np_rng, use_final):
+    seq, w_r, checks = _mk(np_rng, 7, ragged=True)
+
+    def loss(impl, xdata, w_r, ci, cf, co):
+        s = SequenceBatch(data=xdata, lengths=seq.lengths)
+        out, fin = impl(s, w_r, [ci, cf, co])
+        val = jnp.sum(out.data ** 2)
+        if use_final:
+            val = val + jnp.sum(fin[1] ** 2) + jnp.sum(fin[0]) \
+                if impl is _blocked else \
+                val + jnp.sum(fin.c ** 2) + jnp.sum(fin.h)
+        return val
+
+    args = (seq.data, w_r, *checks)
+    ga = jax.grad(lambda *a: loss(_blocked, *a), argnums=(0, 1, 2, 3, 4))(
+        *args)
+    gb = jax.grad(lambda *a: loss(_scan, *a), argnums=(0, 1, 2, 3, 4))(
+        *args)
+    for x, y, name in zip(ga, gb, ["dx", "dwr", "dci", "dcf", "dco"]):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=5e-4, err_msg=name)
+
+
+def test_dispatch_uses_blocked_for_over_vmem(monkeypatch, np_rng):
+    """ops/rnn.py must route an over-VMEM hidden size to the blocked
+    kernel (not the scan) when fusion is on, and count the dispatch."""
+    monkeypatch.delenv("PADDLE_TPU_KERNEL_VMEM_MB", raising=False)
+    # D=256 fits the resident kernel; shrink the budget so resident says
+    # no but blocked (no resident weights) says yes
+    from paddle_tpu.ops.pallas import lstm as resident
+    need = blk.vmem_bytes(B, D)
+    assert need < resident.vmem_bytes(B, D)
+    monkeypatch.setenv("PADDLE_TPU_KERNEL_VMEM_MB",
+                       str(need / 1024 / 1024 * 1.2))
+    assert not resident.supported(B, D, "tanh", "sigmoid", "tanh", None)
+    assert blk.supported(B, D, "tanh", "sigmoid", "tanh", None)
+
+    calls = {"blocked": 0}
+    orig = blk.lstm_fused_blocked
+    monkeypatch.setattr(
+        blk, "lstm_fused_blocked",
+        lambda *a, **k: calls.__setitem__("blocked",
+                                          calls["blocked"] + 1) or
+        orig(*a, **k, interpret=True))
+    seq, w_r, checks = _mk(np_rng, 6)
+    prior = rnn.FUSED_LSTM
+    rnn.FUSED_LSTM = "always"
+    try:
+        n0 = rnn.FUSED_DISPATCH_COUNT
+        out, _ = rnn.lstm(seq, w_r)
+        assert calls["blocked"] == 1
+        assert rnn.FUSED_DISPATCH_COUNT == n0 + 1
+        assert np.all(np.isfinite(np.asarray(out.data)))
+    finally:
+        rnn.FUSED_LSTM = prior
