@@ -250,10 +250,13 @@ _fused.defvjp(_fused_fwd_rule, _fused_bwd_rule)
 
 
 def vmem_bytes(b, d):
-    """Forward footprint: three [B, D] f32 carry scratches + two pipelined
-    weight blocks [D, 4, 128] + small streamed blocks."""
+    """Training-path footprint (the larger, save_residuals forward): three
+    [B, D] f32 carry scratches + two pipelined weight blocks [D, 4, 128]
+    + double-buffered streamed blocks INCLUDING the residual outputs
+    (cs [B, 128] + acts [B, 4, 128]) the VJP variant emits."""
     resident = 3 * b * d + 2 * d * 4 * _BLK
-    streamed = 2 * (b * 4 * _BLK + b * _LANES + 2 * b * _BLK)
+    streamed = 2 * (b * 4 * _BLK + b * _LANES + 2 * b * _BLK
+                    + b * _BLK + b * 4 * _BLK)
     return 4 * (resident + streamed)
 
 

@@ -45,6 +45,11 @@ def main(argv=None):
     ap.add_argument("--ckpt-step", type=int, default=10)
     ap.add_argument("--crash-rank", type=int, default=None)
     ap.add_argument("--crash-step", type=int, default=None)
+    ap.add_argument("--trainer-sparse", action="store_true",
+                    help="train the sparse-embedding model through the "
+                         "REAL layers+SGD trainer API on the global mesh "
+                         "(reference test_CompareSparse: multi-trainer "
+                         "sparse vs local numerics)")
     args = ap.parse_args(argv)
     os.makedirs(args.out_dir, exist_ok=True)
 
@@ -64,6 +69,9 @@ def main(argv=None):
     nproc = jax.process_count()
     rank = jax.process_index()
     assert nproc == int(os.environ["PADDLE_TPU_NUM_PROCESSES"])
+
+    if args.trainer_sparse:
+        return _trainer_sparse(args, nproc, rank)
 
     devices = np.asarray(jax.devices())
     if args.mesh == "data,model":
@@ -164,6 +172,79 @@ def main(argv=None):
         json.dump(out, f)
     print(f"[dist_worker] rank {rank}/{nproc} loss={out['loss']:.6f} "
           f"checksum={checksum:.6f}", flush=True)
+
+
+def _trainer_sparse(args, nproc, rank):
+    """The user-facing path at multi-process scale: layers DSL model with a
+    sparse_update embedding trained through trainer.SGD(mesh=global mesh).
+    Deterministic batches (same stream every process — SPMD); final cost +
+    parameter checksums land in rank{i}.json for the numerics compare."""
+    import json as _json
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    import paddle_tpu.layers as L
+    from paddle_tpu import optim
+    from paddle_tpu.core.sequence import pad_sequences
+    from paddle_tpu.layers.graph import reset_names
+    from paddle_tpu.parallel import distributed as dist
+    from paddle_tpu.trainer.trainer import SGD
+    from paddle_tpu.trainer import events
+
+    vocab, emb_dim, b, t = 64, 8, 8, 5
+    reset_names()
+    w = L.data_layer("w", size=vocab, is_seq=True)
+    emb = L.embedding_layer(w, size=emb_dim, sparse_update=True,
+                            param_attr={"initial_std": 0.1, "name": "emb"})
+    pooled = L.pooling_layer(emb, pooling_type="sum")
+    out = L.fc_layer(pooled, size=2, act="softmax",
+                     param_attr={"initial_std": 0.1, "name": "fc"})
+    lab = L.data_layer("lab", size=1)
+    cost = L.classification_cost(input=out, label=lab)
+
+    rng = np.random.RandomState(5)
+    batches = []
+    for _ in range(12):
+        seqs = [rng.randint(0, vocab, (rng.randint(2, t + 1),))
+                for _ in range(b)]
+        # learnable labels ("does any low token appear") so the test can
+        # assert progress, not just numerics agreement
+        labs = np.asarray([[int((s < vocab // 4).any())] for s in seqs],
+                          np.int32)
+        batches.append({"w": pad_sequences(seqs, max_len=t), "lab": labs})
+
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    tr = SGD(cost=cost, update_equation=optim.Momentum(learning_rate=0.1,
+                                                       momentum=0.0),
+             mesh=mesh, seed=3, donate=False)
+    costs = []
+    tr.train(lambda: iter(batches), num_passes=2, log_period=0,
+             event_handler=lambda e: costs.append(float(e.cost))
+             if isinstance(e, events.EndIteration) else None)
+
+    dist.barrier("final")
+    repl = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+    def subtree_checksum(key):
+        leaves = jax.tree_util.tree_leaves(tr.parameters[key])
+        total = 0.0
+        for v in leaves:
+            g = jax.device_get(jax.jit(lambda a: a, out_shardings=repl)(v))
+            total += float(np.abs(g).sum())
+        return total
+
+    out_rec = {"rank": rank, "nproc": nproc,
+               "loss": costs[-1], "first_loss": costs[0],
+               "emb_checksum": subtree_checksum("emb"),
+               "fc_checksum": subtree_checksum("fc"),
+               "global_devices": jax.device_count(),
+               "mode": "trainer-sparse"}
+    with open(os.path.join(args.out_dir, f"rank{rank}.json"), "w") as f:
+        _json.dump(out_rec, f)
+    print(f"[dist_worker] trainer-sparse rank {rank}/{nproc} "
+          f"loss={costs[-1]:.6f}", flush=True)
 
 
 if __name__ == "__main__":

@@ -117,11 +117,26 @@ class SGD:
                 "sparse": {k: self.optimizer.row_init(self.parameters[k])
                            for k in self._sparse_specs}}
         self.model_state = self.topology.init_state()
+        # multi-controller SPMD: the mesh spans devices owned by OTHER
+        # processes (jax.distributed bring-up).  Every process must then
+        # run the same program on the same host batches; feeds and rng are
+        # assembled into global arrays (see _globalize) and checkpoints
+        # gather-then-write on process 0 only.
+        self._multiprocess = mesh is not None and any(
+            d.process_index != jax.process_index()
+            for d in np.asarray(mesh.devices).flat)
         if mesh is not None:
             rules = sharding_rules
-            self.parameters = shard_params(self.parameters, mesh, rules)
+            if self._multiprocess:
+                # device_put cannot target non-addressable devices; build
+                # global arrays from the (identical-per-process) host values
+                ps = param_shardings(self.parameters, mesh, rules)
+                self.parameters = self._globalize(self.parameters, ps)
+            else:
+                self.parameters = shard_params(self.parameters, mesh, rules)
         self._step_fn = None
         self._eval_fn = None
+        self._gather_cache = {}   # jitted replicate-gathers (save path)
         self._donate = donate
 
     # ------------------------------------------------------------ build
@@ -327,6 +342,29 @@ class SGD:
 
     # ------------------------------------------------------------ train
 
+    def _globalize(self, tree, shardings):
+        """Host pytree -> global jax.Arrays on a process-spanning mesh.
+        Every process holds the same host value (SPMD discipline:
+        deterministic init / identical batch streams); each device takes
+        its addressable shard via the callback."""
+        def conv(x, sh):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                # already global (e.g. fresh-init params kept by a
+                # load_parameters 'rand' merge): gather to host first
+                x = self._devget_replicated(x)
+            a = np.asarray(x)
+            return jax.make_array_from_callback(a.shape, sh,
+                                                lambda idx: a[idx])
+        return jax.tree_util.tree_map(conv, tree, shardings)
+
+    def _globalize_step_inputs(self, feed, step_rng):
+        if not self._multiprocess:
+            return feed, step_rng
+        feed = self._globalize(feed, batch_shardings(feed, self.mesh))
+        step_rng = self._globalize(
+            step_rng, replicated_shardings(step_rng, self.mesh))
+        return feed, step_rng
+
     def log_parameter_stats(self):
         """Per-parameter value abs-max/avg dump (the reference's
         --show_parameter_stats_period, TrainerInternal.cpp:210-214)."""
@@ -381,6 +419,11 @@ class SGD:
             # event stream, whose .cost is the device scalar; float() it
             # lazily in your handler if you need the number immediately)
             cost_sum = jnp.zeros(())
+            if self._multiprocess:
+                # keep the accumulator global-replicated so per-step
+                # arithmetic stays on-device (no host sync in the hot loop)
+                cost_sum = self._globalize(
+                    cost_sum, replicated_shardings(cost_sum, self.mesh))
             n_batches = 0
             window = []
             t0 = time.time()
@@ -391,6 +434,7 @@ class SGD:
                 self.rng, step_rng = jax.random.split(self.rng)
                 if self._step_fn is None:
                     self._build_step(feed)
+                feed, step_rng = self._globalize_step_inputs(feed, step_rng)
                 t_step = time.perf_counter()
                 with timer("train_step"):
                     (self.parameters, self.opt_state, self.model_state,
@@ -435,10 +479,10 @@ class SGD:
                 tc = self.test(test_reader, feeding=feeder)
                 event_handler(events.EndTesting(pass_id, tc))
             if save_dir and (pass_id + 1) % saving_period == 0:
-                path = save_checkpoint(save_dir, pass_id, self.parameters,
-                                       self.opt_state, self.model_state,
-                                       save_only_one=save_only_one)
-                logger.info("saved checkpoint %s", path)
+                path = self.save(save_dir, pass_id,
+                                 save_only_one=save_only_one)
+                if path:
+                    logger.info("saved checkpoint %s", path)
             event_handler(events.EndPass(pass_id))
 
     # ------------------------------------------------------------ test
@@ -459,6 +503,9 @@ class SGD:
         total, n = 0.0, 0
         for batch in reader():
             feed = _normalize_feed(feeder(batch) if feeder else batch)
+            if self._multiprocess:
+                feed = self._globalize(feed,
+                                       batch_shardings(feed, self.mesh))
             cost, _ = self._eval_fn(self.parameters, self.model_state, feed)
             total += float(cost)
             n += 1
@@ -469,9 +516,36 @@ class SGD:
     # ------------------------------------------------------------ io
 
     def save(self, save_dir, pass_id=0, save_only_one=False):
-        return save_checkpoint(save_dir, pass_id, self.parameters,
-                               self.opt_state, self.model_state,
+        params, opt_state = self.parameters, self.opt_state
+        if self._multiprocess:
+            # model-sharded leaves are not process-0-addressable: gather to
+            # replicated (a jitted identity re-sharding), then only the
+            # coordinator writes; everyone waits so a crash right after
+            # the pass boundary can always resume from this checkpoint
+            from paddle_tpu.parallel import barrier
+            params = self._devget_replicated(params, "params")
+            opt_state = self._devget_replicated(opt_state, "opt")
+            if jax.process_index() != 0:
+                barrier(f"save{pass_id}")
+                return None
+        path = save_checkpoint(save_dir, pass_id, params,
+                               opt_state, self.model_state,
                                save_only_one=save_only_one)
+        if self._multiprocess:
+            from paddle_tpu.parallel import barrier
+            barrier(f"save{pass_id}")
+        return path
+
+    def _devget_replicated(self, tree, cache_key=None):
+        if tree is None:
+            return None
+        gather = self._gather_cache.get(cache_key) if cache_key else None
+        if gather is None:
+            shardings = replicated_shardings(tree, self.mesh)
+            gather = jax.jit(lambda t: t, out_shardings=shardings)
+            if cache_key:
+                self._gather_cache[cache_key] = gather
+        return jax.device_get(gather(tree))
 
     def load(self, save_dir, pass_id=None):
         params, opt_state, model_state, meta = load_checkpoint(save_dir, pass_id)
@@ -481,7 +555,28 @@ class SGD:
         if model_state is not None:
             self.model_state = model_state
         self._refresh_prune_masks()
+        self._reglobalize_after_load()
         return meta
+
+    def _reglobalize_after_load(self):
+        """Checkpoint leaves are host arrays; on a process-spanning mesh
+        they must become global arrays again (jit cannot device_put host
+        values onto non-addressable devices).  Params take their rule
+        shardings; opt/model state re-enter replicated — the next step's
+        explicit in_shardings reshards them to their true layout."""
+        if not self._multiprocess:
+            return
+        ps = param_shardings(self.parameters, self.mesh,
+                             self.sharding_rules)
+        self.parameters = self._globalize(self.parameters, ps)
+        if self.opt_state is not None:
+            self.opt_state = self._globalize(
+                self.opt_state,
+                replicated_shardings(self.opt_state, self.mesh))
+        if self.model_state:
+            self.model_state = self._globalize(
+                self.model_state,
+                replicated_shardings(self.model_state, self.mesh))
 
     def load_parameters(self, save_dir, pass_id=None,
                         missing_strategy="fail"):
@@ -511,6 +606,7 @@ class SGD:
         if model_state:
             self.model_state = {**self.model_state, **model_state}
         self._refresh_prune_masks()
+        self._reglobalize_after_load()
 
     def _refresh_prune_masks(self):
         """Re-derive pruning masks after self.parameters was replaced

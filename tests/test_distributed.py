@@ -196,3 +196,28 @@ def test_rendezvous_env_contract():
     assert env == {"PADDLE_TPU_COORDINATOR": "h0:8476",
                    "PADDLE_TPU_NUM_PROCESSES": "4",
                    "PADDLE_TPU_PROCESS_ID": "3"}
+
+
+def test_trainer_sparse_multiprocess_matches_single(tmp_path):
+    """The user-facing trainer path at multi-process scale: a layers-DSL
+    model with a sparse_update embedding trained through SGD(mesh=global
+    mesh) across 2 processes must reproduce single-process numerics AND
+    make progress — the reference's test_CompareSparse scenario
+    (multi-trainer sparse vs local) on the SPMD runtime."""
+    two = _launch(2, str(tmp_path / "p2"),
+                  worker_args=["--trainer-sparse"], timeout=300)
+    one = _launch(1, str(tmp_path / "p1"),
+                  worker_args=["--trainer-sparse"])
+    assert [r["mode"] for r in two] == ["trainer-sparse"] * 2
+    # SPMD: both ranks computed identical state
+    assert two[0]["loss"] == two[1]["loss"]
+    assert two[0]["emb_checksum"] == pytest.approx(two[1]["emb_checksum"],
+                                                   abs=1e-6)
+    # distributed == local
+    assert two[0]["loss"] == pytest.approx(one[0]["loss"], abs=1e-5)
+    assert two[0]["emb_checksum"] == pytest.approx(one[0]["emb_checksum"],
+                                                   rel=1e-5)
+    assert two[0]["fc_checksum"] == pytest.approx(one[0]["fc_checksum"],
+                                                  rel=1e-5)
+    # and it learned
+    assert two[0]["loss"] < 0.95 * two[0]["first_loss"]
