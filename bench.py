@@ -679,6 +679,13 @@ def main():
     if os.environ.get("BENCH_PLATFORM"):
         os.environ["JAX_PLATFORMS"] = os.environ["BENCH_PLATFORM"]
 
+    if os.environ.get("BENCH_DTYPE"):
+        # explicit compute-dtype policy for the run (core/dtypes auto
+        # policy already picks bf16 on TPU; BENCH_DTYPE=float32 measures
+        # the f32 column, bfloat16 forces bf16 off-TPU)
+        from paddle_tpu.core import dtypes as _dtypes
+        _dtypes.set_policy(compute_dtype=os.environ["BENCH_DTYPE"])
+
     if model == "smoke_kernels":
         factory, default_batch = None, 0
     else:

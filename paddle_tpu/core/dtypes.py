@@ -59,3 +59,16 @@ def to_compute(x):
 
 def to_param(x):
     return x.astype(_param_dtype)
+
+
+def cast_tree(tree, dtype):
+    """float32 leaves -> dtype; ids/lengths/masks (ints, bools) and other
+    dtypes pass through.  The one shared implementation of the
+    mixed-precision boundary cast (trainer step, eval, inference)."""
+    import jax
+
+    def cast(x):
+        if hasattr(x, "dtype") and x.dtype == jnp.float32:
+            return x.astype(dtype)
+        return x
+    return jax.tree_util.tree_map(cast, tree)

@@ -131,6 +131,17 @@ def main(argv=None):
         p.add_argument("--pad_batch", action="store_true",
                        help="pad the final short batch to the full batch "
                             "size (one more shape avoided)")
+        p.add_argument("--dtype", default="auto",
+                       choices=["auto", "float32", "bfloat16"],
+                       help="compute dtype for forward+backward; master "
+                            "params and the optimizer stay float32.  "
+                            "auto = platform policy (bf16 matmul inputs "
+                            "on TPU, f32 elsewhere); float32 FORCES full "
+                            "f32 even on TPU (numerics debugging); "
+                            "bfloat16 forces bf16 everywhere and also "
+                            "casts params+feeds at the step boundary "
+                            "(half-width HBM reads; no loss scaling "
+                            "needed)")
 
     t = sub.add_parser("train")
     add_common(t)
@@ -254,10 +265,20 @@ def main(argv=None):
         # config styles train identically when no optimizer is named
         from paddle_tpu import optim
         optimizer = optim.Momentum(learning_rate=1e-3, momentum=0.0)
+    import jax.numpy as jnp
+    if args.dtype != "auto":
+        # op-level policy: explicit float32 must ALSO be asserted (the
+        # auto policy would keep feeding the MXU bf16 inputs on TPU);
+        # bfloat16 additionally casts params + feeds at the step boundary
+        # via SGD(compute_dtype=...) so HBM reads are half-width
+        from paddle_tpu.core import dtypes as _dtypes
+        _dtypes.set_policy(compute_dtype=args.dtype)
     trainer = SGD(cost=cfg["cost"], update_equation=optimizer,
                   mesh=mesh,
                   sharding_rules=cfg.get("sharding_rules"),
-                  evaluators=cfg.get("evaluators"))
+                  evaluators=cfg.get("evaluators"),
+                  compute_dtype=(jnp.bfloat16
+                                 if args.dtype == "bfloat16" else None))
 
     if args.job == "train":
         save_dir = args.save_dir or cfg.get("save_dir")

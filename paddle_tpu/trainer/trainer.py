@@ -58,7 +58,8 @@ class SGD:
 
     def __init__(self, cost, parameters=None, update_equation=None,
                  extra_layers=None, is_local=True, mesh=None,
-                 sharding_rules=None, seed=1, donate=True, evaluators=None):
+                 sharding_rules=None, seed=1, donate=True, evaluators=None,
+                 compute_dtype=None):
         self.costs = cost if isinstance(cost, (list, tuple)) else [cost]
         self.extra_layers = list(extra_layers or [])
         # evaluator specs (evaluators.dsl): fetch their bound layers as
@@ -85,6 +86,13 @@ class SGD:
                 "SGD needs update_equation=, e.g. "
                 "optim.Momentum(learning_rate=0.01)")
         self.optimizer: Optimizer = update_equation
+        # mixed precision, the TPU-native way: master params stay f32 (the
+        # optimizer state/update precision), forward+backward run in
+        # compute_dtype (jnp.bfloat16) — halves HBM traffic and feeds the
+        # MXU its native input width.  bf16's f32-equal exponent range
+        # makes loss scaling unnecessary (unlike fp16).  The cast happens
+        # inside the loss, so autodiff returns f32 master grads.
+        self.compute_dtype = compute_dtype
         self.mesh = mesh
         self.sharding_rules = sharding_rules
         rng = jax.random.PRNGKey(seed)
@@ -186,7 +194,16 @@ class SGD:
                     "share only among sparse_update embeddings")
         return specs
 
+    def _cast_compute(self, tree):
+        """float32 leaves -> compute_dtype (ids, masks, lengths untouched).
+        SequenceBatch data casts; lengths stay int."""
+        from paddle_tpu.core.dtypes import cast_tree
+        return cast_tree(tree, self.compute_dtype)
+
     def _loss_and_extras(self, params, state, feed, rng):
+        if self.compute_dtype is not None:
+            params = self._cast_compute(params)
+            feed = self._cast_compute(feed)
         out, new_state = self.topology.apply(
             params, feed, mode="train", rng=rng, state=state,
             return_state=True)
@@ -194,7 +211,9 @@ class SGD:
         n_cost = len(self.costs)
         cost_vals = outs[:n_cost]
         extra_vals = outs[n_cost:]
-        total = sum(jnp.mean(c) for c in cost_vals)
+        # reductions in f32 regardless of compute dtype (bf16 has ~8 bits
+        # of mantissa; a batch-mean in bf16 loses the loss signal)
+        total = sum(jnp.mean(c.astype(jnp.float32)) for c in cost_vals)
         return total, (new_state, extra_vals)
 
     def _build_step(self, feed_example):
@@ -489,10 +508,16 @@ class SGD:
 
     def _build_eval(self):
         def ev(params, state, feed):
+            if self.compute_dtype is not None:
+                params = self._cast_compute(params)
+                feed = self._cast_compute(feed)
             out = self.topology.apply(params, feed, mode="test", state=state)
             outs = out if isinstance(out, tuple) else (out,)
             cost_vals = outs[:len(self.costs)]
-            return sum(jnp.mean(c) for c in cost_vals), outs[len(self.costs):]
+            # f32 reduction regardless of compute dtype (same rationale as
+            # the train path: a bf16 batch-mean loses the cost signal)
+            return (sum(jnp.mean(c.astype(jnp.float32))
+                        for c in cost_vals), outs[len(self.costs):])
         self._eval_fn = jax.jit(ev)
 
     def test(self, reader, feeding=None):
@@ -646,17 +671,33 @@ class SGD:
 
 
 class Inferencer:
-    """paddle.v2.inference equivalent: run a topology in test mode."""
+    """paddle.v2.inference equivalent: run a topology in test mode.
 
-    def __init__(self, output_layer, parameters, model_state=None):
+    compute_dtype=jnp.bfloat16 runs the forward in bf16 (params cast at
+    the jit boundary; outputs returned in f32) — the serving-side half of
+    the trainer's mixed-precision option."""
+
+    def __init__(self, output_layer, parameters, model_state=None,
+                 compute_dtype=None):
         outs = output_layer if isinstance(output_layer, (list, tuple)) \
             else [output_layer]
         self.topology = Topology(list(outs))
         self.parameters = parameters
         self.model_state = model_state or {}
-        self._fn = jax.jit(
-            lambda p, s, feed: self.topology.apply(p, feed, mode="test",
-                                                   state=s))
+
+        def fwd(p, s, feed):
+            if compute_dtype is not None:
+                from paddle_tpu.core.dtypes import cast_tree
+                p = cast_tree(p, compute_dtype)
+                feed = cast_tree(feed, compute_dtype)
+            out = self.topology.apply(p, feed, mode="test", state=s)
+            if compute_dtype is not None:
+                out = jax.tree_util.tree_map(
+                    lambda x: x.astype(jnp.float32)
+                    if hasattr(x, "dtype") and x.dtype == compute_dtype
+                    else x, out)
+            return out
+        self._fn = jax.jit(fwd)
 
     def infer(self, feed_or_batch, feeding=None):
         if feeding is not None and not isinstance(feed_or_batch, dict):
