@@ -699,6 +699,11 @@ def main():
     # fused number (both env spellings, matching ops/rnn.py's dispatch)
     if _fused_rnn_disabled() and model in _RNN_MODELS:
         cache_key += "@scan"
+    # an explicit non-default compute dtype is its own column: a bf16 run
+    # must never overwrite (or replay as) the f32 row
+    bench_dtype = os.environ.get("BENCH_DTYPE")
+    if bench_dtype and bench_dtype != "auto":
+        cache_key += f"@{bench_dtype}"
 
     stub = {"metric": f"{model} (pending)", "value": None, "unit": "ms/batch",
             "vs_baseline": None}

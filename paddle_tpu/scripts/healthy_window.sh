@@ -48,6 +48,16 @@ python -m paddle_tpu.scripts.xprof_report "$ART/xprof_scan" \
     --write "$ART/xprof_scan_report" 2>> "$ART/xprof_report.log"
 log "scan-trace attribution rc=$? (fused-vs-scan comparison inputs ready)"
 
+log "phase 2c: bf16 column for the MFU-critical families"
+BENCH_DTYPE=bfloat16 BENCH_PROFILE_BASE="$ART/xprof_bf16" \
+    timeout 3600 python -m paddle_tpu.scripts.bench_sweep \
+    --combos "resnet50:256,transformer:128,lstm:64,googlenet:256" \
+    > "$ART/bench_bf16.json" 2> "$ART/bench_bf16.log"
+log "bf16 sweep rc=$? (cached under model@bsN@bfloat16)"
+python -m paddle_tpu.scripts.xprof_report "$ART/xprof_bf16" \
+    --write "$ART/xprof_bf16_report" 2>> "$ART/xprof_report.log"
+log "bf16-trace attribution rc=$?"
+
 log "phase 3: TPU differential dump + compare"
 # resumable per-case dumps; 'default' platform = the axon-routed TPU
 timeout 7200 python -m paddle_tpu.testing.tpu_diff default \
