@@ -230,6 +230,20 @@ def main(argv=None):
         from paddle_tpu.utils.logging import logger
         logger.info("comment: %s", args.comment)
 
+    # launched by scripts/launch_cluster (PADDLE_TPU_* rendezvous) or on a
+    # Cloud-TPU pod (platform fan-out; jax autodetects the coordinator):
+    # connect the multi-controller runtime BEFORE first device use — here,
+    # ahead of the config exec — or every rank would silently train an
+    # independent full copy.  Deliberately AFTER the version/merge_model
+    # early returns: those are built to answer even with a wedged backend
+    # and must never block in a rendezvous.
+    _pod_markers = ("TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES",
+                    "CLOUD_TPU_TASK_ID", "MEGASCALE_COORDINATOR_ADDRESS")
+    if os.environ.get("PADDLE_TPU_COORDINATOR") \
+            or any(k in os.environ for k in _pod_markers):
+        from paddle_tpu.parallel import distributed as dist
+        dist.init_distributed()
+
     cfg = _load_config(args.config, _parse_config_args(args.config_args))
 
     if args.job == "checkgrad":
@@ -258,6 +272,18 @@ def main(argv=None):
         mesh = make_mesh(MeshConfig(data=args.data_parallel,
                                     model=args.model_parallel,
                                     seq=args.seq_parallel))
+    else:
+        import jax as _jax
+        if _jax.process_count() > 1:
+            # multi-process launch with no explicit parallel flags: the
+            # only sane default is data-parallel over every device in the
+            # job (a per-rank local mesh would train N independent copies)
+            from paddle_tpu.parallel import MeshConfig, make_mesh
+            mesh = make_mesh(MeshConfig(data=_jax.device_count()))
+            logger_note = (f"multi-process job: defaulting to "
+                           f"data_parallel={_jax.device_count()}")
+            from paddle_tpu.utils.logging import logger
+            logger.info(logger_note)
     optimizer = cfg.get("optimizer")
     if optimizer is None:
         # same default as the v1 settings() compat path (compat/v1.py:

@@ -22,10 +22,10 @@ from conftest import free_port
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(nproc, out_dir, worker_args=(), timeout=240, expect_rc=0,
-            load_ranks=None):
-    """Fan out nproc dist_worker ranks via the cluster launcher."""
-    os.makedirs(out_dir, exist_ok=True)
+def _launch_cmd(nproc, cmd_tail, timeout=240, expect_rc=0):
+    """Fan out any command over nproc local ranks via the cluster
+    launcher, in its OWN process group so a timeout reaps the rank
+    workers too (orphans would hold the coordinator port + CPU)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # each rank gets exactly ONE cpu device: drop the test harness's
@@ -33,11 +33,7 @@ def _launch(nproc, out_dir, worker_args=(), timeout=240, expect_rc=0,
     env["XLA_FLAGS"] = ""
     cmd = [sys.executable, "-m", "paddle_tpu.scripts.launch_cluster",
            "--local", str(nproc), "--port", str(free_port()),
-           "--workdir", _ROOT,
-           "--", sys.executable, "-m", "paddle_tpu.testing.dist_worker",
-           out_dir] + list(worker_args)
-    # own process group: a timeout must reap the rank workers too, not just
-    # the launcher (orphans would hold the coordinator port + CPU)
+           "--workdir", _ROOT, "--"] + list(cmd_tail)
     proc = subprocess.Popen(cmd, env=env, cwd=_ROOT, text=True,
                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                             start_new_session=True)
@@ -50,6 +46,16 @@ def _launch(nproc, out_dir, worker_args=(), timeout=240, expect_rc=0,
     assert proc.returncode == expect_rc, (
         f"launcher rc={proc.returncode} (wanted {expect_rc})\n"
         f"stdout:\n{stdout[-2000:]}\nstderr:\n{stderr[-2000:]}")
+
+
+def _launch(nproc, out_dir, worker_args=(), timeout=240, expect_rc=0,
+            load_ranks=None):
+    """Fan out nproc dist_worker ranks via the cluster launcher."""
+    os.makedirs(out_dir, exist_ok=True)
+    _launch_cmd(nproc,
+                [sys.executable, "-m", "paddle_tpu.testing.dist_worker",
+                 out_dir] + list(worker_args),
+                timeout=timeout, expect_rc=expect_rc)
     results = []
     for r in (range(nproc) if load_ranks is None else load_ranks):
         with open(os.path.join(out_dir, f"rank{r}.json")) as f:
@@ -221,3 +227,58 @@ def test_trainer_sparse_multiprocess_matches_single(tmp_path):
                                                   rel=1e-5)
     # and it learned
     assert two[0]["loss"] < 0.95 * two[0]["first_loss"]
+
+
+def test_cli_train_under_launcher(tmp_path):
+    """The full user story: launch_cluster fans out `paddle_tpu train`
+    ranks; the CLI detects the rendezvous env, connects jax.distributed,
+    defaults to data-parallel over the job's devices, and the coordinator
+    writes the checkpoint.  Final params must match a single-process run
+    of the same config."""
+    conf = tmp_path / "conf.py"
+    conf.write_text(
+        "import numpy as np\n"
+        "import paddle_tpu.layers as L\n"
+        "from paddle_tpu import optim\n"
+        "from paddle_tpu.data import dense_vector, integer_value\n"
+        "from paddle_tpu.data import reader as reader_mod\n"
+        "def _samples():\n"
+        "    rng = np.random.RandomState(0)\n"
+        "    for _ in range(128):\n"
+        "        v = rng.randn(8).astype(np.float32)\n"
+        "        yield v, int(v[:3].sum() > 0)\n"
+        "def get_config():\n"
+        "    x = L.data_layer('x', size=8)\n"
+        "    lbl = L.data_layer('lbl', size=2)\n"
+        "    h = L.fc_layer(x, size=16, act='tanh')\n"
+        "    out = L.fc_layer(h, size=2, act='softmax')\n"
+        "    return {'cost': L.classification_cost(out, lbl),\n"
+        "            'optimizer': optim.Momentum(learning_rate=0.1,\n"
+        "                                        momentum=0.0),\n"
+        "            'train_reader': reader_mod.batch(_samples, 32),\n"
+        "            'batch_size': 32,\n"
+        "            'feeding': {'x': dense_vector(8),\n"
+        "                        'lbl': integer_value(2)}}\n")
+
+    def run(nproc, save):
+        _launch_cmd(nproc,
+                    [sys.executable, "-m", "paddle_tpu.trainer.cli",
+                     "train", "--config", str(conf), "--num_passes", "2",
+                     "--log_period", "0", "--save_dir", save],
+                    timeout=300)
+
+    run(2, str(tmp_path / "ck2"))
+    run(1, str(tmp_path / "ck1"))
+    import jax
+    from paddle_tpu.trainer.checkpoint import load_checkpoint
+    p2, _, _, _ = load_checkpoint(str(tmp_path / "ck2"))
+    p1, _, _, _ = load_checkpoint(str(tmp_path / "ck1"))
+    flat1 = {jax.tree_util.keystr(k): v
+             for k, v in jax.tree_util.tree_leaves_with_path(p1)}
+    n = 0
+    for k, v in jax.tree_util.tree_leaves_with_path(p2):
+        np.testing.assert_allclose(
+            np.asarray(v), np.asarray(flat1[jax.tree_util.keystr(k)]),
+            rtol=1e-5, atol=1e-6, err_msg=jax.tree_util.keystr(k))
+        n += 1
+    assert n >= 2
