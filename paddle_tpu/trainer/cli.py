@@ -237,10 +237,14 @@ def main(argv=None):
     # independent full copy.  Deliberately AFTER the version/merge_model
     # early returns: those are built to answer even with a wedged backend
     # and must never block in a rendezvous.
-    _pod_markers = ("TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES",
-                    "CLOUD_TPU_TASK_ID", "MEGASCALE_COORDINATOR_ADDRESS")
-    if os.environ.get("PADDLE_TPU_COORDINATOR") \
-            or any(k in os.environ for k in _pod_markers):
+    # pod detection must require MULTIPLE workers: single-host TPU images
+    # (incl. this repo's axon tunnel) set TPU_WORKER_HOSTNAMES=localhost
+    # via sitecustomize, and a 1-host rendezvous would add latency for
+    # nothing
+    _hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    _multihost_pod = ("," in _hostnames
+                      or "MEGASCALE_COORDINATOR_ADDRESS" in os.environ)
+    if os.environ.get("PADDLE_TPU_COORDINATOR") or _multihost_pod:
         from paddle_tpu.parallel import distributed as dist
         dist.init_distributed()
 
