@@ -25,6 +25,16 @@ from paddle_tpu._platform import \
 
 _machines = {}
 _next_id = [1]
+_id_lock = threading.Lock()   # handle allocation under concurrent C threads
+
+
+def _alloc_id():
+    with _id_lock:
+        nid = _next_id[0]
+        _next_id[0] += 1
+        return nid
+
+
 # per-thread error slot: concurrent C threads (pt_capi_clone pattern) must
 # each read their OWN failure, not the last one process-wide
 _tls = threading.local()
@@ -63,8 +73,7 @@ def create(config_path, params_path):
                 "<LayerOutput>` or `__outputs__ = [...]`)")
         params, model_state, _meta = load_merged(params_path)
         inf = Inferencer(outs, params, model_state)
-        mid = _next_id[0]
-        _next_id[0] += 1
+        mid = _alloc_id()
         _machines[mid] = {"inf": inf, "feed": {}, "outs": None}
         return mid
     except Exception as e:  # noqa: BLE001 - crosses the C ABI
@@ -80,8 +89,7 @@ def create_exported(path):
         _honor_jax_platforms_env()
         from paddle_tpu.export import load_inference
         run_fn = load_inference(path)
-        mid = _next_id[0]
-        _next_id[0] += 1
+        mid = _alloc_id()
         _machines[mid] = {"call": run_fn, "feed": {}, "outs": None}
         return mid
     except Exception as e:  # noqa: BLE001 - crosses the C ABI
@@ -135,8 +143,7 @@ def clone_shared(mid):
     try:
         m = _machines[mid]
         engine = {k: m[k] for k in ("inf", "call") if k in m}
-        nid = _next_id[0]
-        _next_id[0] += 1
+        nid = _alloc_id()
         _machines[nid] = dict(engine, feed={}, outs=None)
         return nid
     except Exception as e:

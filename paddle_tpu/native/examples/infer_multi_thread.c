@@ -15,6 +15,7 @@
 #include <pthread.h>
 #include <stdio.h>
 #include <stdlib.h>
+#include <string.h>
 
 #include "paddle_tpu_capi.h"
 
@@ -26,6 +27,8 @@ typedef struct {
   float input[IN_DIM];        /* last-iteration input            */
   float prob[OUT_DIM];        /* last-iteration output           */
   int failed;
+  char err[512];              /* last_error is thread-local: snapshot it
+                                 on the failing thread, not in main */
 } thread_ctx;
 
 static void fill_input(float* dst, int tid, int iter) {
@@ -42,6 +45,7 @@ static void* thread_main(void* p) {
             0 ||
         pt_capi_run(ctx->handle) < 1 ||
         pt_capi_get_output(ctx->handle, 0, ctx->prob, OUT_DIM) != OUT_DIM) {
+      snprintf(ctx->err, sizeof(ctx->err), "%s", pt_capi_last_error());
       ctx->failed = 1;
       return NULL;
     }
@@ -70,6 +74,7 @@ int main(int argc, char** argv) {
   for (int i = 0; i < NUM_THREAD; ++i) {
     ctx[i].tid = i;
     ctx[i].failed = 0;
+    ctx[i].err[0] = 0;
     ctx[i].handle = pt_capi_clone(m);
     if (ctx[i].handle < 0) {
       fprintf(stderr, "clone failed: %s\n", pt_capi_last_error());
@@ -84,7 +89,7 @@ int main(int argc, char** argv) {
   int rc = 0;
   for (int i = 0; i < NUM_THREAD; ++i) {
     if (ctx[i].failed) {
-      fprintf(stderr, "thread %d failed: %s\n", i, pt_capi_last_error());
+      fprintf(stderr, "thread %d failed: %s\n", i, ctx[i].err);
       rc = 1;
       continue;
     }

@@ -43,7 +43,12 @@ def build_ssh_cmd(host, rank, args, command):
     env = rendezvous_env(args.hosts[0], args.port, len(args.hosts), rank)
     env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
     remote = f"cd {shlex.quote(args.workdir)} && {env_str} {command}"
-    return ["ssh", "-o", "BatchMode=yes", host, remote]
+    # -tt: force a remote tty so killing the LOCAL ssh client (fail-fast,
+    # ^C) delivers SIGHUP to the remote rank — without it the remote
+    # python would survive the teardown blocked in a collective, holding
+    # the coordinator port (the reference launcher killed jobs over ssh
+    # for the same reason, paddle.py:52-60)
+    return ["ssh", "-tt", "-o", "BatchMode=yes", host, remote]
 
 
 def wait_fail_fast(procs, poll_s=0.2):
