@@ -19,7 +19,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 
 _FAMILY_ORDER = ["lstm256", "lstm", "lstm1280", "smallnet", "alexnet",
                  "googlenet", "resnet50", "seq2seq", "transformer",
-                 "transformer_decode"]
+                 "transformer_decode", "transformer_serving"]
 
 
 def _fmt_mfu(e):
@@ -60,8 +60,8 @@ def scaling_table(cache):
         m = re.search(r"@bs(\d+)", k)
         return (k.split("@")[0], int(m.group(1)) if m else 0)
 
-    rows = sorted((k for k in cache if "@bs" in k and "@scan" not in k),
-                  key=key)
+    rows = sorted((k for k in cache if "@bs" in k and "@scan" not in k
+                   and "@bfloat16" not in k), key=key)
     if not rows:
         return "(no scaling rows cached yet)"
     lines = ["| run | TPU ms | MFU | tokens/s | remat | measured |",
@@ -76,6 +76,27 @@ def scaling_table(cache):
     return "\n".join(lines)
 
 
+def bf16_table(cache):
+    """f32-vs-bf16 pairs (phase 2c rows cache under key@bfloat16)."""
+    pairs = []
+    for k, e in cache.items():
+        if k.endswith("@bfloat16") and e.get("value") is not None:
+            base = cache.get(k[:-len("@bfloat16")])
+            if base and base.get("value") is not None:
+                pairs.append((k[:-len("@bfloat16")], base, e))
+    if not pairs:
+        return "(no f32-vs-bf16 pairs cached yet)"
+    lines = ["| run | f32 ms | bf16 ms | bf16 speedup | bf16 MFU | "
+             "measured |",
+             "|---|---|---|---|---|---|"]
+    for name, f32, b in sorted(pairs):
+        lines.append(
+            f"| {name} | {f32['value']} | {b['value']} | "
+            f"{f32['value'] / b['value']:.2f}x | {_fmt_mfu(b)} | "
+            f"{_stamp(b)} |")
+    return "\n".join(lines)
+
+
 def kernel_table(cache):
     pairs = []
     for k, e in cache.items():
@@ -85,12 +106,18 @@ def kernel_table(cache):
                 pairs.append((k[:-len("@scan")], fused, e))
     if not pairs:
         return "(no fused-vs-scan pairs cached yet)"
-    lines = ["| model | fused ms | scan ms | kernel speedup | measured |",
-             "|---|---|---|---|---|"]
+    lines = ["| model | fused ms | scan ms | kernel speedup | path | "
+             "measured |",
+             "|---|---|---|---|---|---|"]
     for name, fused, scan in sorted(pairs):
+        # fused_rnn False on the "fused" row means the dispatcher actually
+        # ran the scan (fallback/guard) — flag it rather than implying a
+        # kernel win
+        path = "kernel" if fused.get("fused_rnn", True) else "scan (!)"
         lines.append(
             f"| {name} | {fused['value']} | {scan['value']} | "
-            f"{scan['value'] / fused['value']:.2f}× | {_stamp(fused)} |")
+            f"{scan['value'] / fused['value']:.2f}x | {path} | "
+            f"{_stamp(fused)} |")
     return "\n".join(lines)
 
 
@@ -105,6 +132,8 @@ def main(argv=None):
     print(families_table(cache))
     print("\n## TPU scaling column\n")
     print(scaling_table(cache))
+    print("\n## f32 vs bf16 compute (mixed precision)\n")
+    print(bf16_table(cache))
     print("\n## Fused Pallas RNN kernels vs lax.scan\n")
     print(kernel_table(cache))
 

@@ -95,13 +95,21 @@ def test_perf_report_renders_tables(tmp_path, capsys):
     from paddle_tpu.scripts import perf_report
     cache = {
         "lstm": {"metric": "LSTM h=512 bs=64", "value": 5.0,
-                 "vs_baseline": 36.8, "mfu": 0.13,
+                 "vs_baseline": 36.8, "mfu": 0.13, "fused_rnn": True,
                  "measured_at": "2026-07-30T05:00:00Z"},
         "lstm@scan": {"metric": "LSTM h=512 bs=64", "value": 15.0,
                       "measured_at": "2026-07-30T05:00:00Z"},
+        "lstm1280": {"metric": "LSTM h=1280 bs=64", "value": 18.0,
+                     "vs_baseline": 35.6, "fused_rnn": False,
+                     "measured_at": "2026-07-30T05:00:00Z"},
+        "lstm1280@scan": {"metric": "LSTM h=1280 bs=64", "value": 18.0,
+                          "measured_at": "2026-07-30T05:00:00Z"},
         "resnet50@bs512": {"metric": "ResNet-50 bs=512", "value": 99.0,
                            "mfu": 0.4, "remat": True,
                            "measured_at": "2026-07-30T06:00:00Z"},
+        "resnet50@bs512@bfloat16": {"metric": "ResNet-50 bs=512",
+                                    "value": 55.0, "mfu": 0.6,
+                                    "measured_at": "2026-07-30T07:00:00Z"},
     }
     path = tmp_path / "cache.json"
     path.write_text(json.dumps(cache))
@@ -109,7 +117,12 @@ def test_perf_report_renders_tables(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "| lstm | 64 | 184.0 | 5.0 | 36.8× | 13.0% |" in out
     assert "| resnet50@bs512 | 99.0 | 40.0% | — | yes |" in out
-    assert "| lstm | 5.0 | 15.0 | 3.00× |" in out
+    # bf16 rows leave the scaling table and pair into their own table
+    assert "resnet50@bs512@bfloat16" not in out.split("f32 vs bf16")[0]
+    assert "| resnet50@bs512 | 99.0 | 55.0 | 1.80x | 60.0% |" in out
+    assert "| lstm | 5.0 | 15.0 | 3.00x | kernel |" in out
+    # a dispatch that actually ran the scan is flagged, not sold as a win
+    assert "| lstm1280 | 18.0 | 18.0 | 1.00x | scan (!) |" in out
 
 
 def test_transformer_serving_bench_buckets(bench):
