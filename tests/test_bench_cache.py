@@ -117,12 +117,14 @@ def test_perf_report_renders_tables(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "| lstm | 64 | 184.0 | 5.0 | 36.8× | 13.0% |" in out
     assert "| resnet50@bs512 | 99.0 | 40.0% | — | yes |" in out
-    # bf16 rows leave the scaling table and pair into their own table
-    assert "resnet50@bs512@bfloat16" not in out.split("f32 vs bf16")[0]
-    assert "| resnet50@bs512 | 99.0 | 55.0 | 1.80x | 60.0% |" in out
-    assert "| lstm | 5.0 | 15.0 | 3.00x | kernel |" in out
+    # bf16 rows leave the scaling table and pair into their own table;
+    # the baseline is honestly labelled auto (the bare TPU row runs the
+    # auto bf16-MXU policy) unless an explicit @float32 row exists
+    assert "resnet50@bs512@bfloat16" not in out.split("Mixed-precision")[0]
+    assert "| resnet50@bs512 | auto | 99.0 | 55.0 | 1.80× | 60.0% |" in out
+    assert "| lstm | 5.0 | 15.0 | 3.00× | kernel |" in out
     # a dispatch that actually ran the scan is flagged, not sold as a win
-    assert "| lstm1280 | 18.0 | 18.0 | 1.00x | scan (!) |" in out
+    assert "| lstm1280 | 18.0 | 18.0 | 1.00× | scan (!) |" in out
 
 
 def test_transformer_serving_bench_buckets(bench):
