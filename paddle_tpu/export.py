@@ -23,6 +23,7 @@ multi-platform artifact.
 """
 
 import jax
+import jax.numpy as jnp
 from jax import export as _jx
 
 from paddle_tpu.core.sequence import NestedSequenceBatch, SequenceBatch
@@ -48,16 +49,57 @@ def _as_aval(v):
     return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
 
 
+def quantize_params(params, min_size=1024):
+    """Weight-only symmetric int8 quantization with per-output-channel
+    scales (last axis): float32 leaves with >= min_size elements become
+    (int8, f32 scale) pairs; small leaves (biases, norms) stay f32 —
+    their bytes are noise and their precision is not.
+
+    TPU rationale: serving is usually HBM-bandwidth-bound on the weight
+    stream; int8 storage quarters it (and the artifact size).  The
+    dequant (convert + scale multiply) fuses into the consuming matmul's
+    read under XLA, so compute stays bf16/f32 on the MXU.
+
+    Returns (qtree, dequant) where dequant(qtree) rebuilds a float
+    params pytree; both halves are jit-traceable."""
+    import numpy as np
+
+    def q(x):
+        if getattr(x, "dtype", None) != jnp.float32 \
+                or np.prod(np.shape(x)) < min_size:
+            return x
+        axes = tuple(range(x.ndim - 1)) if x.ndim > 1 else (0,)
+        s = jnp.max(jnp.abs(x), axis=axes, keepdims=True) / 127.0
+        s = jnp.maximum(s, 1e-12)
+        return {"__int8__": jnp.clip(jnp.round(x / s), -127, 127)
+                            .astype(jnp.int8),
+                "__scale__": s.astype(jnp.float32)}
+
+    def is_q(leaf):
+        return isinstance(leaf, dict) and "__int8__" in leaf
+
+    def dequant(tree):
+        return jax.tree_util.tree_map(
+            lambda l: (l["__int8__"].astype(jnp.float32) * l["__scale__"])
+            if is_q(l) else l,
+            tree, is_leaf=is_q)
+
+    qtree = jax.tree_util.tree_map(q, params)
+    return qtree, dequant
+
+
 def export_inference(output_layer, parameters, feed_spec, path=None,
-                     model_state=None, platforms=None):
+                     model_state=None, platforms=None, quantize=None):
     """Lower test-mode inference of `output_layer` (or a list of outputs)
     to StableHLO with `parameters` embedded as constants.
 
     feed_spec: {data_layer_name: example array | ShapeDtypeStruct |
     SequenceBatch thereof} — fixes the exported input shapes (TPU serving
     wants static shapes; export one artifact per bucket for ragged input).
-    Returns the jax.export.Exported; with `path`, also writes the
-    serialized bytes there."""
+    quantize="int8" bakes weight-only int8 constants + fused dequant into
+    the artifact (~4x smaller, ~4x less weight-stream HBM; see
+    quantize_params).  Returns the jax.export.Exported; with `path`, also
+    writes the serialized bytes there."""
     outs = list(output_layer) if isinstance(output_layer, (list, tuple)) \
         else [output_layer]
     topo = Topology(outs)
@@ -74,8 +116,17 @@ def export_inference(output_layer, parameters, feed_spec, path=None,
                 "trainer.model_state for a trained model.",
                 ", ".join(sorted(state)))
 
-    def fwd(feed):
-        return topo.apply(parameters, feed, mode="test", state=state)
+    if quantize is None:
+        def fwd(feed):
+            return topo.apply(parameters, feed, mode="test", state=state)
+    elif quantize == "int8":
+        qparams, dequant = quantize_params(parameters)
+
+        def fwd(feed):
+            return topo.apply(dequant(qparams), feed, mode="test",
+                              state=state)
+    else:
+        raise ValueError(f"quantize={quantize!r} (supported: None, 'int8')")
 
     spec = {k: jax.tree_util.tree_map(_as_aval, v)
             for k, v in feed_spec.items()}
