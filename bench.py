@@ -20,7 +20,9 @@ Env overrides: BENCH_MODEL=lstm|lstm256|lstm1280|resnet50|alexnet|googlenet|
 smallnet|seq2seq|transformer|transformer_decode (seq2seq/transformer report
 tokens/sec — the reference never shipped an NMT row and predates
 transformers; transformer_decode times the KV-cached serving beam search),
-BENCH_STEPS, BENCH_BATCH, BENCH_INIT_TIMEOUT, BENCH_COMPILE_TIMEOUT,
+BENCH_STEPS, BENCH_BATCH, BENCH_INIT_TIMEOUT, BENCH_BUILD_TIMEOUT (eager
+param init; wider default since each distinct shape compiles through the
+tunnel), BENCH_COMPILE_TIMEOUT,
 BENCH_STEP_TIMEOUT (seconds), BENCH_PEAK_TFLOPS (override peak),
 BENCH_PLATFORM (e.g. cpu to force a platform for local testing), and
 BENCH_PROFILE_DIR (capture an xprof trace of the timed steps).
@@ -674,6 +676,11 @@ def main():
         model = "smoke_kernels"
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     t_init = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
+    # build runs eager param init: every distinct shape is its own XLA
+    # compile, and through the axon tunnel those are ~seconds each (the
+    # r4 window saw lstm's init alone blow a 240 s deadline), so build
+    # gets a wider default than the wedge-probe init phase
+    t_build = float(os.environ.get("BENCH_BUILD_TIMEOUT", "900"))
     t_compile = float(os.environ.get("BENCH_COMPILE_TIMEOUT", "600"))
     t_steps = float(os.environ.get("BENCH_STEP_TIMEOUT", "600"))
     if os.environ.get("BENCH_PLATFORM"):
@@ -737,7 +744,7 @@ def main():
         return
 
     # -- phase 2: build model + inputs (host-side) --
-    dog.phase("build", t_init)
+    dog.phase("build", t_build)
     try:
         built = factory(batch)
         run, flops, baseline_ms, metric = built[:4]

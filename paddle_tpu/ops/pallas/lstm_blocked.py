@@ -80,9 +80,9 @@ def _fwd_kernel(xs_ref, wr_ref, chk_ref, mask_ref,
         h_prev = prev_ref[:]                           # full [B, D]
         a, i, f, o, c_new, h_new = _cell_block(
             x4, h_prev, wblk, ci, cf, co, c_prev)
-        # h_prev is a VALUE (full scratch read); pl.ds only indexes refs
-        h_prev_blk = jax.lax.dynamic_slice_in_dim(h_prev, j * blk, blk,
-                                                  axis=1)
+        # block read straight off the ref: Mosaic lowers dynamic slices
+        # on REFS but not the dynamic_slice primitive on values
+        h_prev_blk = prev_ref[:, pl.ds(j * blk, blk)]
         h_out = m * h_new + (1.0 - m) * h_prev_blk
         c_out = m * c_new + (1.0 - m) * c_prev
         new_ref[:, pl.ds(j * blk, blk)] = h_out

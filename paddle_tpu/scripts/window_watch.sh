@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Chip prober + auto-trigger: loop a short real-matmul probe against the
+# tunneled TPU; the moment it answers, hand off to healthy_window.sh.
+# Run detached from round start so no healthy minute is wasted waiting
+# for a human (round-3 verdict: "keep a prober running from minute zero").
+#
+#   bash paddle_tpu/scripts/window_watch.sh [artifacts_dir]
+#
+# Log: /tmp/window_watch.log (probe timeline), plus healthy_window's own
+# logs once triggered.  A wedge AFTER the handoff is healthy_window's
+# problem (its phases are resumable); this script does not re-trigger —
+# re-launch it for another window.
+set -u
+cd "$(dirname "$0")/../.."
+ART="${1:-$PWD/artifacts/r4}"
+LOG=/tmp/window_watch.log
+probe() {
+    timeout 75 python - <<'EOF' >/dev/null 2>&1
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+assert float((x @ x).block_until_ready()[0, 0]) == 256.0
+assert jax.default_backend() == "tpu"
+EOF
+}
+echo "[watch $(date -u +%H:%M:%S)] prober up (pid $$)" >> "$LOG"
+while true; do
+    if probe; then
+        echo "[watch $(date -u +%H:%M:%S)] chip ANSWERED — launching healthy_window" >> "$LOG"
+        exec bash paddle_tpu/scripts/healthy_window.sh "$ART"
+    fi
+    echo "[watch $(date -u +%H:%M:%S)] wedged" >> "$LOG"
+    sleep 150
+done
