@@ -6,7 +6,11 @@ with jax.sharding over a named Mesh (SURVEY.md §2.6 'TPU-native equivalent').
 
 from paddle_tpu.parallel.mesh import (
     Mesh, MeshConfig, make_mesh, single_device_mesh, AXIS_DATA, AXIS_MODEL,
-    AXIS_SEQ, AXIS_EXPERT, ALL_AXES,
+    AXIS_SEQ, AXIS_EXPERT, AXIS_STAGE, ALL_AXES,
+)
+from paddle_tpu.parallel.pipeline import (
+    gpipe, stack_stages, unstack_stages, stage_spec, microbatch,
+    unmicrobatch,
 )
 from paddle_tpu.parallel.sharding import (
     ShardingRules, megatron_rules, param_shardings, shard_params,
@@ -18,7 +22,10 @@ from paddle_tpu.parallel.distributed import (
 
 __all__ = [
     "Mesh", "MeshConfig", "make_mesh", "single_device_mesh",
-    "AXIS_DATA", "AXIS_MODEL", "AXIS_SEQ", "AXIS_EXPERT", "ALL_AXES",
+    "AXIS_DATA", "AXIS_MODEL", "AXIS_SEQ", "AXIS_EXPERT", "AXIS_STAGE",
+    "ALL_AXES",
+    "gpipe", "stack_stages", "unstack_stages", "stage_spec", "microbatch",
+    "unmicrobatch",
     "ShardingRules", "megatron_rules", "param_shardings", "shard_params",
     "batch_shardings", "replicated_shardings", "valid_spec",
     "init_distributed", "is_coordinator", "global_mesh", "barrier",

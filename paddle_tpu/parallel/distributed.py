@@ -79,7 +79,7 @@ def global_mesh(config: Optional[MeshConfig] = None,
         n = jax.device_count()
         shape = config.resolve(n)
         ici_shape = (shape[0] // dcn_data_parallel,) + shape[1:]
-        dcn_shape = (dcn_data_parallel, 1, 1, 1)
+        dcn_shape = (dcn_data_parallel,) + (1,) * (len(ALL_AXES) - 1)
         devices = mesh_utils.create_hybrid_device_mesh(
             ici_shape, dcn_shape)
         return Mesh(devices, ALL_AXES)

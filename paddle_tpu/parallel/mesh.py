@@ -28,7 +28,12 @@ AXIS_DATA = "data"
 AXIS_MODEL = "model"
 AXIS_SEQ = "seq"
 AXIS_EXPERT = "expert"
-ALL_AXES = (AXIS_DATA, AXIS_SEQ, AXIS_EXPERT, AXIS_MODEL)
+AXIS_STAGE = "stage"
+# 'stage' sits between data and the intra-layer axes: its ppermute
+# traffic is one activation per microbatch tick — lighter than model/seq
+# collectives (keep those innermost on ICI) but heavier than the data
+# allreduce (which may cross DCN)
+ALL_AXES = (AXIS_DATA, AXIS_STAGE, AXIS_SEQ, AXIS_EXPERT, AXIS_MODEL)
 
 
 @dataclasses.dataclass
@@ -37,15 +42,16 @@ class MeshConfig:
     model: int = 1
     seq: int = 1
     expert: int = 1
+    stage: int = 1       # pipeline stages (parallel/pipeline.py)
 
     def resolve(self, n_devices):
-        fixed = self.model * self.seq * self.expert
+        fixed = self.model * self.seq * self.expert * self.stage
         data = self.data or max(1, n_devices // fixed)
         if data * fixed != n_devices:
             raise ValueError(
-                f"mesh {data}x{self.seq}x{self.expert}x{self.model} != "
-                f"{n_devices} devices")
-        return (data, self.seq, self.expert, self.model)
+                f"mesh {data}x{self.stage}x{self.seq}x{self.expert}x"
+                f"{self.model} != {n_devices} devices")
+        return (data, self.stage, self.seq, self.expert, self.model)
 
 
 def make_mesh(config: Optional[MeshConfig] = None, devices=None) -> Mesh:
@@ -57,7 +63,8 @@ def make_mesh(config: Optional[MeshConfig] = None, devices=None) -> Mesh:
 
 
 def single_device_mesh() -> Mesh:
-    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1), ALL_AXES)
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(
+        (1,) * len(ALL_AXES)), ALL_AXES)
 
 
 def batch_spec(seq_sharded=False) -> P:

@@ -1,0 +1,136 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over the
+'stage' mesh axis.
+
+The reference's closest ancestor is ParallelNeuralNetwork's `device=N`
+layer placement (gserver/gradientmachines/ParallelNeuralNetwork.cpp:15-60:
+per-device worker threads execute layers as dependencies become ready,
+synchronized by per-Argument condition variables).  The TPU-native redesign
+replaces ready-queues and condvars with a *static* schedule compiled into
+one SPMD program: each device owns one stage's parameters (pytree leading
+axis sharded over 'stage'), microbatches tick through a `lax.scan`, and the
+stage-to-stage activation handoff is a `lax.ppermute` ring shift on ICI.
+
+Backward needs no code: `ppermute` and `scan` are differentiable, so
+`jax.grad` of a pipelined forward IS the reverse pipeline schedule,
+bubbles and all (the transpose of a forward rotation is the backward
+rotation).  Use `remat=True` to rematerialize each stage block instead of
+saving every tick's activations.
+
+Schedule: plain GPipe fill-and-drain — T = M + S - 1 ticks for M
+microbatches over S stages; bubble fraction (S-1)/T shrinks as M grows.
+Stage 0 feeds microbatch t at tick t; the last stage emits microbatch m at
+tick m + S - 1; outputs are collected from the stacked per-stage scan
+output outside the shard_map.
+
+Constraint (inherent to homogeneous pipelining): every stage maps
+activations of one shape to the same shape.  Wrap unequal first/last
+blocks (embedding in, logits out) outside the pipelined middle.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.parallel.mesh import AXIS_STAGE
+
+
+def stack_stages(params_list):
+    """Stack S per-stage parameter pytrees into one pytree with a leading
+    stage axis (shard it over 'stage' via `stage_spec`)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *params_list)
+
+
+def unstack_stages(stacked):
+    """Inverse of stack_stages (host-side convenience)."""
+    n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    return [jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+            for i in range(n)]
+
+
+def stage_spec(stacked_params):
+    """PartitionSpec pytree: leading axis over 'stage', rest replicated."""
+    return jax.tree_util.tree_map(lambda _: P(AXIS_STAGE), stacked_params)
+
+
+def gpipe(stage_fn, stacked_params, x_mb, *, mesh: Mesh,
+          axis_name: str = AXIS_STAGE, data_axis: str = None,
+          remat: bool = False):
+    """Run `stage_fn` as a pipeline over `axis_name`.
+
+    stage_fn: (stage_params, x) -> y with y.shape == x.shape (pytrees of
+        arrays allowed for x/y as long as shapes match across stages).
+    stacked_params: pytree with leading stage axis [S, ...], sharded over
+        `axis_name` (see `stage_spec`).
+    x_mb: [M, mb, ...] microbatched input, replicated over `axis_name`
+        (shard the mb dim over `data_axis` for pp x dp).
+    Returns [M, mb, ...] last-stage outputs, sharded like x_mb.
+    """
+    s = mesh.shape[axis_name]
+    n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_stages != s:
+        raise ValueError(
+            f"{n_stages} stacked stages but mesh '{axis_name}' axis has "
+            f"size {s}; one device must own exactly one stage")
+    m = jax.tree_util.tree_leaves(x_mb)[0].shape[0]
+    nticks = m + s - 1
+    fn = stage_fn
+    if remat:
+        fn = jax.checkpoint(stage_fn)
+
+    def local_fn(p_l, x_l):
+        # p_l: [1, ...] stage slice; x_l: [M, mb, ...] (stage-replicated)
+        p_my = jax.tree_util.tree_map(lambda a: a[0], p_l)
+        stage_id = jax.lax.axis_index(axis_name)
+        zero = jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape[1:], a.dtype), x_l)
+        perm = [(j, (j + 1) % s) for j in range(s)]
+
+        def tick(carry, t):
+            # carry: my previous tick's output, about to move one stage up
+            recv = jax.lax.ppermute(carry, axis_name, perm)
+            feed = jax.tree_util.tree_map(
+                lambda a: a[jnp.minimum(t, m - 1)], x_l)
+            x_in = jax.tree_util.tree_map(
+                lambda f, r: jnp.where(stage_id == 0, f, r), feed, recv)
+            out = fn(p_my, x_in)
+            return out, out
+
+        _, outs = jax.lax.scan(tick, zero, jnp.arange(nticks))
+        # emit every tick's output with a leading singleton stage axis;
+        # stacked over 'stage' outside, the caller slices the last stage's
+        # drain ticks — no cross-stage collective needed
+        return jax.tree_util.tree_map(lambda a: a[None], outs)
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    xspec = jax.tree_util.tree_map(
+        lambda _: P(None, data_axis) if data_axis else P(), x_mb)
+    ospec = jax.tree_util.tree_map(
+        lambda _: (P(axis_name, None, data_axis) if data_axis
+                   else P(axis_name)), x_mb)
+    run = jax.shard_map(local_fn, mesh=mesh, in_specs=(pspec, xspec),
+                        out_specs=ospec, check_vma=False)
+    stacked = run(stacked_params, x_mb)     # [S, T, mb, ...]
+    # last stage (index S-1) drains microbatch i at tick i + S - 1
+    return jax.tree_util.tree_map(
+        lambda a: a[s - 1, s - 1:s - 1 + m], stacked)
+
+
+def microbatch(x, num_microbatches):
+    """[B, ...] -> [M, B/M, ...] (B % M == 0)."""
+    def split(a):
+        b = a.shape[0]
+        if b % num_microbatches:
+            raise ValueError(
+                f"batch {b} not divisible by {num_microbatches} microbatches")
+        return a.reshape((num_microbatches, b // num_microbatches)
+                         + a.shape[1:])
+    return jax.tree_util.tree_map(split, x)
+
+
+def unmicrobatch(x_mb):
+    """[M, mb, ...] -> [M*mb, ...]."""
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]), x_mb)

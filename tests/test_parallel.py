@@ -22,7 +22,7 @@ needs_8 = pytest.mark.skipif(len(jax.devices()) < 8,
 def test_mesh_shapes():
     mesh = make_mesh(MeshConfig(data=4, model=2))
     assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
-        "data": 4, "seq": 1, "expert": 1, "model": 2}
+        "data": 4, "stage": 1, "seq": 1, "expert": 1, "model": 2}
 
 
 def test_valid_spec_fallback():

@@ -1,0 +1,136 @@
+"""Pipeline parallelism (parallel/pipeline.py): the GPipe schedule over the
+'stage' mesh axis must reproduce the sequential stack — forward AND grads
+(the backward schedule is autodiff's transpose of the forward rotation) —
+including combined pipeline x data parallelism and remat.
+
+The reference's analog is ParallelNeuralNetwork's device= placement
+(ParallelNeuralNetwork.cpp:15-60); the equivalence oracle is the same
+config-pair discipline as test_NetworkCompare.cpp."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel import (MeshConfig, make_mesh)
+from paddle_tpu.parallel.pipeline import (
+    gpipe, stack_stages, unstack_stages, stage_spec, microbatch,
+    unmicrobatch)
+
+S, D = 4, 16
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _mk_params(rng):
+    return [{"w": jnp.asarray(rng.randn(D, D) * 0.4, jnp.float32),
+             "b": jnp.asarray(rng.randn(D) * 0.1, jnp.float32)}
+            for _ in range(S)]
+
+
+def _sequential(params_list, x):
+    for p in params_list:
+        x = _stage_fn(p, x)
+    return x
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(MeshConfig(data=2, stage=S))
+
+
+def test_forward_matches_sequential(np_rng, mesh):
+    params = _mk_params(np_rng)
+    stacked = stack_stages(params)
+    x = jnp.asarray(np_rng.randn(24, D), jnp.float32)
+    x_mb = microbatch(x, 6)
+    got = unmicrobatch(gpipe(_stage_fn, stacked, x_mb, mesh=mesh))
+    want = _sequential(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_single_microbatch_and_unstack(np_rng, mesh):
+    params = _mk_params(np_rng)
+    stacked = stack_stages(params)
+    x = jnp.asarray(np_rng.randn(1, 8, D), jnp.float32)   # M=1 degenerate
+    got = gpipe(_stage_fn, stacked, x, mesh=mesh)
+    want = _sequential(params, x[0])
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want),
+                               atol=1e-5)
+    back = unstack_stages(stacked)
+    for a, b in zip(back, params):
+        np.testing.assert_array_equal(np.asarray(a["w"]), np.asarray(b["w"]))
+
+
+@pytest.mark.parametrize("remat", [False, True], ids=["plain", "remat"])
+def test_grads_match_sequential(np_rng, mesh, remat):
+    params = _mk_params(np_rng)
+    stacked = stack_stages(params)
+    x = jnp.asarray(np_rng.randn(16, D), jnp.float32)
+    tgt = jnp.asarray(np_rng.randn(16, D), jnp.float32)
+
+    def loss_pipe(sp):
+        y = unmicrobatch(gpipe(_stage_fn, sp, microbatch(x, 4), mesh=mesh,
+                               remat=remat))
+        return jnp.mean((y - tgt) ** 2)
+
+    def loss_seq(plist):
+        return jnp.mean((_sequential(plist, x) - tgt) ** 2)
+
+    gp = jax.grad(loss_pipe)(stacked)
+    gs = stack_stages(jax.grad(loss_seq)(params))
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(gp[k]), np.asarray(gs[k]),
+                                   atol=1e-5)
+
+
+def test_pp_times_dp(np_rng, mesh):
+    """Microbatch dim sharded over 'data' while stages pipeline."""
+    params = _mk_params(np_rng)
+    stacked = stack_stages(params)
+    x = jnp.asarray(np_rng.randn(32, D), jnp.float32)
+    x_mb = microbatch(x, 4)                       # [4, 8, D], 8 % data=2 == 0
+    got = unmicrobatch(gpipe(_stage_fn, stacked, x_mb, mesh=mesh,
+                             data_axis="data"))
+    want = _sequential(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_trains(np_rng, mesh):
+    """A few pipelined SGD steps reduce the loss (end-to-end schedule +
+    backward under jit)."""
+    params = _mk_params(np_rng)
+    stacked = stack_stages(params)
+    x = jnp.asarray(np_rng.randn(16, D), jnp.float32)
+    tgt = jnp.tanh(jnp.asarray(np_rng.randn(16, D), jnp.float32))
+
+    @jax.jit
+    def step(sp):
+        def loss(sp):
+            y = unmicrobatch(gpipe(_stage_fn, sp, microbatch(x, 4),
+                                   mesh=mesh))
+            return jnp.mean((y - tgt) ** 2)
+        l, g = jax.value_and_grad(loss)(sp)
+        return jax.tree_util.tree_map(lambda p, gg: p - 0.2 * gg, sp, g), l
+
+    first = None
+    for _ in range(30):
+        stacked, l = step(stacked)
+        first = first if first is not None else float(l)
+    assert float(l) < 0.6 * first, (first, float(l))
+
+
+def test_bad_microbatch_raises():
+    with pytest.raises(ValueError, match="not divisible"):
+        microbatch(jnp.zeros((10, D)), 3)
+
+
+def test_stage_count_mismatch_raises(np_rng, mesh):
+    params = _mk_params(np_rng)[:2]               # 2 stages, mesh has 4
+    with pytest.raises(ValueError, match="stacked stages"):
+        gpipe(_stage_fn, stack_stages(params),
+              microbatch(jnp.zeros((8, D)), 2), mesh=mesh)
