@@ -639,6 +639,28 @@ _BENCHES = {
 }
 
 
+def cache_key_for(model, batch=None):
+    """The bench_cache.json row key a run of (model, batch) under the
+    current env will read/write.  Scaling points cache under model@bsN so
+    they coexist with the default-batch headline row; a fused-RNN-disabled
+    run is the scan BASELINE column (@scan); an explicit non-default
+    compute dtype is its own column (@bfloat16) so it never overwrites or
+    replays as the f32 row.  Shared with scripts/bench_sweep.py so the
+    sweep can skip combos already measured live at this revision."""
+    if model == "smoke_kernels":
+        return model
+    default_batch = _BENCHES[model][1]
+    batch = int(batch if batch is not None
+                else os.environ.get("BENCH_BATCH", str(default_batch or 0)))
+    key = model if batch == default_batch else f"{model}@bs{batch}"
+    if _fused_rnn_disabled() and model in _RNN_MODELS:
+        key += "@scan"
+    bench_dtype = os.environ.get("BENCH_DTYPE")
+    if bench_dtype and bench_dtype != "auto":
+        key += f"@{bench_dtype}"
+    return key
+
+
 def smoke_kernels(dog, stub, model):
     """Compile + numerics-check every Pallas kernel on the live backend.
     Fast (small shapes, one compile each) — the Mosaic-regression canary the
@@ -698,19 +720,7 @@ def main():
     else:
         factory, default_batch = _BENCHES[model]
     batch = int(os.environ.get("BENCH_BATCH", str(default_batch or 0)))
-    # scaling-sweep runs cache under their own key so e.g. resnet50@bs256
-    # coexists with the default-batch headline row
-    cache_key = model if batch == default_batch else f"{model}@bs{batch}"
-    # an explicitly-disabled fused-RNN run is the SCAN BASELINE for the
-    # vs-scan kernel column — its own cache row, never overwriting the
-    # fused number (both env spellings, matching ops/rnn.py's dispatch)
-    if _fused_rnn_disabled() and model in _RNN_MODELS:
-        cache_key += "@scan"
-    # an explicit non-default compute dtype is its own column: a bf16 run
-    # must never overwrite (or replay as) the f32 row
-    bench_dtype = os.environ.get("BENCH_DTYPE")
-    if bench_dtype and bench_dtype != "auto":
-        cache_key += f"@{bench_dtype}"
+    cache_key = cache_key_for(model, batch)
 
     stub = {"metric": f"{model} (pending)", "value": None, "unit": "ms/batch",
             "vs_baseline": None}

@@ -16,13 +16,49 @@ plus the TPU scaling points.
 """
 
 import argparse
+import calendar
 import json
 import os
 import subprocess
 import sys
+import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+def _fresh_live_row(model, batch, max_age_s, cache_path=None):
+    """Return the bench_cache.json row for this combo if it was measured
+    LIVE at the current code revision within max_age_s — i.e. re-running it
+    would spend healthy-window time reproducing a number we already have.
+    Conservative: any parse/import/revision mismatch means 'not fresh'."""
+    if max_age_s <= 0:
+        return None
+    if os.environ.get("BENCH_PLATFORM") == "cpu":
+        # a cpu sweep must never report the committed TPU rows as its own
+        return None
+    try:
+        if _REPO not in sys.path:
+            sys.path.insert(0, _REPO)
+        import bench
+        from paddle_tpu.utils.revision import code_revision
+        key = bench.cache_key_for(model, batch)
+        cache_path = cache_path or os.path.join(_REPO, "bench_cache.json")
+        with open(cache_path) as f:
+            row = json.load(f).get(key)
+        if not row or row.get("value") is None:
+            return None
+        if row.get("platform") == "cpu":
+            # a BENCH_CACHE_CPU row must not suppress the live TPU run
+            return None
+        rev = code_revision()
+        if "+" in rev or rev == "unknown" or row.get("revision") != rev:
+            return None
+        age = time.time() - calendar.timegm(
+            time.strptime(row["measured_at"], "%Y-%m-%dT%H:%M:%SZ"))
+        return row if 0 <= age <= max_age_s else None
+    except Exception:   # noqa: BLE001
+        return None
 
 DEFAULT_COMBOS = [
     # BASELINE.md reference points
@@ -68,6 +104,13 @@ def main(argv=None):
     ap.add_argument("--timeout", type=float, default=1500)
     args = ap.parse_args(argv)
 
+    try:
+        skip_fresh_s = float(os.environ.get("BENCH_SWEEP_SKIP_FRESH_S", "0"))
+    except ValueError:
+        print("[sweep] bad BENCH_SWEEP_SKIP_FRESH_S (want seconds) — "
+              "skip-fresh disabled", file=sys.stderr)
+        skip_fresh_s = 0.0
+
     results = {}
     for combo in args.combos.split(","):
         combo = combo.strip()
@@ -80,6 +123,19 @@ def main(argv=None):
             results[combo] = {"error": "bad_combo"}
             continue
         batch = int(batch)
+        # incremental across wedge-interrupted windows: a combo measured
+        # live at this exact revision recently enough doesn't get re-run
+        # (BENCH_SWEEP_SKIP_FRESH_S=0, the default, disables this)
+        fresh = _fresh_live_row(model, batch, skip_fresh_s)
+        if fresh is not None:
+            row = {k: fresh.get(k) for k in
+                   ("value", "unit", "vs_baseline", "mfu", "tokens_per_s")}
+            row.update(error=None, cached=True, skipped_fresh=True)
+            results[combo] = row
+            print(f"[sweep] {combo}: fresh at this revision "
+                  f"({fresh.get('measured_at')}) — skipping",
+                  file=sys.stderr, flush=True)
+            continue
         print(f"[sweep] {model} bs={batch} ...", file=sys.stderr, flush=True)
         try:
             r = run_combo(model, batch, args.steps, args.timeout)
@@ -116,11 +172,19 @@ def main(argv=None):
     # live one
     live_ok = sum(1 for r in results.values()
                   if r.get("value") is not None and not r.get("error")
-                  and not r.get("live_error"))
+                  and not r.get("live_error")
+                  and not r.get("skipped_fresh"))
     replays = sum(1 for r in results.values() if r.get("live_error"))
+    skipped = sum(1 for r in results.values() if r.get("skipped_fresh"))
     if live_ok:
         return 0
-    return 4 if replays else 2
+    if replays:
+        # a skipped-fresh prefix must not hide that THIS window wedged
+        return 4
+    if skipped and skipped == len(results):
+        # nothing to do: every combo already measured live at this revision
+        return 0
+    return 2
 
 
 if __name__ == "__main__":

@@ -16,6 +16,10 @@ set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
 export PADDLE_TPU_BENCH_STRICT_RC=1
+# windows are short and wedge-prone: when the watcher relaunches this
+# script, combos already measured live at this revision within a day are
+# not re-paid (bench_sweep skip-fresh)
+export BENCH_SWEEP_SKIP_FRESH_S="${BENCH_SWEEP_SKIP_FRESH_S:-86400}"
 # every bench.py combo is a fresh subprocess; a shared persistent XLA
 # compile cache means only the FIRST run of each program pays the
 # tunnel-slow compile (the r4 window lost its first combo to exactly

@@ -140,3 +140,77 @@ def test_transformer_serving_bench_buckets(bench):
     import numpy as np
     s = run(0)
     assert np.isfinite(float(s))
+
+
+def test_cache_key_for(bench, monkeypatch):
+    monkeypatch.delenv("BENCH_DTYPE", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_FUSED_RNN", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_FUSED_LSTM", raising=False)
+    assert bench.cache_key_for("lstm", 64) == "lstm"          # default bs
+    assert bench.cache_key_for("lstm", 256) == "lstm@bs256"
+    assert bench.cache_key_for("smoke_kernels") == "smoke_kernels"
+    monkeypatch.setenv("PADDLE_TPU_FUSED_RNN", "0")
+    assert bench.cache_key_for("lstm", 64) == "lstm@scan"
+    assert bench.cache_key_for("alexnet", 64) == "alexnet"    # not an RNN
+    monkeypatch.setenv("BENCH_DTYPE", "bfloat16")
+    assert bench.cache_key_for("alexnet", 64) == "alexnet@bfloat16"
+    assert bench.cache_key_for("lstm", 256) == "lstm@bs256@scan@bfloat16"
+
+
+def test_sweep_skip_fresh(tmp_path, monkeypatch):
+    """bench_sweep only skips combos whose cache row is live-at-this-exact-
+    revision and recent; anything else (old, other revision, dirty tree,
+    missing) re-runs."""
+    import time as _time
+    from paddle_tpu.scripts import bench_sweep as sw
+    from paddle_tpu.utils import revision as rev_mod
+
+    monkeypatch.delenv("BENCH_DTYPE", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_FUSED_RNN", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_FUSED_LSTM", raising=False)
+    monkeypatch.delenv("BENCH_PLATFORM", raising=False)
+    now = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+    old = "2020-01-01T00:00:00Z"
+    cache = {
+        "lstm": {"value": 5.0, "unit": "ms/batch", "revision": "abc123",
+                 "measured_at": now},
+        "alexnet": {"value": 9.0, "unit": "ms/batch", "revision": "abc123",
+                    "measured_at": old},
+        "googlenet": {"value": 7.0, "unit": "ms/batch",
+                      "revision": "OTHER", "measured_at": now},
+    }
+    p = tmp_path / "bench_cache.json"
+    p.write_text(json.dumps(cache))
+
+    monkeypatch.setattr(rev_mod, "code_revision", lambda: "abc123")
+    assert sw._fresh_live_row("lstm", 64, 3600, str(p))["value"] == 5.0
+    assert sw._fresh_live_row("alexnet", 64, 3600, str(p)) is None   # old
+    assert sw._fresh_live_row("googlenet", 64, 3600, str(p)) is None # rev
+    assert sw._fresh_live_row("resnet50", 32, 3600, str(p)) is None  # none
+    assert sw._fresh_live_row("lstm", 64, 0, str(p)) is None         # off
+    monkeypatch.setattr(rev_mod, "code_revision", lambda: "abc123+dirty1")
+    assert sw._fresh_live_row("lstm", 64, 3600, str(p)) is None      # dirty
+
+
+def test_sweep_skip_fresh_platform_guards(tmp_path, monkeypatch):
+    """CPU rows never satisfy freshness; a cpu-forced sweep never skips."""
+    import time as _time
+    from paddle_tpu.scripts import bench_sweep as sw
+    from paddle_tpu.utils import revision as rev_mod
+
+    monkeypatch.delenv("BENCH_DTYPE", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_FUSED_RNN", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_FUSED_LSTM", raising=False)
+    monkeypatch.delenv("BENCH_PLATFORM", raising=False)
+    now = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+    p = tmp_path / "bench_cache.json"
+    p.write_text(json.dumps({
+        "lstm": {"value": 5.0, "revision": "abc123", "measured_at": now,
+                 "platform": "cpu"},
+        "alexnet": {"value": 9.0, "revision": "abc123", "measured_at": now,
+                    "platform": "tpu"}}))
+    monkeypatch.setattr(rev_mod, "code_revision", lambda: "abc123")
+    assert sw._fresh_live_row("lstm", 64, 3600, str(p)) is None
+    assert sw._fresh_live_row("alexnet", 64, 3600, str(p)) is not None
+    monkeypatch.setenv("BENCH_PLATFORM", "cpu")
+    assert sw._fresh_live_row("alexnet", 64, 3600, str(p)) is None
