@@ -624,6 +624,11 @@ def bench_transformer_serving(batch=16, n_requests=64, src_max=128,
 _BENCHES = {
     # name: (factory, default_batch)
     "transformer": (lambda b: bench_transformer(batch=b), 32),
+    # long-context row: 8k tokens/sequence through the Pallas flash
+    # kernel (O(T) memory — the materialized [T,T] softmax at this shape
+    # would be 256 MB/head-batch); proves the long-context plane on chip
+    "transformer_long": (lambda b: bench_transformer(batch=b,
+                                                     seq_len=8192), 2),
     "transformer_decode": (lambda b: bench_transformer_decode(batch=b), 32),
     "transformer_serving": (lambda b: bench_transformer_serving(batch=b), 16),
     "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),

@@ -69,6 +69,7 @@ DEFAULT_COMBOS = [
     "googlenet:256", "googlenet:512",
     "lstm1280:256",
     "transformer:32", "transformer:128",          # 128*256 = 32768 tok
+    "transformer_long:2",                         # 8k-token sequences
     "transformer_decode:32",                      # KV-cached serving path
     "transformer_serving:16",                     # bucketed-length stream
     "seq2seq:64",

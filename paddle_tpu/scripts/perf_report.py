@@ -19,7 +19,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 
 _FAMILY_ORDER = ["lstm256", "lstm", "lstm1280", "smallnet", "alexnet",
                  "googlenet", "resnet50", "seq2seq", "transformer",
-                 "transformer_decode", "transformer_serving"]
+                 "transformer_long", "transformer_decode",
+                 "transformer_serving"]
 
 
 def _fmt_mfu(e):
