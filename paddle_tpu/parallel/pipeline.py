@@ -57,7 +57,7 @@ def stage_spec(stacked_params):
 
 def gpipe(stage_fn, stacked_params, x_mb, *, mesh: Mesh,
           axis_name: str = AXIS_STAGE, data_axis: str = None,
-          remat: bool = False):
+          remat: bool = False, param_specs=None):
     """Run `stage_fn` as a pipeline over `axis_name`.
 
     stage_fn: (stage_params, x) -> y with y.shape == x.shape (pytrees of
@@ -66,6 +66,10 @@ def gpipe(stage_fn, stacked_params, x_mb, *, mesh: Mesh,
         `axis_name` (see `stage_spec`).
     x_mb: [M, mb, ...] microbatched input, replicated over `axis_name`
         (shard the mb dim over `data_axis` for pp x dp).
+    param_specs: optional PartitionSpec pytree for stacked_params whose
+        leading dim is `axis_name` — use to tensor-shard each stage's
+        weights over further axes (pp x tp); stage_fn is then responsible
+        for the matching collectives (e.g. a megatron psum over 'model').
     Returns [M, mb, ...] last-stage outputs, sharded like x_mb.
     """
     s = mesh.shape[axis_name]
@@ -104,7 +108,16 @@ def gpipe(stage_fn, stacked_params, x_mb, *, mesh: Mesh,
         # drain ticks — no cross-stage collective needed
         return jax.tree_util.tree_map(lambda a: a[None], outs)
 
-    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params)
+    if param_specs is None:
+        pspec = jax.tree_util.tree_map(lambda _: P(axis_name),
+                                       stacked_params)
+    else:
+        pspec = param_specs
+        for p in jax.tree_util.tree_leaves(
+                pspec, is_leaf=lambda x: isinstance(x, P)):
+            if not p or p[0] != axis_name:
+                raise ValueError(
+                    f"param_specs leading dim must be {axis_name!r}, got {p}")
     xspec = jax.tree_util.tree_map(
         lambda _: P(None, data_axis) if data_axis else P(), x_mb)
     ospec = jax.tree_util.tree_map(

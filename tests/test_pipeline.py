@@ -134,3 +134,58 @@ def test_stage_count_mismatch_raises(np_rng, mesh):
     with pytest.raises(ValueError, match="stacked stages"):
         gpipe(_stage_fn, stack_stages(params),
               microbatch(jnp.zeros((8, D)), 2), mesh=mesh)
+
+
+def test_pp_times_tp_times_dp(np_rng):
+    """3D: megatron-sharded MLP blocks (tp over 'model') inside pipeline
+    stages (pp over 'stage') on data-sharded microbatches (dp)."""
+    from jax.sharding import PartitionSpec as P
+    mesh3 = make_mesh(MeshConfig(data=2, stage=2, model=2))
+    F = 32
+    params = [{"w1": jnp.asarray(np_rng.randn(D, F) * 0.3, jnp.float32),
+               "w2": jnp.asarray(np_rng.randn(F, D) * 0.3, jnp.float32),
+               "b": jnp.asarray(np_rng.randn(D) * 0.1, jnp.float32)}
+              for _ in range(2)]
+    stacked = stack_stages(params)
+    specs = {"w1": P("stage", None, "model"),   # column-parallel
+             "w2": P("stage", "model", None),   # row-parallel
+             "b": P("stage")}
+
+    def block(p, x):
+        h = jax.nn.relu(x @ p["w1"])            # local [mb, F/tp]
+        part = h @ p["w2"]                      # partial sum
+        return x + jax.lax.psum(part, "model") + p["b"]
+
+    def block_seq(p, x):
+        return x + jax.nn.relu(x @ p["w1"]) @ p["w2"] + p["b"]
+
+    x = jnp.asarray(np_rng.randn(16, D), jnp.float32)
+
+    def loss_pipe(sp):
+        y = unmicrobatch(gpipe(block, sp, microbatch(x, 4), mesh=mesh3,
+                               data_axis="data", param_specs=specs))
+        return jnp.mean(y ** 2)
+
+    def loss_seq(plist):
+        h = x
+        for p in plist:
+            h = block_seq(p, h)
+        return jnp.mean(h ** 2)
+
+    got = loss_pipe(stacked)
+    want = loss_seq(params)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+    gp = jax.grad(loss_pipe)(stacked)
+    gs = stack_stages(jax.grad(loss_seq)(params))
+    for k in ("w1", "w2", "b"):
+        np.testing.assert_allclose(np.asarray(gp[k]), np.asarray(gs[k]),
+                                   atol=2e-5)
+
+
+def test_param_specs_wrong_leading_dim_raises(np_rng, mesh):
+    from jax.sharding import PartitionSpec as P
+    params = _mk_params(np_rng)
+    with pytest.raises(ValueError, match="leading dim"):
+        gpipe(_stage_fn, stack_stages(params),
+              microbatch(jnp.zeros((8, D)), 2), mesh=mesh,
+              param_specs={"w": P("model"), "b": P("model")})
