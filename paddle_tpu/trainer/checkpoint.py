@@ -11,13 +11,55 @@ layout keeps the reference's pass-%05d convention so --start_pass resume
 works the same way.
 """
 
+import atexit
 import json
 import os
 import shutil
+import tempfile
+import threading
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+# single-flight async writer PER SAVE DIR: at most one background save in
+# flight per directory; a failure surfaces on that directory's next
+# save/wait instead of dying silently, and independent trainers saving to
+# different dirs never serialize on (or crash from) each other
+_pending = {}        # realpath(save_dir) -> Thread
+_pending_exc = {}    # realpath(save_dir) -> BaseException
+_pending_lock = threading.Lock()
+
+
+def wait_pending(save_dir=None):
+    """Block until in-flight async saves have landed — for one directory,
+    or all of them when save_dir is None — and re-raise their failure
+    here (the caller's next sync point) if they had one."""
+    with _pending_lock:
+        if save_dir is None:
+            keys = list(_pending) + [k for k in _pending_exc
+                                     if k not in _pending]
+        else:
+            keys = [os.path.realpath(save_dir)]
+        threads = [(_pending.get(k), k) for k in keys]
+    first_exc = None
+    for t, k in threads:
+        if t is not None:
+            t.join()
+        with _pending_lock:
+            exc = _pending_exc.pop(k, None)
+            _pending.pop(k, None)
+        if exc is not None and first_exc is None:
+            first_exc = exc
+    if first_exc is not None:
+        raise first_exc
+
+
+# interpreter shutdown kills daemon threads AFTER atexit callbacks run, so
+# this makes every scheduled async save land (or report its failure) even
+# when an exception unwinds straight out of the train loop — the crash
+# case checkpoints exist for
+atexit.register(wait_pending)
 
 
 def _flatten(tree, prefix=""):
@@ -61,27 +103,78 @@ def _unflatten(flat):
 
 
 def save_checkpoint(save_dir, pass_id, params, opt_state=None, model_state=None,
-                    extra=None, save_only_one=False):
-    """Write output/pass-%05d/{params,opt_state,model_state}.npz + meta."""
-    path = os.path.join(save_dir, f"pass-{pass_id:05d}")
-    os.makedirs(path, exist_ok=True)
-    params = jax.device_get(params)
-    np.savez(os.path.join(path, "params.npz"), **_flatten(params))
-    if opt_state is not None:
-        np.savez(os.path.join(path, "opt_state.npz"),
-                 **_flatten(jax.device_get(opt_state)))
-    if model_state is not None:
-        np.savez(os.path.join(path, "model_state.npz"),
-                 **_flatten(jax.device_get(model_state)))
+                    extra=None, save_only_one=False, block=True):
+    """Write output/pass-%05d/{params,opt_state,model_state}.npz + meta.
+
+    Crash-atomic: everything lands in a hidden .tmp- dir first and is
+    renamed into place, so a crash mid-save can never leave a partial
+    pass dir for load_checkpoint's latest-pass pick to trip on.
+
+    block=False: the device->host snapshot still happens NOW (the values
+    written are this exact pass), but the disk write runs on a background
+    thread so the train loop overlaps I/O with the next pass.  Single
+    flight — a new async save first joins the previous one; call
+    wait_pending() before reading the checkpoint back or exiting."""
+    final = os.path.join(save_dir, f"pass-{pass_id:05d}")
+    host_params = jax.device_get(params)
+    host_opt = jax.device_get(opt_state) if opt_state is not None else None
+    host_mstate = (jax.device_get(model_state)
+                   if model_state is not None else None)
     meta = {"pass_id": pass_id, "format_version": 1}
     meta.update(extra or {})
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(meta, f)
-    if save_only_one:
-        for name in os.listdir(save_dir):
-            if name.startswith("pass-") and name != f"pass-{pass_id:05d}":
-                shutil.rmtree(os.path.join(save_dir, name), ignore_errors=True)
-    return path
+
+    def write():
+        os.makedirs(save_dir, exist_ok=True)
+        tmp = tempfile.mkdtemp(prefix=f".tmp-pass-{pass_id:05d}-",
+                               dir=save_dir)
+        # mkdtemp makes 0700; inherit the parent's perms so renamed pass
+        # dirs stay readable by whatever can read save_dir (as makedirs
+        # used to give)
+        os.chmod(tmp, os.stat(save_dir).st_mode & 0o777)
+        try:
+            np.savez(os.path.join(tmp, "params.npz"), **_flatten(host_params))
+            if host_opt is not None:
+                np.savez(os.path.join(tmp, "opt_state.npz"),
+                         **_flatten(host_opt))
+            if host_mstate is not None:
+                np.savez(os.path.join(tmp, "model_state.npz"),
+                         **_flatten(host_mstate))
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump(meta, f)
+            if os.path.isdir(final):
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        if save_only_one:
+            for name in os.listdir(save_dir):
+                if (name.startswith("pass-")
+                        and name != f"pass-{pass_id:05d}"):
+                    shutil.rmtree(os.path.join(save_dir, name),
+                                  ignore_errors=True)
+
+    key = os.path.realpath(save_dir)
+    if block:
+        wait_pending(save_dir)   # don't interleave with an async predecessor
+        write()
+        return final
+
+    wait_pending(save_dir)
+
+    def run():
+        try:
+            write()
+        except BaseException as e:   # surfaces at the next wait_pending
+            with _pending_lock:
+                _pending_exc[key] = e
+
+    t = threading.Thread(target=run, daemon=True,
+                         name=f"ckpt-save-{pass_id}")
+    with _pending_lock:
+        _pending[key] = t
+    t.start()
+    return final
 
 
 def _load_npz(path):

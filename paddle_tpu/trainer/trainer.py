@@ -498,11 +498,20 @@ class SGD:
                 tc = self.test(test_reader, feeding=feeder)
                 event_handler(events.EndTesting(pass_id, tc))
             if save_dir and (pass_id + 1) % saving_period == 0:
+                # single-process saves overlap the disk write with the
+                # next pass (the snapshot itself is taken synchronously);
+                # multi-process stays blocking for the barrier guarantee
                 path = self.save(save_dir, pass_id,
-                                 save_only_one=save_only_one)
+                                 save_only_one=save_only_one,
+                                 block=self._multiprocess)
                 if path:
-                    logger.info("saved checkpoint %s", path)
+                    # async schedule is not persistence yet; don't claim it
+                    logger.info("saved checkpoint %s" if self._multiprocess
+                                else "saving checkpoint %s (async)", path)
             event_handler(events.EndPass(pass_id))
+        if save_dir:
+            from paddle_tpu.trainer import checkpoint as _ckpt
+            _ckpt.wait_pending()    # durability before train() returns
 
     # ------------------------------------------------------------ test
 
@@ -540,9 +549,10 @@ class SGD:
 
     # ------------------------------------------------------------ io
 
-    def save(self, save_dir, pass_id=0, save_only_one=False):
+    def save(self, save_dir, pass_id=0, save_only_one=False, block=True):
         params, opt_state = self.parameters, self.opt_state
         if self._multiprocess:
+            block = True    # the barrier promise needs the file on disk
             # model-sharded leaves are not process-0-addressable: gather to
             # replicated (a jitted identity re-sharding), then only the
             # coordinator writes; everyone waits so a crash right after
@@ -555,7 +565,7 @@ class SGD:
                 return None
         path = save_checkpoint(save_dir, pass_id, params,
                                opt_state, self.model_state,
-                               save_only_one=save_only_one)
+                               save_only_one=save_only_one, block=block)
         if self._multiprocess:
             from paddle_tpu.parallel import barrier
             barrier(f"save{pass_id}")

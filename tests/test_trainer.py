@@ -211,3 +211,78 @@ def test_bf16_compute_dtype_trains_with_f32_master(np_rng):
     assert np.asarray(probs).dtype == np.float32
     pred = np.argmax(np.asarray(probs), axis=-1)
     np.testing.assert_array_equal(pred, [0, 1])
+
+
+def test_checkpoint_async_and_atomic(tmp_path, monkeypatch):
+    """block=False overlaps the disk write; wait_pending() makes it
+    durable and re-raises background failures; a failed write never
+    leaves a partial pass dir behind (tmp-dir + rename atomicity)."""
+    from paddle_tpu.trainer import checkpoint as ck
+
+    params = {"w": jnp.arange(4, dtype=jnp.float32)}
+    p = save_checkpoint(str(tmp_path), 0, params, block=False)
+    ck.wait_pending()
+    assert os.path.isdir(p)
+    got, _, _, meta = load_checkpoint(str(tmp_path))
+    np.testing.assert_allclose(np.asarray(got["w"]), np.arange(4.0))
+    assert meta["pass_id"] == 0
+
+    # async values are the snapshot at call time, not at write time
+    mutable = {"w": jnp.zeros((2,))}
+    save_checkpoint(str(tmp_path), 1, mutable, block=False)
+    mutable["w"] = jnp.ones((2,))          # mutate AFTER the call
+    ck.wait_pending()
+    got, _, _, _ = load_checkpoint(str(tmp_path), 1)
+    np.testing.assert_allclose(np.asarray(got["w"]), 0.0)
+
+    # failure path: np.savez raising leaves no partial pass dir and the
+    # error surfaces at wait_pending
+    import pytest
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+    monkeypatch.setattr(ck.np, "savez", boom)
+    save_checkpoint(str(tmp_path), 2, params, block=False)
+    with pytest.raises(OSError, match="disk full"):
+        ck.wait_pending()
+    assert not os.path.exists(tmp_path / "pass-00002")
+    assert not [d for d in os.listdir(tmp_path) if d.startswith(".tmp-")]
+    monkeypatch.undo()
+    # a later blocking save still works (pending state fully cleared)
+    save_checkpoint(str(tmp_path), 3, params)
+    assert os.path.isdir(tmp_path / "pass-00003")
+
+
+def test_checkpoint_overwrite_same_pass(tmp_path):
+    """Re-saving the same pass id atomically replaces the old dir."""
+    save_checkpoint(str(tmp_path), 0, {"w": jnp.zeros((2,))})
+    save_checkpoint(str(tmp_path), 0, {"w": jnp.ones((2,))})
+    got, _, _, _ = load_checkpoint(str(tmp_path), 0)
+    np.testing.assert_allclose(np.asarray(got["w"]), 1.0)
+
+
+def test_checkpoint_pending_is_per_dir(tmp_path, monkeypatch):
+    """Async saves to different dirs are independent: one dir's failure
+    never surfaces in (or serializes with) another dir's save."""
+    import pytest
+    from paddle_tpu.trainer import checkpoint as ck
+
+    a, b = tmp_path / "a", tmp_path / "b"
+    params = {"w": jnp.ones((2,))}
+    real_savez = ck.np.savez
+
+    def boom_in_a(path, **kw):
+        if os.sep + "a" + os.sep in path or "/a/" in path:
+            raise OSError("quota on a")
+        return real_savez(path, **kw)
+    monkeypatch.setattr(ck.np, "savez", boom_in_a)
+
+    save_checkpoint(str(a), 0, params, block=False)
+    # b's save must neither raise a's error nor be blocked by it
+    save_checkpoint(str(b), 0, params, block=False)
+    ck.wait_pending(str(b))                    # b lands cleanly
+    got, _, _, _ = load_checkpoint(str(b), 0)
+    np.testing.assert_allclose(np.asarray(got["w"]), 1.0)
+    with pytest.raises(OSError, match="quota on a"):
+        ck.wait_pending(str(a))                # a's failure stays a's
+    ck.wait_pending()                          # global drain is clean now
