@@ -141,9 +141,20 @@ def save_checkpoint(save_dir, pass_id, params, opt_state=None, model_state=None,
                          **_flatten(host_mstate))
             with open(os.path.join(tmp, "meta.json"), "w") as f:
                 json.dump(meta, f)
+            old = None
             if os.path.isdir(final):
-                shutil.rmtree(final)
+                # rename the predecessor aside (microseconds) instead of
+                # rmtree-ing it first (arbitrarily long): the only window
+                # with no pass dir is between the two renames, and
+                # load_checkpoint falls back to .old- dirs for exactly
+                # that window
+                old = tempfile.mkdtemp(prefix=f".old-pass-{pass_id:05d}-",
+                                       dir=save_dir)
+                os.rmdir(old)
+                os.rename(final, old)
             os.rename(tmp, final)
+            if old is not None:
+                shutil.rmtree(old, ignore_errors=True)
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
@@ -190,6 +201,13 @@ def load_checkpoint(save_dir, pass_id=None):
     (params, opt_state, model_state, meta)."""
     if pass_id is None:
         passes = sorted(n for n in os.listdir(save_dir) if n.startswith("pass-"))
+        if not passes:
+            # crash window during an overwrite-save: the predecessor was
+            # renamed aside but the replacement didn't land — recover it
+            passes = sorted(n for n in os.listdir(save_dir)
+                            if n.startswith(".old-pass-")
+                            and os.path.exists(
+                                os.path.join(save_dir, n, "meta.json")))
         if not passes:
             raise FileNotFoundError(f"no pass-* checkpoints in {save_dir}")
         path = os.path.join(save_dir, passes[-1])

@@ -396,12 +396,39 @@ class SGD:
     def train(self, reader, num_passes=1, event_handler=None, feeding=None,
               save_dir=None, saving_period=1, save_only_one=False,
               test_reader=None, test_period=0, log_period=100,
-              buffered_batches=4, show_parameter_stats_period=0):
+              buffered_batches=4, show_parameter_stats_period=0,
+              save_on_signal=True):
         """reader: callable -> iterator of batches (lists of samples).
-        feeding: {data_layer_name: InputType} or a DataFeeder."""
+        feeding: {data_layer_name: InputType} or a DataFeeder.
+
+        save_on_signal: when save_dir is set and train() runs on the main
+        thread, SIGTERM requests a graceful stop — the loop finishes the
+        current batch, writes a checkpoint (meta carries preempted=true
+        and the interrupted pass), and returns instead of dying mid-pass.
+        That is the TPU-preemption story: the maintenance event's TERM
+        becomes a resumable pass boundary (reference recovery was
+        checkpoint/restart only, Trainer.cpp:245-249)."""
         event_handler = event_handler or (lambda e: None)
         feeder = feeding if isinstance(feeding, DataFeeder) else (
             DataFeeder(feeding) if feeding else None)
+
+        self._stop_signal = None
+        prev_handler = None
+        # single-process only: in multi-process SPMD, acting on a local
+        # signal would diverge the ranks mid-collective (skewed delivery)
+        # — there the launcher's fail-fast SIGTERM + pass-checkpoint
+        # resume is the recovery path
+        if save_on_signal and save_dir and not self._multiprocess:
+            import signal as _signal
+
+            def _request_stop(signum, frame):
+                self._stop_signal = signum
+                logger.info("SIGTERM: finishing current batch, then "
+                            "checkpointing to %s", save_dir)
+            try:
+                prev_handler = _signal.signal(_signal.SIGTERM, _request_stop)
+            except ValueError:      # not the main thread — feature off
+                prev_handler = None
 
         def resolve(slot, extras, feed):
             kind, key = slot
@@ -426,92 +453,115 @@ class SGD:
                                  else f"{spec.name}={r}")
             return (" Eval: " + " ".join(parts)) if parts else ""
 
-        for pass_id in range(num_passes):
-            event_handler(events.BeginPass(pass_id))
-            for spec in self.evaluators:
-                spec.reset()
-            batch_reader = reader
-            if buffered_batches:
-                batch_reader = reader_mod.buffered(reader, buffered_batches)
-            # running device-side sums: no host sync in the hot loop —
-            # cost only crosses to the host every log_period (and for the
-            # event stream, whose .cost is the device scalar; float() it
-            # lazily in your handler if you need the number immediately)
-            cost_sum = jnp.zeros(())
-            if self._multiprocess:
-                # keep the accumulator global-replicated so per-step
-                # arithmetic stays on-device (no host sync in the hot loop)
-                cost_sum = self._globalize(
-                    cost_sum, replicated_shardings(cost_sum, self.mesh))
-            n_batches = 0
-            window = []
-            t0 = time.time()
-            for batch_id, batch in enumerate(batch_reader()):
-                feed = _normalize_feed(feeder(batch) if feeder
-                                       else batch)
-                event_handler(events.BeginIteration(pass_id, batch_id))
-                self.rng, step_rng = jax.random.split(self.rng)
-                if self._step_fn is None:
-                    self._build_step(feed)
-                feed, step_rng = self._globalize_step_inputs(feed, step_rng)
-                t_step = time.perf_counter()
-                with timer("train_step"):
-                    (self.parameters, self.opt_state, self.model_state,
-                     cost, extras) = self._step_fn(
-                        self.parameters, self.opt_state, self.model_state,
-                        feed, step_rng)
-                # per-step distribution (BarrierStat skew-profiling role):
-                # record this step's own delta, not the cumulative timer
+        try:
+            for pass_id in range(num_passes):
+                event_handler(events.BeginPass(pass_id))
+                for spec in self.evaluators:
+                    spec.reset()
+                batch_reader = reader
+                if buffered_batches:
+                    batch_reader = reader_mod.buffered(reader, buffered_batches)
+                # running device-side sums: no host sync in the hot loop —
+                # cost only crosses to the host every log_period (and for the
+                # event stream, whose .cost is the device scalar; float() it
+                # lazily in your handler if you need the number immediately)
+                cost_sum = jnp.zeros(())
+                if self._multiprocess:
+                    # keep the accumulator global-replicated so per-step
+                    # arithmetic stays on-device (no host sync in the hot loop)
+                    cost_sum = self._globalize(
+                        cost_sum, replicated_shardings(cost_sum, self.mesh))
+                n_batches = 0
+                window = []
+                t0 = time.time()
+                for batch_id, batch in enumerate(batch_reader()):
+                    feed = _normalize_feed(feeder(batch) if feeder
+                                           else batch)
+                    event_handler(events.BeginIteration(pass_id, batch_id))
+                    self.rng, step_rng = jax.random.split(self.rng)
+                    if self._step_fn is None:
+                        self._build_step(feed)
+                    feed, step_rng = self._globalize_step_inputs(feed, step_rng)
+                    t_step = time.perf_counter()
+                    with timer("train_step"):
+                        (self.parameters, self.opt_state, self.model_state,
+                         cost, extras) = self._step_fn(
+                            self.parameters, self.opt_state, self.model_state,
+                            feed, step_rng)
+                    # per-step distribution (BarrierStat skew-profiling role):
+                    # record this step's own delta, not the cumulative timer
+                    from paddle_tpu.utils.stats import step_histogram
+                    step_histogram.add(time.perf_counter() - t_step)
+                    cost_sum = cost_sum + cost
+                    n_batches += 1
+                    window.append(cost)
+                    if self.evaluators:
+                        update_evaluators(extras, feed)
+                    if log_period and (batch_id + 1) % log_period == 0:
+                        c = float(jnp.mean(jnp.stack(window)))
+                        window = []
+                        dt = (time.time() - t0) / log_period
+                        logger.info("Pass %d Batch %d Cost %.5f (%.1f ms/batch)%s",
+                                    pass_id, batch_id + 1, c, dt * 1e3,
+                                    eval_log_suffix())
+                        t0 = time.time()
+                    if (show_parameter_stats_period
+                            and (batch_id + 1) % show_parameter_stats_period == 0):
+                        self.log_parameter_stats()
+                    event_handler(events.EndIteration(
+                        pass_id, batch_id, cost=cost,
+                        evaluator_results={f"extra_{i}": e
+                                           for i, e in enumerate(extras)}))
+                    if self._stop_signal is not None:
+                        break
+                pass_cost = float(cost_sum) / n_batches if n_batches else float("nan")
+                logger.info("Pass %d done, mean cost %.5f%s", pass_id, pass_cost,
+                            eval_log_suffix())
+                # per-pass step-time distribution (the BarrierStat successor:
+                # in synchronous SPMD the skew diagnostic is p99/p50 spread)
                 from paddle_tpu.utils.stats import step_histogram
-                step_histogram.add(time.perf_counter() - t_step)
-                cost_sum = cost_sum + cost
-                n_batches += 1
-                window.append(cost)
-                if self.evaluators:
-                    update_evaluators(extras, feed)
-                if log_period and (batch_id + 1) % log_period == 0:
-                    c = float(jnp.mean(jnp.stack(window)))
-                    window = []
-                    dt = (time.time() - t0) / log_period
-                    logger.info("Pass %d Batch %d Cost %.5f (%.1f ms/batch)%s",
-                                pass_id, batch_id + 1, c, dt * 1e3,
-                                eval_log_suffix())
-                    t0 = time.time()
-                if (show_parameter_stats_period
-                        and (batch_id + 1) % show_parameter_stats_period == 0):
-                    self.log_parameter_stats()
-                event_handler(events.EndIteration(
-                    pass_id, batch_id, cost=cost,
-                    evaluator_results={f"extra_{i}": e
-                                       for i, e in enumerate(extras)}))
-            pass_cost = float(cost_sum) / n_batches if n_batches else float("nan")
-            logger.info("Pass %d done, mean cost %.5f%s", pass_id, pass_cost,
-                        eval_log_suffix())
-            # per-pass step-time distribution (the BarrierStat successor:
-            # in synchronous SPMD the skew diagnostic is p99/p50 spread)
-            from paddle_tpu.utils.stats import step_histogram
-            if step_histogram.samples:
-                logger.info("  %s", step_histogram.summary())
-                step_histogram.reset()
-            if test_reader is not None and (
-                    not test_period or (pass_id + 1) % test_period == 0):
-                tc = self.test(test_reader, feeding=feeder)
-                event_handler(events.EndTesting(pass_id, tc))
-            if save_dir and (pass_id + 1) % saving_period == 0:
-                # single-process saves overlap the disk write with the
-                # next pass (the snapshot itself is taken synchronously);
-                # multi-process stays blocking for the barrier guarantee
-                path = self.save(save_dir, pass_id,
-                                 save_only_one=save_only_one,
-                                 block=self._multiprocess)
-                if path:
-                    # async schedule is not persistence yet; don't claim it
-                    logger.info("saved checkpoint %s" if self._multiprocess
-                                else "saving checkpoint %s (async)", path)
-            event_handler(events.EndPass(pass_id))
-        if save_dir:
-            from paddle_tpu.trainer import checkpoint as _ckpt
-            _ckpt.wait_pending()    # durability before train() returns
+                if step_histogram.samples:
+                    logger.info("  %s", step_histogram.summary())
+                    step_histogram.reset()
+                if test_reader is not None and self._stop_signal is None and (
+                        not test_period or (pass_id + 1) % test_period == 0):
+                    tc = self.test(test_reader, feeding=feeder)
+                    event_handler(events.EndTesting(pass_id, tc))
+                if save_dir and self._stop_signal is not None:
+                    # preemption checkpoint: blocking (the process is about to
+                    # be reaped — there may be no later sync point)
+                    path = self.save(save_dir, pass_id,
+                                     save_only_one=save_only_one, block=True,
+                                     extra={"preempted": True,
+                                            "signal": int(self._stop_signal)})
+                    if path:
+                        logger.info("preemption checkpoint %s; stopping after "
+                                    "pass %d", path, pass_id)
+                elif save_dir and (pass_id + 1) % saving_period == 0:
+                    # single-process saves overlap the disk write with the
+                    # next pass (the snapshot itself is taken synchronously);
+                    # multi-process stays blocking for the barrier guarantee
+                    path = self.save(save_dir, pass_id,
+                                     save_only_one=save_only_one,
+                                     block=self._multiprocess)
+                    if path:
+                        # async schedule is not persistence yet; don't claim it
+                        logger.info("saved checkpoint %s" if self._multiprocess
+                                    else "saving checkpoint %s (async)", path)
+                event_handler(events.EndPass(pass_id))
+                if self._stop_signal is not None:
+                    break
+        finally:
+            # durability + handler restoration even when an exception
+            # unwinds out of the loop (a leaked handler would make the
+            # process unkillable by SIGTERM)
+            if save_dir:
+                from paddle_tpu.trainer import checkpoint as _ckpt
+                _ckpt.wait_pending()
+            if prev_handler is not None:
+                import signal as _signal
+                _signal.signal(_signal.SIGTERM, prev_handler)
+
 
     # ------------------------------------------------------------ test
 
@@ -549,7 +599,8 @@ class SGD:
 
     # ------------------------------------------------------------ io
 
-    def save(self, save_dir, pass_id=0, save_only_one=False, block=True):
+    def save(self, save_dir, pass_id=0, save_only_one=False, block=True,
+             extra=None):
         params, opt_state = self.parameters, self.opt_state
         if self._multiprocess:
             block = True    # the barrier promise needs the file on disk
@@ -564,7 +615,7 @@ class SGD:
                 barrier(f"save{pass_id}")
                 return None
         path = save_checkpoint(save_dir, pass_id, params,
-                               opt_state, self.model_state,
+                               opt_state, self.model_state, extra=extra,
                                save_only_one=save_only_one, block=block)
         if self._multiprocess:
             from paddle_tpu.parallel import barrier

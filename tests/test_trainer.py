@@ -286,3 +286,110 @@ def test_checkpoint_pending_is_per_dir(tmp_path, monkeypatch):
     with pytest.raises(OSError, match="quota on a"):
         ck.wait_pending(str(a))                # a's failure stays a's
     ck.wait_pending()                          # global drain is clean now
+
+
+def test_sigterm_graceful_checkpoint(tmp_path):
+    """A real SIGTERM mid-pass: the loop finishes the batch, writes a
+    preemption checkpoint (meta.preempted=true), and train() returns
+    cleanly — the TPU-preemption recovery story."""
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+    import time as _time
+
+    script = textwrap.dedent("""
+        import os, sys, time
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax; jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        sys.path.insert(0, %r)
+        import paddle_tpu.layers as L
+        from paddle_tpu import optim
+        from paddle_tpu.trainer import SGD, events
+        from paddle_tpu.data import dense_vector, integer_value
+
+        x = L.data_layer("x", size=2)
+        lab = L.data_layer("lab", size=1)
+        y = L.fc_layer(x, size=2, act="softmax")
+        cost = L.classification_cost(y, lab)
+        rng = np.random.RandomState(0)
+
+        def reader():
+            for i in range(10_000):          # far more than we will run
+                time.sleep(0.05)
+                yield [(rng.randn(2).astype(np.float32), 1)
+                       for _ in range(8)]
+
+        def handler(e):
+            if isinstance(e, events.EndIteration) and e.batch_id == 0:
+                print("READY", flush=True)
+
+        sgd = SGD(cost, update_equation=optim.Momentum(learning_rate=0.1,
+                                                       momentum=0.9))
+        sgd.train(reader=reader, num_passes=5, save_dir=%r, log_period=0,
+                  event_handler=handler,
+                  feeding={"x": dense_vector(2), "lab": integer_value(2)})
+        print("STOPPED-CLEANLY", flush=True)
+    """) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            str(tmp_path / "ckpt"))
+    import queue
+    import threading
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE, text=True)
+    lines = queue.Queue()
+
+    def pump():
+        for ln in proc.stdout:
+            lines.put(ln)
+        lines.put(None)
+    threading.Thread(target=pump, daemon=True).start()
+    try:
+        deadline = _time.time() + 120
+        while True:     # a hung child fails at the deadline, never blocks
+            try:
+                ln = lines.get(timeout=max(0.1, deadline - _time.time()))
+            except queue.Empty:
+                raise AssertionError("never reached first batch") from None
+            assert ln is not None, "child exited before first batch"
+            if "READY" in ln:
+                break
+            assert _time.time() < deadline, "never reached first batch"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=120)
+        out = ""
+        while True:
+            ln = lines.get(timeout=60)
+            if ln is None:
+                break
+            out += ln
+    finally:
+        proc.kill()
+    assert proc.returncode == 0, out
+    assert "STOPPED-CLEANLY" in out
+    from paddle_tpu.trainer.checkpoint import load_checkpoint
+    p, o, m, meta = load_checkpoint(str(tmp_path / "ckpt"))
+    assert meta["preempted"] is True
+    assert meta["signal"] == int(signal.SIGTERM)
+
+
+def test_checkpoint_overwrite_crash_window_recoverable(tmp_path, monkeypatch):
+    """If a crash lands between the two renames of an overwrite-save, the
+    predecessor survives as .old- and load_checkpoint recovers it."""
+    from paddle_tpu.trainer import checkpoint as ck
+    save_checkpoint(str(tmp_path), 0, {"w": jnp.ones((2,))})
+
+    real_rename = os.rename
+    def crash_on_final(src, dst):
+        if os.path.basename(dst).startswith("pass-") and ".tmp-" in src:
+            raise KeyboardInterrupt("simulated crash mid-overwrite")
+        return real_rename(src, dst)
+    monkeypatch.setattr(ck.os, "rename", crash_on_final)
+    import pytest
+    with pytest.raises(KeyboardInterrupt):
+        save_checkpoint(str(tmp_path), 0, {"w": jnp.zeros((2,))})
+    monkeypatch.undo()
+    assert not [d for d in os.listdir(tmp_path) if d.startswith("pass-")]
+    got, _, _, meta = load_checkpoint(str(tmp_path))   # .old- fallback
+    np.testing.assert_allclose(np.asarray(got["w"]), 1.0)
+    assert meta["pass_id"] == 0
