@@ -76,6 +76,27 @@ DEFAULT_COMBOS = [
 ]
 
 
+def _chip_alive(timeout_s=90):
+    """Cheap liveness probe in a fresh subprocess: a 256x256 matmul that
+    must land on the TPU backend (jax's silent CPU fallback would read a
+    fast-failing wedge as alive — same assert as window_watch.sh).
+    Distinguishes 'this combo was slow/oversized' from 'the chip wedged
+    mid-window' after a *_timeout failure.  A cpu-forced sweep has no
+    chip to probe: vacuously alive."""
+    if os.environ.get("BENCH_PLATFORM") == "cpu":
+        return True
+    code = ("import jax, jax.numpy as jnp;"
+            "x = jnp.ones((256, 256));"
+            "assert float((x @ x).block_until_ready()[0, 0]) == 256.0;"
+            "assert jax.default_backend() == 'tpu'")
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s,
+            capture_output=True).returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def run_combo(model, batch, steps, timeout):
     env = dict(os.environ)
     env["BENCH_MODEL"] = model
@@ -165,6 +186,16 @@ def main(argv=None):
             print(f"[sweep] backend wedged "
                   f"({r.get('error') or r.get('live_error')}) — stopping "
                   "sweep", file=sys.stderr)
+            break
+        # a wedge can also land AFTER backend init (the r4 window died in
+        # a build phase): any timeout failure triggers a cheap liveness
+        # probe, and a dead probe stops the sweep instead of burning every
+        # remaining combo's full deadline budget against a wedged chip
+        err = (r.get("error") or r.get("live_error") or "")
+        if err.endswith("_timeout") and not _chip_alive():
+            print(f"[sweep] liveness probe failed after {combo} ({err}) — "
+                  "chip wedged mid-window, stopping sweep", file=sys.stderr)
+            results[combo]["wedge_probe"] = "dead"
             break
     print(json.dumps({"sweep": results}), flush=True)
     # a cached replay over a live failure is NOT a measurement: rc 4

@@ -214,3 +214,47 @@ def test_sweep_skip_fresh_platform_guards(tmp_path, monkeypatch):
     assert sw._fresh_live_row("alexnet", 64, 3600, str(p)) is not None
     monkeypatch.setenv("BENCH_PLATFORM", "cpu")
     assert sw._fresh_live_row("alexnet", 64, 3600, str(p)) is None
+
+
+def test_sweep_stops_on_dead_probe_after_timeout(monkeypatch, capsys):
+    """A *_timeout combo triggers the liveness probe; a dead probe stops
+    the sweep instead of burning the remaining combos' deadlines."""
+    from paddle_tpu.scripts import bench_sweep as sw
+
+    calls = []
+    def fake_combo(model, batch, steps, timeout):
+        calls.append(model)
+        return {"error": "input_build_timeout", "value": None}
+    monkeypatch.setattr(sw, "run_combo", fake_combo)
+    monkeypatch.setattr(sw, "_chip_alive", lambda timeout_s=90: False)
+    monkeypatch.delenv("BENCH_SWEEP_SKIP_FRESH_S", raising=False)
+    rc = sw.main(["--combos", "lstm:64,alexnet:64,googlenet:64"])
+    assert calls == ["lstm"]          # stopped after the first combo
+    assert rc == 2
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["sweep"]["lstm:64"]["wedge_probe"] == "dead"
+
+
+def test_sweep_continues_on_live_probe_after_timeout(monkeypatch):
+    """A slow/oversized combo (timeout but chip alive) must NOT stop the
+    sweep — remaining combos still use the healthy window."""
+    from paddle_tpu.scripts import bench_sweep as sw
+
+    calls = []
+    def fake_combo(model, batch, steps, timeout):
+        calls.append(model)
+        if model == "lstm":
+            return {"error": "compile_timeout", "value": None}
+        return {"value": 9.0, "unit": "ms/batch", "error": None}
+    monkeypatch.setattr(sw, "run_combo", fake_combo)
+    monkeypatch.setattr(sw, "_chip_alive", lambda timeout_s=90: True)
+    monkeypatch.delenv("BENCH_SWEEP_SKIP_FRESH_S", raising=False)
+    rc = sw.main(["--combos", "lstm:64,alexnet:64"])
+    assert calls == ["lstm", "alexnet"]
+    assert rc == 0
+
+
+def test_chip_probe_vacuous_on_cpu_sweep(monkeypatch):
+    from paddle_tpu.scripts import bench_sweep as sw
+    monkeypatch.setenv("BENCH_PLATFORM", "cpu")
+    assert sw._chip_alive() is True        # no subprocess, no 90 s wait
