@@ -8,6 +8,9 @@ to fuse on the MXU; the sequence-parallel ring variant lives in
 paddle_tpu.parallel.ring_attention.
 """
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -15,6 +18,11 @@ from paddle_tpu.core.sequence import SequenceBatch
 from paddle_tpu.ops.linear import matmul
 
 _NEG = -1e30
+
+# dense fallback materializes [B, H, Tq, Tk] f32 logits; beyond this many
+# logit elements per head-batch, route to the O(T)-memory chunked path
+_CHUNKED_MIN = int(os.environ.get("PADDLE_TPU_CHUNKED_ATTN_MIN",
+                                  str(2048 * 2048)))
 
 
 def additive_attention_scores(enc_proj: SequenceBatch, dec_state_proj, v):
@@ -37,13 +45,111 @@ def attention_context(scores, values: SequenceBatch):
     return jnp.einsum("bt,btd->bd", w, values.data)
 
 
+def online_softmax_block(q, k, v, m_prev, l_prev, acc, mask=None,
+                         scale=1.0, acc_dtype=jnp.float32):
+    """One K/V block of flash-style attention — THE shared numerically
+    delicate accumulation (used by chunked_attention here and the ring
+    rotation in parallel/ring_attention.py).
+
+    q: [..., Tq, D], k/v: [..., Tk, D]; m/l: [..., Tq]; acc: [..., Tq, D];
+    mask: optional bool [..., Tq, Tk].  Returns updated (m, l, acc)."""
+    s = jnp.einsum("...qd,...kd->...qk", q, k,
+                   preferred_element_type=acc_dtype) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    # fully-masked blocks (max == _NEG): exp underflows to 0, harmless
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", p.astype(v.dtype), v)
+    return m_new, l_new, acc_new
+
+
+def chunked_attention(q, k, v, scale=None, causal=False, key_mask=None,
+                      q_chunk=512, k_chunk=512):
+    """Flash-style attention in pure XLA: online-softmax accumulation over
+    key chunks inside a scan over query chunks — O(T) memory on ANY
+    backend (the CPU/interpret twin of ops.pallas.flash_attention, and the
+    dense fallback's long-context escape hatch).  The key-chunk body is
+    rematerialized, so the backward pass recomputes blocks instead of
+    saving [Tq, Tk] intermediates.
+
+    q: [B, H, Tq, D], k/v: [B, H, Tk, D]; key_mask: optional [B, Tk]
+    validity (per-key, O(T) — a full [Tq, Tk] mask would defeat the
+    point).  causal matches the dense path's tril offset (query i attends
+    keys <= i + Tk - Tq)."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    scale = scale if scale is not None else 1.0 / (float(d) ** 0.5)
+    q_chunk, k_chunk = min(q_chunk, tq), min(k_chunk, tk)
+    pq, pk_ = (-tq) % q_chunk, (-tk) % k_chunk
+    if pq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pq), (0, 0)))
+    if pk_ or key_mask is None:
+        # padded keys must be masked out; build the O(T) validity vector
+        km = jnp.ones((b, tk), q.dtype) if key_mask is None \
+            else key_mask.astype(q.dtype)
+        key_mask = jnp.pad(km, ((0, 0), (0, pk_)))
+    if pk_:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pk_), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pk_), (0, 0)))
+    nq, nk = (tq + pq) // q_chunk, (tk + pk_) // k_chunk
+    qs = q.reshape(b, h, nq, q_chunk, d).transpose(2, 0, 1, 3, 4)
+    ks = k.reshape(b, h, nk, k_chunk, d).transpose(2, 0, 1, 3, 4)
+    vs = v.reshape(b, h, nk, k_chunk, d).transpose(2, 0, 1, 3, 4)
+    kms = key_mask.reshape(b, nk, k_chunk).transpose(1, 0, 2)
+    off = tk - tq   # dense path's tril offset
+    # f64 inputs keep f64 accumulation, matching the dense path's
+    # promote_types behavior (no silent precision drop above the threshold)
+    acc_dtype = jnp.promote_types(q.dtype, jnp.float32)
+
+    @jax.checkpoint
+    def k_body(carry, inp, q_blk, qi):
+        m, l, acc = carry
+        k_blk, v_blk, km_blk, ki = inp
+        keep = km_blk[:, None, None, :] > 0
+        if causal:
+            qpos = qi * q_chunk + jnp.arange(q_chunk) + off
+            kpos = ki * k_chunk + jnp.arange(k_chunk)
+            keep = keep & (qpos[:, None] >= kpos[None, :])[None, None]
+
+        def update(carry):
+            return online_softmax_block(q_blk, k_blk, v_blk, *carry,
+                                        mask=keep, scale=scale,
+                                        acc_dtype=acc_dtype)
+        if not causal:
+            return update(carry), None
+        # skip key blocks entirely above the diagonal (~half the FLOPs at
+        # long context, same trick as the flash kernel's block indexing)
+        needed = qi * q_chunk + (q_chunk - 1) + off >= ki * k_chunk
+        return jax.lax.cond(needed, update, lambda c: c, carry), None
+
+    def q_body(_, inp):
+        q_blk, qi = inp
+        init = (jnp.full((b, h, q_chunk), _NEG, acc_dtype),
+                jnp.zeros((b, h, q_chunk), acc_dtype),
+                jnp.zeros((b, h, q_chunk, d), acc_dtype))
+        (m, l, acc), _ = jax.lax.scan(
+            functools.partial(k_body, q_blk=q_blk, qi=qi), init,
+            (ks, vs, kms, jnp.arange(nk)))
+        return None, (acc / jnp.maximum(l[..., None], 1e-20)).astype(q.dtype)
+
+    _, outs = jax.lax.scan(q_body, None, (qs, jnp.arange(nq)))
+    out = outs.transpose(1, 2, 0, 3, 4).reshape(b, h, nq * q_chunk, d)
+    return out[:, :, :tq]
+
+
 def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
                           use_flash=None):
     """q: [B, H, Tq, Dh], k/v: [B, H, Tk, Dh] -> [B, H, Tq, Dh].
 
     Softmax in f32 (TPU numerics), logits computed on the MXU in bf16.
     On TPU, unmasked block-aligned shapes route to the Pallas flash
-    kernel (ops.pallas.flash_attention) — O(T) HBM instead of O(T^2).
+    kernel (ops.pallas.flash_attention) — O(T) HBM instead of O(T^2);
+    elsewhere, shapes whose logits would exceed PADDLE_TPU_CHUNKED_ATTN_MIN
+    elements route to chunked_attention (same O(T) memory in pure XLA).
     """
     if use_flash is None:
         from paddle_tpu.ops import pallas as pk
@@ -53,6 +159,8 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
     if use_flash:
         from paddle_tpu.ops.pallas import flash_attention
         return flash_attention(q, k, v, scale=scale, causal=causal)
+    if mask is None and q.shape[2] * k.shape[2] >= _CHUNKED_MIN:
+        return chunked_attention(q, k, v, scale=scale, causal=causal)
     dh = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(float(dh))
     logits = jnp.einsum(

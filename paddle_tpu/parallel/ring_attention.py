@@ -22,24 +22,12 @@ _NEG = -1e30
 
 
 def _block_attn(q, k, v, m_prev, l_prev, acc, mask=None, scale=1.0):
-    """One K/V block of flash-style attention.
-
-    q: [B, H, Tq, D], k/v: [B, H, Tk, D]; m/l: [B, H, Tq]; acc: [B,H,Tq,D].
-    Returns updated (m, l, acc).
-    """
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    if mask is not None:
-        s = jnp.where(mask, s, _NEG)
-    m_cur = jnp.max(s, axis=-1)
-    m_new = jnp.maximum(m_prev, m_cur)
-    # guard fully-masked blocks (m_cur == _NEG): exp underflows to 0, fine
-    p = jnp.exp(s - m_new[..., None])
-    l_corr = jnp.exp(m_prev - m_new)
-    l_new = l_prev * l_corr + jnp.sum(p, axis=-1)
-    acc_new = acc * l_corr[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p.astype(v.dtype), v)
-    return m_new, l_new, acc_new
+    """One K/V block of flash-style attention — delegates to the shared
+    accumulation in ops.attention so the delicate m/l/acc math lives in
+    exactly one place."""
+    from paddle_tpu.ops.attention import online_softmax_block
+    return online_softmax_block(q, k, v, m_prev, l_prev, acc, mask=mask,
+                                scale=scale)
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
