@@ -480,8 +480,12 @@ def bench_transformer(batch=32, seq_len=256, vocab=32000, d_model=512,
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, src, trg):
+        # full_seq: every bench sequence is max-length, so masking drops
+        # entirely and the Pallas flash kernel engages on TPU (a key_mask
+        # would still be O(T)-memory via chunked_attention, but off the
+        # flash fast path)
         loss, grads = jax.value_and_grad(transformer.loss)(
-            params, src, trg, trg, heads, remat=remat)
+            params, src, trg, trg, heads, remat=remat, full_seq=True)
         new_params, new_opt = opt.update(grads, opt_state, params)
         return new_params, new_opt, loss
 

@@ -56,10 +56,10 @@ def init(rng, src_vocab=30000, trg_vocab=30000, d_model=512, num_heads=8,
     return params
 
 
-def _mha(blk, xq, xkv, num_heads, mask=None, causal=False):
+def _mha(blk, xq, xkv, num_heads, key_mask=None, causal=False):
     return attn_ops.multi_head_attention(
         xq, xkv, blk["wq"], blk["wk"], blk["wv"], blk["wo"], num_heads,
-        mask=mask, causal=causal)
+        key_mask=key_mask, causal=causal)
 
 
 def _ffn(blk, x):
@@ -71,21 +71,43 @@ def _ln(p, x):
     return layer_norm(x, p["g"], p["b"])
 
 
-def _enc_block(blk, x, mask, num_heads):
+def _check_full(seq: SequenceBatch):
+    """full_seq=True promises no padding; catch a broken promise when the
+    lengths are concrete (outside jit) instead of silently attending
+    padded keys."""
+    lengths = seq.lengths
+    if isinstance(lengths, jax.core.Tracer):
+        return
+    t = seq.data.shape[1]
+    if bool(jnp.any(lengths != t)):
+        raise ValueError(
+            f"full_seq=True but batch has lengths {np_min_max(lengths)} "
+            f"< T={t}; drop full_seq or pack the batch")
+
+
+def np_min_max(lengths):
+    import numpy as _np
+    a = _np.asarray(lengths)
+    return (int(a.min()), int(a.max()))
+
+
+def _enc_block(blk, x, key_mask, num_heads):
     h = _ln(blk["ln1"], x)
-    x = x + _mha(blk["attn"], h, h, num_heads, mask=mask)
+    x = x + _mha(blk["attn"], h, h, num_heads, key_mask=key_mask)
     return x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
 
 
-def _dec_block(blk, x, enc_out, self_mask, cross_mask, num_heads):
+def _dec_block(blk, x, enc_out, self_km, cross_km, num_heads):
     h = _ln(blk["ln1"], x)
-    x = x + _mha(blk["attn"], h, h, num_heads, mask=self_mask, causal=True)
+    x = x + _mha(blk["attn"], h, h, num_heads, key_mask=self_km,
+                 causal=True)
     x = x + _mha(blk["xattn"], _ln(blk["ln_x"], x), enc_out, num_heads,
-                 mask=cross_mask)
+                 key_mask=cross_km)
     return x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
 
 
-def encode(params, src: SequenceBatch, num_heads=8, remat=False):
+def encode(params, src: SequenceBatch, num_heads=8, remat=False,
+           full_seq=False):
     """remat=True checkpoints each block (jax.checkpoint): backward
     recomputes activations instead of storing them — the HBM headroom for
     >=32k-token batches."""
@@ -94,38 +116,47 @@ def encode(params, src: SequenceBatch, num_heads=8, remat=False):
         else _enc_block
     x = emb_ops.embedding_lookup(params["src_emb"], src.data)
     x = x * math.sqrt(x.shape[-1]) + params["pos"][:t][None]
-    mask = attn_ops.padding_mask(src.mask(), src.mask())
+    # key validity stays O(T) ([B, T]); full_seq=True promises every
+    # sequence is max-length (packed/bucketed batches) and drops the mask
+    # entirely so the flash/chunked O(T)-memory paths engage — validated
+    # when lengths are concrete (a jit-traced batch is trusted)
+    key_mask = None if full_seq else src.mask()
+    if full_seq:
+        _check_full(src)
     for blk in params["enc"]:
-        x = block(blk, x, mask, num_heads)
+        x = block(blk, x, key_mask, num_heads)
     return x
 
 
 def decode(params, enc_out, src_mask, trg_in: SequenceBatch, num_heads=8,
-           pos_offset=0, remat=False):
+           pos_offset=0, remat=False, full_seq=False):
     t = trg_in.data.shape[1]
     block = jax.checkpoint(_dec_block, static_argnums=(5,)) if remat \
         else _dec_block
     x = emb_ops.embedding_lookup(params["trg_emb"], trg_in.data)
     x = x * math.sqrt(x.shape[-1]) + \
         params["pos"][pos_offset:pos_offset + t][None]
-    self_mask = attn_ops.padding_mask(trg_in.mask(), trg_in.mask())
-    cross_mask = attn_ops.padding_mask(trg_in.mask(), src_mask)
+    self_km = None if full_seq else trg_in.mask()
+    cross_km = None if full_seq else src_mask
+    if full_seq:
+        _check_full(trg_in)
     for blk in params["dec"]:
-        x = block(blk, x, enc_out, self_mask, cross_mask, num_heads)
+        x = block(blk, x, enc_out, self_km, cross_km, num_heads)
     x = _ln(params["ln_f"], x)
     return linear.matmul(x, params["out"])
 
 
 def forward(params, src: SequenceBatch, trg_in: SequenceBatch, num_heads=8,
-            remat=False):
-    enc_out = encode(params, src, num_heads, remat=remat)
+            remat=False, full_seq=False):
+    enc_out = encode(params, src, num_heads, remat=remat, full_seq=full_seq)
     return decode(params, enc_out, src.mask(), trg_in, num_heads,
-                  remat=remat)
+                  remat=remat, full_seq=full_seq)
 
 
 def loss(params, src, trg_in, trg_next, num_heads=8, label_smoothing=0.1,
-         remat=False):
-    logits = forward(params, src, trg_in, num_heads, remat=remat)
+         remat=False, full_seq=False):
+    logits = forward(params, src, trg_in, num_heads, remat=remat,
+                     full_seq=full_seq)
     labels = trg_next.data
     if labels.ndim == 3:
         labels = labels[..., 0]

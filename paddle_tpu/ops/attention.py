@@ -142,7 +142,7 @@ def chunked_attention(q, k, v, scale=None, causal=False, key_mask=None,
 
 
 def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
-                          use_flash=None):
+                          use_flash=None, key_mask=None):
     """q: [B, H, Tq, Dh], k/v: [B, H, Tk, Dh] -> [B, H, Tq, Dh].
 
     Softmax in f32 (TPU numerics), logits computed on the MXU in bf16.
@@ -150,17 +150,30 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
     kernel (ops.pallas.flash_attention) — O(T) HBM instead of O(T^2);
     elsewhere, shapes whose logits would exceed PADDLE_TPU_CHUNKED_ATTN_MIN
     elements route to chunked_attention (same O(T) memory in pure XLA).
+
+    key_mask: [B, Tk] per-key validity — the O(T) way to express padding
+    (a full [Tq, Tk] `mask` forces the dense path and O(T^2) memory).
+    Padded QUERY rows are not specially masked: they produce garbage that
+    positionwise downstream ops keep local and masked losses drop.
     """
+    if mask is not None and key_mask is not None:
+        raise ValueError("pass mask or key_mask, not both")
+    if use_flash and key_mask is not None:
+        raise ValueError("the flash kernel has no mask support; drop "
+                         "use_flash=True or the key_mask")
     if use_flash is None:
         from paddle_tpu.ops import pallas as pk
-        use_flash = (pk.use_pallas() and mask is None
+        use_flash = (pk.use_pallas() and mask is None and key_mask is None
                      and q.shape[2] % 128 == 0 and k.shape[2] % 128 == 0
                      and (not causal or q.shape[2] == k.shape[2]))
     if use_flash:
         from paddle_tpu.ops.pallas import flash_attention
         return flash_attention(q, k, v, scale=scale, causal=causal)
     if mask is None and q.shape[2] * k.shape[2] >= _CHUNKED_MIN:
-        return chunked_attention(q, k, v, scale=scale, causal=causal)
+        return chunked_attention(q, k, v, scale=scale, causal=causal,
+                                 key_mask=key_mask)
+    if key_mask is not None:
+        mask = key_mask[:, None, None, :] > 0
     dh = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(float(dh))
     logits = jnp.einsum(
@@ -177,9 +190,10 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
 
 
 def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
-                         causal=False):
+                         causal=False, key_mask=None):
     """Dense multi-head attention.  x_q: [B, Tq, D], x_kv: [B, Tk, D],
-    wq/wk/wv: [D, D], wo: [D, D]."""
+    wq/wk/wv: [D, D], wo: [D, D].  key_mask: [B, Tk] padding validity
+    (O(T); preferred over a materialized [Tq, Tk] mask)."""
     b, tq, d = x_q.shape
     tk = x_kv.shape[1]
     dh = d // num_heads
@@ -190,11 +204,18 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
     q = split(x_q, wq, tq)
     k = split(x_kv, wk, tk)
     v = split(x_kv, wv, tk)
-    out = dot_product_attention(q, k, v, mask=mask, causal=causal)
+    out = dot_product_attention(q, k, v, mask=mask, causal=causal,
+                                key_mask=key_mask)
     out = out.transpose(0, 2, 1, 3).reshape(b, tq, d)
     return matmul(out, wo)
 
 
 def padding_mask(q_len_mask, k_len_mask):
-    """[B, Tq], [B, Tk] -> [B, 1, Tq, Tk] boolean attention mask."""
+    """[B, Tq], [B, Tk] -> [B, 1, Tq, Tk] boolean attention mask.
+
+    O(T^2) memory and forces the dense attention path — prefer passing
+    the [B, Tk] validity vector as dot_product_attention's `key_mask`
+    (O(T), routes to flash-style chunking at long context).  Kept for
+    callers that genuinely need a 2-D mask (e.g. blockwise or relative
+    masking)."""
     return (q_len_mask[:, None, :, None] > 0) & (k_len_mask[:, None, None, :] > 0)

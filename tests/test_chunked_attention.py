@@ -95,3 +95,29 @@ def test_long_context_cpu_feasible(np_rng):
     out = att.chunked_attention(q, q, q, causal=True)
     assert out.shape == (1, 2, t, D)
     assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_flash_forced_with_key_mask_raises(np_rng):
+    q, k, v = _qkv(np_rng, 64, 64)
+    km = jnp.ones((B, 64))
+    with pytest.raises(ValueError, match="no mask support"):
+        att.dot_product_attention(q, k, v, key_mask=km, use_flash=True)
+
+
+def test_transformer_full_seq_promise_checked(np_rng):
+    """full_seq=True on a genuinely padded (concrete) batch raises instead
+    of silently attending padded keys."""
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models import transformer
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=32,
+                              trg_vocab=32, d_model=16, dff=32,
+                              enc_layers=1, dec_layers=1, max_len=8)
+    ids = jnp.asarray(np_rng.randint(3, 32, (2, 8)), jnp.int32)
+    padded = SequenceBatch(ids, jnp.asarray([8, 5], jnp.int32))
+    full = SequenceBatch(ids, jnp.full((2,), 8, jnp.int32))
+    with pytest.raises(ValueError, match="full_seq=True but"):
+        transformer.forward(params, padded, full, num_heads=2,
+                            full_seq=True)
+    out = transformer.forward(params, full, full, num_heads=2,
+                              full_seq=True)
+    assert out.shape == (2, 8, 32)
