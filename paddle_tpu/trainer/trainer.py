@@ -59,7 +59,7 @@ class SGD:
     def __init__(self, cost, parameters=None, update_equation=None,
                  extra_layers=None, is_local=True, mesh=None,
                  sharding_rules=None, seed=1, donate=True, evaluators=None,
-                 compute_dtype=None):
+                 compute_dtype=None, grad_accum_steps=1):
         self.costs = cost if isinstance(cost, (list, tuple)) else [cost]
         self.extra_layers = list(extra_layers or [])
         # evaluator specs (evaluators.dsl): fetch their bound layers as
@@ -112,6 +112,15 @@ class SGD:
         if self._prune_masks:
             self.parameters = param_hooks.apply_masks(
                 self.parameters, self._prune_masks)
+        # validate BEFORE allocating optimizer slots (a sparse-incompatible
+        # setting must not first build full-vocab [V, D] slot tables)
+        self.grad_accum_steps = int(grad_accum_steps)
+        if self.grad_accum_steps < 1:
+            raise ConfigError("grad_accum_steps must be >= 1")
+        if self.grad_accum_steps > 1 and self._sparse_specs:
+            raise ConfigError(
+                "grad_accum_steps > 1 is unsupported with sparse_update "
+                "embeddings (touched-row sets differ per micro-batch)")
         dense_params = {k: v for k, v in self.parameters.items()
                         if k not in self._sparse_specs}
         self.opt_state = self.optimizer.init(dense_params) \
@@ -124,6 +133,17 @@ class SGD:
                 "dense": self.opt_state,
                 "sparse": {k: self.optimizer.row_init(self.parameters[k])
                            for k in self._sparse_specs}}
+        # gradient accumulation (reference num_batches_per_send_parameter's
+        # local-accumulate, RemoteParameterUpdater.h:37-54): grads sum over
+        # N micro-batches, the optimizer applies their mean every Nth —
+        # still ONE jitted step (lax.cond-gated apply), so a big effective
+        # batch fits any HBM.  Checkpointed with opt_state: resume keeps
+        # mid-accumulation progress.
+        if self.grad_accum_steps > 1:
+            self.opt_state = {
+                "inner": self.opt_state,
+                "gsum": jax.tree_util.tree_map(jnp.zeros_like, dense_params),
+                "tick": jnp.zeros((), jnp.int32)}
         self.model_state = self.topology.init_state()
         # multi-controller SPMD: the mesh spans devices owned by OTHER
         # processes (jax.distributed bring-up).  Every process must then
@@ -237,12 +257,36 @@ class SGD:
 
         prune_masks = self._prune_masks
 
+        accum = self.grad_accum_steps
+
         def dense_step(params, opt_state, state, feed, rng):
             (loss, (new_state, extras)), grads = jax.value_and_grad(
                 self._loss_and_extras, has_aux=True)(params, state, feed, rng)
             if prune_masks:
                 grads = param_hooks.apply_masks(grads, prune_masks)
-            new_params, new_opt = self.optimizer.update(grads, opt_state, params)
+            if accum > 1:
+                gsum = jax.tree_util.tree_map(
+                    jnp.add, opt_state["gsum"], grads)
+                tick = opt_state["tick"] + 1
+
+                def apply(_):
+                    mean_g = jax.tree_util.tree_map(
+                        lambda s: s / accum, gsum)
+                    p2, o2 = self.optimizer.update(
+                        mean_g, opt_state["inner"], params)
+                    return (p2, o2,
+                            jax.tree_util.tree_map(jnp.zeros_like, gsum),
+                            jnp.zeros((), jnp.int32))
+
+                def hold(_):
+                    return params, opt_state["inner"], gsum, tick
+
+                new_params, inner, gsum, tick = jax.lax.cond(
+                    tick >= accum, apply, hold, None)
+                new_opt = {"inner": inner, "gsum": gsum, "tick": tick}
+            else:
+                new_params, new_opt = self.optimizer.update(
+                    grads, opt_state, params)
             merged_state = {**state, **new_state}
             return new_params, new_opt, merged_state, loss, extras
 
@@ -333,6 +377,14 @@ class SGD:
         # (the reference keeps momentum etc. sharded in the pserver the same
         # way, ParameterServer2 block-indexed buffers)
         def dense_state_shardings(dstate, dense_ps):
+            if isinstance(dstate, dict) and "gsum" in dstate:
+                # grad-accumulation wrapper: the accumulator shards like
+                # the grads it sums (= the params), the tick replicates
+                return {"inner": dense_state_shardings(dstate["inner"],
+                                                       dense_ps),
+                        "gsum": dense_ps,
+                        "tick": replicated_shardings(dstate["tick"],
+                                                     self.mesh)}
             if isinstance(dstate, dict) and "slots" in dstate:
                 return {"step": replicated_shardings(dstate["step"],
                                                      self.mesh),
@@ -637,6 +689,13 @@ class SGD:
         params, opt_state, model_state, meta = load_checkpoint(save_dir, pass_id)
         self.parameters = params
         if opt_state is not None:
+            ckpt_accum = isinstance(opt_state, dict) and "gsum" in opt_state
+            if ckpt_accum != (self.grad_accum_steps > 1):
+                raise ConfigError(
+                    f"checkpoint opt_state was written with grad_accum_steps"
+                    f"{'>1' if ckpt_accum else '=1'} but this trainer has "
+                    f"grad_accum_steps={self.grad_accum_steps}; rebuild the "
+                    "SGD with a matching setting to resume")
             self.opt_state = opt_state
         if model_state is not None:
             self.model_state = model_state

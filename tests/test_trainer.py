@@ -393,3 +393,114 @@ def test_checkpoint_overwrite_crash_window_recoverable(tmp_path, monkeypatch):
     got, _, _, meta = load_checkpoint(str(tmp_path))   # .old- fallback
     np.testing.assert_allclose(np.asarray(got["w"]), 1.0)
     assert meta["pass_id"] == 0
+
+
+def test_grad_accumulation_matches_full_batch(np_rng):
+    """accum=2 over half-batches reproduces full-batch training: the mean
+    of two half-batch mean-grads equals the full-batch mean-grad, so the
+    parameter trajectories match (reference local-accumulate,
+    RemoteParameterUpdater.h:37-54)."""
+    import pytest
+    xs = np_rng.randn(64, 2).astype(np.float32)
+    ys = ((xs[:, 0] > 0) ^ (xs[:, 1] > 0)).astype(np.int64)
+
+    def mk_reader(batch):
+        def reader():
+            for i in range(0, 64, batch):
+                yield [(xs[j], int(ys[j])) for j in range(i, i + batch)]
+        return reader
+
+    def build(accum):
+        reset_names()
+        x = L.data_layer("x", size=2)
+        lab = L.data_layer("lab", size=1)
+        y = L.fc_layer(x, size=2, act="softmax")
+        cost = L.classification_cost(y, lab)
+        return SGD(cost=cost, grad_accum_steps=accum,
+                   update_equation=optim.Momentum(learning_rate=0.2,
+                                                  momentum=0.9))
+    full = build(1)
+    full.train(mk_reader(32), num_passes=2, log_period=0,
+               buffered_batches=0,
+               feeding={"x": dense_vector(2), "lab": integer_value(2)})
+    acc = build(2)
+    acc.train(mk_reader(16), num_passes=2, log_period=0,
+              buffered_batches=0,
+              feeding={"x": dense_vector(2), "lab": integer_value(2)})
+    for k in full.parameters:
+        for kk in full.parameters[k]:
+            np.testing.assert_allclose(
+                np.asarray(acc.parameters[k][kk]),
+                np.asarray(full.parameters[k][kk]), atol=1e-5,
+                err_msg=f"{k}/{kk}")
+    assert int(acc.opt_state["tick"]) == 0     # pass ended on a boundary
+    with pytest.raises(Exception):
+        build(0)
+
+
+def test_grad_accum_rejects_sparse(np_rng):
+    import pytest
+    reset_names()
+    w = L.data_layer("w", size=50)
+    lbl = L.data_layer("lbl", size=2)
+    emb = L.embedding_layer(w, size=8, sparse_update=True)
+    p = L.pooling_layer(emb, pooling_type="sum")
+    out = L.fc_layer(p, size=2, act="softmax")
+    cost = L.classification_cost(out, lbl)
+    with pytest.raises(Exception, match="sparse"):
+        SGD(cost=cost, grad_accum_steps=2,
+            update_equation=optim.Momentum(learning_rate=0.1))
+
+
+def test_grad_accum_mid_checkpoint_resume(np_rng, tmp_path):
+    """A checkpoint taken MID-accumulation carries gsum/tick; resuming
+    with a matching grad_accum_steps continues the same trajectory, and a
+    mismatched setting is rejected up front (not a KeyError mid-jit)."""
+    import pytest
+    xs = np_rng.randn(48, 2).astype(np.float32)
+    ys = (xs[:, 0] > 0).astype(np.int64)
+
+    def mk_reader(n_batches):
+        def reader():
+            for i in range(n_batches):
+                s = (i * 16) % 48
+                yield [(xs[j], int(ys[j])) for j in range(s, s + 16)]
+        return reader
+
+    def build(accum=2):
+        reset_names()
+        x = L.data_layer("x", size=2)
+        lab = L.data_layer("lab", size=1)
+        y = L.fc_layer(x, size=2, act="softmax")
+        cost = L.classification_cost(y, lab)
+        return SGD(cost=cost, grad_accum_steps=accum,
+                   update_equation=optim.Momentum(learning_rate=0.2,
+                                                  momentum=0.9))
+    feeding = {"x": dense_vector(2), "lab": integer_value(2)}
+
+    # 3 micro-batches with accum=2 -> ends MID-accumulation (tick=1)
+    a = build()
+    a.train(mk_reader(3), num_passes=1, feeding=feeding, log_period=0,
+            buffered_batches=0, save_dir=str(tmp_path))
+    assert int(a.opt_state["tick"]) == 1
+
+    b = build()
+    b.load(str(tmp_path))
+    assert int(b.opt_state["tick"]) == 1
+    np.testing.assert_allclose(
+        np.asarray(jax.tree_util.tree_leaves(b.opt_state["gsum"])[0]),
+        np.asarray(jax.tree_util.tree_leaves(a.opt_state["gsum"])[0]))
+    # both finish the accumulation window with the same 4th micro-batch
+    a.train(mk_reader(1), num_passes=1, feeding=feeding, log_period=0,
+            buffered_batches=0)
+    b.train(mk_reader(1), num_passes=1, feeding=feeding, log_period=0,
+            buffered_batches=0)
+    for k in a.parameters:
+        for kk in a.parameters[k]:
+            np.testing.assert_allclose(np.asarray(b.parameters[k][kk]),
+                                       np.asarray(a.parameters[k][kk]),
+                                       atol=1e-6)
+    # mismatched resume settings fail loudly
+    c = build(accum=1)
+    with pytest.raises(Exception, match="grad_accum"):
+        c.load(str(tmp_path))
