@@ -28,6 +28,12 @@ def _load_config(path, config_args):
     through the config compiler (paddle_tpu.compat) unchanged."""
     src = open(path).read()
     if "def get_config" in src:
+        # fresh layer-name registry per invocation: a second cli.main()
+        # in the same process (train then test) must mint the SAME layer
+        # names, or loaded params won't match the rebuilt graph (the
+        # compat path already resets inside parse_config)
+        from paddle_tpu.layers.graph import reset_names
+        reset_names()
         ns = runpy.run_path(path, init_globals={"CONFIG_ARGS": config_args})
         if "get_config" in ns:
             return ns["get_config"]()
@@ -146,6 +152,10 @@ def main(argv=None):
     t = sub.add_parser("train")
     add_common(t)
     t.add_argument("--num_passes", type=int, default=1)
+    t.add_argument("--grad_accum_steps", type=int, default=1,
+                   help="sum grads over N micro-batches, apply their mean "
+                        "every Nth step (large effective batch in fixed "
+                        "HBM)")
     t.add_argument("--save_dir", default=None)
     t.add_argument("--saving_period", type=int, default=1)
     t.add_argument("--save_only_one", action="store_true")
@@ -308,7 +318,8 @@ def main(argv=None):
                   sharding_rules=cfg.get("sharding_rules"),
                   evaluators=cfg.get("evaluators"),
                   compute_dtype=(jnp.bfloat16
-                                 if args.dtype == "bfloat16" else None))
+                                 if args.dtype == "bfloat16" else None),
+                  grad_accum_steps=getattr(args, "grad_accum_steps", 1))
 
     if args.job == "train":
         save_dir = args.save_dir or cfg.get("save_dir")

@@ -666,6 +666,8 @@ class SGD:
             if jax.process_index() != 0:
                 barrier(f"save{pass_id}")
                 return None
+        extra = dict(extra or {})
+        extra.setdefault("grad_accum_steps", self.grad_accum_steps)
         path = save_checkpoint(save_dir, pass_id, params,
                                opt_state, self.model_state, extra=extra,
                                save_only_one=save_only_one, block=block)
@@ -689,19 +691,47 @@ class SGD:
         params, opt_state, model_state, meta = load_checkpoint(save_dir, pass_id)
         self.parameters = params
         if opt_state is not None:
-            ckpt_accum = isinstance(opt_state, dict) and "gsum" in opt_state
-            if ckpt_accum != (self.grad_accum_steps > 1):
-                raise ConfigError(
-                    f"checkpoint opt_state was written with grad_accum_steps"
-                    f"{'>1' if ckpt_accum else '=1'} but this trainer has "
-                    f"grad_accum_steps={self.grad_accum_steps}; rebuild the "
-                    "SGD with a matching setting to resume")
+            opt_state = self._adapt_accum_state(opt_state, meta)
             self.opt_state = opt_state
         if model_state is not None:
             self.model_state = model_state
         self._refresh_prune_masks()
         self._reglobalize_after_load()
         return meta
+
+    def _adapt_accum_state(self, opt_state, meta):
+        """Reconcile a checkpoint's grad-accumulation wrapper with THIS
+        trainer's grad_accum_steps.  Clean boundaries (tick 0) convert
+        freely in both directions — a test job or an accum-setting change
+        just works; only a checkpoint holding genuinely mid-accumulation
+        grads under a DIFFERENT accum value is an error (replaying those
+        grads at another denominator would mis-scale the next step)."""
+        wrapped = isinstance(opt_state, dict) and "gsum" in opt_state
+        want = self.grad_accum_steps > 1
+        tick = int(opt_state["tick"]) if wrapped else 0
+        stored = meta.get("grad_accum_steps")
+        if wrapped and not want:
+            if tick:
+                logger.warning(
+                    "checkpoint holds %d accumulated micro-batch grads "
+                    "(grad_accum_steps=%s) — discarded, this trainer "
+                    "doesn't accumulate", tick, stored or ">1")
+            return opt_state["inner"]
+        if want and not wrapped:
+            dense = {k: v for k, v in self.parameters.items()
+                     if k not in self._sparse_specs}
+            return {"inner": opt_state,
+                    "gsum": jax.tree_util.tree_map(jnp.zeros_like, dense),
+                    "tick": jnp.zeros((), jnp.int32)}
+        if wrapped and want and stored and stored != self.grad_accum_steps:
+            if tick:
+                raise ConfigError(
+                    f"checkpoint is mid-accumulation (tick={tick}) under "
+                    f"grad_accum_steps={stored}; this trainer has "
+                    f"{self.grad_accum_steps} — resume with the matching "
+                    "setting (or from a pass boundary)")
+            # clean boundary: gsum is zeros, the wrapper carries over
+        return opt_state
 
     def _reglobalize_after_load(self):
         """Checkpoint leaves are host arrays; on a process-spanning mesh
