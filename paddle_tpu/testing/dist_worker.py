@@ -21,6 +21,9 @@ Modes:
                      with parameters sharded over `model` — the reference
                      distributed plane had the same two splits
                      (num_gradient_servers x parallel_nn model split)
+  --mesh stage       GPipe pipeline across processes: each rank's device
+                     owns one stage, the stage-to-stage ppermute rides
+                     the inter-process transport
 Failure/restart drill (the reference's fault story was pserver
 checkpointing; here it's coordinator checkpoints + whole-job relaunch):
   --ckpt-dir D       rank 0 checkpoints params at step --ckpt-step;
@@ -35,11 +38,20 @@ import os
 import sys
 
 
+def _global_array(sharding, host_value):
+    """Build a process-spanning global array from an identical-per-process
+    host value: each device picks its addressable shard via the callback
+    (mesh-shape-agnostic — works for data, tensor, and stage shardings)."""
+    import jax
+    return jax.make_array_from_callback(
+        host_value.shape, sharding, lambda idx: host_value[idx])
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("out_dir")
     ap.add_argument("--mesh", default="data",
-                    choices=["data", "data,model"])
+                    choices=["data", "data,model", "stage"])
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument("--ckpt-step", type=int, default=10)
@@ -74,6 +86,8 @@ def main(argv=None):
         return _trainer_sparse(args, nproc, rank)
 
     devices = np.asarray(jax.devices())
+    if args.mesh == "stage":
+        return _pipeline_stage(args, nproc, rank, devices)
     if args.mesh == "data,model":
         assert devices.size % 2 == 0, \
             "data,model mesh needs an even device count"
@@ -111,13 +125,9 @@ def main(argv=None):
         print(f"[dist_worker] rank {rank} resuming from step {start_step}",
               flush=True)
 
-    def global_array(sharding, host_value):
-        # every process holds the full host value (deterministic seed /
-        # checkpoint); each device picks its addressable shard via the
-        # callback — works for any mesh shape, unlike the per-process
-        # slice arithmetic a data-only mesh allows
-        return jax.make_array_from_callback(
-            host_value.shape, sharding, lambda idx: host_value[idx])
+    # every process holds the full host value (deterministic seed /
+    # checkpoint); _global_array shards it per device
+    global_array = _global_array
 
     params = {k: global_array(param_sh[k], np.asarray(v))
               for k, v in init.items()}
@@ -172,6 +182,68 @@ def main(argv=None):
         json.dump(out, f)
     print(f"[dist_worker] rank {rank}/{nproc} loss={out['loss']:.6f} "
           f"checksum={checksum:.6f}", flush=True)
+
+
+def _pipeline_stage(args, nproc, rank, devices):
+    """Pipeline parallelism ACROSS PROCESSES: each rank's device owns one
+    GPipe stage; the stage-to-stage ppermute rides the inter-process
+    transport.  The test compares against an in-process sequential run of
+    the same blocks (the reference's config-pair equivalence discipline)."""
+    import json as _json
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu.parallel import distributed as dist
+    from paddle_tpu.parallel.pipeline import (gpipe, microbatch,
+                                              unmicrobatch)
+
+    mesh = Mesh(devices, ("stage",))
+    s = devices.size
+    rng = np.random.RandomState(0)
+    host_stacked = {
+        "w": np.stack([rng.randn(8, 8).astype(np.float32) * 0.4
+                       for _ in range(s)]),
+        "b": np.zeros((s, 8), np.float32)}
+    B, STEPS = 16, args.steps
+    xs = rng.randn(STEPS, B, 8).astype(np.float32)
+    ys = np.tanh(rng.randn(STEPS, B, 8)).astype(np.float32)
+
+    ga = _global_array
+    psh = {k: NamedSharding(mesh, P("stage")) for k in host_stacked}
+    repl = NamedSharding(mesh, P())
+    params = {k: ga(psh[k], v) for k, v in host_stacked.items()}
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    @jax.jit
+    def step(sp, x, y):
+        def loss_fn(sp):
+            out = unmicrobatch(gpipe(stage_fn, sp, microbatch(x, 4),
+                                     mesh=mesh))
+            return jnp.mean((out - y) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(sp)
+        return jax.tree_util.tree_map(
+            lambda w, gw: w - 0.3 * gw, sp, g), loss
+
+    loss = first_loss = None
+    for t in range(STEPS):
+        params, loss = step(params, ga(repl, xs[t]), ga(repl, ys[t]))
+        if first_loss is None:
+            first_loss = float(loss)
+
+    dist.barrier("final")
+    checksum = float(sum(jnp.sum(jnp.abs(v)) for v in
+                         jax.tree_util.tree_leaves(params)))
+    out = {"rank": rank, "nproc": nproc, "loss": float(loss),
+           "first_loss": first_loss, "checksum": checksum,
+           "global_devices": jax.device_count(), "mesh": args.mesh,
+           "start_step": 0, "coordinator": dist.is_coordinator()}
+    with open(os.path.join(args.out_dir, f"rank{rank}.json"), "w") as f:
+        _json.dump(out, f)
+    print(f"[dist_worker] rank {rank}/{nproc} pipeline loss="
+          f"{out['loss']:.6f} checksum={checksum:.6f}", flush=True)
 
 
 def _trainer_sparse(args, nproc, rank):
