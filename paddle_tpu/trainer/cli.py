@@ -176,6 +176,14 @@ def main(argv=None):
     te.add_argument("--model_dir", required=True)
     te.add_argument("--test_pass", type=int, default=None)
 
+    tm = sub.add_parser("time",
+                        help="time the train step (reference --job=time, "
+                             "TrainerBenchmark.cpp): warm up, then report "
+                             "ms/batch percentiles over --num_batches")
+    add_common(tm)
+    tm.add_argument("--num_batches", type=int, default=20)
+    tm.add_argument("--warmup", type=int, default=2)
+
     cg = sub.add_parser("checkgrad",
                         help="finite-difference gradient check "
                              "(reference --job=checkgrad; single-device, "
@@ -373,6 +381,35 @@ def main(argv=None):
                             feeding=_feeder_from_args(args, cfg,
                                                       allow_pad=False))
         print(f"test cost: {cost:.5f}")
+        return 0
+
+    if args.job == "time":
+        import time as _time
+        feeder = _feeder_from_args(args, cfg)
+        reader = cfg["train_reader"]
+        batches = []
+        for b in reader():
+            batches.append(b)
+            if len(batches) >= args.num_batches + args.warmup:
+                break
+        if len(batches) <= args.warmup:
+            print(f"time: need more than --warmup={args.warmup} batches, "
+                  f"reader yielded {len(batches)}", file=sys.stderr)
+            return 2
+        import jax as _jax
+        durs = []
+        for i, b in enumerate(batches):
+            t0 = _time.perf_counter()
+            cost = trainer.train_one_batch(b, feeder=feeder)
+            _jax.block_until_ready(cost)    # real step time, not dispatch
+            if i >= args.warmup:
+                durs.append((_time.perf_counter() - t0) * 1e3)
+        durs.sort()
+        n = len(durs)
+        pct = lambda p: durs[min(n - 1, int(p * n))]
+        print(f"time: {n} batches  p50={pct(0.5):.2f}ms  "
+              f"p90={pct(0.9):.2f}ms  p99={pct(0.99):.2f}ms  "
+              f"mean={sum(durs) / n:.2f}ms")
         return 0
 
 

@@ -615,6 +615,23 @@ class SGD:
                 _signal.signal(_signal.SIGTERM, prev_handler)
 
 
+    def train_one_batch(self, batch, feeder=None):
+        """One jitted train step on one host batch; returns the device
+        cost scalar (reference TrainerInternal::trainOneBatch:66 at API
+        level — the CLI `time` job and custom loops use this)."""
+        feeder = feeder if isinstance(feeder, DataFeeder) else (
+            DataFeeder(feeder) if feeder else None)
+        feed = _normalize_feed(feeder(batch) if feeder else batch)
+        self.rng, step_rng = jax.random.split(self.rng)
+        if self._step_fn is None:
+            self._build_step(feed)
+        feed, step_rng = self._globalize_step_inputs(feed, step_rng)
+        (self.parameters, self.opt_state, self.model_state,
+         cost, _extras) = self._step_fn(
+            self.parameters, self.opt_state, self.model_state,
+            feed, step_rng)
+        return cost
+
     # ------------------------------------------------------------ test
 
     def _build_eval(self):

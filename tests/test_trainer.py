@@ -574,3 +574,33 @@ def test_cli_test_job_loads_accum_checkpoint(tmp_path):
     assert not rc
     rc = cli.main(["test", "--config", str(conf), "--model_dir", str(d)])
     assert not rc
+
+
+def test_cli_time_job(tmp_path, capsys):
+    conf = tmp_path / "conf.py"
+    conf.write_text(
+        "import numpy as np\n"
+        "import paddle_tpu.layers as L\n"
+        "from paddle_tpu import optim\n"
+        "from paddle_tpu.data import dense_vector, integer_value\n"
+        "from paddle_tpu.data import reader as reader_mod\n"
+        "def _samples():\n"
+        "    rng = np.random.RandomState(0)\n"
+        "    for i in range(64):\n"
+        "        yield rng.randn(2).astype(np.float32), int(i % 2)\n"
+        "def get_config():\n"
+        "    x = L.data_layer('x', size=2)\n"
+        "    lbl = L.data_layer('lbl', size=2)\n"
+        "    out = L.fc_layer(x, size=2, act='softmax')\n"
+        "    return {'cost': L.classification_cost(out, lbl),\n"
+        "            'optimizer': optim.Momentum(learning_rate=0.1),\n"
+        "            'train_reader': reader_mod.batch(_samples, 8),\n"
+        "            'batch_size': 8,\n"
+        "            'feeding': {'x': dense_vector(2),\n"
+        "                        'lbl': integer_value(2)}}\n")
+    from paddle_tpu.trainer import cli
+    rc = cli.main(["time", "--config", str(conf), "--num_batches", "4",
+                   "--warmup", "1"])
+    assert not rc
+    out = capsys.readouterr().out
+    assert "p50=" in out and "p99=" in out and "4 batches" in out
