@@ -96,4 +96,15 @@ timeout 7200 python -m paddle_tpu.scripts.nmt_scale \
     > "$ART/nmt_scale.json" 2> "$ART/nmt_scale.log"
 log "nmt rc=$? -> $ART/nmt_scale.json"
 
+log "phase 5: render the perf report from the refreshed cache"
+python -m paddle_tpu.scripts.perf_report > "$ART/perf_report.md" \
+    2>> "$ART/perf_report.log" \
+    && log "perf report -> $ART/perf_report.md" \
+    || log "perf_report rc=$? (see $ART/perf_report.log)"
+cat > "$ART/WINDOW_DONE" <<EOF2
+window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown)
+bench_cache.json now holds the live rows; README's headline caveat and
+docs/perf.md's cached tables should be refreshed from perf_report.md.
+EOF2
+
 log "done at $(date -u +%Y%m%dT%H%M%SZ); artifacts in $ART — review, update docs/perf.md, commit"
