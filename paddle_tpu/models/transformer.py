@@ -56,10 +56,10 @@ def init(rng, src_vocab=30000, trg_vocab=30000, d_model=512, num_heads=8,
     return params
 
 
-def _mha(blk, xq, xkv, num_heads, key_mask=None, causal=False):
+def _mha(blk, xq, xkv, num_heads, key_mask=None, causal=False, mesh=None):
     return attn_ops.multi_head_attention(
         xq, xkv, blk["wq"], blk["wk"], blk["wv"], blk["wo"], num_heads,
-        key_mask=key_mask, causal=causal)
+        key_mask=key_mask, causal=causal, mesh=mesh)
 
 
 def _ffn(blk, x):
@@ -91,29 +91,34 @@ def np_min_max(lengths):
     return (int(a.min()), int(a.max()))
 
 
-def _enc_block(blk, x, key_mask, num_heads):
+def _enc_block(blk, x, key_mask, num_heads, mesh=None):
     h = _ln(blk["ln1"], x)
-    x = x + _mha(blk["attn"], h, h, num_heads, key_mask=key_mask)
+    x = x + _mha(blk["attn"], h, h, num_heads, key_mask=key_mask,
+                 mesh=mesh)
     return x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
 
 
-def _dec_block(blk, x, enc_out, self_km, cross_km, num_heads):
+def _dec_block(blk, x, enc_out, self_km, cross_km, num_heads, mesh=None):
     h = _ln(blk["ln1"], x)
     x = x + _mha(blk["attn"], h, h, num_heads, key_mask=self_km,
-                 causal=True)
+                 causal=True, mesh=mesh)
     x = x + _mha(blk["xattn"], _ln(blk["ln_x"], x), enc_out, num_heads,
-                 key_mask=cross_km)
+                 key_mask=cross_km, mesh=mesh)
     return x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
 
 
 def encode(params, src: SequenceBatch, num_heads=8, remat=False,
-           full_seq=False):
+           full_seq=False, mesh=None):
     """remat=True checkpoints each block (jax.checkpoint): backward
     recomputes activations instead of storing them — the HBM headroom for
-    >=32k-token batches."""
+    >=32k-token batches.
+
+    mesh: a mesh whose `seq` axis is >1 runs every attention sequence-
+    parallel via the ppermute ring (callers shard the T dim of the feeds
+    over that axis) — long-context training across chips."""
     t = src.data.shape[1]
-    block = jax.checkpoint(_enc_block, static_argnums=(3,)) if remat \
-        else _enc_block
+    block = (jax.checkpoint(_enc_block, static_argnums=(3, 4)) if remat
+             else _enc_block)
     x = emb_ops.embedding_lookup(params["src_emb"], src.data)
     x = x * math.sqrt(x.shape[-1]) + params["pos"][:t][None]
     # key validity stays O(T) ([B, T]); full_seq=True promises every
@@ -124,15 +129,15 @@ def encode(params, src: SequenceBatch, num_heads=8, remat=False,
     if full_seq:
         _check_full(src)
     for blk in params["enc"]:
-        x = block(blk, x, key_mask, num_heads)
+        x = block(blk, x, key_mask, num_heads, mesh)
     return x
 
 
 def decode(params, enc_out, src_mask, trg_in: SequenceBatch, num_heads=8,
-           pos_offset=0, remat=False, full_seq=False):
+           pos_offset=0, remat=False, full_seq=False, mesh=None):
     t = trg_in.data.shape[1]
-    block = jax.checkpoint(_dec_block, static_argnums=(5,)) if remat \
-        else _dec_block
+    block = (jax.checkpoint(_dec_block, static_argnums=(5, 6)) if remat
+             else _dec_block)
     x = emb_ops.embedding_lookup(params["trg_emb"], trg_in.data)
     x = x * math.sqrt(x.shape[-1]) + \
         params["pos"][pos_offset:pos_offset + t][None]
@@ -141,22 +146,23 @@ def decode(params, enc_out, src_mask, trg_in: SequenceBatch, num_heads=8,
     if full_seq:
         _check_full(trg_in)
     for blk in params["dec"]:
-        x = block(blk, x, enc_out, self_km, cross_km, num_heads)
+        x = block(blk, x, enc_out, self_km, cross_km, num_heads, mesh)
     x = _ln(params["ln_f"], x)
     return linear.matmul(x, params["out"])
 
 
 def forward(params, src: SequenceBatch, trg_in: SequenceBatch, num_heads=8,
-            remat=False, full_seq=False):
-    enc_out = encode(params, src, num_heads, remat=remat, full_seq=full_seq)
+            remat=False, full_seq=False, mesh=None):
+    enc_out = encode(params, src, num_heads, remat=remat,
+                     full_seq=full_seq, mesh=mesh)
     return decode(params, enc_out, src.mask(), trg_in, num_heads,
-                  remat=remat, full_seq=full_seq)
+                  remat=remat, full_seq=full_seq, mesh=mesh)
 
 
 def loss(params, src, trg_in, trg_next, num_heads=8, label_smoothing=0.1,
-         remat=False, full_seq=False):
+         remat=False, full_seq=False, mesh=None):
     logits = forward(params, src, trg_in, num_heads, remat=remat,
-                     full_seq=full_seq)
+                     full_seq=full_seq, mesh=mesh)
     labels = trg_next.data
     if labels.ndim == 3:
         labels = labels[..., 0]

@@ -190,10 +190,17 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
 
 
 def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
-                         causal=False, key_mask=None):
+                         causal=False, key_mask=None, mesh=None,
+                         seq_axis="seq"):
     """Dense multi-head attention.  x_q: [B, Tq, D], x_kv: [B, Tk, D],
     wq/wk/wv: [D, D], wo: [D, D].  key_mask: [B, Tk] padding validity
-    (O(T); preferred over a materialized [Tq, Tk] mask)."""
+    (O(T); preferred over a materialized [Tq, Tk] mask).
+
+    mesh: when given with a >1 `seq_axis`, attention runs SEQUENCE-
+    PARALLEL through the ppermute ring (parallel/ring_attention): callers
+    shard T over that axis and each device holds T/n — the long-context
+    training plane.  Requires key_mask-style masking (a 2-D mask has no
+    O(T) sharding)."""
     b, tq, d = x_q.shape
     tk = x_kv.shape[1]
     dh = d // num_heads
@@ -204,8 +211,21 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
     q = split(x_q, wq, tq)
     k = split(x_kv, wk, tk)
     v = split(x_kv, wv, tk)
-    out = dot_product_attention(q, k, v, mask=mask, causal=causal,
-                                key_mask=key_mask)
+    if mesh is not None and mesh.shape.get(seq_axis, 1) > 1:
+        if mask is not None:
+            raise ValueError("sequence-parallel attention needs key_mask "
+                             "masking, not a materialized 2-D mask")
+        if causal and tq != tk:
+            raise ValueError(
+                "sequence-parallel causal attention requires Tq == Tk "
+                "(the ring has no tril-offset convention for unequal "
+                "lengths; self-attention always satisfies this)")
+        from paddle_tpu.parallel.ring_attention import ring_attention
+        out = ring_attention(q, k, v, mesh, axis_name=seq_axis,
+                             causal=causal, kv_mask=key_mask)
+    else:
+        out = dot_product_attention(q, k, v, mask=mask, causal=causal,
+                                    key_mask=key_mask)
     out = out.transpose(0, 2, 1, 3).reshape(b, tq, d)
     return matmul(out, wo)
 

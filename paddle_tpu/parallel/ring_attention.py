@@ -62,7 +62,20 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
                 cm = qpos[:, None] >= kpos[None, :]
                 cm = cm[None, None]
                 mask = cm if mask is None else (mask & cm)
-            m, l, acc = _block_attn(q_l, k_blk, v_blk, m, l, acc, mask, scale)
+
+            def attend(carry):
+                m, l, acc = carry
+                return _block_attn(q_l, k_blk, v_blk, m, l, acc, mask,
+                                   scale)
+            if causal:
+                # skip blocks entirely above the diagonal (~half the FLOPs
+                # at long context — same trick as chunked_attention); the
+                # ppermute below still runs so the ring stays in step
+                needed = (my * tq + tq - 1) >= (src * tq)
+                m, l, acc = jax.lax.cond(needed, attend,
+                                         lambda c: c, (m, l, acc))
+            else:
+                m, l, acc = attend((m, l, acc))
             perm = [(j, (j + 1) % n) for j in range(n)]
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
             v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
