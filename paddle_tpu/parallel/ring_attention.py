@@ -52,25 +52,30 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
             # block owner index: blocks travel forward, so at step i we hold
             # the block originally on device (my - i) mod n
             src = (my - i) % n
-            mask = None
-            if kvm_blk is not None:
-                mask = kvm_blk[:, None, None, :] > 0
-            if causal:
-                # global positions: q pos = my*tq + iq ; k pos = src*tq + ik
-                qpos = my * tq + jnp.arange(tq)
-                kpos = src * tq + jnp.arange(tq)
-                cm = qpos[:, None] >= kpos[None, :]
-                cm = cm[None, None]
-                mask = cm if mask is None else (mask & cm)
 
             def attend(carry):
                 m, l, acc = carry
+                # mask built INSIDE the branch: a skipped block must not
+                # pay for its [tq, tq] causal mask either
+                mask = None
+                if kvm_blk is not None:
+                    mask = kvm_blk[:, None, None, :] > 0
+                if causal:
+                    # global positions: q = my*tq + iq ; k = src*tq + ik
+                    qpos = my * tq + jnp.arange(tq)
+                    kpos = src * tq + jnp.arange(tq)
+                    cm = (qpos[:, None] >= kpos[None, :])[None, None]
+                    mask = cm if mask is None else (mask & cm)
                 return _block_attn(q_l, k_blk, v_blk, m, l, acc, mask,
                                    scale)
             if causal:
-                # skip blocks entirely above the diagonal (~half the FLOPs
-                # at long context — same trick as chunked_attention); the
-                # ppermute below still runs so the ring stays in step
+                # skip blocks entirely above the diagonal.  NOTE: with the
+                # contiguous T sharding used here this saves FLOPs/energy
+                # on the idle devices, NOT wall-clock — the ring is
+                # synchronous, so each step runs at the speed of its
+                # busiest device (balanced zigzag/striped sharding would
+                # convert the skip into ~2x throughput; future work).  The
+                # ppermute below still runs so the ring stays in step.
                 needed = (my * tq + tq - 1) >= (src * tq)
                 m, l, acc = jax.lax.cond(needed, attend,
                                          lambda c: c, (m, l, acc))
