@@ -110,6 +110,139 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
     return fn(q, k, v, qm, kvm)
 
 
+def zigzag_order(t_global, n):
+    """Permutation original->zigzag storage: device d's contiguous shard
+    holds original chunks (d, 2n-1-d), each of length T/(2n).  Under this
+    layout every device owns one early and one late chunk, so causal ring
+    attention does the SAME work per device per step (see
+    ring_attention_zigzag) — the load balance contiguous sharding lacks."""
+    import numpy as np
+    if t_global % (2 * n):
+        raise ValueError(f"zigzag needs T % {2 * n} == 0, got {t_global}")
+    chunk = t_global // (2 * n)
+    idx = []
+    for d in range(n):
+        idx.extend(range(d * chunk, (d + 1) * chunk))
+        idx.extend(range((2 * n - 1 - d) * chunk, (2 * n - d) * chunk))
+    return np.asarray(idx)
+
+
+def zigzag_permute(x, n, axis=2):
+    """Reorder the global T axis into zigzag storage layout."""
+    return jnp.take(x, jnp.asarray(zigzag_order(x.shape[axis], n)),
+                    axis=axis)
+
+
+def zigzag_unpermute(x, n, axis=2):
+    import numpy as np
+    order = zigzag_order(x.shape[axis], n)
+    return jnp.take(x, jnp.asarray(np.argsort(order)), axis=axis)
+
+
+def ring_attention_zigzag(q, k, v, mesh: Mesh, axis_name="seq",
+                          q_mask=None, kv_mask=None, scale=None):
+    """CAUSAL ring attention over zigzag-ordered sequences: the balanced
+    long-context training plane.
+
+    Contiguous sharding makes causal ring steps degenerate — device 0
+    skips n-1 of n blocks while device n-1 computes all of them, so the
+    block skip saves FLOPs but no wall-clock.  Zigzag gives device d
+    original chunks (d, 2n-1-d): per ring step each device attends
+    exactly ~2 half-blocks (qhi x klo always; qlo x klo when my >= src;
+    qhi x khi when src >= my — one of the two, both triangular at
+    my == src), halving causal attention cost AND balancing it, so the
+    saving is real throughput.
+
+    q/k/v: [B, H, T, D] GLOBAL, already zigzag_permute'd and sharded over
+    T on `axis_name`; q_mask/kv_mask [B, T] likewise (q_mask zeroes
+    padded query rows, matching ring_attention).  Returns zigzag-ordered
+    output sharded like q (zigzag_unpermute to restore order)."""
+    n = mesh.shape[axis_name]
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+
+    def local_fn(q_l, k_l, v_l, qm_l, kvm_l):
+        b, h, tq, d = q_l.shape
+        half = tq // 2
+        my = jax.lax.axis_index(axis_name)
+
+        def pos(chunk_id):
+            return chunk_id * half + jnp.arange(half)
+
+        def split(t, ax):
+            lo = jax.lax.slice_in_dim(t, 0, half, axis=ax)
+            hi = jax.lax.slice_in_dim(t, half, tq, axis=ax)
+            return lo, hi
+
+        def body(i, carry):
+            mlo, llo, alo, mhi, lhi, ahi, k_blk, v_blk, kvm_blk = carry
+            src = (my - i) % n
+            klo, khi = split(k_blk, 2)
+            vlo, vhi = split(v_blk, 2)
+            kmlo, kmhi = split(kvm_blk, 1)
+            qlo, qhi = split(q_l, 2)
+            q_chunk = (my, 2 * n - 1 - my)
+            k_chunk = (src, 2 * n - 1 - src)
+
+            def attend(qc, kc, q_, k_, v_, km_, carry, need_causal=True):
+                m, l, acc = carry
+                mask = km_[:, None, None, :] > 0
+                if need_causal:
+                    cm = pos(qc)[:, None] >= pos(kc)[None, :]
+                    mask = mask & cm[None, None]
+                return _block_attn(q_, k_, v_, m, l, acc, mask, scale)
+
+            # qhi x klo: always fully below the diagonal — padding mask
+            # only, no causal comparison to build
+            mhi, lhi, ahi = attend(q_chunk[1], k_chunk[0], qhi, klo, vlo,
+                                   kmlo, (mhi, lhi, ahi),
+                                   need_causal=False)
+            # qlo x klo: needed iff my >= src
+            mlo, llo, alo = jax.lax.cond(
+                my >= src,
+                lambda c: attend(q_chunk[0], k_chunk[0], qlo, klo, vlo,
+                                 kmlo, c),
+                lambda c: c, (mlo, llo, alo))
+            # qhi x khi: needed iff src >= my
+            mhi, lhi, ahi = jax.lax.cond(
+                src >= my,
+                lambda c: attend(q_chunk[1], k_chunk[1], qhi, khi, vhi,
+                                 kmhi, c),
+                lambda c: c, (mhi, lhi, ahi))
+
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            kvm_blk = jax.lax.ppermute(kvm_blk, axis_name, perm)
+            return (mlo, llo, alo, mhi, lhi, ahi, k_blk, v_blk, kvm_blk)
+
+        def init(hl):
+            return (jnp.full((b, h, hl), _NEG, jnp.float32),
+                    jnp.zeros((b, h, hl), jnp.float32),
+                    jnp.zeros((b, h, hl, d), jnp.float32))
+
+        (mlo, llo, alo), (mhi, lhi, ahi) = init(half), init(half)
+        out = jax.lax.fori_loop(
+            0, n, body,
+            (mlo, llo, alo, mhi, lhi, ahi, k_l, v_l, kvm_l))
+        mlo, llo, alo, mhi, lhi, ahi = out[:6]
+        olo = alo / jnp.maximum(llo[..., None], 1e-20)
+        ohi = ahi / jnp.maximum(lhi[..., None], 1e-20)
+        o = jnp.concatenate([olo, ohi], axis=2)
+        # padded query rows come back zeroed, matching ring_attention
+        return (o * (qm_l[:, None, :, None] > 0)).astype(q_l.dtype)
+
+    spec = P(None, None, axis_name, None)
+    mspec = P(None, axis_name)
+    qm = q_mask if q_mask is not None else jnp.ones(
+        (q.shape[0], q.shape[2]), jnp.float32)
+    kvm = kv_mask if kv_mask is not None else jnp.ones(
+        (k.shape[0], k.shape[2]), jnp.float32)
+    fn = jax.shard_map(local_fn, mesh=mesh,
+                       in_specs=(spec, spec, spec, mspec, mspec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v, qm, kvm)
+
+
 def ulysses_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
                       mask=None):
     """All-to-all sequence parallelism (Ulysses): reshard [B,H,T/n,D] ->
