@@ -80,15 +80,12 @@ def _check_full(seq: SequenceBatch):
         return
     t = seq.data.shape[1]
     if bool(jnp.any(lengths != t)):
+        import numpy as _np
+        a = _np.asarray(lengths)
         raise ValueError(
-            f"full_seq=True but batch has lengths {np_min_max(lengths)} "
-            f"< T={t}; drop full_seq or pack the batch")
-
-
-def np_min_max(lengths):
-    import numpy as _np
-    a = _np.asarray(lengths)
-    return (int(a.min()), int(a.max()))
+            f"full_seq=True but batch has lengths "
+            f"{(int(a.min()), int(a.max()))} < T={t}; drop full_seq or "
+            "pack the batch")
 
 
 def _enc_block(blk, x, key_mask, num_heads, mesh=None):

@@ -513,28 +513,37 @@ def test_grad_accum_mid_checkpoint_resume(np_rng, tmp_path):
         d.load(str(tmp_path))
 
 
-def test_cli_grad_accum_flag(tmp_path):
-    conf = tmp_path / "conf.py"
-    conf.write_text(
+
+def _write_tiny_conf(path, n_samples=32, with_test_reader=False):
+    """Shared tiny CLI config: 2-feature softmax classifier on synthetic
+    data (the three CLI-job tests differ only in reader size/test_reader)."""
+    test_line = ("    'test_reader': reader_mod.batch(_samples, 8),\n"
+                 if with_test_reader else "")
+    path.write_text(
         "import numpy as np\n"
         "import paddle_tpu.layers as L\n"
         "from paddle_tpu import optim\n"
         "from paddle_tpu.data import dense_vector, integer_value\n"
+        "from paddle_tpu.data import reader as reader_mod\n"
         "def _samples():\n"
         "    rng = np.random.RandomState(0)\n"
-        "    for i in range(32):\n"
+        f"    for i in range({n_samples}):\n"
         "        yield rng.randn(2).astype(np.float32), int(i % 2)\n"
         "def get_config():\n"
-        "    from paddle_tpu.data import reader as reader_mod\n"
         "    x = L.data_layer('x', size=2)\n"
         "    lbl = L.data_layer('lbl', size=2)\n"
         "    out = L.fc_layer(x, size=2, act='softmax')\n"
         "    return {'cost': L.classification_cost(out, lbl),\n"
         "            'optimizer': optim.Momentum(learning_rate=0.1),\n"
         "            'train_reader': reader_mod.batch(_samples, 8),\n"
+        + test_line +
         "            'batch_size': 8,\n"
         "            'feeding': {'x': dense_vector(2),\n"
         "                        'lbl': integer_value(2)}}\n")
+
+def test_cli_grad_accum_flag(tmp_path):
+    conf = tmp_path / "conf.py"
+    _write_tiny_conf(conf)
     from paddle_tpu.trainer import cli
     rc = cli.main(["train", "--config", str(conf), "--num_passes", "1",
                    "--log_period", "0", "--grad_accum_steps", "2"])
@@ -545,27 +554,7 @@ def test_cli_test_job_loads_accum_checkpoint(tmp_path):
     """Train with --grad_accum_steps 2, evaluate with the plain test job:
     the accum wrapper unwraps transparently."""
     conf = tmp_path / "conf.py"
-    conf.write_text(
-        "import numpy as np\n"
-        "import paddle_tpu.layers as L\n"
-        "from paddle_tpu import optim\n"
-        "from paddle_tpu.data import dense_vector, integer_value\n"
-        "from paddle_tpu.data import reader as reader_mod\n"
-        "def _samples():\n"
-        "    rng = np.random.RandomState(0)\n"
-        "    for i in range(32):\n"
-        "        yield rng.randn(2).astype(np.float32), int(i % 2)\n"
-        "def get_config():\n"
-        "    x = L.data_layer('x', size=2)\n"
-        "    lbl = L.data_layer('lbl', size=2)\n"
-        "    out = L.fc_layer(x, size=2, act='softmax')\n"
-        "    return {'cost': L.classification_cost(out, lbl),\n"
-        "            'optimizer': optim.Momentum(learning_rate=0.1),\n"
-        "            'train_reader': reader_mod.batch(_samples, 8),\n"
-        "            'test_reader': reader_mod.batch(_samples, 8),\n"
-        "            'batch_size': 8,\n"
-        "            'feeding': {'x': dense_vector(2),\n"
-        "                        'lbl': integer_value(2)}}\n")
+    _write_tiny_conf(conf, with_test_reader=True)
     from paddle_tpu.trainer import cli
     d = tmp_path / "out"
     rc = cli.main(["train", "--config", str(conf), "--num_passes", "1",
@@ -578,26 +567,7 @@ def test_cli_test_job_loads_accum_checkpoint(tmp_path):
 
 def test_cli_time_job(tmp_path, capsys):
     conf = tmp_path / "conf.py"
-    conf.write_text(
-        "import numpy as np\n"
-        "import paddle_tpu.layers as L\n"
-        "from paddle_tpu import optim\n"
-        "from paddle_tpu.data import dense_vector, integer_value\n"
-        "from paddle_tpu.data import reader as reader_mod\n"
-        "def _samples():\n"
-        "    rng = np.random.RandomState(0)\n"
-        "    for i in range(64):\n"
-        "        yield rng.randn(2).astype(np.float32), int(i % 2)\n"
-        "def get_config():\n"
-        "    x = L.data_layer('x', size=2)\n"
-        "    lbl = L.data_layer('lbl', size=2)\n"
-        "    out = L.fc_layer(x, size=2, act='softmax')\n"
-        "    return {'cost': L.classification_cost(out, lbl),\n"
-        "            'optimizer': optim.Momentum(learning_rate=0.1),\n"
-        "            'train_reader': reader_mod.batch(_samples, 8),\n"
-        "            'batch_size': 8,\n"
-        "            'feeding': {'x': dense_vector(2),\n"
-        "                        'lbl': integer_value(2)}}\n")
+    _write_tiny_conf(conf, n_samples=64)
     from paddle_tpu.trainer import cli
     rc = cli.main(["time", "--config", str(conf), "--num_batches", "4",
                    "--warmup", "1"])
