@@ -56,10 +56,11 @@ def init(rng, src_vocab=30000, trg_vocab=30000, d_model=512, num_heads=8,
     return params
 
 
-def _mha(blk, xq, xkv, num_heads, key_mask=None, causal=False, mesh=None):
+def _mha(blk, xq, xkv, num_heads, key_mask=None, causal=False, mesh=None,
+         zigzag=False):
     return attn_ops.multi_head_attention(
         xq, xkv, blk["wq"], blk["wk"], blk["wv"], blk["wo"], num_heads,
-        key_mask=key_mask, causal=causal, mesh=mesh)
+        key_mask=key_mask, causal=causal, mesh=mesh, zigzag=zigzag)
 
 
 def _ffn(blk, x):
@@ -69,6 +70,13 @@ def _ffn(blk, x):
 
 def _ln(p, x):
     return layer_norm(x, p["g"], p["b"])
+
+
+def _zigzag_idx(t, mesh):
+    """THE permutation decode's logits and loss's labels share — one
+    definition so they can never misalign."""
+    from paddle_tpu.parallel.ring_attention import zigzag_order
+    return jnp.asarray(zigzag_order(t, mesh.shape["seq"]))
 
 
 def _check_full(seq: SequenceBatch):
@@ -95,10 +103,11 @@ def _enc_block(blk, x, key_mask, num_heads, mesh=None):
     return x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
 
 
-def _dec_block(blk, x, enc_out, self_km, cross_km, num_heads, mesh=None):
+def _dec_block(blk, x, enc_out, self_km, cross_km, num_heads, mesh=None,
+               zigzag=False):
     h = _ln(blk["ln1"], x)
     x = x + _mha(blk["attn"], h, h, num_heads, key_mask=self_km,
-                 causal=True, mesh=mesh)
+                 causal=True, mesh=mesh, zigzag=zigzag)
     x = x + _mha(blk["xattn"], _ln(blk["ln_x"], x), enc_out, num_heads,
                  key_mask=cross_km, mesh=mesh)
     return x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
@@ -131,44 +140,71 @@ def encode(params, src: SequenceBatch, num_heads=8, remat=False,
 
 
 def decode(params, enc_out, src_mask, trg_in: SequenceBatch, num_heads=8,
-           pos_offset=0, remat=False, full_seq=False, mesh=None):
+           pos_offset=0, remat=False, full_seq=False, mesh=None,
+           zigzag=False):
+    """zigzag=True (mesh with seq>1 only): the decoder stream — ids,
+    positions, masks — is processed in zigzag storage order so the causal
+    self-attention rides the BALANCED ring (ring_attention_zigzag); the
+    non-causal cross-attention doesn't care about q order.  Returned
+    logits are in zigzag order: permute labels the same way (loss() does)
+    rather than unpermuting — masked CE is permutation-invariant."""
     t = trg_in.data.shape[1]
-    block = (jax.checkpoint(_dec_block, static_argnums=(5, 6)) if remat
+    block = (jax.checkpoint(_dec_block, static_argnums=(5, 6, 7)) if remat
              else _dec_block)
-    x = emb_ops.embedding_lookup(params["trg_emb"], trg_in.data)
-    x = x * math.sqrt(x.shape[-1]) + \
-        params["pos"][pos_offset:pos_offset + t][None]
+    ids, pos_rows = trg_in.data, params["pos"][pos_offset:pos_offset + t]
     self_km = None if full_seq else trg_in.mask()
+    if zigzag:
+        if mesh is None or mesh.shape.get("seq", 1) <= 1:
+            raise ValueError("zigzag decode needs a mesh with seq > 1")
+        if pos_offset:
+            raise ValueError("zigzag is a training-path layout; "
+                             "incremental decode uses the cache path")
+        order = _zigzag_idx(t, mesh)
+        ids = ids[:, order]
+        pos_rows = pos_rows[order]
+        if self_km is not None:
+            self_km = self_km[:, order]
+    x = emb_ops.embedding_lookup(params["trg_emb"], ids)
+    x = x * math.sqrt(x.shape[-1]) + pos_rows[None]
     cross_km = None if full_seq else src_mask
     if full_seq:
         _check_full(trg_in)
     for blk in params["dec"]:
-        x = block(blk, x, enc_out, self_km, cross_km, num_heads, mesh)
+        x = block(blk, x, enc_out, self_km, cross_km, num_heads, mesh,
+                  zigzag)
     x = _ln(params["ln_f"], x)
     return linear.matmul(x, params["out"])
 
 
 def forward(params, src: SequenceBatch, trg_in: SequenceBatch, num_heads=8,
-            remat=False, full_seq=False, mesh=None):
+            remat=False, full_seq=False, mesh=None, zigzag=False):
     enc_out = encode(params, src, num_heads, remat=remat,
                      full_seq=full_seq, mesh=mesh)
     return decode(params, enc_out, src.mask(), trg_in, num_heads,
-                  remat=remat, full_seq=full_seq, mesh=mesh)
+                  remat=remat, full_seq=full_seq, mesh=mesh,
+                  zigzag=zigzag)
 
 
 def loss(params, src, trg_in, trg_next, num_heads=8, label_smoothing=0.1,
-         remat=False, full_seq=False, mesh=None):
+         remat=False, full_seq=False, mesh=None, zigzag=False):
     logits = forward(params, src, trg_in, num_heads, remat=remat,
-                     full_seq=full_seq, mesh=mesh)
+                     full_seq=full_seq, mesh=mesh, zigzag=zigzag)
     labels = trg_next.data
     if labels.ndim == 3:
         labels = labels[..., 0]
+    tok_mask = trg_in.mask(jnp.float32)
+    if zigzag:
+        # logits are in zigzag order; align labels + mask the same way
+        # (masked CE is permutation-invariant, so no unpermute needed)
+        order = _zigzag_idx(labels.shape[1], mesh)
+        labels = labels[:, order]
+        tok_mask = tok_mask[:, order]
     v = logits.shape[-1]
     logp = jax.nn.log_softmax(logits, axis=-1)
     onehot = jax.nn.one_hot(labels, v)
     smoothed = onehot * (1 - label_smoothing) + label_smoothing / v
     per_tok = -jnp.sum(smoothed * logp, axis=-1)
-    per_seq = losses.masked_seq_mean(per_tok, trg_in.mask(per_tok.dtype))
+    per_seq = losses.masked_seq_mean(per_tok, tok_mask.astype(per_tok.dtype))
     return jnp.mean(per_seq)
 
 

@@ -191,7 +191,7 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
 
 def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
                          causal=False, key_mask=None, mesh=None,
-                         seq_axis="seq"):
+                         seq_axis="seq", zigzag=False):
     """Dense multi-head attention.  x_q: [B, Tq, D], x_kv: [B, Tk, D],
     wq/wk/wv: [D, D], wo: [D, D].  key_mask: [B, Tk] padding validity
     (O(T); preferred over a materialized [Tq, Tk] mask).
@@ -211,7 +211,13 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
     q = split(x_q, wq, tq)
     k = split(x_kv, wk, tk)
     v = split(x_kv, wv, tk)
-    if mesh is not None and mesh.shape.get(seq_axis, 1) > 1:
+    ring_active = mesh is not None and mesh.shape.get(seq_axis, 1) > 1
+    if zigzag and not (ring_active and causal):
+        # fail fast: zigzag-ordered inputs under a plain causal mask would
+        # silently attend the future (mirrors transformer.decode's guard)
+        raise ValueError("zigzag=True requires causal=True and a mesh "
+                         f"whose {seq_axis!r} axis is > 1")
+    if ring_active:
         if mask is not None:
             raise ValueError("sequence-parallel attention needs key_mask "
                              "masking, not a materialized 2-D mask")
@@ -220,9 +226,18 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
                 "sequence-parallel causal attention requires Tq == Tk "
                 "(the ring has no tril-offset convention for unequal "
                 "lengths; self-attention always satisfies this)")
-        from paddle_tpu.parallel.ring_attention import ring_attention
-        out = ring_attention(q, k, v, mesh, axis_name=seq_axis,
-                             causal=causal, kv_mask=key_mask)
+        if zigzag and causal:
+            # balanced causal ring: caller feeds zigzag-ordered sequences
+            # (see parallel.ring_attention.zigzag_permute) — halved AND
+            # load-balanced attention per ring step
+            from paddle_tpu.parallel.ring_attention import (
+                ring_attention_zigzag)
+            out = ring_attention_zigzag(q, k, v, mesh, axis_name=seq_axis,
+                                        kv_mask=key_mask)
+        else:
+            from paddle_tpu.parallel.ring_attention import ring_attention
+            out = ring_attention(q, k, v, mesh, axis_name=seq_axis,
+                                 causal=causal, kv_mask=key_mask)
     else:
         out = dot_product_attention(q, k, v, mask=mask, causal=causal,
                                     key_mask=key_mask)
