@@ -129,3 +129,43 @@ def bucket_for(length: int, bounds) -> int:
         if length <= b:
             return b
     return bounds[-1]
+
+
+def pack_sequences(seqs, max_len, pad_value=0):
+    """Greedy first-fit packing of ragged sequences into [B, max_len] rows
+    — the ragged-attention half of the reference's no-padding claim
+    (Argument.sequenceStartPositions, parameter/Argument.h:84-93): several
+    short sequences share one row, and segment labels keep attention
+    block-diagonal per original sequence
+    (ops.attention.chunked_attention(q_segment_ids=...) / segment_mask).
+
+    Returns (data [B, max_len], segment_ids [B, max_len] — 1-based per
+    row, 0 = padding — and positions [B, max_len], the within-segment
+    token index for positional embeddings).  Sequences longer than
+    max_len are truncated.
+    """
+    rows = []          # list of (free, [seq, ...])
+    for s in seqs:
+        s = np.asarray(s)[:max_len]
+        placed = False
+        for row in rows:
+            if row[0] >= len(s):
+                row[1].append(s)
+                row[0] -= len(s)
+                placed = True
+                break
+        if not placed:
+            rows.append([max_len - len(s), [s]])
+    b = len(rows)
+    data = np.full((b, max_len), pad_value,
+                   rows[0][1][0].dtype if rows else np.int32)
+    seg = np.zeros((b, max_len), np.int32)
+    pos = np.zeros((b, max_len), np.int32)
+    for i, (_, members) in enumerate(rows):
+        t = 0
+        for j, s in enumerate(members):
+            data[i, t:t + len(s)] = s
+            seg[i, t:t + len(s)] = j + 1
+            pos[i, t:t + len(s)] = np.arange(len(s))
+            t += len(s)
+    return data, seg, pos

@@ -68,7 +68,8 @@ def online_softmax_block(q, k, v, m_prev, l_prev, acc, mask=None,
 
 
 def chunked_attention(q, k, v, scale=None, causal=False, key_mask=None,
-                      q_chunk=512, k_chunk=512):
+                      q_chunk=512, k_chunk=512, q_segment_ids=None,
+                      kv_segment_ids=None):
     """Flash-style attention in pure XLA: online-softmax accumulation over
     key chunks inside a scan over query chunks — O(T) memory on ANY
     backend (the CPU/interpret twin of ops.pallas.flash_attention, and the
@@ -79,7 +80,13 @@ def chunked_attention(q, k, v, scale=None, causal=False, key_mask=None,
     q: [B, H, Tq, D], k/v: [B, H, Tk, D]; key_mask: optional [B, Tk]
     validity (per-key, O(T) — a full [Tq, Tk] mask would defeat the
     point).  causal matches the dense path's tril offset (query i attends
-    keys <= i + Tk - Tq)."""
+    keys <= i + Tk - Tq).
+
+    q_segment_ids/kv_segment_ids: [B, T] int segment labels for PACKED
+    batches (core.sequence.pack_sequences) — attention is block-diagonal
+    per segment (q attends k iff labels match), computed per chunk pair so
+    the [Tq, Tk] segment mask is never materialized.  Padding rows carry
+    a label real segments never use."""
     b, h, tq, d = q.shape
     tk = k.shape[2]
     scale = scale if scale is not None else 1.0 / (float(d) ** 0.5)
@@ -95,21 +102,41 @@ def chunked_attention(q, k, v, scale=None, causal=False, key_mask=None,
     if pk_:
         k = jnp.pad(k, ((0, 0), (0, 0), (0, pk_), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, pk_), (0, 0)))
+    if kv_segment_ids is not None and q_segment_ids is None:
+        raise ValueError(
+            "kv_segment_ids without q_segment_ids: label the query side "
+            "too (a lone KV labeling would be silently dropped)")
+    segmented = q_segment_ids is not None
+    if segmented:
+        # pad labels with two DIFFERENT sentinels so padded q never
+        # matches padded k (and neither matches a real segment)
+        q_seg = jnp.pad(q_segment_ids.astype(jnp.int32),
+                        ((0, 0), (0, pq)), constant_values=-1)
+        kv_seg = jnp.pad((q_segment_ids if kv_segment_ids is None
+                          else kv_segment_ids).astype(jnp.int32),
+                         ((0, 0), (0, pk_)), constant_values=-2)
     nq, nk = (tq + pq) // q_chunk, (tk + pk_) // k_chunk
     qs = q.reshape(b, h, nq, q_chunk, d).transpose(2, 0, 1, 3, 4)
     ks = k.reshape(b, h, nk, k_chunk, d).transpose(2, 0, 1, 3, 4)
     vs = v.reshape(b, h, nk, k_chunk, d).transpose(2, 0, 1, 3, 4)
     kms = key_mask.reshape(b, nk, k_chunk).transpose(1, 0, 2)
+    qsegs = (q_seg.reshape(b, nq, q_chunk).transpose(1, 0, 2)
+             if segmented else jnp.zeros((nq, b, q_chunk), jnp.int32))
+    ksegs = (kv_seg.reshape(b, nk, k_chunk).transpose(1, 0, 2)
+             if segmented else jnp.zeros((nk, b, k_chunk), jnp.int32))
     off = tk - tq   # dense path's tril offset
     # f64 inputs keep f64 accumulation, matching the dense path's
     # promote_types behavior (no silent precision drop above the threshold)
     acc_dtype = jnp.promote_types(q.dtype, jnp.float32)
 
     @jax.checkpoint
-    def k_body(carry, inp, q_blk, qi):
+    def k_body(carry, inp, q_blk, qi, qseg_blk):
         m, l, acc = carry
-        k_blk, v_blk, km_blk, ki = inp
+        k_blk, v_blk, km_blk, kseg_blk, ki = inp
         keep = km_blk[:, None, None, :] > 0
+        if segmented:
+            keep = keep & (qseg_blk[:, :, None]
+                           == kseg_blk[:, None, :])[:, None]
         if causal:
             qpos = qi * q_chunk + jnp.arange(q_chunk) + off
             kpos = ki * k_chunk + jnp.arange(k_chunk)
@@ -127,16 +154,17 @@ def chunked_attention(q, k, v, scale=None, causal=False, key_mask=None,
         return jax.lax.cond(needed, update, lambda c: c, carry), None
 
     def q_body(_, inp):
-        q_blk, qi = inp
+        q_blk, qi, qseg_blk = inp
         init = (jnp.full((b, h, q_chunk), _NEG, acc_dtype),
                 jnp.zeros((b, h, q_chunk), acc_dtype),
                 jnp.zeros((b, h, q_chunk, d), acc_dtype))
         (m, l, acc), _ = jax.lax.scan(
-            functools.partial(k_body, q_blk=q_blk, qi=qi), init,
-            (ks, vs, kms, jnp.arange(nk)))
+            functools.partial(k_body, q_blk=q_blk, qi=qi,
+                              qseg_blk=qseg_blk), init,
+            (ks, vs, kms, ksegs, jnp.arange(nk)))
         return None, (acc / jnp.maximum(l[..., None], 1e-20)).astype(q.dtype)
 
-    _, outs = jax.lax.scan(q_body, None, (qs, jnp.arange(nq)))
+    _, outs = jax.lax.scan(q_body, None, (qs, jnp.arange(nq), qsegs))
     out = outs.transpose(1, 2, 0, 3, 4).reshape(b, h, nq * q_chunk, d)
     return out[:, :, :tq]
 
@@ -243,6 +271,17 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
                                     key_mask=key_mask)
     out = out.transpose(0, 2, 1, 3).reshape(b, tq, d)
     return matmul(out, wo)
+
+
+def segment_mask(q_segment_ids, kv_segment_ids=None):
+    """[B, Tq], [B, Tk] int labels -> [B, 1, Tq, Tk] block-diagonal
+    attention mask for PACKED batches (label 0 = padding, never matches).
+    O(T^2) — for long context pass the labels to chunked_attention
+    instead, which applies them per chunk pair."""
+    kv = q_segment_ids if kv_segment_ids is None else kv_segment_ids
+    same = q_segment_ids[:, None, :, None] == kv[:, None, None, :]
+    return same & (q_segment_ids[:, None, :, None] > 0) \
+        & (kv[:, None, None, :] > 0)
 
 
 def padding_mask(q_len_mask, k_len_mask):
