@@ -1,4 +1,5 @@
 from paddle_tpu.core import dtypes
-from paddle_tpu.core.sequence import SequenceBatch
+from paddle_tpu.core.sequence import (SequenceBatch, pack_sequences,
+                                      pad_sequences)
 
-__all__ = ["dtypes", "SequenceBatch"]
+__all__ = ["dtypes", "SequenceBatch", "pack_sequences", "pad_sequences"]
