@@ -170,7 +170,8 @@ def chunked_attention(q, k, v, scale=None, causal=False, key_mask=None,
 
 
 def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
-                          use_flash=None, key_mask=None):
+                          use_flash=None, key_mask=None,
+                          q_segment_ids=None, kv_segment_ids=None):
     """q: [B, H, Tq, Dh], k/v: [B, H, Tk, Dh] -> [B, H, Tq, Dh].
 
     Softmax in f32 (TPU numerics), logits computed on the MXU in bf16.
@@ -186,12 +187,19 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
     """
     if mask is not None and key_mask is not None:
         raise ValueError("pass mask or key_mask, not both")
-    if use_flash and key_mask is not None:
+    segmented = q_segment_ids is not None
+    if kv_segment_ids is not None and not segmented:
+        raise ValueError(
+            "kv_segment_ids without q_segment_ids: label the query side "
+            "too (a lone KV labeling would be silently dropped)")
+    if use_flash and (mask is not None or key_mask is not None
+                      or segmented):
         raise ValueError("the flash kernel has no mask support; drop "
-                         "use_flash=True or the key_mask")
+                         "use_flash=True or the masking")
     if use_flash is None:
         from paddle_tpu.ops import pallas as pk
         use_flash = (pk.use_pallas() and mask is None and key_mask is None
+                     and not segmented
                      and q.shape[2] % 128 == 0 and k.shape[2] % 128 == 0
                      and (not causal or q.shape[2] == k.shape[2]))
     if use_flash:
@@ -199,8 +207,15 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
         return flash_attention(q, k, v, scale=scale, causal=causal)
     if mask is None and q.shape[2] * k.shape[2] >= _CHUNKED_MIN:
         return chunked_attention(q, k, v, scale=scale, causal=causal,
-                                 key_mask=key_mask)
-    if key_mask is not None:
+                                 key_mask=key_mask,
+                                 q_segment_ids=q_segment_ids,
+                                 kv_segment_ids=kv_segment_ids)
+    if segmented:
+        seg = segment_mask(q_segment_ids, kv_segment_ids)
+        mask = seg if mask is None else (mask & seg)
+        if key_mask is not None:
+            mask = mask & (key_mask[:, None, None, :] > 0)
+    elif key_mask is not None:
         mask = key_mask[:, None, None, :] > 0
     dh = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(float(dh))
@@ -219,7 +234,8 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
 
 def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
                          causal=False, key_mask=None, mesh=None,
-                         seq_axis="seq", zigzag=False):
+                         seq_axis="seq", zigzag=False,
+                         q_segment_ids=None, kv_segment_ids=None):
     """Dense multi-head attention.  x_q: [B, Tq, D], x_kv: [B, Tk, D],
     wq/wk/wv: [D, D], wo: [D, D].  key_mask: [B, Tk] padding validity
     (O(T); preferred over a materialized [Tq, Tk] mask).
@@ -240,6 +256,11 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
     k = split(x_kv, wk, tk)
     v = split(x_kv, wv, tk)
     ring_active = mesh is not None and mesh.shape.get(seq_axis, 1) > 1
+    if ring_active and (q_segment_ids is not None
+                        or kv_segment_ids is not None):
+        raise ValueError("segment-packed attention is not wired into the "
+                         "ring yet; use a data-parallel mesh for packed "
+                         "batches")
     if zigzag and not (ring_active and causal):
         # fail fast: zigzag-ordered inputs under a plain causal mask would
         # silently attend the future (mirrors transformer.decode's guard)
@@ -268,7 +289,9 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
                                  causal=causal, kv_mask=key_mask)
     else:
         out = dot_product_attention(q, k, v, mask=mask, causal=causal,
-                                    key_mask=key_mask)
+                                    key_mask=key_mask,
+                                    q_segment_ids=q_segment_ids,
+                                    kv_segment_ids=kv_segment_ids)
     out = out.transpose(0, 2, 1, 3).reshape(b, tq, d)
     return matmul(out, wo)
 

@@ -110,3 +110,42 @@ def test_kv_segments_without_q_segments_raises(np_rng):
     with pytest.raises(ValueError, match="label the query side"):
         att.chunked_attention(x, x, x,
                               kv_segment_ids=jnp.ones((1, 8), jnp.int32))
+
+
+def test_mha_level_segment_attention(np_rng):
+    """Packed batches work through the standard MHA entry point: outputs
+    at each segment's positions equal running that segment alone."""
+    D_MODEL = H * D
+    seqs = [np_rng.randint(0, 9, n) for n in (5, 3, 6)]
+    _, seg, _ = pack_sequences(seqs, max_len=8)
+    b, t = seg.shape
+    x = jnp.asarray(np_rng.randn(b, t, D_MODEL) * 0.5, jnp.float32)
+    w = {k: jnp.asarray(np_rng.randn(D_MODEL, D_MODEL) * 0.2, jnp.float32)
+         for k in "qkvo"}
+    segj = jnp.asarray(seg)
+    packed = att.multi_head_attention(
+        x, x, w["q"], w["k"], w["v"], w["o"], H, causal=True,
+        q_segment_ids=segj)
+    for i in range(b):
+        for s_id in np.unique(seg[i]):
+            if s_id == 0:
+                continue
+            idx = np.where(seg[i] == s_id)[0]
+            alone = att.multi_head_attention(
+                x[i : i + 1, idx], x[i : i + 1, idx], w["q"], w["k"],
+                w["v"], w["o"], H, causal=True)
+            np.testing.assert_allclose(np.asarray(packed)[i, idx],
+                                       np.asarray(alone)[0], atol=2e-5)
+
+
+def test_mha_segment_ring_combination_rejected(np_rng):
+    from paddle_tpu.parallel import MeshConfig, make_mesh
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    D_MODEL = H * D
+    x = jnp.asarray(np_rng.randn(2, 16, D_MODEL), jnp.float32)
+    w = jnp.eye(D_MODEL)
+    with pytest.raises(ValueError, match="not wired into the ring"):
+        att.multi_head_attention(x, x, w, w, w, w, H, mesh=mesh,
+                                 q_segment_ids=jnp.ones((2, 16), jnp.int32))
