@@ -475,8 +475,10 @@ def bench_transformer(batch=32, seq_len=256, vocab=32000, d_model=512,
     src, trg = mk(), mk()
 
     # default: recompute per block once the token count reaches the 32k
-    # scaling point (batch*seq >= 32768)
-    remat = _env_remat(batch * seq_len >= 32768)
+    # scaling point (batch*seq >= 32768) OR the sequence itself is long
+    # (transformer_long: per-layer [B, 8192, D] activations + the 32k-
+    # vocab logits leave little HBM headroom without remat)
+    remat = _env_remat(batch * seq_len >= 32768 or seq_len >= 4096)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, src, trg):
