@@ -57,10 +57,11 @@ def init(rng, src_vocab=30000, trg_vocab=30000, d_model=512, num_heads=8,
 
 
 def _mha(blk, xq, xkv, num_heads, key_mask=None, causal=False, mesh=None,
-         zigzag=False):
+         zigzag=False, q_segment_ids=None):
     return attn_ops.multi_head_attention(
         xq, xkv, blk["wq"], blk["wk"], blk["wv"], blk["wo"], num_heads,
-        key_mask=key_mask, causal=causal, mesh=mesh, zigzag=zigzag)
+        key_mask=key_mask, causal=causal, mesh=mesh, zigzag=zigzag,
+        q_segment_ids=q_segment_ids)
 
 
 def _ffn(blk, x):
@@ -96,10 +97,10 @@ def _check_full(seq: SequenceBatch):
             "pack the batch")
 
 
-def _enc_block(blk, x, key_mask, num_heads, mesh=None):
+def _enc_block(blk, x, key_mask, num_heads, mesh=None, segment_ids=None):
     h = _ln(blk["ln1"], x)
     x = x + _mha(blk["attn"], h, h, num_heads, key_mask=key_mask,
-                 mesh=mesh)
+                 mesh=mesh, q_segment_ids=segment_ids)
     return x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
 
 
@@ -114,28 +115,48 @@ def _dec_block(blk, x, enc_out, self_km, cross_km, num_heads, mesh=None,
 
 
 def encode(params, src: SequenceBatch, num_heads=8, remat=False,
-           full_seq=False, mesh=None):
+           full_seq=False, mesh=None, segment_ids=None, positions=None):
     """remat=True checkpoints each block (jax.checkpoint): backward
     recomputes activations instead of storing them — the HBM headroom for
     >=32k-token batches.
 
     mesh: a mesh whose `seq` axis is >1 runs every attention sequence-
     parallel via the ppermute ring (callers shard the T dim of the feeds
-    over that axis) — long-context training across chips."""
+    over that axis) — long-context training across chips.
+
+    segment_ids/positions: PACKED rows (core.sequence.pack_sequences —
+    several short sequences per row): attention stays block-diagonal per
+    segment and each token's positional row is its within-segment index,
+    so the encoder behaves exactly as if every sequence ran alone."""
     t = src.data.shape[1]
     block = (jax.checkpoint(_enc_block, static_argnums=(3, 4)) if remat
              else _enc_block)
+    if (segment_ids is None) != (positions is None):
+        raise ValueError("packed encode needs BOTH segment_ids and "
+                         "positions (pack_sequences returns them "
+                         "together)")
     x = emb_ops.embedding_lookup(params["src_emb"], src.data)
-    x = x * math.sqrt(x.shape[-1]) + params["pos"][:t][None]
+    if positions is not None and not isinstance(positions, jax.core.Tracer):
+        max_pos = int(jnp.max(positions))
+        if max_pos >= params["pos"].shape[0]:
+            # fail fast like the unpacked path and init_decode_cache do;
+            # the gather would otherwise silently clamp to the last row
+            raise ValueError(
+                f"packed position {max_pos} exceeds the positional table "
+                f"({params['pos'].shape[0]}); re-init with a larger "
+                "max_len or pack shorter rows")
+    pos_rows = (params["pos"][positions] if positions is not None
+                else params["pos"][:t][None])
+    x = x * math.sqrt(x.shape[-1]) + pos_rows
     # key validity stays O(T) ([B, T]); full_seq=True promises every
     # sequence is max-length (packed/bucketed batches) and drops the mask
     # entirely so the flash/chunked O(T)-memory paths engage — validated
     # when lengths are concrete (a jit-traced batch is trusted)
-    key_mask = None if full_seq else src.mask()
+    key_mask = None if full_seq or segment_ids is not None else src.mask()
     if full_seq:
         _check_full(src)
     for blk in params["enc"]:
-        x = block(blk, x, key_mask, num_heads, mesh)
+        x = block(blk, x, key_mask, num_heads, mesh, segment_ids)
     return x
 
 

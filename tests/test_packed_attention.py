@@ -149,3 +149,59 @@ def test_mha_segment_ring_combination_rejected(np_rng):
     with pytest.raises(ValueError, match="not wired into the ring"):
         att.multi_head_attention(x, x, w, w, w, w, H, mesh=mesh,
                                  q_segment_ids=jnp.ones((2, 16), jnp.int32))
+
+
+def test_transformer_encode_packed_matches_alone(np_rng):
+    """transformer.encode on a packed row equals encoding each sequence
+    alone: segment-isolated attention + within-segment positions."""
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models import transformer
+
+    V, DM, HEADS, MAXLEN = 32, 16, 2, 12
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=V,
+                              trg_vocab=V, d_model=DM, dff=32,
+                              enc_layers=2, dec_layers=1, max_len=MAXLEN)
+    seqs = [np_rng.randint(3, V, n) for n in (5, 4, 7, 3)]
+    data, seg, pos = pack_sequences(seqs, max_len=MAXLEN)
+    b = data.shape[0]
+    packed = transformer.encode(
+        params,
+        SequenceBatch(jnp.asarray(data), jnp.full((b,), MAXLEN, jnp.int32)),
+        num_heads=HEADS, segment_ids=jnp.asarray(seg),
+        positions=jnp.asarray(pos))
+    # oracle: each sequence alone (full-length row of its own size)
+    for i in range(b):
+        for s_id in np.unique(seg[i]):
+            if s_id == 0:
+                continue
+            idx = np.where(seg[i] == s_id)[0]
+            ids = data[i, idx][None]
+            alone = transformer.encode(
+                params,
+                SequenceBatch(jnp.asarray(ids),
+                              jnp.asarray([len(idx)], jnp.int32)),
+                num_heads=HEADS)
+            np.testing.assert_allclose(np.asarray(packed)[i, idx],
+                                       np.asarray(alone)[0], atol=3e-5)
+    # both-or-neither guard
+    with pytest.raises(ValueError, match="BOTH segment_ids"):
+        transformer.encode(
+            params,
+            SequenceBatch(jnp.asarray(data),
+                          jnp.full((b,), MAXLEN, jnp.int32)),
+            num_heads=HEADS, segment_ids=jnp.asarray(seg))
+
+
+def test_packed_positions_overflow_raises(np_rng):
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models import transformer
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=32,
+                              trg_vocab=32, d_model=16, dff=32,
+                              enc_layers=1, dec_layers=1, max_len=4)
+    data, seg, pos = pack_sequences([np.arange(3, 9)], max_len=8)
+    with pytest.raises(ValueError, match="positional table"):
+        transformer.encode(
+            params,
+            SequenceBatch(jnp.asarray(data), jnp.asarray([8], jnp.int32)),
+            num_heads=2, segment_ids=jnp.asarray(seg),
+            positions=jnp.asarray(pos))
