@@ -134,3 +134,42 @@ def mix(readers_and_ratios, seed=0):
             except StopIteration:
                 alive[i] = False
     return new_reader
+
+
+def packed(reader, max_len, buffer_size=256, pad_value=0):
+    """Pack a reader of ragged token sequences into (data, segment_ids,
+    positions) rows of width max_len (core.sequence.pack_sequences):
+    several short sequences share a row, attention stays block-diagonal
+    per segment (ops.attention q_segment_ids / transformer.encode
+    segment_ids=...).  Buffers `buffer_size` sequences per packing round
+    so first-fit has material to work with; yields one packed ROW per
+    item (compose with batch() for [B, max_len] feeds).  Sequences longer
+    than max_len are TRUNCATED to it (warned once per stream — split long
+    documents upstream if the tail matters)."""
+    from paddle_tpu.core.sequence import pack_sequences
+    from paddle_tpu.utils.logging import logger
+
+    def new_reader():
+        buf = []
+        warned = [False]
+
+        def flush():
+            data, seg, pos = pack_sequences(buf, max_len,
+                                            pad_value=pad_value)
+            for i in range(data.shape[0]):
+                yield data[i], seg[i], pos[i]
+            buf.clear()
+
+        for s in reader():
+            if len(s) > max_len and not warned[0]:
+                warned[0] = True
+                logger.warning(
+                    "packed(): sequence of %d tokens truncated to "
+                    "max_len=%d (further truncations not logged)",
+                    len(s), max_len)
+            buf.append(s)
+            if len(buf) >= buffer_size:
+                yield from flush()
+        if buf:
+            yield from flush()
+    return new_reader
