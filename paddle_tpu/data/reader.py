@@ -156,9 +156,12 @@ def packed(reader, max_len, buffer_size=256, pad_value=0):
         def flush():
             data, seg, pos = pack_sequences(buf, max_len,
                                             pad_value=pad_value)
+            # clear BEFORE yielding: a consumer that abandons the stream
+            # mid-flush (zip with a shorter iterator) must not leave the
+            # buffer populated in the suspended frame
+            buf.clear()
             for i in range(data.shape[0]):
                 yield data[i], seg[i], pos[i]
-            buf.clear()
 
         for s in reader():
             if len(s) > max_len and not warned[0]:

@@ -95,6 +95,16 @@ def gpipe(stage_fn, stacked_params, x_mb, *, mesh: Mesh,
         def tick(carry, t):
             # carry: my previous tick's output, about to move one stage up
             recv = jax.lax.ppermute(carry, axis_name, perm)
+            # drain ticks (t >= m) re-feed the clamped last microbatch;
+            # the duplicates are discarded by the caller's output slice.
+            # Deliberately NOT a zero feed: a stage_fn that is non-finite
+            # at zero input (eps-free normalization, division by a norm)
+            # would produce NaN drain activations, and NaN * 0-cotangent
+            # = NaN poisons the summed parameter gradients under grad.
+            # A real microbatch keeps every tick finite, and its zero
+            # cotangent then contributes an exact 0.  (No FLOPs are
+            # wasted relative to any alternative — the scan body runs
+            # every tick regardless.)
             feed = jax.tree_util.tree_map(
                 lambda a: a[jnp.minimum(t, m - 1)], x_l)
             x_in = jax.tree_util.tree_map(

@@ -49,8 +49,15 @@ def wait_pending(save_dir=None):
         with _pending_lock:
             exc = _pending_exc.pop(k, None)
             _pending.pop(k, None)
-        if exc is not None and first_exc is None:
-            first_exc = exc
+        if exc is not None:
+            if first_exc is None:
+                first_exc = exc
+            else:
+                # don't drop the rest on the floor: the first one is
+                # re-raised, the others at least leave a trace
+                from paddle_tpu.utils.logging import logger
+                logger.error("async checkpoint save to %s also failed: %r",
+                             k, exc)
     if first_exc is not None:
         raise first_exc
 

@@ -406,10 +406,19 @@ def main(argv=None):
                 durs.append((_time.perf_counter() - t0) * 1e3)
         durs.sort()
         n = len(durs)
-        pct = lambda p: durs[min(n - 1, int(p * n))]
-        print(f"time: {n} batches  p50={pct(0.5):.2f}ms  "
-              f"p90={pct(0.9):.2f}ms  p99={pct(0.99):.2f}ms  "
-              f"mean={sum(durs) / n:.2f}ms")
+        if n < 100:
+            # with few samples a "p99" is just the max — don't overstate
+            # fidelity with percentile labels
+            print(f"time: {n} batches  min={durs[0]:.2f}ms  "
+                  f"mean={sum(durs) / n:.2f}ms  max={durs[-1]:.2f}ms")
+        else:
+            import numpy as _np
+            # same estimator as utils.stats.Histogram so the trainer's
+            # pass-end log and this job agree on what "p99" means
+            p50, p90, p99 = _np.percentile(durs, [50, 90, 99])
+            print(f"time: {n} batches  p50={p50:.2f}ms  "
+                  f"p90={p90:.2f}ms  p99={p99:.2f}ms  "
+                  f"mean={sum(durs) / n:.2f}ms")
         return 0
 
 

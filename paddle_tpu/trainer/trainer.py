@@ -466,6 +466,7 @@ class SGD:
 
         self._stop_signal = None
         prev_handler = None
+        handler_armed = False
         # single-process only: in multi-process SPMD, acting on a local
         # signal would diverge the ranks mid-collective (skewed delivery)
         # — there the launcher's fail-fast SIGTERM + pass-checkpoint
@@ -479,6 +480,7 @@ class SGD:
                             "checkpointing to %s", save_dir)
             try:
                 prev_handler = _signal.signal(_signal.SIGTERM, _request_stop)
+                handler_armed = True
             except ValueError:      # not the main thread — feature off
                 prev_handler = None
 
@@ -607,12 +609,20 @@ class SGD:
             # durability + handler restoration even when an exception
             # unwinds out of the loop (a leaked handler would make the
             # process unkillable by SIGTERM)
-            if save_dir:
-                from paddle_tpu.trainer import checkpoint as _ckpt
-                _ckpt.wait_pending()
-            if prev_handler is not None:
-                import signal as _signal
-                _signal.signal(_signal.SIGTERM, prev_handler)
+            try:
+                if save_dir:
+                    from paddle_tpu.trainer import checkpoint as _ckpt
+                    _ckpt.wait_pending(save_dir)
+            finally:
+                # restore even when wait_pending re-raises a save failure;
+                # signal.signal() returns None when the prior handler was
+                # installed outside Python, so gate on the armed flag, not
+                # the returned value
+                if handler_armed:
+                    import signal as _signal
+                    _signal.signal(_signal.SIGTERM,
+                                   prev_handler if prev_handler is not None
+                                   else _signal.SIG_DFL)
 
 
     def train_one_batch(self, batch, feeder=None):

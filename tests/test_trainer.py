@@ -573,4 +573,18 @@ def test_cli_time_job(tmp_path, capsys):
                    "--warmup", "1"])
     assert not rc
     out = capsys.readouterr().out
-    assert "p50=" in out and "p99=" in out and "4 batches" in out
+    # few samples: percentile labels would overstate fidelity, so the
+    # job reports min/mean/max instead
+    assert "4 batches" in out and "min=" in out and "max=" in out
+    assert "p99=" not in out
+
+
+def test_cli_time_job_percentiles(tmp_path, capsys):
+    conf = tmp_path / "conf.py"
+    _write_tiny_conf(conf, n_samples=816)          # 102 batches of 8
+    from paddle_tpu.trainer import cli
+    rc = cli.main(["time", "--config", str(conf), "--num_batches", "100",
+                   "--warmup", "1"])
+    assert not rc
+    out = capsys.readouterr().out
+    assert "100 batches" in out and "p50=" in out and "p99=" in out
