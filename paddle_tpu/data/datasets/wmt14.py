@@ -1,5 +1,12 @@
 """WMT14 fr-en NMT (reference v2/dataset/wmt14.py: (src_ids, trg_ids,
-trg_next_ids) triples with <s>/<e>/<unk>)."""
+trg_next_ids) triples with <s>/<e>/<unk>).
+
+This module is the small-vocab API-parity surface (zero-egress synthetic
+corpus, same triple format).  The REFERENCE-SCALE run — 30k vocab, the
+reference's demo/seqToseq preprocess.py pipeline role — lives in
+scripts/nmt_scale.py, which builds the full-size config and drives the
+flagship attention-NMT model through the trainer (see docs/perf.md for
+its on-chip milestones)."""
 
 import numpy as np
 

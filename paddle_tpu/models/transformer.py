@@ -137,7 +137,12 @@ def encode(params, src: SequenceBatch, num_heads=8, remat=False,
                          "together)")
     x = emb_ops.embedding_lookup(params["src_emb"], src.data)
     if positions is not None and not isinstance(positions, jax.core.Tracer):
-        max_pos = int(jnp.max(positions))
+        try:
+            max_pos = int(jnp.max(positions))
+        except jax.errors.ConcretizationTypeError:
+            # inside a jit trace even closed-over constants are staged;
+            # the eager-path check below is best-effort only
+            max_pos = -1
         if max_pos >= params["pos"].shape[0]:
             # fail fast like the unpacked path and init_decode_cache do;
             # the gather would otherwise silently clamp to the last row

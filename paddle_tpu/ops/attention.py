@@ -244,7 +244,10 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
     PARALLEL through the ppermute ring (parallel/ring_attention): callers
     shard T over that axis and each device holds T/n — the long-context
     training plane.  Requires key_mask-style masking (a 2-D mask has no
-    O(T) sharding)."""
+    O(T) sharding).  q_segment_ids/kv_segment_ids compose with the ring:
+    the KV labels rotate with K/V so packed rows stay block-diagonal
+    per segment under sequence parallelism (zigzag included — permute
+    the labels like the tokens)."""
     b, tq, d = x_q.shape
     tk = x_kv.shape[1]
     dh = d // num_heads
@@ -256,11 +259,6 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
     k = split(x_kv, wk, tk)
     v = split(x_kv, wv, tk)
     ring_active = mesh is not None and mesh.shape.get(seq_axis, 1) > 1
-    if ring_active and (q_segment_ids is not None
-                        or kv_segment_ids is not None):
-        raise ValueError("segment-packed attention is not wired into the "
-                         "ring yet; use a data-parallel mesh for packed "
-                         "batches")
     if zigzag and not (ring_active and causal):
         # fail fast: zigzag-ordered inputs under a plain causal mask would
         # silently attend the future (mirrors transformer.decode's guard)
@@ -278,15 +276,20 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
         if zigzag and causal:
             # balanced causal ring: caller feeds zigzag-ordered sequences
             # (see parallel.ring_attention.zigzag_permute) — halved AND
-            # load-balanced attention per ring step
+            # load-balanced attention per ring step.  Segment labels (if
+            # any) must be zigzag-permuted alongside the tokens.
             from paddle_tpu.parallel.ring_attention import (
                 ring_attention_zigzag)
             out = ring_attention_zigzag(q, k, v, mesh, axis_name=seq_axis,
-                                        kv_mask=key_mask)
+                                        kv_mask=key_mask,
+                                        q_segment_ids=q_segment_ids,
+                                        kv_segment_ids=kv_segment_ids)
         else:
             from paddle_tpu.parallel.ring_attention import ring_attention
             out = ring_attention(q, k, v, mesh, axis_name=seq_axis,
-                                 causal=causal, kv_mask=key_mask)
+                                 causal=causal, kv_mask=key_mask,
+                                 q_segment_ids=q_segment_ids,
+                                 kv_segment_ids=kv_segment_ids)
     else:
         out = dot_product_attention(q, k, v, mask=mask, causal=causal,
                                     key_mask=key_mask,

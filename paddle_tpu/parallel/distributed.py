@@ -95,3 +95,48 @@ def barrier(name: str = "barrier"):
         return
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices(name)
+
+
+def step_skew_report(durations, name="train_step"):
+    """Cross-rank straggler/skew report — the SPMD successor to the
+    reference's per-trainer BarrierStat arrival profiling
+    (utils/BarrierStat.h:196-273, logged per --log_period_server).
+
+    In synchronous SPMD the collectives themselves equalize device time,
+    so the straggler signal lives in each rank's HOST-side step wall
+    time (input pipeline, Python dispatch, H2D feeds): a rank that
+    arrives late at its next collective stalls every other rank.  Each
+    rank passes its recent per-step wall durations (seconds); the stats
+    are all-gathered (so this is a COLLECTIVE — every rank must call it
+    at the same step, even with an empty window: the gather always runs,
+    so ranks can't deadlock on divergent emptiness) and every rank
+    returns the same report string; the coordinator also logs it.
+    Returns None when every rank's window was empty."""
+    durations = np.asarray(durations, np.float64).reshape(-1)
+    if durations.size:
+        local = np.asarray([
+            float(np.percentile(durations, 50)),
+            float(np.percentile(durations, 99)),
+            float(np.mean(durations)),
+            float(durations.size)], np.float32)
+    else:
+        local = np.zeros((4,), np.float32)
+    if jax.process_count() == 1:
+        all_stats = local[None]
+    else:
+        from jax.experimental import multihost_utils
+        all_stats = np.asarray(multihost_utils.process_allgather(local))
+    if not all_stats[:, 3].any():
+        return None
+    p50s, p99s = all_stats[:, 0], all_stats[:, 1]
+    slowest = int(np.argmax(p50s))
+    lo = max(float(p50s.min()), 1e-9)
+    spread_pct = (float(p50s.max()) - float(p50s.min())) / lo * 100.0
+    per_rank = " ".join(
+        f"r{i}[p50={p * 1e3:.1f}ms p99={q * 1e3:.1f}ms]"
+        for i, (p, q) in enumerate(zip(p50s, p99s)))
+    report = (f"{name} skew ({int(all_stats[:, 3].max())} steps/rank): "
+              f"{per_rank} | slowest=r{slowest} p50-spread={spread_pct:.0f}%")
+    if is_coordinator():
+        logger.info(report)
+    return report

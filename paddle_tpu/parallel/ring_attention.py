@@ -30,25 +30,57 @@ def _block_attn(q, k, v, m_prev, l_prev, acc, mask=None, scale=1.0):
                                 scale=scale)
 
 
+def _resolve_segments(q, k, q_segment_ids, kv_segment_ids):
+    """Shared validation/defaulting for the segment-packed ring paths.
+
+    Returns (segmented, q_seg, kv_seg) where the seg arrays are int32
+    [B, T] (zeros when unsegmented, so shard_map specs stay static).
+    Semantics match ops.attention.chunked_attention: q attends k iff
+    labels are equal — padding (label 0) only ever matches padding, so
+    real queries never see padded keys and padded query rows produce
+    garbage that masked losses drop."""
+    if kv_segment_ids is not None and q_segment_ids is None:
+        raise ValueError(
+            "kv_segment_ids without q_segment_ids: label the query side "
+            "too (a lone KV labeling would be silently dropped)")
+    segmented = q_segment_ids is not None
+    if not segmented:
+        return (False, jnp.zeros((q.shape[0], q.shape[2]), jnp.int32),
+                jnp.zeros((k.shape[0], k.shape[2]), jnp.int32))
+    if kv_segment_ids is None and k.shape[2] != q.shape[2]:
+        raise ValueError(
+            "q_segment_ids with Tq != Tk needs explicit kv_segment_ids")
+    return (True, q_segment_ids.astype(jnp.int32),
+            (q_segment_ids if kv_segment_ids is None
+             else kv_segment_ids).astype(jnp.int32))
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
-                   q_mask=None, kv_mask=None, scale=None):
+                   q_mask=None, kv_mask=None, scale=None,
+                   q_segment_ids=None, kv_segment_ids=None):
     """Sequence-parallel attention under shard_map.
 
     q/k/v: [B, H, T, D] GLOBAL shapes, sharded over T on `axis_name`
     (caller annotates; this function builds its own shard_map).
     q_mask/kv_mask: [B, T] validity (global, sharded the same way).
+    q_segment_ids/kv_segment_ids: [B, T] int labels for PACKED rows
+    (core.sequence.pack_sequences) — the KV labels rotate around the
+    ring with K/V and attention stays block-diagonal per segment, so
+    long-context sharding composes with padding-free packing.
     Returns [B, H, T, D] sharded like q.
     """
     n = mesh.shape[axis_name]
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    segmented, q_seg, kv_seg = _resolve_segments(
+        q, k, q_segment_ids, kv_segment_ids)
 
-    def local_fn(q_l, k_l, v_l, qm_l, kvm_l):
+    def local_fn(q_l, k_l, v_l, qm_l, kvm_l, qseg_l, kvseg_l):
         # local shapes: [B, H, T/n, D]
         b, h, tq, d = q_l.shape
         my = jax.lax.axis_index(axis_name)
 
         def body(i, carry):
-            m, l, acc, k_blk, v_blk, kvm_blk = carry
+            m, l, acc, k_blk, v_blk, kvm_blk, kvseg_blk = carry
             # block owner index: blocks travel forward, so at step i we hold
             # the block originally on device (my - i) mod n
             src = (my - i) % n
@@ -60,6 +92,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
                 mask = None
                 if kvm_blk is not None:
                     mask = kvm_blk[:, None, None, :] > 0
+                if segmented:
+                    sm = (qseg_l[:, :, None]
+                          == kvseg_blk[:, None, :])[:, None]
+                    mask = sm if mask is None else (mask & sm)
                 if causal:
                     # global positions: q = my*tq + iq ; k = src*tq + ik
                     qpos = my * tq + jnp.arange(tq)
@@ -86,13 +122,17 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
             v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
             if kvm_blk is not None:
                 kvm_blk = jax.lax.ppermute(kvm_blk, axis_name, perm)
-            return m, l, acc, k_blk, v_blk, kvm_blk
+            if segmented:
+                # KV labels travel with their K/V block (unsegmented runs
+                # keep the dummy carry but skip the rotation)
+                kvseg_blk = jax.lax.ppermute(kvseg_blk, axis_name, perm)
+            return m, l, acc, k_blk, v_blk, kvm_blk, kvseg_blk
 
         m0 = jnp.full((b, h, tq), _NEG, jnp.float32)
         l0 = jnp.zeros((b, h, tq), jnp.float32)
         acc0 = jnp.zeros((b, h, tq, d), jnp.float32)
-        m, l, acc, _, _, _ = jax.lax.fori_loop(
-            0, n, body, (m0, l0, acc0, k_l, v_l, kvm_l))
+        m, l, acc = jax.lax.fori_loop(
+            0, n, body, (m0, l0, acc0, k_l, v_l, kvm_l, kvseg_l))[:3]
         out = acc / jnp.maximum(l[..., None], 1e-20)
         if qm_l is not None:
             out = out * (qm_l[:, None, :, None] > 0)
@@ -105,9 +145,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
     kvm = kv_mask if kv_mask is not None else jnp.ones(
         (k.shape[0], k.shape[2]), jnp.float32)
     fn = jax.shard_map(local_fn, mesh=mesh,
-                       in_specs=(spec, spec, spec, mspec, mspec),
+                       in_specs=(spec, spec, spec, mspec, mspec,
+                                 mspec, mspec),
                        out_specs=spec, check_vma=False)
-    return fn(q, k, v, qm, kvm)
+    return fn(q, k, v, qm, kvm, q_seg, kv_seg)
 
 
 def zigzag_order(t_global, n):
@@ -140,7 +181,8 @@ def zigzag_unpermute(x, n, axis=2):
 
 
 def ring_attention_zigzag(q, k, v, mesh: Mesh, axis_name="seq",
-                          q_mask=None, kv_mask=None, scale=None):
+                          q_mask=None, kv_mask=None, scale=None,
+                          q_segment_ids=None, kv_segment_ids=None):
     """CAUSAL ring attention over zigzag-ordered sequences: the balanced
     long-context training plane.
 
@@ -155,12 +197,19 @@ def ring_attention_zigzag(q, k, v, mesh: Mesh, axis_name="seq",
 
     q/k/v: [B, H, T, D] GLOBAL, already zigzag_permute'd and sharded over
     T on `axis_name`; q_mask/kv_mask [B, T] likewise (q_mask zeroes
-    padded query rows, matching ring_attention).  Returns zigzag-ordered
+    padded query rows, matching ring_attention).
+    q_segment_ids/kv_segment_ids: [B, T] PACKED-row labels, zigzag-
+    permuted like everything else — the segment-equality mask depends
+    only on label pairs, so it composes with any storage order, and the
+    causal comparison uses original global positions (pos()), which stay
+    correct for contiguous packed segments.  Returns zigzag-ordered
     output sharded like q (zigzag_unpermute to restore order)."""
     n = mesh.shape[axis_name]
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    segmented, q_seg, kv_seg = _resolve_segments(
+        q, k, q_segment_ids, kv_segment_ids)
 
-    def local_fn(q_l, k_l, v_l, qm_l, kvm_l):
+    def local_fn(q_l, k_l, v_l, qm_l, kvm_l, qseg_l, kvseg_l):
         b, h, tq, d = q_l.shape
         half = tq // 2
         my = jax.lax.axis_index(axis_name)
@@ -174,18 +223,25 @@ def ring_attention_zigzag(q, k, v, mesh: Mesh, axis_name="seq",
             return lo, hi
 
         def body(i, carry):
-            mlo, llo, alo, mhi, lhi, ahi, k_blk, v_blk, kvm_blk = carry
+            (mlo, llo, alo, mhi, lhi, ahi,
+             k_blk, v_blk, kvm_blk, kvseg_blk) = carry
             src = (my - i) % n
             klo, khi = split(k_blk, 2)
             vlo, vhi = split(v_blk, 2)
             kmlo, kmhi = split(kvm_blk, 1)
+            kslo, kshi = split(kvseg_blk, 1)
             qlo, qhi = split(q_l, 2)
+            qslo, qshi = split(qseg_l, 1)
             q_chunk = (my, 2 * n - 1 - my)
             k_chunk = (src, 2 * n - 1 - src)
 
-            def attend(qc, kc, q_, k_, v_, km_, carry, need_causal=True):
+            def attend(qc, kc, q_, k_, v_, km_, qs_, ks_, carry,
+                       need_causal=True):
                 m, l, acc = carry
                 mask = km_[:, None, None, :] > 0
+                if segmented:
+                    mask = mask & (qs_[:, :, None]
+                                   == ks_[:, None, :])[:, None]
                 if need_causal:
                     cm = pos(qc)[:, None] >= pos(kc)[None, :]
                     mask = mask & cm[None, None]
@@ -194,26 +250,29 @@ def ring_attention_zigzag(q, k, v, mesh: Mesh, axis_name="seq",
             # qhi x klo: always fully below the diagonal — padding mask
             # only, no causal comparison to build
             mhi, lhi, ahi = attend(q_chunk[1], k_chunk[0], qhi, klo, vlo,
-                                   kmlo, (mhi, lhi, ahi),
+                                   kmlo, qshi, kslo, (mhi, lhi, ahi),
                                    need_causal=False)
             # qlo x klo: needed iff my >= src
             mlo, llo, alo = jax.lax.cond(
                 my >= src,
                 lambda c: attend(q_chunk[0], k_chunk[0], qlo, klo, vlo,
-                                 kmlo, c),
+                                 kmlo, qslo, kslo, c),
                 lambda c: c, (mlo, llo, alo))
             # qhi x khi: needed iff src >= my
             mhi, lhi, ahi = jax.lax.cond(
                 src >= my,
                 lambda c: attend(q_chunk[1], k_chunk[1], qhi, khi, vhi,
-                                 kmhi, c),
+                                 kmhi, qshi, kshi, c),
                 lambda c: c, (mhi, lhi, ahi))
 
             perm = [(j, (j + 1) % n) for j in range(n)]
             k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
             v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
             kvm_blk = jax.lax.ppermute(kvm_blk, axis_name, perm)
-            return (mlo, llo, alo, mhi, lhi, ahi, k_blk, v_blk, kvm_blk)
+            if segmented:
+                kvseg_blk = jax.lax.ppermute(kvseg_blk, axis_name, perm)
+            return (mlo, llo, alo, mhi, lhi, ahi, k_blk, v_blk, kvm_blk,
+                    kvseg_blk)
 
         def init(hl):
             return (jnp.full((b, h, hl), _NEG, jnp.float32),
@@ -223,7 +282,7 @@ def ring_attention_zigzag(q, k, v, mesh: Mesh, axis_name="seq",
         (mlo, llo, alo), (mhi, lhi, ahi) = init(half), init(half)
         out = jax.lax.fori_loop(
             0, n, body,
-            (mlo, llo, alo, mhi, lhi, ahi, k_l, v_l, kvm_l))
+            (mlo, llo, alo, mhi, lhi, ahi, k_l, v_l, kvm_l, kvseg_l))
         mlo, llo, alo, mhi, lhi, ahi = out[:6]
         olo = alo / jnp.maximum(llo[..., None], 1e-20)
         ohi = ahi / jnp.maximum(lhi[..., None], 1e-20)
@@ -238,9 +297,10 @@ def ring_attention_zigzag(q, k, v, mesh: Mesh, axis_name="seq",
     kvm = kv_mask if kv_mask is not None else jnp.ones(
         (k.shape[0], k.shape[2]), jnp.float32)
     fn = jax.shard_map(local_fn, mesh=mesh,
-                       in_specs=(spec, spec, spec, mspec, mspec),
+                       in_specs=(spec, spec, spec, mspec, mspec,
+                                 mspec, mspec),
                        out_specs=spec, check_vma=False)
-    return fn(q, k, v, qm, kvm)
+    return fn(q, k, v, qm, kvm, q_seg, kv_seg)
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,

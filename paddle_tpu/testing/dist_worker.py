@@ -292,7 +292,9 @@ def _trainer_sparse(args, nproc, rank):
                                                        momentum=0.0),
              mesh=mesh, seed=3, donate=False)
     costs = []
-    tr.train(lambda: iter(batches), num_passes=2, log_period=0,
+    # log_period=6 fires the cross-rank straggler report twice per pass
+    # (12 batches), exported below for the test to assert on
+    tr.train(lambda: iter(batches), num_passes=2, log_period=6,
              event_handler=lambda e: costs.append(float(e.cost))
              if isinstance(e, events.EndIteration) else None)
 
@@ -312,6 +314,7 @@ def _trainer_sparse(args, nproc, rank):
                "emb_checksum": subtree_checksum("emb"),
                "fc_checksum": subtree_checksum("fc"),
                "global_devices": jax.device_count(),
+               "skew_report": tr.last_skew_report,
                "mode": "trainer-sparse"}
     with open(os.path.join(args.out_dir, f"rank{rank}.json"), "w") as f:
         _json.dump(out_rec, f)

@@ -153,6 +153,9 @@ class SGD:
         self._multiprocess = mesh is not None and any(
             d.process_index != jax.process_index()
             for d in np.asarray(mesh.devices).flat)
+        # latest cross-rank straggler report (parallel.distributed.
+        # step_skew_report), refreshed every log_period in multi-process runs
+        self.last_skew_report = None
         if mesh is not None:
             rules = sharding_rules
             if self._multiprocess:
@@ -527,6 +530,7 @@ class SGD:
                         cost_sum, replicated_shardings(cost_sum, self.mesh))
                 n_batches = 0
                 window = []
+                skew_window = []     # host-side step wall times this period
                 t0 = time.time()
                 for batch_id, batch in enumerate(batch_reader()):
                     feed = _normalize_feed(feeder(batch) if feeder
@@ -545,8 +549,10 @@ class SGD:
                     # per-step distribution (BarrierStat skew-profiling role):
                     # record this step's own delta, not the cumulative timer
                     from paddle_tpu.utils.stats import step_histogram
-                    step_histogram.add(time.perf_counter() - t_step)
+                    step_dt = time.perf_counter() - t_step
+                    step_histogram.add(step_dt)
                     cost_sum = cost_sum + cost
+                    skew_window.append(step_dt)
                     n_batches += 1
                     window.append(cost)
                     if self.evaluators:
@@ -558,6 +564,15 @@ class SGD:
                         logger.info("Pass %d Batch %d Cost %.5f (%.1f ms/batch)%s",
                                     pass_id, batch_id + 1, c, dt * 1e3,
                                     eval_log_suffix())
+                        if self._multiprocess:
+                            # cross-rank straggler diagnosis (the reference
+                            # BarrierStat role): collective — every rank
+                            # reaches this block at the same batch_id
+                            from paddle_tpu.parallel.distributed import (
+                                step_skew_report)
+                            self.last_skew_report = step_skew_report(
+                                skew_window)
+                        skew_window = []
                         t0 = time.time()
                     if (show_parameter_stats_period
                             and (batch_id + 1) % show_parameter_stats_period == 0):

@@ -227,6 +227,15 @@ def test_trainer_sparse_multiprocess_matches_single(tmp_path):
                                                   rel=1e-5)
     # and it learned
     assert two[0]["loss"] < 0.95 * two[0]["first_loss"]
+    # cross-rank straggler telemetry (the BarrierStat successor) fired:
+    # every rank carries the same report naming each rank's p50/p99
+    for r in two:
+        rep = r["skew_report"]
+        assert rep and "r0[p50=" in rep and "r1[p50=" in rep \
+            and "slowest=" in rep and "p50-spread=" in rep
+    assert two[0]["skew_report"] is not None
+    # single-process runs are not multiprocess: no collective, no report
+    assert one[0]["skew_report"] is None
 
 
 def test_cli_train_under_launcher(tmp_path):
