@@ -9,8 +9,17 @@ Usage:
   python extract_features.py embedding --model_dir DIR --param src_emb \
       --out emb.npz [--text emb.txt]
 
+  python extract_features.py import_torch --torch_file resnet50.pth \
+      --depth 50 --out_dir model   # torchvision key convention; BN
+                                   # running stats land in model_state
+
 With no --model_dir, randomly-initialized weights are used so the demo runs
-end-to-end without a download (the reference ships get_model.sh instead)."""
+end-to-end without a download (the reference ships get_model.sh instead).
+golden_features.npz pins the import path: features extracted through this
+CLI from the deterministic torchvision-convention checkpoint built by
+tests/test_model_zoo.py, which also proves them equal to torch's own
+forward on the same weights (regenerate by re-running the commands in
+test_model_zoo_demo_end_to_end)."""
 
 import argparse
 import os
@@ -70,6 +79,30 @@ def run_embedding(args):
         logger.info("wrote %s", args.text)
 
 
+def run_import_torch(args):
+    """Convert a torch checkpoint (torchvision ResNet key convention) into
+    a paddle_tpu pass dir — the reference's get_model.sh role: after this,
+    `resnet --model_dir` extracts features from the PRETRAINED weights
+    (reference demo/model_zoo/resnet/classify.py on a downloaded model)."""
+    import torch
+    from paddle_tpu.trainer.checkpoint import save_checkpoint
+    from paddle_tpu.utils.tools.torch_import import import_torchvision_resnet
+    sd = torch.load(args.torch_file, map_location="cpu", weights_only=True)
+    if isinstance(sd, dict) and "conv1.weight" not in sd:
+        sd = sd.get("state_dict", sd)   # wrapped checkpoints
+    if not isinstance(sd, dict) or "conv1.weight" not in sd:
+        raise SystemExit(
+            f"{args.torch_file}: expected a state_dict in torchvision "
+            "ResNet naming (conv1.weight, layer1.0..., fc.weight), got "
+            f"{type(sd).__name__}")
+    params, state = import_torchvision_resnet(sd, depth=args.depth)
+    save_checkpoint(args.out_dir, 0, params, model_state=state,
+                    extra={"imported_from": os.path.basename(args.torch_file),
+                           "depth": args.depth})
+    logger.info("imported %s (depth %d) -> %s/pass-00000",
+                args.torch_file, args.depth, args.out_dir)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     sub = p.add_subparsers(dest="what", required=True)
@@ -89,9 +122,16 @@ def main(argv=None):
                    help="params path to the table, e.g. src_emb or emb/w")
     e.add_argument("--out", default="embedding.npz")
     e.add_argument("--text", default=None)
+    t = sub.add_parser("import_torch")
+    t.add_argument("--torch_file", required=True,
+                   help=".pt/.pth state_dict in torchvision ResNet naming")
+    t.add_argument("--depth", type=int, default=50)
+    t.add_argument("--out_dir", required=True)
     args = p.parse_args(argv)
     if args.what == "resnet":
         run_resnet(args)
+    elif args.what == "import_torch":
+        run_import_torch(args)
     else:
         run_embedding(args)
 

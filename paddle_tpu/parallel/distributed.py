@@ -126,15 +126,19 @@ def step_skew_report(durations, name="train_step"):
     else:
         from jax.experimental import multihost_utils
         all_stats = np.asarray(multihost_utils.process_allgather(local))
-    if not all_stats[:, 3].any():
+    have = all_stats[:, 3] > 0
+    if not have.any():
         return None
     p50s, p99s = all_stats[:, 0], all_stats[:, 1]
-    slowest = int(np.argmax(p50s))
-    lo = max(float(p50s.min()), 1e-9)
-    spread_pct = (float(p50s.max()) - float(p50s.min())) / lo * 100.0
+    # ranks with an empty window are reported but excluded from the
+    # min/argmax/spread stats (their zeros would poison all three)
+    slowest = int(np.argmax(np.where(have, p50s, -np.inf)))
+    lo = max(float(p50s[have].min()), 1e-9)
+    spread_pct = (float(p50s[have].max()) - float(p50s[have].min())) \
+        / lo * 100.0
     per_rank = " ".join(
-        f"r{i}[p50={p * 1e3:.1f}ms p99={q * 1e3:.1f}ms]"
-        for i, (p, q) in enumerate(zip(p50s, p99s)))
+        f"r{i}[p50={p * 1e3:.1f}ms p99={q * 1e3:.1f}ms]" if h else f"r{i}[--]"
+        for i, (p, q, h) in enumerate(zip(p50s, p99s, have)))
     report = (f"{name} skew ({int(all_stats[:, 3].max())} steps/rank): "
               f"{per_rank} | slowest=r{slowest} p50-spread={spread_pct:.0f}%")
     if is_coordinator():

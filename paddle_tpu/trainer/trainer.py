@@ -552,7 +552,10 @@ class SGD:
                     step_dt = time.perf_counter() - t_step
                     step_histogram.add(step_dt)
                     cost_sum = cost_sum + cost
-                    skew_window.append(step_dt)
+                    if self._multiprocess and log_period:
+                        # only consumed by the cross-rank report below;
+                        # don't accumulate a pass-long list otherwise
+                        skew_window.append(step_dt)
                     n_batches += 1
                     window.append(cost)
                     if self.evaluators:

@@ -1,11 +1,17 @@
 """Import torch parameters into a paddle_tpu params pytree (the modern
 counterpart of python/paddle/utils/torch2paddle.py, which converted torch7
-binary weight files).
+binary weight files, feeding demo/model_zoo's pretrained-model scripts).
 
 Matching is by explicit mapping {params_path: tensor_name} or, with
-mapping=None, positionally over leaves in declaration order with automatic
-transposition of 2-D kernels (torch nn.Linear stores [out, in]; our fc
-kernels are [in, out])."""
+mapping=None, positionally over leaves in declaration order.  Layout
+conversions are automatic when shapes demand them: 2-D kernels transpose
+(torch nn.Linear stores [out, in]; our fc kernels are [in, out]) and 4-D
+conv kernels permute (torch [out, in, kh, kw] -> our NHWC [kh, kw, in, out]).
+
+`resnet_mapping(depth)` emits the full torchvision-convention key map for
+the ImageNet ResNets (models/resnet.py mirrors torchvision's v1.5 layout:
+stride on the 3x3), so real torchvision checkpoints — or anything saved
+with their key names — import directly, BN running stats included."""
 
 import numpy as np
 
@@ -51,8 +57,67 @@ def from_torch_state_dict(params, state_dict, mapping=None,
         if arr.shape != cur.shape and transpose_linear and arr.ndim == 2 \
                 and arr.T.shape == cur.shape:
             arr = arr.T
+        if arr.shape != cur.shape and arr.ndim == 4 \
+                and arr.transpose(2, 3, 1, 0).shape == cur.shape:
+            # torch conv [out, in, kh, kw] -> NHWC kernel [kh, kw, in, out]
+            arr = arr.transpose(2, 3, 1, 0)
         if arr.shape != cur.shape:
             raise ValueError(f"shape mismatch at {'/'.join(path)}: "
                              f"torch {arr.shape} vs params {cur.shape}")
         target[path[-1]] = jnp.asarray(arr, cur.dtype)
     return out
+
+
+def resnet_mapping(depth=50):
+    """Key maps from models/resnet.py's ImageNet pytree to torchvision's
+    state_dict convention (conv1/bn1, layer{1-4}.{i}.conv{1-3}/bn{1-3}/
+    downsample.{0,1}, fc).  Returns (param_mapping, state_mapping):
+    param_mapping feeds from_torch_state_dict on the params pytree,
+    state_mapping on the BN-running-stats state pytree."""
+    table = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+    if depth not in table:
+        raise ValueError(
+            f"resnet_mapping supports bottleneck depths {sorted(table)}; "
+            f"got {depth} (18/34 are BasicBlock models with a different "
+            "key structure)")
+    blocks_per = table[depth]
+    pm = {"stem/w": "conv1.weight",
+          "stem/bn/gamma": "bn1.weight", "stem/bn/beta": "bn1.bias",
+          "head/w": "fc.weight", "head/b": "fc.bias"}
+    sm = {"stem/mean": "bn1.running_mean", "stem/var": "bn1.running_var"}
+    for si, n in enumerate(blocks_per):
+        for bi in range(n):
+            ours, theirs = f"s{si}b{bi}", f"layer{si + 1}.{bi}"
+            for ci in (1, 2, 3):
+                pm[f"{ours}/c{ci}/w"] = f"{theirs}.conv{ci}.weight"
+                pm[f"{ours}/c{ci}/bn/gamma"] = f"{theirs}.bn{ci}.weight"
+                pm[f"{ours}/c{ci}/bn/beta"] = f"{theirs}.bn{ci}.bias"
+                sm[f"{ours}/c{ci}/mean"] = f"{theirs}.bn{ci}.running_mean"
+                sm[f"{ours}/c{ci}/var"] = f"{theirs}.bn{ci}.running_var"
+            if bi == 0:     # every stage's first block has a downsample
+                pm[f"{ours}/proj/w"] = f"{theirs}.downsample.0.weight"
+                pm[f"{ours}/proj/bn/gamma"] = f"{theirs}.downsample.1.weight"
+                pm[f"{ours}/proj/bn/beta"] = f"{theirs}.downsample.1.bias"
+                sm[f"{ours}/proj/mean"] = \
+                    f"{theirs}.downsample.1.running_mean"
+                sm[f"{ours}/proj/var"] = f"{theirs}.downsample.1.running_var"
+    return pm, sm
+
+
+def import_torchvision_resnet(state_dict, depth=50, num_classes=None):
+    """state_dict (torchvision ResNet-50/101/152 key convention) ->
+    (params, state) ready for models/resnet.forward(train=False).
+    num_classes defaults to the checkpoint's fc rows."""
+    import jax
+    from paddle_tpu.models import resnet
+    if num_classes is None:
+        num_classes = int(np.asarray(
+            state_dict["fc.bias"].detach().cpu().numpy()
+            if hasattr(state_dict["fc.bias"], "detach")
+            else state_dict["fc.bias"]).shape[0])
+    params, state = resnet.init(jax.random.PRNGKey(0), depth=depth,
+                                num_classes=num_classes)
+    pm, sm = resnet_mapping(depth)
+    params = from_torch_state_dict(params, state_dict, mapping=pm)
+    state = from_torch_state_dict(state, state_dict, mapping=sm)
+    return params, state

@@ -1,0 +1,193 @@
+"""Model-zoo pretrained-import parity (reference demo/model_zoo/resnet:
+get_model.sh + classify.py ran a DOWNLOADED pretrained ResNet; this
+zero-egress twin proves the import path itself — a torch checkpoint in
+torchvision's ResNet key convention converts into our pytree and
+reproduces torch's own forward bit-for-bit-close, BN running stats
+included — so a user pointing `extract_features.py import_torch` at a
+real torchvision .pth gets the reference workflow end to end)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F
+
+BLOCKS = (3, 4, 6, 3)
+WIDTHS = (256, 512, 1024, 2048)
+NUM_CLASSES = 10
+
+
+def _torch_resnet50_state_dict(seed=0):
+    """Deterministic state_dict with torchvision ResNet-50 key names and
+    shapes (fc sized NUM_CLASSES) — stands in for a downloaded
+    resnet50.pth; running stats are non-trivial so eval-mode BN is
+    genuinely exercised."""
+    g = torch.Generator().manual_seed(seed)
+
+    def t(*shape, scale=None):
+        if scale is None:
+            # He-ish conv init: keeps activations O(1) through 16 blocks
+            # so the torch-vs-jax comparison is numerically meaningful
+            fan = int(np.prod(shape[1:])) if len(shape) == 4 else shape[-1]
+            scale = (2.0 / fan) ** 0.5
+        return torch.randn(*shape, generator=g) * scale
+
+    def bn_entries(prefix, c):
+        # running_var > gamma^2 so each eval-mode BN damps slightly:
+        # with arbitrary (non-fitted) running stats the network would
+        # otherwise amplify ~1.3x per BN and reach 1e6 activations,
+        # drowning the parity check in f32 rounding noise
+        return {f"{prefix}.weight": 1.0 + t(c, scale=0.05),
+                f"{prefix}.bias": t(c, scale=0.05),
+                f"{prefix}.running_mean": t(c, scale=0.1),
+                f"{prefix}.running_var": 2.5 + t(c, scale=0.1).abs()}
+
+    sd = {"conv1.weight": t(64, 3, 7, 7)}
+    sd.update(bn_entries("bn1", 64))
+    cin = 64
+    for si, (n, w) in enumerate(zip(BLOCKS, WIDTHS)):
+        mid = w // 4
+        for bi in range(n):
+            p = f"layer{si + 1}.{bi}"
+            sd[f"{p}.conv1.weight"] = t(mid, cin, 1, 1)
+            sd.update(bn_entries(f"{p}.bn1", mid))
+            sd[f"{p}.conv2.weight"] = t(mid, mid, 3, 3)
+            sd.update(bn_entries(f"{p}.bn2", mid))
+            sd[f"{p}.conv3.weight"] = t(w, mid, 1, 1)
+            sd.update(bn_entries(f"{p}.bn3", w))
+            if bi == 0:
+                sd[f"{p}.downsample.0.weight"] = t(w, cin, 1, 1)
+                sd.update(bn_entries(f"{p}.downsample.1", w))
+            cin = w
+    sd["fc.weight"] = t(NUM_CLASSES, cin, scale=0.02)
+    sd["fc.bias"] = t(NUM_CLASSES, scale=0.02)
+    return sd
+
+
+def _torch_forward(sd, x_nchw):
+    """Functional eval-mode ResNet-50 v1.5 forward straight off the
+    state_dict — the oracle the imported JAX model must match."""
+
+    def bn(x, p):
+        return F.batch_norm(x, sd[f"{p}.running_mean"],
+                            sd[f"{p}.running_var"], sd[f"{p}.weight"],
+                            sd[f"{p}.bias"], training=False)
+
+    def block(x, p, stride):
+        y = F.relu(bn(F.conv2d(x, sd[f"{p}.conv1.weight"]), f"{p}.bn1"))
+        y = F.relu(bn(F.conv2d(y, sd[f"{p}.conv2.weight"], stride=stride,
+                               padding=1), f"{p}.bn2"))
+        y = bn(F.conv2d(y, sd[f"{p}.conv3.weight"]), f"{p}.bn3")
+        if f"{p}.downsample.0.weight" in sd:
+            x = bn(F.conv2d(x, sd[f"{p}.downsample.0.weight"],
+                            stride=stride), f"{p}.downsample.1")
+        return F.relu(x + y)
+
+    with torch.no_grad():
+        x = F.conv2d(x_nchw, sd["conv1.weight"], stride=2, padding=3)
+        x = F.relu(bn(x, "bn1"))
+        x = F.max_pool2d(x, 3, 2, 1)
+        for si, n in enumerate(BLOCKS):
+            for bi in range(n):
+                x = block(x, f"layer{si + 1}.{bi}",
+                          2 if (bi == 0 and si > 0) else 1)
+        pooled = x.mean(dim=(2, 3))
+        logits = F.linear(pooled, sd["fc.weight"], sd["fc.bias"])
+    return pooled.numpy(), logits.numpy()
+
+
+def _images(b=2, hw=32, seed=1):
+    rng = np.random.RandomState(seed)
+    return rng.rand(b, hw, hw, 3).astype(np.float32)
+
+
+def test_torchvision_resnet_import_matches_torch_forward():
+    """The golden proof for the model-zoo row: importing a torch
+    checkpoint and running OUR ResNet reproduces TORCH's forward on the
+    same weights (features and logits)."""
+    from paddle_tpu.models import resnet
+    from paddle_tpu.utils.tools.torch_import import import_torchvision_resnet
+
+    sd = _torch_resnet50_state_dict()
+    params, state = import_torchvision_resnet(sd, depth=50)
+    imgs = _images()
+    want_pool, want_logits = _torch_forward(
+        sd, torch.from_numpy(imgs.transpose(0, 3, 1, 2)))
+
+    got_pool = np.asarray(resnet.features(params, state, jnp.asarray(imgs)))
+    got_logits, _ = resnet.forward(params, state, jnp.asarray(imgs),
+                                   train=False)
+    np.testing.assert_allclose(got_pool, want_pool, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(got_logits), want_logits,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_model_zoo_demo_end_to_end(tmp_path):
+    """The reference workflow: get_model (here: import_torch) ->
+    classify.py --job=extract (here: resnet --layer pool) — run through
+    the actual demo CLI, output equals the torch oracle and the
+    committed golden."""
+    sd = _torch_resnet50_state_dict()
+    pt = tmp_path / "resnet50_det.pt"
+    torch.save(sd, str(pt))
+    imgs = _images()
+    np.save(tmp_path / "imgs.npy", imgs)
+
+    demo = os.path.join(os.path.dirname(__file__), "..", "demo",
+                        "model_zoo", "extract_features.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    ck = tmp_path / "ckpt"
+    r = subprocess.run(
+        [sys.executable, demo, "import_torch", "--torch_file", str(pt),
+         "--depth", "50", "--out_dir", str(ck)],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(
+        [sys.executable, demo, "resnet", "--model_dir", str(ck),
+         "--layer", "pool", "--images", str(tmp_path / "imgs.npy"),
+         "--out", str(tmp_path / "feats.npz")],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    feats = np.load(tmp_path / "feats.npz")["features"]
+    want_pool, _ = _torch_forward(
+        sd, torch.from_numpy(imgs.transpose(0, 3, 1, 2)))
+    np.testing.assert_allclose(feats, want_pool, rtol=1e-4, atol=1e-4)
+
+    golden_path = os.path.join(os.path.dirname(__file__), "..", "demo",
+                               "model_zoo", "golden_features.npz")
+    if os.path.exists(golden_path):
+        golden = np.load(golden_path)["features"]
+        np.testing.assert_allclose(feats, golden, rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_mapping_is_exhaustive():
+    """Every tensor in a torchvision-convention checkpoint is consumed,
+    and every leaf of our pytree is written — nothing silently keeps its
+    random init (the classic weight-import failure mode)."""
+    from paddle_tpu.utils.tools.torch_import import resnet_mapping
+    sd = _torch_resnet50_state_dict()
+    pm, sm = resnet_mapping(50)
+    used = set(pm.values()) | set(sm.values())
+    # num_batches_tracked has no analog; everything else must be used
+    assert used == set(sd.keys())
+
+    from paddle_tpu.models import resnet
+    params, state = resnet.init(jax.random.PRNGKey(0), depth=50,
+                                num_classes=NUM_CLASSES)
+
+    def paths(tree, prefix=""):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                yield from paths(v, f"{prefix}{k}/")
+        else:
+            yield prefix.rstrip("/")
+
+    assert set(pm) == set(paths(params))
+    assert set(sm) == set(paths(state))
