@@ -509,6 +509,78 @@ def bench_transformer(batch=32, seq_len=256, vocab=32000, d_model=512,
         {"tokens_per_step": tok, "remat": remat}
 
 
+def bench_transformer_packed(batch=16, max_len=512, vocab=32000,
+                             d_model=512, dff=2048, layers=6, heads=8):
+    """Padding-free packed training on the flagship encoder: ragged
+    sequences (geometric-ish length mix, mean ~1/3 max_len) packed
+    first-fit into [B, max_len] rows (core.sequence.pack_sequences),
+    segment-ids attention keeping rows block-diagonal, within-segment
+    positions.  The headline is REAL tokens/sec — the same ragged stream
+    padded 1:1 would spend ~3x the step FLOPs per real token, which is
+    the reference's Argument.sequenceStartPositions no-padding story at
+    transformer scale.  extras carry pack_efficiency (real/slot tokens)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.sequence import SequenceBatch, pack_sequences
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops import losses as loss_ops
+    from paddle_tpu import optim
+
+    # encoder-only benchmark: no decoder stack and a 1-row target vocab,
+    # so grad + Adam traffic covers exactly the params the loss trains
+    # (a full trg_emb/out pair would add ~33M dead params to every step)
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=1, d_model=d_model, dff=dff,
+                              enc_layers=layers, dec_layers=0,
+                              max_len=max_len)
+    opt = optim.Adam(learning_rate=1e-4)
+    opt_state = opt.init(params)
+    rng = np.random.RandomState(0)
+    seqs, rows = [], None
+    while rows is None or rows[0].shape[0] < batch:
+        seqs.extend(rng.randint(3, vocab, int(n))
+                    for n in np.clip(rng.geometric(1.0 / (max_len // 3),
+                                                   size=64), 8, max_len))
+        rows = pack_sequences(seqs, max_len)
+    data, seg, pos = (jnp.asarray(a[:batch]) for a in rows)
+    src = SequenceBatch(data, jnp.full((batch,), max_len, jnp.int32))
+    real_tokens = int(np.sum(np.asarray(seg) > 0))
+    remat = _env_remat(batch * max_len >= 32768)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, src, seg, pos):
+        def loss_fn(p):
+            # masked-LM-style objective: re-predict each real token from
+            # its contextual encoding (enough to drive fwd+bwd+update at
+            # the exact packed-training shapes)
+            h = transformer.encode(p, src, heads, remat=remat,
+                                   segment_ids=seg, positions=pos)
+            logits = h @ p["src_emb"].T
+            per_tok = loss_ops.classification_cost(logits, src.data)
+            m = (seg > 0).astype(per_tok.dtype)
+            return jnp.sum(per_tok * m) / jnp.maximum(jnp.sum(m), 1.0)
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_opt = opt.update(grads, opt_state, params)
+        return new_params, new_opt, loss
+
+    def run(s):
+        nonlocal params, opt_state
+        params, opt_state, loss = step(params, opt_state, src, seg, pos)
+        return loss
+
+    # compute runs on every SLOT (padded) position; credit = real tokens
+    tok_slots = batch * max_len
+    n_params = layers * (4 * d_model ** 2 + 2 * d_model * dff)
+    attn = 4.0 * layers * batch * max_len * max_len * d_model
+    flops = 3.0 * (2.0 * n_params * tok_slots
+                   + 2.0 * vocab * d_model * tok_slots + attn)
+    return run, flops, None, (
+        f"transformer packed-encoder train ms/batch bs={batch} "
+        f"slots={max_len} real_tok/row={real_tokens / batch:.0f}"), \
+        {"tokens_per_step": real_tokens, "remat": remat,
+         "pack_efficiency": round(real_tokens / tok_slots, 3)}
+
+
 def _decode_flops(batch, src_len, max_len, vocab, d_model, dff, layers,
                   beam):
     """Analytic FLOPs of one KV-cached beam decode of a batch: per decoded
@@ -635,6 +707,9 @@ _BENCHES = {
     # would be 256 MB/head-batch); proves the long-context plane on chip
     "transformer_long": (lambda b: bench_transformer(batch=b,
                                                      seq_len=8192), 2),
+    # padding-free packed training (real tokens/sec headline; the
+    # reference's no-padding Argument story at transformer scale)
+    "transformer_packed": (lambda b: bench_transformer_packed(batch=b), 16),
     "transformer_decode": (lambda b: bench_transformer_decode(batch=b), 32),
     "transformer_serving": (lambda b: bench_transformer_serving(batch=b), 16),
     "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),
@@ -648,6 +723,34 @@ _BENCHES = {
     "googlenet": (lambda b: bench_image("googlenet", b, 613.0, 3.0e9, 224, 1000), 64),
     "smallnet": (lambda b: bench_image("smallnet", b, 10.463, 2.5e7, 32, 10), 64),
 }
+
+
+# published K40m ms/batch per (model, batch) — BASELINE.md single-GPU
+# table (benchmark/README.md:33-58,115-135).  The factories carry the
+# bs-64 default; this table corrects vs_baseline for the batch-scaling
+# rows so each row compares against ITS published number, and batches
+# the reference never published compare against nothing (vs_baseline
+# null) rather than the wrong row.
+_BASELINE_MS = {
+    ("alexnet", 64): 195.0, ("alexnet", 128): 334.0,
+    ("alexnet", 256): 602.0, ("alexnet", 512): 1629.0,
+    ("googlenet", 64): 613.0, ("googlenet", 128): 1149.0,
+    ("googlenet", 256): 2348.0,
+    ("smallnet", 64): 10.463, ("smallnet", 512): 63.039,
+    ("lstm256", 64): 83.0, ("lstm256", 128): 110.0,
+    ("lstm", 64): 184.0, ("lstm", 256): 414.0,
+    ("lstm1280", 64): 641.0,
+}
+
+
+def _resolve_baseline(model, batch, factory_baseline_ms):
+    """vs_baseline denominator for (model, batch): the published row if
+    one exists, the factory's number at its default batch, else None."""
+    if (model, batch) in _BASELINE_MS:
+        return _BASELINE_MS[(model, batch)]
+    if batch == _BENCHES.get(model, (None, None))[1]:
+        return factory_baseline_ms
+    return None
 
 
 def cache_key_for(model, batch=None):
@@ -769,6 +872,7 @@ def main():
     try:
         built = factory(batch)
         run, flops, baseline_ms, metric = built[:4]
+        baseline_ms = _resolve_baseline(model, batch, baseline_ms)
         extras = built[4] if len(built) > 4 else {}
     except Exception as e:  # noqa: BLE001
         dog.clear()
@@ -815,6 +919,7 @@ def main():
             # before Mosaic rejected it; only the retry's tracing counts
             fused_count0 = _rnn_dispatch.FUSED_DISPATCH_COUNT
             run, flops, baseline_ms, metric = factory(batch)[:4]
+            baseline_ms = _resolve_baseline(model, batch, baseline_ms)
             loss = run(0)
             jax.block_until_ready(loss)
         compile_s = time.perf_counter() - t0
@@ -876,6 +981,8 @@ def main():
         out["tokens_per_s"] = round(extras["tokens_per_step"] / dt)
     if "remat" in extras:
         out["remat"] = extras["remat"]
+    if "pack_efficiency" in extras:
+        out["pack_efficiency"] = extras["pack_efficiency"]
     if fused_rnn_fallback:
         out["fused_rnn_fallback"] = True
         out["fused_rnn_first_error"] = fused_rnn_first_error

@@ -61,15 +61,23 @@ def _fresh_live_row(model, batch, max_age_s, cache_path=None):
         return None
 
 DEFAULT_COMBOS = [
-    # BASELINE.md reference points
+    # BASELINE.md reference points (bs 64 rows)
     "lstm:64", "lstm256:64", "lstm1280:64",
     "alexnet:64", "googlenet:64", "smallnet:64", "resnet50:32",
+    # BASELINE.md batch-scaling rows (benchmark/README.md:33-58,115-135:
+    # AlexNet 128/256/512, GoogleNet 128/256, SmallNet 512, LSTM h=256
+    # bs128, h=512 bs256) — the TPU column for every published row, not
+    # just the 2016 bs-64 points
+    "alexnet:128", "alexnet:256", "alexnet:512",
+    "googlenet:128", "smallnet:512",
+    "lstm256:128", "lstm:256",
     # TPU scaling column
     "resnet50:256", "resnet50:512", "resnet50:1024",
     "googlenet:256", "googlenet:512",
     "lstm1280:256",
     "transformer:32", "transformer:128",          # 128*256 = 32768 tok
     "transformer_long:2",                         # 8k-token sequences
+    "transformer_packed:16",                      # padding-free packing
     "transformer_decode:32",                      # KV-cached serving path
     "transformer_serving:16",                     # bucketed-length stream
     "seq2seq:64",

@@ -34,7 +34,7 @@ unset JAX_COMPILATION_CACHE_DIR JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS
 # repo-root-relative (resolved after the cd below)
 if [ $# -ge 1 ]; then ART=$(realpath -m "$1"); else ART=""; fi
 cd "$(dirname "$0")/../.."
-ART="${ART:-$PWD/artifacts/r4}"
+ART="${ART:-$PWD/artifacts/r5}"
 mkdir -p "$ART"
 log() { echo "[healthy_window $(date -u +%H:%M:%S)] $*" >&2; }
 

@@ -12,7 +12,7 @@
 # re-launch it for another window.
 set -u
 cd "$(dirname "$0")/../.."
-ART="${1:-$PWD/artifacts/r4}"
+ART="${1:-$PWD/artifacts/r5}"
 LOG=/tmp/window_watch.log
 probe() {
     timeout 75 python - <<'EOF' >/dev/null 2>&1

@@ -258,3 +258,23 @@ def test_chip_probe_vacuous_on_cpu_sweep(monkeypatch):
     from paddle_tpu.scripts import bench_sweep as sw
     monkeypatch.setenv("BENCH_PLATFORM", "cpu")
     assert sw._chip_alive() is True        # no subprocess, no 90 s wait
+
+
+def test_vs_baseline_resolves_per_batch_row():
+    """Batch-scaling combos must compare against THEIR published
+    BASELINE.md row, not the factory's bs-64 number; unpublished batches
+    compare against nothing."""
+    import bench
+    # published scaling rows
+    assert bench._resolve_baseline("alexnet", 512, 195.0) == 1629.0
+    assert bench._resolve_baseline("lstm", 256, 184.0) == 414.0
+    assert bench._resolve_baseline("smallnet", 512, 10.463) == 63.039
+    # default batch keeps the factory's number
+    assert bench._resolve_baseline("lstm", 64, 184.0) == 184.0
+    assert bench._resolve_baseline("transformer", 32, None) is None
+    # non-default, never published -> no comparison
+    assert bench._resolve_baseline("resnet50", 1024, None) is None
+    assert bench._resolve_baseline("alexnet", 1024, 195.0) is None
+    # every _BASELINE_MS key is a real model at a real batch
+    for (m, b) in bench._BASELINE_MS:
+        assert m in bench._BENCHES and b > 0
