@@ -58,6 +58,17 @@ class GradientMachine:
 
     createFromConfigProto = createFromTopology  # reference-name alias
 
+    @classmethod
+    def create(cls, outputs, mode=None, seed=1, **kw):
+        """Mode-dispatched construction, the reference Trainer's entry
+        (Trainer.cpp:150-156: ask GradientMachineMode's registry first,
+        fall back to the built-in machines).  mode=None builds the
+        standard machine; a registered mode name dispatches to its
+        factory(outputs, seed=..., **kw)."""
+        if mode is None:
+            return cls.createFromTopology(outputs, seed=seed)
+        return GradientMachineMode.create(mode, outputs, seed=seed, **kw)
+
     def _feedify(self, feed):
         return {k: v if isinstance(v, SequenceBatch) else jnp.asarray(v)
                 for k, v in feed.items()}
@@ -106,6 +117,62 @@ class GradientMachine:
             self._grads, opt_state, self.parameters)
         self._grads = None
         return opt_state
+
+
+class GradientMachineMode:
+    """Plugin registry for custom training-machine modes (reference
+    gserver/gradientmachines/GradientMachineMode.h: link-time-registered
+    modes the Trainer tries before its built-ins, Trainer.cpp:150-156).
+
+    The reference existed so C++ plugins could add machines without
+    patching the Trainer; the Python-native equivalent is a name-keyed
+    factory registry feeding GradientMachine.create(mode=...):
+
+        @GradientMachineMode.register("averaged")
+        def make(outputs, seed=1, **kw):
+            return MyAveragedMachine(outputs, seed)
+
+        gm = GradientMachine.create(cost, mode="averaged")
+
+    Factories return anything honoring the GradientMachine call surface
+    (forward/forwardBackward/applyOptimizer...)."""
+
+    _registry = {}
+
+    @classmethod
+    def register(cls, mode, factory=None):
+        """Register `factory` under `mode` (usable as a decorator).
+        Re-registering an existing mode raises — shadowing a plugin
+        silently was the reference's mode-id collision failure."""
+        if factory is None:
+            return lambda f: cls.register(mode, f)
+        if mode in cls._registry:
+            raise ValueError(f"GradientMachineMode {mode!r} already "
+                             "registered")
+        cls._registry[mode] = factory
+        return factory
+
+    @classmethod
+    def is_registered(cls, mode):
+        return mode in cls._registry
+
+    @classmethod
+    def registered(cls):
+        return tuple(sorted(cls._registry))
+
+    @classmethod
+    def create(cls, mode, outputs, **kw):
+        """tryCreateGradientMachine: build via the registered factory;
+        unknown modes fail fast naming what IS registered."""
+        if mode not in cls._registry:
+            raise KeyError(
+                f"no GradientMachineMode {mode!r}; registered: "
+                f"{list(cls.registered()) or 'none'}")
+        return cls._registry[mode](outputs, **kw)
+
+    @classmethod
+    def unregister(cls, mode):
+        cls._registry.pop(mode, None)
 
 
 class MultiNetwork:

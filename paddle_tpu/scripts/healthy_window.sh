@@ -76,6 +76,12 @@ python -m paddle_tpu.scripts.xprof_report "$ART/xprof_bf16" \
     --write "$ART/xprof_bf16_report" 2>> "$ART/xprof_report.log"
 log "bf16-trace attribution rc=$?"
 
+log "phase 2d: int8 weight-only serving column (vs the bf16/f32 rows)"
+BENCH_QUANT=int8 timeout 3600 python -m paddle_tpu.scripts.bench_sweep \
+    --combos "transformer_decode:32,transformer_serving:16" \
+    > "$ART/bench_int8.json" 2> "$ART/bench_int8.log"
+log "int8 sweep rc=$? (cached under model@int8)"
+
 log "phase 3: TPU differential dump + compare"
 # resumable per-case dumps; 'default' platform = the axon-routed TPU
 timeout 7200 python -m paddle_tpu.testing.tpu_diff default \

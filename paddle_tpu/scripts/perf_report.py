@@ -136,6 +136,22 @@ def kernel_table(cache):
     return "\n".join(lines)
 
 
+def int8_table(cache):
+    """Weight-only int8 serving column (phase 2d rows cache under
+    key@int8): the float-vs-int8 latency ratio isolates the weight-stream
+    HBM effect — the serving figure of merit docs/serving.md promises."""
+    pairs = _suffix_pairs(cache, "@int8")
+    if not pairs:
+        return "(no int8 pairs cached yet)"
+    lines = ["| model | float ms | int8 ms | int8 speedup | measured |",
+             "|---|---|---|---|---|"]
+    for name, base, q in pairs:
+        lines.append(
+            f"| {name} | {base['value']} | {q['value']} | "
+            f"{base['value'] / q['value']:.2f}× | {_stamp(q)} |")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--cache",
@@ -151,6 +167,8 @@ def main(argv=None):
     print(bf16_table(cache))
     print("\n## Fused Pallas RNN kernels vs lax.scan\n")
     print(kernel_table(cache))
+    print("\n## Weight-only int8 serving column\n")
+    print(int8_table(cache))
 
 
 if __name__ == "__main__":

@@ -97,3 +97,47 @@ def test_alternating_updates_gan_style(np_rng):
     st = mn.applyOptimizer(opt, st, subnet=1)
     assert np.any(before_b_head != np.asarray(
         jax.tree_util.tree_leaves(mn.parameters["__fc_3__"])[0]))
+
+
+def test_gradient_machine_mode_registry(np_rng):
+    """GradientMachineMode plugin registry (reference GradientMachineMode.h
+    dispatched at Trainer.cpp:150-156): registered modes construct through
+    GradientMachine.create, unknown modes fail fast naming the registry,
+    re-registration is rejected."""
+    from paddle_tpu.api import GradientMachine, GradientMachineMode
+
+    reset_names()
+    x = L.data_layer("x", size=4)
+    lab = L.data_layer("lab", size=1)
+    cost = L.classification_cost(
+        input=L.fc_layer(x, size=2, act="softmax"), label=lab)
+
+    # default mode: the standard machine
+    gm0 = GradientMachine.create(cost)
+    assert isinstance(gm0, GradientMachine)
+
+    made = {}
+
+    @GradientMachineMode.register("logging")
+    def make_logging(outputs, seed=1, tag=None, **kw):
+        made["tag"] = tag
+        return GradientMachine.createFromTopology(outputs, seed=seed)
+
+    try:
+        assert GradientMachineMode.is_registered("logging")
+        assert "logging" in GradientMachineMode.registered()
+        gm = GradientMachine.create(cost, mode="logging", tag="t1")
+        assert made["tag"] == "t1"
+        feed = {"x": np_rng.randn(4, 4).astype(np.float32),
+                "lab": np_rng.randint(0, 2, (4, 1)).astype(np.int32)}
+        c, _ = gm.forwardBackward(feed)
+        assert np.isfinite(c)
+        # collision fails fast
+        import pytest
+        with pytest.raises(ValueError, match="already registered"):
+            GradientMachineMode.register("logging", make_logging)
+        # unknown mode names what exists
+        with pytest.raises(KeyError, match="logging"):
+            GradientMachine.create(cost, mode="nope")
+    finally:
+        GradientMachineMode.unregister("logging")
