@@ -66,6 +66,11 @@ class GradientMachine:
         standard machine; a registered mode name dispatches to its
         factory(outputs, seed=..., **kw)."""
         if mode is None:
+            if kw:
+                raise TypeError(
+                    f"GradientMachine.create got {sorted(kw)} without "
+                    "mode=; extra kwargs only reach a registered mode's "
+                    "factory")
             return cls.createFromTopology(outputs, seed=seed)
         return GradientMachineMode.create(mode, outputs, seed=seed, **kw)
 

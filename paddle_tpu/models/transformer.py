@@ -97,10 +97,12 @@ def _check_full(seq: SequenceBatch):
             "pack the batch")
 
 
-def _enc_block(blk, x, key_mask, num_heads, mesh=None, segment_ids=None):
+def _enc_block(blk, x, key_mask, num_heads, mesh=None, segment_ids=None,
+               causal=False, zigzag=False):
     h = _ln(blk["ln1"], x)
     x = x + _mha(blk["attn"], h, h, num_heads, key_mask=key_mask,
-                 mesh=mesh, q_segment_ids=segment_ids)
+                 causal=causal, mesh=mesh, zigzag=zigzag,
+                 q_segment_ids=segment_ids)
     return x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
 
 
@@ -115,7 +117,8 @@ def _dec_block(blk, x, enc_out, self_km, cross_km, num_heads, mesh=None,
 
 
 def encode(params, src: SequenceBatch, num_heads=8, remat=False,
-           full_seq=False, mesh=None, segment_ids=None, positions=None):
+           full_seq=False, mesh=None, segment_ids=None, positions=None,
+           causal=False, zigzag=False):
     """remat=True checkpoints each block (jax.checkpoint): backward
     recomputes activations instead of storing them — the HBM headroom for
     >=32k-token batches.
@@ -127,15 +130,32 @@ def encode(params, src: SequenceBatch, num_heads=8, remat=False,
     segment_ids/positions: PACKED rows (core.sequence.pack_sequences —
     several short sequences per row): attention stays block-diagonal per
     segment and each token's positional row is its within-segment index,
-    so the encoder behaves exactly as if every sequence ran alone."""
+    so the encoder behaves exactly as if every sequence ran alone.
+
+    causal=True turns the stack into a decoder-only (GPT-style) trunk:
+    every self-attention is causal — combined with segment_ids this is
+    packed causal-LM training (see lm_loss).  zigzag=True (causal +
+    seq>1 mesh only) processes the stream in zigzag storage order so the
+    causal self-attention rides the balanced ring; the returned hidden
+    states are in zigzag order (lm_loss aligns its labels the same way)."""
     t = src.data.shape[1]
-    block = (jax.checkpoint(_enc_block, static_argnums=(3, 4)) if remat
-             else _enc_block)
+    block = (jax.checkpoint(_enc_block, static_argnums=(3, 4, 6, 7))
+             if remat else _enc_block)
     if (segment_ids is None) != (positions is None):
         raise ValueError("packed encode needs BOTH segment_ids and "
                          "positions (pack_sequences returns them "
                          "together)")
-    x = emb_ops.embedding_lookup(params["src_emb"], src.data)
+    ids, order = src.data, None
+    if zigzag:
+        if not causal or mesh is None or mesh.shape.get("seq", 1) <= 1:
+            raise ValueError("zigzag encode needs causal=True and a mesh "
+                             "with seq > 1")
+        order = _zigzag_idx(t, mesh)
+        ids = ids[:, order]
+        if segment_ids is not None:
+            segment_ids = segment_ids[:, order]
+            positions = positions[:, order]
+    x = emb_ops.embedding_lookup(params["src_emb"], ids)
     if positions is not None and not isinstance(positions, jax.core.Tracer):
         try:
             max_pos = int(jnp.max(positions))
@@ -150,18 +170,26 @@ def encode(params, src: SequenceBatch, num_heads=8, remat=False,
                 f"packed position {max_pos} exceeds the positional table "
                 f"({params['pos'].shape[0]}); re-init with a larger "
                 "max_len or pack shorter rows")
-    pos_rows = (params["pos"][positions] if positions is not None
-                else params["pos"][:t][None])
+    if positions is not None:
+        pos_rows = params["pos"][positions]
+    else:
+        pos_rows = params["pos"][:t]
+        if order is not None:
+            pos_rows = pos_rows[order]
+        pos_rows = pos_rows[None]
     x = x * math.sqrt(x.shape[-1]) + pos_rows
     # key validity stays O(T) ([B, T]); full_seq=True promises every
     # sequence is max-length (packed/bucketed batches) and drops the mask
     # entirely so the flash/chunked O(T)-memory paths engage — validated
     # when lengths are concrete (a jit-traced batch is trusted)
     key_mask = None if full_seq or segment_ids is not None else src.mask()
+    if key_mask is not None and order is not None:
+        key_mask = key_mask[:, order]
     if full_seq:
         _check_full(src)
     for blk in params["enc"]:
-        x = block(blk, x, key_mask, num_heads, mesh, segment_ids)
+        x = block(blk, x, key_mask, num_heads, mesh, segment_ids, causal,
+                  zigzag)
     return x
 
 
@@ -225,13 +253,67 @@ def loss(params, src, trg_in, trg_next, num_heads=8, label_smoothing=0.1,
         order = _zigzag_idx(labels.shape[1], mesh)
         labels = labels[:, order]
         tok_mask = tok_mask[:, order]
-    v = logits.shape[-1]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    onehot = jax.nn.one_hot(labels, v)
-    smoothed = onehot * (1 - label_smoothing) + label_smoothing / v
-    per_tok = -jnp.sum(smoothed * logp, axis=-1)
+    per_tok = _token_ce(logits, labels, label_smoothing)
     per_seq = losses.masked_seq_mean(per_tok, tok_mask.astype(per_tok.dtype))
     return jnp.mean(per_seq)
+
+
+def _token_ce(logits, labels, label_smoothing):
+    """Per-token (optionally label-smoothed) cross-entropy — the ONE
+    definition loss() and lm_loss() share."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if label_smoothing:
+        v = logits.shape[-1]
+        onehot = jax.nn.one_hot(labels, v)
+        smoothed = onehot * (1 - label_smoothing) + label_smoothing / v
+        return -jnp.sum(smoothed * logp, axis=-1)
+    return -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+
+
+def lm_loss(params, tokens: SequenceBatch, num_heads=8, segment_ids=None,
+            positions=None, mesh=None, zigzag=False, remat=False,
+            label_smoothing=0.0):
+    """Decoder-only (GPT-style) causal LM: the encoder stack run causal,
+    next-token cross-entropy with the input embedding tied as the output
+    projection.  Token-mean objective (the standard LM loss — every real
+    token weighs the same regardless of how rows were packed).
+
+    segment_ids/positions (pack_sequences layout) train PACKED rows with
+    every segment isolated: label t is token t+1 of the SAME segment, so
+    each segment's last token — and padding — carries no label.  mesh
+    (seq>1) runs the causal attention sequence-parallel; zigzag=True
+    additionally balances the causal ring (labels are aligned to the
+    zigzag order internally — masked token-mean is permutation-
+    invariant).  The modern training plane the reference's
+    Argument.sequenceStartPositions pointed toward: no-padding batches,
+    long-context sharding, one loss call."""
+    ids = tokens.data
+    b, t = ids.shape
+    if segment_ids is not None:
+        seg = segment_ids
+        valid = jnp.concatenate(
+            [(seg[:, :-1] > 0) & (seg[:, :-1] == seg[:, 1:]),
+             jnp.zeros((b, 1), bool)], axis=1)
+    else:
+        m = tokens.mask() > 0
+        # label for position t exists iff position t+1 is a real token
+        valid = jnp.concatenate([m[:, 1:], jnp.zeros((b, 1), bool)],
+                                axis=1)
+    labels = jnp.roll(ids, -1, axis=1)      # wrap at T-1 is masked out
+    h = encode(params, tokens, num_heads, remat=remat, mesh=mesh,
+               segment_ids=segment_ids, positions=positions, causal=True,
+               zigzag=zigzag)
+    if zigzag:
+        order = _zigzag_idx(t, mesh)
+        labels, valid = labels[:, order], valid[:, order]
+    # final LN before the tied projection (the GPT/pre-LN convention,
+    # same as decode's ln_f): without it the un-normalized residual
+    # stream's depth-growing magnitude sets the softmax temperature
+    h = _ln(params["ln_f"], h)
+    logits = linear.matmul(h, params["src_emb"].T)
+    per_tok = _token_ce(logits, labels, label_smoothing)
+    w = valid.astype(per_tok.dtype)
+    return jnp.sum(per_tok * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
 # --------------------------------------------------------- cached decode
