@@ -83,7 +83,10 @@ BENCH_QUANT=int8 timeout 3600 python -m paddle_tpu.scripts.bench_sweep \
 log "int8 sweep rc=$? (cached under model@int8)"
 
 log "phase 3: TPU differential dump + compare"
-# resumable per-case dumps; 'default' platform = the axon-routed TPU
+# resumable per-case dumps; 'default' platform = the axon-routed TPU.
+# Retry error/timeout records from earlier partial windows — a wedge
+# mid-group leaves TimeoutExpired records for its missing sub-cases
+export TPU_DIFF_RETRY_ERRORS=1
 timeout 7200 python -m paddle_tpu.testing.tpu_diff default \
     "$ART/diff_tpu.npz" 2> "$ART/diff_tpu.log"
 log "tpu dump rc=$?"

@@ -83,26 +83,50 @@ def run_cases(only=None, out_dir=None):
         def loss(p):
             return sum(jnp.mean(d.astype(jnp.float32)) for d in fwd(p))
 
-        rec = {}
-        try:
+        def thunk(fwd=fwd, loss=loss, params=params):
             vals = jax.jit(fwd)(params)
-            for i, v in enumerate(vals):
-                rec[f"out{i}"] = np.asarray(v, np.float32)
-            grads = jax.jit(jax.grad(loss))(params)
-            flat, _ = jax.tree_util.tree_flatten_with_path(grads)
-            for path, g in flat:
-                if np.issubdtype(np.asarray(g).dtype, np.floating):
-                    rec["grad" + jax.tree_util.keystr(path)] = (
-                        np.asarray(g, np.float32))
-        except Exception as e:   # record, don't abort the sweep
-            rec["__error__"] = np.frombuffer(
-                f"{type(e).__name__}: {e}"[:500].encode(), np.uint8)
-        results[name] = rec
-        if out_dir:
-            np.savez_compressed(os.path.join(out_dir, name + ".npz"), **rec)
-        print(f"[tpu_diff] {name}: {len(rec)} arrays", file=sys.stderr,
-              flush=True)
+            rec = {f"out{i}": np.asarray(v, np.float32)
+                   for i, v in enumerate(vals)}
+            rec.update(_grad_arrays(jax.jit(jax.grad(loss))(params)))
+            return rec
+        _run_case(name, thunk, out_dir, results)
     return results
+
+
+def _grad_arrays(grads):
+    """Float grad leaves as {gradPATH: f32 array} — the one flattening
+    every runner shares."""
+    import numpy as np
+    import jax
+    out = {}
+    for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
+        if np.issubdtype(np.asarray(g).dtype, np.floating):
+            out["grad" + jax.tree_util.keystr(path)] = (
+                np.asarray(g, np.float32))
+    return out
+
+
+def _run_case(cname, thunk, out_dir, results):
+    """Shared per-case scaffolding (cache skip, __error__ capture in the
+    format test_tpu_differential parses, save, progress print) — one
+    definition for all three runners so the dump format cannot diverge.
+    Assumes the caller already checked the cache when it needed to skip
+    building inputs too; a second check here is cheap and keeps direct
+    callers safe."""
+    import numpy as np
+    if out_dir and os.path.exists(os.path.join(out_dir, cname + ".npz")):
+        print(f"[tpu_diff] {cname}: cached", file=sys.stderr, flush=True)
+        return
+    try:
+        rec = thunk()
+    except Exception as e:   # record, don't abort the sweep
+        rec = {"__error__": np.frombuffer(
+            f"{type(e).__name__}: {e}"[:500].encode(), np.uint8)}
+    results[cname] = rec
+    if out_dir:
+        np.savez_compressed(os.path.join(out_dir, cname + ".npz"), **rec)
+    print(f"[tpu_diff] {cname}: {len(rec)} arrays", file=sys.stderr,
+          flush=True)
 
 
 # name -> zero-arg ctor; the supervisor derives the __optim__ resume marker
@@ -148,12 +172,7 @@ def run_optimizer_cases(out_dir=None):
 
     results = {}
     for name, ctor in sorted(mk.items()):
-        cname = f"optim_{name}"
-        if out_dir and os.path.exists(os.path.join(out_dir, cname + ".npz")):
-            print(f"[tpu_diff] {cname}: cached", file=sys.stderr, flush=True)
-            continue
-        rec = {}
-        try:
+        def thunk(ctor=ctor):
             opt = ctor()
             state = opt.init(params)
 
@@ -164,18 +183,135 @@ def run_optimizer_cases(out_dir=None):
                 return p, s
 
             p, s = chain(params, state)
+            rec = {}
             for k, v in jax.tree_util.tree_flatten_with_path(
                     {"p": p, "s": s})[0]:
                 if np.issubdtype(np.asarray(v).dtype, np.floating):
                     rec[jax.tree_util.keystr(k)] = np.asarray(v, np.float32)
-        except Exception as e:  # noqa: BLE001
-            rec["__error__"] = np.frombuffer(
-                f"{type(e).__name__}: {e}"[:500].encode(), np.uint8)
-        results[cname] = rec
-        if out_dir:
-            np.savez_compressed(os.path.join(out_dir, cname + ".npz"), **rec)
-        print(f"[tpu_diff] {cname}: {len(rec)} arrays", file=sys.stderr,
-              flush=True)
+            return rec
+        _run_case(f"optim_{name}", thunk, out_dir, results)
+    return results
+
+
+def _model_case_packed_lm():
+    """Packed causal LM (transformer.lm_loss): segments + within-segment
+    positions + causal attention + tied projection, fwd + grads."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.sequence import SequenceBatch, pack_sequences
+    from paddle_tpu.models import transformer
+    r = np.random.RandomState(3)
+    seqs = [r.randint(3, 48, n) for n in (5, 9, 7, 3, 12, 4)]
+    data, seg, pos = pack_sequences(seqs, max_len=16)
+    b = data.shape[0]
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=48,
+                              trg_vocab=1, d_model=16, dff=32,
+                              enc_layers=2, dec_layers=0, max_len=16)
+    tokens = SequenceBatch(jnp.asarray(data),
+                           jnp.full((b,), 16, jnp.int32))
+    seg, pos = jnp.asarray(seg), jnp.asarray(pos)
+
+    def loss(p):
+        return transformer.lm_loss(p, tokens, 2, segment_ids=seg,
+                                   positions=pos)
+    return params, loss
+
+
+def _model_case_chunked_segment_attn():
+    """chunked_attention with segment ids (the O(T) packed-attention
+    numerics core), fwd + grads wrt the inputs."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.core.sequence import pack_sequences
+    from paddle_tpu.ops import attention as att
+    r = np.random.RandomState(5)
+    seqs = [r.randint(0, 9, n) for n in (11, 7, 13, 5, 9, 18)]
+    _, seg, _ = pack_sequences(seqs, max_len=32)
+    b = seg.shape[0]
+    x = jnp.asarray(r.randn(b, 2, 32, 8) * 0.5, jnp.float32)
+    segj = jnp.asarray(seg)
+    m = (segj > 0).astype(jnp.float32)
+
+    def loss(p):
+        out = att.chunked_attention(p["x"], p["x"], p["x"], causal=True,
+                                    q_segment_ids=segj, q_chunk=8,
+                                    k_chunk=8, key_mask=m)
+        return jnp.sum((out * m[:, None, :, None]) ** 2)
+    return {"x": x}, loss
+
+
+def _model_case_mt_loss():
+    """transformer.loss (encoder + causal decoder + cross-attention +
+    label smoothing): the flagship MT train objective."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models import transformer
+    r = np.random.RandomState(7)
+    params = transformer.init(jax.random.PRNGKey(1), src_vocab=48,
+                              trg_vocab=48, d_model=16, dff=32,
+                              enc_layers=1, dec_layers=1, max_len=12)
+    mk = lambda: SequenceBatch(
+        jnp.asarray(r.randint(3, 48, (3, 12)), jnp.int32),
+        jnp.asarray(r.randint(6, 13, (3,)), jnp.int32))
+    src, trg_in, trg_next = mk(), mk(), mk()
+
+    def loss(p):
+        return transformer.loss(p, src, trg_in, trg_next, num_heads=2)
+    return params, loss
+
+
+def _model_case_ring1_attention():
+    """ring_attention on a 1-device mesh: compiles the shard_map +
+    ppermute + online-softmax rotation on the real backend (the
+    multi-chip numerics core, single-chip-verifiable half)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.parallel.ring_attention import ring_attention
+    r = np.random.RandomState(9)
+    q = jnp.asarray(r.randn(2, 2, 16, 8) * 0.5, jnp.float32)
+    k = jnp.asarray(r.randn(2, 2, 16, 8) * 0.5, jnp.float32)
+    v = jnp.asarray(r.randn(2, 2, 16, 8) * 0.5, jnp.float32)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("seq",))
+
+    def loss(p):
+        out = ring_attention(p["q"], p["k"], p["v"], mesh, causal=True)
+        return jnp.sum(out ** 2)
+    return {"q": q, "k": k, "v": v}, loss
+
+
+_MODEL_CASES = {
+    "packed_lm": _model_case_packed_lm,
+    "chunked_segment_attn": _model_case_chunked_segment_attn,
+    "mt_loss": _model_case_mt_loss,
+    "ring1_attention": _model_case_ring1_attention,
+}
+
+
+def _model_marker():
+    return "model_" + sorted(_MODEL_CASES)[-1]
+
+
+def run_model_cases(out_dir=None):
+    """Differential coverage for the model-level paths the layer sweep
+    can't reach: packed causal LM, segment-packed chunked attention, the
+    flagship MT loss, and the ring rotation (1-device)."""
+    import numpy as np
+    import jax
+
+    results = {}
+    for name, build in sorted(_MODEL_CASES.items()):
+        def thunk(build=build):
+            params, loss = build()
+            val, grads = jax.jit(jax.value_and_grad(loss))(params)
+            rec = {"out0": np.asarray(val, np.float32)}
+            rec.update(_grad_arrays(grads))
+            return rec
+        _run_case(f"model_{name}", thunk, out_dir, results)
     return results
 
 
@@ -262,21 +398,27 @@ def supervise(platform, out_path, case_timeout=150.0, max_consec_fail=4):
         f.write((keep_stamp or rev) + "\n")
     retry_errors = os.environ.get("TPU_DIFF_RETRY_ERRORS", "0") == "1"
     consec = 0
-    names = _case_names() + ["__optim__"]
+    names = _case_names() + ["__optim__", "__models__"]
+    group_markers = {"__optim__": _optim_marker,
+                     "__models__": _model_marker}
+    group_subcases = {
+        "__optim__": lambda: [f"optim_{n}" for n in _OPTIM_CTORS],
+        "__models__": lambda: [f"model_{n}" for n in _MODEL_CASES]}
     for name in names:
         # marker must be the LAST file the worker writes (sorted order), or
         # a mid-sweep kill would make resume skip the remainder
         marker = os.path.join(
-            out_dir, (name if name != "__optim__" else _optim_marker())
+            out_dir,
+            (group_markers[name]() if name in group_markers else name)
             + ".npz")
         deleted_stale = False
         if retry_errors:
             # drop error-only records so the worker recomputes them; for
-            # __optim__ that means ANY optim_* sub-case record, not just
-            # the marker (the worker skips per-sub-case caches)
-            stale = ([os.path.join(out_dir, f"optim_{n}.npz")
-                      for n in _OPTIM_CTORS] if name == "__optim__"
-                     else [marker])
+            # a group that means ANY sub-case record, not just the marker
+            # (the worker skips per-sub-case caches)
+            stale = ([os.path.join(out_dir, f"{c}.npz")
+                      for c in group_subcases[name]()]
+                     if name in group_subcases else [marker])
             for p in stale:
                 if os.path.exists(p) and _is_error_record(p):
                     os.unlink(p)
@@ -293,21 +435,23 @@ def supervise(platform, out_path, case_timeout=150.0, max_consec_fail=4):
                            stderr=subprocess.DEVNULL)
             consec = 0
         except subprocess.TimeoutExpired:
-            # regular case: record the timeout under the marker name so the
-            # comparing test FAILS on it (TPU_DIFF_RETRY_ERRORS=1 retries).
-            # __optim__: write NOTHING — completed sub-cases are cached, so
-            # the next run resumes the group from where the kill landed
-            # instead of a marker-file record hiding the missing tail.
-            # If the worker already wrote a healthy .npz and only wedged on
-            # exit, keep the real result — don't overwrite it with a
-            # timeout record.
-            if name != "__optim__" and not (
-                    os.path.exists(marker) and not _is_error_record(marker)):
-                np.savez_compressed(
-                    marker,
-                    __error__=np.frombuffer(
-                        f"TimeoutExpired: worker exceeded {case_timeout}s "
-                        f"(wedged backend?)".encode(), np.uint8))
+            # record the timeout so the comparing test FAILS on it instead
+            # of silently skipping (the test enumerates cases from the CPU
+            # dump, so a missing record means the case never gets compared
+            # at all); TPU_DIFF_RETRY_ERRORS=1 deletes these on the next
+            # run.  Group cases get a record per MISSING sub-case —
+            # completed sub-cases keep their healthy caches, so a retried
+            # group resumes from where the kill landed.  Never overwrite a
+            # healthy .npz the worker wrote before wedging on exit.
+            timeout_rec = np.frombuffer(
+                f"TimeoutExpired: worker exceeded {case_timeout}s "
+                f"(wedged backend?)".encode(), np.uint8)
+            missing = ([os.path.join(out_dir, c + ".npz")
+                        for c in group_subcases[name]()]
+                       if name in group_subcases else [marker])
+            for p in missing:
+                if not (os.path.exists(p) and not _is_error_record(p)):
+                    np.savez_compressed(p, __error__=timeout_rec)
             consec += 1
             print(f"[tpu_diff] {name}: TIMEOUT ({case_timeout}s)",
                   file=sys.stderr, flush=True)
@@ -342,6 +486,8 @@ def main():
     out_dir = out_path + ".d"
     if only == {"__optim__"}:
         run_optimizer_cases(out_dir=out_dir)
+    elif only == {"__models__"}:
+        run_model_cases(out_dir=out_dir)
     else:
         run_cases(only, out_dir=out_dir)
 
