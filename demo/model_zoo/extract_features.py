@@ -61,6 +61,19 @@ def run_resnet(args):
     logger.info("wrote %s: %s", args.out, np.asarray(feats).shape)
 
 
+def _save_embedding(table, out_path, text_path):
+    """npz + reference extract_para.py text format (one row per word) —
+    shared by the checkpoint and pretrained-binary subcommands."""
+    np.savez(out_path, embedding=table)
+    logger.info("wrote %s: vocab=%d dim=%d", out_path, *table.shape)
+    if text_path:
+        with open(text_path, "w") as f:
+            f.write(f"{table.shape[0]} {table.shape[1]}\n")
+            for row in table:
+                f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+        logger.info("wrote %s", text_path)
+
+
 def run_embedding(args):
     params, _ = load_params(args.model_dir, args.pass_id)
     node = params
@@ -68,15 +81,7 @@ def run_embedding(args):
         node = node[part]
     table = np.asarray(node["w"] if isinstance(node, dict) and "w" in node
                        else node)
-    np.savez(args.out, embedding=table)
-    logger.info("wrote %s: vocab=%d dim=%d", args.out, *table.shape)
-    if args.text:
-        # reference extract_para.py text format: one row per word
-        with open(args.text, "w") as f:
-            f.write(f"{table.shape[0]} {table.shape[1]}\n")
-            for row in table:
-                f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
-        logger.info("wrote %s", args.text)
+    _save_embedding(table, args.out, args.text)
 
 
 def run_import_torch(args):
@@ -103,6 +108,17 @@ def run_import_torch(args):
                 args.torch_file, args.depth, args.out_dir)
 
 
+def run_ref_embedding(args):
+    """Reference demo/model_zoo/embedding workflow (extract_para.py): pull
+    a sub-dict's rows out of a PRETRAINED reference-format binary
+    embedding table and write npz (+ the reference text format)."""
+    from paddle_tpu.utils.tools import ref_params
+    indices = (np.loadtxt(args.indices, dtype=np.int64, ndmin=1)
+               if args.indices else None)       # None = every row, one read
+    rows = ref_params.extract_rows(args.emb_file, indices, args.dim)
+    _save_embedding(rows, args.out, args.text)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     sub = p.add_subparsers(dest="what", required=True)
@@ -127,11 +143,21 @@ def main(argv=None):
                    help=".pt/.pth state_dict in torchvision ResNet naming")
     t.add_argument("--depth", type=int, default=50)
     t.add_argument("--out_dir", required=True)
+    re_ = sub.add_parser("ref_embedding")
+    re_.add_argument("--emb_file", required=True,
+                     help="reference-format binary embedding table")
+    re_.add_argument("--dim", type=int, required=True)
+    re_.add_argument("--indices", default=None,
+                     help="file of word ids (one per line); default: all")
+    re_.add_argument("--out", default="embedding.npz")
+    re_.add_argument("--text", default=None)
     args = p.parse_args(argv)
     if args.what == "resnet":
         run_resnet(args)
     elif args.what == "import_torch":
         run_import_torch(args)
+    elif args.what == "ref_embedding":
+        run_ref_embedding(args)
     else:
         run_embedding(args)
 

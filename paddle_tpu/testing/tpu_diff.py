@@ -440,9 +440,10 @@ def supervise(platform, out_path, case_timeout=150.0, max_consec_fail=4):
             # dump, so a missing record means the case never gets compared
             # at all); TPU_DIFF_RETRY_ERRORS=1 deletes these on the next
             # run.  Group cases get a record per MISSING sub-case —
-            # completed sub-cases keep their healthy caches, so a retried
-            # group resumes from where the kill landed.  Never overwrite a
-            # healthy .npz the worker wrote before wedging on exit.
+            # completed sub-cases keep their caches (healthy results AND
+            # genuine __error__ records the worker wrote before wedging:
+            # a real error message beats a generic timeout), so a retried
+            # group resumes from where the kill landed.
             timeout_rec = np.frombuffer(
                 f"TimeoutExpired: worker exceeded {case_timeout}s "
                 f"(wedged backend?)".encode(), np.uint8)
@@ -450,7 +451,7 @@ def supervise(platform, out_path, case_timeout=150.0, max_consec_fail=4):
                         for c in group_subcases[name]()]
                        if name in group_subcases else [marker])
             for p in missing:
-                if not (os.path.exists(p) and not _is_error_record(p)):
+                if not os.path.exists(p):
                     np.savez_compressed(p, __error__=timeout_rec)
             consec += 1
             print(f"[tpu_diff] {name}: TIMEOUT ({case_timeout}s)",

@@ -1,0 +1,167 @@
+"""Reference binary Parameter-file interop (clean-room from the format
+the reference documents in demo/model_zoo/embedding/paraconvert.py:33-55
+and writes in parameter/Parameter.cpp:281-307):
+
+    header, 16 bytes little-endian x86 layout:
+        version     int32   (0 in every shipped model)
+        float_size  int32   sizeof(real): 4 or 8
+        para_count  int64   total number of scalars
+    body: para_count scalars of float_size bytes
+
+This is the format of every reference checkpoint param file
+(pass-%05d/<param_name>) AND of the shipped pretrained model_zoo
+artifacts (ResNet weights, baidu.dict embedding table), so reading it is
+the migration path for weights trained on the reference.
+
+Functions mirror the reference tooling: read/write single files,
+binary<->text (paraconvert.py parity, same text layout), pass-dir bulk
+load, and the extract_para.py sub-dict row extraction."""
+
+import os
+import struct
+
+import numpy as np
+
+_HEADER = struct.Struct("<iiq")     # version, float_size, para_count
+
+
+def _parse_header(path):
+    """(version, float_size, count) if the file CARRIES a plausible
+    reference header, else None — the is-this-a-param-file test."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(_HEADER.size)
+    except OSError:
+        return None
+    if len(head) != _HEADER.size:
+        return None
+    version, float_size, count = _HEADER.unpack(head)
+    if float_size not in (4, 8) or count < 0:
+        return None
+    return version, float_size, count
+
+
+def read_param(path, with_header=False):
+    """-> flat np array (f32 or f64 per the file's float_size); with
+    with_header=True, (array, (version, float_size))."""
+    parsed = _parse_header(path)
+    if parsed is None:
+        raise ValueError(
+            f"{path}: no reference Parameter header (16 bytes: version "
+            "i32, float_size i32 in {{4,8}}, count i64)")
+    version, float_size, count = parsed
+    dt = np.float32 if float_size == 4 else np.float64
+    with open(path, "rb") as f:
+        f.seek(_HEADER.size)
+        data = np.fromfile(f, dtype=dt, count=count)
+    if data.size != count:
+        raise ValueError(f"{path}: body has {data.size} scalars, header "
+                         f"promises {count}")
+    return (data, (version, float_size)) if with_header else data
+
+
+def write_param(path, arr, version=0, float_size=None):
+    """Write a reference-format binary param file; float_size defaults to
+    the array's own width (f64 in -> f64 file)."""
+    arr = np.asarray(arr)
+    if float_size is None:
+        float_size = 8 if arr.dtype == np.float64 else 4
+    dt = np.float32 if float_size == 4 else np.float64
+    arr = np.ascontiguousarray(arr, dt).reshape(-1)
+    with open(path, "wb") as f:
+        f.write(_HEADER.pack(version, float_size, arr.size))
+        arr.tofile(f)
+
+
+def binary2text(in_path, out_path, dim):
+    """paraconvert.py --b2t: header line 'version,float_size,count', then
+    count/dim lines of dim comma-joined values.  Header metadata and
+    precision follow the SOURCE file (f64 stays f64 through the round
+    trip)."""
+    data, (version, float_size) = read_param(in_path, with_header=True)
+    if data.size % dim:
+        raise ValueError(f"{in_path}: {data.size} scalars not divisible "
+                         f"by dim={dim}")
+    fmt = "{:.7f}" if float_size == 4 else "{:.17g}"
+    with open(out_path, "w") as f:
+        f.write(f"{version},{float_size},{data.size}\n")
+        for row in data.reshape(-1, dim):
+            f.write(",".join(fmt.format(v) for v in row) + "\n")
+    return data.size // dim
+
+
+def text2binary(in_path, out_path):
+    """paraconvert.py --t2b: inverse of binary2text (header's version and
+    float_size are preserved into the binary)."""
+    with open(in_path) as f:
+        head = f.readline().strip().split(",")
+        version, float_size, count = int(head[0]), int(head[1]), int(head[2])
+        dt = np.float32 if float_size == 4 else np.float64
+        vals = np.loadtxt(f, delimiter=",", dtype=dt, ndmin=2)
+    flat = vals.reshape(-1)
+    if flat.size != count:
+        raise ValueError(f"{in_path}: {flat.size} values, header "
+                         f"promises {count}")
+    write_param(out_path, flat, version=version, float_size=float_size)
+    return flat.size
+
+
+def load_pass_dir(pass_dir):
+    """Reference checkpoint dir (pass-%05d/ with one binary file per
+    parameter) -> {param_name: flat array}.  Entries WITHOUT a parseable
+    reference header (done markers, subdirs) are skipped; a file that
+    carries the header but fails to read (truncated body) RAISES — a
+    silently dropped param would fall back to random init downstream."""
+    out = {}
+    for name in sorted(os.listdir(pass_dir)):
+        p = os.path.join(pass_dir, name)
+        if not os.path.isfile(p) or _parse_header(p) is None:
+            continue
+        out[name] = read_param(p)
+    return out
+
+
+def extract_rows(emb_path, indices, dim):
+    """extract_para.py role: pull the embedding rows of a sub-dict out of
+    a full pretrained table.  indices: word ids into the big table, or
+    None for every row (one read, no gather)."""
+    data = read_param(emb_path)
+    if data.size % dim:
+        raise ValueError(f"{emb_path}: {data.size} scalars not divisible "
+                         f"by dim={dim}")
+    table = data.reshape(-1, dim)
+    if indices is None:
+        return table
+    idx = np.asarray(indices, np.int64)
+    if idx.size and (idx.min() < 0 or idx.max() >= table.shape[0]):
+        raise ValueError(
+            f"indices span [{idx.min()}, {idx.max()}] but table has "
+            f"{table.shape[0]} rows")
+    return table[idx]
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="reference binary Parameter-file converter "
+                    "(paraconvert.py parity)")
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--b2t", action="store_true")
+    g.add_argument("--t2b", action="store_true")
+    ap.add_argument("-i", required=True, help="input file")
+    ap.add_argument("-o", required=True, help="output file")
+    ap.add_argument("-d", type=int, default=None,
+                    help="embedding dim (required for --b2t)")
+    args = ap.parse_args(argv)
+    if args.b2t:
+        if not args.d:
+            ap.error("--b2t needs -d DIM")
+        n = binary2text(args.i, args.o, args.d)
+        print(f"wrote {args.o}: {n} rows x {args.d}")
+    else:
+        n = text2binary(args.i, args.o)
+        print(f"wrote {args.o}: {n} scalars")
+
+
+if __name__ == "__main__":
+    main()

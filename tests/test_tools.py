@@ -1,6 +1,8 @@
 """User tooling (reference python/paddle/utils/): log curve plotting, model
 diagram emission, torch parameter import."""
 
+import os
+
 import numpy as np
 import pytest
 import jax
@@ -164,3 +166,112 @@ def test_xprof_report_attributes_categories(tmp_path, monkeypatch):
                             captured.__setitem__("env", env) or FakeProc()))
     bench_sweep.run_combo("lstm", 64, None, 60)
     assert captured["env"]["BENCH_PROFILE_DIR"].endswith("lstm_bs64")
+
+
+def test_ref_params_roundtrip(tmp_path):
+    """Reference binary Parameter format (paraconvert.py:33-55 spec):
+    write -> read identity, binary<->text round trip, 16-byte header."""
+    import struct
+    from paddle_tpu.utils.tools import ref_params
+    rng = np.random.RandomState(0)
+    table = rng.randn(7, 5).astype(np.float32)
+    b = tmp_path / "emb.bin"
+    ref_params.write_param(str(b), table)
+    # header layout is the documented 16 bytes: version, float_size, count
+    raw = b.read_bytes()
+    version, fsize, count = struct.unpack("<iiq", raw[:16])
+    assert (version, fsize, count) == (0, 4, 35)
+    np.testing.assert_array_equal(
+        ref_params.read_param(str(b)).reshape(7, 5), table)
+    # binary -> text -> binary survives (text carries 7 decimals)
+    t = tmp_path / "emb.txt"
+    b2 = tmp_path / "emb2.bin"
+    assert ref_params.binary2text(str(b), str(t), dim=5) == 7
+    assert t.read_text().splitlines()[0] == "0,4,35"
+    ref_params.text2binary(str(t), str(b2))
+    np.testing.assert_allclose(ref_params.read_param(str(b2)),
+                               table.reshape(-1), atol=1e-6)
+
+
+def test_ref_params_f64_and_errors(tmp_path):
+    import struct
+    from paddle_tpu.utils.tools import ref_params
+    # f64 body (float_size=8) reads too
+    vals = np.arange(6, dtype=np.float64)
+    p = tmp_path / "d.bin"
+    with open(p, "wb") as f:
+        f.write(struct.pack("<iiq", 0, 8, 6))
+        vals.tofile(f)
+    got = ref_params.read_param(str(p))
+    assert got.dtype == np.float64
+    np.testing.assert_array_equal(got, vals)
+    # truncated body fails loudly
+    q = tmp_path / "t.bin"
+    q.write_bytes(struct.pack("<iiq", 0, 4, 100) + b"\x00" * 8)
+    with pytest.raises(ValueError, match="promises 100"):
+        ref_params.read_param(str(q))
+    # junk float_size fails loudly
+    r = tmp_path / "j.bin"
+    r.write_bytes(struct.pack("<iiq", 0, 3, 1) + b"\x00" * 4)
+    with pytest.raises(ValueError, match="float_size"):
+        ref_params.read_param(str(r))
+
+
+def test_ref_params_extract_and_pass_dir(tmp_path):
+    """extract_para.py role (sub-dict rows) + reference pass-dir bulk
+    load feeding an actual embedding_layer lookup."""
+    from paddle_tpu.utils.tools import ref_params
+    rng = np.random.RandomState(1)
+    table = rng.randn(20, 4).astype(np.float32)
+    emb = tmp_path / "baidu_emb.bin"
+    ref_params.write_param(str(emb), table)
+    rows = ref_params.extract_rows(str(emb), [3, 0, 19], 4)
+    np.testing.assert_array_equal(rows, table[[3, 0, 19]])
+    with pytest.raises(ValueError, match="rows"):
+        ref_params.extract_rows(str(emb), [20], 4)
+
+    # reference checkpoint dir: one binary file per param + a done marker
+    d = tmp_path / "pass-00003"
+    d.mkdir()
+    ref_params.write_param(str(d / "emb.w0"), table)
+    ref_params.write_param(str(d / "fc.w0"), table[:4, :2])
+    (d / "done").write_text("")
+    loaded = ref_params.load_pass_dir(str(d))
+    assert sorted(loaded) == ["emb.w0", "fc.w0"]
+    np.testing.assert_array_equal(loaded["emb.w0"].reshape(20, 4), table)
+
+    # the imported table drives a real embedding lookup
+    import jax.numpy as jnp
+    from paddle_tpu.ops.embedding import embedding_lookup
+    out = embedding_lookup(jnp.asarray(loaded["emb.w0"].reshape(20, 4)),
+                           jnp.asarray([[3, 0]]))
+    np.testing.assert_allclose(np.asarray(out)[0], table[[3, 0]],
+                               atol=1e-6)
+
+
+def test_ref_embedding_demo_cli(tmp_path):
+    """demo CLI: ref_embedding subcommand extracts a sub-dict from a
+    pretrained-format table (the pre_DictAndModel.sh -> extract_para.py
+    workflow, zero-egress)."""
+    import subprocess
+    import sys as _sys
+    from paddle_tpu.utils.tools import ref_params
+    rng = np.random.RandomState(2)
+    table = rng.randn(11, 3).astype(np.float32)
+    emb = tmp_path / "model.bin"
+    ref_params.write_param(str(emb), table)
+    idx = tmp_path / "ids.txt"
+    idx.write_text("5\n1\n9\n")
+    demo = os.path.join(os.path.dirname(__file__), "..", "demo",
+                        "model_zoo", "extract_features.py")
+    r = subprocess.run(
+        [_sys.executable, demo, "ref_embedding", "--emb_file", str(emb),
+         "--dim", "3", "--indices", str(idx),
+         "--out", str(tmp_path / "sub.npz"),
+         "--text", str(tmp_path / "sub.txt")],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    got = np.load(tmp_path / "sub.npz")["embedding"]
+    np.testing.assert_array_equal(got, table[[5, 1, 9]])
+    assert (tmp_path / "sub.txt").read_text().splitlines()[0] == "3 3"
