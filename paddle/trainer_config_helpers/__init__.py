@@ -90,11 +90,14 @@ def outputs(layers, *args):
 
 
 def inputs(layers, *args):
-    """Wrapped inputs(): explicit data-layer ordering."""
+    """Wrapped inputs(): explicit data-layer ordering — wins over the
+    outputs-derived DFS order (reference HasInputsSet semantics)."""
     ins = list(layers if isinstance(layers, (list, tuple)) else [layers])
     ins += list(args)
     if _cp.in_parse():
-        _cp.active_context().input_order = [l.name for l in ins]
+        ctx = _cp.active_context()
+        ctx.input_order = [l.name for l in ins]
+        ctx.explicit_inputs = True
     return None
 
 

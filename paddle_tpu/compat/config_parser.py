@@ -109,6 +109,7 @@ class ParseContext:
         self.data_sources = {}
         self.outputs = []
         self.input_order = []       # data layers in declaration order
+        self.explicit_inputs = False    # inputs(...) was called
         self.evaluators = []
 
 
@@ -127,15 +128,74 @@ def in_parse():
     return bool(_ACTIVE)
 
 
+def _dfs_input_order(outputs):
+    """Data layers in DFS-LRV order over the output graph — the
+    reference's `outputs()` input-order rule
+    (trainer_config_helpers/networks.py:1410-1490): provider slots pair
+    with data layers AS REACHED FROM THE OUTPUTS, not as declared.  The
+    two orders differ when a config declares its label layer first
+    (benchmark/paddle/image/googlenet.py:146 declares `label` before
+    `input`, but the provider yields (img, label)).  Memoized traversal
+    (Topology's walker) yields the reference's first-occurrence order
+    without its exponential revisits on diamond graphs."""
+    from paddle_tpu.layers.graph import Topology
+    order = []
+    for node in Topology._topo_sort(outputs):
+        if getattr(node, "layer_type", None) == "data" \
+                and node.name not in order:
+            order.append(node.name)
+    return order
+
+
 class ParsedConfig:
     def __init__(self, ctx: ParseContext, namespace):
         self.settings = ctx.settings
         self.data_sources = ctx.data_sources
         self.outputs = ctx.outputs
-        self.input_order = ctx.input_order
+        if getattr(ctx, "explicit_inputs", False):
+            # reference: an explicit inputs() wins outright
+            # (HasInputsSet() early-return, networks.py:1449)
+            self.input_order = list(ctx.input_order)
+        else:
+            # reference semantics: input order derives from the outputs'
+            # graph; declaration order only covers data layers the
+            # outputs never reach (kept as a tail so nothing is dropped)
+            dfs = _dfs_input_order(ctx.outputs)
+            order = dfs + [n for n in ctx.input_order if n not in dfs]
+            self._check_seqness_stable(ctx, order)
+            self.input_order = order
         self.evaluators = ctx.evaluators
         self.config_dir = ctx.config_dir
         self.namespace = namespace   # the script's globals (for tooling)
+
+    @staticmethod
+    def _check_seqness_stable(ctx, final_order):
+        """data_layer resolved each layer's seq-ness at DECLARATION index
+        into list-style input_types; feeding pairs types by FINAL order.
+        When the two orders differ, that is only sound if every layer's
+        seq-ness is the same under both pairings (true for the common
+        dense/int image configs) — otherwise fail loud instead of
+        silently scrambling sequence flags."""
+        types = getattr(ctx, "_resolved_types", None)
+        if not isinstance(types, (list, tuple)) \
+                or final_order == ctx.input_order:
+            return
+        decl_idx = {n: i for i, n in enumerate(ctx.input_order)}
+
+        def seqness(i):
+            if i is None or i >= len(types):
+                return None
+            return getattr(types[i], "seq_type", 0)
+
+        for fi, name in enumerate(final_order):
+            if seqness(fi) != seqness(decl_idx.get(name)):
+                raise ConfigError(
+                    f"data layer {name!r}: declaration order and the "
+                    "outputs-derived input order assign different "
+                    "sequence types from the provider's list-style "
+                    "input_types; declare data layers in input order, "
+                    "call inputs(...) explicitly, or use dict-style "
+                    "input_types")
 
 
 def _import_provider(module, config_dir):

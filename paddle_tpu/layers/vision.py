@@ -188,9 +188,12 @@ def img_pool_layer(input, pool_size, stride=1, num_channels=None,
 
     oh, ow = osize(in_shape[0], wh, sh, ph), osize(in_shape[1], ww, sw, pw)
     out_size = channels * oh * ow
-    # XLA reduce_window pads symmetrically; extend padding to reach ceil size
-    eh = (oh - 1) * sh + wh - in_shape[0] - ph
-    ew = (ow - 1) * sw + ww - in_shape[1] - pw
+    # reduce_window needs explicit lo/hi padding; the ceil-mode overhang
+    # is whatever the output size requires BEYOND the symmetric 2*p
+    # (subtracting p only once would double-pad the high side whenever
+    # base padding is nonzero — inception's 3x3 s1 p1 pools hit this)
+    eh = (oh - 1) * sh + wh - in_shape[0] - 2 * ph
+    ew = (ow - 1) * sw + ww - in_shape[1] - 2 * pw
     cfg = {"window": (wh, ww), "stride": (sh, sw),
            "padding": (ph, pw), "extra_pad": (max(eh, 0), max(ew, 0)),
            "channels": channels, "pool_type": pt, "in_shape": in_shape,

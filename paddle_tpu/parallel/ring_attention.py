@@ -109,9 +109,11 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
                 # contiguous T sharding used here this saves FLOPs/energy
                 # on the idle devices, NOT wall-clock — the ring is
                 # synchronous, so each step runs at the speed of its
-                # busiest device (balanced zigzag/striped sharding would
-                # convert the skip into ~2x throughput; future work).  The
-                # ppermute below still runs so the ring stays in step.
+                # busiest device.  ring_attention_zigzag below converts
+                # the skip into real ~2x throughput via balanced
+                # sharding; this plain variant stays for non-causal and
+                # layout-constrained callers.  The ppermute below still
+                # runs so the ring stays in step.
                 needed = (my * tq + tq - 1) >= (src * tq)
                 m, l, acc = jax.lax.cond(needed, attend,
                                          lambda c: c, (m, l, acc))

@@ -7,9 +7,9 @@
 #   bash paddle_tpu/scripts/window_watch.sh [artifacts_dir]
 #
 # Log: /tmp/window_watch.log (probe timeline), plus healthy_window's own
-# logs once triggered.  A wedge AFTER the handoff is healthy_window's
-# problem (its phases are resumable); this script does not re-trigger —
-# re-launch it for another window.
+# logs once triggered.  The loop re-triggers healthy_window after every
+# return (wedge mid-queue OR completed queue) — run ONE instance; a
+# second would fight over the same artifacts dir and chip.
 set -u
 cd "$(dirname "$0")/../.."
 ART="${1:-$PWD/artifacts/r5}"
@@ -26,7 +26,15 @@ echo "[watch $(date -u +%H:%M:%S)] prober up (pid $$)" >> "$LOG"
 while true; do
     if probe; then
         echo "[watch $(date -u +%H:%M:%S)] chip ANSWERED — launching healthy_window" >> "$LOG"
-        exec bash paddle_tpu/scripts/healthy_window.sh "$ART"
+        # run (not exec): if the window wedges mid-queue or completes,
+        # keep probing — a later window resumes the queue (skip-fresh
+        # and per-phase caches make re-entry cheap, and a completed
+        # queue's re-run is nearly a no-op)
+        bash paddle_tpu/scripts/healthy_window.sh "$ART" \
+            >> "$LOG" 2>&1
+        echo "[watch $(date -u +%H:%M:%S)] healthy_window returned rc=$?; resuming probe" >> "$LOG"
+        sleep 150
+        continue
     fi
     echo "[watch $(date -u +%H:%M:%S)] wedged" >> "$LOG"
     sleep 150

@@ -608,3 +608,66 @@ def test_traffic_prediction_config_unchanged(in_tmp):
     costs = _train_batches(cfg, n_batches=1, num_passes=1)
     assert costs, "provider yielded no batches"
     assert np.isfinite(costs).all()
+
+
+@pytest.mark.parametrize("conf,cargs", [
+    ("smallnet_mnist_cifar.py", "batch_size=4"),
+    ("alexnet.py", "batch_size=2"),
+    # googlenet compiles for minutes on CPU: covered on demand (it DID
+    # expose the DFS input-order and ceil-pool-padding divergences)
+    pytest.param("googlenet.py", "batch_size=2", marks=pytest.mark.skipif(
+        not os.environ.get("PADDLE_TPU_SLOW_TESTS"),
+        reason="minutes-long CPU compile; set PADDLE_TPU_SLOW_TESTS=1")),
+], ids=["smallnet", "alexnet", "googlenet"])
+def test_benchmark_image_config_unchanged(in_tmp, conf, cargs):
+    """benchmark/paddle/image configs (the BASELINE.md conv rows) run
+    verbatim: py2 provider (xrange, inclusive-randint labels), img_conv /
+    img_cmrnorm / img_pool stacks, conv_projection inceptions, DFS input
+    order (label declared first), ceil-mode pooling, config_args batch
+    sizing."""
+    path = f"{REFERENCE}/benchmark/paddle/image/{conf}"
+    if not os.path.exists(path):
+        pytest.skip("reference benchmark configs not available")
+    _write(in_tmp / "train.list", "dummy\n")
+    parsed = parse_config(path, cargs)
+    cfg = config_to_runtime(parsed)
+    costs = _train_batches(cfg, n_batches=2)
+    assert np.isfinite(costs).all()
+
+
+def test_explicit_inputs_beats_dfs_order(in_tmp):
+    """inputs(...) wins over the outputs-derived DFS order (reference
+    HasInputsSet early-return, networks.py:1449) — a config listing its
+    data layers explicitly must feed in THAT order even when the graph
+    reaches them differently."""
+    conf = in_tmp / "conf.py"
+    _write(conf, """
+from paddle.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=0.01)
+# declared label-first AND reached label-last by the graph; the explicit
+# inputs() call pins the order regardless
+lab = data_layer(name='lab', size=1)
+x = data_layer(name='x', size=6)
+fc = fc_layer(input=x, size=4, act=TanhActivation())
+cost = classification_cost(
+    input=fc_layer(input=fc, size=2, act=SoftmaxActivation()), label=lab)
+inputs(lab, x)
+outputs(cost)
+""")
+    parsed = parse_config(str(conf), "")
+    assert parsed.input_order == ["lab", "x"]
+
+    conf2 = in_tmp / "conf2.py"
+    _write(conf2, """
+from paddle.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=0.01)
+lab = data_layer(name='lab', size=1)
+x = data_layer(name='x', size=6)
+fc = fc_layer(input=x, size=4, act=TanhActivation())
+cost = classification_cost(
+    input=fc_layer(input=fc, size=2, act=SoftmaxActivation()), label=lab)
+outputs(cost)
+""")
+    # no inputs(): DFS from the outputs reaches x before lab
+    parsed2 = parse_config(str(conf2), "")
+    assert parsed2.input_order == ["x", "lab"]

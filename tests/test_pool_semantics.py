@@ -1,0 +1,64 @@
+"""img_pool_layer vs a brute-force oracle across ceil/floor modes and
+paddings (reference outputSize semantics, config_parser cnn_output_size:
+ceil_mode pools pad the HIGH side just enough to reach the ceil output —
+the inception 3x3 s1 p1 case regressed once by double-counting base
+padding)."""
+
+import math
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.layers as L
+from paddle_tpu.layers.graph import Topology, reset_names
+
+
+def _ref_pool(img, k, s, p, ceil, kind):
+    c, h, w = img.shape
+
+    def osz(n):
+        if ceil:
+            return int(math.ceil((n + 2 * p - k) / s)) + 1
+        return (n + 2 * p - k) // s + 1
+
+    oh, ow = osz(h), osz(w)
+    out = np.zeros((c, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            vals = []
+            for di in range(k):
+                for dj in range(k):
+                    y, x = i * s - p + di, j * s - p + dj
+                    if 0 <= y < h and 0 <= x < w:
+                        vals.append(img[:, y, x])
+            v = np.stack(vals, 0)
+            out[:, i, j] = v.max(0) if kind == "max" else v.mean(0)
+    return out
+
+
+@pytest.mark.parametrize("h,k,s,p,ceil", [
+    (28, 3, 1, 1, True),      # inception maxpool (the regression case)
+    (56, 3, 2, 0, True),      # stem pool, fractional ceil
+    (28, 3, 2, 1, True),
+    (14, 5, 3, 2, True),
+    (28, 3, 2, 1, False),
+    (29, 2, 2, 0, True),      # odd input
+])
+@pytest.mark.parametrize("kind", ["max", "avg"])
+def test_pool_matches_bruteforce(h, k, s, p, ceil, kind):
+    reset_names()
+    c = 2
+    rng = np.random.RandomState(h * 100 + k * 10 + s + p)
+    x = L.data_layer("x", size=c * h * h)
+    pool = L.img_pool_layer(x, pool_size=k, stride=s, padding=p,
+                            num_channels=c, ceil_mode=ceil, pool_type=kind)
+    topo = Topology([pool])
+    params = topo.init(jax.random.PRNGKey(0))
+    img = rng.randn(c, h, h).astype(np.float32)
+    got = np.asarray(topo.apply(
+        params, {"x": jnp.asarray(img.reshape(1, -1))}, mode="test"))
+    want = _ref_pool(img, k, s, p, ceil, kind)
+    assert pool.img_shape == want.shape[1:]
+    np.testing.assert_allclose(got.reshape(want.shape), want, atol=1e-5)

@@ -1,0 +1,25 @@
+"""Cross-rank straggler telemetry unit semantics (the 2-process behavior
+is asserted in test_distributed); single-process here: report shape,
+empty-window collective safety, array inputs."""
+
+import numpy as np
+
+from paddle_tpu.parallel.distributed import step_skew_report
+
+
+def test_report_shape_and_content():
+    rep = step_skew_report([0.010, 0.012, 0.020, 0.011])
+    assert rep.startswith("train_step skew (4 steps/rank):")
+    assert "r0[p50=" in rep and "p99=" in rep
+    assert "slowest=r0" in rep and "p50-spread=0%" in rep
+
+
+def test_array_input_and_name():
+    rep = step_skew_report(np.asarray([0.5, 0.25]), name="io_wait")
+    assert rep.startswith("io_wait skew (2 steps/rank)")
+
+
+def test_empty_window_returns_none_after_gather():
+    # the gather still runs (collective safety) but the report is None
+    assert step_skew_report([]) is None
+    assert step_skew_report(np.asarray([])) is None
