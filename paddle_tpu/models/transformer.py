@@ -300,20 +300,29 @@ def lm_loss(params, tokens: SequenceBatch, num_heads=8, segment_ids=None,
         valid = jnp.concatenate([m[:, 1:], jnp.zeros((b, 1), bool)],
                                 axis=1)
     labels = jnp.roll(ids, -1, axis=1)      # wrap at T-1 is masked out
-    h = encode(params, tokens, num_heads, remat=remat, mesh=mesh,
-               segment_ids=segment_ids, positions=positions, causal=True,
-               zigzag=zigzag)
+    logits = lm_logits(params, tokens, num_heads, remat=remat, mesh=mesh,
+                       segment_ids=segment_ids, positions=positions,
+                       zigzag=zigzag)
     if zigzag:
         order = _zigzag_idx(t, mesh)
         labels, valid = labels[:, order], valid[:, order]
-    # final LN before the tied projection (the GPT/pre-LN convention,
-    # same as decode's ln_f): without it the un-normalized residual
-    # stream's depth-growing magnitude sets the softmax temperature
-    h = _ln(params["ln_f"], h)
-    logits = linear.matmul(h, params["src_emb"].T)
     per_tok = _token_ce(logits, labels, label_smoothing)
     w = valid.astype(per_tok.dtype)
     return jnp.sum(per_tok * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def _lm_project(params, h):
+    """Final LN + tied-embedding projection (the GPT/pre-LN convention,
+    same ln_f as decode): without the LN the un-normalized residual
+    stream's depth-growing magnitude would set the softmax temperature."""
+    return linear.matmul(_ln(params["ln_f"], h), params["src_emb"].T)
+
+
+def lm_logits(params, tokens: SequenceBatch, num_heads=8, **encode_kw):
+    """Full-sequence LM logits [B, T, V]: the lm_generate oracle and the
+    building block lm_loss uses via encode(causal=True) + _lm_project."""
+    h = encode(params, tokens, num_heads, causal=True, **encode_kw)
+    return _lm_project(params, h)
 
 
 # --------------------------------------------------------- cached decode
@@ -374,20 +383,13 @@ def decode_step_cached(params, src_mask, prev_ids, t, cache, cross,
     pos_mask = jnp.broadcast_to(pos_mask, (b, max_len))
     new_cache = []
     for blk, c, cx in zip(params["dec"], cache, cross):
-        h = _ln(blk["ln1"], x)
-        k = jax.lax.dynamic_update_slice_in_dim(
-            c["k"], linear.matmul(h, blk["attn"]["wk"]), t, axis=1)
-        v = jax.lax.dynamic_update_slice_in_dim(
-            c["v"], linear.matmul(h, blk["attn"]["wv"]), t, axis=1)
-        q = linear.matmul(h, blk["attn"]["wq"])
-        att = _attend(q, k, v, num_heads, pos_mask)
-        x = x + linear.matmul(att, blk["attn"]["wo"])
+        x, nc = _cached_self_attn(blk, x, c, t, pos_mask, num_heads)
         hx = _ln(blk["ln_x"], x)
         xq = linear.matmul(hx, blk["xattn"]["wq"])
         xat = _attend(xq, cx["xk"], cx["xv"], num_heads, src_mask > 0)
         x = x + linear.matmul(xat, blk["xattn"]["wo"])
         x = x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
-        new_cache.append({"k": k, "v": v})
+        new_cache.append(nc)
     x = _ln(params["ln_f"], x)
     return linear.matmul(x, params["out"])[:, 0], new_cache
 
@@ -445,3 +447,116 @@ def generate(params, src: SequenceBatch, beam_size=4, max_len=64, bos_id=0,
                   jnp.zeros((bk,), jnp.int32))
     return beam_ops.beam_search(step_fn, init_state, b, beam_size, max_len,
                                 bos_id, eos_id, length_penalty=length_penalty)
+
+
+# ------------------------------------------------------ decoder-only LM
+
+def _cached_self_attn(blk, x, c, t, pos_mask, num_heads):
+    """Shared incremental self-attention block: write this position's K/V
+    into the cache, attend over positions <= t, residual-add — ONE
+    definition for decode_step_cached and lm_decode_step so the two
+    cached steps cannot drift."""
+    h = _ln(blk["ln1"], x)
+    k = jax.lax.dynamic_update_slice_in_dim(
+        c["k"], linear.matmul(h, blk["attn"]["wk"]), t, axis=1)
+    v = jax.lax.dynamic_update_slice_in_dim(
+        c["v"], linear.matmul(h, blk["attn"]["wv"]), t, axis=1)
+    q = linear.matmul(h, blk["attn"]["wq"])
+    att = _attend(q, k, v, num_heads, pos_mask)
+    return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
+
+
+def lm_decode_step(params, prev_ids, t, cache, num_heads=8):
+    """One incremental position of the decoder-only trunk (the enc stack
+    run causal, lm_loss's twin): prev_ids [B] at position t -> (logits
+    [B, V], updated cache).  cache: per-enc-layer K/V buffers
+    [B, max_len, D] (init_lm_cache)."""
+    b = prev_ids.shape[0]
+    max_len = cache[0]["k"].shape[1]
+    x = emb_ops.embedding_lookup(params["src_emb"], prev_ids)[:, None]
+    x = x * math.sqrt(x.shape[-1]) \
+        + jax.lax.dynamic_slice_in_dim(params["pos"], t, 1)[None]
+    pos_mask = jnp.broadcast_to(jnp.arange(max_len)[None, :] <= t,
+                                (b, max_len))
+    new_cache = []
+    for blk, c in zip(params["enc"], cache):
+        x, nc = _cached_self_attn(blk, x, c, t, pos_mask, num_heads)
+        x = x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
+        new_cache.append(nc)
+    return _lm_project(params, x)[:, 0], new_cache
+
+
+def init_lm_cache(params, batch, max_len):
+    """K/V buffers for lm_decode_step (mirrors init_decode_cache, but for
+    the enc stack the LM trunk runs)."""
+    if max_len > params["pos"].shape[0]:
+        raise ValueError(
+            f"lm decode max_len {max_len} exceeds the positional table "
+            f"({params['pos'].shape[0]}); re-init with a larger max_len")
+    d = params["src_emb"].shape[1]
+    dt = params["src_emb"].dtype
+    return [{"k": jnp.zeros((batch, max_len, d), dt),
+             "v": jnp.zeros((batch, max_len, d), dt)}
+            for _ in params["enc"]]
+
+
+def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
+                top_k=0, rng=None, eos_id=None):
+    """Autoregressive sampling from the decoder-only LM (KV-cached, one
+    jittable lax.scan): prompt [B, Tp] int ids (equal-length prompts;
+    pack/bucket ragged prompts upstream) -> ids [B, max_len] beginning
+    with the prompt.
+
+    temperature=0 is greedy (deterministic argmax — the rollout the
+    oracle test replays with full-sequence lm_logits); otherwise
+    categorical over logits/temperature, optionally truncated to the
+    top_k highest-probability tokens.  eos_id: rows that emit it keep
+    emitting it (done-row pinning, matching beam-search semantics)."""
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, tp = prompt.shape
+    if not (0 < tp <= max_len):
+        raise ValueError(f"prompt length {tp} must be in [1, {max_len}]")
+    if temperature and rng is None:
+        raise ValueError("temperature > 0 sampling needs rng=jax.random."
+                         "PRNGKey(...)")
+    vocab = params["src_emb"].shape[0]
+    if top_k and not (0 < top_k <= vocab):
+        # the negative gather index would silently clamp inside jit and
+        # disable truncation entirely
+        raise ValueError(f"top_k={top_k} must be in [1, vocab={vocab}]")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    ids0 = jnp.zeros((b, max_len), jnp.int32)
+    ids0 = jax.lax.dynamic_update_slice(ids0, prompt, (0, 0))
+
+    def sample(logits, key):
+        if not temperature:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    def step(carry, t):
+        ids, cache, key, done = carry
+        tok = jnp.take_along_axis(ids, t[None, None], axis=1)[:, 0]
+        logits, cache = lm_decode_step(params, tok, t, cache, num_heads)
+        key, sub = jax.random.split(key)
+        nxt = sample(logits, sub)
+        if eos_id is not None:
+            # only GENERATED eos pins a row (tok at t is generated iff
+            # t >= tp): a bos==eos vocab or an eos-valued separator
+            # inside the prompt must not suppress the whole continuation
+            done = done | ((tok == eos_id) & (t >= tp))
+            nxt = jnp.where(done, eos_id, nxt)
+        # prompt positions keep their given token (t runs to max_len-2,
+        # so t+1 is always in bounds)
+        cur = jnp.take_along_axis(ids, (t + 1)[None, None], axis=1)[:, 0]
+        nxt = jnp.where((t + 1) < tp, cur, nxt)
+        ids = jax.vmap(lambda row, v: row.at[t + 1].set(v))(ids, nxt)
+        return (ids, cache, key, done), None
+
+    init = (ids0, init_lm_cache(params, b, max_len), rng,
+            jnp.zeros((b,), bool))
+    (ids, _, _, _), _ = jax.lax.scan(step, init, jnp.arange(max_len - 1))
+    return ids

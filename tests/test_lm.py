@@ -135,3 +135,86 @@ def test_lm_zigzag_guards():
                            jnp.full((2,), T, jnp.int32))
     with pytest.raises(ValueError, match="seq > 1"):
         transformer.lm_loss(params, tokens, HEADS, zigzag=True)
+
+
+def _oracle_greedy(params, prompt, max_len, heads=HEADS):
+    """Full-recompute greedy rollout via lm_logits — the numerics oracle
+    for the KV-cached lm_generate."""
+    b, tp = prompt.shape
+    ids = np.zeros((b, max_len), np.int32)
+    ids[:, :tp] = prompt
+    for t in range(max_len - 1):
+        sb = SequenceBatch(jnp.asarray(ids), jnp.full((b,), t + 1,
+                                                      jnp.int32))
+        logits = transformer.lm_logits(params, sb, heads)
+        nxt = np.asarray(jnp.argmax(logits[:, t], axis=-1))
+        if t + 1 < tp:
+            continue
+        ids[:, t + 1] = nxt
+    return ids
+
+
+def test_lm_generate_cached_matches_full_recompute(np_rng):
+    """Greedy lm_generate (KV cache, one position per step) reproduces
+    the full-sequence argmax rollout exactly."""
+    params = _params(max_len=12)
+    prompt = np_rng.randint(3, V, (3, 4)).astype(np.int32)
+    got = np.asarray(transformer.lm_generate(params, prompt, max_len=12,
+                                             num_heads=HEADS))
+    want = _oracle_greedy(params, prompt, 12)
+    np.testing.assert_array_equal(got, want)
+    # prompt preserved
+    np.testing.assert_array_equal(got[:, :4], prompt)
+
+
+def test_lm_generate_sampling_and_eos(np_rng):
+    params = _params(max_len=16)
+    prompt = np_rng.randint(3, V, (4, 2)).astype(np.int32)
+    ids = np.asarray(transformer.lm_generate(
+        params, prompt, max_len=16, num_heads=HEADS, temperature=0.8,
+        top_k=5, rng=jax.random.PRNGKey(3)))
+    assert ids.shape == (4, 16)
+    assert ((ids >= 0) & (ids < V)).all()
+    # same rng -> same draw; different rng -> (overwhelmingly) different
+    ids2 = np.asarray(transformer.lm_generate(
+        params, prompt, max_len=16, num_heads=HEADS, temperature=0.8,
+        top_k=5, rng=jax.random.PRNGKey(3)))
+    np.testing.assert_array_equal(ids, ids2)
+
+    # eos pinning: once a row emits eos, it keeps emitting eos
+    eos = 7
+    ids3 = np.asarray(transformer.lm_generate(
+        params, prompt, max_len=16, num_heads=HEADS, temperature=1.5,
+        rng=jax.random.PRNGKey(5), eos_id=eos))
+    for row in ids3:
+        hit = np.where(row == eos)[0]
+        if hit.size and hit[0] >= 2:           # ignore eos inside prompt
+            assert (row[hit[0]:] == eos).all()
+
+    # guards
+    with pytest.raises(ValueError, match="needs rng"):
+        transformer.lm_generate(params, prompt, max_len=16,
+                                num_heads=HEADS, temperature=0.5)
+    with pytest.raises(ValueError, match="prompt length"):
+        transformer.lm_generate(params, np.zeros((1, 20), np.int32),
+                                max_len=16, num_heads=HEADS)
+
+
+def test_lm_generate_eos_in_prompt_does_not_pin(np_rng):
+    """An eos-valued token INSIDE the prompt (bos==eos vocabs, separator
+    tokens) must not suppress the continuation — only generated eos
+    pins a row."""
+    params = _params(max_len=12)
+    eos = 5
+    prompt = np.asarray([[eos, 10, 11, 12]], np.int32)
+    ids = np.asarray(transformer.lm_generate(
+        params, prompt, max_len=12, num_heads=HEADS, eos_id=eos))
+    np.testing.assert_array_equal(ids[0, :4], prompt[0])
+    # greedy continuation must equal the no-eos run until it first
+    # GENERATES eos (if ever) — i.e. eos handling changed nothing early
+    ids_free = np.asarray(transformer.lm_generate(
+        params, prompt, max_len=12, num_heads=HEADS))
+    gen, free = ids[0, 4:], ids_free[0, 4:]
+    cut = np.where(free == eos)[0]
+    upto = cut[0] + 1 if cut.size else len(free)
+    np.testing.assert_array_equal(gen[:upto], free[:upto])
