@@ -466,6 +466,39 @@ def _cached_self_attn(blk, x, c, t, pos_mask, num_heads):
     return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
 
 
+def lm_prefill(params, prompt, max_len, num_heads=8):
+    """Batched causal prefill: run the trunk over the WHOLE prompt in one
+    pass (the MXU-friendly leg), writing every position's K/V into fresh
+    decode caches.  Returns (last-position logits [B, V], cache) — the
+    state lm_decode_step continues from at t = Tp.  Equivalent to Tp
+    sequential lm_decode_step calls (the generation oracle test covers
+    the composition), ~Tp x fewer serial steps."""
+    b, tp = prompt.shape
+    x = emb_ops.embedding_lookup(params["src_emb"], prompt)
+    x = x * math.sqrt(x.shape[-1]) + params["pos"][:tp][None]
+    cache = init_lm_cache(params, b, tp if max_len is None else max_len)
+    new_cache = []
+    for blk, c in zip(params["enc"], cache):
+        h = _ln(blk["ln1"], x)
+        k = linear.matmul(h, blk["attn"]["wk"])
+        v = linear.matmul(h, blk["attn"]["wv"])
+        q = linear.matmul(h, blk["attn"]["wq"])
+        d = q.shape[-1]
+        dh = d // num_heads
+        split = lambda a: a.reshape(b, tp, num_heads, dh).transpose(
+            0, 2, 1, 3)
+        att = attn_ops.dot_product_attention(
+            split(q), split(k), split(v), causal=True, use_flash=False)
+        att = att.transpose(0, 2, 1, 3).reshape(b, tp, d)
+        x = x + linear.matmul(att, blk["attn"]["wo"])
+        x = x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
+        new_cache.append(
+            {"k": jax.lax.dynamic_update_slice_in_dim(c["k"], k, 0, axis=1),
+             "v": jax.lax.dynamic_update_slice_in_dim(c["v"], v, 0,
+                                                      axis=1)})
+    return _lm_project(params, x[:, -1:])[:, 0], new_cache
+
+
 def lm_decode_step(params, prev_ids, t, cache, num_heads=8):
     """One incremental position of the decoder-only trunk (the enc stack
     run causal, lm_loss's twin): prev_ids [B] at position t -> (logits
@@ -511,7 +544,11 @@ def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
     oracle test replays with full-sequence lm_logits); otherwise
     categorical over logits/temperature, optionally truncated to the
     top_k highest-probability tokens.  eos_id: rows that emit it keep
-    emitting it (done-row pinning, matching beam-search semantics)."""
+    emitting it (done-row pinning, matching beam-search semantics).
+
+    The prompt is consumed by ONE batched causal pass (lm_prefill — the
+    MXU-friendly leg that fills the KV cache for all Tp positions at
+    once); only the generated tail runs the per-token scan."""
     prompt = jnp.asarray(prompt, jnp.int32)
     b, tp = prompt.shape
     if not (0 < tp <= max_len):
@@ -525,38 +562,42 @@ def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
         # disable truncation entirely
         raise ValueError(f"top_k={top_k} must be in [1, vocab={vocab}]")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    ids0 = jnp.zeros((b, max_len), jnp.int32)
-    ids0 = jax.lax.dynamic_update_slice(ids0, prompt, (0, 0))
 
     def sample(logits, key):
         if not temperature:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         logits = logits / temperature
         if top_k:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
+            from paddle_tpu.ops.sampling import top_k as topk_op
+            kvals, _ = topk_op(logits, top_k)       # lax.top_k, no sort
+            logits = jnp.where(logits < kvals[:, -1:], -jnp.inf, logits)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
+    logits0, cache = lm_prefill(params, prompt, max_len, num_heads)
+    rng, sub = jax.random.split(rng)
+    first = sample(logits0, sub)
+    ids0 = jnp.zeros((b, max_len), jnp.int32)
+    ids0 = jax.lax.dynamic_update_slice(ids0, prompt, (0, 0))
+    if tp < max_len:
+        ids0 = ids0.at[:, tp].set(first)
+
     def step(carry, t):
+        # t in [tp, max_len-2]: token at t is GENERATED; emit t+1
         ids, cache, key, done = carry
         tok = jnp.take_along_axis(ids, t[None, None], axis=1)[:, 0]
         logits, cache = lm_decode_step(params, tok, t, cache, num_heads)
         key, sub = jax.random.split(key)
         nxt = sample(logits, sub)
         if eos_id is not None:
-            # only GENERATED eos pins a row (tok at t is generated iff
-            # t >= tp): a bos==eos vocab or an eos-valued separator
-            # inside the prompt must not suppress the whole continuation
-            done = done | ((tok == eos_id) & (t >= tp))
+            # only GENERATED eos pins a row: a bos==eos vocab or an
+            # eos-valued separator inside the prompt must not suppress
+            # the whole continuation (prompt steps never enter this scan)
+            done = done | (tok == eos_id)
             nxt = jnp.where(done, eos_id, nxt)
-        # prompt positions keep their given token (t runs to max_len-2,
-        # so t+1 is always in bounds)
-        cur = jnp.take_along_axis(ids, (t + 1)[None, None], axis=1)[:, 0]
-        nxt = jnp.where((t + 1) < tp, cur, nxt)
         ids = jax.vmap(lambda row, v: row.at[t + 1].set(v))(ids, nxt)
         return (ids, cache, key, done), None
 
-    init = (ids0, init_lm_cache(params, b, max_len), rng,
-            jnp.zeros((b,), bool))
-    (ids, _, _, _), _ = jax.lax.scan(step, init, jnp.arange(max_len - 1))
+    init = (ids0, cache, rng, jnp.zeros((b,), bool))
+    (ids, _, _, _), _ = jax.lax.scan(step, init,
+                                     jnp.arange(tp, max_len - 1))
     return ids

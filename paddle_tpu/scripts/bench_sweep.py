@@ -79,6 +79,7 @@ DEFAULT_COMBOS = [
     "transformer_long:2",                         # 8k-token sequences
     "transformer_packed:16",                      # padding-free packing
     "transformer_decode:32",                      # KV-cached serving path
+    "transformer_lm_decode:32",                   # LM sampling throughput
     "transformer_serving:16",                     # bucketed-length stream
     "seq2seq:64",
 ]
