@@ -68,7 +68,7 @@ def main(argv=None):
     state = opt.init(params)
     packed = reader_mod.batch(
         reader_mod.packed(lambda: sentences(512), args.max_len,
-                          buffer_size=64), args.batch)
+                          buffer_size=64), args.batch, drop_last=True)
 
     @jax.jit
     def step(p, s, data, seg, pos):
@@ -82,8 +82,6 @@ def main(argv=None):
     loss = None
     for epoch in range(args.epochs):
         for rows in packed():
-            if len(rows) < args.batch:
-                continue
             params, state, loss = step(
                 params, state,
                 jnp.asarray(np.stack([r[0] for r in rows])),

@@ -469,14 +469,18 @@ def _cached_self_attn(blk, x, c, t, pos_mask, num_heads):
 def lm_prefill(params, prompt, max_len, num_heads=8):
     """Batched causal prefill: run the trunk over the WHOLE prompt in one
     pass (the MXU-friendly leg), writing every position's K/V into fresh
-    decode caches.  Returns (last-position logits [B, V], cache) — the
-    state lm_decode_step continues from at t = Tp.  Equivalent to Tp
-    sequential lm_decode_step calls (the generation oracle test covers
-    the composition), ~Tp x fewer serial steps."""
+    decode caches.  Returns (per-position hidden states [B, Tp, D],
+    cache) — the state lm_decode_step continues from; the caller
+    gathers the position(s) it needs BEFORE the d_model x vocab
+    projection (projecting every prompt position would multiply the
+    most expensive matmul by Tp).  Equivalent to Tp sequential
+    lm_decode_step calls (the generation oracle test covers the
+    composition), ~Tp x fewer serial steps.  With ragged prompts
+    causality keeps padding positions out of real ones."""
     b, tp = prompt.shape
     x = emb_ops.embedding_lookup(params["src_emb"], prompt)
     x = x * math.sqrt(x.shape[-1]) + params["pos"][:tp][None]
-    cache = init_lm_cache(params, b, tp if max_len is None else max_len)
+    cache = init_lm_cache(params, b, max_len)
     new_cache = []
     for blk, c in zip(params["enc"], cache):
         h = _ln(blk["ln1"], x)
@@ -496,7 +500,7 @@ def lm_prefill(params, prompt, max_len, num_heads=8):
             {"k": jax.lax.dynamic_update_slice_in_dim(c["k"], k, 0, axis=1),
              "v": jax.lax.dynamic_update_slice_in_dim(c["v"], v, 0,
                                                       axis=1)})
-    return _lm_project(params, x[:, -1:])[:, 0], new_cache
+    return x, new_cache
 
 
 def lm_decode_step(params, prev_ids, t, cache, num_heads=8):
@@ -534,11 +538,14 @@ def init_lm_cache(params, batch, max_len):
 
 
 def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
-                top_k=0, rng=None, eos_id=None):
+                top_k=0, rng=None, eos_id=None, prompt_lengths=None):
     """Autoregressive sampling from the decoder-only LM (KV-cached, one
-    jittable lax.scan): prompt [B, Tp] int ids (equal-length prompts;
-    pack/bucket ragged prompts upstream) -> ids [B, max_len] beginning
-    with the prompt.
+    jittable lax.scan): prompt [B, Tp] int ids -> ids [B, max_len]
+    beginning with each row's prompt.  prompt_lengths [B] supports
+    RAGGED prompts in one batch (rows padded to Tp; row i's generation
+    starts at its own length — pad value never matters because causal
+    attention keeps padding positions out of real ones and the scan
+    rewrites each position's K/V as it passes).
 
     temperature=0 is greedy (deterministic argmax — the rollout the
     oracle test replays with full-sequence lm_logits); otherwise
@@ -548,7 +555,9 @@ def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
 
     The prompt is consumed by ONE batched causal pass (lm_prefill — the
     MXU-friendly leg that fills the KV cache for all Tp positions at
-    once); only the generated tail runs the per-token scan."""
+    once); the per-token scan starts at the SHORTEST row's length and
+    re-feeds longer rows' remaining prompt tokens (their K/V rewrites
+    are identical — projections are position-local)."""
     prompt = jnp.asarray(prompt, jnp.int32)
     b, tp = prompt.shape
     if not (0 < tp <= max_len):
@@ -562,6 +571,23 @@ def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
         # disable truncation entirely
         raise ValueError(f"top_k={top_k} must be in [1, vocab={vocab}]")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
+    if prompt_lengths is None:
+        lengths = jnp.full((b,), tp, jnp.int32)
+        t_start = tp
+    else:
+        lengths = jnp.asarray(prompt_lengths, jnp.int32)
+        # static scan start: the shortest row's length when concrete
+        # (the usual outside-jit call); inside a trace fall back to
+        # re-feeding from position 1 (still one prefill for the bulk)
+        try:
+            t_start = int(jnp.min(lengths))
+        except jax.errors.ConcretizationTypeError:
+            t_start = 1
+        if not isinstance(lengths, jax.core.Tracer) \
+                and (t_start < 1 or int(jnp.max(lengths)) > tp):
+            raise ValueError(
+                f"prompt_lengths must be in [1, {tp}] (got "
+                f"[{t_start}, {int(jnp.max(lengths))}])")
 
     def sample(logits, key):
         if not temperature:
@@ -573,16 +599,27 @@ def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
             logits = jnp.where(logits < kvals[:, -1:], -jnp.inf, logits)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
-    logits0, cache = lm_prefill(params, prompt, max_len, num_heads)
+    hidden, cache = lm_prefill(params, prompt, max_len, num_heads)
+    # each row's first generated token comes from ITS last real
+    # position — gather the hidden state first, project ONE position
+    # (the d_model x vocab matmul is the expensive part)
+    h_last = jnp.take_along_axis(
+        hidden, (lengths - 1)[:, None, None], axis=1)
+    logits0 = _lm_project(params, h_last)[:, 0]
     rng, sub = jax.random.split(rng)
     first = sample(logits0, sub)
     ids0 = jnp.zeros((b, max_len), jnp.int32)
     ids0 = jax.lax.dynamic_update_slice(ids0, prompt, (0, 0))
-    if tp < max_len:
-        ids0 = ids0.at[:, tp].set(first)
+    # seed each row's first generated slot; a row whose prompt already
+    # fills max_len keeps its prompt value (clamped position, old value)
+    seed_pos = jnp.minimum(lengths, max_len - 1)
+    keep = jnp.take_along_axis(ids0, seed_pos[:, None], axis=1)[:, 0]
+    ids0 = ids0.at[jnp.arange(b), seed_pos].set(
+        jnp.where(lengths < max_len, first, keep))
 
     def step(carry, t):
-        # t in [tp, max_len-2]: token at t is GENERATED; emit t+1
+        # token at t is generated for rows with lengths <= t, still
+        # prompt for longer rows (re-fed; identical K/V rewrite)
         ids, cache, key, done = carry
         tok = jnp.take_along_axis(ids, t[None, None], axis=1)[:, 0]
         logits, cache = lm_decode_step(params, tok, t, cache, num_heads)
@@ -591,13 +628,17 @@ def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
         if eos_id is not None:
             # only GENERATED eos pins a row: a bos==eos vocab or an
             # eos-valued separator inside the prompt must not suppress
-            # the whole continuation (prompt steps never enter this scan)
-            done = done | (tok == eos_id)
+            # the whole continuation
+            done = done | ((tok == eos_id) & (t >= lengths))
             nxt = jnp.where(done, eos_id, nxt)
+        # rows whose prompt extends past t keep their given token; the
+        # slot at a row's own `lengths` was seeded from prefill logits
+        cur = jnp.take_along_axis(ids, (t + 1)[None, None], axis=1)[:, 0]
+        nxt = jnp.where((t + 1) <= lengths, cur, nxt)
         ids = jax.vmap(lambda row, v: row.at[t + 1].set(v))(ids, nxt)
         return (ids, cache, key, done), None
 
     init = (ids0, cache, rng, jnp.zeros((b,), bool))
     (ids, _, _, _), _ = jax.lax.scan(step, init,
-                                     jnp.arange(tp, max_len - 1))
+                                     jnp.arange(t_start, max_len - 1))
     return ids

@@ -218,3 +218,27 @@ def test_lm_generate_eos_in_prompt_does_not_pin(np_rng):
     cut = np.where(free == eos)[0]
     upto = cut[0] + 1 if cut.size else len(free)
     np.testing.assert_array_equal(gen[:upto], free[:upto])
+
+
+def test_lm_generate_ragged_prompts_match_per_row(np_rng):
+    """One batch with per-row prompt lengths == each row generated alone
+    with its exact prompt (greedy): the ragged path changes batching,
+    not numerics."""
+    params = _params(max_len=14)
+    tp = 6
+    lens = [2, 6, 4]
+    prompt = np_rng.randint(3, V, (3, tp)).astype(np.int32)
+    prompt[0, lens[0]:] = 0          # pad values must not matter
+    prompt[2, lens[2]:] = V - 1
+    got = np.asarray(transformer.lm_generate(
+        params, prompt, max_len=14, num_heads=HEADS,
+        prompt_lengths=np.asarray(lens)))
+    for i, li in enumerate(lens):
+        alone = np.asarray(transformer.lm_generate(
+            params, prompt[i:i + 1, :li], max_len=14, num_heads=HEADS))
+        np.testing.assert_array_equal(got[i], alone[0], err_msg=f"row {i}")
+    # bad lengths fail fast
+    with pytest.raises(ValueError, match="prompt_lengths"):
+        transformer.lm_generate(params, prompt, max_len=14,
+                                num_heads=HEADS,
+                                prompt_lengths=np.asarray([2, 9, 4]))
