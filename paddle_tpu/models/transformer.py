@@ -22,15 +22,21 @@ def _dense(rng, din, dout, scale=None):
     return s * jax.random.normal(rng, (din, dout), jnp.float32)
 
 
-def _block_init(ks, d, dff, cross=False):
+def _block_init(ks, d, dff, cross=False, moe_experts=0):
     blk = {
         "ln1": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
         "attn": {"wq": _dense(next(ks), d, d), "wk": _dense(next(ks), d, d),
                  "wv": _dense(next(ks), d, d), "wo": _dense(next(ks), d, d)},
         "ln2": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
-        "ffn": {"w1": _dense(next(ks), d, dff), "b1": jnp.zeros((dff,)),
-                "w2": _dense(next(ks), dff, d), "b2": jnp.zeros((d,))},
     }
+    if moe_experts and moe_experts > 1:
+        from paddle_tpu.ops import moe as moe_ops
+        blk["moe"] = moe_ops.init_moe(next(ks), d, dff, moe_experts)
+    else:
+        blk["ffn"] = {"w1": _dense(next(ks), d, dff),
+                      "b1": jnp.zeros((dff,)),
+                      "w2": _dense(next(ks), dff, d),
+                      "b2": jnp.zeros((d,))}
     if cross:
         blk["ln_x"] = {"g": jnp.ones((d,)), "b": jnp.zeros((d,))}
         blk["xattn"] = {"wq": _dense(next(ks), d, d),
@@ -41,13 +47,21 @@ def _block_init(ks, d, dff, cross=False):
 
 
 def init(rng, src_vocab=30000, trg_vocab=30000, d_model=512, num_heads=8,
-         dff=2048, enc_layers=6, dec_layers=6, max_len=512):
-    ks = iter(jax.random.split(rng, 16 + 8 * (enc_layers + dec_layers)))
+         dff=2048, enc_layers=6, dec_layers=6, max_len=512,
+         moe_experts=0):
+    """moe_experts > 1 replaces every ENC block's dense FFN with a
+    top-k-gated mixture of that many expert FFNs (ops/moe.py: batched
+    einsum over the expert dim, shardable over the 'expert' mesh axis
+    via moe.expert_shardings) — the modern sparse-LM trunk.  Decoder
+    blocks keep dense FFNs (the MoE plane targets the causal/encoder
+    trunk lm_loss trains)."""
+    ks = iter(jax.random.split(rng, 16 + 9 * (enc_layers + dec_layers)))
     params = {
         "src_emb": _dense(next(ks), src_vocab, d_model, scale=0.02),
         "trg_emb": _dense(next(ks), trg_vocab, d_model, scale=0.02),
         "pos": 0.02 * jax.random.normal(next(ks), (max_len, d_model)),
-        "enc": [_block_init(ks, d_model, dff) for _ in range(enc_layers)],
+        "enc": [_block_init(ks, d_model, dff, moe_experts=moe_experts)
+                for _ in range(enc_layers)],
         "dec": [_block_init(ks, d_model, dff, cross=True)
                 for _ in range(dec_layers)],
         "ln_f": {"g": jnp.ones((d_model,)), "b": jnp.zeros((d_model,))},
@@ -97,13 +111,26 @@ def _check_full(seq: SequenceBatch):
             "pack the batch")
 
 
+def _block_ffn(blk, h, moe_top_k=2):
+    """Dense or mixture FFN, depending on how the block was initialized;
+    returns (output, load-balance aux) with aux == 0 for dense.  relu
+    for both so an identical-experts mixture reproduces the dense block
+    exactly (the MoE equivalence test relies on it)."""
+    if "moe" in blk:
+        from paddle_tpu.ops import moe as moe_ops
+        return moe_ops.moe_ffn(h, blk["moe"], top_k=moe_top_k,
+                               act=jax.nn.relu, return_aux=True)
+    return _ffn(blk["ffn"], h), jnp.zeros(())
+
+
 def _enc_block(blk, x, key_mask, num_heads, mesh=None, segment_ids=None,
-               causal=False, zigzag=False):
+               causal=False, zigzag=False, moe_top_k=2):
     h = _ln(blk["ln1"], x)
     x = x + _mha(blk["attn"], h, h, num_heads, key_mask=key_mask,
                  causal=causal, mesh=mesh, zigzag=zigzag,
                  q_segment_ids=segment_ids)
-    return x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
+    y, aux = _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)
+    return x + y, aux
 
 
 def _dec_block(blk, x, enc_out, self_km, cross_km, num_heads, mesh=None,
@@ -118,7 +145,7 @@ def _dec_block(blk, x, enc_out, self_km, cross_km, num_heads, mesh=None,
 
 def encode(params, src: SequenceBatch, num_heads=8, remat=False,
            full_seq=False, mesh=None, segment_ids=None, positions=None,
-           causal=False, zigzag=False):
+           causal=False, zigzag=False, moe_top_k=2, return_aux=False):
     """remat=True checkpoints each block (jax.checkpoint): backward
     recomputes activations instead of storing them — the HBM headroom for
     >=32k-token batches.
@@ -139,7 +166,7 @@ def encode(params, src: SequenceBatch, num_heads=8, remat=False,
     causal self-attention rides the balanced ring; the returned hidden
     states are in zigzag order (lm_loss aligns its labels the same way)."""
     t = src.data.shape[1]
-    block = (jax.checkpoint(_enc_block, static_argnums=(3, 4, 6, 7))
+    block = (jax.checkpoint(_enc_block, static_argnums=(3, 4, 6, 7, 8))
              if remat else _enc_block)
     if (segment_ids is None) != (positions is None):
         raise ValueError("packed encode needs BOTH segment_ids and "
@@ -187,10 +214,12 @@ def encode(params, src: SequenceBatch, num_heads=8, remat=False,
         key_mask = key_mask[:, order]
     if full_seq:
         _check_full(src)
+    aux_total = jnp.zeros(())
     for blk in params["enc"]:
-        x = block(blk, x, key_mask, num_heads, mesh, segment_ids, causal,
-                  zigzag)
-    return x
+        x, aux = block(blk, x, key_mask, num_heads, mesh, segment_ids,
+                       causal, zigzag, moe_top_k)
+        aux_total = aux_total + aux
+    return (x, aux_total) if return_aux else x
 
 
 def decode(params, enc_out, src_mask, trg_in: SequenceBatch, num_heads=8,
@@ -231,18 +260,24 @@ def decode(params, enc_out, src_mask, trg_in: SequenceBatch, num_heads=8,
 
 
 def forward(params, src: SequenceBatch, trg_in: SequenceBatch, num_heads=8,
-            remat=False, full_seq=False, mesh=None, zigzag=False):
-    enc_out = encode(params, src, num_heads, remat=remat,
-                     full_seq=full_seq, mesh=mesh)
-    return decode(params, enc_out, src.mask(), trg_in, num_heads,
-                  remat=remat, full_seq=full_seq, mesh=mesh,
-                  zigzag=zigzag)
+            remat=False, full_seq=False, mesh=None, zigzag=False,
+            return_aux=False, moe_top_k=2):
+    enc = encode(params, src, num_heads, remat=remat,
+                 full_seq=full_seq, mesh=mesh, return_aux=return_aux,
+                 moe_top_k=moe_top_k)
+    enc_out, aux = enc if return_aux else (enc, None)
+    logits = decode(params, enc_out, src.mask(), trg_in, num_heads,
+                    remat=remat, full_seq=full_seq, mesh=mesh,
+                    zigzag=zigzag)
+    return (logits, aux) if return_aux else logits
 
 
 def loss(params, src, trg_in, trg_next, num_heads=8, label_smoothing=0.1,
-         remat=False, full_seq=False, mesh=None, zigzag=False):
-    logits = forward(params, src, trg_in, num_heads, remat=remat,
-                     full_seq=full_seq, mesh=mesh, zigzag=zigzag)
+         remat=False, full_seq=False, mesh=None, zigzag=False,
+         moe_aux_weight=0.01, moe_top_k=2):
+    logits, aux = forward(params, src, trg_in, num_heads, remat=remat,
+                          full_seq=full_seq, mesh=mesh, zigzag=zigzag,
+                          return_aux=True, moe_top_k=moe_top_k)
     labels = trg_next.data
     if labels.ndim == 3:
         labels = labels[..., 0]
@@ -255,7 +290,7 @@ def loss(params, src, trg_in, trg_next, num_heads=8, label_smoothing=0.1,
         tok_mask = tok_mask[:, order]
     per_tok = _token_ce(logits, labels, label_smoothing)
     per_seq = losses.masked_seq_mean(per_tok, tok_mask.astype(per_tok.dtype))
-    return jnp.mean(per_seq)
+    return jnp.mean(per_seq) + moe_aux_weight * aux
 
 
 def _token_ce(logits, labels, label_smoothing):
@@ -272,7 +307,7 @@ def _token_ce(logits, labels, label_smoothing):
 
 def lm_loss(params, tokens: SequenceBatch, num_heads=8, segment_ids=None,
             positions=None, mesh=None, zigzag=False, remat=False,
-            label_smoothing=0.0):
+            label_smoothing=0.0, moe_aux_weight=0.01, moe_top_k=2):
     """Decoder-only (GPT-style) causal LM: the encoder stack run causal,
     next-token cross-entropy with the input embedding tied as the output
     projection.  Token-mean objective (the standard LM loss — every real
@@ -300,15 +335,19 @@ def lm_loss(params, tokens: SequenceBatch, num_heads=8, segment_ids=None,
         valid = jnp.concatenate([m[:, 1:], jnp.zeros((b, 1), bool)],
                                 axis=1)
     labels = jnp.roll(ids, -1, axis=1)      # wrap at T-1 is masked out
-    logits = lm_logits(params, tokens, num_heads, remat=remat, mesh=mesh,
-                       segment_ids=segment_ids, positions=positions,
-                       zigzag=zigzag)
+    logits, aux = lm_logits(params, tokens, num_heads, remat=remat,
+                            mesh=mesh, segment_ids=segment_ids,
+                            positions=positions, zigzag=zigzag,
+                            moe_top_k=moe_top_k, return_aux=True)
     if zigzag:
         order = _zigzag_idx(t, mesh)
         labels, valid = labels[:, order], valid[:, order]
     per_tok = _token_ce(logits, labels, label_smoothing)
     w = valid.astype(per_tok.dtype)
-    return jnp.sum(per_tok * w) / jnp.maximum(jnp.sum(w), 1.0)
+    ce = jnp.sum(per_tok * w) / jnp.maximum(jnp.sum(w), 1.0)
+    # MoE load-balance aux (exactly 0 for a dense trunk, so the weight
+    # is inert there)
+    return ce + moe_aux_weight * aux
 
 
 def _lm_project(params, h):
@@ -318,11 +357,18 @@ def _lm_project(params, h):
     return linear.matmul(_ln(params["ln_f"], h), params["src_emb"].T)
 
 
-def lm_logits(params, tokens: SequenceBatch, num_heads=8, **encode_kw):
+def lm_logits(params, tokens: SequenceBatch, num_heads=8,
+              return_aux=False, **encode_kw):
     """Full-sequence LM logits [B, T, V]: the lm_generate oracle and the
-    building block lm_loss uses via encode(causal=True) + _lm_project."""
-    h = encode(params, tokens, num_heads, causal=True, **encode_kw)
-    return _lm_project(params, h)
+    building block lm_loss uses via encode(causal=True) + _lm_project.
+    return_aux=True additionally returns the MoE load-balance aux (0 for
+    a dense trunk)."""
+    out = encode(params, tokens, num_heads, causal=True,
+                 return_aux=return_aux, **encode_kw)
+    if return_aux:
+        h, aux = out
+        return _lm_project(params, h), aux
+    return _lm_project(params, out)
 
 
 # --------------------------------------------------------- cached decode
@@ -466,7 +512,7 @@ def _cached_self_attn(blk, x, c, t, pos_mask, num_heads):
     return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
 
 
-def lm_prefill(params, prompt, max_len, num_heads=8):
+def lm_prefill(params, prompt, max_len, num_heads=8, moe_top_k=2):
     """Batched causal prefill: run the trunk over the WHOLE prompt in one
     pass (the MXU-friendly leg), writing every position's K/V into fresh
     decode caches.  Returns (per-position hidden states [B, Tp, D],
@@ -495,7 +541,7 @@ def lm_prefill(params, prompt, max_len, num_heads=8):
             split(q), split(k), split(v), causal=True, use_flash=False)
         att = att.transpose(0, 2, 1, 3).reshape(b, tp, d)
         x = x + linear.matmul(att, blk["attn"]["wo"])
-        x = x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
+        x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
         new_cache.append(
             {"k": jax.lax.dynamic_update_slice_in_dim(c["k"], k, 0, axis=1),
              "v": jax.lax.dynamic_update_slice_in_dim(c["v"], v, 0,
@@ -503,7 +549,8 @@ def lm_prefill(params, prompt, max_len, num_heads=8):
     return x, new_cache
 
 
-def lm_decode_step(params, prev_ids, t, cache, num_heads=8):
+def lm_decode_step(params, prev_ids, t, cache, num_heads=8,
+                   moe_top_k=2):
     """One incremental position of the decoder-only trunk (the enc stack
     run causal, lm_loss's twin): prev_ids [B] at position t -> (logits
     [B, V], updated cache).  cache: per-enc-layer K/V buffers
@@ -518,7 +565,7 @@ def lm_decode_step(params, prev_ids, t, cache, num_heads=8):
     new_cache = []
     for blk, c in zip(params["enc"], cache):
         x, nc = _cached_self_attn(blk, x, c, t, pos_mask, num_heads)
-        x = x + _ffn(blk["ffn"], _ln(blk["ln2"], x))
+        x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
         new_cache.append(nc)
     return _lm_project(params, x)[:, 0], new_cache
 
@@ -538,7 +585,8 @@ def init_lm_cache(params, batch, max_len):
 
 
 def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
-                top_k=0, rng=None, eos_id=None, prompt_lengths=None):
+                top_k=0, rng=None, eos_id=None, prompt_lengths=None,
+                moe_top_k=2):
     """Autoregressive sampling from the decoder-only LM (KV-cached, one
     jittable lax.scan): prompt [B, Tp] int ids -> ids [B, max_len]
     beginning with each row's prompt.  prompt_lengths [B] supports
@@ -577,17 +625,23 @@ def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
     else:
         lengths = jnp.asarray(prompt_lengths, jnp.int32)
         # static scan start: the shortest row's length when concrete
-        # (the usual outside-jit call); inside a trace fall back to
-        # re-feeding from position 1 (still one prefill for the bulk)
-        try:
-            t_start = int(jnp.min(lengths))
-        except jax.errors.ConcretizationTypeError:
+        # (the usual outside-jit call); under a trace fall back to
+        # re-feeding from position 1 (still one prefill for the bulk).
+        # Two traced shapes exist: an ARGUMENT is a Tracer (int() would
+        # raise TracerIntegerConversionError), a closed-over constant
+        # stages its ops (ConcretizationTypeError) — handle both.
+        if isinstance(lengths, jax.core.Tracer):
             t_start = 1
-        if not isinstance(lengths, jax.core.Tracer) \
-                and (t_start < 1 or int(jnp.max(lengths)) > tp):
-            raise ValueError(
-                f"prompt_lengths must be in [1, {tp}] (got "
-                f"[{t_start}, {int(jnp.max(lengths))}])")
+        else:
+            try:
+                t_start = int(jnp.min(lengths))
+            except jax.errors.ConcretizationTypeError:
+                t_start = 1
+            else:
+                if t_start < 1 or int(jnp.max(lengths)) > tp:
+                    raise ValueError(
+                        f"prompt_lengths must be in [1, {tp}] (got "
+                        f"[{t_start}, {int(jnp.max(lengths))}])")
 
     def sample(logits, key):
         if not temperature:
@@ -599,7 +653,8 @@ def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
             logits = jnp.where(logits < kvals[:, -1:], -jnp.inf, logits)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
-    hidden, cache = lm_prefill(params, prompt, max_len, num_heads)
+    hidden, cache = lm_prefill(params, prompt, max_len, num_heads,
+                               moe_top_k)
     # each row's first generated token comes from ITS last real
     # position — gather the hidden state first, project ONE position
     # (the d_model x vocab matmul is the expensive part)
@@ -622,7 +677,8 @@ def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
         # prompt for longer rows (re-fed; identical K/V rewrite)
         ids, cache, key, done = carry
         tok = jnp.take_along_axis(ids, t[None, None], axis=1)[:, 0]
-        logits, cache = lm_decode_step(params, tok, t, cache, num_heads)
+        logits, cache = lm_decode_step(params, tok, t, cache,
+                                       num_heads, moe_top_k)
         key, sub = jax.random.split(key)
         nxt = sample(logits, sub)
         if eos_id is not None:
