@@ -111,15 +111,18 @@ def _check_full(seq: SequenceBatch):
             "pack the batch")
 
 
-def _block_ffn(blk, h, moe_top_k=2):
+def _block_ffn(blk, h, moe_top_k=2, valid=None):
     """Dense or mixture FFN, depending on how the block was initialized;
     returns (output, load-balance aux) with aux == 0 for dense.  relu
     for both so an identical-experts mixture reproduces the dense block
-    exactly (the MoE equivalence test relies on it)."""
+    exactly (the MoE equivalence test relies on it).  valid: [B, T] real-
+    token mask — the aux statistics must not be skewed by padding rows
+    that all route identically."""
     if "moe" in blk:
         from paddle_tpu.ops import moe as moe_ops
         return moe_ops.moe_ffn(h, blk["moe"], top_k=moe_top_k,
-                               act=jax.nn.relu, return_aux=True)
+                               act=jax.nn.relu, return_aux=True,
+                               valid=valid)
     return _ffn(blk["ffn"], h), jnp.zeros(())
 
 
@@ -129,7 +132,11 @@ def _enc_block(blk, x, key_mask, num_heads, mesh=None, segment_ids=None,
     x = x + _mha(blk["attn"], h, h, num_heads, key_mask=key_mask,
                  causal=causal, mesh=mesh, zigzag=zigzag,
                  q_segment_ids=segment_ids)
-    y, aux = _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)
+    # real-token mask for the MoE aux: packed rows label padding 0,
+    # unpacked rows carry key_mask; full_seq has no padding at all
+    valid = (segment_ids > 0 if segment_ids is not None
+             else (key_mask > 0 if key_mask is not None else None))
+    y, aux = _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k, valid)
     return x + y, aux
 
 
@@ -440,21 +447,23 @@ def decode_step_cached(params, src_mask, prev_ids, t, cache, cross,
     return linear.matmul(x, params["out"])[:, 0], new_cache
 
 
-def _beam_setup(params, src, beam_size, num_heads):
+def _beam_setup(params, src, beam_size, num_heads, moe_top_k=2):
     """Shared oracle/serving preamble: encode once, tile lane-major."""
     b = src.data.shape[0]
-    enc_out = encode(params, src, num_heads)
+    enc_out = encode(params, src, num_heads, moe_top_k=moe_top_k)
     enc_l = jnp.repeat(enc_out, beam_size, axis=0)
     src_mask_l = jnp.repeat(src.mask(), beam_size, axis=0)
     return b, b * beam_size, enc_l, src_mask_l
 
 
 def generate_cached(params, src: SequenceBatch, beam_size=4, max_len=64,
-                    bos_id=0, eos_id=1, num_heads=8, length_penalty=0.6):
+                    bos_id=0, eos_id=1, num_heads=8, length_penalty=0.6,
+                    moe_top_k=2):
     """Beam decode with KV-cached incremental steps: O(T) attention per new
     token instead of re-running the full decoder stack over the whole
     prefix (O(T^2) per token) — the serving-path decoder."""
-    b, bk, enc_l, src_mask_l = _beam_setup(params, src, beam_size, num_heads)
+    b, bk, enc_l, src_mask_l = _beam_setup(params, src, beam_size,
+                                           num_heads, moe_top_k)
     # invariant across steps AND identical across a row's lanes: closed
     # over, not carried in the scan state (gather_state would re-copy it
     # per emitted token)
@@ -473,10 +482,11 @@ def generate_cached(params, src: SequenceBatch, beam_size=4, max_len=64,
 
 
 def generate(params, src: SequenceBatch, beam_size=4, max_len=64, bos_id=0,
-             eos_id=1, num_heads=8, length_penalty=0.6):
+             eos_id=1, num_heads=8, length_penalty=0.6, moe_top_k=2):
     """Beam decode, full-recompute step (the numerics oracle for
     generate_cached; prefer generate_cached for serving throughput)."""
-    b, bk, enc_l, src_mask_l = _beam_setup(params, src, beam_size, num_heads)
+    b, bk, enc_l, src_mask_l = _beam_setup(params, src, beam_size,
+                                           num_heads, moe_top_k)
 
     def step_fn(state, prev_ids):
         toks, step = state           # toks: [BK, max_len]; step: [BK] (equal)

@@ -45,32 +45,43 @@ def moe_gates(probs, top_k):
     return kept / jnp.maximum(kept.sum(-1, keepdims=True), 1e-9)
 
 
-def aux_load_balance_loss(probs, gates, top_k):
+def aux_load_balance_loss(probs, gates, top_k, valid=None):
     """GShard/Switch auxiliary loss over precomputed router tensors:
     E * sum_e(frac_tokens_picking_e * mean_prob_e); minimized (=1) at
-    uniform expert utilization."""
+    uniform expert utilization.  valid: optional [...] token mask — the
+    statistics count REAL tokens only, so padding (which routes
+    identically everywhere) can't skew the balance pressure."""
     e = probs.shape[-1]
     picked = (gates > 0).astype(probs.dtype)
-    frac = picked.reshape(-1, e).mean(0) / max(top_k, 1)
-    mean_prob = probs.reshape(-1, e).mean(0)
+    if valid is None:
+        frac = picked.reshape(-1, e).mean(0) / max(top_k, 1)
+        mean_prob = probs.reshape(-1, e).mean(0)
+    else:
+        w = valid.astype(probs.dtype).reshape(-1, 1)
+        n = jnp.maximum(w.sum(), 1.0)
+        frac = (picked.reshape(-1, e) * w).sum(0) / n / max(top_k, 1)
+        mean_prob = (probs.reshape(-1, e) * w).sum(0) / n
     return e * jnp.sum(frac * mean_prob)
 
 
-def moe_ffn(x, params, top_k=2, act=jax.nn.gelu, return_aux=False):
+def moe_ffn(x, params, top_k=2, act=jax.nn.gelu, return_aux=False,
+            valid=None):
     """x: [B, T, D] -> [B, T, D] through E gated FFN experts.
 
     All experts run as one batched einsum over the E dim; under a mesh with
     w1/w2 sharded P('expert', ...) each device computes its local experts'
     partial output and the gate-weighted combine psums across the axis.
     The router runs ONCE; return_aux=True additionally returns the
-    load-balance loss built from the same probs/gates."""
+    load-balance loss built from the same probs/gates, restricted to
+    `valid` [B, T] tokens when given (padding must not train the
+    router)."""
     probs = router_probs(x, params["wg"])              # [B, T, E]
     gates = moe_gates(probs, top_k)
     h = act(jnp.einsum("btd,edf->btef", x, params["w1"]))
     y = jnp.einsum("btef,efd->bted", h, params["w2"])
     out = jnp.einsum("bted,bte->btd", y, gates)
     if return_aux:
-        return out, aux_load_balance_loss(probs, gates, top_k)
+        return out, aux_load_balance_loss(probs, gates, top_k, valid)
     return out
 
 

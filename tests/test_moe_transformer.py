@@ -120,11 +120,11 @@ def test_moe_lm_expert_parallel_matches_single(np_rng):
 
     l1, g1 = jax.jit(jax.value_and_grad(lm))(params)
 
+    from paddle_tpu.ops import moe
     repl = NamedSharding(mesh, P())
     sh = jax.tree_util.tree_map(lambda _: repl, params)
     for blk in sh["enc"]:
-        blk["moe"]["w1"] = NamedSharding(mesh, P("expert", None, None))
-        blk["moe"]["w2"] = NamedSharding(mesh, P("expert", None, None))
+        blk["moe"] = moe.expert_shardings(mesh)
     placed = jax.device_put(params, sh)
     with mesh:
         l2, g2 = jax.jit(jax.value_and_grad(lm))(placed)
