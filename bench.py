@@ -579,6 +579,62 @@ def bench_transformer_packed(batch=16, max_len=512, vocab=32000,
          "pack_efficiency": round(real_tokens / tok_slots, 3)}
 
 
+def bench_transformer_moe(batch=16, seq_len=512, vocab=32000, d_model=512,
+                          dff=2048, layers=6, heads=8, experts=8,
+                          moe_top_k=2):
+    """Sparse-expert causal-LM train step: the flagship trunk with every
+    block's FFN an 8-expert top-2 mixture (models/transformer.init
+    moe_experts=...).  E x the dense FFN parameters; the batched-einsum
+    dispatch EXECUTES all E experts per token (dense dispatch — MXU-
+    friendly, no gather/scatter), so the step genuinely pays ~E x the
+    dense FFN FLOPs and the flops model counts it that way."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.sequence import SequenceBatch
+    from paddle_tpu.models import transformer
+    from paddle_tpu import optim
+
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=1, d_model=d_model, dff=dff,
+                              enc_layers=layers, dec_layers=0,
+                              max_len=seq_len, moe_experts=experts)
+    opt = optim.Adam(learning_rate=1e-4)
+    opt_state = opt.init(params)
+    rng = np.random.RandomState(0)
+    tokens = SequenceBatch(
+        jnp.asarray(rng.randint(3, vocab, (batch, seq_len)), jnp.int32),
+        jnp.full((batch,), seq_len, jnp.int32))
+    remat = _env_remat(batch * seq_len >= 32768)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: transformer.lm_loss(p, tokens, heads, remat=remat,
+                                          moe_top_k=moe_top_k))(params)
+        new_params, new_opt = opt.update(grads, opt_state, params)
+        return new_params, new_opt, loss
+
+    def run(s):
+        nonlocal params, opt_state
+        params, opt_state, loss = step(params, opt_state, tokens)
+        return loss
+
+    tok = batch * seq_len
+    # EXECUTED compute per token: attention stack + ALL E expert FFNs
+    # (the batched einsum runs every expert; gating selects afterwards)
+    # + router + tied projection; x3 train
+    n_params = layers * (4 * d_model ** 2
+                         + experts * 2 * d_model * dff
+                         + d_model * experts)
+    attn = 4.0 * layers * batch * seq_len * seq_len * d_model
+    flops = 3.0 * (2.0 * n_params * tok + 2.0 * vocab * d_model * tok
+                   + attn)
+    return run, flops, None, (
+        f"transformer MoE-LM train ms/batch bs={batch} len={seq_len} "
+        f"E={experts} k={moe_top_k}"), \
+        {"tokens_per_step": tok, "remat": remat}
+
+
 def bench_transformer_lm_decode(batch=32, prompt_len=32, max_len=160,
                                 vocab=32000, d_model=512, dff=2048,
                                 layers=6, heads=8):
@@ -770,6 +826,8 @@ _BENCHES = {
     # padding-free packed training (real tokens/sec headline; the
     # reference's no-padding Argument story at transformer scale)
     "transformer_packed": (lambda b: bench_transformer_packed(batch=b), 16),
+    # sparse-expert LM train step (router + expert dispatch on the clock)
+    "transformer_moe": (lambda b: bench_transformer_moe(batch=b), 16),
     "transformer_decode": (lambda b: bench_transformer_decode(batch=b), 32),
     "transformer_lm_decode": (lambda b: bench_transformer_lm_decode(batch=b), 32),
     "transformer_serving": (lambda b: bench_transformer_serving(batch=b), 16),

@@ -70,6 +70,21 @@ def init(rng, src_vocab=30000, trg_vocab=30000, d_model=512, num_heads=8,
     return params
 
 
+def moe_lm_shardings(mesh, params):
+    """NamedShardings for a moe_experts trunk: everything replicated
+    except each block's expert weights, which take the canonical
+    moe.expert_shardings layout (wg replicated, w1/w2 over 'expert') —
+    THE recipe the dryrun leg and the parity tests share."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.ops import moe as moe_ops
+    repl = NamedSharding(mesh, P())
+    sh = jax.tree_util.tree_map(lambda _: repl, params)
+    for blk in sh["enc"]:
+        if "moe" in blk:
+            blk["moe"] = moe_ops.expert_shardings(mesh)
+    return sh
+
+
 def _mha(blk, xq, xkv, num_heads, key_mask=None, causal=False, mesh=None,
          zigzag=False, q_segment_ids=None):
     return attn_ops.multi_head_attention(

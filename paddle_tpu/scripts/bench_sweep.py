@@ -78,6 +78,7 @@ DEFAULT_COMBOS = [
     "transformer:32", "transformer:128",          # 128*256 = 32768 tok
     "transformer_long:2",                         # 8k-token sequences
     "transformer_packed:16",                      # padding-free packing
+    "transformer_moe:16",                         # sparse-expert LM step
     "transformer_decode:32",                      # KV-cached serving path
     "transformer_lm_decode:32",                   # LM sampling throughput
     "transformer_serving:16",                     # bucketed-length stream

@@ -120,11 +120,7 @@ def test_moe_lm_expert_parallel_matches_single(np_rng):
 
     l1, g1 = jax.jit(jax.value_and_grad(lm))(params)
 
-    from paddle_tpu.ops import moe
-    repl = NamedSharding(mesh, P())
-    sh = jax.tree_util.tree_map(lambda _: repl, params)
-    for blk in sh["enc"]:
-        blk["moe"] = moe.expert_shardings(mesh)
+    sh = transformer.moe_lm_shardings(mesh, params)
     placed = jax.device_put(params, sh)
     with mesh:
         l2, g2 = jax.jit(jax.value_and_grad(lm))(placed)
