@@ -242,3 +242,21 @@ def test_lm_generate_ragged_prompts_match_per_row(np_rng):
         transformer.lm_generate(params, prompt, max_len=14,
                                 num_heads=HEADS,
                                 prompt_lengths=np.asarray([2, 9, 4]))
+
+
+def test_lm_demo_runs():
+    """demo/lm end to end at smoke scale: trains, then prints greedy and
+    sampled continuations (the 15th demo family stays green)."""
+    import os
+    import subprocess
+    import sys
+    demo = os.path.join(os.path.dirname(__file__), "..", "demo", "lm",
+                        "train_and_sample.py")
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}    # skip the startup lottery
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, demo, "--epochs", "1"],
+                       capture_output=True, text=True, env=env,
+                       timeout=480)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "greedy" in r.stdout and "sampled" in r.stdout, r.stdout
