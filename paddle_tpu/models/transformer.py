@@ -48,25 +48,48 @@ def _block_init(ks, d, dff, cross=False, moe_experts=0):
 
 def init(rng, src_vocab=30000, trg_vocab=30000, d_model=512, num_heads=8,
          dff=2048, enc_layers=6, dec_layers=6, max_len=512,
-         moe_experts=0):
+         moe_experts=0, pos_type="learned"):
     """moe_experts > 1 replaces every ENC block's dense FFN with a
     top-k-gated mixture of that many expert FFNs (ops/moe.py: batched
     einsum over the expert dim, shardable over the 'expert' mesh axis
     via moe.expert_shardings) — the modern sparse-LM trunk.  Decoder
     blocks keep dense FFNs (the MoE plane targets the causal/encoder
-    trunk lm_loss trains)."""
+    trunk lm_loss trains).
+
+    pos_type="rope" drops the learned positional table entirely: the
+    trunk rotates q/k per position instead (ops.attention.rope), so
+    max_len stops being a hard cap — a rope trunk can run sequences
+    longer than anything trained on (relative-position attention).
+    Callers pass the same pos_type to encode/lm_* (static config, like
+    depth in models/resnet).  rope is a decoder-only-trunk feature:
+    the seq2seq decoder stack needs the learned table, so
+    pos_type='rope' requires dec_layers=0."""
     ks = iter(jax.random.split(rng, 16 + 9 * (enc_layers + dec_layers)))
     params = {
         "src_emb": _dense(next(ks), src_vocab, d_model, scale=0.02),
         "trg_emb": _dense(next(ks), trg_vocab, d_model, scale=0.02),
-        "pos": 0.02 * jax.random.normal(next(ks), (max_len, d_model)),
-        "enc": [_block_init(ks, d_model, dff, moe_experts=moe_experts)
-                for _ in range(enc_layers)],
-        "dec": [_block_init(ks, d_model, dff, cross=True)
-                for _ in range(dec_layers)],
-        "ln_f": {"g": jnp.ones((d_model,)), "b": jnp.zeros((d_model,))},
-        "out": _dense(next(ks), d_model, trg_vocab),
     }
+    # the pos key is drawn in its historical slot EITHER WAY so a given
+    # seed yields byte-identical weights for every other parameter
+    # (golden generation tests pin exactly that)
+    pos_key = next(ks)
+    if pos_type == "rope" and dec_layers:
+        raise ValueError(
+            "pos_type='rope' is the decoder-only trunk configuration "
+            "(lm_loss/lm_generate); the seq2seq decoder stack needs the "
+            "learned table — use dec_layers=0 or pos_type='learned'")
+    if pos_type == "learned":
+        params["pos"] = 0.02 * jax.random.normal(pos_key,
+                                                 (max_len, d_model))
+    elif pos_type != "rope":
+        raise ValueError(f"pos_type must be 'learned' or 'rope', got "
+                         f"{pos_type!r}")
+    params["enc"] = [_block_init(ks, d_model, dff, moe_experts=moe_experts)
+                     for _ in range(enc_layers)]
+    params["dec"] = [_block_init(ks, d_model, dff, cross=True)
+                     for _ in range(dec_layers)]
+    params["ln_f"] = {"g": jnp.ones((d_model,)), "b": jnp.zeros((d_model,))}
+    params["out"] = _dense(next(ks), d_model, trg_vocab)
     return params
 
 
@@ -86,11 +109,11 @@ def moe_lm_shardings(mesh, params):
 
 
 def _mha(blk, xq, xkv, num_heads, key_mask=None, causal=False, mesh=None,
-         zigzag=False, q_segment_ids=None):
+         zigzag=False, q_segment_ids=None, rope_positions=None):
     return attn_ops.multi_head_attention(
         xq, xkv, blk["wq"], blk["wk"], blk["wv"], blk["wo"], num_heads,
         key_mask=key_mask, causal=causal, mesh=mesh, zigzag=zigzag,
-        q_segment_ids=q_segment_ids)
+        q_segment_ids=q_segment_ids, rope_positions=rope_positions)
 
 
 def _ffn(blk, x):
@@ -142,11 +165,11 @@ def _block_ffn(blk, h, moe_top_k=2, valid=None):
 
 
 def _enc_block(blk, x, key_mask, num_heads, mesh=None, segment_ids=None,
-               causal=False, zigzag=False, moe_top_k=2):
+               causal=False, zigzag=False, moe_top_k=2, rope_pos=None):
     h = _ln(blk["ln1"], x)
     x = x + _mha(blk["attn"], h, h, num_heads, key_mask=key_mask,
                  causal=causal, mesh=mesh, zigzag=zigzag,
-                 q_segment_ids=segment_ids)
+                 q_segment_ids=segment_ids, rope_positions=rope_pos)
     # real-token mask for the MoE aux: packed rows label padding 0,
     # unpacked rows carry key_mask; full_seq has no padding at all
     valid = (segment_ids > 0 if segment_ids is not None
@@ -167,7 +190,8 @@ def _dec_block(blk, x, enc_out, self_km, cross_km, num_heads, mesh=None,
 
 def encode(params, src: SequenceBatch, num_heads=8, remat=False,
            full_seq=False, mesh=None, segment_ids=None, positions=None,
-           causal=False, zigzag=False, moe_top_k=2, return_aux=False):
+           causal=False, zigzag=False, moe_top_k=2, return_aux=False,
+           pos_type="learned"):
     """remat=True checkpoints each block (jax.checkpoint): backward
     recomputes activations instead of storing them — the HBM headroom for
     >=32k-token batches.
@@ -188,6 +212,11 @@ def encode(params, src: SequenceBatch, num_heads=8, remat=False,
     causal self-attention rides the balanced ring; the returned hidden
     states are in zigzag order (lm_loss aligns its labels the same way)."""
     t = src.data.shape[1]
+    if (pos_type == "learned") != ("pos" in params):
+        raise ValueError(
+            f"pos_type={pos_type!r} but params were initialized "
+            f"{'with' if 'pos' in params else 'without'} a learned "
+            "positional table — pass the SAME pos_type used at init")
     block = (jax.checkpoint(_enc_block, static_argnums=(3, 4, 6, 7, 8))
              if remat else _enc_block)
     if (segment_ids is None) != (positions is None):
@@ -205,7 +234,8 @@ def encode(params, src: SequenceBatch, num_heads=8, remat=False,
             segment_ids = segment_ids[:, order]
             positions = positions[:, order]
     x = emb_ops.embedding_lookup(params["src_emb"], ids)
-    if positions is not None and not isinstance(positions, jax.core.Tracer):
+    if positions is not None and pos_type == "learned" \
+            and not isinstance(positions, jax.core.Tracer):
         try:
             max_pos = int(jnp.max(positions))
         except jax.errors.ConcretizationTypeError:
@@ -219,14 +249,27 @@ def encode(params, src: SequenceBatch, num_heads=8, remat=False,
                 f"packed position {max_pos} exceeds the positional table "
                 f"({params['pos'].shape[0]}); re-init with a larger "
                 "max_len or pack shorter rows")
-    if positions is not None:
+    rope_pos = None
+    if pos_type == "rope":
+        # rotary positions ride q/k inside attention; nothing is added
+        # to the embeddings and no table caps the length.  Packed rows
+        # use within-segment positions (relative attention per segment);
+        # zigzag uses the permuted global positions.
+        x = x * math.sqrt(x.shape[-1])
+        if positions is not None:
+            rope_pos = positions
+        else:
+            rope_pos = jnp.arange(t)
+            if order is not None:
+                rope_pos = rope_pos[order]
+    elif positions is not None:
         pos_rows = params["pos"][positions]
+        x = x * math.sqrt(x.shape[-1]) + pos_rows
     else:
         pos_rows = params["pos"][:t]
         if order is not None:
             pos_rows = pos_rows[order]
-        pos_rows = pos_rows[None]
-    x = x * math.sqrt(x.shape[-1]) + pos_rows
+        x = x * math.sqrt(x.shape[-1]) + pos_rows[None]
     # key validity stays O(T) ([B, T]); full_seq=True promises every
     # sequence is max-length (packed/bucketed batches) and drops the mask
     # entirely so the flash/chunked O(T)-memory paths engage — validated
@@ -239,7 +282,7 @@ def encode(params, src: SequenceBatch, num_heads=8, remat=False,
     aux_total = jnp.zeros(())
     for blk in params["enc"]:
         x, aux = block(blk, x, key_mask, num_heads, mesh, segment_ids,
-                       causal, zigzag, moe_top_k)
+                       causal, zigzag, moe_top_k, rope_pos)
         aux_total = aux_total + aux
     return (x, aux_total) if return_aux else x
 
@@ -329,7 +372,8 @@ def _token_ce(logits, labels, label_smoothing):
 
 def lm_loss(params, tokens: SequenceBatch, num_heads=8, segment_ids=None,
             positions=None, mesh=None, zigzag=False, remat=False,
-            label_smoothing=0.0, moe_aux_weight=0.01, moe_top_k=2):
+            label_smoothing=0.0, moe_aux_weight=0.01, moe_top_k=2,
+            pos_type="learned"):
     """Decoder-only (GPT-style) causal LM: the encoder stack run causal,
     next-token cross-entropy with the input embedding tied as the output
     projection.  Token-mean objective (the standard LM loss — every real
@@ -360,7 +404,8 @@ def lm_loss(params, tokens: SequenceBatch, num_heads=8, segment_ids=None,
     logits, aux = lm_logits(params, tokens, num_heads, remat=remat,
                             mesh=mesh, segment_ids=segment_ids,
                             positions=positions, zigzag=zigzag,
-                            moe_top_k=moe_top_k, return_aux=True)
+                            moe_top_k=moe_top_k, pos_type=pos_type,
+                            return_aux=True)
     if zigzag:
         order = _zigzag_idx(t, mesh)
         labels, valid = labels[:, order], valid[:, order]
@@ -522,22 +567,38 @@ def generate(params, src: SequenceBatch, beam_size=4, max_len=64, bos_id=0,
 
 # ------------------------------------------------------ decoder-only LM
 
-def _cached_self_attn(blk, x, c, t, pos_mask, num_heads):
+def _rope_flat(x_btd, positions, num_heads):
+    """Apply rope to a flat [B, T, D] projection: split heads, rotate,
+    re-flatten — cached K is stored ROTATED (the standard KV-cache
+    convention; old keys never need re-rotation)."""
+    from paddle_tpu.ops.attention import rope
+    b, t, d = x_btd.shape
+    dh = d // num_heads
+    xh = x_btd.reshape(b, t, num_heads, dh).transpose(0, 2, 1, 3)
+    xh = rope(xh, positions)
+    return xh.transpose(0, 2, 1, 3).reshape(b, t, d)
+
+
+def _cached_self_attn(blk, x, c, t, pos_mask, num_heads, rope_pos=None):
     """Shared incremental self-attention block: write this position's K/V
     into the cache, attend over positions <= t, residual-add — ONE
     definition for decode_step_cached and lm_decode_step so the two
     cached steps cannot drift."""
     h = _ln(blk["ln1"], x)
-    k = jax.lax.dynamic_update_slice_in_dim(
-        c["k"], linear.matmul(h, blk["attn"]["wk"]), t, axis=1)
+    k_new = linear.matmul(h, blk["attn"]["wk"])
+    q = linear.matmul(h, blk["attn"]["wq"])
+    if rope_pos is not None:
+        k_new = _rope_flat(k_new, rope_pos, num_heads)
+        q = _rope_flat(q, rope_pos, num_heads)
+    k = jax.lax.dynamic_update_slice_in_dim(c["k"], k_new, t, axis=1)
     v = jax.lax.dynamic_update_slice_in_dim(
         c["v"], linear.matmul(h, blk["attn"]["wv"]), t, axis=1)
-    q = linear.matmul(h, blk["attn"]["wq"])
     att = _attend(q, k, v, num_heads, pos_mask)
     return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
 
 
-def lm_prefill(params, prompt, max_len, num_heads=8, moe_top_k=2):
+def lm_prefill(params, prompt, max_len, num_heads=8, moe_top_k=2,
+               pos_type="learned"):
     """Batched causal prefill: run the trunk over the WHOLE prompt in one
     pass (the MXU-friendly leg), writing every position's K/V into fresh
     decode caches.  Returns (per-position hidden states [B, Tp, D],
@@ -549,8 +610,15 @@ def lm_prefill(params, prompt, max_len, num_heads=8, moe_top_k=2):
     composition), ~Tp x fewer serial steps.  With ragged prompts
     causality keeps padding positions out of real ones."""
     b, tp = prompt.shape
+    if (pos_type == "learned") != ("pos" in params):
+        raise ValueError(
+            f"pos_type={pos_type!r} but params were initialized "
+            f"{'with' if 'pos' in params else 'without'} a learned "
+            "positional table — pass the SAME pos_type used at init")
     x = emb_ops.embedding_lookup(params["src_emb"], prompt)
-    x = x * math.sqrt(x.shape[-1]) + params["pos"][:tp][None]
+    x = x * math.sqrt(x.shape[-1])
+    if pos_type == "learned":
+        x = x + params["pos"][:tp][None]
     cache = init_lm_cache(params, b, max_len)
     new_cache = []
     for blk, c in zip(params["enc"], cache):
@@ -558,6 +626,10 @@ def lm_prefill(params, prompt, max_len, num_heads=8, moe_top_k=2):
         k = linear.matmul(h, blk["attn"]["wk"])
         v = linear.matmul(h, blk["attn"]["wv"])
         q = linear.matmul(h, blk["attn"]["wq"])
+        if pos_type == "rope":
+            # cache stores ROTATED keys (old keys never re-rotate)
+            k = _rope_flat(k, jnp.arange(tp), num_heads)
+            q = _rope_flat(q, jnp.arange(tp), num_heads)
         d = q.shape[-1]
         dh = d // num_heads
         split = lambda a: a.reshape(b, tp, num_heads, dh).transpose(
@@ -575,7 +647,7 @@ def lm_prefill(params, prompt, max_len, num_heads=8, moe_top_k=2):
 
 
 def lm_decode_step(params, prev_ids, t, cache, num_heads=8,
-                   moe_top_k=2):
+                   moe_top_k=2, pos_type="learned"):
     """One incremental position of the decoder-only trunk (the enc stack
     run causal, lm_loss's twin): prev_ids [B] at position t -> (logits
     [B, V], updated cache).  cache: per-enc-layer K/V buffers
@@ -583,13 +655,16 @@ def lm_decode_step(params, prev_ids, t, cache, num_heads=8,
     b = prev_ids.shape[0]
     max_len = cache[0]["k"].shape[1]
     x = emb_ops.embedding_lookup(params["src_emb"], prev_ids)[:, None]
-    x = x * math.sqrt(x.shape[-1]) \
-        + jax.lax.dynamic_slice_in_dim(params["pos"], t, 1)[None]
+    x = x * math.sqrt(x.shape[-1])
+    if pos_type == "learned":
+        x = x + jax.lax.dynamic_slice_in_dim(params["pos"], t, 1)[None]
+    rope_pos = (jnp.asarray(t)[None] if pos_type == "rope" else None)
     pos_mask = jnp.broadcast_to(jnp.arange(max_len)[None, :] <= t,
                                 (b, max_len))
     new_cache = []
     for blk, c in zip(params["enc"], cache):
-        x, nc = _cached_self_attn(blk, x, c, t, pos_mask, num_heads)
+        x, nc = _cached_self_attn(blk, x, c, t, pos_mask, num_heads,
+                                  rope_pos)
         x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
         new_cache.append(nc)
     return _lm_project(params, x)[:, 0], new_cache
@@ -598,10 +673,12 @@ def lm_decode_step(params, prev_ids, t, cache, num_heads=8,
 def init_lm_cache(params, batch, max_len):
     """K/V buffers for lm_decode_step (mirrors init_decode_cache, but for
     the enc stack the LM trunk runs)."""
-    if max_len > params["pos"].shape[0]:
+    if "pos" in params and max_len > params["pos"].shape[0]:
+        # learned table caps the length; a rope trunk has no cap
         raise ValueError(
             f"lm decode max_len {max_len} exceeds the positional table "
-            f"({params['pos'].shape[0]}); re-init with a larger max_len")
+            f"({params['pos'].shape[0]}); re-init with a larger max_len "
+            "or use pos_type='rope'")
     d = params["src_emb"].shape[1]
     dt = params["src_emb"].dtype
     return [{"k": jnp.zeros((batch, max_len, d), dt),
@@ -611,7 +688,7 @@ def init_lm_cache(params, batch, max_len):
 
 def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
                 top_k=0, rng=None, eos_id=None, prompt_lengths=None,
-                moe_top_k=2):
+                moe_top_k=2, pos_type="learned"):
     """Autoregressive sampling from the decoder-only LM (KV-cached, one
     jittable lax.scan): prompt [B, Tp] int ids -> ids [B, max_len]
     beginning with each row's prompt.  prompt_lengths [B] supports
@@ -679,7 +756,7 @@ def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     hidden, cache = lm_prefill(params, prompt, max_len, num_heads,
-                               moe_top_k)
+                               moe_top_k, pos_type)
     # each row's first generated token comes from ITS last real
     # position — gather the hidden state first, project ONE position
     # (the d_model x vocab matmul is the expensive part)
@@ -703,7 +780,7 @@ def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
         ids, cache, key, done = carry
         tok = jnp.take_along_axis(ids, t[None, None], axis=1)[:, 0]
         logits, cache = lm_decode_step(params, tok, t, cache,
-                                       num_heads, moe_top_k)
+                                       num_heads, moe_top_k, pos_type)
         key, sub = jax.random.split(key)
         nxt = sample(logits, sub)
         if eos_id is not None:

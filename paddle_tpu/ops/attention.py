@@ -235,7 +235,8 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
 def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
                          causal=False, key_mask=None, mesh=None,
                          seq_axis="seq", zigzag=False,
-                         q_segment_ids=None, kv_segment_ids=None):
+                         q_segment_ids=None, kv_segment_ids=None,
+                         rope_positions=None):
     """Dense multi-head attention.  x_q: [B, Tq, D], x_kv: [B, Tk, D],
     wq/wk/wv: [D, D], wo: [D, D].  key_mask: [B, Tk] padding validity
     (O(T); preferred over a materialized [Tq, Tk] mask).
@@ -258,6 +259,15 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
     q = split(x_q, wq, tq)
     k = split(x_kv, wk, tk)
     v = split(x_kv, wv, tk)
+    if rope_positions is not None:
+        # rotary positions on q/k before any masking or sharding
+        # (self-attention: one positions array serves both sides)
+        if tq != tk:
+            raise ValueError(
+                "rope_positions requires self-attention (Tq == Tk); "
+                "cross-attention has no shared position stream")
+        q = rope(q, rope_positions)
+        k = rope(k, rope_positions)
     ring_active = mesh is not None and mesh.shape.get(seq_axis, 1) > 1
     if zigzag and not (ring_active and causal):
         # fail fast: zigzag-ordered inputs under a plain causal mask would
@@ -297,6 +307,34 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
                                     kv_segment_ids=kv_segment_ids)
     out = out.transpose(0, 2, 1, 3).reshape(b, tq, d)
     return matmul(out, wo)
+
+
+def rope(x, positions, base=10000.0):
+    """Rotary position embedding: rotate head-dim pairs of x [..., H, T, D]
+    by per-position angles (RoFormer).  positions: [T] or [B, T] int —
+    PACKED rows pass within-segment positions, so every segment sees
+    positions starting at 0 exactly as if it ran alone; attention scores
+    depend only on RELATIVE position, which is what lets a rope model
+    run sequences longer than anything seen in training (no learned
+    table to outgrow).  Applied to q and k BEFORE attention, it composes
+    unchanged with the ring/zigzag sharding (rotation is positionwise;
+    the rotated K blocks travel the ring like any other)."""
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"rope needs an even head dim, got {d}")
+    half = d // 2
+    freq = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    pos = jnp.asarray(positions, jnp.float32)
+    ang = pos[..., None] * freq                    # [..., T, half]
+    if ang.ndim == 2:                              # positions [T]
+        ang = ang[None, None]                      # -> [1, 1, T, half]
+    else:                                          # positions [B, T]
+        ang = ang[:, None]                         # -> [B, 1, T, half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+        axis=-1).astype(x.dtype)
 
 
 def segment_mask(q_segment_ids, kv_segment_ids=None):

@@ -1,0 +1,177 @@
+"""Rotary positions (ops.attention.rope + transformer pos_type='rope'):
+relative-position invariance, cached-generation parity, packed rows,
+ring composition, and the headline capability — running BEYOND the
+training max_len (no learned table to outgrow)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.sequence import SequenceBatch, pack_sequences
+from paddle_tpu.ops import attention as att
+from paddle_tpu.models import transformer
+
+V, DM, HEADS, T = 48, 16, 2, 12
+
+needs_8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                             reason="needs 8 virtual devices")
+
+
+def _rope_params(max_len=T, seed=0):
+    return transformer.init(jax.random.PRNGKey(seed), src_vocab=V,
+                            trg_vocab=1, d_model=DM, dff=32,
+                            enc_layers=2, dec_layers=0, max_len=max_len,
+                            pos_type="rope")
+
+
+def test_rope_scores_are_relative(np_rng):
+    """q.k after rope depends only on the position DIFFERENCE — the
+    property that makes length extrapolation possible."""
+    q = jnp.asarray(np_rng.randn(1, 2, 4, 8), jnp.float32)
+    k = jnp.asarray(np_rng.randn(1, 2, 4, 8), jnp.float32)
+    p = jnp.asarray([0, 3, 7, 11])
+    s1 = jnp.einsum("bhqd,bhkd->bhqk", att.rope(q, p), att.rope(k, p))
+    s2 = jnp.einsum("bhqd,bhkd->bhqk", att.rope(q, p + 100),
+                    att.rope(k, p + 100))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), atol=1e-4)
+    with pytest.raises(ValueError, match="even head dim"):
+        att.rope(jnp.zeros((1, 1, 2, 7)), jnp.arange(2))
+
+
+def test_rope_params_have_no_table():
+    params = _rope_params()
+    assert "pos" not in params
+    # and a learned init of the same seed matches everywhere else
+    learned = transformer.init(jax.random.PRNGKey(0), src_vocab=V,
+                               trg_vocab=1, d_model=DM, dff=32,
+                               enc_layers=2, dec_layers=0, max_len=T)
+    np.testing.assert_array_equal(np.asarray(params["src_emb"]),
+                                  np.asarray(learned["src_emb"]))
+    np.testing.assert_array_equal(
+        np.asarray(params["enc"][0]["attn"]["wq"]),
+        np.asarray(learned["enc"][0]["attn"]["wq"]))
+
+
+def test_rope_lm_generate_matches_oracle(np_rng):
+    """KV-cached rope generation (rotated keys in the cache) == the
+    full-recompute argmax rollout."""
+    params = _rope_params()
+    prompt = np_rng.randint(3, V, (3, 4)).astype(np.int32)
+    got = np.asarray(transformer.lm_generate(
+        params, prompt, max_len=T, num_heads=HEADS, pos_type="rope"))
+    b = prompt.shape[0]
+    ids = np.zeros((b, T), np.int32)
+    ids[:, :4] = prompt
+    for t in range(T - 1):
+        sb = SequenceBatch(jnp.asarray(ids),
+                           jnp.full((b,), t + 1, jnp.int32))
+        logits = transformer.lm_logits(params, sb, HEADS, pos_type="rope")
+        nxt = np.asarray(jnp.argmax(logits[:, t], axis=-1))
+        if t + 1 >= 4:
+            ids[:, t + 1] = nxt
+    np.testing.assert_array_equal(got, ids)
+
+
+def test_rope_packed_matches_per_row(np_rng):
+    """Packed rope rows use within-segment positions: the loss equals the
+    one-sequence-per-row layout, exactly like the learned path."""
+    params = _rope_params()
+    seqs = [np_rng.randint(3, V, n) for n in (5, 9, 7, 3)]
+    data, seg, pos = pack_sequences(seqs, max_len=T)
+    b = data.shape[0]
+    packed = transformer.lm_loss(
+        params,
+        SequenceBatch(jnp.asarray(data), jnp.full((b,), T, jnp.int32)),
+        HEADS, segment_ids=jnp.asarray(seg), positions=jnp.asarray(pos),
+        pos_type="rope")
+    n = len(seqs)
+    d1 = np.zeros((n, T), np.int32)
+    s1 = np.zeros((n, T), np.int32)
+    p1 = np.zeros((n, T), np.int32)
+    for i, sq in enumerate(seqs):
+        d1[i, :len(sq)] = sq
+        s1[i, :len(sq)] = 1
+        p1[i, :len(sq)] = np.arange(len(sq))
+    alone = transformer.lm_loss(
+        params,
+        SequenceBatch(jnp.asarray(d1), jnp.full((n,), T, jnp.int32)),
+        HEADS, segment_ids=jnp.asarray(s1), positions=jnp.asarray(p1),
+        pos_type="rope")
+    np.testing.assert_allclose(float(packed), float(alone), rtol=2e-5)
+
+
+def test_rope_runs_beyond_trained_max_len(np_rng):
+    """THE rope payoff: a trunk initialized with max_len=8 runs T=24
+    sequences (logits AND generation) — the learned path hard-fails at
+    its table size."""
+    params = _rope_params(max_len=8)
+    long_toks = SequenceBatch(
+        jnp.asarray(np_rng.randint(3, V, (2, 24)), jnp.int32),
+        jnp.full((2,), 24, jnp.int32))
+    logits = transformer.lm_logits(params, long_toks, HEADS,
+                                   pos_type="rope")
+    assert logits.shape == (2, 24, V)
+    assert np.isfinite(np.asarray(logits)).all()
+    ids = transformer.lm_generate(params,
+                                  np.asarray(long_toks.data[:, :6]),
+                                  max_len=24, num_heads=HEADS,
+                                  pos_type="rope")
+    assert np.asarray(ids).shape == (2, 24)
+    # the learned twin refuses the same request, loudly
+    learned = transformer.init(jax.random.PRNGKey(0), src_vocab=V,
+                               trg_vocab=1, d_model=DM, dff=32,
+                               enc_layers=2, dec_layers=0, max_len=8)
+    with pytest.raises(ValueError, match="positional table"):
+        transformer.lm_generate(learned,
+                                np.asarray(long_toks.data[:, :6]),
+                                max_len=24, num_heads=HEADS)
+
+
+def test_rope_lm_trains(np_rng):
+    from paddle_tpu import optim
+    params = _rope_params()
+    rng = np.random.RandomState(0)
+    data = (np.arange(T)[None] + rng.randint(0, 45, (8, 1))) % 45 + 3
+    toks = SequenceBatch(jnp.asarray(data, jnp.int32),
+                         jnp.full((8,), T, jnp.int32))
+    opt = optim.Adam(learning_rate=3e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        l, g = jax.value_and_grad(lambda p: transformer.lm_loss(
+            p, toks, HEADS, pos_type="rope"))(p)
+        p2, s2 = opt.update(g, s, p)
+        return p2, s2, l
+
+    first = None
+    for _ in range(120):
+        params, state, l = step(params, state)
+        first = first if first is not None else float(l)
+    assert float(l) < 0.5 * first, (first, float(l))
+
+
+@needs_8
+def test_rope_ring_matches_single(np_rng):
+    """rope composes with the seq-parallel ring unchanged (rotation is
+    positionwise, applied before sharding): sharded loss+grads ==
+    single-device."""
+    from paddle_tpu.parallel import MeshConfig, make_mesh
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    params = _rope_params(max_len=16)
+    toks = SequenceBatch(
+        jnp.asarray(np_rng.randint(3, V, (4, 16)), jnp.int32),
+        jnp.full((4,), 16, jnp.int32))
+
+    def lm(p, m):
+        return transformer.lm_loss(p, toks, HEADS, mesh=m,
+                                   pos_type="rope")
+
+    l1, g1 = jax.jit(jax.value_and_grad(lambda p: lm(p, None)))(params)
+    l2, g2 = jax.jit(jax.value_and_grad(lambda p: lm(p, mesh)))(params)
+    np.testing.assert_allclose(float(l2), float(l1), rtol=2e-4)
+    for a, b_ in zip(jax.tree_util.tree_leaves(g2),
+                     jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-3, atol=1e-4)
