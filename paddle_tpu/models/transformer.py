@@ -22,11 +22,14 @@ def _dense(rng, din, dout, scale=None):
     return s * jax.random.normal(rng, (din, dout), jnp.float32)
 
 
-def _block_init(ks, d, dff, cross=False, moe_experts=0):
+def _block_init(ks, d, dff, cross=False, moe_experts=0, d_kv=None):
+    dkv = d_kv or d
     blk = {
         "ln1": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
-        "attn": {"wq": _dense(next(ks), d, d), "wk": _dense(next(ks), d, d),
-                 "wv": _dense(next(ks), d, d), "wo": _dense(next(ks), d, d)},
+        "attn": {"wq": _dense(next(ks), d, d),
+                 "wk": _dense(next(ks), d, dkv),
+                 "wv": _dense(next(ks), d, dkv),
+                 "wo": _dense(next(ks), d, d)},
         "ln2": {"g": jnp.ones((d,)), "b": jnp.zeros((d,))},
     }
     if moe_experts and moe_experts > 1:
@@ -48,13 +51,19 @@ def _block_init(ks, d, dff, cross=False, moe_experts=0):
 
 def init(rng, src_vocab=30000, trg_vocab=30000, d_model=512, num_heads=8,
          dff=2048, enc_layers=6, dec_layers=6, max_len=512,
-         moe_experts=0, pos_type="learned"):
+         moe_experts=0, pos_type="learned", num_kv_heads=None):
     """moe_experts > 1 replaces every ENC block's dense FFN with a
     top-k-gated mixture of that many expert FFNs (ops/moe.py: batched
     einsum over the expert dim, shardable over the 'expert' mesh axis
     via moe.expert_shardings) — the modern sparse-LM trunk.  Decoder
     blocks keep dense FFNs (the MoE plane targets the causal/encoder
     trunk lm_loss trains).
+
+    num_kv_heads < num_heads gives the ENC/causal blocks grouped-query
+    attention (GQA): wk/wv project to num_kv_heads*head_dim, each KV
+    head serving a group of query heads — the KV cache (and its HBM
+    stream at decode) shrinks by the same factor, the standard serving
+    lever.  Carried entirely by the weight shapes; every path infers it.
 
     pos_type="rope" drops the learned positional table entirely: the
     trunk rotates q/k per position instead (ops.attention.rope), so
@@ -84,7 +93,14 @@ def init(rng, src_vocab=30000, trg_vocab=30000, d_model=512, num_heads=8,
     elif pos_type != "rope":
         raise ValueError(f"pos_type must be 'learned' or 'rope', got "
                          f"{pos_type!r}")
-    params["enc"] = [_block_init(ks, d_model, dff, moe_experts=moe_experts)
+    d_kv = None
+    if num_kv_heads is not None:
+        if num_heads % num_kv_heads:
+            raise ValueError(f"num_heads={num_heads} not divisible by "
+                             f"num_kv_heads={num_kv_heads}")
+        d_kv = (d_model // num_heads) * num_kv_heads
+    params["enc"] = [_block_init(ks, d_model, dff, moe_experts=moe_experts,
+                                 d_kv=d_kv)
                      for _ in range(enc_layers)]
     params["dec"] = [_block_init(ks, d_model, dff, cross=True)
                      for _ in range(dec_layers)]
@@ -468,16 +484,22 @@ def cross_kv(params, enc_out):
 
 
 def _attend(q, k, v, num_heads, mask):
-    """q: [B, 1, D] against k/v: [B, T, D] with mask [B, T] -> [B, 1, D].
-    Tiny-Tq attention: always the masked XLA path (flash needs big tiles)."""
-    b, tk, d = k.shape
+    """q: [B, Tq, D] against k/v: [B, T, Dkv] with mask [B, T] ->
+    [B, Tq, D].  Tiny-Tq attention: always the masked XLA path (flash
+    needs big tiles).  Dkv < D means grouped KV heads (GQA) — repeated
+    up to full heads here, so the CACHE stays small."""
+    b, tq, d = q.shape
+    tk, dkv = k.shape[1], k.shape[2]
     dh = d // num_heads
-    qh = q.reshape(b, 1, num_heads, dh).transpose(0, 2, 1, 3)
-    kh = k.reshape(b, tk, num_heads, dh).transpose(0, 2, 1, 3)
-    vh = v.reshape(b, tk, num_heads, dh).transpose(0, 2, 1, 3)
+    hkv = dkv // dh
+    qh = q.reshape(b, tq, num_heads, dh).transpose(0, 2, 1, 3)
+    kh = attn_ops.repeat_kv_heads(
+        k.reshape(b, tk, hkv, dh).transpose(0, 2, 1, 3), num_heads)
+    vh = attn_ops.repeat_kv_heads(
+        v.reshape(b, tk, hkv, dh).transpose(0, 2, 1, 3), num_heads)
     out = attn_ops.dot_product_attention(
         qh, kh, vh, mask=mask[:, None, None, :], use_flash=False)
-    return out.transpose(0, 2, 1, 3).reshape(b, 1, d)
+    return out.transpose(0, 2, 1, 3).reshape(b, tq, d)
 
 
 def decode_step_cached(params, src_mask, prev_ids, t, cache, cross,
@@ -567,15 +589,15 @@ def generate(params, src: SequenceBatch, beam_size=4, max_len=64, bos_id=0,
 
 # ------------------------------------------------------ decoder-only LM
 
-def _rope_flat(x_btd, positions, num_heads):
-    """Apply rope to a flat [B, T, D] projection: split heads, rotate,
-    re-flatten — cached K is stored ROTATED (the standard KV-cache
-    convention; old keys never need re-rotation)."""
-    from paddle_tpu.ops.attention import rope
+def _rope_flat(x_btd, positions, head_dim):
+    """Apply rope to a flat [B, T, H*head_dim] projection: split heads,
+    rotate, re-flatten — cached K is stored ROTATED (the standard
+    KV-cache convention; old keys never need re-rotation).  Head count
+    comes from the width, so grouped-KV projections rotate correctly."""
     b, t, d = x_btd.shape
-    dh = d // num_heads
-    xh = x_btd.reshape(b, t, num_heads, dh).transpose(0, 2, 1, 3)
-    xh = rope(xh, positions)
+    h = d // head_dim
+    xh = x_btd.reshape(b, t, h, head_dim).transpose(0, 2, 1, 3)
+    xh = attn_ops.rope(xh, positions)
     return xh.transpose(0, 2, 1, 3).reshape(b, t, d)
 
 
@@ -588,8 +610,9 @@ def _cached_self_attn(blk, x, c, t, pos_mask, num_heads, rope_pos=None):
     k_new = linear.matmul(h, blk["attn"]["wk"])
     q = linear.matmul(h, blk["attn"]["wq"])
     if rope_pos is not None:
-        k_new = _rope_flat(k_new, rope_pos, num_heads)
-        q = _rope_flat(q, rope_pos, num_heads)
+        dh = q.shape[-1] // num_heads
+        k_new = _rope_flat(k_new, rope_pos, dh)
+        q = _rope_flat(q, rope_pos, dh)
     k = jax.lax.dynamic_update_slice_in_dim(c["k"], k_new, t, axis=1)
     v = jax.lax.dynamic_update_slice_in_dim(
         c["v"], linear.matmul(h, blk["attn"]["wv"]), t, axis=1)
@@ -626,16 +649,20 @@ def lm_prefill(params, prompt, max_len, num_heads=8, moe_top_k=2,
         k = linear.matmul(h, blk["attn"]["wk"])
         v = linear.matmul(h, blk["attn"]["wv"])
         q = linear.matmul(h, blk["attn"]["wq"])
-        if pos_type == "rope":
-            # cache stores ROTATED keys (old keys never re-rotate)
-            k = _rope_flat(k, jnp.arange(tp), num_heads)
-            q = _rope_flat(q, jnp.arange(tp), num_heads)
         d = q.shape[-1]
         dh = d // num_heads
-        split = lambda a: a.reshape(b, tp, num_heads, dh).transpose(
+        if pos_type == "rope":
+            # cache stores ROTATED keys (old keys never re-rotate)
+            k = _rope_flat(k, jnp.arange(tp), dh)
+            q = _rope_flat(q, jnp.arange(tp), dh)
+        hkv = k.shape[-1] // dh
+        split = lambda a, hh: a.reshape(b, tp, hh, dh).transpose(
             0, 2, 1, 3)
         att = attn_ops.dot_product_attention(
-            split(q), split(k), split(v), causal=True, use_flash=False)
+            split(q, num_heads),
+            attn_ops.repeat_kv_heads(split(k, hkv), num_heads),
+            attn_ops.repeat_kv_heads(split(v, hkv), num_heads),
+            causal=True, use_flash=False)
         att = att.transpose(0, 2, 1, 3).reshape(b, tp, d)
         x = x + linear.matmul(att, blk["attn"]["wo"])
         x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
@@ -651,7 +678,9 @@ def lm_decode_step(params, prev_ids, t, cache, num_heads=8,
     """One incremental position of the decoder-only trunk (the enc stack
     run causal, lm_loss's twin): prev_ids [B] at position t -> (logits
     [B, V], updated cache).  cache: per-enc-layer K/V buffers
-    [B, max_len, D] (init_lm_cache)."""
+    [B, max_len, Dkv] where Dkv is each block's KV projection width —
+    d_model normally, num_kv_heads*head_dim on a GQA trunk
+    (init_lm_cache sizes off the weights)."""
     b = prev_ids.shape[0]
     max_len = cache[0]["k"].shape[1]
     x = emb_ops.embedding_lookup(params["src_emb"], prev_ids)[:, None]
@@ -679,11 +708,15 @@ def init_lm_cache(params, batch, max_len):
             f"lm decode max_len {max_len} exceeds the positional table "
             f"({params['pos'].shape[0]}); re-init with a larger max_len "
             "or use pos_type='rope'")
-    d = params["src_emb"].shape[1]
     dt = params["src_emb"].dtype
-    return [{"k": jnp.zeros((batch, max_len, d), dt),
-             "v": jnp.zeros((batch, max_len, d), dt)}
-            for _ in params["enc"]]
+    # per-block KV width from the projection itself: grouped-KV trunks
+    # (init num_kv_heads=) get the proportionally smaller cache — the
+    # point of GQA at serving time
+    return [{"k": jnp.zeros((batch, max_len,
+                             blk["attn"]["wk"].shape[1]), dt),
+             "v": jnp.zeros((batch, max_len,
+                             blk["attn"]["wv"].shape[1]), dt)}
+            for blk in params["enc"]]
 
 
 def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,

@@ -232,6 +232,18 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
     return jnp.einsum("bhqk,bhkd->bhqd", w.astype(v.dtype), v)
 
 
+def repeat_kv_heads(kv, num_heads):
+    """[B, Hkv, T, D] -> [B, H, T, D] by repeating each KV head over its
+    query group (GQA).  H % Hkv must hold; Hkv == H is a no-op."""
+    hkv = kv.shape[1]
+    if hkv == num_heads:
+        return kv
+    if num_heads % hkv:
+        raise ValueError(f"num_heads={num_heads} not divisible by "
+                         f"num_kv_heads={hkv}")
+    return jnp.repeat(kv, num_heads // hkv, axis=1)
+
+
 def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
                          causal=False, key_mask=None, mesh=None,
                          seq_axis="seq", zigzag=False,
@@ -252,13 +264,29 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
     b, tq, d = x_q.shape
     tk = x_kv.shape[1]
     dh = d // num_heads
+    # GQA is carried by the WEIGHT SHAPES: wk/wv projecting to fewer
+    # than num_heads*dh columns mean grouped KV heads (transformer.init
+    # num_kv_heads=)
+    if wk.shape[1] % dh:
+        raise ValueError(f"wk projects to {wk.shape[1]} dims, not a "
+                         f"multiple of head dim {dh}")
+    if wv.shape[1] != wk.shape[1]:
+        raise ValueError(f"wk ({wk.shape[1]}) and wv ({wv.shape[1]}) "
+                         "must project to the same grouped-KV width")
+    hkv = wk.shape[1] // dh
 
-    def split(x, w, t):
-        return matmul(x, w).reshape(b, t, num_heads, dh).transpose(0, 2, 1, 3)
+    def split(x, w, t, h):
+        return matmul(x, w).reshape(b, t, h, dh).transpose(0, 2, 1, 3)
 
-    q = split(x_q, wq, tq)
-    k = split(x_kv, wk, tk)
-    v = split(x_kv, wv, tk)
+    q = split(x_q, wq, tq, num_heads)
+    # GQA: k/v project to fewer heads (wk/wv are [D, dh*Hkv]) and each
+    # serves a GROUP of query heads — the serving lever is the smaller
+    # KV cache (models/transformer init_lm_cache sizes off these
+    # shapes); compute repeats them up to full heads HERE, so the
+    # ring/chunked paths downstream still move full-width K/V (keeping
+    # grouped heads through the ring is a future bandwidth lever)
+    k = repeat_kv_heads(split(x_kv, wk, tk, hkv), num_heads)
+    v = repeat_kv_heads(split(x_kv, wv, tk, hkv), num_heads)
     if rope_positions is not None:
         # rotary positions on q/k before any masking or sharding
         # (self-attention: one positions array serves both sides)
