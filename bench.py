@@ -635,21 +635,35 @@ def bench_transformer_moe(batch=16, seq_len=512, vocab=32000, d_model=512,
         {"tokens_per_step": tok, "remat": remat}
 
 
+def _lm_kv_heads():
+    """BENCH_LM_KV_HEADS parsed ONCE (int or None) — the bench body and
+    cache_key_for must agree on what counts as 'GQA on'."""
+    try:
+        v = int(os.environ.get("BENCH_LM_KV_HEADS", "0"))
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
 def bench_transformer_lm_decode(batch=32, prompt_len=32, max_len=160,
                                 vocab=32000, d_model=512, dff=2048,
                                 layers=6, heads=8):
     """LM sampling throughput: KV-cached greedy generation on the
     decoder-only trunk (models/transformer.lm_generate) — the modern
     serving workload the seq2seq beam families don't cover.  Emitted
-    (post-prompt) tokens/sec is the headline."""
+    (post-prompt) tokens/sec is the headline.  BENCH_LM_KV_HEADS=K
+    measures the grouped-query variant (KV cache + per-token HBM stream
+    shrink heads/K-fold; cache row transformer_lm_decode@gqaK)."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models import transformer
 
+    kv_heads = _lm_kv_heads()
     params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
                               trg_vocab=1, d_model=d_model, dff=dff,
                               enc_layers=layers, dec_layers=0,
-                              max_len=max_len)
+                              max_len=max_len, num_heads=heads,
+                              num_kv_heads=kv_heads)
     rng = np.random.RandomState(0)
     prompt = jnp.asarray(rng.randint(3, vocab, (batch, prompt_len)),
                          jnp.int32)
@@ -661,16 +675,21 @@ def bench_transformer_lm_decode(batch=32, prompt_len=32, max_len=160,
         # the timed work is the whole generation scan
         return gen(params, prompt).sum()
 
-    # per decoded position per row: self-attn q/k/v/o + ffn + the
+    # EXECUTED compute per decoded position per row: q+o projections at
+    # full width, k/v at the (possibly grouped) KV width, + ffn + the
     # d_model x vocab tied projection; attention reads the whole cache
-    per_tok = layers * (4 * d_model ** 2 + 2 * d_model * dff) \
-        + d_model * vocab
+    d_kv = (d_model // heads) * kv_heads if kv_heads else d_model
+    per_tok = layers * (2 * d_model ** 2 + 2 * d_model * d_kv
+                        + 2 * d_model * dff) + d_model * vocab
     attn = layers * 2.0 * d_model * max_len * max_len / 2
     flops = 2.0 * batch * (per_tok * (max_len - 1) + attn)
+    extras = {"tokens_per_step": batch * (max_len - prompt_len)}
+    tag = f" kv_heads={kv_heads}" if kv_heads else ""
+    if kv_heads:
+        extras["kv_heads"] = kv_heads
     return run, flops, None, (
         f"transformer LM decode ms/batch bs={batch} prompt={prompt_len} "
-        f"T={max_len}"), \
-        {"tokens_per_step": batch * (max_len - prompt_len)}
+        f"T={max_len}" + tag), extras
 
 
 def _decode_flops(batch, src_len, max_len, vocab, d_model, dff, layers,
@@ -893,6 +912,8 @@ def cache_key_for(model, batch=None):
         key += f"@{bench_dtype}"
     if os.environ.get("BENCH_QUANT") == "int8" and model in _QUANT_MODELS:
         key += "@int8"
+    if model == "transformer_lm_decode" and _lm_kv_heads():
+        key += f"@gqa{_lm_kv_heads()}"
     return key
 
 
