@@ -9,10 +9,12 @@ from paddle_tpu.data.provider import (
     integer_value_sub_sequence, CacheType, SeqType, InputType,
 )
 from paddle_tpu.data.feeder import DataFeeder
+from paddle_tpu.data.prefetch import ShardedPrefetcher, device_placer
 from paddle_tpu.data import datasets
 
 __all__ = [
     "reader", "provider", "DataFeeder", "datasets",
+    "ShardedPrefetcher", "device_placer",
     "dense_vector", "sparse_binary_vector", "sparse_float_vector",
     "integer_value", "dense_vector_sequence", "sparse_binary_vector_sequence",
     "sparse_float_vector_sequence", "integer_value_sequence",

@@ -108,6 +108,70 @@ class DataFeeder:
             return pad_nested_sequences(nested)
         raise ValueError(f"unsupported input type {itype}")
 
+    def feed_specs(self, batch_size, bucket_bounds=None):
+        """Abstract feed shapes for AOT warm-up (``SGD.precompile``).
+
+        Returns one feed dict of ``jax.ShapeDtypeStruct`` leaves per
+        combination of padded sequence lengths from ``bucket_bounds``
+        (default: this feeder's own bounds; pick them with
+        ``core.sequence.bucket_boundaries``), mirroring exactly the
+        shapes/dtypes ``__call__`` produces for a full batch of
+        ``batch_size`` padded to those buckets.  ``__call__`` buckets
+        every SEQUENCE slot independently, so with S sequence slots and K
+        bounds this is the full K**S cross-product — a seq2seq batch with
+        short sources and long targets still hits a precompiled shape.
+        With no sequence slots the result is a single spec (shapes don't
+        depend on the bucket).
+        """
+        from itertools import product
+
+        import jax
+        from paddle_tpu.core.sequence import SequenceBatch as _SB
+
+        bounds = bucket_bounds if bucket_bounds is not None \
+            else self.bucket_bounds
+        seq_names = [n for n, t in self.feeding.items()
+                     if t.seq_type != SeqType.NO_SEQUENCE]
+        if seq_names and not bounds:
+            raise ValueError(
+                "feed_specs: sequence slots need bucket_bounds (the "
+                "padded lengths to precompile for; see "
+                "core.sequence.bucket_boundaries)")
+        b = int(self.pad_batch_to or batch_size)
+
+        def one(lens):
+            feed = {}
+            for name, itype in self.feeding.items():
+                if itype.seq_type == SeqType.NO_SEQUENCE:
+                    if itype.kind == "index":
+                        feed[name] = jax.ShapeDtypeStruct((b,), np.int32)
+                    else:       # dense / densified sparse -> [B, dim] f32
+                        feed[name] = jax.ShapeDtypeStruct(
+                            (b, itype.dim), np.float32)
+                elif itype.seq_type == SeqType.SEQUENCE:
+                    max_len = lens[name]
+                    if itype.kind == "index":
+                        data = jax.ShapeDtypeStruct((b, max_len), np.int32)
+                    else:
+                        data = jax.ShapeDtypeStruct((b, max_len, itype.dim),
+                                                    np.float32)
+                    feed[name] = _SB(
+                        data=data,
+                        lengths=jax.ShapeDtypeStruct((b,), np.int32))
+                else:
+                    raise ValueError(
+                        f"feed_specs: SUB_SEQUENCE slot {name!r} has no "
+                        "static bucket shape (nested max lengths are "
+                        "data-dependent); precompile with a concrete "
+                        "example feed instead")
+            return feed
+
+        if not seq_names:
+            return [one({})]
+        return [one(dict(zip(seq_names, combo)))
+                for combo in product(sorted(int(m) for m in bounds),
+                                     repeat=len(seq_names))]
+
     def __call__(self, batch):
         """batch: list of dicts {name: sample} or tuples in feeding order."""
         names = list(self.feeding)

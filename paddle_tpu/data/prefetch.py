@@ -1,0 +1,218 @@
+"""Overlapped input pipeline: host batches -> device-resident feeds, N ahead.
+
+Reference: the DoubleBuffer thread (gserver/dataproviders/DataProvider.h:251)
+hid host-side data latency behind GPU compute; ``reader.buffered()`` carries
+that over for HOST batches.  This module completes the device half of the
+story: a bounded background thread runs DataFeeder conversion AND the H2D
+transfer (``jax.device_put`` under the mesh's batch ``NamedSharding``, or
+``jax.make_array_from_callback`` on a process-spanning mesh), so the trainer
+hot loop dequeues batches that are already device-resident and sharded —
+step wall time excludes input time entirely.
+
+Donation safety: every batch is freshly ``device_put`` — the prefetcher
+never pools or reuses device buffers, and the producer drops its own
+reference the moment a batch enters the queue, so even a jitted consumer
+that DONATES its feed can never alias a buffer still held here (see
+``test_donation_safety``).  Note the trainer step itself does not donate
+feeds (its ``donate_argnums`` covers params/opt state only); the
+fresh-buffer discipline is what keeps third-party donating consumers
+safe, and is one reason ``SGD.train(prefetch=N)`` is bit-identical to
+``prefetch=0``.
+
+Exceptions raised by the source reader, the convert fn, or device placement
+surface in the CONSUMER thread at the next ``__next__``; ``close()`` (or
+exhausting the stream) joins the producer thread.
+"""
+
+import queue as _queue
+import threading
+import time
+import weakref
+
+import jax
+
+
+_END = object()
+
+
+def _release(stop, q):
+    """Stop the producer and drop queued (device-resident) batches.
+    Module-level so a weakref.finalize can run it after the owning
+    prefetcher is garbage collected (no strong ref to self)."""
+    stop.set()
+    try:
+        while True:
+            q.get_nowait()
+    except _queue.Empty:
+        pass
+
+
+def _bounded_put(q, stop, item):
+    """Bounded put that aborts promptly on stop instead of blocking
+    forever against a consumer that went away."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except _queue.Full:
+            continue
+    return False
+
+
+def _fill(source, convert, place, stop, q):
+    """Producer body.  Module-level (not a bound method) on purpose: a
+    RUNNING Thread strongly references its target, so a method target
+    would keep the prefetcher alive and its GC finalizer from ever
+    firing."""
+    try:
+        for batch in source():
+            if stop.is_set():
+                return
+            feed = convert(batch) if convert else batch
+            feed = place(feed)
+            if not _bounded_put(q, stop, feed):
+                return
+            # the queue now holds the ONLY producer-side reference: once
+            # dequeued, the consumer (and its donating step) owns the
+            # buffers outright
+            del feed
+    except BaseException as e:  # noqa: BLE001 — must cross threads
+        _bounded_put(q, stop, _Failure(e))
+    else:
+        _bounded_put(q, stop, _END)
+
+
+class _Failure:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def device_placer(mesh=None, multiprocess=False):
+    """Return a fn placing a host feed pytree onto device(s).
+
+    mesh=None: plain ``jax.device_put`` (default device).
+    mesh: ``device_put`` under ``batch_shardings`` (leading dim over the
+    'data' axis — the NamedSharding shard_map-era placement).
+    multiprocess: the mesh spans devices owned by other processes;
+    ``device_put`` cannot target non-addressable devices, so global arrays
+    are assembled from the (identical-per-process) host values by
+    ``parallel.sharding.globalize_pytree`` — the same helper behind
+    ``SGD._globalize``.
+    """
+    if mesh is None:
+        return jax.device_put
+    from paddle_tpu.parallel import batch_shardings
+    if not multiprocess:
+        def place(feed):
+            return jax.device_put(feed, batch_shardings(feed, mesh))
+        return place
+
+    from paddle_tpu.parallel.sharding import globalize_pytree
+
+    def place_global(feed):
+        return globalize_pytree(feed, batch_shardings(feed, mesh))
+    return place_global
+
+
+class ShardedPrefetcher:
+    """Bounded background producer of device-resident feeds.
+
+    source: zero-arg callable returning an iterator of host batches (the
+    reader contract).
+    convert: host batch -> feed pytree (feeder conversion + normalization);
+    runs on the producer thread.  None = identity.
+    place: feed pytree -> device-resident feed; runs on the producer
+    thread.  None = ``jax.device_put`` (see ``device_placer`` for mesh /
+    multi-process placement).
+    depth: max batches resident ahead of the consumer (queue bound; HBM
+    cost is ~depth+1 extra batches).
+
+    Iterate to consume; ``wait_s`` accumulates the consumer-side blocked
+    time (the trainer's ``h2d_wait`` counter: ~0 when the pipeline keeps
+    up, ~input latency when input-bound).  Context manager: ``close()`` on
+    exit stops the producer and joins it.
+    """
+
+    def __init__(self, source, depth=2, convert=None, place=None,
+                 start=True):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._source = source
+        self._convert = convert
+        self._place = place if place is not None else jax.device_put
+        self._q = _queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._done = False
+        self._started = False
+        self.wait_s = 0.0       # cumulative consumer-side input wait
+        self.batches = 0        # batches handed to the consumer
+        self._thread = threading.Thread(
+            target=_fill,
+            args=(source, convert, self._place, self._stop, self._q),
+            daemon=True, name="paddle-tpu-prefetch")
+        # a consumer that abandons the iterator without close() (break
+        # out of the loop, exception) must not leave the producer
+        # spinning with ~depth+1 batches of HBM pinned: GC of the
+        # prefetcher stops and drains it
+        self._finalizer = weakref.finalize(self, _release,
+                                           self._stop, self._q)
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ consumer
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        if not self._started:   # start=False consumer iterating directly:
+            self.start()        # a forever-empty queue would deadlock here
+        t0 = time.perf_counter()
+        item = self._q.get()
+        self.wait_s += time.perf_counter() - t0
+        if item is _END:
+            self._done = True
+            self._thread.join()
+            raise StopIteration
+        if isinstance(item, _Failure):
+            self._done = True
+            self._thread.join()
+            raise item.exc
+        self.batches += 1
+        return item
+
+    def start(self):
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def close(self):
+        """Stop the producer and join it; safe to call more than once.
+        Queued (undelivered) batches are dropped."""
+        self._done = True
+        # stop + drain (unblocks a producer waiting on a full queue);
+        # also disarms the GC finalizer
+        _release(self._stop, self._q)
+        self._finalizer.detach()
+        if self._started:
+            self._thread.join(timeout=30.0)
+            if self._thread.is_alive():
+                from paddle_tpu.utils.logging import logger
+                logger.warning(
+                    "ShardedPrefetcher.close(): producer thread still "
+                    "alive after 30s (reader or device placement is "
+                    "blocked); it is a daemon and ~depth batches of "
+                    "device memory stay pinned until it unblocks")
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -18,6 +18,7 @@ from paddle_tpu.parallel.sharding import (
 )
 from paddle_tpu.parallel.distributed import (
     init_distributed, is_coordinator, global_mesh, barrier,
+    check_equal_progress,
 )
 
 __all__ = [
@@ -29,4 +30,5 @@ __all__ = [
     "ShardingRules", "megatron_rules", "param_shardings", "shard_params",
     "batch_shardings", "replicated_shardings", "valid_spec",
     "init_distributed", "is_coordinator", "global_mesh", "barrier",
+    "check_equal_progress",
 ]

@@ -97,6 +97,114 @@ def barrier(name: str = "barrier"):
     multihost_utils.sync_global_devices(name)
 
 
+# check_equal_progress call ordinal: every rank executes the same sequence
+# of pass ends (num_passes comes from the shared config), so a local
+# counter stays in lockstep across processes and makes each call's
+# coordination-service keys/barrier ids unique — stale keys from an
+# earlier train() call can never be read
+_progress_seq = [0]
+_warned_no_client = []      # one-shot fallback warning state
+
+
+def check_equal_progress(n_batches, name="pass", timeout_s=600.0,
+                         skip=False):
+    """Equal-progress guard for multi-process training.
+
+    Gathers each rank's batch count and raises ConfigError on mismatch:
+    SPMD training requires every rank's reader to yield the same number
+    of batches — a rank with MORE batches has already enqueued step
+    executables whose cross-process collectives (the grad psum) no other
+    rank will join, so its DEVICE queue is wedged the moment the counts
+    diverge.  A device-side collective (process_allgather) would wedge
+    right behind it; the gather therefore goes over the coordination
+    service's host-side KV store (jax.distributed client), which needs no
+    device participation — the mismatch surfaces as this error on every
+    rank's HOST even while the device queues hang, and tearing the
+    process down aborts the orphaned device work.  A rank that never
+    arrives (crashed) turns into a barrier timeout error after
+    ``timeout_s`` instead of an infinite hang.
+
+    The trainer calls this at PASS END — a point every rank reaches
+    unconditionally, however many batches its reader produced.  Without a
+    coordination-service client (multi-process runtime brought up outside
+    ``jax.distributed``) it falls back to a device allgather, which still
+    catches skew a pass late (counts equal this pass, unequal the next)
+    but can itself hang in the wedged case — prefer init_distributed.
+
+    skip=True (a rank stopping early on purpose — SIGTERM preemption)
+    still PARTICIPATES in the gather but marks its count preempted (the
+    encoding is ``-(n+1)``, so the actual count survives): signal
+    delivery is not synchronized across ranks, so unequal counts are
+    expected then, and a rank that silently skipped the collective would
+    strand every other rank at the barrier for ``timeout_s``.  When any
+    rank is preempted the mismatch check never raises; instead the
+    equality of the DECODED counts tells every rank — consistently —
+    whether the device queues are still sound (equal: all dispatched
+    steps' collectives are matched, host syncs and a final checkpoint
+    are safe) or wedged (unequal: a rank dispatched steps whose psums
+    will never complete).
+
+    Returns ``(common, preempted)``: ``common`` is the shared batch
+    count, or None when counts diverged (only possible preempted —
+    otherwise it raises); ``preempted`` is True when any rank stopped on
+    a signal, which callers must treat as job-wide stop (a preempted
+    peer will not join the next pass's collectives).  Single-process:
+    no collective, ``(n_batches, skip)``.
+    """
+    n = -(int(n_batches) + 1) if skip else int(n_batches)
+    nproc = jax.process_count()
+    if nproc == 1:
+        return int(n_batches), bool(skip)
+    from paddle_tpu.utils.error import ConfigError
+
+    seq = _progress_seq[0]
+    _progress_seq[0] += 1
+    try:
+        # private namespace: the only handle on the coordination-service
+        # KV client; a jax relocation degrades to the device fallback
+        # below rather than crashing the pass end
+        from jax._src import distributed as _dist
+        client = getattr(_dist.global_state, "client", None)
+    except ImportError:
+        client = None
+    if client is None:
+        if not _warned_no_client:
+            _warned_no_client.append(True)      # once per process, not
+            logger.warning(                     # once per pass end
+                "check_equal_progress: no coordination-service client; "
+                "falling back to a device allgather (cannot interrupt an "
+                "already-wedged device queue)")
+        from jax.experimental import multihost_utils
+        counts = [int(c) for c in np.asarray(multihost_utils.
+                  process_allgather(np.asarray([n], np.int64))).reshape(-1)]
+    else:
+        rank = jax.process_index()
+        key = f"paddle_tpu/eqprog/{seq}"
+        t_ms = max(1000, int(timeout_s * 1000))
+        client.key_value_set(f"{key}/r{rank}", str(n))
+        # all ranks' keys are visible once everyone arrives; a missing
+        # rank fails this barrier after timeout_s instead of hanging
+        client.wait_at_barrier(f"{key}/barrier", t_ms)
+        counts = [int(client.blocking_key_value_get(f"{key}/r{i}", t_ms))
+                  for i in range(nproc)]
+        # second barrier before cleanup so no rank deletes a key a
+        # straggler is still reading
+        client.wait_at_barrier(f"{key}/done", t_ms)
+        client.key_value_delete(f"{key}/r{rank}")
+    preempted = any(c < 0 for c in counts)
+    decoded = [-c - 1 if c < 0 else c for c in counts]
+    if len(set(decoded)) > 1:
+        if preempted:       # expected when signal delivery raced the
+            return None, True           # stop-check; not a config error
+        per_rank = " ".join(f"r{i}={c}" for i, c in enumerate(decoded))
+        raise ConfigError(
+            f"unequal per-rank batch counts in {name}: {per_rank} — "
+            "multi-process train() requires every rank's reader to yield "
+            "the same number of batches per pass (shard the data evenly, "
+            "or drop the remainder with batch(..., drop_last=True))")
+    return decoded[0], preempted
+
+
 def step_skew_report(durations, name="train_step"):
     """Cross-rank straggler/skew report — the SPMD successor to the
     reference's per-trainer BarrierStat arrival profiling

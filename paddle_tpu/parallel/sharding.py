@@ -120,9 +120,12 @@ def shard_params(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
 
 def batch_shardings(feed, mesh: Mesh):
     """Shard every array's leading (batch) dim over 'data'; scalars
-    replicated.  SequenceBatch lengths shard over 'data' too."""
+    replicated.  SequenceBatch lengths shard over 'data' too.  Leaves may
+    be jax.ShapeDtypeStructs (the SGD.precompile AOT path lowers against
+    abstract feeds)."""
     def spec_for_leaf(x):
-        nd = np.ndim(x)
+        shape = getattr(x, "shape", None)
+        nd = len(shape) if shape is not None else np.ndim(x)
         if nd == 0:
             return NamedSharding(mesh, P())
         return NamedSharding(mesh, P(*([AXIS_DATA] + [None] * (nd - 1))))
@@ -132,3 +135,24 @@ def batch_shardings(feed, mesh: Mesh):
 def replicated_shardings(tree, mesh: Mesh):
     return jax.tree_util.tree_map(
         lambda _: NamedSharding(mesh, P()), tree)
+
+
+def globalize_pytree(tree, shardings, gather=None):
+    """Host pytree -> global jax.Arrays on a process-spanning mesh.
+    Every process holds the same host value (SPMD discipline:
+    deterministic init / identical batch streams); each device takes its
+    addressable shard via the callback.  The single implementation behind
+    both the trainer's synchronous path (SGD._globalize) and the prefetch
+    producer thread (data.prefetch.device_placer) — the multi-process
+    assembly is subtle enough that two copies would drift.
+
+    gather: optional fn pulling an already-global (non-fully-addressable)
+    jax.Array back to a host value first; leaves are assumed host-side
+    when omitted."""
+    def conv(x, sh):
+        if gather is not None and isinstance(x, jax.Array) \
+                and not x.is_fully_addressable:
+            x = gather(x)
+        a = np.asarray(x)
+        return jax.make_array_from_callback(a.shape, sh, lambda idx: a[idx])
+    return jax.tree_util.tree_map(conv, tree, shardings)

@@ -83,6 +83,7 @@ DEFAULT_COMBOS = [
     "transformer_lm_decode:32",                   # LM sampling throughput
     "transformer_serving:16",                     # bucketed-length stream
     "seq2seq:64",
+    "trainer_prefetch:64",                        # input-pipeline overlap
 ]
 
 

@@ -292,8 +292,8 @@ def _trainer_sparse(args, nproc, rank):
                                                        momentum=0.0),
              mesh=mesh, seed=3, donate=False)
     costs = []
-    # log_period=6 fires the cross-rank straggler report twice per pass
-    # (12 batches), exported below for the test to assert on
+    # the cross-rank straggler report fires once per PASS END (over all
+    # 12 batches' step times); exported below for the test to assert on
     tr.train(lambda: iter(batches), num_passes=2, log_period=6,
              event_handler=lambda e: costs.append(float(e.cost))
              if isinstance(e, events.EndIteration) else None)

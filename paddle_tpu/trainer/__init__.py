@@ -1,9 +1,10 @@
 """Training driver (reference §2.7 trainer + v2 trainer API)."""
 
-from paddle_tpu.trainer.trainer import SGD, Inferencer, infer
+from paddle_tpu.trainer.trainer import SGD, Trainer, Inferencer, infer
 from paddle_tpu.trainer import events
 from paddle_tpu.trainer.checkpoint import (
     save_checkpoint, load_checkpoint, merge_model, load_merged)
 
-__all__ = ["SGD", "Inferencer", "infer", "events", "save_checkpoint",
-           "load_checkpoint", "merge_model", "load_merged"]
+__all__ = ["SGD", "Trainer", "Inferencer", "infer", "events",
+           "save_checkpoint", "load_checkpoint", "merge_model",
+           "load_merged"]

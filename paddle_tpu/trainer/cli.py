@@ -99,6 +99,16 @@ def _feeder_from_args(args, cfg, allow_pad=True):
                            pad_batch=pad)
 
 
+def _resolve_prefetch(args):
+    """--prefetch, defaulting to the FLAGS pair the reference shipped:
+    async_load_data (DoubleBuffer on/off) × prefetch_depth."""
+    p = getattr(args, "prefetch", None)
+    if p is not None:
+        return p
+    from paddle_tpu.utils.flags import FLAGS
+    return FLAGS.prefetch_depth if FLAGS.async_load_data else 0
+
+
 def _parse_config_args(s):
     out = {}
     if s:
@@ -152,6 +162,17 @@ def main(argv=None):
     t = sub.add_parser("train")
     add_common(t)
     t.add_argument("--num_passes", type=int, default=1)
+    t.add_argument("--prefetch", type=int, default=None,
+                   help="overlapped input pipeline: convert + H2D-transfer "
+                        "N batches ahead on a background thread so the "
+                        "step never waits on input (0 = off; costs ~N+1 "
+                        "batches of extra HBM).  Default comes from FLAGS: "
+                        "prefetch_depth when async_load_data (the "
+                        "reference DoubleBuffer default), else 0")
+    t.add_argument("--jax_compilation_cache_dir", default=None,
+                   help="persist XLA compilations here and reuse them "
+                        "across restarts (opt-in; pairs with seq_buckets "
+                        "so warm starts skip every bucket compile)")
     t.add_argument("--grad_accum_steps", type=int, default=1,
                    help="sum grads over N micro-batches, apply their mean "
                         "every Nth step (large effective batch in fixed "
@@ -244,6 +265,9 @@ def main(argv=None):
     if getattr(args, "debug_nans", False):
         import jax
         jax.config.update("jax_debug_nans", True)
+    if getattr(args, "jax_compilation_cache_dir", None):
+        from paddle_tpu.utils.flags import set_compilation_cache_dir
+        set_compilation_cache_dir(args.jax_compilation_cache_dir)
     if getattr(args, "comment", ""):
         from paddle_tpu.utils.logging import logger
         logger.info("comment: %s", args.comment)
@@ -366,7 +390,8 @@ def main(argv=None):
                           test_period=args.test_period,
                           log_period=args.log_period,
                           show_parameter_stats_period=
-                          args.show_parameter_stats_period)
+                          args.show_parameter_stats_period,
+                          prefetch=_resolve_prefetch(args))
         finally:
             # flush the trace even on a mid-pass failure — crashed runs are
             # the ones you most want a profile of

@@ -46,6 +46,23 @@ def _normalize_feed(feed):
             for k, v in feed.items()}
 
 
+def _feed_signature(feed):
+    """Hashable (treedef, leaf shapes/dtypes) key for a feed pytree — the
+    dispatch key of the AOT-precompiled step executables (one per length
+    bucket).  Works for concrete arrays and jax.ShapeDtypeStructs alike."""
+    leaves, treedef = jax.tree_util.tree_flatten(feed)
+    return (treedef,
+            tuple((tuple(l.shape), np.dtype(l.dtype).name) for l in leaves))
+
+
+def _abstract_feed(feed):
+    """Feed pytree -> same pytree of jax.ShapeDtypeStructs (leaves that
+    already are ShapeDtypeStructs pass through)."""
+    return jax.tree_util.tree_map(
+        lambda l: l if isinstance(l, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(np.shape(l), l.dtype), feed)
+
+
 class SGD:
     """paddle.v2.trainer.SGD equivalent.
 
@@ -154,7 +171,9 @@ class SGD:
             d.process_index != jax.process_index()
             for d in np.asarray(mesh.devices).flat)
         # latest cross-rank straggler report (parallel.distributed.
-        # step_skew_report), refreshed every log_period in multi-process runs
+        # step_skew_report), refreshed at each pass end in multi-process
+        # runs (pass end is the only point every rank reaches
+        # unconditionally, so the collective cannot deadlock there)
         self.last_skew_report = None
         if mesh is not None:
             rules = sharding_rules
@@ -168,6 +187,11 @@ class SGD:
         self._step_fn = None
         self._eval_fn = None
         self._gather_cache = {}   # jitted replicate-gathers (save path)
+        self._compiled = {}       # feed signature -> AOT step executable
+        # incremented each time the step's Python body is traced — the
+        # trace-count hook: after precompile() covers every bucket, a
+        # whole training pass must leave this unchanged
+        self.trace_count = 0
         self._donate = donate
 
     # ------------------------------------------------------------ build
@@ -368,7 +392,13 @@ class SGD:
             return (new_params, {"dense": new_dstate, "sparse": new_sparse},
                     merged_state, loss, extras)
 
-        step = sparse_step if specs else dense_step
+        base_step = sparse_step if specs else dense_step
+
+        def step(params, opt_state, state, feed, rng):
+            # Python body runs only under tracing: this is the trace-count
+            # hook precompile()'s no-retrace guarantee is asserted against
+            self.trace_count += 1
+            return base_step(params, opt_state, state, feed, rng)
 
         if self.mesh is None:
             self._step_fn = jax.jit(
@@ -417,27 +447,77 @@ class SGD:
     # ------------------------------------------------------------ train
 
     def _globalize(self, tree, shardings):
-        """Host pytree -> global jax.Arrays on a process-spanning mesh.
-        Every process holds the same host value (SPMD discipline:
-        deterministic init / identical batch streams); each device takes
-        its addressable shard via the callback."""
-        def conv(x, sh):
-            if isinstance(x, jax.Array) and not x.is_fully_addressable:
-                # already global (e.g. fresh-init params kept by a
-                # load_parameters 'rand' merge): gather to host first
-                x = self._devget_replicated(x)
-            a = np.asarray(x)
-            return jax.make_array_from_callback(a.shape, sh,
-                                                lambda idx: a[idx])
-        return jax.tree_util.tree_map(conv, tree, shardings)
+        """Host pytree -> global jax.Arrays (parallel.sharding.
+        globalize_pytree).  Already-global leaves (e.g. fresh-init params
+        kept by a load_parameters 'rand' merge) are gathered to host
+        first."""
+        from paddle_tpu.parallel.sharding import globalize_pytree
+        return globalize_pytree(tree, shardings,
+                                gather=self._devget_replicated)
 
     def _globalize_step_inputs(self, feed, step_rng):
         if not self._multiprocess:
             return feed, step_rng
         feed = self._globalize(feed, batch_shardings(feed, self.mesh))
-        step_rng = self._globalize(
+        return feed, self._globalize_rng(step_rng)
+
+    def _globalize_rng(self, step_rng):
+        """rng half of _globalize_step_inputs — the prefetch path already
+        globalized the feed on the producer thread."""
+        if not self._multiprocess:
+            return step_rng
+        return self._globalize(
             step_rng, replicated_shardings(step_rng, self.mesh))
-        return feed, step_rng
+
+    # ------------------------------------------------------------ warm-up
+
+    def precompile(self, batch_specs):
+        """AOT warm-up: compile the train step once per feed spec so a
+        bucketed pass never pays an XLA compile inside the timed loop.
+
+        batch_specs: iterable of feed dicts {data_layer_name: leaf} where
+        a leaf is a concrete array, a ``jax.ShapeDtypeStruct``, or a
+        SequenceBatch of either — one spec per length bucket.
+        ``DataFeeder.feed_specs(batch_size, bucket_bounds)`` builds them
+        from the feeding types + ``core.sequence.bucket_boundaries``.
+
+        Each spec is lowered and compiled via ``jax.jit(step).lower(...)
+        .compile()`` and the executable is dispatched by feed shape in
+        ``train()``/``train_one_batch()`` — a subsequent pass over those
+        buckets triggers no new traces (assert with ``trace_count``).
+        Returns the number of NEW executables compiled.  Pair with the
+        ``jax_compilation_cache_dir`` flag (utils/flags.py) to persist
+        the compilations across process restarts.
+        """
+        n_new = 0
+        for spec in batch_specs:
+            feed = _abstract_feed(spec)
+            sig = _feed_signature(feed)
+            if sig in self._compiled:
+                continue
+            if self._step_fn is None:
+                self._build_step(feed)
+            rng_spec = jax.ShapeDtypeStruct(np.shape(self.rng),
+                                            self.rng.dtype)
+            lowered = self._step_fn.lower(
+                self.parameters, self.opt_state, self.model_state, feed,
+                rng_spec)
+            self._compiled[sig] = lowered.compile()
+            n_new += 1
+        if n_new:
+            logger.info("precompiled %d step executable(s) (%d cached)",
+                        n_new, len(self._compiled))
+        return n_new
+
+    def _dispatch_step(self, feed):
+        """The executable for this feed shape: a precompiled bucket
+        program if one exists, else the jitted step (which traces on new
+        shapes)."""
+        if self._compiled:
+            fn = self._compiled.get(_feed_signature(feed))
+            if fn is not None:
+                return fn
+        return self._step_fn
 
     def log_parameter_stats(self):
         """Per-parameter value abs-max/avg dump (the reference's
@@ -452,9 +532,39 @@ class SGD:
               save_dir=None, saving_period=1, save_only_one=False,
               test_reader=None, test_period=0, log_period=100,
               buffered_batches=4, show_parameter_stats_period=0,
-              save_on_signal=True):
+              save_on_signal=True, prefetch=0, progress_timeout_s=600.0):
         """reader: callable -> iterator of batches (lists of samples).
         feeding: {data_layer_name: InputType} or a DataFeeder.
+
+        prefetch: run feeder conversion AND the H2D transfer on a bounded
+        background thread, `prefetch` batches ahead of the step
+        (data.prefetch.ShardedPrefetcher — the DoubleBuffer story
+        completed to the device side).  The hot loop then dequeues
+        device-resident, mesh-sharded feeds, so step wall time excludes
+        input time; the per-period log line's h2d_wait column shows the
+        residual input wait (~0 when the pipeline keeps up).  Numerically
+        identical to prefetch=0 (same batches, same order, donation-safe).
+        Costs ~prefetch+1 extra batches of HBM; supersedes
+        buffered_batches (the host-only half) when set.
+
+        Multi-process note: every rank's reader must yield the SAME number
+        of batches per pass — cross-rank collectives (the step's psums,
+        the pass-end skew report) hang otherwise.  The pass-end
+        equal-progress check (parallel.distributed.check_equal_progress)
+        runs over the coordination service's HOST-side channel, so at
+        PASS END a violation surfaces as a hard error — ConfigError
+        naming each rank's count, or a barrier timeout when a rank is
+        already wedged mid-pass — instead of a silent deadlock.  (A rank
+        that stops mid-pass can still wedge peers at the next device
+        sync point inside THEIR pass — e.g. the log-period cost mean —
+        before they reach this guard; that is inherent to SPMD and the
+        cluster runtime's reap timeout is the backstop there.)
+        progress_timeout_s
+        bounds that pass-end barrier: a rank stopping early on SIGTERM
+        waits there for its peers to finish the pass, so on long passes
+        raise it above the worst-case pass remainder or the preempted
+        rank times out before the peers arrive (and before its
+        checkpoint).
 
         save_on_signal: when save_dir is set and train() runs on the main
         thread, SIGTERM requests a graceful stop — the loop finishes the
@@ -470,17 +580,22 @@ class SGD:
         self._stop_signal = None
         prev_handler = None
         handler_armed = False
-        # single-process only: in multi-process SPMD, acting on a local
-        # signal would diverge the ranks mid-collective (skewed delivery)
-        # — there the launcher's fail-fast SIGTERM + pass-checkpoint
-        # resume is the recovery path
-        if save_on_signal and save_dir and not self._multiprocess:
+        # multi-process too, and there even WITHOUT save_dir: skewed
+        # signal delivery diverges per-rank batch counts, but the
+        # pass-end equal-progress gather coordinates the ranks — a
+        # preempted rank reports its count as preempted, every rank
+        # stops together, and host syncs/the checkpoint are skipped when
+        # the decoded counts show wedged device queues.  An unhandled
+        # SIGTERM would instead kill the rank instantly and strand its
+        # peers at the barrier; only the checkpoint WRITE needs save_dir
+        if save_on_signal and (save_dir or self._multiprocess):
             import signal as _signal
 
             def _request_stop(signum, frame):
                 self._stop_signal = signum
-                logger.info("SIGTERM: finishing current batch, then "
-                            "checkpointing to %s", save_dir)
+                logger.info("SIGTERM: finishing current batch, then %s",
+                            f"checkpointing to {save_dir}" if save_dir
+                            else "stopping at pass end (no save_dir)")
             try:
                 prev_handler = _signal.signal(_signal.SIGTERM, _request_stop)
                 handler_armed = True
@@ -516,8 +631,21 @@ class SGD:
                 for spec in self.evaluators:
                     spec.reset()
                 batch_reader = reader
-                if buffered_batches:
+                if buffered_batches and not prefetch:
+                    # host-only double buffering; with prefetch the device
+                    # pipeline's own thread covers it
                     batch_reader = reader_mod.buffered(reader, buffered_batches)
+                prefetcher = None
+                # ONE conversion fn for both paths — the bit-identical
+                # guarantee between prefetch=N and prefetch=0 rests on it
+                convert = (lambda b: _normalize_feed(feeder(b)
+                                                     if feeder else b))
+                if prefetch:
+                    from paddle_tpu.data.prefetch import (ShardedPrefetcher,
+                                                          device_placer)
+                    prefetcher = ShardedPrefetcher(
+                        batch_reader, depth=prefetch, convert=convert,
+                        place=device_placer(self.mesh, self._multiprocess))
                 # running device-side sums: no host sync in the hot loop —
                 # cost only crosses to the host every log_period (and for the
                 # event stream, whose .cost is the device scalar; float() it
@@ -530,65 +658,142 @@ class SGD:
                         cost_sum, replicated_shardings(cost_sum, self.mesh))
                 n_batches = 0
                 window = []
-                skew_window = []     # host-side step wall times this period
+                skew_window = []     # host-side step wall times this pass
+                h2d_window = 0.0     # input wait this log period (seconds)
                 t0 = time.time()
-                for batch_id, batch in enumerate(batch_reader()):
-                    feed = _normalize_feed(feeder(batch) if feeder
-                                           else batch)
-                    event_handler(events.BeginIteration(pass_id, batch_id))
-                    self.rng, step_rng = jax.random.split(self.rng)
-                    if self._step_fn is None:
-                        self._build_step(feed)
-                    feed, step_rng = self._globalize_step_inputs(feed, step_rng)
-                    t_step = time.perf_counter()
-                    with timer("train_step"):
-                        (self.parameters, self.opt_state, self.model_state,
-                         cost, extras) = self._step_fn(
-                            self.parameters, self.opt_state, self.model_state,
-                            feed, step_rng)
-                    # per-step distribution (BarrierStat skew-profiling role):
-                    # record this step's own delta, not the cumulative timer
-                    from paddle_tpu.utils.stats import step_histogram
-                    step_dt = time.perf_counter() - t_step
-                    step_histogram.add(step_dt)
-                    cost_sum = cost_sum + cost
-                    if self._multiprocess and log_period:
-                        # only consumed by the cross-rank report below;
-                        # don't accumulate a pass-long list otherwise
-                        skew_window.append(step_dt)
-                    n_batches += 1
-                    window.append(cost)
-                    if self.evaluators:
-                        update_evaluators(extras, feed)
-                    if log_period and (batch_id + 1) % log_period == 0:
-                        c = float(jnp.mean(jnp.stack(window)))
-                        window = []
-                        dt = (time.time() - t0) / log_period
-                        logger.info("Pass %d Batch %d Cost %.5f (%.1f ms/batch)%s",
-                                    pass_id, batch_id + 1, c, dt * 1e3,
-                                    eval_log_suffix())
-                        if self._multiprocess:
-                            # cross-rank straggler diagnosis (the reference
-                            # BarrierStat role): collective — every rank
-                            # reaches this block at the same batch_id
-                            from paddle_tpu.parallel.distributed import (
-                                step_skew_report)
-                            self.last_skew_report = step_skew_report(
-                                skew_window)
-                        skew_window = []
-                        t0 = time.time()
-                    if (show_parameter_stats_period
-                            and (batch_id + 1) % show_parameter_stats_period == 0):
-                        self.log_parameter_stats()
-                    event_handler(events.EndIteration(
-                        pass_id, batch_id, cost=cost,
-                        evaluator_results={f"extra_{i}": e
-                                           for i, e in enumerate(extras)}))
-                    if self._stop_signal is not None:
-                        break
-                pass_cost = float(cost_sum) / n_batches if n_batches else float("nan")
+                feed_iter = iter(prefetcher) if prefetcher is not None \
+                    else iter(batch_reader())
+                batch_id = -1
+                try:
+                    while True:
+                        # h2d_wait: host time blocked acquiring the next
+                        # device-ready feed — with prefetch this is the queue
+                        # wait (~0 when the pipeline keeps up), without it the
+                        # reader + feeder conversion run inline here
+                        t_in = time.perf_counter()
+                        try:
+                            item = next(feed_iter)
+                        except StopIteration:
+                            break
+                        feed = item if prefetcher is not None else \
+                            convert(item)
+                        h2d_dt = time.perf_counter() - t_in
+                        batch_id += 1
+                        event_handler(events.BeginIteration(pass_id, batch_id))
+                        self.rng, step_rng = jax.random.split(self.rng)
+                        if self._step_fn is None:
+                            self._build_step(feed)
+                        if prefetcher is None:
+                            # multi-process: the synchronous path's global-
+                            # array H2D assembly counts into h2d_wait too —
+                            # otherwise the prefetch 0-vs-N comparison the
+                            # column exists for is apples-to-oranges.
+                            # (Single-process this is a no-op; there the
+                            # sync path's transfer happens lazily inside
+                            # the jit call and lands in step time.)
+                            t_g = time.perf_counter()
+                            feed, step_rng = self._globalize_step_inputs(
+                                feed, step_rng)
+                            h2d_dt += time.perf_counter() - t_g
+                        else:       # feed was placed on the producer thread;
+                            # rng assembly still runs here and counts like
+                            # the synchronous path's (same per-step work on
+                            # both sides of the 0-vs-N comparison)
+                            t_g = time.perf_counter()
+                            step_rng = self._globalize_rng(step_rng)
+                            h2d_dt += time.perf_counter() - t_g
+                        global_stats.get("h2d_wait").add(h2d_dt)
+                        h2d_window += h2d_dt
+                        step_fn = self._dispatch_step(feed)
+                        t_step = time.perf_counter()
+                        with timer("train_step"):
+                            (self.parameters, self.opt_state, self.model_state,
+                             cost, extras) = step_fn(
+                                self.parameters, self.opt_state, self.model_state,
+                                feed, step_rng)
+                        # per-step distribution (BarrierStat skew-profiling role):
+                        # record this step's own delta, not the cumulative timer
+                        from paddle_tpu.utils.stats import step_histogram
+                        step_dt = time.perf_counter() - t_step
+                        step_histogram.add(step_dt)
+                        cost_sum = cost_sum + cost
+                        if self._multiprocess and len(skew_window) < 10000:
+                            # consumed by the PASS-END cross-rank report (a
+                            # collective can only live where every rank is
+                            # guaranteed to arrive); bounded like step_histogram
+                            skew_window.append(step_dt)
+                        n_batches += 1
+                        if log_period:      # only the log line consumes it;
+                            window.append(cost)  # log_period=0 must not pin
+                        if self.evaluators:      # a device scalar per batch
+                            update_evaluators(extras, feed)
+                        if log_period and (batch_id + 1) % log_period == 0:
+                            c = float(jnp.mean(jnp.stack(window)))
+                            window = []
+                            dt = (time.time() - t0) / log_period
+                            logger.info("Pass %d Batch %d Cost %.5f (%.1f ms/batch"
+                                        " h2d_wait=%.2fms)%s",
+                                        pass_id, batch_id + 1, c, dt * 1e3,
+                                        h2d_window / log_period * 1e3,
+                                        eval_log_suffix())
+                            h2d_window = 0.0
+                            t0 = time.time()
+                        if (show_parameter_stats_period
+                                and (batch_id + 1) % show_parameter_stats_period == 0):
+                            self.log_parameter_stats()
+                        event_handler(events.EndIteration(
+                            pass_id, batch_id, cost=cost,
+                            evaluator_results={f"extra_{i}": e
+                                               for i, e in enumerate(extras)}))
+                        if self._stop_signal is not None:
+                            break
+                finally:
+                    if prefetcher is not None:
+                        prefetcher.close()
+                sync_safe = True
+                if self._multiprocess:
+                    # pass end is the ONE point every rank reaches no
+                    # matter how many batches its reader produced, so the
+                    # cross-rank collectives live here: first the
+                    # equal-progress guard (unequal batch counts raise a
+                    # ConfigError instead of deadlocking the job), then
+                    # the straggler/skew report (reference BarrierStat).
+                    # On SIGTERM a rank still participates but marks its
+                    # count preempted: signal delivery is not
+                    # synchronized across ranks, so unequal counts are
+                    # expected then, a silently-skipping rank would
+                    # strand the others at the barrier, and the
+                    # preemption checkpoint below must still run.  A
+                    # preempted peer also means WE must stop after this
+                    # pass — it will not join the next pass's collectives
+                    from paddle_tpu.parallel.distributed import (
+                        check_equal_progress, step_skew_report)
+                    common, preempted = check_equal_progress(
+                        n_batches, name=f"pass {pass_id}",
+                        timeout_s=progress_timeout_s,
+                        skip=self._stop_signal is not None)
+                    # common=None: counts diverged (preempted mid-step
+                    # skew) — a rank dispatched steps whose collectives
+                    # will never complete, so ANY host sync on device
+                    # values (pass cost, skew report, checkpoint gather)
+                    # could hang; skip them all, consistently on every
+                    # rank (all ranks see the same counts)
+                    sync_safe = common is not None
+                    if not preempted:
+                        self.last_skew_report = step_skew_report(skew_window)
+                    elif self._stop_signal is None:
+                        import signal as _sig
+                        logger.warning(
+                            "a peer rank was preempted; stopping after "
+                            "pass %d too (continuing would wedge on its "
+                            "missing collectives)", pass_id)
+                        self._stop_signal = int(_sig.SIGTERM)
+                # sync_safe=False: evaluator results are device scalars from
+                # the same possibly-wedged steps as cost_sum — no host syncs
+                pass_cost = (float(cost_sum) / n_batches
+                             if n_batches and sync_safe else float("nan"))
                 logger.info("Pass %d done, mean cost %.5f%s", pass_id, pass_cost,
-                            eval_log_suffix())
+                            eval_log_suffix() if sync_safe else "")
                 # per-pass step-time distribution (the BarrierStat successor:
                 # in synchronous SPMD the skew diagnostic is p99/p50 spread)
                 from paddle_tpu.utils.stats import step_histogram
@@ -600,15 +805,28 @@ class SGD:
                     tc = self.test(test_reader, feeding=feeder)
                     event_handler(events.EndTesting(pass_id, tc))
                 if save_dir and self._stop_signal is not None:
-                    # preemption checkpoint: blocking (the process is about to
-                    # be reaped — there may be no later sync point)
-                    path = self.save(save_dir, pass_id,
-                                     save_only_one=save_only_one, block=True,
-                                     extra={"preempted": True,
-                                            "signal": int(self._stop_signal)})
-                    if path:
-                        logger.info("preemption checkpoint %s; stopping after "
-                                    "pass %d", path, pass_id)
+                    if not sync_safe:
+                        # parameters depend on dispatched steps whose
+                        # collectives will never complete — the gather
+                        # inside save() would hang, not checkpoint
+                        logger.warning(
+                            "preempted with unequal per-rank batch counts; "
+                            "device state is unrecoverable — SKIPPING the "
+                            "preemption checkpoint (last periodic "
+                            "checkpoint remains the restart point)")
+                    else:
+                        # preemption checkpoint: blocking (the process is
+                        # about to be reaped — there may be no later sync
+                        # point)
+                        path = self.save(save_dir, pass_id,
+                                         save_only_one=save_only_one,
+                                         block=True,
+                                         extra={"preempted": True,
+                                                "signal":
+                                                int(self._stop_signal)})
+                        if path:
+                            logger.info("preemption checkpoint %s; stopping "
+                                        "after pass %d", path, pass_id)
                 elif save_dir and (pass_id + 1) % saving_period == 0:
                     # single-process saves overlap the disk write with the
                     # next pass (the snapshot itself is taken synchronously);
@@ -655,7 +873,7 @@ class SGD:
             self._build_step(feed)
         feed, step_rng = self._globalize_step_inputs(feed, step_rng)
         (self.parameters, self.opt_state, self.model_state,
-         cost, _extras) = self._step_fn(
+         cost, _extras) = self._dispatch_step(feed)(
             self.parameters, self.opt_state, self.model_state,
             feed, step_rng)
         return cost
@@ -842,6 +1060,7 @@ class SGD:
         self.parameters = param_hooks.apply_masks(
             self.parameters, self._prune_masks)
         self._step_fn = None
+        self._compiled = {}     # AOT executables hold the old masks too
 
     def log_layer_stats(self, feed):
         """Per-layer output abs-mean/abs-max on one batch (reference
@@ -921,3 +1140,8 @@ class Inferencer:
 
 def infer(output_layer, parameters, input, feeding=None):
     return Inferencer(output_layer, parameters).infer(input, feeding=feeding)
+
+
+# the modern name for the training driver (SGD is the v2-compat spelling):
+# Trainer.train(prefetch=...), Trainer.precompile(...) read naturally
+Trainer = SGD

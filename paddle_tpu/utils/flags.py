@@ -71,8 +71,16 @@ class Flags:
     beam_size: int = 1
 
     # ---- data
-    async_load_data: bool = True    # reference DoubleBuffer
+    async_load_data: bool = True    # reference DoubleBuffer on/off; with
+    #                                 prefetch_depth, the CLI default for
+    #                                 --prefetch (SGD.train(prefetch=N),
+    #                                 data/prefetch.py device pipeline)
     prefetch_depth: int = 2
+    # opt-in persistent XLA compilation cache: compiled step executables
+    # (incl. SGD.precompile's per-bucket programs) are written here and
+    # reused across process restarts — the AOT warm-up then costs a disk
+    # read instead of a compile.  None = off (JAX default).
+    jax_compilation_cache_dir: Optional[str] = None
 
     # ---- observability (new floor; reference had host timers only)
     profile_dir: Optional[str] = None   # capture an xprof trace of training
@@ -102,7 +110,8 @@ class Flags:
                 parser.add_argument(name, type=str, default=None)
 
     def apply(self):
-        """Push flag values into the runtime (dtype policy, debug_nans)."""
+        """Push flag values into the runtime (dtype policy, debug_nans,
+        persistent compilation cache)."""
         from paddle_tpu.core import dtypes
         import jax
         dtypes.set_policy(self.dtype,
@@ -110,6 +119,21 @@ class Flags:
                           else self.compute_dtype)
         if self.debug_nans:
             jax.config.update("jax_debug_nans", True)
+        if self.jax_compilation_cache_dir:
+            set_compilation_cache_dir(self.jax_compilation_cache_dir)
+
+
+def set_compilation_cache_dir(path):
+    """Wire the opt-in persistent XLA compilation cache (docs/
+    input_pipeline.md).  min_compile_time is dropped to 0 so every bucket
+    executable persists, not just the slow ones — the whole point is a
+    cold process skipping ALL bucket compiles."""
+    import jax
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except AttributeError:      # older jax: the dir alone still works
+        pass
 
 
 # Reference flags with no runtime role here, and why — the lookup table for

@@ -43,13 +43,24 @@ def _parse_header(path):
 
 def read_param(path, with_header=False):
     """-> flat np array (f32 or f64 per the file's float_size); with
-    with_header=True, (array, (version, float_size))."""
+    with_header=True, (array, (version, float_size)).
+
+    version != 0 is REJECTED, mirroring the reference's
+    Parameter.cpp CHECK (every shipped model writes version 0) — a
+    nonzero version means either corruption or a format this reader does
+    not understand, and silently accepting it would misinterpret the
+    body."""
     parsed = _parse_header(path)
     if parsed is None:
         raise ValueError(
             f"{path}: no reference Parameter header (16 bytes: version "
             "i32, float_size i32 in {{4,8}}, count i64)")
     version, float_size, count = parsed
+    if version != 0:
+        raise ValueError(
+            f"{path}: Parameter version {version} unsupported (the "
+            "reference CHECKs version == 0 in every shipped file; a "
+            "nonzero value here is corruption or a different format)")
     dt = np.float32 if float_size == 4 else np.float64
     with open(path, "rb") as f:
         f.seek(_HEADER.size)
@@ -110,8 +121,9 @@ def load_pass_dir(pass_dir):
     """Reference checkpoint dir (pass-%05d/ with one binary file per
     parameter) -> {param_name: flat array}.  Entries WITHOUT a parseable
     reference header (done markers, subdirs) are skipped; a file that
-    carries the header but fails to read (truncated body) RAISES — a
-    silently dropped param would fall back to random init downstream."""
+    carries the header but fails to read (truncated body, version != 0)
+    RAISES — a silently dropped param would fall back to random init
+    downstream."""
     out = {}
     for name in sorted(os.listdir(pass_dir)):
         p = os.path.join(pass_dir, name)

@@ -25,13 +25,22 @@ def _leaf_paths(tree, prefix=()):
 
 
 def from_torch_state_dict(params, state_dict, mapping=None,
-                          transpose_linear=True):
+                          transpose_linear=True, always_transpose=()):
     """Return a copy of `params` with values taken from the torch
     state_dict.  Shapes must match exactly (after the optional [out,in] ->
-    [in,out] linear transposition)."""
+    [in,out] linear transposition).
+
+    always_transpose: param paths ('a/b/c') whose 2-D tensors are KNOWN
+    torch nn.Linear kernels and transpose unconditionally.  Shape-driven
+    transposition cannot decide SQUARE 2-D tensors (arr.T.shape ==
+    arr.shape), so those import as-is with a loud warning unless listed
+    here — a silently untransposed square linear is the classic
+    wrong-numerics import."""
     import copy
     import jax.numpy as jnp
+    from paddle_tpu.utils.logging import logger
     out = copy.deepcopy(params)
+    always_transpose = set(always_transpose)
 
     def to_np(t):
         return t.detach().cpu().numpy() if hasattr(t, "detach") \
@@ -50,13 +59,25 @@ def from_torch_state_dict(params, state_dict, mapping=None,
 
     for path, tensor in items:
         arr = to_np(tensor)
+        path_s = "/".join(path)
         target = out
         for p in path[:-1]:
             target = target[p]
         cur = np.asarray(target[path[-1]])
-        if arr.shape != cur.shape and transpose_linear and arr.ndim == 2 \
+        if path_s in always_transpose and arr.ndim == 2:
+            arr = arr.T
+        elif arr.shape != cur.shape and transpose_linear and arr.ndim == 2 \
                 and arr.T.shape == cur.shape:
             arr = arr.T
+        elif (transpose_linear and arr.ndim == 2
+              and arr.shape == cur.shape
+              and arr.shape[0] == arr.shape[1]):
+            logger.warning(
+                "torch import: %s is a SQUARE 2-D tensor %s — shape alone "
+                "cannot tell torch's [out, in] from our [in, out], so it "
+                "is imported AS-IS; if it is an nn.Linear kernel, pass "
+                "always_transpose={%r} (wrong layout = silently wrong "
+                "numerics)", path_s, arr.shape, path_s)
         if arr.shape != cur.shape and arr.ndim == 4 \
                 and arr.transpose(2, 3, 1, 0).shape == cur.shape:
             # torch conv [out, in, kh, kw] -> NHWC kernel [kh, kw, in, out]
@@ -68,12 +89,21 @@ def from_torch_state_dict(params, state_dict, mapping=None,
     return out
 
 
+# known nn.Linear kernels in the torchvision ResNet mapping: the fc head
+# is [out, in] in torch and [in, out] here and must ALWAYS transpose —
+# when num_classes happens to equal the feature width (square tensor),
+# shape-driven transposition cannot decide and would import it wrong
+RESNET_ALWAYS_TRANSPOSE = frozenset({"head/w"})
+
+
 def resnet_mapping(depth=50):
     """Key maps from models/resnet.py's ImageNet pytree to torchvision's
     state_dict convention (conv1/bn1, layer{1-4}.{i}.conv{1-3}/bn{1-3}/
     downsample.{0,1}, fc).  Returns (param_mapping, state_mapping):
     param_mapping feeds from_torch_state_dict on the params pytree,
-    state_mapping on the BN-running-stats state pytree."""
+    state_mapping on the BN-running-stats state pytree.  Pair with
+    always_transpose=RESNET_ALWAYS_TRANSPOSE (the fc head is a known
+    linear; import_torchvision_resnet wires it)."""
     table = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
     if depth not in table:
         raise ValueError(
@@ -118,6 +148,7 @@ def import_torchvision_resnet(state_dict, depth=50, num_classes=None):
     params, state = resnet.init(jax.random.PRNGKey(0), depth=depth,
                                 num_classes=num_classes)
     pm, sm = resnet_mapping(depth)
-    params = from_torch_state_dict(params, state_dict, mapping=pm)
+    params = from_torch_state_dict(params, state_dict, mapping=pm,
+                                   always_transpose=RESNET_ALWAYS_TRANSPOSE)
     state = from_torch_state_dict(state, state_dict, mapping=sm)
     return params, state

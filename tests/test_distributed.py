@@ -335,3 +335,78 @@ def test_pipeline_across_processes(tmp_path):
     assert two[0]["loss"] == pytest.approx(float(loss), rel=1e-4)
     checksum = float(sum(jnp.sum(jnp.abs(v)) for v in w + b))
     assert two[0]["checksum"] == pytest.approx(checksum, rel=1e-4)
+
+
+def test_check_equal_progress_kv_path(monkeypatch):
+    """The pass-end equal-progress guard gathers counts over the
+    coordination service's HOST-side KV store (no device collective — a
+    skewed rank's wedged device queue cannot block it): equal counts pass
+    and clean up their keys, unequal counts raise ConfigError naming
+    every rank."""
+    import jax
+    from jax._src import distributed as _dist
+    from paddle_tpu.parallel import distributed as D
+    from paddle_tpu.utils.error import ConfigError
+
+    class FakeClient:
+        def __init__(self):
+            self.store = {}
+            self.barriers = []
+
+        def key_value_set(self, k, v):
+            assert k not in self.store, f"stale key reused: {k}"
+            self.store[k] = v
+
+        def blocking_key_value_get(self, k, timeout_ms):
+            return self.store[k]
+
+        def wait_at_barrier(self, b, timeout_ms):
+            self.barriers.append(b)
+
+        def key_value_delete(self, k):
+            self.store.pop(k, None)
+
+    fake = FakeClient()
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setattr(_dist.global_state, "client", fake, raising=False)
+
+    # equal counts: pre-populate rank 1's key as its process would have
+    seq = D._progress_seq[0]
+    fake.store[f"paddle_tpu/eqprog/{seq}/r1"] = "5"
+    assert D.check_equal_progress(5, name="pass 0") == (5, False)
+    # arrival + cleanup barriers ran, own key deleted
+    assert fake.barriers == [f"paddle_tpu/eqprog/{seq}/barrier",
+                             f"paddle_tpu/eqprog/{seq}/done"]
+    assert f"paddle_tpu/eqprog/{seq}/r0" not in fake.store
+
+    # unequal counts: hard ConfigError naming each rank's count
+    seq = D._progress_seq[0]
+    fake.store[f"paddle_tpu/eqprog/{seq}/r1"] = "7"
+    with pytest.raises(ConfigError, match=r"r0=5 r1=7"):
+        D.check_equal_progress(5, name="pass 1")
+
+    # preempted rank (skip=True) still participates, marking its count
+    # -(n+1): unequal decoded counts do NOT raise — every rank gets
+    # (None, True) and consistently skips follow-up device syncs
+    seq = D._progress_seq[0]
+    fake.store[f"paddle_tpu/eqprog/{seq}/r1"] = "9"
+    assert D.check_equal_progress(5, name="pass 2",
+                                  skip=True) == (None, True)
+    # mirror: this rank finished, the OTHER rank was preempted at 3
+    seq = D._progress_seq[0]
+    fake.store[f"paddle_tpu/eqprog/{seq}/r1"] = "-4"
+    assert D.check_equal_progress(5, name="pass 3") == (None, True)
+    # preempted but EQUAL counts (cluster-wide SIGTERM between batches):
+    # device queues are sound — common count comes back, syncs are safe
+    seq = D._progress_seq[0]
+    fake.store[f"paddle_tpu/eqprog/{seq}/r1"] = "5"
+    assert D.check_equal_progress(5, name="pass 4",
+                                  skip=True) == (5, True)
+
+    # single process: no client interaction at all
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    n_keys = len(fake.store)
+    assert D.check_equal_progress(3) == (3, False)
+    assert D.check_equal_progress(3, skip=True) == (3, True)
+    assert len(fake.store) == n_keys

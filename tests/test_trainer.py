@@ -579,6 +579,140 @@ def test_cli_time_job(tmp_path, capsys):
     assert "p99=" not in out
 
 
+def test_train_prefetch_bit_identical():
+    """train(prefetch=2) — feeder conversion + H2D on the background
+    pipeline thread, donation active (donate defaults True) — produces
+    BIT-identical parameters to the synchronous prefetch=0 loop: same
+    batches, same order, same rng stream, donation-safe buffers."""
+    from paddle_tpu.utils.stats import global_stats
+
+    def build():
+        reset_names()
+        x = L.data_layer("x", size=2)
+        lab = L.data_layer("lab", size=1)
+        h = L.fc_layer(x, size=8, act="tanh")
+        y = L.fc_layer(h, size=2, act="softmax")
+        cost = L.classification_cost(y, lab)
+        return SGD(cost=cost, update_equation=optim.Adam(learning_rate=0.05))
+
+    feeding = {"x": dense_vector(2), "lab": integer_value(2)}
+    sync = build()
+    sync.train(_xor_reader(n=128), num_passes=3, feeding=feeding,
+               log_period=0, buffered_batches=0, prefetch=0)
+    global_stats.get("h2d_wait").reset()
+    over = build()
+    over.train(_xor_reader(n=128), num_passes=3, feeding=feeding,
+               log_period=0, buffered_batches=0, prefetch=2)
+    # the overlap is observable: every batch passed through the counter
+    assert global_stats.get("h2d_wait").count == 3 * 4
+    for k in sync.parameters:
+        for kk in sync.parameters[k]:
+            np.testing.assert_array_equal(
+                np.asarray(over.parameters[k][kk]),
+                np.asarray(sync.parameters[k][kk]),
+                err_msg=f"{k}/{kk}: prefetch=2 diverged from prefetch=0")
+
+
+def test_train_prefetch_propagates_reader_error():
+    """A reader blowing up mid-pass surfaces in train() (producer-thread
+    failure crosses into the training thread), and the pipeline shuts
+    down instead of leaking its thread."""
+    import threading
+    import pytest
+
+    def bad_reader():
+        yield [(np.zeros(2, np.float32), 0) for _ in range(8)]
+        raise RuntimeError("reader died")
+
+    reset_names()
+    x = L.data_layer("x", size=2)
+    lab = L.data_layer("lab", size=1)
+    y = L.fc_layer(x, size=2, act="softmax")
+    cost = L.classification_cost(y, lab)
+    tr = SGD(cost=cost, update_equation=optim.Momentum(learning_rate=0.1))
+    with pytest.raises(RuntimeError, match="reader died"):
+        tr.train(lambda: bad_reader(), num_passes=1, log_period=0,
+                 buffered_batches=0, prefetch=2,
+                 feeding={"x": dense_vector(2), "lab": integer_value(2)})
+    assert not [t for t in threading.enumerate()
+                if t.name == "paddle-tpu-prefetch" and t.is_alive()]
+
+
+def _bucketed_seq_data(n=48, batch=16, seed=0):
+    """Variable-length id sequences: batch 0's lengths stay <= 8 (lands
+    on the 8-bucket), later batches reach 15 (the 16-bucket) — both
+    precompiled shapes are genuinely exercised."""
+    rng = np.random.RandomState(seed)
+    lens = [int(rng.randint(3, 9)) if i < batch else int(rng.randint(9, 16))
+            for i in range(n)]
+    samples = [([int(v) for v in rng.randint(0, 20, ln)], int(i % 2))
+               for i, ln in enumerate(lens)]
+
+    def reader():
+        for i in range(0, n, batch):
+            yield samples[i:i + batch]
+    return reader
+
+
+def test_feed_specs_cross_product_multi_seq():
+    """__call__ buckets every sequence slot independently, so feed_specs
+    must cover the full bounds cross-product: a seq2seq batch with short
+    sources and long targets still hits a precompiled shape."""
+    from paddle_tpu.data import integer_value_sequence
+
+    feeder = DataFeeder({"src": integer_value_sequence(10),
+                         "tgt": integer_value_sequence(10),
+                         "lbl": integer_value(2)},
+                        bucket_bounds=[8, 16], pad_batch_to=4)
+    specs = feeder.feed_specs(4)
+    assert len(specs) == 4                        # 2 bounds ** 2 slots
+    shapes = {(s["src"].data.shape[1], s["tgt"].data.shape[1])
+              for s in specs}
+    assert shapes == {(8, 8), (8, 16), (16, 8), (16, 16)}
+    assert all(s["lbl"].shape == (4,) for s in specs)
+
+
+def test_precompile_buckets_no_retrace():
+    """Trainer.precompile compiles ONE executable per bucket feed spec
+    (DataFeeder.feed_specs), and a subsequent train() over those buckets
+    dispatches to them without a single new trace — the trace-count hook
+    (SGD.trace_count only increments inside the step's Python body, i.e.
+    under tracing) is the assertable no-retrace guarantee."""
+    from paddle_tpu.data import integer_value_sequence
+    from paddle_tpu.trainer import Trainer        # = SGD, modern spelling
+
+    reset_names()
+    w = L.data_layer("w", size=20)
+    lbl = L.data_layer("lbl", size=2)
+    emb = L.embedding_layer(w, size=6)
+    p = L.pooling_layer(emb, pooling_type="sum")
+    out = L.fc_layer(p, size=2, act="softmax")
+    cost = L.classification_cost(out, lbl)
+    tr = Trainer(cost=cost,
+                 update_equation=optim.Momentum(learning_rate=0.1))
+
+    batch, bounds = 16, [8, 16]
+    feeder = DataFeeder({"w": integer_value_sequence(20),
+                         "lbl": integer_value(2)},
+                        bucket_bounds=bounds, pad_batch_to=batch)
+    specs = feeder.feed_specs(batch)
+    assert len(specs) == 2                        # one per bucket
+    assert tr.precompile(specs) == 2
+    assert tr.precompile(specs) == 0              # idempotent: all cached
+    traced = tr.trace_count
+    assert traced >= 2
+
+    reader = _bucketed_seq_data(batch=batch)
+    tr.train(reader, num_passes=2, feeding=feeder, log_period=0,
+             buffered_batches=0)
+    assert tr.trace_count == traced, (
+        "train() over precompiled buckets traced the step again")
+    # and the precompiled path trains for real with prefetch too
+    tr.train(reader, num_passes=1, feeding=feeder, log_period=0,
+             buffered_batches=0, prefetch=2)
+    assert tr.trace_count == traced
+
+
 def test_cli_time_job_percentiles(tmp_path, capsys):
     conf = tmp_path / "conf.py"
     _write_tiny_conf(conf, n_samples=816)          # 102 batches of 8
