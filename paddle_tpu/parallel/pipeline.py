@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu.parallel.mesh import AXIS_STAGE
+from paddle_tpu.parallel.sharding import shard_map
 
 
 def stack_stages(params_list):
@@ -133,7 +134,7 @@ def gpipe(stage_fn, stacked_params, x_mb, *, mesh: Mesh,
     ospec = jax.tree_util.tree_map(
         lambda _: (P(axis_name, None, data_axis) if data_axis
                    else P(axis_name)), x_mb)
-    run = jax.shard_map(local_fn, mesh=mesh, in_specs=(pspec, xspec),
+    run = shard_map(local_fn, mesh=mesh, in_specs=(pspec, xspec),
                         out_specs=ospec, check_vma=False)
     stacked = run(stacked_params, x_mb)     # [S, T, mb, ...]
     # last stage (index S-1) drains microbatch i at tick i + S - 1

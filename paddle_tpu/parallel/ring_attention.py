@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from paddle_tpu.parallel.sharding import shard_map
+
 _NEG = -1e30
 
 
@@ -146,7 +148,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
         (q.shape[0], q.shape[2]), jnp.float32)
     kvm = kv_mask if kv_mask is not None else jnp.ones(
         (k.shape[0], k.shape[2]), jnp.float32)
-    fn = jax.shard_map(local_fn, mesh=mesh,
+    fn = shard_map(local_fn, mesh=mesh,
                        in_specs=(spec, spec, spec, mspec, mspec,
                                  mspec, mspec),
                        out_specs=spec, check_vma=False)
@@ -298,7 +300,7 @@ def ring_attention_zigzag(q, k, v, mesh: Mesh, axis_name="seq",
         (q.shape[0], q.shape[2]), jnp.float32)
     kvm = kv_mask if kv_mask is not None else jnp.ones(
         (k.shape[0], k.shape[2]), jnp.float32)
-    fn = jax.shard_map(local_fn, mesh=mesh,
+    fn = shard_map(local_fn, mesh=mesh,
                        in_specs=(spec, spec, spec, mspec, mspec,
                                  mspec, mspec),
                        out_specs=spec, check_vma=False)
@@ -329,6 +331,6 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
         return reshard_bwd(out)
 
     spec = P(None, None, axis_name, None)
-    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)

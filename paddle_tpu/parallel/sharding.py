@@ -27,6 +27,31 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL
 
+# version-compat shard_map: jax >= 0.5 promotes it to jax.shard_map, jax
+# 0.4.x keeps it in the experimental namespace.  Call sites here use the
+# NEW kwarg name (check_vma); whether the resolved function takes it is a
+# separate axis from where it lives (the promotion and the check_rep ->
+# check_vma rename were different releases), so translate by signature.
+try:
+    _shard_map_impl = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+try:
+    import inspect
+    _SM_TAKES_VMA = ("check_vma"
+                     in inspect.signature(_shard_map_impl).parameters)
+except (TypeError, ValueError):         # uninspectable wrapper: assume new
+    _SM_TAKES_VMA = True
+
+if _SM_TAKES_VMA:
+    shard_map = _shard_map_impl
+else:
+    def shard_map(f, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_impl(f, **kwargs)
+
 
 def _path_str(path):
     parts = []

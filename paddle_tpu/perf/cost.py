@@ -1,0 +1,88 @@
+"""Extract XLA's cost model + an HLO op histogram from a compiled step.
+
+Works on any backend: `jax.jit(step).lower(*args).compile()` never
+executes the program, so the CPU backend yields the structural numbers
+(FLOPs, bytes accessed, op mix, fusion count) even when the TPU is
+wedged.  The histogram is parsed from the post-optimization HLO text —
+the same program XLA would schedule — so a change that de-fuses a kernel
+or splits a matmul shows up as op-count / bytes deltas here before any
+chip ever times it.
+"""
+
+import collections
+import re
+
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%\S+ = (.*)$")
+# op name = first bare identifier followed by '(' after the result type.
+# Non-tuple types ("f32[128,512]{1,0}") are one whitespace-free token;
+# tuple types start with '(' and are skipped by paren balancing below.
+_OP_RE = re.compile(r"^\S*\s+([a-z][a-z0-9\-]*)\(")
+
+# bookkeeping pseudo-ops: structurally meaningless for a regression diff
+# (parameter count changes with donation plumbing, constants with literal
+# folding) — kept OUT of the histogram so diffs track real work.
+_SKIP_OPS = frozenset({"parameter", "constant"})
+
+
+def _op_of(rhs):
+    """HLO opcode of one instruction's right-hand side."""
+    if rhs.startswith("("):          # tuple-typed result: skip balanced ()
+        depth = 0
+        for i, ch in enumerate(rhs):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    rhs = rhs[i + 1:].lstrip()
+                    break
+        m = re.match(r"([a-z][a-z0-9\-]*)\(", rhs)
+        return m.group(1) if m else None
+    m = _OP_RE.match(rhs)
+    return m.group(1) if m else None
+
+
+def op_histogram(hlo_text):
+    """{opcode: count} over every instruction in the HLO module text."""
+    hist = collections.Counter()
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        op = _op_of(m.group(1))
+        if op and op not in _SKIP_OPS:
+            hist[op] += 1
+    return dict(sorted(hist.items()))
+
+
+def normalize_cost_analysis(ca):
+    """compiled.cost_analysis() returns a dict or a 1-list of dicts
+    depending on jax version; normalize to one flat dict."""
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+def extract(compiled):
+    """Structural cost record for one compiled executable.
+
+    Keys: flops, bytes_accessed, transcendentals, arithmetic_intensity,
+    hlo_op_histogram, hlo_op_total, fusion_count, dot_count,
+    convolution_count.  All pure numbers / plain dicts — JSON-ready.
+    """
+    ca = normalize_cost_analysis(compiled.cost_analysis())
+    hist = op_histogram(compiled.as_text())
+    flops = float(ca.get("flops", 0.0))
+    bytes_accessed = float(ca.get("bytes accessed", 0.0))
+    return {
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "transcendentals": float(ca.get("transcendentals", 0.0)),
+        "arithmetic_intensity": (flops / bytes_accessed)
+        if bytes_accessed else None,
+        "hlo_op_histogram": hist,
+        "hlo_op_total": sum(hist.values()),
+        "fusion_count": hist.get("fusion", 0),
+        "dot_count": hist.get("dot", 0),
+        "convolution_count": hist.get("convolution", 0),
+    }

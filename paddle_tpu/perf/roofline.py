@@ -1,0 +1,74 @@
+"""Roofline model: (FLOPs, bytes accessed) -> predicted step time / MFU.
+
+The classic two-ceiling roofline (Williams et al.): a step whose
+arithmetic intensity (FLOPs per HBM byte) sits below the chip's ridge
+point is bandwidth-bound, above it compute-bound; predicted time is
+
+    t = max(flops / peak_flops, bytes / hbm_bw)
+
+and predicted MFU = (flops / peak_flops) / t = min(1, intensity/ridge).
+This is an UPPER BOUND on achievable MFU — it assumes perfect overlap of
+compute and HBM traffic and ignores per-step dispatch overhead, so tiny
+steps (SmallNet at 2 ms/batch) will measure well below their prediction.
+The bytes input comes from XLA's cost model on whatever backend compiled
+the program (the CPU backend in the no-chip-window case), so it reflects
+f32 traffic unless the program itself casts; on TPU the auto bf16 policy
+roughly halves matmul operand bytes — the prediction is conservative for
+bandwidth-bound families.
+
+Spec sources: public TPU system spec sheets / the jax-ml scaling book;
+the v5e peak matches bench.py's `_PEAK_TFLOPS` table so measured MFU and
+predicted MFU share a denominator.
+"""
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    name: str
+    peak_flops: float       # dense bf16 FLOP/s (f32 for the cpu row)
+    hbm_bytes_per_s: float  # HBM (DRAM for cpu) bandwidth, bytes/s
+
+    @property
+    def ridge_intensity(self):
+        """FLOPs/byte where the roofline's two ceilings meet."""
+        return self.peak_flops / self.hbm_bytes_per_s
+
+
+# Keyed by the short names the snapshot JSON uses.  The cpu row is a
+# sanity anchor only (one NUMA node, AVX-512 class) — wall-clock on the
+# shared CI hosts is far noisier than the TPU rows.
+SPECS = {
+    "v5e": ChipSpec("TPU v5e", 197e12, 819e9),
+    "v5p": ChipSpec("TPU v5p", 459e12, 2765e9),
+    "v4": ChipSpec("TPU v4", 275e12, 1228e9),
+    "cpu": ChipSpec("cpu (sanity anchor)", 1e11, 50e9),
+}
+
+
+def predict(flops, bytes_accessed, spec):
+    """Roofline prediction for one compiled step on one chip spec.
+
+    Returns a dict with compute_ms / memory_ms (the two ceilings),
+    predicted_ms (their max), predicted_mfu, the step's arithmetic
+    intensity vs the chip's ridge point, and the named bottleneck.
+    """
+    if isinstance(spec, str):
+        spec = SPECS[spec]
+    if flops < 0 or bytes_accessed < 0:
+        raise ValueError("flops/bytes_accessed must be non-negative")
+    compute_s = flops / spec.peak_flops
+    memory_s = bytes_accessed / spec.hbm_bytes_per_s
+    t = max(compute_s, memory_s)
+    intensity = (flops / bytes_accessed) if bytes_accessed else float("inf")
+    return {
+        "chip": spec.name,
+        "compute_ms": compute_s * 1e3,
+        "memory_ms": memory_s * 1e3,
+        "predicted_ms": t * 1e3,
+        "predicted_mfu": (compute_s / t) if t > 0 else 0.0,
+        "arithmetic_intensity": intensity,
+        "ridge_intensity": spec.ridge_intensity,
+        "bottleneck": "compute" if compute_s >= memory_s else "memory",
+    }

@@ -11,6 +11,9 @@ whole table and the round-end bench replays it from cache.
 Usage:
   python -m paddle_tpu.scripts.bench_sweep [--combos m:b,m:b,...]
       [--steps N] [--timeout S]
+  python -m paddle_tpu.scripts.bench_sweep --analytic
+      (chip-independent: write the analytic cost/roofline snapshot on the
+      CPU backend instead of running live combos — see paddle_tpu/perf/)
 Default combos cover the BASELINE.md families at their reference batch
 plus the TPU scaling points.
 """
@@ -75,6 +78,8 @@ DEFAULT_COMBOS = [
     "resnet50:256", "resnet50:512", "resnet50:1024",
     "googlenet:256", "googlenet:512",
     "lstm1280:256",
+    "lstm2048:64",                                # MXU-scale recurrent row
+    "transformer_packed_8k:2",                    # 8k-slot packed rows
     "transformer:32", "transformer:128",          # 128*256 = 32768 tok
     "transformer_long:2",                         # 8k-token sequences
     "transformer_packed:16",                      # padding-free packing
@@ -135,7 +140,21 @@ def main(argv=None):
     ap.add_argument("--combos", default=",".join(DEFAULT_COMBOS))
     ap.add_argument("--steps", type=int, default=None)
     ap.add_argument("--timeout", type=float, default=1500)
+    ap.add_argument("--analytic", action="store_true",
+                    help="run the chip-independent analytic snapshot "
+                         "(paddle_tpu.perf.analytic, CPU backend) instead "
+                         "of live combos — the no-chip-window fallback")
+    ap.add_argument("--analytic-out", default=None,
+                    help="snapshot path for --analytic (default: "
+                         "BENCH_ANALYTIC_r06.json at the repo root)")
     args = ap.parse_args(argv)
+
+    if args.analytic:
+        if _REPO not in sys.path:
+            sys.path.insert(0, _REPO)
+        from paddle_tpu.perf import analytic
+        return analytic.main(["--out", args.analytic_out]
+                             if args.analytic_out else [])
 
     try:
         skip_fresh_s = float(os.environ.get("BENCH_SWEEP_SKIP_FRESH_S", "0"))

@@ -6,12 +6,23 @@
 #
 #   bash paddle_tpu/scripts/healthy_window.sh [artifacts_dir]
 #
+# Dry-run mode (round-6; tests/test_healthy_window.py):
+#   HW_DRYRUN=1 bash paddle_tpu/scripts/healthy_window.sh [artifacts_dir]
+# executes every phase end-to-end on the CPU backend with smoke-scale
+# arguments and short timeouts, so the harness itself (paths, rcs, env
+# plumbing, resume markers) is debugged with ZERO chip-window minutes.
+# Dry runs never touch bench_cache.json (BENCH_NO_CACHE) nor the
+# committed analytic snapshot.
+#
 # Phases:
 #  1. bench.py --smoke-kernels          (Mosaic compile canary, ~minutes)
 #  2. bench_sweep                       (BASELINE rows + scaling column ->
 #                                        bench_cache.json)
 #  3. tpu_diff TPU dump + differential  (CPU-vs-TPU numerics evidence)
 #  4. nmt_scale                         (verbatim-config NMT row + golden)
+#  5. perf_report render
+#  6. analytic snapshot refresh         (chip-INDEPENDENT cost/roofline —
+#                                        last so it burns no window time)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -20,6 +31,36 @@ export PADDLE_TPU_BENCH_STRICT_RC=1
 # script, combos already measured live at this revision within a day are
 # not re-paid (bench_sweep skip-fresh)
 export BENCH_SWEEP_SKIP_FRESH_S="${BENCH_SWEEP_SKIP_FRESH_S:-86400}"
+
+DRY="${HW_DRYRUN:-0}"
+if [ "$DRY" = "1" ]; then
+    # smoke-scale everything: cpu backend, 2 timed steps, tiny model/
+    # stream shapes, one small tpu_diff case, 200-word NMT; no cache
+    # reads OR writes (a cpu dry run must neither replay committed TPU
+    # rows as success nor dirty them)
+    export BENCH_PLATFORM=cpu JAX_PLATFORMS=cpu
+    export BENCH_STEPS=2 BENCH_SERVING_TINY=1 BENCH_NO_CACHE=1
+    export BENCH_SWEEP_SKIP_FRESH_S=0
+    T_SMOKE=900; T_SWEEP=900; T_COL=600; T_DIFF=600; T_NMT=600
+    SWEEP_ARGS=(--combos "smallnet:8,trainer_prefetch:8" --steps 2)
+    SCAN_ARGS=(--combos "smallnet:8" --steps 2)
+    BF16_ARGS=(--combos "smallnet:8" --steps 2)
+    INT8_ARGS=(--combos "transformer_serving:4" --steps 2)
+    DIFF_CASES="embedding"
+    NMT_ARGS=(--vocab 200 --steps 4 --gen-sents 4 --beam 2 --max-gen-len 20)
+    ANALYTIC_FAMILIES="smallnet,trainer_prefetch"
+else
+    T_SMOKE=1200; T_SWEEP=14400; T_COL=3600; T_DIFF=7200; T_NMT=7200
+    SWEEP_ARGS=()
+    SCAN_ARGS=(--combos "lstm:64,lstm256:64,lstm1280:64,seq2seq:64")
+    BF16_ARGS=(--combos "resnet50:256,transformer:128,lstm:64,googlenet:256")
+    INT8_ARGS=(--combos "transformer_decode:32,transformer_serving:16")
+    DIFF_CASES=""
+    NMT_ARGS=(--vocab 30000 --steps 300 --gen-sents 32 --beam 5
+              --max-gen-len 50)
+    ANALYTIC_FAMILIES=""
+fi
+
 # every bench.py combo is a fresh subprocess; a shared persistent XLA
 # compile cache means only the FIRST run of each program pays the
 # tunnel-slow compile (the r4 window lost its first combo to exactly
@@ -34,12 +75,13 @@ unset JAX_COMPILATION_CACHE_DIR JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS
 # repo-root-relative (resolved after the cd below)
 if [ $# -ge 1 ]; then ART=$(realpath -m "$1"); else ART=""; fi
 cd "$(dirname "$0")/../.."
-ART="${ART:-$PWD/artifacts/r5}"
+ART="${ART:-$PWD/artifacts/r6}"
 mkdir -p "$ART"
 log() { echo "[healthy_window $(date -u +%H:%M:%S)] $*" >&2; }
+[ "$DRY" = "1" ] && log "DRY RUN: cpu backend, smoke-scale arguments"
 
 log "phase 1: pallas kernel smoke"
-timeout 1200 python bench.py --smoke-kernels \
+timeout "$T_SMOKE" python bench.py --smoke-kernels \
     > "$ART/smoke_kernels.json" 2> "$ART/smoke_kernels.log"
 log "smoke rc=$? -> $ART/smoke_kernels.json"
 
@@ -48,8 +90,8 @@ export JAX_COMPILATION_CACHE_DIR="$_JAX_CACHE_DIR"
 export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="$_JAX_CACHE_MIN"
 
 log "phase 2: bench sweep (BASELINE + scaling; per-combo xprof traces)"
-BENCH_PROFILE_BASE="$ART/xprof" timeout 14400 \
-    python -m paddle_tpu.scripts.bench_sweep \
+BENCH_PROFILE_BASE="$ART/xprof" timeout "$T_SWEEP" \
+    python -m paddle_tpu.scripts.bench_sweep "${SWEEP_ARGS[@]}" \
     > "$ART/bench_sweep.json" 2> "$ART/bench_sweep.log"
 log "sweep rc=$? (bench_cache.json updated)"
 python -m paddle_tpu.scripts.xprof_report "$ART/xprof" \
@@ -58,8 +100,8 @@ log "xprof attribution rc=$? -> $ART/xprof_report.{txt,json}"
 
 log "phase 2b: scan baselines for the fused-kernel vs-scan column"
 PADDLE_TPU_FUSED_RNN=0 BENCH_PROFILE_BASE="$ART/xprof_scan" \
-    timeout 3600 python -m paddle_tpu.scripts.bench_sweep \
-    --combos "lstm:64,lstm256:64,lstm1280:64,seq2seq:64" \
+    timeout "$T_COL" python -m paddle_tpu.scripts.bench_sweep \
+    "${SCAN_ARGS[@]}" \
     > "$ART/bench_scan_baselines.json" 2> "$ART/bench_scan_baselines.log"
 log "scan baselines rc=$? (cached under model@scan)"
 python -m paddle_tpu.scripts.xprof_report "$ART/xprof_scan" \
@@ -68,8 +110,8 @@ log "scan-trace attribution rc=$? (fused-vs-scan comparison inputs ready)"
 
 log "phase 2c: bf16 column for the MFU-critical families"
 BENCH_DTYPE=bfloat16 BENCH_PROFILE_BASE="$ART/xprof_bf16" \
-    timeout 3600 python -m paddle_tpu.scripts.bench_sweep \
-    --combos "resnet50:256,transformer:128,lstm:64,googlenet:256" \
+    timeout "$T_COL" python -m paddle_tpu.scripts.bench_sweep \
+    "${BF16_ARGS[@]}" \
     > "$ART/bench_bf16.json" 2> "$ART/bench_bf16.log"
 log "bf16 sweep rc=$? (cached under model@bsN@bfloat16)"
 python -m paddle_tpu.scripts.xprof_report "$ART/xprof_bf16" \
@@ -77,8 +119,8 @@ python -m paddle_tpu.scripts.xprof_report "$ART/xprof_bf16" \
 log "bf16-trace attribution rc=$?"
 
 log "phase 2d: int8 weight-only serving column (vs the bf16/f32 rows)"
-BENCH_QUANT=int8 timeout 3600 python -m paddle_tpu.scripts.bench_sweep \
-    --combos "transformer_decode:32,transformer_serving:16" \
+BENCH_QUANT=int8 timeout "$T_COL" python -m paddle_tpu.scripts.bench_sweep \
+    "${INT8_ARGS[@]}" \
     > "$ART/bench_int8.json" 2> "$ART/bench_int8.log"
 log "int8 sweep rc=$? (cached under model@int8)"
 
@@ -87,11 +129,11 @@ log "phase 3: TPU differential dump + compare"
 # Retry error/timeout records from earlier partial windows — a wedge
 # mid-group leaves TimeoutExpired records for its missing sub-cases
 export TPU_DIFF_RETRY_ERRORS=1
-timeout 7200 python -m paddle_tpu.testing.tpu_diff default \
-    "$ART/diff_tpu.npz" 2> "$ART/diff_tpu.log"
+timeout "$T_DIFF" python -m paddle_tpu.testing.tpu_diff default \
+    "$ART/diff_tpu.npz" $DIFF_CASES 2> "$ART/diff_tpu.log"
 log "tpu dump rc=$?"
-JAX_PLATFORMS=cpu timeout 3600 python -m paddle_tpu.testing.tpu_diff cpu \
-    "$ART/diff_cpu.npz" 2> "$ART/diff_cpu.log"
+JAX_PLATFORMS=cpu timeout "$T_COL" python -m paddle_tpu.testing.tpu_diff \
+    cpu "$ART/diff_cpu.npz" $DIFF_CASES 2> "$ART/diff_cpu.log"
 log "cpu dump rc=$?"
 PADDLE_TPU_DIFF="$ART/diff_cpu.npz:$ART/diff_tpu.npz" \
     python -m pytest tests/test_tpu_differential.py -q \
@@ -99,9 +141,8 @@ PADDLE_TPU_DIFF="$ART/diff_cpu.npz:$ART/diff_tpu.npz" \
 log "differential pytest rc=$? -> $ART/tpu_differential_pytest.log"
 
 log "phase 4: reference-scale NMT (verbatim configs, 30k vocab)"
-timeout 7200 python -m paddle_tpu.scripts.nmt_scale \
-    --out-dir "$ART/nmt" --vocab 30000 --steps 300 --gen-sents 32 \
-    --beam 5 --max-gen-len 50 \
+timeout "$T_NMT" python -m paddle_tpu.scripts.nmt_scale \
+    --out-dir "$ART/nmt" "${NMT_ARGS[@]}" \
     > "$ART/nmt_scale.json" 2> "$ART/nmt_scale.log"
 log "nmt rc=$? -> $ART/nmt_scale.json"
 
@@ -110,8 +151,23 @@ python -m paddle_tpu.scripts.perf_report > "$ART/perf_report.md" \
     2>> "$ART/perf_report.log" \
     && log "perf report -> $ART/perf_report.md" \
     || log "perf_report rc=$? (see $ART/perf_report.log)"
+
+log "phase 6: analytic cost/roofline snapshot (chip-independent, cpu)"
+# the dry run writes into ART (never the committed round snapshot); the
+# real window refreshes BENCH_ANALYTIC_r06.json at the repo root AFTER
+# the chip phases, so the snapshot never competes for window minutes
+if [ "$DRY" = "1" ]; then
+    timeout "$T_SWEEP" python bench.py --analytic \
+        --families "$ANALYTIC_FAMILIES" --out "$ART/analytic_snapshot.json" \
+        > "$ART/analytic.json" 2> "$ART/analytic.log"
+else
+    timeout 7200 python bench.py --analytic \
+        > "$ART/analytic.json" 2> "$ART/analytic.log"
+fi
+log "analytic rc=$? -> $ART/analytic.json"
+
 cat > "$ART/WINDOW_DONE" <<EOF2
-window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown)
+window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)
 bench_cache.json now holds the live rows; README's headline caveat and
 docs/perf.md's cached tables should be refreshed from perf_report.md.
 EOF2

@@ -495,19 +495,30 @@ class SGD:
             sig = _feed_signature(feed)
             if sig in self._compiled:
                 continue
-            if self._step_fn is None:
-                self._build_step(feed)
-            rng_spec = jax.ShapeDtypeStruct(np.shape(self.rng),
-                                            self.rng.dtype)
-            lowered = self._step_fn.lower(
-                self.parameters, self.opt_state, self.model_state, feed,
-                rng_spec)
-            self._compiled[sig] = lowered.compile()
+            self._compiled[sig] = self.lower_step(feed).compile()
             n_new += 1
         if n_new:
             logger.info("precompiled %d step executable(s) (%d cached)",
                         n_new, len(self._compiled))
         return n_new
+
+    def lower_step(self, feed_spec):
+        """Lower (not compile, never execute) the jitted train step for
+        one feed spec — the AOT building block behind ``precompile`` and
+        the hook the analytic perf layer (``paddle_tpu/perf``) uses to
+        read XLA's cost model for a trainer step without a device run.
+
+        feed_spec: one feed dict of concrete arrays or
+        ``jax.ShapeDtypeStruct`` leaves (``DataFeeder.feed_specs``
+        builds them).  Returns the ``jax.stages.Lowered``.
+        """
+        feed = _abstract_feed(feed_spec)
+        if self._step_fn is None:
+            self._build_step(feed)
+        rng_spec = jax.ShapeDtypeStruct(np.shape(self.rng), self.rng.dtype)
+        return self._step_fn.lower(
+            self.parameters, self.opt_state, self.model_state, feed,
+            rng_spec)
 
     def _dispatch_step(self, feed):
         """The executable for this feed shape: a precompiled bucket

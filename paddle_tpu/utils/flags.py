@@ -136,6 +136,108 @@ def set_compilation_cache_dir(path):
         pass
 
 
+# Per-flag documentation: {field name: (help, reference cmd_parameter
+# equivalent or "—" for TPU-native flags with no reference twin)}.
+# docs/flags.md's flag-reference table is GENERATED from this dict +
+# the dataclass defaults (`python -m paddle_tpu.utils.flags`), and
+# tests/test_flags_doc.py fails when a Flags field is added without a
+# row here or without regenerating the doc.
+FLAG_DOCS = {
+    "use_tpu": ("use the TPU backend; False pins CPU", "use_gpu"),
+    "dtype": ("parameter dtype", 'real ("paddle float")'),
+    "compute_dtype": ("matmul/conv compute dtype on TPU; auto = bf16 on "
+                      "TPU, f32 on CPU", "—"),
+    "seed": ("RNG seed (0 = time-based)", "seed"),
+    "job": ("train | test | checkgrad | merge_model", "job"),
+    "config": ("model config script (native get_config() or reference v1 "
+               "trainer_config_helpers script)", "config"),
+    "config_args": ("k=v,k=v passed into the config script", "config_args"),
+    "comment": ("freeform run annotation, logged once", "—"),
+    "log_period": ("batches between progress lines (0 = pass end only)",
+                   "log_period"),
+    "dot_period": ("'.'-cadence kept for config compat; logging is the "
+                   "progress channel here", "dot_period"),
+    "saving_period": ("passes between checkpoints", "saving_period"),
+    "saving_period_by_batches": ("also checkpoint every N batches "
+                                 "(0 = off)", "saving_period_by_batches"),
+    "test_period": ("passes between test() sweeps (0 = every pass)",
+                    "test_period"),
+    "test_pass": ("load pass N for a test job", "test_pass"),
+    "average_test_period": ("Polyak-averaged eval cadence",
+                            "average_test_period"),
+    "num_passes": ("passes over the data", "num_passes"),
+    "start_pass": ("resume from pass K (loads pass K-1)", "start_pass"),
+    "save_dir": ("checkpoint directory", "save_dir"),
+    "save_only_one": ("keep only the latest checkpoint", "save_only_one"),
+    "init_model_path": ("warm-start parameters from a checkpoint dir",
+                        "init_model_path"),
+    "load_missing_parameter_strategy": ("fail | rand | zero for params "
+                                        "absent from the warm-start",
+                                        "load_missing_parameter_strategy"),
+    "show_parameter_stats_period": ("batches between per-param absmax/"
+                                    "absavg dumps",
+                                    "show_parameter_stats_period"),
+    "show_layer_stat": ("per-layer output stats each log_period",
+                        "show_layer_stat"),
+    "checkgrad_eps": ("finite-difference epsilon for the checkgrad job",
+                      "checkgrad_eps"),
+    "prev_batch_state": ("carry RNN state across batches",
+                         "prev_batch_state"),
+    "with_cost": ("train with a cost layer (off for inference nets)",
+                  "with_cost"),
+    "predict_file": ("input file for the predict drivers", "predict_file"),
+    "predict_output_dir": ("where predict jobs write outputs",
+                           "predict_output_dir"),
+    "data_parallel": ("data-parallel mesh axis (0 = all devices)",
+                      "trainer_count"),
+    "model_parallel": ("tensor-parallel mesh axis (megatron rules)",
+                       "parallel_nn"),
+    "seq_parallel": ("sequence/context-parallel axis (ring attention)",
+                     "—"),
+    "expert_parallel": ("expert-parallel mesh axis (MoE)", "—"),
+    "coordinator": ("multi-host rendezvous address "
+                    "(jax.distributed)", "port/ports_num/nics"),
+    "num_processes": ("process count for multi-host rendezvous",
+                      "num_gradient_servers"),
+    "process_id": ("this host's index in the rendezvous", "trainer_id"),
+    "dcn_data_parallel": ("slices joined over DCN (hybrid ICI×DCN mesh)",
+                          "—"),
+    "beam_size": ("beam width for generation jobs", "beam_size"),
+    "async_load_data": ("input pipeline overlap on/off; with "
+                        "prefetch_depth gives --prefetch its default",
+                        "async_load_data (DoubleBuffer)"),
+    "prefetch_depth": ("batches converted + H2D-transferred ahead on the "
+                       "prefetch thread", "—"),
+    "jax_compilation_cache_dir": ("opt-in persistent XLA compile cache "
+                                  "(AOT bucket warm-up survives restarts)",
+                                  "—"),
+    "profile_dir": ("capture an xprof/TensorBoard device trace", "—"),
+    "debug_nans": ("fail fast on the op producing a NaN",
+                   "feenableexcept (TrainerMain.cpp)"),
+    "memory_profile_path": ("dump a device memory profile", "—"),
+}
+
+_TABLE_BEGIN = ("<!-- BEGIN GENERATED FLAGS TABLE "
+                "(python -m paddle_tpu.utils.flags; do not edit) -->")
+_TABLE_END = "<!-- END GENERATED FLAGS TABLE -->"
+
+
+def flags_table_md():
+    """The docs/flags.md flag-reference table, generated from the Flags
+    dataclass + FLAG_DOCS so the doc can never drift from the code."""
+    lines = [_TABLE_BEGIN,
+             "",
+             "| flag | default | meaning | reference cmd_parameter |",
+             "|---|---|---|---|"]
+    for field in dataclasses.fields(Flags):
+        help_, ref = (s.replace("|", "\\|") for s in FLAG_DOCS[field.name])
+        default = "None" if field.default is None else repr(field.default)
+        lines.append(f"| `--{field.name}` | `{default}` | {help_} | "
+                     f"{ref} |")
+    lines += ["", _TABLE_END]
+    return "\n".join(lines)
+
+
 # Reference flags with no runtime role here, and why — the lookup table for
 # migrating users (reference Flags.cpp names):
 SUBSUMED = {
@@ -163,3 +265,7 @@ SUBSUMED = {
 
 
 FLAGS = Flags()
+
+
+if __name__ == "__main__":
+    print(flags_table_md())

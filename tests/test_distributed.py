@@ -19,6 +19,10 @@ import pytest
 
 from conftest import free_port
 
+# multi-process rendezvous tests (subprocess workers + timeouts);
+# nightly lane — README "Running the tests"
+pytestmark = pytest.mark.slow
+
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -1,0 +1,53 @@
+"""docs/flags.md drift gate: the flag-reference table there is GENERATED
+from `paddle_tpu.utils.flags` (the Flags dataclass + FLAG_DOCS).  Adding
+a flag without a doc row, leaving a stale row behind, or editing the
+dataclass without regenerating the doc fails here — the doc can never
+silently drift from the code.
+
+Regenerate with:  python -m paddle_tpu.utils.flags  (paste between the
+BEGIN/END markers in docs/flags.md).
+"""
+
+import dataclasses
+import os
+
+from paddle_tpu.utils import flags
+
+_DOC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs", "flags.md")
+
+
+def _field_names():
+    return {f.name for f in dataclasses.fields(flags.Flags)}
+
+
+def test_every_flag_has_a_doc_row():
+    missing = sorted(_field_names() - set(flags.FLAG_DOCS))
+    assert not missing, (
+        f"Flags fields without a FLAG_DOCS row: {missing} — add (help, "
+        "reference cmd_parameter equivalent or '—') entries and "
+        "regenerate docs/flags.md (python -m paddle_tpu.utils.flags)")
+
+
+def test_no_stale_doc_rows():
+    stale = sorted(set(flags.FLAG_DOCS) - _field_names())
+    assert not stale, f"FLAG_DOCS rows for removed flags: {stale}"
+
+
+def test_doc_rows_name_a_reference_fate():
+    # every row either names its reference cmd_parameter or explicitly
+    # documents the drop with '—' — no empty cells
+    for name, (help_, ref) in flags.FLAG_DOCS.items():
+        assert help_.strip(), f"{name}: empty help"
+        assert ref.strip(), f"{name}: empty reference column (use '—')"
+
+
+def test_docs_flags_md_is_regenerated():
+    with open(_DOC) as f:
+        doc = f.read()
+    table = flags.flags_table_md()
+    assert flags._TABLE_BEGIN in doc and flags._TABLE_END in doc, (
+        "docs/flags.md lost its generated-table markers")
+    assert table in doc, (
+        "docs/flags.md's generated flags table is stale — regenerate with "
+        "`python -m paddle_tpu.utils.flags` and paste between the markers")

@@ -23,6 +23,10 @@ from paddle_tpu.layers.graph import Topology, reset_names, value_data
 from paddle_tpu.layers import networks as N
 from paddle_tpu.testing import check_grads
 
+# scan-heavy sweep (finite-difference grads through every recurrent/
+# attention case); nightly lane — README "Running the tests"
+pytestmark = pytest.mark.slow
+
 # layer types with no gradient path to sweep, with reasons
 EXCLUDED = {
     "data": "input placeholder",

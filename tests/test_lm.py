@@ -244,6 +244,7 @@ def test_lm_generate_ragged_prompts_match_per_row(np_rng):
                                 prompt_lengths=np.asarray([2, 9, 4]))
 
 
+@pytest.mark.slow   # multi-second end-to-end; nightly lane
 def test_lm_demo_runs():
     """demo/lm end to end at smoke scale: trains, then prints greedy and
     sampled continuations (the 15th demo family stays green)."""

@@ -18,6 +18,10 @@ import jax.numpy as jnp
 torch = pytest.importorskip("torch")
 import torch.nn.functional as F
 
+# end-to-end demo/torch-import runs (multi-minute subprocesses);
+# nightly lane — README "Running the tests"
+pytestmark = pytest.mark.slow
+
 BLOCKS = (3, 4, 6, 3)
 WIDTHS = (256, 512, 1024, 2048)
 NUM_CLASSES = 10

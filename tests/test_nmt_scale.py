@@ -8,6 +8,9 @@ import sys
 
 import pytest
 
+# subprocess end-to-end NMT harness run; nightly lane
+pytestmark = pytest.mark.slow
+
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _REF = os.environ.get("PADDLE_TPU_REFERENCE", "/root/reference")
 

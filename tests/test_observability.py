@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 
+@pytest.mark.slow   # multi-second end-to-end; nightly lane
 def test_profiler_trace_writes_files(tmp_path):
     from paddle_tpu.utils import profiler
     d = str(tmp_path / "xprof")

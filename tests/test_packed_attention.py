@@ -175,6 +175,7 @@ def test_mha_segment_ring_matches_unsharded(np_rng, causal):
                                    rtol=5e-3, atol=5e-5)
 
 
+@pytest.mark.slow   # multi-second end-to-end; nightly lane
 def test_transformer_encode_packed_matches_alone(np_rng):
     """transformer.encode on a packed row equals encoding each sequence
     alone: segment-isolated attention + within-segment positions."""

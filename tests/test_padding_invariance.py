@@ -27,6 +27,10 @@ from paddle_tpu.layers.graph import Topology, reset_names, value_data
 
 from tests.test_layer_grad_sweep import CASES, B0, T0
 
+# scan-heavy sweep (every sequence case re-built at two padded lengths);
+# nightly lane — README "Running the tests"
+pytestmark = pytest.mark.slow
+
 EXTRA = 3          # appended timesteps
 GARBAGE = 7.5      # pad payload: loud, not zero
 

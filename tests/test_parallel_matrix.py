@@ -30,6 +30,10 @@ from paddle_tpu.parallel import (MeshConfig, make_mesh, megatron_rules,
                                  replicated_shardings)
 from paddle_tpu import optim
 
+# mesh-matrix sweep over model/data/seq shardings (multi-minute);
+# nightly lane — README "Running the tests"
+pytestmark = pytest.mark.slow
+
 needs_8 = pytest.mark.skipif(len(jax.devices()) < 8,
                              reason="needs 8 virtual devices")
 

@@ -13,6 +13,10 @@ from paddle_tpu.core.sequence import pad_sequences
 from paddle_tpu.layers import recurrent as R
 from paddle_tpu.layers.graph import Topology, reset_names, value_data
 
+# scan-heavy (hoisted vs unhoisted recurrent_group, fwd+grad);
+# nightly lane — README "Running the tests"
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def toggle():
