@@ -19,7 +19,11 @@ from analytic model FLOPs and the chip's peak (device_kind table below).
 Env overrides: BENCH_MODEL=lstm|lstm256|lstm1280|resnet50|alexnet|googlenet|
 smallnet|seq2seq|transformer|transformer_decode (seq2seq/transformer report
 tokens/sec — the reference never shipped an NMT row and predates
-transformers; transformer_decode times the KV-cached serving beam search),
+transformers; transformer_decode times the KV-cached serving beam search).
+A bare family name also works positionally: `python bench.py serving`
+drives the serving RUNTIME (paddle_tpu/serving dynamic batcher) at several
+closed-loop load levels and reports batched vs batch-size-1 throughput,
+tail latency, and mean batch occupancy.  Other overrides:
 BENCH_STEPS, BENCH_BATCH, BENCH_INIT_TIMEOUT, BENCH_BUILD_TIMEOUT (eager
 param init; wider default since each distinct shape compiles through the
 tunnel), BENCH_COMPILE_TIMEOUT,
@@ -870,6 +874,102 @@ def bench_transformer_serving(batch=16, n_requests=64, src_max=128,
         + (f" quant={quant}" if quant else "")), extras
 
 
+def bench_serving_engine(batch=32, dim=256, hidden=1024, classes=32,
+                         n_requests=256, max_delay_ms=2.0):
+    """Dynamic-batching serving runtime (paddle_tpu/serving): closed-loop
+    client threads hammer the Batcher with single-sample requests; the
+    engine AOT-serves padded bucket batches.  extras carry the offered-
+    load sweep — throughput / p50 / p99 / mean batch occupancy per client
+    count — plus the batch-size-1 baseline (max_batch_size=1, same model,
+    same engine) at saturating load, so the row IS the batched-vs-
+    unbatched serving comparison.  run() serves one closed-loop burst
+    (n_requests over 8 clients) for the timed phase."""
+    import jax
+    from paddle_tpu.layers import api as L
+    from paddle_tpu.layers.graph import Topology, reset_names
+    from paddle_tpu.serving import Batcher, InferenceEngine, ServingMetrics
+
+    ladder = tuple(b for b in (1, 4, 16, 64) if b < batch) + (batch,)
+    reset_names()
+    x = L.data_layer("serving_x", size=dim)
+    h = L.fc_layer(input=x, size=hidden, act="tanh")
+    out_l = L.fc_layer(input=h, size=classes, act="softmax")
+    params = Topology([out_l]).init(jax.random.PRNGKey(0))
+    spec = {"serving_x": jax.ShapeDtypeStruct((1, dim), np.float32)}
+    # warm=False: under --analytic nothing may execute (warmup runs each
+    # bucket once); the load path below warms explicitly
+    engine = InferenceEngine.from_topology(out_l, params, spec,
+                                           buckets=ladder, warm=False,
+                                           name="bench")
+    rng = np.random.RandomState(0)
+    rows = [{"serving_x": rng.randn(dim).astype(np.float32)}
+            for _ in range(64)]
+
+    def drive(n_clients, max_batch, n_req):
+        """One closed-loop level: n_clients threads, back-to-back
+        requests, fresh metrics; returns throughput + latency tails."""
+        engine.metrics = ServingMetrics()
+        bat = Batcher(engine, max_batch_size=max_batch,
+                      max_delay_ms=max_delay_ms, queue_size=4096)
+        lats, lock = [], threading.Lock()
+
+        def client(k):
+            my = []
+            for i in range(n_req // n_clients):
+                t0 = time.perf_counter()
+                bat.submit(rows[(k * 7 + i) % len(rows)]).result(120)
+                my.append(time.perf_counter() - t0)
+            with lock:
+                lats.extend(my)
+
+        ts = [threading.Thread(target=client, args=(k,))
+              for k in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        bat.close()
+        lats.sort()
+        snap = engine.metrics.snapshot()
+        return {"clients": n_clients, "max_batch": max_batch,
+                "throughput_rps": round(len(lats) / dt, 1),
+                "p50_ms": round(lats[len(lats) // 2] * 1e3, 2),
+                "p99_ms": round(lats[min(len(lats) - 1,
+                                         int(len(lats) * 0.99))] * 1e3, 2),
+                "mean_occupancy": snap["mean_occupancy"],
+                "padding_waste": snap["padding_waste"]}
+
+    extras = {"lower": lambda: engine.lower(ladder[-1])}
+    if os.environ.get("BENCH_ANALYTIC_BUILD") != "1":
+        engine.warmup()
+        drive(8, batch, 64)             # warm the whole batched path
+        sweep = [drive(c, batch, n_requests) for c in (2, 8, 32)]
+        sat = sweep[-1]
+        bs1 = drive(32, 1, n_requests)  # no-batching baseline, same load
+        extras.update(
+            load_sweep=sweep,
+            batched_throughput_rps=sat["throughput_rps"],
+            batched_p99_ms=sat["p99_ms"],
+            mean_batch_occupancy=sat["mean_occupancy"],
+            padding_waste=sat["padding_waste"],
+            bs1_throughput_rps=bs1["throughput_rps"],
+            bs1_p99_ms=bs1["p99_ms"],
+            batching_speedup=round(sat["throughput_rps"]
+                                   / bs1["throughput_rps"], 2))
+
+    def run(s):
+        r = drive(8, batch, n_requests)
+        return np.float32(r["throughput_rps"])
+
+    # fwd matmul FLOPs per request, over the burst run() serves
+    flops = 2.0 * (dim * hidden + hidden * classes) * n_requests
+    return run, flops, None, (
+        f"serving dynamic-batch ms/burst ({n_requests} reqs, 8 clients, "
+        f"buckets {list(ladder)}, delay {max_delay_ms:g}ms)"), extras
+
+
 def bench_trainer_prefetch(batch=64, dim=256, hidden=512, n_batches=24,
                            host_ms=4.0):
     """Trainer hot-loop input overlap: steps/s with the input pipeline
@@ -979,6 +1079,9 @@ _BENCHES = {
     "transformer_decode": (lambda b: bench_transformer_decode(batch=b), 32),
     "transformer_lm_decode": (lambda b: bench_transformer_lm_decode(batch=b), 32),
     "transformer_serving": (lambda b: bench_transformer_serving(batch=b), 16),
+    # the serving RUNTIME row (paddle_tpu/serving): dynamic batcher +
+    # bucketed AOT engine under closed-loop load, batched vs batch-size-1
+    "serving": (lambda b: bench_serving_engine(batch=b), 32),
     "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),
     # input-pipeline overlap row: steps/s at train(prefetch=0) vs 2 on a
     # synthetic input-bound workload (the ShardedPrefetcher's win)
@@ -1091,6 +1194,11 @@ def main():
         from paddle_tpu.perf import analytic
         sys.exit(analytic.main(sys.argv[1:]))
     model = os.environ.get("BENCH_MODEL", "lstm")
+    # positional family name: `python bench.py serving` == BENCH_MODEL=serving
+    for a in sys.argv[1:]:
+        if not a.startswith("-") and a in _BENCHES:
+            model = a
+            break
     if "--smoke-kernels" in sys.argv:
         model = "smoke_kernels"
     steps = int(os.environ.get("BENCH_STEPS", "20"))

@@ -140,6 +140,38 @@ def export_inference(output_layer, parameters, feed_spec, path=None,
     return exp
 
 
+def export_bucketed(output_layer, parameters, feed_spec, buckets,
+                    path_prefix, model_state=None, platforms=None,
+                    quantize=None):
+    """One artifact per batch bucket — the export half of the serving
+    runtime's bucket ladder (serving/engine.py).
+
+    feed_spec leaves carry a LEADING batch axis (any size); it is replaced
+    by each bucket.  Artifacts land at the documented naming convention
+    ``{path_prefix}.b{N}.shlo`` (one per bucket N), which
+    ``serving.InferenceEngine.from_artifacts(f"{path_prefix}.b*.shlo")``
+    loads back as a ladder.  Returns {bucket: path}."""
+    spec = {k: jax.tree_util.tree_map(_as_aval, v)
+            for k, v in feed_spec.items()}
+
+    def rebatch(n):
+        return {k: jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct((n,) + tuple(l.shape[1:]),
+                                           l.dtype), v)
+            for k, v in spec.items()}
+
+    paths = {}
+    for n in sorted({int(b) for b in buckets}):
+        if n < 1:
+            raise ValueError(f"bucket {n} < 1")
+        path = f"{path_prefix}.b{n}.shlo"
+        export_inference(output_layer, parameters, rebatch(n), path=path,
+                         model_state=model_state, platforms=platforms,
+                         quantize=quantize)
+        paths[n] = path
+    return paths
+
+
 def load_inference(path_or_bytes):
     """Deserialize an exported artifact -> callable(feed_dict)."""
     if isinstance(path_or_bytes, (bytes, bytearray)):

@@ -55,6 +55,10 @@ FAMILIES = [
     ("transformer_lm_decode", "transformer_lm_decode", None),
     ("transformer_decode", "transformer_decode", None),
     ("transformer_serving", "transformer_serving", None),
+    # the serving RUNTIME (paddle_tpu/serving): the engine's top-bucket
+    # executable via InferenceEngine.lower — gates the serving forward's
+    # structure like the training families
+    ("serving", "serving", None),
     ("trainer_prefetch", "trainer_prefetch", None),
 ]
 
@@ -108,11 +112,11 @@ def capture(name, model, batch=None, chips=("v5e", "v5p")):
                compile_s=round(time.perf_counter() - t0, 1))
     # bench.py's hand-derived FLOPs model, normalized to the same scope
     # as the lowered program (one step); trainer_prefetch's model covers
-    # a whole pass, serving's covers the whole request stream — the
-    # lowered program there is one batch, so scopes differ and the
-    # cross-check is omitted for serving.
+    # a whole pass, the serving families' covers the whole request
+    # stream/burst — the lowered program there is one batch, so scopes
+    # differ and the cross-check is omitted for them.
     bps = extras.get("batches_per_step")
-    if model == "transformer_serving":
+    if model in ("transformer_serving", "serving"):
         row["bench_model_flops"] = None
     else:
         row["bench_model_flops"] = model_flops / (bps or 1)

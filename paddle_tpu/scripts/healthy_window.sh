@@ -23,6 +23,8 @@
 #  5. perf_report render
 #  6. analytic snapshot refresh         (chip-INDEPENDENT cost/roofline —
 #                                        last so it burns no window time)
+#  7. serving runtime smoke             (dynamic batcher + HTTP front-end
+#                                        self-test on an ephemeral port)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -48,7 +50,8 @@ if [ "$DRY" = "1" ]; then
     INT8_ARGS=(--combos "transformer_serving:4" --steps 2)
     DIFF_CASES="embedding"
     NMT_ARGS=(--vocab 200 --steps 4 --gen-sents 4 --beam 2 --max-gen-len 20)
-    ANALYTIC_FAMILIES="smallnet,trainer_prefetch"
+    ANALYTIC_FAMILIES="smallnet,trainer_prefetch,serving"
+    T_SERVE=600
 else
     T_SMOKE=1200; T_SWEEP=14400; T_COL=3600; T_DIFF=7200; T_NMT=7200
     SWEEP_ARGS=()
@@ -59,6 +62,7 @@ else
     NMT_ARGS=(--vocab 30000 --steps 300 --gen-sents 32 --beam 5
               --max-gen-len 50)
     ANALYTIC_FAMILIES=""
+    T_SERVE=600
 fi
 
 # every bench.py combo is a fresh subprocess; a shared persistent XLA
@@ -165,6 +169,14 @@ else
         > "$ART/analytic.json" 2> "$ART/analytic.log"
 fi
 log "analytic rc=$? -> $ART/analytic.json"
+
+log "phase 7: serving runtime smoke (dynamic batcher + HTTP front-end)"
+# self-contained: ephemeral port, concurrent requests, a malformed
+# request, /healthz + /metrics sanity — one JSON line, nonzero rc on any
+# failed check (serving/server.py --smoke)
+timeout "$T_SERVE" python -m paddle_tpu.serving --smoke \
+    > "$ART/serving_smoke.json" 2> "$ART/serving_smoke.log"
+log "serving smoke rc=$? -> $ART/serving_smoke.json"
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

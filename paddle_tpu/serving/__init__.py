@@ -1,0 +1,28 @@
+"""Serving runtime: dynamic-batching inference over bucketed AOT
+executables (docs/serving.md §3).
+
+    engine.py   InferenceEngine — one XLA executable per batch bucket
+                (in-process forward or exported StableHLO ladder), pad to
+                bucket / slice back, warm-up, analytic lower() hook
+    batcher.py  Batcher — bounded queue + background batching thread,
+                futures, admission control, deadlines, graceful drain
+    server.py   JSON/HTTP front-end (/v1/infer, /healthz, /metrics) + CLI
+    metrics.py  ServingMetrics — latency percentiles, occupancy, padding
+                waste, queue depth; Prometheus text at /metrics
+
+    python -m paddle_tpu.serving --artifacts 'model.b*.shlo' --port 8080
+"""
+
+from paddle_tpu.serving.batcher import (BatchExecutionError, Batcher,
+                                        DeadlineExceededError,
+                                        OverloadedError, ShutdownError)
+from paddle_tpu.serving.engine import (DEFAULT_BUCKETS, InferenceEngine,
+                                       InvalidRequestError)
+from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.server import make_server
+
+__all__ = [
+    "Batcher", "BatchExecutionError", "DeadlineExceededError",
+    "DEFAULT_BUCKETS", "InferenceEngine", "InvalidRequestError",
+    "OverloadedError", "ServingMetrics", "ShutdownError", "make_server",
+]

@@ -1,0 +1,7 @@
+"""``python -m paddle_tpu.serving`` — the serving CLI (server.py)."""
+
+import sys
+
+from paddle_tpu.serving.server import main
+
+sys.exit(main())

@@ -1,0 +1,403 @@
+"""Bucketed AOT inference engine — the execution half of the serving runtime.
+
+TPU serving wants static shapes: one XLA executable per batch-size bucket,
+compiled BEFORE traffic arrives, with every request batch padded up to the
+nearest bucket and results sliced back.  ``InferenceEngine`` owns exactly
+that ladder, for either execution source:
+
+* in-process — a jit-traceable forward (``from_inferencer`` /
+  ``from_topology``) AOT-compiled per bucket via the same
+  ``jit(fn).lower(spec).compile()`` idiom as ``SGD.precompile``/
+  ``SGD.lower_step``; ``lower(bucket)`` exposes the ``jax.stages.Lowered``
+  so the analytic perf layer (``paddle_tpu/perf``) can read XLA's cost
+  model per bucket without executing anything.
+* exported artifacts — one serialized StableHLO file per bucket
+  (``export.export_bucketed`` writes ``model.b{N}.shlo``;
+  ``from_artifacts`` loads the ladder), each wrapped in ``jax.jit`` so the
+  call compiles once per bucket and then dispatches.
+
+Trace discipline mirrors the trainer: ``trace_count`` increments whenever
+the forward's Python body runs under tracing, ``warmup()`` asserts one
+trace per bucket, and steady-state serving cannot retrace by construction
+(requests only ever execute at ladder shapes).  The ``lower()`` analytic
+hook does trace (it re-stages the function); it is an offline tool, not a
+serving path.
+
+Batches larger than the top bucket are served by chunking at the top
+bucket; numerics are row-independent (the forward is applied per row), so
+padding and chunking change nothing about any real row's result.
+"""
+
+import re
+import threading
+import time
+
+import numpy as np
+import jax
+
+from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.utils.error import ConfigError
+from paddle_tpu.utils.logging import logger
+from paddle_tpu.utils.stats import timer
+
+DEFAULT_BUCKETS = (1, 4, 16, 64)
+
+# export_bucketed's documented naming convention, parsed by from_artifacts
+ARTIFACT_RE = re.compile(r"\.b(\d+)\.shlo$")
+
+
+class InvalidRequestError(ValueError):
+    """Feed does not match the engine's input spec (shape/dtype/slots) —
+    raised BEFORE the request reaches the batching queue."""
+
+
+def _leaves(tree):
+    return jax.tree_util.tree_flatten(tree)
+
+
+def _np_leaf(leaf):
+    return leaf if isinstance(leaf, np.ndarray) else np.asarray(leaf)
+
+
+def _pad_rows(tree, n):
+    """Pad every leaf's leading (batch) axis up to n by replicating the
+    last real row — replication keeps padding numerically in-range for any
+    model (zeros can be out-of-vocabulary for an id feed)."""
+    def pad(leaf):
+        leaf = _np_leaf(leaf)
+        b = leaf.shape[0]
+        if b == n:
+            return leaf
+        reps = np.repeat(leaf[-1:], n - b, axis=0)
+        return np.concatenate([leaf, reps], axis=0)
+    return jax.tree_util.tree_map(pad, tree)
+
+
+def _slice_rows(tree, n):
+    # numpy slicing on host-materialized outputs: a jnp slice here would
+    # stage a NEW XLA computation per (bucket, real-rows) shape pair —
+    # ~100ms compile on every previously unseen occupancy
+    return jax.tree_util.tree_map(lambda l: l[:n], tree)
+
+
+def _concat_rows(trees):
+    if len(trees) == 1:
+        return trees[0]
+    return jax.tree_util.tree_map(lambda *ls: np.concatenate(ls, axis=0),
+                                  *trees)
+
+
+class InferenceEngine:
+    """Dynamic-batching execution engine over a bucket ladder.
+
+    Build with one of the factories:
+      ``from_inferencer(inferencer, feed_spec, buckets=...)``
+      ``from_topology(output_layer, parameters, feed_spec, ...)``
+      ``from_artifact(path)`` / ``from_artifacts(glob_pattern)``
+
+    ``feed_spec``: one feed dict whose leaves carry a LEADING batch axis
+    (any size — it is replaced per bucket); leaves may be example arrays,
+    ``jax.ShapeDtypeStruct``s, or SequenceBatch-wrapped versions.
+
+    ``warm=True`` compiles every bucket up front (serving startup);
+    ``warm=False`` compiles each bucket on first use (the v2 in-process
+    path, where paying the whole ladder eagerly would be waste).
+    """
+
+    def __init__(self, *, jitted=None, feed_spec=None, artifacts=None,
+                 buckets=DEFAULT_BUCKETS, warm=True, name="model",
+                 metrics=None, trace_box=None):
+        self.name = name
+        self.metrics = metrics or ServingMetrics()
+        self._lock = threading.Lock()   # executable table + compile serial
+        self._compiled = {}             # bucket -> executable(feed)
+        self._trace_box = trace_box if trace_box is not None else [0]
+        self._artifacts = None
+        if (jitted is None) == (artifacts is None):
+            raise ConfigError("InferenceEngine: exactly one of jitted= or "
+                              "artifacts= must be given (use the from_* "
+                              "factories)")
+        if artifacts is not None:
+            # {bucket: jax.export.Exported}
+            self._artifacts = dict(artifacts)
+            self.buckets = tuple(sorted(self._artifacts))
+            spec = _artifact_feed_spec(self._artifacts[self.buckets[0]])
+        else:
+            self._jit = jitted
+            self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+            if not self.buckets or self.buckets[0] < 1:
+                raise ConfigError(f"bad bucket ladder {buckets!r}")
+            spec = feed_spec
+        if spec is None:
+            raise ConfigError("InferenceEngine needs a feed_spec")
+        self._set_row_spec(spec)
+        if warm:
+            self.warmup()
+
+    # ------------------------------------------------------------ factories
+
+    @classmethod
+    def from_inferencer(cls, inferencer, feed_spec, buckets=DEFAULT_BUCKETS,
+                        warm=True, name="model", metrics=None):
+        """Wrap an in-process ``trainer.Inferencer`` (params/state/quantize
+        already resolved there) in a bucket ladder."""
+        trace_box = [0]
+
+        def fwd(feed):
+            trace_box[0] += 1       # runs only under tracing
+            return inferencer._fwd(inferencer._exec_params,
+                                   inferencer.model_state, feed)
+
+        return cls(jitted=jax.jit(fwd), feed_spec=feed_spec,
+                   buckets=buckets, warm=warm, name=name, metrics=metrics,
+                   trace_box=trace_box)
+
+    @classmethod
+    def from_topology(cls, output_layer, parameters, feed_spec,
+                      model_state=None, buckets=DEFAULT_BUCKETS, warm=True,
+                      compute_dtype=None, quantize=None, name="model",
+                      metrics=None):
+        from paddle_tpu.trainer.trainer import Inferencer
+        inf = Inferencer(output_layer, parameters, model_state=model_state,
+                         compute_dtype=compute_dtype, quantize=quantize)
+        return cls.from_inferencer(inf, feed_spec, buckets=buckets,
+                                   warm=warm, name=name, metrics=metrics)
+
+    @classmethod
+    def from_artifact(cls, path_or_bytes, warm=True, name=None,
+                      metrics=None):
+        """One exported StableHLO artifact -> a one-bucket engine (the
+        bucket is the artifact's baked batch size)."""
+        from paddle_tpu.export import load_inference
+        exp = load_inference(path_or_bytes).exported
+        bucket = _artifact_batch(exp)
+        return cls(artifacts={bucket: exp}, warm=warm,
+                   name=name or "artifact", metrics=metrics)
+
+    @classmethod
+    def from_artifacts(cls, pattern, warm=True, name=None, metrics=None):
+        """Load a bucket ladder written by ``export.export_bucketed``:
+        ``pattern`` is a glob (or explicit list of paths) of
+        ``<prefix>.b{N}.shlo`` files; N (from the filename, cross-checked
+        against the baked batch dim) keys the ladder."""
+        import glob as _glob
+        from paddle_tpu.export import load_inference
+        paths = (sorted(_glob.glob(pattern)) if isinstance(pattern, str)
+                 else sorted(pattern))
+        if not paths:
+            raise ConfigError(f"from_artifacts: nothing matches {pattern!r}")
+        arts = {}
+        for p in paths:
+            m = ARTIFACT_RE.search(p)
+            if not m:
+                raise ConfigError(
+                    f"from_artifacts: {p!r} does not follow the "
+                    "<prefix>.b{N}.shlo naming convention "
+                    "(export.export_bucketed writes it)")
+            n = int(m.group(1))
+            exp = load_inference(p).exported
+            baked = _artifact_batch(exp)
+            if baked != n:
+                raise ConfigError(
+                    f"from_artifacts: {p!r} names bucket {n} but its baked "
+                    f"batch dim is {baked}")
+            arts[n] = exp
+        return cls(artifacts=arts, warm=warm, name=name or "artifacts",
+                   metrics=metrics)
+
+    # ------------------------------------------------------------ spec
+
+    def _set_row_spec(self, feed_spec):
+        """Normalize the batch-leading feed spec into a per-row signature
+        (treedef + per-leaf trailing shape/dtype) used for validation and
+        per-bucket ShapeDtypeStruct construction."""
+        def aval(leaf):
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                return leaf
+            a = np.asarray(leaf)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+        spec = jax.tree_util.tree_map(aval, feed_spec)
+        leaves, treedef = _leaves(spec)
+        for l in leaves:
+            if len(l.shape) < 1:
+                raise ConfigError(
+                    "feed_spec leaves need a leading batch axis; got "
+                    f"scalar {l}")
+        self._treedef = treedef
+        self._row_sig = tuple((tuple(l.shape[1:]), np.dtype(l.dtype))
+                              for l in leaves)
+
+    def bucket_spec(self, bucket):
+        """The feed pytree of ``ShapeDtypeStruct``s for one bucket."""
+        leaves = [jax.ShapeDtypeStruct((bucket,) + shape, dtype)
+                  for shape, dtype in self._row_sig]
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    def validate(self, feed, batch=True):
+        """Shape/dtype-check a feed against the engine spec; raises
+        ``InvalidRequestError``.  batch=True expects a leading batch axis
+        (equal across leaves); batch=False expects bare per-row leaves.
+        Returns the batch size (or 1 for rows)."""
+        try:
+            leaves, treedef = _leaves(feed)
+        except Exception as e:    # noqa: BLE001 — unflattenable input
+            raise InvalidRequestError(f"unreadable feed: {e}") from e
+        if treedef != self._treedef:
+            raise InvalidRequestError(
+                f"feed structure {treedef} != engine spec {self._treedef}")
+        b = None
+        for leaf, (shape, dtype) in zip(leaves, self._row_sig):
+            a = _np_leaf(leaf)
+            if batch:
+                if a.ndim != len(shape) + 1 or tuple(a.shape[1:]) != shape:
+                    raise InvalidRequestError(
+                        f"leaf shape {a.shape} != [B]+{list(shape)}")
+                if b is None:
+                    b = a.shape[0]
+                elif a.shape[0] != b:
+                    raise InvalidRequestError(
+                        f"inconsistent batch dims ({b} vs {a.shape[0]})")
+            elif tuple(a.shape) != shape:
+                raise InvalidRequestError(
+                    f"row leaf shape {a.shape} != {list(shape)}")
+            if np.dtype(a.dtype) != dtype:
+                raise InvalidRequestError(
+                    f"leaf dtype {a.dtype} != {dtype}")
+        if batch and not b:
+            raise InvalidRequestError("empty batch")
+        return b if batch else 1
+
+    # ------------------------------------------------------------ compile
+
+    @property
+    def trace_count(self):
+        return self._trace_box[0]
+
+    def bucket_for(self, n):
+        """Smallest bucket >= n, or None when n exceeds the ladder top."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return None
+
+    def _exec_for(self, bucket):
+        with self._lock:
+            fn = self._compiled.get(bucket)
+            if fn is not None:
+                return fn
+            t0 = time.perf_counter()
+            if self._artifacts is not None:
+                fn = jax.jit(self._artifacts[bucket].call)
+            else:
+                fn = self._jit.lower(self.bucket_spec(bucket)).compile()
+            self._compiled[bucket] = fn
+            logger.info("serving[%s]: bucket %d ready in %.2fs", self.name,
+                        bucket, time.perf_counter() - t0)
+            return fn
+
+    def warmup(self):
+        """Compile AND execute every ladder bucket once (on zeros) before
+        traffic — the first execution of a fresh executable pays one-time
+        runtime setup (~100ms-class even on CPU) that must never land on a
+        live request.  In-process engines additionally assert the
+        per-bucket trace discipline: each NEW bucket costs exactly one
+        trace of the forward's Python body, and steady-state serving costs
+        zero (``trace_count`` stays flat).  Returns the number of newly
+        compiled buckets."""
+        n_new = 0
+        for b in self.buckets:
+            fresh = b not in self._compiled
+            before = self.trace_count
+            fn = self._exec_for(b)
+            if not fresh:
+                continue
+            n_new += 1
+            zeros = jax.tree_util.tree_map(
+                lambda l: np.zeros(l.shape, l.dtype), self.bucket_spec(b))
+            jax.block_until_ready(fn(zeros))
+            if self._artifacts is None and self.trace_count != before + 1:
+                raise AssertionError(
+                    f"serving[{self.name}]: bucket {b} warm-up traced "
+                    f"{self.trace_count - before} times (expected exactly 1)"
+                    " — the forward is not shape-stable")
+        if n_new:
+            logger.info("serving[%s]: %d bucket executable(s) warm %s",
+                        self.name, len(self._compiled), list(self.buckets))
+        return n_new
+
+    def lower(self, bucket=None):
+        """The ``jax.stages.Lowered`` for one bucket (default: the ladder
+        top) — the ``extras["lower"]`` analytic hook: ``perf/analytic``
+        compiles it on the CPU backend and reads XLA's cost model to
+        predict per-bucket serving cost.  Offline tool: lowering re-stages
+        the forward (one extra trace); artifacts cannot re-lower."""
+        if self._artifacts is not None:
+            raise ConfigError(
+                "lower(): an artifact-backed engine holds serialized "
+                "StableHLO, not a traceable forward; run the analytic "
+                "layer against the in-process engine that exported it")
+        bucket = int(bucket) if bucket is not None else self.buckets[-1]
+        return self._jit.lower(self.bucket_spec(bucket))
+
+    # ------------------------------------------------------------ execute
+
+    def infer(self, feed):
+        """Serve one request batch: validate, pad to the nearest bucket
+        (chunking at the ladder top when the batch exceeds it), execute,
+        slice the real rows back.  Returns the output pytree with HOST
+        numpy leaves (serving results leave the device).  Row results are
+        independent of padding and co-batched rows."""
+        b = self.validate(feed, batch=True)
+        top = self.buckets[-1]
+        if b > top:
+            chunks = []
+            for lo in range(0, b, top):
+                n = min(top, b - lo)
+                chunks.append(self._infer_bucketed(
+                    jax.tree_util.tree_map(
+                        lambda l: _np_leaf(l)[lo:lo + n], feed), n))
+            return _concat_rows(chunks)
+        return self._infer_bucketed(feed, b)
+
+    def _infer_bucketed(self, feed, b):
+        bucket = self.bucket_for(b)
+        fn = self._exec_for(bucket)
+        t0 = time.perf_counter()
+        with timer("serving_batch"):
+            out = fn(_pad_rows(feed, bucket))
+            # materialize to host here: serving results leave the device
+            # anyway, and host-side numpy slicing is free while a device
+            # slice would compile per occupancy (see _slice_rows)
+            out = jax.device_get(out)
+        self.metrics.observe_batch(b, bucket, time.perf_counter() - t0)
+        return _slice_rows(out, b)
+
+
+# ---------------------------------------------------------------- artifacts
+
+
+def _artifact_feed_tree(exp):
+    """Exported -> its feed pytree of avals.  ``export_inference`` exports
+    functions of ONE positional feed argument; the in_tree is ((feed,), {})."""
+    tree = jax.tree_util.tree_unflatten(exp.in_tree, list(exp.in_avals))
+    args, kwargs = tree
+    if kwargs or len(args) != 1:
+        raise ConfigError(
+            "artifact does not take a single feed argument (was it written "
+            "by export_inference/export_bucketed?)")
+    return args[0]
+
+
+def _artifact_feed_spec(exp):
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+        _artifact_feed_tree(exp))
+
+
+def _artifact_batch(exp):
+    leaves, _ = _leaves(_artifact_feed_tree(exp))
+    dims = {l.shape[0] for l in leaves if len(l.shape)}
+    if len(dims) != 1:
+        raise ConfigError(
+            f"artifact input batch dims disagree: {sorted(dims)}")
+    return int(dims.pop())

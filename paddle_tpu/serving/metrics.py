@@ -1,0 +1,179 @@
+"""Live serving metrics: latency percentiles, queue depth, batch occupancy.
+
+The reference's serving story had no observability beyond host logs; a
+dynamic batcher is unoperable without numbers — whether batching is
+actually happening (occupancy), how much compute padding burns (waste
+ratio), and where the tail latency sits.  One ``ServingMetrics`` instance
+is shared by the engine, the batcher, and the HTTP front-end, built on
+``utils/stats.py`` (the ``Histogram`` percentile machinery, ``keep="last"``
+so a long-running server reports RECENT latency, and a ``global_stats``
+timer for the per-batch engine time so ``print_all_stats()`` shows serving
+next to training).
+
+``render_prometheus()`` is the text format served at ``/metrics``;
+``snapshot()`` is the same data as a dict (the bench family and the smoke
+JSON consume it).
+"""
+
+import threading
+
+from paddle_tpu.utils.stats import Histogram
+
+# submit() rejection reasons — keys are part of the /metrics surface
+REJECT_REASONS = ("overload", "deadline", "invalid", "shutdown")
+
+_QUANTILES = (50, 95, 99)
+
+
+class ServingMetrics:
+    """Thread-safe counters + latency/batch histograms for one engine."""
+
+    def __init__(self, name="paddle_tpu_serving", max_samples=100000):
+        self.name = name
+        self._lock = threading.Lock()
+        self.requests_total = 0          # accepted into the queue
+        self.responses_total = 0         # futures resolved with a result
+        self.errors_total = 0            # futures failed by a batch error
+        self.rejected = {r: 0 for r in REJECT_REASONS}
+        self.batches_total = 0
+        self.batch_rows_total = 0        # real rows executed
+        self.batch_slots_total = 0       # padded bucket slots executed
+        # request wall latency submit -> future resolved (seconds)
+        self.latency = Histogram(f"{name}_latency", max_samples=max_samples,
+                                 keep="last")
+        # engine batch execution time (seconds)
+        self.batch_time = Histogram(f"{name}_batch_time",
+                                    max_samples=max_samples, keep="last")
+        # wired by the batcher: zero-arg callable -> current queue depth
+        self.queue_depth_fn = None
+
+    # ------------------------------------------------------------ record
+
+    def accepted(self):
+        with self._lock:
+            self.requests_total += 1
+
+    def reject(self, reason):
+        with self._lock:
+            self.rejected[reason] = self.rejected.get(reason, 0) + 1
+
+    def observe_batch(self, n_real, bucket, seconds):
+        with self._lock:
+            self.batches_total += 1
+            self.batch_rows_total += int(n_real)
+            self.batch_slots_total += int(bucket)
+        self.batch_time.add(seconds)
+
+    def observe_response(self, latency_s):
+        with self._lock:
+            self.responses_total += 1
+        self.latency.add(latency_s)
+
+    def observe_error(self, n=1):
+        with self._lock:
+            self.errors_total += int(n)
+
+    # ------------------------------------------------------------ derive
+
+    @property
+    def mean_occupancy(self):
+        """Real rows per executed batch (> 1.0 iff batching happened)."""
+        with self._lock:
+            return (self.batch_rows_total / self.batches_total
+                    if self.batches_total else 0.0)
+
+    @property
+    def padding_waste(self):
+        """Fraction of executed bucket slots that held padding."""
+        with self._lock:
+            return (1.0 - self.batch_rows_total / self.batch_slots_total
+                    if self.batch_slots_total else 0.0)
+
+    def queue_depth(self):
+        fn = self.queue_depth_fn
+        try:
+            return int(fn()) if fn is not None else 0
+        except Exception:   # noqa: BLE001 — a dying queue must not kill /metrics
+            return 0
+
+    def snapshot(self):
+        """All metrics as one dict (bench family / smoke JSON surface)."""
+        lat = self.latency.percentiles(_QUANTILES)
+        bt = self.batch_time.percentiles(_QUANTILES)
+        with self._lock:
+            out = {
+                "requests_total": self.requests_total,
+                "responses_total": self.responses_total,
+                "errors_total": self.errors_total,
+                "rejected": dict(self.rejected),
+                "batches_total": self.batches_total,
+                "batch_rows_total": self.batch_rows_total,
+                "batch_slots_total": self.batch_slots_total,
+            }
+        out["queue_depth"] = self.queue_depth()
+        out["mean_occupancy"] = round(self.mean_occupancy, 3)
+        out["padding_waste"] = round(self.padding_waste, 3)
+        out["latency_ms"] = {f"p{q}": round(v * 1e3, 3)
+                             for q, v in lat.items()}
+        out["batch_time_ms"] = {f"p{q}": round(v * 1e3, 3)
+                                for q, v in bt.items()}
+        return out
+
+    # ------------------------------------------------------------ render
+
+    def render_prometheus(self):
+        """Prometheus text exposition for the /metrics endpoint."""
+        n = self.name
+        lat = self.latency.percentiles(_QUANTILES)
+        bt = self.batch_time.percentiles(_QUANTILES)
+        lines = []
+
+        def emit(metric, value, help_, mtype="gauge", labels=""):
+            lines.append(f"# HELP {n}_{metric} {help_}")
+            lines.append(f"# TYPE {n}_{metric} {mtype}")
+            lines.append(f"{n}_{metric}{labels} {value}")
+
+        with self._lock:
+            counters = [
+                ("requests_total", self.requests_total,
+                 "requests accepted into the batching queue"),
+                ("responses_total", self.responses_total,
+                 "requests answered with a result"),
+                ("errors_total", self.errors_total,
+                 "requests failed by a batch execution error"),
+                ("batches_total", self.batches_total,
+                 "engine batches executed"),
+                ("batch_rows_total", self.batch_rows_total,
+                 "real request rows executed"),
+                ("batch_slots_total", self.batch_slots_total,
+                 "bucket slots executed (rows + padding)"),
+            ]
+            rejected = dict(self.rejected)
+        for metric, value, help_ in counters:
+            emit(metric, value, help_, mtype="counter")
+        lines.append(f"# HELP {n}_rejected_total requests rejected before "
+                     "batching, by reason")
+        lines.append(f"# TYPE {n}_rejected_total counter")
+        for reason in sorted(rejected):
+            lines.append(
+                f'{n}_rejected_total{{reason="{reason}"}} {rejected[reason]}')
+        emit("queue_depth", self.queue_depth(), "requests waiting in queue")
+        emit("batch_occupancy_mean", f"{self.mean_occupancy:.6f}",
+             "mean real rows per executed batch")
+        emit("padding_waste_ratio", f"{self.padding_waste:.6f}",
+             "fraction of executed slots that held padding")
+        lines.append(f"# HELP {n}_latency_seconds request wall latency "
+                     "(submit to response), recent-window quantiles")
+        lines.append(f"# TYPE {n}_latency_seconds summary")
+        for q, v in lat.items():
+            lines.append(
+                f'{n}_latency_seconds{{quantile="0.{q}"}} {v:.6f}')
+        lines.append(f"{n}_latency_seconds_count {self.latency.count}")
+        lines.append(f"# HELP {n}_batch_time_seconds engine batch execution "
+                     "time, recent-window quantiles")
+        lines.append(f"# TYPE {n}_batch_time_seconds summary")
+        for q, v in bt.items():
+            lines.append(
+                f'{n}_batch_time_seconds{{quantile="0.{q}"}} {v:.6f}')
+        lines.append(f"{n}_batch_time_seconds_count {self.batch_time.count}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,363 @@
+"""Stdlib HTTP front-end + CLI for the serving runtime.
+
+The reference served models from C++ services over the C API; the
+TPU-native runtime's front door is a dependency-free JSON/HTTP server on
+``http.server.ThreadingHTTPServer`` — each connection thread blocks on its
+request's Future while the single batcher thread forms engine batches, so
+concurrency comes from the batcher, not from the HTTP layer.
+
+Endpoints:
+  POST /v1/infer   {"feed": {slot: array}, "deadline_ms": optional}
+                   -> {"outputs": ..., "latency_ms": ...}
+                   errors map to status codes: invalid feed/JSON 400,
+                   overload 429, shutdown 503, deadline 504, batch
+                   failure 500 — always a JSON body with "error".
+  GET  /healthz    200 {"status": "ok", ...} (503 once draining)
+  GET  /metrics    Prometheus text (serving/metrics.py)
+
+CLI (``python -m paddle_tpu.serving``):
+  --artifact model.shlo            one-bucket exported artifact
+  --artifacts 'model.b*.shlo'      bucket ladder (export.export_bucketed)
+  --demo                           built-in tiny MLP (smoke/bring-up)
+  --buckets 1,4,16 --port N --max-delay-ms --queue-size --deadline-ms
+  --smoke                          self-test: ephemeral port, concurrent
+                                   requests, /metrics sanity, ONE JSON
+                                   line, exit code (healthy_window.sh's
+                                   serving phase)
+
+The JSON front-end serves plain-array feed slots (dense/index vectors);
+structured SequenceBatch slots are an in-process engine feature.
+SIGTERM drains gracefully: stop admissions, finish queued requests,
+answer in-flight connections, then exit.
+"""
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import jax
+
+from paddle_tpu.serving.batcher import (Batcher, DeadlineExceededError,
+                                        OverloadedError, ShutdownError)
+from paddle_tpu.serving.engine import InferenceEngine, InvalidRequestError
+from paddle_tpu.utils.logging import logger
+
+_STATUS = ((InvalidRequestError, 400), (OverloadedError, 429),
+           (ShutdownError, 503), (DeadlineExceededError, 504))
+
+
+def _json_to_row(engine, obj):
+    """JSON feed dict -> per-row numpy feed matching the engine spec
+    (dtype cast here; shape checking is the engine's job)."""
+    if not isinstance(obj, dict):
+        raise InvalidRequestError("'feed' must be an object of "
+                                  "{slot: array}")
+    spec_row = engine.bucket_spec(1)
+    if not isinstance(spec_row, dict):
+        raise InvalidRequestError(
+            "this model's feed is not a flat dict; the JSON front-end "
+            "serves plain-array slots only")
+    row = {}
+    for name, sds in spec_row.items():
+        if not isinstance(sds, jax.ShapeDtypeStruct):
+            raise InvalidRequestError(
+                f"feed slot {name!r} is structured (SequenceBatch); the "
+                "JSON front-end serves plain-array slots only")
+        if name not in obj:
+            raise InvalidRequestError(f"missing feed slot {name!r}")
+        try:
+            row[name] = np.asarray(obj[name], dtype=sds.dtype)
+        except (TypeError, ValueError) as e:
+            raise InvalidRequestError(
+                f"feed slot {name!r}: cannot convert to {sds.dtype}: {e}") \
+                from e
+    extra = sorted(set(obj) - set(spec_row))
+    if extra:
+        raise InvalidRequestError(f"unknown feed slot(s) {extra}")
+    return row
+
+
+def _to_jsonable(tree):
+    return jax.tree_util.tree_map(lambda a: np.asarray(a).tolist(), tree)
+
+
+class ServingHandler(BaseHTTPRequestHandler):
+    # one server == one model; the batcher hangs off the server object
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # route access logs to our logger
+        logger.debug("http: " + fmt, *args)
+
+    def _reply(self, code, payload, content_type="application/json"):
+        body = (payload if isinstance(payload, bytes)
+                else json.dumps(payload).encode())
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # ------------------------------------------------------------ GET
+
+    def do_GET(self):
+        batcher = self.server.batcher
+        if self.path == "/healthz":
+            draining = batcher.closed
+            self._reply(503 if draining else 200, {
+                "status": "draining" if draining else "ok",
+                "model": batcher.engine.name,
+                "buckets": list(batcher.engine.buckets),
+                "queue_depth": batcher.metrics.queue_depth(),
+            })
+        elif self.path == "/metrics":
+            self._reply(200, batcher.metrics.render_prometheus().encode(),
+                        content_type="text/plain; version=0.0.4")
+        else:
+            self._reply(404, {"error": f"no route {self.path!r}"})
+
+    # ------------------------------------------------------------ POST
+
+    def do_POST(self):
+        if self.path != "/v1/infer":
+            self._reply(404, {"error": f"no route {self.path!r}"})
+            return
+        t0 = time.perf_counter()
+        batcher = self.server.batcher
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            try:
+                req = json.loads(self.rfile.read(length) or b"")
+            except ValueError as e:
+                raise InvalidRequestError(f"malformed JSON: {e}") from e
+            if not isinstance(req, dict) or "feed" not in req:
+                raise InvalidRequestError('body must be {"feed": {...}}')
+            deadline_ms = req.get("deadline_ms")
+            if deadline_ms is not None and (
+                    not isinstance(deadline_ms, (int, float))
+                    or deadline_ms <= 0):
+                raise InvalidRequestError("deadline_ms must be a positive "
+                                          "number")
+            row = _json_to_row(batcher.engine, req["feed"])
+            fut = batcher.submit(row, deadline_ms=deadline_ms)
+            # bounded wait: batch errors surface here; the timeout is a
+            # backstop against a wedged engine, not a policy knob (use
+            # deadline_ms for per-request deadlines)
+            out = fut.result(timeout=600)
+            self._reply(200, {
+                "outputs": _to_jsonable(out),
+                "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            })
+        except Exception as e:    # noqa: BLE001 — every error is a response
+            for etype, code in _STATUS:
+                if isinstance(e, etype):
+                    break
+            else:
+                code = 500
+            self._reply(code, {"error": f"{type(e).__name__}: {e}"})
+
+
+def make_server(batcher, host="127.0.0.1", port=0):
+    """Bind (port 0 = ephemeral) and return the server; caller runs
+    ``serve_forever()``.  ``server.port`` carries the bound port."""
+    httpd = ThreadingHTTPServer((host, port), ServingHandler)
+    httpd.daemon_threads = True
+    httpd.batcher = batcher
+    httpd.port = httpd.server_address[1]
+    return httpd
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def _demo_engine(buckets, warm=True):
+    """Built-in tiny MLP engine — bring-up/smoke without an artifact."""
+    from paddle_tpu.layers import api as L
+    from paddle_tpu.layers.graph import Topology, reset_names
+    reset_names()
+    x = L.data_layer("serving_demo_x", size=16)
+    h = L.fc_layer(input=x, size=32, act="tanh")
+    out = L.fc_layer(input=h, size=4, act="softmax")
+    params = Topology([out]).init(jax.random.PRNGKey(0))
+    spec = {"serving_demo_x": jax.ShapeDtypeStruct((1, 16), np.float32)}
+    return InferenceEngine.from_topology(out, params, spec, buckets=buckets,
+                                         warm=warm, name="demo")
+
+
+def _build_engine(args):
+    if args.artifact:
+        return InferenceEngine.from_artifact(args.artifact)
+    if args.artifacts:
+        return InferenceEngine.from_artifacts(args.artifacts)
+    if args.demo:
+        buckets = tuple(int(b) for b in args.buckets.split(","))
+        return _demo_engine(buckets)
+    raise SystemExit("serving: pass one of --artifact PATH, "
+                     "--artifacts GLOB, --demo")
+
+
+def _zeros_row_json(engine, fill=0.5):
+    """A valid JSON feed for this engine's spec (smoke traffic)."""
+    row = {}
+    for name, sds in engine.bucket_spec(1).items():
+        shape = tuple(sds.shape[1:])
+        if np.issubdtype(sds.dtype, np.integer):
+            row[name] = np.zeros(shape, sds.dtype).tolist()
+        else:
+            row[name] = np.full(shape, fill, sds.dtype).tolist()
+    return row
+
+
+def _smoke(batcher, n_requests=8):
+    """Self-contained serving smoke: ephemeral port, n concurrent HTTP
+    requests, a malformed request, /healthz + /metrics sanity.  Prints ONE
+    JSON line; returns the process exit code (healthy_window.sh phase)."""
+    import urllib.error
+    import urllib.request
+
+    httpd = make_server(batcher, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.port}"
+    feed = _zeros_row_json(batcher.engine)
+    ok = [0]
+    errs = []
+
+    def hit(i):
+        body = json.dumps({"feed": feed}).encode()
+        try:
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/v1/infer", data=body,
+                    headers={"Content-Type": "application/json"}),
+                    timeout=30) as r:
+                resp = json.loads(r.read())
+                if "outputs" in resp:
+                    ok[0] += 1
+        except Exception as e:    # noqa: BLE001
+            errs.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=hit, args=(i,))
+               for i in range(n_requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+
+    # malformed JSON must 400 without wounding the engine
+    bad_status = None
+    try:
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/v1/infer", data=b"{not json",
+            headers={"Content-Type": "application/json"}), timeout=30)
+    except urllib.error.HTTPError as e:
+        bad_status = e.code
+    with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+        health = json.loads(r.read())
+    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+        metrics_text = r.read().decode()
+
+    snap = batcher.metrics.snapshot()
+    name = batcher.metrics.name
+    metrics_sane = (
+        f"{name}_requests_total {snap['requests_total']}" in metrics_text
+        and f"{name}_batches_total" in metrics_text
+        and 'latency_seconds{quantile="0.50"}' in metrics_text
+        and snap["responses_total"] == ok[0]
+        and snap["batches_total"] >= 1)
+    out = {
+        "metric": "serving smoke (dynamic batcher + HTTP front-end)",
+        "value": ok[0], "unit": f"requests_ok/{n_requests}",
+        "vs_baseline": None,
+        "bad_request_status": bad_status,
+        "healthz": health.get("status"),
+        "metrics_sane": bool(metrics_sane),
+        "mean_occupancy": snap["mean_occupancy"],
+        "p50_ms": snap["latency_ms"]["p50"],
+        "p99_ms": snap["latency_ms"]["p99"],
+    }
+    if errs:
+        out["errors"] = errs[:5]
+    httpd.shutdown()
+    batcher.close()
+    print(json.dumps(out), flush=True)
+    passed = (ok[0] == n_requests and bad_status == 400
+              and health.get("status") == "ok" and metrics_sane)
+    return 0 if passed else 2
+
+
+def main(argv=None):
+    from paddle_tpu.utils.flags import FLAGS
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.serving",
+        description="dynamic-batching inference server")
+    ap.add_argument("--artifact", help="exported StableHLO artifact")
+    ap.add_argument("--artifacts",
+                    help="glob of bucketed artifacts (model.b*.shlo)")
+    ap.add_argument("--demo", action="store_true",
+                    help="serve the built-in tiny MLP")
+    ap.add_argument("--buckets", default=FLAGS.serving_buckets,
+                    help="batch bucket ladder for --demo (artifacts carry "
+                         "their own)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=FLAGS.serving_port)
+    ap.add_argument("--max-batch-size", type=int,
+                    default=FLAGS.serving_max_batch_size or None)
+    ap.add_argument("--max-delay-ms", type=float,
+                    default=FLAGS.serving_max_delay_ms)
+    ap.add_argument("--queue-size", type=int,
+                    default=FLAGS.serving_queue_size)
+    ap.add_argument("--deadline-ms", type=float,
+                    default=FLAGS.serving_deadline_ms or None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-test on an ephemeral port, print one JSON "
+                         "line, exit")
+    args = ap.parse_args(argv)
+    if args.smoke and not (args.artifact or args.artifacts):
+        args.demo = True
+    if args.smoke:
+        # a generous batch window so the smoke's concurrent clients
+        # reliably coalesce (the occupancy>1 assertion) even on a loaded
+        # CI machine
+        args.max_delay_ms = max(args.max_delay_ms, 50.0)
+
+    engine = _build_engine(args)
+    batcher = Batcher(engine, max_batch_size=args.max_batch_size,
+                      max_delay_ms=args.max_delay_ms,
+                      queue_size=args.queue_size,
+                      default_deadline_ms=args.deadline_ms)
+    if args.smoke:
+        return _smoke(batcher)
+
+    httpd = make_server(batcher, args.host, args.port)
+    logger.info("serving %s on http://%s:%d (buckets %s, max_delay %.1fms, "
+                "queue %d)", engine.name, args.host, httpd.port,
+                list(engine.buckets), args.max_delay_ms, args.queue_size)
+
+    def _drain(signum, frame):
+        logger.info("SIGTERM: draining (no new admissions, finishing "
+                    "queued requests)")
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+    try:
+        signal.signal(signal.SIGTERM, _drain)
+        signal.signal(signal.SIGINT, _drain)
+    except ValueError:
+        pass        # not the main thread (embedded use)
+    try:
+        httpd.serve_forever()
+    finally:
+        # order matters: the drain resolves every in-flight future, THEN
+        # server_close() joins the handler threads (block_on_close) so
+        # their responses reach the sockets before the interpreter exits
+        # — otherwise the work the drain completed is dropped on the wire
+        batcher.close(drain=True)
+        httpd.server_close()
+        logger.info("serving stopped; %d responses served",
+                    batcher.metrics.responses_total)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1137,6 +1137,9 @@ class Inferencer:
                     if hasattr(x, "dtype") and x.dtype == compute_dtype
                     else x, out)
             return out
+        # the raw (un-jitted) forward is the hook serving.InferenceEngine
+        # wraps to AOT-compile one executable per batch bucket
+        self._fwd = fwd
         self._fn = jax.jit(fwd)
 
     def infer(self, feed_or_batch, feeding=None):

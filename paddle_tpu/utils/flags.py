@@ -82,6 +82,16 @@ class Flags:
     # read instead of a compile.  None = off (JAX default).
     jax_compilation_cache_dir: Optional[str] = None
 
+    # ---- serving runtime (serving/: dynamic batcher + HTTP front-end;
+    # the reference served through C++ services over the C API with no
+    # batching layer, so these are TPU-native)
+    serving_port: int = 8080
+    serving_buckets: str = "1,4,16,64"
+    serving_max_batch_size: int = 0     # 0 = the bucket ladder's top
+    serving_max_delay_ms: float = 5.0
+    serving_queue_size: int = 256
+    serving_deadline_ms: float = 0.0    # 0 = no per-request deadline
+
     # ---- observability (new floor; reference had host timers only)
     profile_dir: Optional[str] = None   # capture an xprof trace of training
     debug_nans: bool = False            # NaN -> immediate error with op
@@ -211,6 +221,17 @@ FLAG_DOCS = {
     "jax_compilation_cache_dir": ("opt-in persistent XLA compile cache "
                                   "(AOT bucket warm-up survives restarts)",
                                   "—"),
+    "serving_port": ("HTTP port for python -m paddle_tpu.serving", "—"),
+    "serving_buckets": ("batch bucket ladder (comma ints) the serving "
+                        "engine AOT-compiles", "—"),
+    "serving_max_batch_size": ("largest dynamic batch formed (0 = the "
+                               "bucket ladder's top)", "—"),
+    "serving_max_delay_ms": ("how long the first queued request waits "
+                             "for batch co-riders", "—"),
+    "serving_queue_size": ("admission bound; a full queue rejects with "
+                           "HTTP 429", "—"),
+    "serving_deadline_ms": ("default per-request deadline (0 = none); "
+                            "expired requests fail with HTTP 504", "—"),
     "profile_dir": ("capture an xprof/TensorBoard device trace", "—"),
     "debug_nans": ("fail fast on the op producing a NaN",
                    "feenableexcept (TrainerMain.cpp)"),

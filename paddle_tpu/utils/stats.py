@@ -96,16 +96,29 @@ class Histogram:
     """Step-duration histogram with percentile summary (TPU-native stand-in
     for the reference's BarrierStat worker-skew profiling,
     utils/BarrierStat.h:196-273 — in synchronous SPMD the interesting skew
-    is the per-step duration distribution)."""
+    is the per-step duration distribution).
 
-    def __init__(self, name, max_samples=10000):
+    keep="first" (default) freezes the first max_samples observations — the
+    right bound for a training pass that resets each pass.  keep="last"
+    turns the buffer into a ring holding the most recent max_samples — the
+    right bound for a long-running server whose recent latency is the one
+    that matters (serving/metrics.py)."""
+
+    def __init__(self, name, max_samples=10000, keep="first"):
         self.name = name
         self.samples = []
         self.max_samples = max_samples
+        if keep not in ("first", "last"):
+            raise ValueError(f"keep={keep!r} (supported: 'first', 'last')")
+        self.keep = keep
+        self.count = 0          # total observed, including evicted
 
     def add(self, seconds):
+        self.count += 1
         if len(self.samples) < self.max_samples:
             self.samples.append(seconds)
+        elif self.keep == "last":
+            self.samples[(self.count - 1) % self.max_samples] = seconds
 
     def percentiles(self, qs=(50, 90, 99)):
         import numpy as np
@@ -122,6 +135,7 @@ class Histogram:
 
     def reset(self):
         self.samples = []
+        self.count = 0
 
 
 step_histogram = Histogram("train_step")

@@ -1,10 +1,66 @@
-"""paddle.v2.inference (reference v2/inference.py:11-73)."""
+"""paddle.v2.inference (reference v2/inference.py:11-73), routed through
+the serving runtime's bucketed AOT engine.
 
-from paddle_tpu.trainer.trainer import Inferencer
+The reference's ``Inference`` wrapped the GradientMachine in test mode;
+here it wraps ``serving.InferenceEngine``: the forward is AOT-compiled
+once per batch bucket (ladder 1/4/16/64 by default), each ``infer`` batch
+pads to the nearest bucket and slices back, and repeated calls at ragged
+batch sizes never retrace.  Buckets compile lazily (first use), so a
+one-shot ``infer`` costs one compile exactly like the old direct path.
+
+Row results are independent of padding and co-batched rows, so routing
+through the engine is a pure execution change — outputs match the direct
+forward bit-for-bit (tests/test_serving.py parity test).
+"""
+
+from paddle_tpu.trainer.trainer import Inferencer, _normalize_feed
+from paddle_tpu.data.feeder import DataFeeder
+
+
+class Inference:
+    """v2-style inference object over the bucketed engine.
+
+    output_layer: LayerOutput (or list); parameters: v2 Parameters or a
+    raw pytree; buckets: batch ladder (default serving.DEFAULT_BUCKETS);
+    larger batches chunk at the ladder top."""
+
+    def __init__(self, output_layer, parameters, model_state=None,
+                 buckets=None):
+        from paddle_tpu.v2.parameters import Parameters
+        tree = parameters.tree if isinstance(parameters, Parameters) \
+            else parameters
+        self._inferencer = Inferencer(output_layer, tree,
+                                      model_state=model_state)
+        self._buckets = buckets
+        self._engines = {}      # row signature -> engine (sequence slots
+        #                         pad per batch, so each padded length is
+        #                         its own bucket ladder)
+
+    def _engine_for(self, feed):
+        import numpy as np
+        import jax
+        from paddle_tpu.serving import DEFAULT_BUCKETS, InferenceEngine
+        leaves, treedef = jax.tree_util.tree_flatten(feed)
+        sig = (treedef, tuple((tuple(np.shape(l)[1:]), np.dtype(l.dtype))
+                              for l in leaves))
+        eng = self._engines.get(sig)
+        if eng is None:
+            eng = self._engines[sig] = InferenceEngine.from_inferencer(
+                self._inferencer, feed_spec=feed,
+                buckets=self._buckets or DEFAULT_BUCKETS,
+                warm=False, name="v2.infer")
+        return eng
+
+    def infer(self, input, feeding=None):
+        if feeding is not None and not isinstance(input, dict):
+            feeder = feeding if isinstance(feeding, DataFeeder) \
+                else DataFeeder(feeding)
+            feed = feeder(input)
+        else:
+            feed = input
+        feed = _normalize_feed(feed)
+        return self._engine_for(feed).infer(feed)
 
 
 def infer(output_layer, parameters, input, feeding=None):
-    from paddle_tpu.v2.parameters import Parameters
-    tree = parameters.tree if isinstance(parameters, Parameters) \
-        else parameters
-    return Inferencer(output_layer, tree).infer(input, feeding=feeding)
+    return Inference(output_layer, parameters).infer(input, feeding=feeding)

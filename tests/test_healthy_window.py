@@ -45,7 +45,8 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "bench_int8.json", "diff_cpu.npz", "diff_tpu.npz",
                  "tpu_differential_pytest.log", "nmt_scale.json",
                  "perf_report.md", "analytic.json",
-                 "analytic_snapshot.json", "WINDOW_DONE"):
+                 "analytic_snapshot.json", "serving_smoke.json",
+                 "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -59,9 +60,19 @@ def test_dryrun_executes_every_phase(tmp_path):
     for combo, row in sweep["sweep"].items():
         assert row.get("value") is not None, (combo, row)
     snap = json.loads((art / "analytic_snapshot.json").read_text())
-    assert set(snap["families"]) == {"smallnet", "trainer_prefetch"}
+    assert set(snap["families"]) == {"smallnet", "trainer_prefetch",
+                                     "serving"}
     for fam, row in snap["families"].items():
         assert row.get("predicted_ms", 0) > 0, (fam, row)
+    # the serving smoke really served: every request answered, the
+    # malformed request 400'd, /metrics rendered sanely, and batching
+    # happened (occupancy > 1 under the smoke's concurrent clients)
+    smoke_srv = json.loads((art / "serving_smoke.json").read_text())
+    assert smoke_srv["value"] == int(smoke_srv["unit"].split("/")[1]), \
+        smoke_srv
+    assert smoke_srv["bad_request_status"] == 400, smoke_srv
+    assert smoke_srv["metrics_sane"] is True, smoke_srv
+    assert smoke_srv["mean_occupancy"] > 1.0, smoke_srv
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows
