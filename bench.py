@@ -23,7 +23,10 @@ transformers; transformer_decode times the KV-cached serving beam search).
 A bare family name also works positionally: `python bench.py serving`
 drives the serving RUNTIME (paddle_tpu/serving dynamic batcher) at several
 closed-loop load levels and reports batched vs batch-size-1 throughput,
-tail latency, and mean batch occupancy.  Other overrides:
+tail latency, and mean batch occupancy; `python bench.py serving_generate`
+drives the continuous-batching GENERATION engine (serving/decode_engine)
+against the sequential whole-batch policy at 2/8/32 clients and reports
+useful tokens/s, p99 TTFT, and slot occupancy for both.  Other overrides:
 BENCH_STEPS, BENCH_BATCH, BENCH_INIT_TIMEOUT, BENCH_BUILD_TIMEOUT (eager
 param init; wider default since each distinct shape compiles through the
 tunnel), BENCH_COMPILE_TIMEOUT,
@@ -970,6 +973,131 @@ def bench_serving_engine(batch=32, dim=256, hidden=1024, classes=32,
         f"buckets {list(ladder)}, delay {max_delay_ms:g}ms)"), extras
 
 
+def bench_serving_generate(slots=8, n_requests=64, vocab=256, d_model=128,
+                           dff=256, layers=3, heads=2,
+                           prefill_buckets=(8, 16), gen_short=4,
+                           gen_long=48, seed=0):
+    """Continuous-batching generation serving (serving/decode_engine.py):
+    closed-loop clients stream /v1/generate-shaped requests (mixed prompt
+    lengths, mixed max_tokens — mostly short answers, some long ones)
+    through the slot-based decode engine, against the SAME engine run
+    under the sequential whole-batch policy (GenerationBatcher
+    admission="gang": fill the slab, ride every row to the slowest one,
+    only then admit more — what lm_generate's fixed-batch decode does).
+    Same compiled slab step, same prefill ladder: the sweep isolates
+    exactly what continuous admission/eviction buys.
+
+    Headline: useful tokens/sec at 8 clients, continuous.  extras carry
+    the 2/8/32-client sweep for BOTH policies (tokens/s, p50/p99 TTFT,
+    slot occupancy), the continuous-vs-gang speedups, and the analytic
+    AOT hook (extras["lower"]: the slab decode step's Lowered)."""
+    import jax
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving import GenerationBatcher, ServingMetrics
+    from paddle_tpu.serving.decode_engine import DecodeEngine
+
+    gen_cap = gen_long
+    max_len = prefill_buckets[-1] + gen_cap
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=1, d_model=d_model, dff=dff,
+                              enc_layers=layers, dec_layers=0,
+                              max_len=max_len, num_heads=heads)
+    engine = DecodeEngine(params, num_heads=heads, num_slots=slots,
+                          max_len=max_len, prefill_buckets=prefill_buckets,
+                          name="bench_gen",
+                          warm=os.environ.get("BENCH_ANALYTIC_BUILD") != "1")
+    rng = np.random.RandomState(seed)
+    # the serving-shaped mix: 3/4 short completions, 1/4 long ones — the
+    # exact shape where whole-batch decode burns finished rows' steps
+    reqs = [(rng.randint(1, vocab, rng.randint(3, prefill_buckets[-1] + 1)
+                         ).astype(np.int32),
+             gen_long if i % 4 == 0 else gen_short)
+            for i in range(n_requests)]
+
+    def drive(mode, n_clients, reqs):
+        """One closed-loop level under one admission policy."""
+        engine.metrics = ServingMetrics()
+        bat = GenerationBatcher(engine, queue_size=4096, admission=mode)
+        ttfts, lock, nxt = [], threading.Lock(), [0]
+        tokens = [0]
+
+        def client():
+            while True:
+                with lock:
+                    i = nxt[0]
+                    if i >= len(reqs):
+                        return
+                    nxt[0] += 1
+                prompt, mt = reqs[i]
+                out = bat.submit(prompt, max_tokens=mt).result(300)
+                with lock:
+                    ttfts.append(out["ttft_ms"])
+                    tokens[0] += len(out["tokens"])
+
+        ts = [threading.Thread(target=client) for _ in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        bat.close()
+        ttfts.sort()
+        snap = engine.metrics.snapshot()
+        return {"clients": n_clients, "mode": mode,
+                "tokens_per_s": round(tokens[0] / dt, 1),
+                "ttft_p50_ms": round(ttfts[len(ttfts) // 2], 2),
+                "ttft_p99_ms": round(ttfts[min(len(ttfts) - 1,
+                                               int(len(ttfts) * 0.99))], 2),
+                "mean_slot_occupancy": snap["mean_slot_occupancy"]}
+
+    def best_of(mode, n_clients, reqs, n=2):
+        """Best throughput of n runs, for BOTH policies symmetrically:
+        client threads contend with the decode worker for cores, so on a
+        small host a single closed-loop run can lose a large slice of
+        wall time to the scheduler; the best run is the one least
+        distorted by that noise."""
+        runs = [drive(mode, n_clients, reqs) for _ in range(n)]
+        return max(runs, key=lambda r: r["tokens_per_s"])
+
+    extras = {"lower": lambda: engine.lower()}
+    if os.environ.get("BENCH_ANALYTIC_BUILD") != "1":
+        drive("continuous", 8, reqs[:16])       # warm the whole path
+        sweep = []
+        for c in (2, 8, 32):
+            cont = best_of("continuous", c, reqs)
+            gang = best_of("gang", c, reqs)
+            sweep.append({"clients": c, "continuous": cont, "gang": gang,
+                          "speedup": round(cont["tokens_per_s"]
+                                           / gang["tokens_per_s"], 2)})
+        at8 = sweep[1]
+        extras.update(
+            load_sweep=sweep,
+            continuous_tokens_per_s=at8["continuous"]["tokens_per_s"],
+            continuous_ttft_p99_ms=at8["continuous"]["ttft_p99_ms"],
+            gang_tokens_per_s=at8["gang"]["tokens_per_s"],
+            gang_ttft_p99_ms=at8["gang"]["ttft_p99_ms"],
+            mean_slot_occupancy=at8["continuous"]["mean_slot_occupancy"],
+            continuous_speedup=at8["speedup"])
+
+    def run(s):
+        r = drive("continuous", 8, reqs)
+        return np.float32(r["tokens_per_s"])
+
+    # executed decode compute of one burst: every step runs the whole
+    # [slots]-row slab; ideal-occupancy step count = useful tokens / slots
+    total_tokens = sum(mt for _, mt in reqs)
+    per_tok = layers * (6 * d_model ** 2 + 2 * d_model * dff) \
+        + d_model * vocab
+    attn = layers * 4.0 * d_model * max_len * max_len / 2
+    flops = (2.0 * per_tok + attn / max_len) * slots \
+        * (total_tokens / slots)
+    return run, flops, None, (
+        f"generation serving ms/burst ({n_requests} reqs, 8 clients, "
+        f"{slots} slots, prefill {list(prefill_buckets)}, "
+        f"max_tokens {gen_short}/{gen_long})"), extras
+
+
 def bench_trainer_prefetch(batch=64, dim=256, hidden=512, n_batches=24,
                            host_ms=4.0):
     """Trainer hot-loop input overlap: steps/s with the input pipeline
@@ -1082,6 +1210,10 @@ _BENCHES = {
     # the serving RUNTIME row (paddle_tpu/serving): dynamic batcher +
     # bucketed AOT engine under closed-loop load, batched vs batch-size-1
     "serving": (lambda b: bench_serving_engine(batch=b), 32),
+    # continuous-batching GENERATION serving (serving/decode_engine.py):
+    # slot-based KV-slab decode vs sequential whole-batch at 2/8/32
+    # clients; b = the slot count
+    "serving_generate": (lambda b: bench_serving_generate(slots=b), 8),
     "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),
     # input-pipeline overlap row: steps/s at train(prefetch=0) vs 2 on a
     # synthetic input-bound workload (the ShardedPrefetcher's win)

@@ -699,6 +699,64 @@ def lm_decode_step(params, prev_ids, t, cache, num_heads=8,
     return _lm_project(params, x)[:, 0], new_cache
 
 
+def _cached_self_attn_slots(blk, x, c, positions, pos_mask, num_heads,
+                            rope_pos=None):
+    """``_cached_self_attn`` with a PER-ROW position vector: row r writes
+    its K/V at its own ``positions[r]`` (scatter instead of a shared
+    dynamic slice) and attends under its own mask row.  Row r's compute is
+    exactly ``_cached_self_attn``'s at t=positions[r] — every matmul here
+    is batched over the leading axis ([S, 1, D] @ [D, H]), so a row's
+    numerics do not depend on what the other slots are doing.  The
+    continuous-batching decode slab (serving/decode_engine.py) runs on
+    this."""
+    h = _ln(blk["ln1"], x)
+    k_new = linear.matmul(h, blk["attn"]["wk"])
+    q = linear.matmul(h, blk["attn"]["wq"])
+    if rope_pos is not None:
+        dh = q.shape[-1] // num_heads
+        k_new = _rope_flat(k_new, rope_pos, dh)
+        q = _rope_flat(q, rope_pos, dh)
+    v_new = linear.matmul(h, blk["attn"]["wv"])
+    rows = jnp.arange(positions.shape[0])
+    k = c["k"].at[rows, positions].set(k_new[:, 0])
+    v = c["v"].at[rows, positions].set(v_new[:, 0])
+    att = _attend(q, k, v, num_heads, pos_mask)
+    return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
+
+
+def lm_decode_step_slots(params, prev_ids, positions, cache, num_heads=8,
+                         moe_top_k=2, pos_type="learned"):
+    """One incremental decode position for EVERY row of a slot slab, each
+    row at its OWN position — the continuous-batching twin of
+    ``lm_decode_step`` (which advances the whole batch at one shared t).
+
+    prev_ids [S], positions [S] int32; cache: per-enc-layer K/V
+    [S, max_len, Dkv] (``init_lm_cache``) -> (logits [S, V], new cache).
+    Row r computes exactly ``lm_decode_step``'s result at t=positions[r]:
+    the position row is gathered instead of sliced, the K/V write is a
+    per-row scatter, and the attention mask is per-row ``<= positions[r]``
+    — same values, same masked-softmax width (masked logits sit at -1e30,
+    whose exp is exactly 0.0, so cache width beyond a row's position never
+    perturbs its numerics).  tests/test_decode_engine.py pins the
+    per-request bit-identity against ``lm_generate``."""
+    s = prev_ids.shape[0]
+    max_len = cache[0]["k"].shape[1]
+    x = emb_ops.embedding_lookup(params["src_emb"], prev_ids)[:, None]
+    x = x * math.sqrt(x.shape[-1])
+    if pos_type == "learned":
+        x = x + params["pos"][positions][:, None]
+    rope_pos = positions[:, None] if pos_type == "rope" else None
+    pos_mask = jnp.arange(max_len)[None, :] <= positions[:, None]
+    pos_mask = jnp.broadcast_to(pos_mask, (s, max_len))
+    new_cache = []
+    for blk, c in zip(params["enc"], cache):
+        x, nc = _cached_self_attn_slots(blk, x, c, positions, pos_mask,
+                                        num_heads, rope_pos)
+        x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
+        new_cache.append(nc)
+    return _lm_project(params, x)[:, 0], new_cache
+
+
 def init_lm_cache(params, batch, max_len):
     """K/V buffers for lm_decode_step (mirrors init_decode_cache, but for
     the enc stack the LM trunk runs)."""

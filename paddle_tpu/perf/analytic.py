@@ -59,6 +59,9 @@ FAMILIES = [
     # executable via InferenceEngine.lower — gates the serving forward's
     # structure like the training families
     ("serving", "serving", None),
+    # continuous-batching generation (serving/decode_engine.py): the slab
+    # decode step via DecodeEngine.lower — the per-token serving hot path
+    ("serving_generate", "serving_generate", None),
     ("trainer_prefetch", "trainer_prefetch", None),
 ]
 
@@ -116,7 +119,9 @@ def capture(name, model, batch=None, chips=("v5e", "v5p")):
     # stream/burst — the lowered program there is one batch, so scopes
     # differ and the cross-check is omitted for them.
     bps = extras.get("batches_per_step")
-    if model in ("transformer_serving", "serving"):
+    if model in ("transformer_serving", "serving", "serving_generate"):
+        # the lowered program is one batch/slab step while the bench FLOPs
+        # model covers the whole stream/burst — scopes differ, no cross-check
         row["bench_model_flops"] = None
     else:
         row["bench_model_flops"] = model_flops / (bps or 1)

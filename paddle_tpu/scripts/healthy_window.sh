@@ -25,6 +25,9 @@
 #                                        last so it burns no window time)
 #  7. serving runtime smoke             (dynamic batcher + HTTP front-end
 #                                        self-test on an ephemeral port)
+#  8. generation serving smoke          (continuous-batching decode engine:
+#                                        concurrent staggered /v1/generate,
+#                                        streaming, EOS early-finish)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -50,7 +53,7 @@ if [ "$DRY" = "1" ]; then
     INT8_ARGS=(--combos "transformer_serving:4" --steps 2)
     DIFF_CASES="embedding"
     NMT_ARGS=(--vocab 200 --steps 4 --gen-sents 4 --beam 2 --max-gen-len 20)
-    ANALYTIC_FAMILIES="smallnet,trainer_prefetch,serving"
+    ANALYTIC_FAMILIES="smallnet,trainer_prefetch,serving,serving_generate"
     T_SERVE=600
 else
     T_SMOKE=1200; T_SWEEP=14400; T_COL=3600; T_DIFF=7200; T_NMT=7200
@@ -177,6 +180,14 @@ log "phase 7: serving runtime smoke (dynamic batcher + HTTP front-end)"
 timeout "$T_SERVE" python -m paddle_tpu.serving --smoke \
     > "$ART/serving_smoke.json" 2> "$ART/serving_smoke.log"
 log "serving smoke rc=$? -> $ART/serving_smoke.json"
+
+log "phase 8: generation serving smoke (continuous-batching decode engine)"
+# concurrent STAGGERED /v1/generate requests (admissions land mid-decode,
+# slots churn), one streaming request, EOS early-finish — one JSON line,
+# nonzero rc on any failed check (serving/server.py --smoke-generate)
+timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-generate \
+    > "$ART/serving_gen_smoke.json" 2> "$ART/serving_gen_smoke.log"
+log "generation smoke rc=$? -> $ART/serving_gen_smoke.json"
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

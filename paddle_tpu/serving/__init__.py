@@ -1,21 +1,31 @@
 """Serving runtime: dynamic-batching inference over bucketed AOT
-executables (docs/serving.md §3).
+executables (docs/serving.md §3) and continuous-batching generation
+(docs/serving.md §4).
 
-    engine.py   InferenceEngine — one XLA executable per batch bucket
-                (in-process forward or exported StableHLO ladder), pad to
-                bucket / slice back, warm-up, analytic lower() hook
-    batcher.py  Batcher — bounded queue + background batching thread,
-                futures, admission control, deadlines, graceful drain
-    server.py   JSON/HTTP front-end (/v1/infer, /healthz, /metrics) + CLI
-    metrics.py  ServingMetrics — latency percentiles, occupancy, padding
-                waste, queue depth; Prometheus text at /metrics
+    engine.py        InferenceEngine — one XLA executable per batch bucket
+                     (in-process forward or exported StableHLO ladder),
+                     pad to bucket / slice back, warm-up, analytic
+                     lower() hook
+    batcher.py       Batcher — bounded queue + background batching thread,
+                     futures, admission control, deadlines, graceful drain
+    decode_engine.py DecodeEngine + GenerationBatcher — slot-based
+                     continuous-batching LM decode over a fixed KV-cache
+                     slab (prefill through the bucketed engine ladder,
+                     per-token streaming, TTFT/TPOT metrics)
+    server.py        JSON/HTTP front-end (/v1/infer, /v1/generate,
+                     /healthz, /metrics) + CLI
+    metrics.py       ServingMetrics — latency/TTFT/TPOT percentiles,
+                     occupancy, padding waste, slot evictions, queue
+                     depth; Prometheus text at /metrics
 
     python -m paddle_tpu.serving --artifacts 'model.b*.shlo' --port 8080
+    python -m paddle_tpu.serving --demo-generate --port 8080
 """
 
 from paddle_tpu.serving.batcher import (BatchExecutionError, Batcher,
                                         DeadlineExceededError,
                                         OverloadedError, ShutdownError)
+from paddle_tpu.serving.decode_engine import DecodeEngine, GenerationBatcher
 from paddle_tpu.serving.engine import (DEFAULT_BUCKETS, InferenceEngine,
                                        InvalidRequestError)
 from paddle_tpu.serving.metrics import ServingMetrics
@@ -23,6 +33,7 @@ from paddle_tpu.serving.server import make_server
 
 __all__ = [
     "Batcher", "BatchExecutionError", "DeadlineExceededError",
-    "DEFAULT_BUCKETS", "InferenceEngine", "InvalidRequestError",
-    "OverloadedError", "ServingMetrics", "ShutdownError", "make_server",
+    "DecodeEngine", "DEFAULT_BUCKETS", "GenerationBatcher",
+    "InferenceEngine", "InvalidRequestError", "OverloadedError",
+    "ServingMetrics", "ShutdownError", "make_server",
 ]

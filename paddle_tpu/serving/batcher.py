@@ -97,7 +97,8 @@ class Batcher:
             # admission control this class exists to provide
             raise ValueError("queue_size must be >= 1")
         self._q = queue.Queue(maxsize=int(queue_size))
-        self.metrics.queue_depth_fn = self._q.qsize
+        self._depth_fn = self._q.qsize
+        self.metrics.queue_depth_fns.append(self._depth_fn)
         self._closed = threading.Event()
         # makes {closed-check + enqueue} atomic against close(): without
         # it a submit could slip its request into the queue after the
@@ -220,6 +221,12 @@ class Batcher:
         fail queued requests with ``ShutdownError``.  Idempotent."""
         with self._admit_lock:      # no submit can race past this point
             self._closed.set()
+        # stop contributing to a (possibly shared, longer-lived) metrics
+        # object's queue depth — a closed batcher's queue is not backlog
+        try:
+            self.metrics.queue_depth_fns.remove(self._depth_fn)
+        except ValueError:
+            pass                    # already removed (idempotent close)
         if not drain:
             while True:
                 try:

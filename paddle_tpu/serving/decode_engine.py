@@ -1,0 +1,747 @@
+"""Continuous-batching generation: slot-based KV-cache decode engine.
+
+``models/transformer.lm_generate`` decodes one fixed prompt batch end to
+end: a single long request holds the whole batch hostage, finished rows
+keep burning decode steps until the slowest row is done, and new
+requests wait for the entire batch to drain.  This module is the serving
+answer (Orca-style iteration-level scheduling over a vLLM-style slot
+slab):
+
+* ``DecodeEngine`` — a fixed-shape KV-cache SLAB ``[num_slots, max_len,
+  Dkv]`` per layer (``init_lm_cache`` machinery) plus per-slot position
+  counters.  ONE jitted decode step (``lm_decode_step_slots``) advances
+  every slot by one token; each row runs at its own position, so slots
+  hold unrelated requests at unrelated depths.  Admission and eviction
+  happen BETWEEN steps, entirely on the host: a freed slot's cache row is
+  overwritten wholesale at the next admission, so scheduling never
+  touches compiled code and the step traces exactly once at warm-up and
+  never again (``expect_traces`` discipline, shared with
+  ``InferenceEngine.warmup`` and ``SGD.precompile``).
+
+* Prefill rides the existing bucketed ``InferenceEngine`` ladder: one
+  engine per prompt-LENGTH bucket (each with its own batch-bucket
+  ladder), whose forward is ``lm_prefill`` + the last-real-position
+  logits — the exact composition ``lm_generate`` uses, so a request's
+  greedy stream is bit-identical to running it alone (the parity tests
+  pin this token for token).  Prompt compile cost is paid once per
+  (length bucket, batch bucket), never per request.
+
+* ``GenerationBatcher`` — the request front: bounded queue, per-request
+  deadlines (``DeadlineExceededError`` while queued), admission control
+  (``InvalidRequestError`` before the queue, ``OverloadedError`` on a
+  full queue), streaming ``on_token`` callbacks, graceful drain, and
+  batch-failure isolation (a step failure fails only the requests that
+  were in flight; the engine resets and keeps serving).
+
+Greedy decode only (temperature-0 argmax inside the jitted step): the
+deterministic serving mode whose numerics the oracle tests can pin.
+Sampling stays on ``lm_generate``.
+"""
+
+import queue
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.serving.batcher import (BatchExecutionError,
+                                        DeadlineExceededError,
+                                        OverloadedError, ShutdownError)
+from paddle_tpu.serving.engine import InferenceEngine, InvalidRequestError
+from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.testing.trace import expect_traces
+from paddle_tpu.utils.error import ConfigError
+from paddle_tpu.utils.logging import logger
+
+DEFAULT_PREFILL_BUCKETS = (32, 64)
+
+
+class DecodeEngine:
+    """Slot-based continuous-batching decoder over a decoder-only LM trunk
+    (``models/transformer`` params with ``dec_layers=0``).
+
+    params: the trunk pytree; num_slots: concurrent requests the slab
+    holds; max_len: slab length — every request must satisfy
+    ``len(prompt) + max_tokens <= max_len``; prefill_buckets: prompt-
+    length ladder (prompts pad up to the nearest bucket; the top bucket
+    caps prompt length); prefill_batch_buckets: the batch ladder each
+    prefill engine compiles; eos_id: default stop token (None = run to
+    max_tokens; per-request override at submit).
+
+    Slot lifecycle (docs/serving.md §4): FREE -> (prefill) -> ACTIVE
+    -> one emitted token per ``step()`` -> EVICTED (eos | length |
+    error | shutdown) -> FREE.  All bookkeeping is host-side numpy; the
+    device only ever sees the fixed-shape slab step and the fixed-shape
+    admission write.
+    """
+
+    def __init__(self, params, *, num_heads=8, num_slots=8, max_len=256,
+                 prefill_buckets=DEFAULT_PREFILL_BUCKETS,
+                 prefill_batch_buckets=(1, 4), eos_id=None, moe_top_k=2,
+                 pos_type="learned", metrics=None, name="lm", warm=True):
+        from paddle_tpu.models import transformer
+        self._transformer = transformer
+        if params.get("dec"):
+            raise ConfigError(
+                "DecodeEngine serves the decoder-only LM trunk "
+                "(init dec_layers=0); this params tree has a seq2seq "
+                "decoder stack — use generate_cached for that")
+        self.params = params
+        self.num_heads = int(num_heads)
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.eos_id = eos_id
+        self.moe_top_k = moe_top_k
+        self.pos_type = pos_type
+        self.name = name
+        self._metrics = metrics or ServingMetrics()
+        self.prefill_buckets = tuple(sorted(set(int(b)
+                                                for b in prefill_buckets)))
+        if not self.prefill_buckets or self.prefill_buckets[0] < 1:
+            raise ConfigError(f"bad prefill ladder {prefill_buckets!r}")
+        if self.prefill_buckets[-1] >= self.max_len:
+            raise ConfigError(
+                f"prefill bucket top {self.prefill_buckets[-1]} leaves no "
+                f"room to generate within max_len={self.max_len}")
+        if self.num_slots < 1:
+            raise ConfigError("num_slots must be >= 1")
+        # init_lm_cache validates max_len against the positional table
+        self._cache = transformer.init_lm_cache(params, self.num_slots,
+                                                self.max_len)
+        # host-side slot state: token fed at the NEXT step and the
+        # position it sits at; free slots idle at (0, 0) — their compute
+        # is discarded and their cache row is overwritten at admission
+        self._tokens = np.zeros((self.num_slots,), np.int32)
+        self._pos = np.zeros((self.num_slots,), np.int32)
+        self._free = list(range(self.num_slots))[::-1]   # pop() -> slot 0 first
+        self._prefill_batch_buckets = tuple(prefill_batch_buckets)
+        self._prefill_engines = {}     # length bucket -> InferenceEngine
+        self._step_traces = [0]
+
+        def _step_fn(p, cache, tokens, pos):
+            self._step_traces[0] += 1      # runs only under tracing
+            logits, cache = transformer.lm_decode_step_slots(
+                p, tokens, pos, cache, self.num_heads, self.moe_top_k,
+                self.pos_type)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        # donate the slab: the step rewrites one position per row, the
+        # rest is carried through — without donation every step would copy
+        # the whole [S, max_len, Dkv] cache
+        self._jit_step = jax.jit(_step_fn, donate_argnums=(1,))
+
+        def _admit_fn(cache, row, slot):
+            self._admit_traces[0] += 1
+            return jax.tree_util.tree_map(
+                lambda s, r: jax.lax.dynamic_update_slice(
+                    s, r[None].astype(s.dtype), (slot, 0, 0)), cache, row)
+
+        self._admit_traces = [0]
+        # jax.jit compiles one executable per distinct row prefix length
+        # (= prefill bucket); warm-up pays each bucket's trace up front
+        self._jit_admit = jax.jit(_admit_fn, donate_argnums=(0,))
+        self._warm = False
+        if warm:
+            self.warmup()
+
+    # ------------------------------------------------------------ prefill
+
+    def prefill_bucket_for(self, n):
+        """Smallest prompt-length bucket >= n, or None beyond the top."""
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b
+        return None
+
+    def _prefill_engine(self, bucket):
+        eng = self._prefill_engines.get(bucket)
+        if eng is not None:
+            return eng
+        params, transformer = self.params, self._transformer
+        trace_box = [0]
+
+        def fwd(feed):
+            trace_box[0] += 1
+            # cache at BUCKET length, not slab length: the admission
+            # write only needs the prompt prefix, so the device<->host
+            # round-trip per admission moves bucket-sized rows instead
+            # of max_len-sized ones
+            hidden, cache = transformer.lm_prefill(
+                params, feed["prompt"], bucket, self.num_heads,
+                self.moe_top_k, self.pos_type)
+            # the request's FIRST token comes from its last real
+            # position's hidden state — gather BEFORE the d_model x vocab
+            # projection, exactly like lm_generate
+            h_last = jnp.take_along_axis(
+                hidden, (feed["length"] - 1)[:, None, None], axis=1)
+            logits0 = transformer._lm_project(params, h_last)[:, 0]
+            return {"first_logits": logits0, "cache": cache}
+
+        spec = {"prompt": jax.ShapeDtypeStruct((1, bucket), np.int32),
+                "length": jax.ShapeDtypeStruct((1,), np.int32)}
+        eng = InferenceEngine(jitted=jax.jit(fwd), feed_spec=spec,
+                              buckets=self._prefill_batch_buckets,
+                              warm=False, name=f"{self.name}.prefill{bucket}",
+                              metrics=self.metrics, trace_box=trace_box)
+        self._prefill_engines[bucket] = eng
+        return eng
+
+    def prefill(self, prompts, lengths):
+        """Run prompts through the length-bucketed prefill ladder.
+
+        prompts: [n, L] int32 (rows padded to a common L <= the ladder
+        top; pad value is irrelevant — causal attention plus the decode
+        loop's own K/V rewrites keep it out of every real position);
+        lengths: [n] real lengths.  Returns (first_tokens [n] np.int32,
+        cache_rows: list of n per-layer {"k","v"} host-numpy rows
+        [bucket, Dkv] — BUCKET-length prefixes, which is all admission
+        writes into the slab; see ``admit``).
+        """
+        prompts = np.asarray(prompts, np.int32)
+        lengths = np.asarray(lengths, np.int32)
+        n, t = prompts.shape
+        bucket = self.prefill_bucket_for(t)
+        if bucket is None:
+            raise InvalidRequestError(
+                f"prompt length {t} exceeds the prefill ladder top "
+                f"{self.prefill_buckets[-1]}")
+        if t < bucket:
+            prompts = np.concatenate(
+                [prompts, np.zeros((n, bucket - t), np.int32)], axis=1)
+        out = self._prefill_engine(bucket).infer(
+            {"prompt": prompts, "length": lengths})
+        first = np.argmax(out["first_logits"], axis=-1).astype(np.int32)
+        rows = [jax.tree_util.tree_map(lambda l, i=i: l[i], out["cache"])
+                for i in range(n)]
+        return first, rows
+
+    # ------------------------------------------------------------ slots
+
+    @property
+    def free_slots(self):
+        return len(self._free)
+
+    @property
+    def num_active(self):
+        return self.num_slots - len(self._free)
+
+    @property
+    def step_trace_count(self):
+        """Traces of the slab decode step (the no-retrace discipline:
+        exactly 1 after warm-up, flat across admission/eviction churn).
+        ``lower()`` is an offline tool and re-stages (+1)."""
+        return self._step_traces[0]
+
+    @property
+    def metrics(self):
+        return self._metrics
+
+    @metrics.setter
+    def metrics(self, m):
+        # rewire the cached prefill engines too, so a metrics swap (the
+        # bench's per-drive reset) never strands the prefill plane's
+        # batch/latency stats on an orphaned object
+        self._metrics = m
+        for eng in self._prefill_engines.values():
+            eng.metrics = m
+
+    def admit(self, first_token, cache_row, length):
+        """Seat one prefilled request: write its bucket-length cache rows
+        into positions [0, bucket) of a free slot's slab row and arm the
+        slot at (first_token, position=length).  The row tail past the
+        bucket keeps whatever the previous occupant left there — safe by
+        the same argument that covers prompt padding: position p is
+        scatter-overwritten by the decode step in the same step that
+        first unmasks it.  Returns the slot id; raises if no slot is free
+        (callers check ``free_slots`` — the batcher never over-admits)."""
+        if not self._free:
+            raise RuntimeError(f"{self.name}: no free decode slot")
+        slot = self._free.pop()
+        self._cache = self._jit_admit(self._cache, cache_row,
+                                      np.int32(slot))
+        self._tokens[slot] = first_token
+        self._pos[slot] = length
+        return slot
+
+    def evict(self, slot, reason):
+        """Free a slot (between steps).  The cache row is left as-is —
+        the next admission overwrites it wholesale."""
+        self._tokens[slot] = 0
+        self._pos[slot] = 0
+        self._free.append(slot)
+        self.metrics.evict_slot(reason)
+
+    def step(self):
+        """Advance EVERY slot one position; returns the next token per
+        slot ([num_slots] np.int32).  Free slots compute too (fixed-shape
+        slab — that is the cost model) but their output is garbage the
+        caller ignores and their cache rows are overwritten at admission.
+        Callers then bump their active slots via ``advance``."""
+        t0 = time.perf_counter()
+        nxt, self._cache = self._jit_step(self.params, self._cache,
+                                          self._tokens, self._pos)
+        nxt = np.asarray(nxt)
+        self.metrics.observe_decode_step(self.num_active, self.num_slots,
+                                         time.perf_counter() - t0)
+        return nxt
+
+    def advance(self, slot, token):
+        """Record the token just emitted for ``slot``: it is fed at the
+        next step, one position further along."""
+        self._tokens[slot] = token
+        self._pos[slot] += 1
+
+    def reset(self):
+        """Drop all slot state and re-zero the cache slab (the batch-
+        failure isolation path: a failed step must not leak a poisoned
+        slab into the next batch)."""
+        self._cache = self._transformer.init_lm_cache(
+            self.params, self.num_slots, self.max_len)
+        self._tokens[:] = 0
+        self._pos[:] = 0
+        self._free = list(range(self.num_slots))[::-1]
+
+    # ------------------------------------------------------------ warm-up
+
+    def warmup(self):
+        """Compile + execute the slab step, the admission write, and every
+        prefill ladder engine before traffic, asserting the trace
+        discipline: the step's Python body traces exactly ONCE here and
+        never again in steady state (admission/eviction are host-side, so
+        churn cannot retrace by construction — the churn test pins it).
+        Idempotent: a second call only warms prefill buckets added since."""
+        for b in self.prefill_buckets:
+            self._prefill_engine(b).warmup()
+        if self._warm:
+            return
+        for b in self.prefill_buckets:
+            zero_row = jax.tree_util.tree_map(
+                lambda l: np.zeros((b,) + l.shape[2:], l.dtype),
+                self._cache)
+            with expect_traces(lambda: self._admit_traces[0], 1,
+                               f"decode[{self.name}]: bucket-{b} "
+                               "admission warm-up"):
+                self._cache = self._jit_admit(self._cache, zero_row,
+                                              np.int32(0))
+        with expect_traces(lambda: self.step_trace_count, 1,
+                           f"decode[{self.name}]: slab step warm-up",
+                           hint="the decode step is not shape-stable"):
+            nxt, self._cache = self._jit_step(
+                self.params, self._cache, self._tokens, self._pos)
+            jax.block_until_ready(nxt)
+        self._warm = True
+        logger.info("decode[%s]: warm (%d slots, max_len %d, prefill "
+                    "buckets %s)", self.name, self.num_slots, self.max_len,
+                    list(self.prefill_buckets))
+
+    def lower(self, what="step"):
+        """``jax.stages.Lowered`` of the slab decode step (default) or of
+        one prefill bucket (``what=<bucket int>``) — the ``extras
+        ["lower"]`` analytic hook (perf/analytic.py).  Offline tool: it
+        re-stages the function (one extra trace), like
+        ``InferenceEngine.lower``."""
+        if what == "step":
+            return self._jit_step.lower(self.params, self._cache,
+                                        self._tokens, self._pos)
+        return self._prefill_engine(int(what)).lower(
+            self._prefill_batch_buckets[-1])
+
+    # ------------------------------------------------------------ validate
+
+    def validate_request(self, prompt, max_tokens):
+        """Admission-control checks, raised BEFORE the queue."""
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise InvalidRequestError(
+                f"prompt must be a non-empty 1-D id sequence, got shape "
+                f"{prompt.shape}")
+        if not np.issubdtype(prompt.dtype, np.integer):
+            raise InvalidRequestError(
+                f"prompt must be integer token ids, got {prompt.dtype}")
+        if prompt.size > self.prefill_buckets[-1]:
+            raise InvalidRequestError(
+                f"prompt length {prompt.size} exceeds the prefill ladder "
+                f"top {self.prefill_buckets[-1]}")
+        try:
+            max_tokens = int(max_tokens)
+        except (TypeError, ValueError):
+            raise InvalidRequestError(
+                f"max_tokens must be an int, got {max_tokens!r}") from None
+        if max_tokens < 1:
+            raise InvalidRequestError(f"max_tokens={max_tokens} must be "
+                                      ">= 1")
+        if prompt.size + max_tokens > self.max_len:
+            raise InvalidRequestError(
+                f"prompt ({prompt.size}) + max_tokens ({max_tokens}) "
+                f"exceeds the engine max_len ({self.max_len})")
+        vocab = self.params["src_emb"].shape[0]
+        if prompt.size and (int(prompt.min()) < 0
+                            or int(prompt.max()) >= vocab):
+            raise InvalidRequestError(
+                f"prompt ids must be in [0, {vocab}); got "
+                f"[{int(prompt.min())}, {int(prompt.max())}]")
+        return prompt.astype(np.int32), max_tokens
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_tokens", "eos_id", "future", "deadline",
+                 "t_submit", "t_first", "on_token", "tokens", "slot",
+                 "abandoned")
+
+    def __init__(self, prompt, max_tokens, eos_id, deadline, on_token):
+        self.abandoned = False
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.eos_id = eos_id
+        self.future = Future()
+        self.deadline = deadline          # absolute perf_counter() or None
+        self.t_submit = time.perf_counter()
+        self.t_first = None
+        self.on_token = on_token
+        self.tokens = []
+        self.slot = None
+
+    def fail(self, exc):
+        try:
+            self.future.set_exception(exc)
+        except InvalidStateError:
+            pass
+
+    def emit(self, token, name):
+        self.tokens.append(int(token))
+        if self.t_first is None:
+            self.t_first = time.perf_counter()
+        if self.on_token is not None:
+            try:
+                self.on_token(int(token))
+            except Exception as e:    # noqa: BLE001 — a client callback
+                # must never wedge the decode loop
+                logger.warning("%s: on_token callback failed: %s: %s",
+                               name, type(e).__name__, e)
+                self.on_token = None
+
+
+class GenerationBatcher:
+    """Continuous-batching front for a ``DecodeEngine`` — the generation
+    twin of ``Batcher``: bounded queue, futures, deadlines, drain; plus
+    streaming (per-token callbacks) and slot scheduling.
+
+    ONE worker thread runs the loop: admit queued requests into free
+    slots (prefilling same-bucket prompts together through the ladder),
+    run one slab step, deliver each active slot's token, evict finished
+    slots.  Admission happens strictly BETWEEN steps, so the compiled
+    step never sees a shape change.
+
+    admission="continuous" (the point of this module) refills freed slots
+    from the queue between ANY two steps.  admission="gang" only admits
+    into an EMPTY slab and runs that gang to completion — the sequential
+    whole-batch policy ``lm_generate`` imposes (finished rows burn steps
+    until the slowest row is done; arrivals wait for the drain).  Same
+    compiled step, same prefill ladder, so ``bench.py serving_generate``'s
+    continuous-vs-sequential comparison isolates exactly the scheduling
+    policy.
+    """
+
+    def __init__(self, engine, queue_size=256, default_deadline_ms=None,
+                 default_max_tokens=64, admission="continuous", name=None):
+        self.engine = engine
+        self.metrics = engine.metrics
+        self.default_deadline_s = (float(default_deadline_ms) / 1e3
+                                   if default_deadline_ms else None)
+        self.default_max_tokens = int(default_max_tokens)
+        if int(queue_size) < 1:
+            raise ValueError("queue_size must be >= 1")
+        if admission not in ("continuous", "gang"):
+            raise ValueError(f"admission={admission!r} (supported: "
+                             "'continuous', 'gang')")
+        self._gang = admission == "gang"
+        self._q = queue.Queue(maxsize=int(queue_size))
+        self._depth_fn = self._q.qsize
+        self.metrics.queue_depth_fns.append(self._depth_fn)
+        self._closed = threading.Event()
+        self._drain = True
+        self._admit_lock = threading.Lock()
+        self._by_slot = {}          # slot -> _GenRequest
+        self._abandoned = set()     # futures flagged mid-prefill (before
+        #                             their request reached a slot)
+        self.name = name or f"gen_batcher[{engine.name}]"
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=self.name)
+        self._thread.start()
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, prompt, max_tokens=None, eos_id=None, deadline_ms=None,
+               on_token=None):
+        """Admit one generation request; returns a Future resolving to
+        ``{"tokens": [ids...], "finish_reason": "eos"|"length",
+        "ttft_ms": float}``.
+
+        prompt: 1-D int token ids (<= the prefill ladder top);
+        max_tokens: emission cap (default: the batcher default), with
+        ``len(prompt) + max_tokens <= engine.max_len``; eos_id: stop
+        token override (None = the engine default); on_token: optional
+        callable invoked per emitted token from the engine thread (the
+        streaming hook — exceptions are logged, never fatal).
+
+        Raises synchronously: ``InvalidRequestError``,
+        ``OverloadedError`` (queue full), ``ShutdownError`` (draining).
+        """
+        if self._closed.is_set():
+            self.metrics.reject("shutdown")
+            raise ShutdownError(f"{self.name} is draining; submit rejected")
+        try:
+            prompt, max_tokens = self.engine.validate_request(
+                prompt, max_tokens if max_tokens is not None
+                else self.default_max_tokens)
+        except InvalidRequestError:
+            self.metrics.reject("invalid")
+            raise
+        dl_s = (float(deadline_ms) / 1e3 if deadline_ms
+                else self.default_deadline_s)
+        req = _GenRequest(prompt, max_tokens,
+                          self.engine.eos_id if eos_id is None else eos_id,
+                          time.perf_counter() + dl_s if dl_s else None,
+                          on_token)
+        with self._admit_lock:
+            if self._closed.is_set():   # close() raced the check above
+                self.metrics.reject("shutdown")
+                raise ShutdownError(
+                    f"{self.name} is draining; submit rejected")
+            try:
+                self._q.put_nowait(req)
+            except queue.Full:
+                self.metrics.reject("overload")
+                raise OverloadedError(
+                    f"{self.name}: queue full ({self._q.maxsize} waiting)") \
+                    from None
+        self.metrics.accepted()
+        return req.future
+
+    def generate(self, prompt, timeout=None, **kw):
+        """submit() + block for the result (the HTTP handler's path)."""
+        return self.submit(prompt, **kw).result(timeout)
+
+    def abandon(self, future):
+        """The caller behind ``future`` is gone (e.g. the streaming HTTP
+        client disconnected): stop spending decode steps on it.  A still-
+        queued request is cancelled outright; a slotted one is flagged
+        and the worker evicts it at the next token boundary instead of
+        decoding to max_tokens.  No-op if it already finished."""
+        if future.done() or future.cancel():
+            return          # finished, or still queued (admission drops
+            #                 cancelled work)
+        for req in list(self._by_slot.values()):
+            if req.future is future:
+                req.abandoned = True
+                return
+        # running but not slotted: it is inside the prefill window —
+        # admission checks this set before seating it
+        self._abandoned.add(future)
+
+    # ------------------------------------------------------------ worker
+
+    def _pull(self, block):
+        try:
+            return self._q.get(timeout=0.05) if block else \
+                self._q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _finish(self, req, reason):
+        """Evict a slotted request and resolve its future."""
+        self.engine.evict(req.slot, reason)
+        del self._by_slot[req.slot]
+        req.slot = None
+        self._resolve(req, reason)
+
+    def _resolve(self, req, reason):
+        """Resolve a finished request's future — the ONE place the
+        response shape is built (slotted finishes and prefill-time
+        finishes — max_tokens==1 / immediate eos / abandoned — all land
+        here)."""
+        self._abandoned.discard(req.future)     # a late abandon() of a
+        #                                         finished future is inert
+        ttft = (req.t_first - req.t_submit) if req.t_first else 0.0
+        self.metrics.observe_response(time.perf_counter() - req.t_submit)
+        try:
+            req.future.set_result({
+                "tokens": list(req.tokens),
+                "finish_reason": reason,
+                "ttft_ms": round(ttft * 1e3, 3),
+            })
+        except InvalidStateError:
+            pass
+
+    def _admit_from_queue(self, block):
+        """Fill free slots from the queue; same-length-bucket prompts
+        prefill as ONE engine batch.  Runs strictly between steps."""
+        if self._gang and self._by_slot:
+            return          # whole-batch policy: drain before refilling
+        picked = []
+        while self.engine.free_slots > len(picked):
+            req = self._pull(block and not picked)
+            if req is None:
+                break
+            block = False
+            now = time.perf_counter()
+            if req.deadline is not None and now > req.deadline:
+                self.metrics.reject("deadline")
+                req.fail(DeadlineExceededError(
+                    f"deadline exceeded after "
+                    f"{(now - req.t_submit) * 1e3:.1f}ms in queue"))
+                continue
+            if not req.future.set_running_or_notify_cancel():
+                continue            # client cancelled while queued
+            picked.append(req)
+        if not picked:
+            return
+        groups = {}
+        for req in picked:
+            b = self.engine.prefill_bucket_for(req.prompt.size)
+            groups.setdefault(b, []).append(req)
+        for bucket, reqs in sorted(groups.items()):
+            prompts = np.zeros((len(reqs), bucket), np.int32)
+            lengths = np.zeros((len(reqs),), np.int32)
+            for i, req in enumerate(reqs):
+                prompts[i, :req.prompt.size] = req.prompt
+                lengths[i] = req.prompt.size
+            try:
+                first, rows = self.engine.prefill(prompts, lengths)
+            except Exception as e:    # noqa: BLE001 — isolate to THIS group
+                logger.warning("%s: prefill of %d failed: %s: %s",
+                               self.name, len(reqs), type(e).__name__, e)
+                self.metrics.observe_error(len(reqs))
+                for req in reqs:
+                    req.fail(BatchExecutionError(
+                        f"prefill failed: {type(e).__name__}: {e}"))
+                continue
+            for i, req in enumerate(reqs):
+                if req.future in self._abandoned:
+                    self._abandoned.discard(req.future)
+                    req.abandoned = True
+                req.emit(first[i], self.name)
+                self.metrics.observe_ttft(req.t_first - req.t_submit)
+                self.metrics.observe_gen_tokens(1)
+                if req.abandoned:
+                    self._resolve(req, "abandoned")     # never seated, so
+                    #                                     no slot eviction
+                elif req.eos_id is not None \
+                        and int(first[i]) == req.eos_id:
+                    self._resolve(req, "eos")
+                elif req.max_tokens == 1:
+                    self._resolve(req, "length")
+                else:
+                    try:
+                        req.slot = self.engine.admit(first[i], rows[i],
+                                                     lengths[i])
+                    except Exception as e:    # noqa: BLE001 — the slot
+                        # write is a device op like step/prefill; a
+                        # failure may have consumed the donated slab, so
+                        # fail everything in flight (incl. this group's
+                        # rest) and reset; later groups get the fresh slab
+                        self._fail_all_inflight(
+                            e, extra=[req] + reqs[i + 1:])
+                        break
+                    self._by_slot[req.slot] = req
+
+    def _fail_all_inflight(self, e, extra=()):
+        """A device operation (step or slot admission) failed: fail every
+        in-flight request (plus ``extra`` ones caught mid-admission) with
+        the cause, reset the engine (the donated slab may be consumed),
+        and let the loop keep serving."""
+        victims = list(self._by_slot.values()) + list(extra)
+        logger.warning("%s: device op over %d request(s) failed: %s: %s",
+                       self.name, len(victims), type(e).__name__, e)
+        self.metrics.observe_error(len(victims))
+        for req in victims:
+            req.fail(BatchExecutionError(
+                f"decode batch failed: {type(e).__name__}: {e}"))
+        for _ in self._by_slot:
+            self.metrics.evict_slot("error")
+        self._by_slot.clear()
+        self.engine.reset()
+
+    def _loop(self):
+        while True:
+            if self._closed.is_set() and not self._drain:
+                # the worker owns slot state: fail the in-flight requests
+                # here, never from close()'s thread
+                for slot, req in list(self._by_slot.items()):
+                    req.fail(ShutdownError(
+                        "generation batcher closed without drain"))
+                    self.engine.evict(slot, "shutdown")
+                self._by_slot.clear()
+                return
+            self._admit_from_queue(block=not self._by_slot)
+            if not self._by_slot:
+                if self._closed.is_set() and self._q.empty():
+                    return
+                continue
+            try:
+                nxt = self.engine.step()
+            except Exception as e:    # noqa: BLE001 — isolate to the
+                # requests in flight; the loop keeps serving
+                self._fail_all_inflight(e)
+                continue
+            for slot, req in list(self._by_slot.items()):
+                if req.future in self._abandoned:
+                    # abandon() raced the seating window: the flag landed
+                    # in the set after admission's check — honor it here
+                    self._abandoned.discard(req.future)
+                    req.abandoned = True
+                if req.abandoned:
+                    self._finish(req, "abandoned")
+                    continue
+                tok = int(nxt[slot])
+                req.emit(tok, self.name)
+                self.metrics.observe_gen_tokens(1)
+                if req.eos_id is not None and tok == req.eos_id:
+                    self._finish(req, "eos")
+                elif len(req.tokens) >= req.max_tokens:
+                    self._finish(req, "length")
+                else:
+                    self.engine.advance(slot, tok)
+
+    # ------------------------------------------------------------ shutdown
+
+    def close(self, drain=True, timeout=60.0):
+        """Stop admissions, then either finish every queued AND in-flight
+        generation (drain=True) or fail them (drain=False).  Idempotent."""
+        with self._admit_lock:
+            self._drain = drain
+            self._closed.set()
+        try:
+            self.metrics.queue_depth_fns.remove(self._depth_fn)
+        except ValueError:
+            pass                    # already removed (idempotent close)
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            # a wedged step: slot state belongs to the (still running)
+            # worker — touching _by_slot or the engine from here would
+            # race it; callers' own result() timeouts bound their wait
+            logger.warning("%s: worker did not drain within %.0fs; "
+                           "leaving in-flight slots to it", self.name,
+                           timeout)
+        # empty anything still queued (a submit that raced the close, or
+        # drain=False leftovers) — the queue is thread-safe either way
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            self.metrics.reject("shutdown")
+            req.fail(ShutdownError("generation batcher closed"))
+
+    @property
+    def closed(self):
+        return self._closed.is_set()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -304,22 +304,26 @@ class InferenceEngine:
         trace of the forward's Python body, and steady-state serving costs
         zero (``trace_count`` stays flat).  Returns the number of newly
         compiled buckets."""
+        from paddle_tpu.testing.trace import expect_traces
         n_new = 0
         for b in self.buckets:
-            fresh = b not in self._compiled
-            before = self.trace_count
-            fn = self._exec_for(b)
-            if not fresh:
+            if b in self._compiled:
                 continue
             n_new += 1
             zeros = jax.tree_util.tree_map(
                 lambda l: np.zeros(l.shape, l.dtype), self.bucket_spec(b))
-            jax.block_until_ready(fn(zeros))
-            if self._artifacts is None and self.trace_count != before + 1:
-                raise AssertionError(
-                    f"serving[{self.name}]: bucket {b} warm-up traced "
-                    f"{self.trace_count - before} times (expected exactly 1)"
-                    " — the forward is not shape-stable")
+
+            def _compile_and_run(b=b, zeros=zeros):
+                jax.block_until_ready(self._exec_for(b)(zeros))
+
+            if self._artifacts is None:
+                with expect_traces(lambda: self.trace_count, 1,
+                                   f"serving[{self.name}]: bucket {b} "
+                                   "warm-up",
+                                   hint="the forward is not shape-stable"):
+                    _compile_and_run()
+            else:
+                _compile_and_run()
         if n_new:
             logger.info("serving[%s]: %d bucket executable(s) warm %s",
                         self.name, len(self._compiled), list(self.buckets))

@@ -22,6 +22,13 @@ from paddle_tpu.utils.stats import Histogram
 # submit() rejection reasons — keys are part of the /metrics surface
 REJECT_REASONS = ("overload", "deadline", "invalid", "shutdown")
 
+# decode-slot eviction reasons (generation serving, decode_engine.py):
+# eos = the model emitted the stop token, length = per-request max_tokens
+# reached, error = the slot's request failed with its batch, shutdown =
+# drain(False) failed it, abandoned = the caller disconnected mid-stream.
+# Keys are part of the /metrics surface.
+EVICT_REASONS = ("eos", "length", "error", "shutdown", "abandoned")
+
 _QUANTILES = (50, 95, 99)
 
 
@@ -44,8 +51,30 @@ class ServingMetrics:
         # engine batch execution time (seconds)
         self.batch_time = Histogram(f"{name}_batch_time",
                                     max_samples=max_samples, keep="last")
-        # wired by the batcher: zero-arg callable -> current queue depth
-        self.queue_depth_fn = None
+        # ---- generation serving (decode_engine.py) ----
+        # time-to-first-token: submit -> the request's first token exists
+        # (prefill done); the latency a chat user feels before anything
+        # streams
+        self.ttft = Histogram(f"{name}_ttft", max_samples=max_samples,
+                              keep="last")
+        # time-per-output-token: one slab decode step's wall time — every
+        # active request emits exactly one token per step, so this IS the
+        # per-token latency of the stream
+        self.tpot = Histogram(f"{name}_tpot", max_samples=max_samples,
+                              keep="last")
+        self.gen_tokens_total = 0        # useful (delivered) tokens
+        self.decode_steps_total = 0
+        self.active_slot_steps_total = 0  # sum of active slots over steps
+        self.slot_count = 0              # gauge, set by the decode engine
+        self.evictions = {r: 0 for r in EVICT_REASONS}
+        # v2 Inference per-row-signature engine cache (satellite): LRU
+        # evictions of whole compiled engines under ragged feed signatures
+        self.engine_cache_evictions = 0
+        # wired by batchers: each contributes a zero-arg callable -> its
+        # current queue depth; queue_depth() sums them (a combined
+        # inference+generation server shares ONE metrics object, and one
+        # plane's backlog must never mask another's)
+        self.queue_depth_fns = []
 
     # ------------------------------------------------------------ record
 
@@ -73,6 +102,29 @@ class ServingMetrics:
         with self._lock:
             self.errors_total += int(n)
 
+    def observe_ttft(self, seconds):
+        self.ttft.add(seconds)
+
+    def observe_decode_step(self, n_active, n_slots, seconds):
+        """One slab decode step: n_active of n_slots held live requests."""
+        with self._lock:
+            self.decode_steps_total += 1
+            self.active_slot_steps_total += int(n_active)
+            self.slot_count = int(n_slots)
+        self.tpot.add(seconds)
+
+    def observe_gen_tokens(self, n=1):
+        with self._lock:
+            self.gen_tokens_total += int(n)
+
+    def evict_slot(self, reason):
+        with self._lock:
+            self.evictions[reason] = self.evictions.get(reason, 0) + 1
+
+    def evict_engine_cache(self):
+        with self._lock:
+            self.engine_cache_evictions += 1
+
     # ------------------------------------------------------------ derive
 
     @property
@@ -89,17 +141,29 @@ class ServingMetrics:
             return (1.0 - self.batch_rows_total / self.batch_slots_total
                     if self.batch_slots_total else 0.0)
 
+    @property
+    def mean_slot_occupancy(self):
+        """Active slots per decode step (generation serving); the fraction
+        of the slab doing useful work is this over ``slot_count``."""
+        with self._lock:
+            return (self.active_slot_steps_total / self.decode_steps_total
+                    if self.decode_steps_total else 0.0)
+
     def queue_depth(self):
-        fn = self.queue_depth_fn
-        try:
-            return int(fn()) if fn is not None else 0
-        except Exception:   # noqa: BLE001 — a dying queue must not kill /metrics
-            return 0
+        total = 0
+        for fn in list(self.queue_depth_fns):
+            try:
+                total += int(fn())
+            except Exception:   # noqa: BLE001 — a dying queue must not
+                pass            # kill /metrics
+        return total
 
     def snapshot(self):
         """All metrics as one dict (bench family / smoke JSON surface)."""
         lat = self.latency.percentiles(_QUANTILES)
         bt = self.batch_time.percentiles(_QUANTILES)
+        ttft = self.ttft.percentiles(_QUANTILES)
+        tpot = self.tpot.percentiles(_QUANTILES)
         with self._lock:
             out = {
                 "requests_total": self.requests_total,
@@ -109,14 +173,24 @@ class ServingMetrics:
                 "batches_total": self.batches_total,
                 "batch_rows_total": self.batch_rows_total,
                 "batch_slots_total": self.batch_slots_total,
+                "gen_tokens_total": self.gen_tokens_total,
+                "decode_steps_total": self.decode_steps_total,
+                "slot_count": self.slot_count,
+                "evictions": dict(self.evictions),
+                "engine_cache_evictions": self.engine_cache_evictions,
             }
         out["queue_depth"] = self.queue_depth()
         out["mean_occupancy"] = round(self.mean_occupancy, 3)
         out["padding_waste"] = round(self.padding_waste, 3)
+        out["mean_slot_occupancy"] = round(self.mean_slot_occupancy, 3)
         out["latency_ms"] = {f"p{q}": round(v * 1e3, 3)
                              for q, v in lat.items()}
         out["batch_time_ms"] = {f"p{q}": round(v * 1e3, 3)
                                 for q, v in bt.items()}
+        out["ttft_ms"] = {f"p{q}": round(v * 1e3, 3)
+                          for q, v in ttft.items()}
+        out["tpot_ms"] = {f"p{q}": round(v * 1e3, 3)
+                          for q, v in tpot.items()}
         return out
 
     # ------------------------------------------------------------ render
@@ -176,4 +250,44 @@ class ServingMetrics:
             lines.append(
                 f'{n}_batch_time_seconds{{quantile="0.{q}"}} {v:.6f}')
         lines.append(f"{n}_batch_time_seconds_count {self.batch_time.count}")
+
+        # ---- generation serving (decode_engine.py) ----
+        ttft = self.ttft.percentiles(_QUANTILES)
+        tpot = self.tpot.percentiles(_QUANTILES)
+        with self._lock:
+            gen_counters = [
+                ("gen_tokens_total", self.gen_tokens_total,
+                 "generated tokens delivered to requests"),
+                ("decode_steps_total", self.decode_steps_total,
+                 "continuous-batching slab decode steps executed"),
+                ("engine_cache_evictions_total",
+                 self.engine_cache_evictions,
+                 "compiled engines evicted from the per-row-signature "
+                 "LRU cache"),
+            ]
+            evictions = dict(self.evictions)
+            slot_count = self.slot_count
+        for metric, value, help_ in gen_counters:
+            emit(metric, value, help_, mtype="counter")
+        lines.append(f"# HELP {n}_slot_evictions_total decode slots "
+                     "evicted, by reason")
+        lines.append(f"# TYPE {n}_slot_evictions_total counter")
+        for reason in sorted(evictions):
+            lines.append(f'{n}_slot_evictions_total{{reason="{reason}"}} '
+                         f"{evictions[reason]}")
+        emit("slot_count", slot_count, "decode slots in the slab")
+        emit("slot_occupancy_mean", f"{self.mean_slot_occupancy:.6f}",
+             "mean active slots per decode step")
+        lines.append(f"# HELP {n}_ttft_seconds time to first token "
+                     "(submit to first token), recent-window quantiles")
+        lines.append(f"# TYPE {n}_ttft_seconds summary")
+        for q, v in ttft.items():
+            lines.append(f'{n}_ttft_seconds{{quantile="0.{q}"}} {v:.6f}')
+        lines.append(f"{n}_ttft_seconds_count {self.ttft.count}")
+        lines.append(f"# HELP {n}_tpot_seconds per-output-token latency "
+                     "(one slab decode step), recent-window quantiles")
+        lines.append(f"# TYPE {n}_tpot_seconds summary")
+        for q, v in tpot.items():
+            lines.append(f'{n}_tpot_seconds{{quantile="0.{q}"}} {v:.6f}')
+        lines.append(f"{n}_tpot_seconds_count {self.tpot.count}")
         return "\n".join(lines) + "\n"

@@ -12,6 +12,15 @@ Endpoints:
                    errors map to status codes: invalid feed/JSON 400,
                    overload 429, shutdown 503, deadline 504, batch
                    failure 500 — always a JSON body with "error".
+  POST /v1/generate {"prompt": [ids], "max_tokens": N, "eos_id": opt,
+                    "deadline_ms": opt, "stream": false}
+                   -> {"tokens": [...], "finish_reason": "eos"|"length",
+                       "ttft_ms": ..., "latency_ms": ...}
+                   "stream": true streams newline-delimited JSON chunks
+                   ({"token": id} per emitted token, then a {"done":
+                   true, ...} record) over chunked transfer encoding —
+                   continuous-batching generation (decode_engine.py,
+                   docs/serving.md §4); same error-code mapping.
   GET  /healthz    200 {"status": "ok", ...} (503 once draining)
   GET  /metrics    Prometheus text (serving/metrics.py)
 
@@ -19,11 +28,18 @@ CLI (``python -m paddle_tpu.serving``):
   --artifact model.shlo            one-bucket exported artifact
   --artifacts 'model.b*.shlo'      bucket ladder (export.export_bucketed)
   --demo                           built-in tiny MLP (smoke/bring-up)
+  --demo-generate                  built-in tiny LM trunk behind the
+                                   continuous-batching /v1/generate
   --buckets 1,4,16 --port N --max-delay-ms --queue-size --deadline-ms
+  --gen-slots --gen-max-len --gen-prefill-buckets --gen-max-tokens
   --smoke                          self-test: ephemeral port, concurrent
                                    requests, /metrics sanity, ONE JSON
                                    line, exit code (healthy_window.sh's
                                    serving phase)
+  --smoke-generate                 generation self-test: concurrent
+                                   staggered /v1/generate requests,
+                                   streaming, EOS early-finish, ONE JSON
+                                   line (healthy_window.sh phase 8)
 
 The JSON front-end serves plain-array feed slots (dense/index vectors);
 structured SequenceBatch slots are an in-process engine feature.
@@ -33,6 +49,7 @@ answer in-flight connections, then exit.
 
 import argparse
 import json
+import queue as _queue
 import signal
 import sys
 import threading
@@ -105,13 +122,21 @@ class ServingHandler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------ GET
 
     def do_GET(self):
-        batcher = self.server.batcher
+        # one server serves an inference batcher, a generation batcher,
+        # or both; health/metrics report whichever exists — and draining
+        # on EITHER plane marks the node unhealthy (a balancer must stop
+        # routing as soon as any served endpoint starts rejecting 503)
+        batchers = [b for b in (self.server.batcher,
+                                self.server.gen_batcher) if b is not None]
+        batcher = batchers[0]
         if self.path == "/healthz":
-            draining = batcher.closed
+            draining = any(b.closed for b in batchers)
+            engine = batcher.engine
             self._reply(503 if draining else 200, {
                 "status": "draining" if draining else "ok",
-                "model": batcher.engine.name,
-                "buckets": list(batcher.engine.buckets),
+                "model": engine.name,
+                "buckets": list(getattr(engine, "buckets", None)
+                                or getattr(engine, "prefill_buckets", ())),
                 "queue_depth": batcher.metrics.queue_depth(),
             })
         elif self.path == "/metrics":
@@ -122,26 +147,52 @@ class ServingHandler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------ POST
 
+    def _read_json(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        try:
+            req = json.loads(self.rfile.read(length) or b"")
+        except ValueError as e:
+            raise InvalidRequestError(f"malformed JSON: {e}") from e
+        if not isinstance(req, dict):
+            raise InvalidRequestError("body must be a JSON object")
+        return req
+
+    @staticmethod
+    def _deadline_ms(req):
+        deadline_ms = req.get("deadline_ms")
+        if deadline_ms is not None and (
+                not isinstance(deadline_ms, (int, float))
+                or deadline_ms <= 0):
+            raise InvalidRequestError("deadline_ms must be a positive "
+                                      "number")
+        return deadline_ms
+
+    def _error_reply(self, e):
+        for etype, code in _STATUS:
+            if isinstance(e, etype):
+                break
+        else:
+            code = 500
+        self._reply(code, {"error": f"{type(e).__name__}: {e}"})
+
     def do_POST(self):
+        if self.path == "/v1/generate":
+            self._post_generate()
+            return
         if self.path != "/v1/infer":
             self._reply(404, {"error": f"no route {self.path!r}"})
             return
         t0 = time.perf_counter()
         batcher = self.server.batcher
+        if batcher is None:
+            self._reply(404, {"error": "no inference model is being "
+                                       "served (generation-only server)"})
+            return
         try:
-            length = int(self.headers.get("Content-Length") or 0)
-            try:
-                req = json.loads(self.rfile.read(length) or b"")
-            except ValueError as e:
-                raise InvalidRequestError(f"malformed JSON: {e}") from e
-            if not isinstance(req, dict) or "feed" not in req:
+            req = self._read_json()
+            if "feed" not in req:
                 raise InvalidRequestError('body must be {"feed": {...}}')
-            deadline_ms = req.get("deadline_ms")
-            if deadline_ms is not None and (
-                    not isinstance(deadline_ms, (int, float))
-                    or deadline_ms <= 0):
-                raise InvalidRequestError("deadline_ms must be a positive "
-                                          "number")
+            deadline_ms = self._deadline_ms(req)
             row = _json_to_row(batcher.engine, req["feed"])
             fut = batcher.submit(row, deadline_ms=deadline_ms)
             # bounded wait: batch errors surface here; the timeout is a
@@ -153,20 +204,129 @@ class ServingHandler(BaseHTTPRequestHandler):
                 "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
             })
         except Exception as e:    # noqa: BLE001 — every error is a response
-            for etype, code in _STATUS:
-                if isinstance(e, etype):
-                    break
-            else:
-                code = 500
-            self._reply(code, {"error": f"{type(e).__name__}: {e}"})
+            self._error_reply(e)
+
+    # ------------------------------------------------------- POST generate
+
+    def _post_generate(self):
+        t0 = time.perf_counter()
+        gen = self.server.gen_batcher
+        if gen is None:
+            self._reply(404, {"error": "no generation model is being "
+                                       "served (start with "
+                                       "--demo-generate or wire a "
+                                       "GenerationBatcher)"})
+            return
+        try:
+            req = self._read_json()
+            if "prompt" not in req:
+                raise InvalidRequestError('body must be {"prompt": [ids]}')
+            prompt = req["prompt"]
+            if not isinstance(prompt, list) or not prompt \
+                    or not all(isinstance(t, int) for t in prompt):
+                raise InvalidRequestError(
+                    "'prompt' must be a non-empty list of int token ids")
+            try:
+                prompt = np.asarray(prompt, np.int64)
+            except (OverflowError, ValueError) as e:
+                # Python ints are unbounded; an id past int64 is a
+                # malformed request, not a server error
+                raise InvalidRequestError(
+                    f"prompt ids out of range: {e}") from e
+            deadline_ms = self._deadline_ms(req)
+            kw = dict(max_tokens=req.get("max_tokens"),
+                      eos_id=req.get("eos_id"), deadline_ms=deadline_ms)
+            if req.get("stream"):
+                self._generate_stream(gen, prompt, kw, t0)
+                return
+            out = gen.submit(prompt, **kw).result(timeout=600)
+            out = dict(out)
+            out["latency_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+            self._reply(200, out)
+        except Exception as e:    # noqa: BLE001 — every error is a response
+            self._error_reply(e)
+
+    def _generate_stream(self, gen, prompt, kw, t0):
+        """Chunked-transfer NDJSON stream: one {"token": id} record per
+        emitted token (pushed from the decode loop as the slot advances),
+        then a closing {"done": true, ...} record.  Admission errors are
+        raised BEFORE any bytes go out, so they still map to their status
+        codes; a failure mid-stream terminates with an {"error": ...}
+        record instead (the status line is already on the wire)."""
+        events = _queue.Queue()
+        fut = gen.submit(prompt,
+                         on_token=lambda t: events.put(("token", t)), **kw)
+        # the callback fires in the engine thread strictly before the
+        # future resolves, so the queue orders tokens before done
+        fut.add_done_callback(lambda f: events.put(("done", f)))
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+        except Exception as e:    # noqa: BLE001 — peer gone before the
+            # status line finished: a second reply would corrupt the
+            # connection; reclaim the slot and drop it
+            logger.warning("generate stream: client gone before headers: "
+                           "%s: %s", type(e).__name__, e)
+            gen.abandon(fut)
+            self.close_connection = True
+            return
+
+        def chunk(obj):
+            data = (json.dumps(obj) + "\n").encode()
+            self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+
+        # the status line is on the wire: from here every failure must
+        # terminate the chunk stream, never fall back to a second reply
+        try:
+            while True:
+                kind, val = events.get(timeout=600)
+                if kind == "token":
+                    chunk({"token": int(val)})
+                    continue
+                exc = val.exception()
+                if exc is not None:
+                    chunk({"error": f"{type(exc).__name__}: {exc}"})
+                else:
+                    out = dict(val.result())
+                    out["done"] = True
+                    out["latency_ms"] = round(
+                        (time.perf_counter() - t0) * 1e3, 3)
+                    chunk(out)
+                break
+            self.wfile.write(b"0\r\n\r\n")
+        except Exception as e:    # noqa: BLE001 — client gone / wedged
+            logger.warning("generate stream aborted: %s: %s",
+                           type(e).__name__, e)
+            # the reader is gone: reclaim its decode slot instead of
+            # generating to max_tokens for nobody
+            gen.abandon(fut)
+            # best-effort error record + terminator, then DROP the
+            # connection: a keep-alive socket with an unterminated chunk
+            # stream would block the client forever
+            try:
+                chunk({"error": f"stream aborted: {type(e).__name__}"})
+                self.wfile.write(b"0\r\n\r\n")
+            except Exception:   # noqa: BLE001 — socket already gone
+                pass
+            self.close_connection = True
 
 
-def make_server(batcher, host="127.0.0.1", port=0):
+def make_server(batcher, host="127.0.0.1", port=0, gen_batcher=None):
     """Bind (port 0 = ephemeral) and return the server; caller runs
-    ``serve_forever()``.  ``server.port`` carries the bound port."""
+    ``serve_forever()``.  ``server.port`` carries the bound port.
+
+    batcher: the /v1/infer ``Batcher`` (None for a generation-only
+    server); gen_batcher: the /v1/generate ``GenerationBatcher`` (None
+    for an inference-only server).  At least one must be given."""
+    if batcher is None and gen_batcher is None:
+        raise ValueError("make_server needs a batcher, a gen_batcher, or "
+                         "both")
     httpd = ThreadingHTTPServer((host, port), ServingHandler)
     httpd.daemon_threads = True
     httpd.batcher = batcher
+    httpd.gen_batcher = gen_batcher
     httpd.port = httpd.server_address[1]
     return httpd
 
@@ -188,6 +348,34 @@ def _demo_engine(buckets, warm=True):
                                          warm=warm, name="demo")
 
 
+def _demo_gen_batcher(args, tiny=False, metrics=None):
+    """Built-in tiny decoder-only LM trunk behind the continuous-batching
+    decode engine — /v1/generate bring-up and smoke without a trained
+    model.  ``tiny=True`` shrinks slab + ladder to smoke scale so the
+    self-test warms in seconds.  ``metrics``: share the inference
+    batcher's ServingMetrics on a combined server, so /metrics reports
+    BOTH planes from the one object the handler renders."""
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving.decode_engine import (DecodeEngine,
+                                                  GenerationBatcher)
+    if tiny:
+        slots, max_len, buckets = 4, 48, (8, 16)
+    else:
+        slots = args.gen_slots
+        max_len = args.gen_max_len
+        buckets = tuple(int(b) for b in args.gen_prefill_buckets.split(","))
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=256,
+                              trg_vocab=1, d_model=32, num_heads=2,
+                              dff=64, enc_layers=2, dec_layers=0,
+                              max_len=max_len)
+    engine = DecodeEngine(params, num_heads=2, num_slots=slots,
+                          max_len=max_len, prefill_buckets=buckets,
+                          name="demo_lm", metrics=metrics)
+    return GenerationBatcher(engine, queue_size=args.queue_size,
+                             default_deadline_ms=args.deadline_ms,
+                             default_max_tokens=args.gen_max_tokens)
+
+
 def _build_engine(args):
     if args.artifact:
         return InferenceEngine.from_artifact(args.artifact)
@@ -197,7 +385,7 @@ def _build_engine(args):
         buckets = tuple(int(b) for b in args.buckets.split(","))
         return _demo_engine(buckets)
     raise SystemExit("serving: pass one of --artifact PATH, "
-                     "--artifacts GLOB, --demo")
+                     "--artifacts GLOB, --demo, --demo-generate")
 
 
 def _zeros_row_json(engine, fill=0.5):
@@ -288,6 +476,111 @@ def _smoke(batcher, n_requests=8):
     return 0 if passed else 2
 
 
+def _smoke_generate(gen, n_requests=6):
+    """Generation-serving self-test (healthy_window.sh phase 8): ephemeral
+    port, concurrent STAGGERED /v1/generate requests with mixed prompt
+    lengths and max_tokens (so admissions land mid-decode and slots churn),
+    one streaming request, and an EOS early-finish probe (greedy decode is
+    deterministic: replaying a prompt with eos_id set to one of its own
+    continuation tokens must finish early with reason "eos").  Prints ONE
+    JSON line; returns the process exit code."""
+    import urllib.request
+
+    httpd = make_server(None, port=0, gen_batcher=gen)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.port}"
+    rng = np.random.RandomState(0)
+    results = [None] * n_requests
+    errs = []
+
+    def post(body):
+        req = urllib.request.Request(
+            f"{base}/v1/generate", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, r.read()
+
+    def hit(i):
+        prompt = rng.randint(1, 256, 3 + 2 * i).tolist()
+        n_tok = 10 + 3 * (i % 3)
+        try:
+            time.sleep(0.005 * i)       # staggered admissions: later
+            # requests land while earlier ones are mid-decode, so slots
+            # churn (admission between steps, never a retrace)
+            status, raw = post({"prompt": prompt, "max_tokens": n_tok})
+            resp = json.loads(raw)
+            if status == 200 and len(resp["tokens"]) == n_tok \
+                    and resp["finish_reason"] == "length":
+                results[i] = resp
+        except Exception as e:    # noqa: BLE001
+            errs.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=hit, args=(i,))
+               for i in range(n_requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    ok = sum(1 for r in results if r is not None)
+
+    # streaming: chunked NDJSON — tokens then a done record, and the
+    # streamed ids must equal the non-streamed result for the same prompt
+    # (greedy decode is deterministic).  EOS probe: replay stops AT the
+    # first occurrence of the chosen stop token.  Guarded like hit(): a
+    # probe failure must become a False flag in the ONE JSON line, never
+    # a traceback that leaves phase 8's artifact empty.
+    stream_ok = eos_ok = False
+    try:
+        probe = rng.randint(1, 256, 5).tolist()
+        _, raw = post({"prompt": probe, "max_tokens": 6})
+        plain = json.loads(raw)
+        _, raw = post({"prompt": probe, "max_tokens": 6, "stream": True})
+        lines = [json.loads(ln) for ln in raw.decode().splitlines() if ln]
+        streamed = [ln["token"] for ln in lines if "token" in ln]
+        done = [ln for ln in lines if ln.get("done")]
+        stream_ok = (bool(done) and streamed == plain["tokens"]
+                     and done[0]["tokens"] == plain["tokens"])
+        eos = plain["tokens"][2]
+        _, raw = post({"prompt": probe, "max_tokens": 6, "eos_id": eos})
+        eos_probe = json.loads(raw)
+        eos_ok = (eos_probe["finish_reason"] == "eos"
+                  and eos_probe["tokens"][-1] == eos
+                  and len(eos_probe["tokens"]) <= 3)
+    except Exception as e:    # noqa: BLE001
+        errs.append(f"probe: {type(e).__name__}: {e}")
+
+    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+        metrics_text = r.read().decode()
+    snap = gen.metrics.snapshot()
+    name = gen.metrics.name
+    metrics_sane = (
+        f"{name}_gen_tokens_total {snap['gen_tokens_total']}" in metrics_text
+        and f"{name}_decode_steps_total" in metrics_text
+        and 'ttft_seconds{quantile="0.50"}' in metrics_text
+        and snap["gen_tokens_total"] > 0
+        and snap["decode_steps_total"] > 0)
+    out = {
+        "metric": "generation serving smoke (continuous batching + HTTP)",
+        "value": ok, "unit": f"requests_ok/{n_requests}",
+        "vs_baseline": None,
+        "stream_ok": bool(stream_ok),
+        "eos_early_finish": bool(eos_ok),
+        "metrics_sane": bool(metrics_sane),
+        "mean_slot_occupancy": snap["mean_slot_occupancy"],
+        "gen_tokens_total": snap["gen_tokens_total"],
+        "evictions": snap["evictions"],
+        "ttft_p50_ms": snap["ttft_ms"]["p50"],
+        "tpot_p50_ms": snap["tpot_ms"]["p50"],
+    }
+    if errs:
+        out["errors"] = errs[:5]
+    httpd.shutdown()
+    gen.close()
+    print(json.dumps(out), flush=True)
+    passed = (ok == n_requests and stream_ok and eos_ok and metrics_sane)
+    return 0 if passed else 2
+
+
 def main(argv=None):
     from paddle_tpu.utils.flags import FLAGS
     ap = argparse.ArgumentParser(
@@ -298,9 +591,19 @@ def main(argv=None):
                     help="glob of bucketed artifacts (model.b*.shlo)")
     ap.add_argument("--demo", action="store_true",
                     help="serve the built-in tiny MLP")
+    ap.add_argument("--demo-generate", action="store_true",
+                    help="serve the built-in tiny LM behind the "
+                         "continuous-batching /v1/generate")
     ap.add_argument("--buckets", default=FLAGS.serving_buckets,
                     help="batch bucket ladder for --demo (artifacts carry "
                          "their own)")
+    ap.add_argument("--gen-slots", type=int, default=FLAGS.serving_gen_slots)
+    ap.add_argument("--gen-max-len", type=int,
+                    default=FLAGS.serving_gen_max_len)
+    ap.add_argument("--gen-prefill-buckets",
+                    default=FLAGS.serving_gen_prefill_buckets)
+    ap.add_argument("--gen-max-tokens", type=int,
+                    default=FLAGS.serving_gen_max_tokens)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=FLAGS.serving_port)
     ap.add_argument("--max-batch-size", type=int,
@@ -314,6 +617,9 @@ def main(argv=None):
     ap.add_argument("--smoke", action="store_true",
                     help="self-test on an ephemeral port, print one JSON "
                          "line, exit")
+    ap.add_argument("--smoke-generate", action="store_true",
+                    help="generation self-test on an ephemeral port, "
+                         "print one JSON line, exit")
     args = ap.parse_args(argv)
     if args.smoke and not (args.artifact or args.artifacts):
         args.demo = True
@@ -323,6 +629,20 @@ def main(argv=None):
         # CI machine
         args.max_delay_ms = max(args.max_delay_ms, 50.0)
 
+    if args.smoke_generate:
+        return _smoke_generate(_demo_gen_batcher(args, tiny=True))
+    if args.demo_generate and not (args.artifact or args.artifacts
+                                   or args.demo):
+        # generation-only server: no /v1/infer batcher
+        gen_batcher = _demo_gen_batcher(args)
+        httpd = make_server(None, args.host, args.port,
+                            gen_batcher=gen_batcher)
+        logger.info("serving %s on http://%s:%d (/v1/generate: %d slots, "
+                    "max_len %d)", gen_batcher.engine.name, args.host,
+                    httpd.port, gen_batcher.engine.num_slots,
+                    gen_batcher.engine.max_len)
+        return _serve(httpd, None, gen_batcher)
+
     engine = _build_engine(args)
     batcher = Batcher(engine, max_batch_size=args.max_batch_size,
                       max_delay_ms=args.max_delay_ms,
@@ -331,10 +651,19 @@ def main(argv=None):
     if args.smoke:
         return _smoke(batcher)
 
-    httpd = make_server(batcher, args.host, args.port)
+    # combined server: the generation plane shares the inference
+    # batcher's metrics, so the ONE /metrics page reports both
+    gen_batcher = (_demo_gen_batcher(args, metrics=engine.metrics)
+                   if args.demo_generate else None)
+    httpd = make_server(batcher, args.host, args.port,
+                        gen_batcher=gen_batcher)
     logger.info("serving %s on http://%s:%d (buckets %s, max_delay %.1fms, "
                 "queue %d)", engine.name, args.host, httpd.port,
                 list(engine.buckets), args.max_delay_ms, args.queue_size)
+    return _serve(httpd, batcher, gen_batcher)
+
+
+def _serve(httpd, batcher, gen_batcher):
 
     def _drain(signum, frame):
         logger.info("SIGTERM: draining (no new admissions, finishing "
@@ -352,10 +681,14 @@ def main(argv=None):
         # server_close() joins the handler threads (block_on_close) so
         # their responses reach the sockets before the interpreter exits
         # — otherwise the work the drain completed is dropped on the wire
-        batcher.close(drain=True)
+        if batcher is not None:
+            batcher.close(drain=True)
+        if gen_batcher is not None:
+            gen_batcher.close(drain=True)
         httpd.server_close()
+        metrics = (batcher or gen_batcher).metrics
         logger.info("serving stopped; %d responses served",
-                    batcher.metrics.responses_total)
+                    metrics.responses_total)
     return 0
 
 

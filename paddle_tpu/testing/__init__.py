@@ -2,5 +2,7 @@
 trainer mode and gserver/tests/LayerGradUtil.h discipline)."""
 
 from paddle_tpu.testing.gradcheck import check_topology_grads, check_grads
+from paddle_tpu.testing.trace import assert_no_retrace, expect_traces
 
-__all__ = ["check_topology_grads", "check_grads"]
+__all__ = ["check_topology_grads", "check_grads", "assert_no_retrace",
+           "expect_traces"]

@@ -1,0 +1,43 @@
+"""Trace-count discipline, shared.
+
+Three AOT surfaces make the same promise — warm-up traces the step's
+Python body exactly once per compiled shape, and steady state never
+retraces: ``SGD.precompile`` (trainer/trainer.py), the serving
+``InferenceEngine.warmup`` bucket ladder, and the continuous-batching
+``DecodeEngine`` slab step (serving/decode_engine.py).  Each keeps a
+counter that increments ONLY inside the traced function's Python body
+(so it moves iff JAX is staging the function); this module holds the one
+assertion they all share, so the promise is phrased — and its failure
+message reads — the same everywhere.
+"""
+
+import contextlib
+
+
+@contextlib.contextmanager
+def expect_traces(get_count, expected, what, hint=None):
+    """Assert the wrapped block traces exactly ``expected`` times.
+
+    ``get_count``: zero-arg callable returning the current trace counter
+    (e.g. ``lambda: engine.trace_count``).  ``what`` names the operation
+    for the failure message; ``hint`` (optional) names the likely cause.
+
+        with expect_traces(lambda: tr.trace_count, 0,
+                           "train() over precompiled buckets"):
+            tr.train(...)
+    """
+    before = get_count()
+    yield
+    actual = get_count() - before
+    if actual != expected:
+        msg = (f"{what}: traced {actual} time(s) "
+               f"(expected exactly {expected})")
+        if hint:
+            msg += f" — {hint}"
+        raise AssertionError(msg)
+
+
+def assert_no_retrace(get_count, what, hint="the compiled path retraced"):
+    """``expect_traces(..., 0, ...)`` — the steady-state half of the
+    discipline, named for readability at call sites."""
+    return expect_traces(get_count, 0, what, hint=hint)

@@ -91,6 +91,12 @@ class Flags:
     serving_max_delay_ms: float = 5.0
     serving_queue_size: int = 256
     serving_deadline_ms: float = 0.0    # 0 = no per-request deadline
+    # ---- generation serving (serving/decode_engine.py: slot-based
+    # continuous batching over a fixed KV-cache slab; docs/serving.md §4)
+    serving_gen_slots: int = 8          # concurrent decode slots
+    serving_gen_max_len: int = 256      # KV slab length (prompt + output)
+    serving_gen_prefill_buckets: str = "32,64"  # prompt-length ladder
+    serving_gen_max_tokens: int = 64    # default per-request emission cap
 
     # ---- observability (new floor; reference had host timers only)
     profile_dir: Optional[str] = None   # capture an xprof trace of training
@@ -232,6 +238,16 @@ FLAG_DOCS = {
                            "HTTP 429", "—"),
     "serving_deadline_ms": ("default per-request deadline (0 = none); "
                             "expired requests fail with HTTP 504", "—"),
+    "serving_gen_slots": ("decode slots in the continuous-batching KV "
+                          "slab (concurrent generations)", "—"),
+    "serving_gen_max_len": ("KV-cache slab length; every request needs "
+                            "prompt + max_tokens <= this", "—"),
+    "serving_gen_prefill_buckets": ("prompt-length ladder (comma ints) "
+                                    "the prefill engines AOT-compile; "
+                                    "the top bucket caps prompt length",
+                                    "—"),
+    "serving_gen_max_tokens": ("default per-request emission cap for "
+                               "/v1/generate", "—"),
     "profile_dir": ("capture an xprof/TensorBoard device trace", "—"),
     "debug_nans": ("fail fast on the op producing a NaN",
                    "feenableexcept (TrainerMain.cpp)"),

@@ -8,10 +8,21 @@ pads to the nearest bucket and slices back, and repeated calls at ragged
 batch sizes never retrace.  Buckets compile lazily (first use), so a
 one-shot ``infer`` costs one compile exactly like the old direct path.
 
+Sequence slots pad per batch, so every distinct padded length is its own
+row signature and needs its own bucket ladder.  The per-signature engine
+table is a bounded LRU (``max_engines``, default 8): under ragged lengths
+it can no longer grow without limit — the least-recently-used engine
+(and its compiled executables) is dropped, counted in
+``metrics.engine_cache_evictions`` and surfaced at ``/metrics`` as
+``engine_cache_evictions_total``.  An evicted signature that returns
+simply recompiles on first use, like any cold bucket.
+
 Row results are independent of padding and co-batched rows, so routing
 through the engine is a pure execution change — outputs match the direct
 forward bit-for-bit (tests/test_serving.py parity test).
 """
+
+from collections import OrderedDict
 
 from paddle_tpu.trainer.trainer import Inferencer, _normalize_feed
 from paddle_tpu.data.feeder import DataFeeder
@@ -22,19 +33,25 @@ class Inference:
 
     output_layer: LayerOutput (or list); parameters: v2 Parameters or a
     raw pytree; buckets: batch ladder (default serving.DEFAULT_BUCKETS);
-    larger batches chunk at the ladder top."""
+    larger batches chunk at the ladder top; max_engines: bound on the
+    per-row-signature engine LRU (>= 1)."""
 
     def __init__(self, output_layer, parameters, model_state=None,
-                 buckets=None):
+                 buckets=None, max_engines=8):
         from paddle_tpu.v2.parameters import Parameters
+        from paddle_tpu.serving import ServingMetrics
         tree = parameters.tree if isinstance(parameters, Parameters) \
             else parameters
         self._inferencer = Inferencer(output_layer, tree,
                                       model_state=model_state)
         self._buckets = buckets
-        self._engines = {}      # row signature -> engine (sequence slots
-        #                         pad per batch, so each padded length is
-        #                         its own bucket ladder)
+        if int(max_engines) < 1:
+            raise ValueError("max_engines must be >= 1")
+        self._max_engines = int(max_engines)
+        # ONE metrics object across every signature's engine, so the
+        # eviction counter (and batch/latency stats) survive evictions
+        self.metrics = ServingMetrics()
+        self._engines = OrderedDict()   # row signature -> engine (LRU)
 
     def _engine_for(self, feed):
         import numpy as np
@@ -44,11 +61,16 @@ class Inference:
         sig = (treedef, tuple((tuple(np.shape(l)[1:]), np.dtype(l.dtype))
                               for l in leaves))
         eng = self._engines.get(sig)
-        if eng is None:
-            eng = self._engines[sig] = InferenceEngine.from_inferencer(
-                self._inferencer, feed_spec=feed,
-                buckets=self._buckets or DEFAULT_BUCKETS,
-                warm=False, name="v2.infer")
+        if eng is not None:
+            self._engines.move_to_end(sig)      # most recently used
+            return eng
+        eng = self._engines[sig] = InferenceEngine.from_inferencer(
+            self._inferencer, feed_spec=feed,
+            buckets=self._buckets or DEFAULT_BUCKETS,
+            warm=False, name="v2.infer", metrics=self.metrics)
+        while len(self._engines) > self._max_engines:
+            self._engines.popitem(last=False)   # least recently used
+            self.metrics.evict_engine_cache()
         return eng
 
     def infer(self, input, feeding=None):

@@ -46,7 +46,7 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "tpu_differential_pytest.log", "nmt_scale.json",
                  "perf_report.md", "analytic.json",
                  "analytic_snapshot.json", "serving_smoke.json",
-                 "WINDOW_DONE"):
+                 "serving_gen_smoke.json", "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -61,7 +61,7 @@ def test_dryrun_executes_every_phase(tmp_path):
         assert row.get("value") is not None, (combo, row)
     snap = json.loads((art / "analytic_snapshot.json").read_text())
     assert set(snap["families"]) == {"smallnet", "trainer_prefetch",
-                                     "serving"}
+                                     "serving", "serving_generate"}
     for fam, row in snap["families"].items():
         assert row.get("predicted_ms", 0) > 0, (fam, row)
     # the serving smoke really served: every request answered, the
@@ -73,6 +73,16 @@ def test_dryrun_executes_every_phase(tmp_path):
     assert smoke_srv["bad_request_status"] == 400, smoke_srv
     assert smoke_srv["metrics_sane"] is True, smoke_srv
     assert smoke_srv["mean_occupancy"] > 1.0, smoke_srv
+    # the generation smoke really generated: every staggered request
+    # answered, the stream matched the plain response, the EOS probe
+    # finished early, and the TTFT/slot metrics rendered
+    smoke_gen = json.loads((art / "serving_gen_smoke.json").read_text())
+    assert smoke_gen["value"] == int(smoke_gen["unit"].split("/")[1]), \
+        smoke_gen
+    assert smoke_gen["stream_ok"] is True, smoke_gen
+    assert smoke_gen["eos_early_finish"] is True, smoke_gen
+    assert smoke_gen["metrics_sane"] is True, smoke_gen
+    assert smoke_gen["gen_tokens_total"] > 0, smoke_gen
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows

@@ -366,6 +366,51 @@ def test_v2_infer_sequence_feeds_across_padded_lengths():
         np.testing.assert_allclose(got, direct, rtol=1e-6, atol=1e-7)
 
 
+def test_v2_engine_cache_lru_bounded_with_eviction_counter():
+    """Satellite: the per-row-signature engine table is a bounded LRU —
+    under many distinct padded lengths it stops growing, counts its
+    evictions (surfaced at /metrics as engine_cache_evictions_total),
+    and an evicted signature that returns simply recompiles and still
+    serves the right numbers."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.core.sequence import SequenceBatch
+    import jax.numpy as jnp
+    ids = L.data_layer("ids", size=50)
+    emb = L.embedding_layer(input=ids, size=8)
+    pooled = L.pooling_layer(input=emb, pooling_type=None)
+    out = L.fc_layer(input=pooled, size=2, act="softmax")
+    topo = Topology([out])
+    params = topo.init(jax.random.PRNGKey(0))
+    inf = paddle.inference.Inference(out, params, max_engines=3)
+    rng = np.random.RandomState(11)
+
+    def feed(t):
+        return {"ids": SequenceBatch(
+            data=jnp.asarray(rng.randint(0, 50, (2, t)), jnp.int32),
+            lengths=jnp.asarray([t, max(1, t - 1)], jnp.int32))}
+
+    for t in range(4, 11):          # 7 distinct signatures through cap 3
+        inf.infer(feed(t))
+    assert len(inf._engines) == 3
+    assert inf.metrics.engine_cache_evictions == 4
+    assert "engine_cache_evictions_total 4" \
+        in inf.metrics.render_prometheus()
+    # the evicted t=4 signature returns: recompiles, same numerics
+    fd = feed(4)
+    direct = np.asarray(topo.apply(params, dict(fd), mode="test"))
+    np.testing.assert_allclose(np.asarray(inf.infer(fd)), direct,
+                               rtol=1e-6, atol=1e-7)
+    # most-recently-used signatures survived the round trip
+    assert len(inf._engines) == 3
+
+    # default bound: the ragged-length loop that used to grow without
+    # limit now stays capped
+    inf8 = paddle.inference.Inference(out, params)
+    for t in range(3, 13):
+        inf8.infer(feed(t))
+    assert len(inf8._engines) <= 8
+
+
 # ---------------------------------------------------------------- HTTP
 
 
