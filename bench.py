@@ -947,6 +947,9 @@ def bench_serving_engine(batch=32, dim=256, hidden=1024, classes=32,
     extras = {"lower": lambda: engine.lower(ladder[-1])}
     if os.environ.get("BENCH_ANALYTIC_BUILD") != "1":
         engine.warmup()
+        # health probe (the /readyz readiness contract, docs/serving.md
+        # §5): an unwarm ladder would put compiles on the timed clock
+        assert engine.ready, "serving bench engine not ready after warmup"
         drive(8, batch, 64)             # warm the whole batched path
         sweep = [drive(c, batch, n_requests) for c in (2, 8, 32)]
         sat = sweep[-1]

@@ -64,11 +64,16 @@ def _fill(source, convert, place, stop, q):
     RUNNING Thread strongly references its target, so a method target
     would keep the prefetcher alive and its GC finalizer from ever
     firing."""
+    from paddle_tpu.resilience import faults
     try:
         for batch in source():
             if stop.is_set():
                 return
             feed = convert(batch) if convert else batch
+            # fault point at the H2D boundary (resilience/faults.py): an
+            # injected failure crosses to the consumer like any real
+            # placement error — surfaced at its next __next__
+            faults.hit("data.prefetch.h2d")
             feed = place(feed)
             if not _bounded_put(q, stop, feed):
                 return

@@ -28,6 +28,10 @@
 #  8. generation serving smoke          (continuous-batching decode engine:
 #                                        concurrent staggered /v1/generate,
 #                                        streaming, EOS early-finish)
+#  9. chaos smoke                       (resilience layer: server under an
+#                                        injected decode-step fault, slot
+#                                        re-prefill recovery bit-identical;
+#                                        kill-9 trainer + resume)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -188,6 +192,15 @@ log "phase 8: generation serving smoke (continuous-batching decode engine)"
 timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-generate \
     > "$ART/serving_gen_smoke.json" 2> "$ART/serving_gen_smoke.log"
 log "generation smoke rc=$? -> $ART/serving_gen_smoke.json"
+
+log "phase 9: chaos smoke (fault injection + supervised recovery)"
+# serving under an injected decode-step fault (recovered streams must be
+# bit-identical to the clean run) + kill-9 trainer resume at smoke scale
+# — one JSON line, nonzero rc on any failed check
+# (python -m paddle_tpu.resilience --smoke; docs/serving.md §5)
+timeout "$T_SERVE" python -m paddle_tpu.resilience --smoke \
+    > "$ART/chaos_smoke.json" 2> "$ART/chaos_smoke.log"
+log "chaos smoke rc=$? -> $ART/chaos_smoke.json"
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

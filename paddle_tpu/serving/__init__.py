@@ -13,7 +13,9 @@ executables (docs/serving.md §3) and continuous-batching generation
                      slab (prefill through the bucketed engine ladder,
                      per-token streaming, TTFT/TPOT metrics)
     server.py        JSON/HTTP front-end (/v1/infer, /v1/generate,
-                     /healthz, /metrics) + CLI
+                     /healthz liveness, /readyz readiness, /metrics)
+                     + CLI; 429/503 carry Retry-After, SIGTERM drain
+                     under a hard deadline (docs/serving.md §5)
     metrics.py       ServingMetrics — latency/TTFT/TPOT percentiles,
                      occupancy, padding waste, slot evictions, queue
                      depth; Prometheus text at /metrics
@@ -22,6 +24,7 @@ executables (docs/serving.md §3) and continuous-batching generation
     python -m paddle_tpu.serving --demo-generate --port 8080
 """
 
+from paddle_tpu.resilience.supervisor import BreakerOpenError, Supervisor
 from paddle_tpu.serving.batcher import (BatchExecutionError, Batcher,
                                         DeadlineExceededError,
                                         OverloadedError, ShutdownError)
@@ -32,8 +35,9 @@ from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.serving.server import make_server
 
 __all__ = [
-    "Batcher", "BatchExecutionError", "DeadlineExceededError",
-    "DecodeEngine", "DEFAULT_BUCKETS", "GenerationBatcher",
-    "InferenceEngine", "InvalidRequestError", "OverloadedError",
-    "ServingMetrics", "ShutdownError", "make_server",
+    "Batcher", "BatchExecutionError", "BreakerOpenError",
+    "DeadlineExceededError", "DecodeEngine", "DEFAULT_BUCKETS",
+    "GenerationBatcher", "InferenceEngine", "InvalidRequestError",
+    "OverloadedError", "ServingMetrics", "ShutdownError", "Supervisor",
+    "make_server",
 ]

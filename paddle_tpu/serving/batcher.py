@@ -32,6 +32,7 @@ from concurrent.futures import Future, InvalidStateError
 import jax
 import numpy as np
 
+from paddle_tpu.resilience import faults
 from paddle_tpu.serving.engine import InvalidRequestError, _np_leaf
 from paddle_tpu.utils.logging import logger
 
@@ -120,6 +121,9 @@ class Batcher:
         checked before queueing so a malformed request can never poison a
         batch), ``OverloadedError`` (queue full), ``ShutdownError``
         (draining)."""
+        # fault point FIRST: an injected submit failure provably mutated
+        # nothing, so retry_transient's idempotence guarantee holds
+        faults.hit("batcher.submit")
         if self._closed.is_set():
             self.metrics.reject("shutdown")
             raise ShutdownError(f"{self.name} is draining; submit rejected")
@@ -253,6 +257,12 @@ class Batcher:
     @property
     def closed(self):
         return self._closed.is_set()
+
+    @property
+    def ready(self):
+        """Readiness (/readyz): accepting work AND the engine's ladder
+        is warm (no request can pay a compile or hit a drain)."""
+        return not self._closed.is_set() and self.engine.ready
 
     def __enter__(self):
         return self

@@ -47,6 +47,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.supervisor import (BreakerOpenError,
+                                              WatchdogTimeout)
 from paddle_tpu.serving.batcher import (BatchExecutionError,
                                         DeadlineExceededError,
                                         OverloadedError, ShutdownError)
@@ -117,6 +120,14 @@ class DecodeEngine:
         self._tokens = np.zeros((self.num_slots,), np.int32)
         self._pos = np.zeros((self.num_slots,), np.int32)
         self._free = list(range(self.num_slots))[::-1]   # pop() -> slot 0 first
+        # epoch guard: reset() bumps it, step() refuses to commit across
+        # a bump — a watchdog-abandoned step finishing LATE (its thread
+        # cannot be killed) can never write its cache into a rebuilt
+        # slab.  The lock makes {epoch check + cache commit} atomic
+        # against {epoch bump + slab rebuild}: without it a stale step
+        # could pass the check and then overwrite the fresh slab.
+        self._epoch = 0
+        self._epoch_lock = threading.Lock()
         self._prefill_batch_buckets = tuple(prefill_batch_buckets)
         self._prefill_engines = {}     # length bucket -> InferenceEngine
         self._step_traces = [0]
@@ -200,6 +211,7 @@ class DecodeEngine:
         [bucket, Dkv] — BUCKET-length prefixes, which is all admission
         writes into the slab; see ``admit``).
         """
+        faults.hit("serving.prefill")
         prompts = np.asarray(prompts, np.int32)
         lengths = np.asarray(lengths, np.int32)
         n, t = prompts.shape
@@ -234,6 +246,12 @@ class DecodeEngine:
         exactly 1 after warm-up, flat across admission/eviction churn).
         ``lower()`` is an offline tool and re-stages (+1)."""
         return self._step_traces[0]
+
+    @property
+    def ready(self):
+        """Readiness (/readyz): the slab step, admission write, and
+        prefill ladder are all warm."""
+        return self._warm
 
     @property
     def metrics(self):
@@ -279,11 +297,28 @@ class DecodeEngine:
         slot ([num_slots] np.int32).  Free slots compute too (fixed-shape
         slab — that is the cost model) but their output is garbage the
         caller ignores and their cache rows are overwritten at admission.
-        Callers then bump their active slots via ``advance``."""
+        Callers then bump their active slots via ``advance``.
+
+        Epoch-guarded: inputs are snapshotted up front and the result is
+        only committed if no ``reset()`` happened meanwhile — so a
+        watchdog-abandoned step that finishes late consumes its own
+        (already orphaned) cache buffer and then discards itself,
+        instead of poisoning the rebuilt slab."""
+        epoch = self._epoch
+        params, cache = self.params, self._cache
+        tokens, pos = self._tokens.copy(), self._pos.copy()
+        # the fault point sits at the device-step boundary: a hang here
+        # models a wedged device step for the watchdog to catch
+        faults.hit("serving.decode_step")
         t0 = time.perf_counter()
-        nxt, self._cache = self._jit_step(self.params, self._cache,
-                                          self._tokens, self._pos)
+        nxt, cache = self._jit_step(params, cache, tokens, pos)
         nxt = np.asarray(nxt)
+        with self._epoch_lock:
+            if epoch != self._epoch:
+                raise RuntimeError(
+                    f"{self.name}: engine was reset mid-step; stale step "
+                    "result discarded")
+            self._cache = cache
         self.metrics.observe_decode_step(self.num_active, self.num_slots,
                                          time.perf_counter() - t0)
         return nxt
@@ -297,9 +332,13 @@ class DecodeEngine:
     def reset(self):
         """Drop all slot state and re-zero the cache slab (the batch-
         failure isolation path: a failed step must not leak a poisoned
-        slab into the next batch)."""
-        self._cache = self._transformer.init_lm_cache(
-            self.params, self.num_slots, self.max_len)
+        slab into the next batch).  The compiled step/admit/prefill
+        executables stay jit-cached — a rebuild costs zero new traces —
+        and the epoch bump orphans any still-running stale step."""
+        with self._epoch_lock:
+            self._epoch += 1
+            self._cache = self._transformer.init_lm_cache(
+                self.params, self.num_slots, self.max_len)
         self._tokens[:] = 0
         self._pos[:] = 0
         self._free = list(range(self.num_slots))[::-1]
@@ -389,10 +428,13 @@ class DecodeEngine:
 class _GenRequest:
     __slots__ = ("prompt", "max_tokens", "eos_id", "future", "deadline",
                  "t_submit", "t_first", "on_token", "tokens", "slot",
-                 "abandoned")
+                 "abandoned", "recoveries", "replay_feed")
 
     def __init__(self, prompt, max_tokens, eos_id, deadline, on_token):
         self.abandoned = False
+        self.recoveries = 0
+        self.replay_feed = []     # recovery replay: recorded tokens still
+        #                           to teacher-force through the slab step
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.eos_id = eos_id
@@ -446,9 +488,16 @@ class GenerationBatcher:
     """
 
     def __init__(self, engine, queue_size=256, default_deadline_ms=None,
-                 default_max_tokens=64, admission="continuous", name=None):
+                 default_max_tokens=64, admission="continuous", name=None,
+                 supervisor=None):
         self.engine = engine
         self.metrics = engine.metrics
+        # resilience.Supervisor (None = PR-5 semantics: a step failure
+        # fails the in-flight batch).  With one attached: step failures
+        # and watchdog trips REBUILD the slab and re-prefill every
+        # in-flight request (streams continue bit-identically), and the
+        # circuit breaker sheds admissions after repeated failures.
+        self.supervisor = supervisor
         self.default_deadline_s = (float(default_deadline_ms) / 1e3
                                    if default_deadline_ms else None)
         self.default_max_tokens = int(default_max_tokens)
@@ -488,8 +537,13 @@ class GenerationBatcher:
         streaming hook — exceptions are logged, never fatal).
 
         Raises synchronously: ``InvalidRequestError``,
-        ``OverloadedError`` (queue full), ``ShutdownError`` (draining).
+        ``OverloadedError`` (queue full), ``ShutdownError`` (draining),
+        ``BreakerOpenError`` (circuit breaker shedding; carries
+        ``retry_after_s``).
         """
+        # fault point FIRST: an injected submit failure provably mutated
+        # nothing, so retry_transient's idempotence guarantee holds
+        faults.hit("batcher.submit")
         if self._closed.is_set():
             self.metrics.reject("shutdown")
             raise ShutdownError(f"{self.name} is draining; submit rejected")
@@ -500,6 +554,17 @@ class GenerationBatcher:
         except InvalidRequestError:
             self.metrics.reject("invalid")
             raise
+        # breaker AFTER validation: a malformed request must not burn the
+        # half-open probe slot (it would never reach a step to resolve it)
+        if self.supervisor is not None:
+            ok, retry_after = self.supervisor.breaker.admit()
+            if not ok:
+                self.metrics.reject("breaker")
+                self._snap_breaker()
+                raise BreakerOpenError(
+                    f"{self.name}: circuit breaker open (engine recently "
+                    f"failed repeatedly); retry in {retry_after:.2f}s",
+                    retry_after_s=retry_after)
         dl_s = (float(deadline_ms) / 1e3 if deadline_ms
                 else self.default_deadline_s)
         req = _GenRequest(prompt, max_tokens,
@@ -509,12 +574,17 @@ class GenerationBatcher:
         with self._admit_lock:
             if self._closed.is_set():   # close() raced the check above
                 self.metrics.reject("shutdown")
+                if self.supervisor is not None:     # the request never
+                    self.supervisor.breaker.release_probe()   # ran: hand
+                #                                     the probe slot back
                 raise ShutdownError(
                     f"{self.name} is draining; submit rejected")
             try:
                 self._q.put_nowait(req)
             except queue.Full:
                 self.metrics.reject("overload")
+                if self.supervisor is not None:
+                    self.supervisor.breaker.release_probe()
                 raise OverloadedError(
                     f"{self.name}: queue full ({self._q.maxsize} waiting)") \
                     from None
@@ -648,6 +718,73 @@ class GenerationBatcher:
                         break
                     self._by_slot[req.slot] = req
 
+    def _snap_breaker(self):
+        """Mirror the breaker's state into the metrics gauge."""
+        b = self.supervisor.breaker
+        self.metrics.set_breaker_state(b.state, b.opened_total)
+
+    def _recover_inflight(self, e):
+        """The supervised step failed (error or watchdog trip): rebuild
+        the slab from the AOT cache (``reset()`` — the compiled step is
+        jit-cached, so the rebuild costs ZERO new traces) and re-prefill
+        every in-flight request from prompt + tokens-generated-so-far,
+        continuing each greedy stream bit-identically
+        (``Supervisor.reprefill``).  A request whose replay outgrew the
+        prefill ladder or whose recovery budget ran out fails with the
+        cause; everything else keeps streaming."""
+        sup = self.supervisor
+        victims = list(self._by_slot.values())
+        self._by_slot.clear()
+        logger.warning("%s: supervised step over %d request(s) failed: "
+                       "%s: %s — rebuilding slab + re-prefilling",
+                       self.name, len(victims), type(e).__name__, e)
+        self.engine.reset()     # bumps the epoch: a hung stale step can
+        #                         never commit into the rebuilt slab
+        # eviction reasons are counted per OUTCOME below: a victim that
+        # re-seats counts "recovered"; one whose caller left counts
+        # "abandoned"; one that cannot be recovered counts "error"
+        recoverable = []
+        for req in victims:
+            if req.future in self._abandoned:
+                self._abandoned.discard(req.future)
+                req.abandoned = True
+            if req.abandoned:
+                self.metrics.evict_slot("abandoned")
+                self._resolve(req, "abandoned")
+                continue
+            req.recoveries += 1
+            if req.recoveries > sup.max_request_recoveries:
+                self.metrics.evict_slot("error")
+                self.metrics.observe_error(1)
+                req.fail(BatchExecutionError(
+                    f"request failed after {req.recoveries - 1} slot "
+                    f"recoveries: {type(e).__name__}: {e}"))
+                continue
+            recoverable.append(req)
+        if not recoverable:
+            return
+        # same-bucket victims re-prefill as ONE engine batch; each
+        # result is (slot, replay_feed) or the exception for that victim
+        try:
+            outcomes = sup.reprefill(self.engine,
+                                     [(req.prompt, req.tokens)
+                                      for req in recoverable])
+        except Exception as re:    # noqa: BLE001 — an unexpected recovery
+            # crash must fail the victims, never the worker thread
+            outcomes = [re] * len(recoverable)
+        for req, out in zip(recoverable, outcomes):
+            if isinstance(out, BaseException):
+                self.metrics.evict_slot("error")
+                self.metrics.observe_error(1)
+                req.fail(BatchExecutionError(
+                    f"slot recovery failed: {type(out).__name__}: {out} "
+                    f"(after step failure: {type(e).__name__}: {e})"))
+                continue
+            req.slot, req.replay_feed = out
+            self._by_slot[req.slot] = req
+            self.metrics.evict_slot("recovered")
+            self.metrics.observe_slot_reprefill()
+
     def _fail_all_inflight(self, e, extra=()):
         """A device operation (step or slot admission) failed: fail every
         in-flight request (plus ``extra`` ones caught mid-admission) with
@@ -681,11 +818,32 @@ class GenerationBatcher:
                 if self._closed.is_set() and self._q.empty():
                     return
                 continue
+            sup = self.supervisor
             try:
-                nxt = self.engine.step()
+                if sup is None:
+                    nxt = self.engine.step()
+                else:
+                    try:
+                        nxt = sup.run_step(self.engine)
+                    except WatchdogTimeout:
+                        self.metrics.observe_watchdog_trip()
+                        raise
+                    sup.breaker.record_success()
+                    self._snap_breaker()
             except Exception as e:    # noqa: BLE001 — isolate to the
                 # requests in flight; the loop keeps serving
-                self._fail_all_inflight(e)
+                if sup is not None:
+                    opened = sup.breaker.record_failure()
+                    self._snap_breaker()
+                    if opened:
+                        logger.warning(
+                            "%s: circuit breaker OPEN after %d consecutive "
+                            "step failures; shedding new admissions for "
+                            "%.1fs", self.name, sup.breaker.threshold,
+                            sup.breaker.cooldown_s)
+                    self._recover_inflight(e)
+                else:
+                    self._fail_all_inflight(e)
                 continue
             for slot, req in list(self._by_slot.items()):
                 if req.future in self._abandoned:
@@ -695,6 +853,13 @@ class GenerationBatcher:
                     req.abandoned = True
                 if req.abandoned:
                     self._finish(req, "abandoned")
+                    continue
+                if req.replay_feed:
+                    # recovery replay (teacher-forced): this step's
+                    # emission re-derives an already-delivered token —
+                    # swallow it and feed the recorded stream instead,
+                    # until the slot regains its pre-failure position
+                    self.engine.advance(slot, req.replay_feed.pop(0))
                     continue
                 tok = int(nxt[slot])
                 req.emit(tok, self.name)
@@ -739,6 +904,18 @@ class GenerationBatcher:
     @property
     def closed(self):
         return self._closed.is_set()
+
+    @property
+    def ready(self):
+        """Readiness (/readyz): accepting work, the engine is warm, and
+        the circuit breaker is not OPEN.  Half-open counts ready: the
+        balancer must route again or the probe that would reclose the
+        breaker could never arrive (non-probe admits shed with
+        Retry-After, which is the breaker doing its job)."""
+        if self._closed.is_set() or not self.engine.ready:
+            return False
+        return self.supervisor is None \
+            or self.supervisor.breaker.state != "open"
 
     def __enter__(self):
         return self

@@ -35,6 +35,7 @@ import time
 import numpy as np
 import jax
 
+from paddle_tpu.resilience import faults
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.utils.error import ConfigError
 from paddle_tpu.utils.logging import logger
@@ -273,6 +274,13 @@ class InferenceEngine:
     def trace_count(self):
         return self._trace_box[0]
 
+    @property
+    def ready(self):
+        """Readiness (the /readyz half of health): every ladder bucket
+        holds a warmed executable, so no request can pay a compile."""
+        with self._lock:
+            return all(b in self._compiled for b in self.buckets)
+
     def bucket_for(self, n):
         """Smallest bucket >= n, or None when n exceeds the ladder top."""
         for b in self.buckets:
@@ -366,6 +374,7 @@ class InferenceEngine:
     def _infer_bucketed(self, feed, b):
         bucket = self.bucket_for(b)
         fn = self._exec_for(bucket)
+        faults.hit("serving.engine.execute")
         t0 = time.perf_counter()
         with timer("serving_batch"):
             out = fn(_pad_rows(feed, bucket))

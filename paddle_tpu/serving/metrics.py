@@ -19,15 +19,23 @@ import threading
 
 from paddle_tpu.utils.stats import Histogram
 
-# submit() rejection reasons — keys are part of the /metrics surface
-REJECT_REASONS = ("overload", "deadline", "invalid", "shutdown")
+# submit() rejection reasons — keys are part of the /metrics surface.
+# breaker = the circuit breaker is open (resilience/supervisor.py): the
+# engine recently failed M consecutive steps, shed fast with 503.
+REJECT_REASONS = ("overload", "deadline", "invalid", "shutdown", "breaker")
 
 # decode-slot eviction reasons (generation serving, decode_engine.py):
 # eos = the model emitted the stop token, length = per-request max_tokens
 # reached, error = the slot's request failed with its batch, shutdown =
-# drain(False) failed it, abandoned = the caller disconnected mid-stream.
-# Keys are part of the /metrics surface.
-EVICT_REASONS = ("eos", "length", "error", "shutdown", "abandoned")
+# drain(False) failed it, abandoned = the caller disconnected mid-stream,
+# recovered = the slot was torn down by a step failure and re-prefilled
+# onto the rebuilt slab (resilience/supervisor.py).  Keys are part of
+# the /metrics surface.
+EVICT_REASONS = ("eos", "length", "error", "shutdown", "abandoned",
+                 "recovered")
+
+# circuit-breaker state gauge encoding (breaker_state metric)
+BREAKER_STATES = {"closed": 0, "half_open": 1, "open": 2}
 
 _QUANTILES = (50, 95, 99)
 
@@ -70,6 +78,12 @@ class ServingMetrics:
         # v2 Inference per-row-signature engine cache (satellite): LRU
         # evictions of whole compiled engines under ragged feed signatures
         self.engine_cache_evictions = 0
+        # ---- resilience (resilience/): recovery events all flow here
+        self.retries_total = 0           # transient submit retries taken
+        self.watchdog_trips_total = 0    # step deadline misses
+        self.slot_reprefills_total = 0   # slots rebuilt by re-prefill
+        self.breaker_open_total = 0      # times the breaker tripped open
+        self.breaker_state = 0           # gauge: 0 closed/1 half-open/2 open
         # wired by batchers: each contributes a zero-arg callable -> its
         # current queue depth; queue_depth() sums them (a combined
         # inference+generation server shares ONE metrics object, and one
@@ -125,6 +139,28 @@ class ServingMetrics:
         with self._lock:
             self.engine_cache_evictions += 1
 
+    # ---- resilience events (resilience/supervisor.py callers) ----
+
+    def observe_retry(self, n=1):
+        with self._lock:
+            self.retries_total += int(n)
+
+    def observe_watchdog_trip(self):
+        with self._lock:
+            self.watchdog_trips_total += 1
+
+    def observe_slot_reprefill(self, n=1):
+        with self._lock:
+            self.slot_reprefills_total += int(n)
+
+    def set_breaker_state(self, state, opened_total=None):
+        """Snapshot the breaker's state ('closed'/'half_open'/'open')
+        and cumulative open count into the gauge/counter pair."""
+        with self._lock:
+            self.breaker_state = BREAKER_STATES.get(state, 0)
+            if opened_total is not None:
+                self.breaker_open_total = int(opened_total)
+
     # ------------------------------------------------------------ derive
 
     @property
@@ -178,7 +214,14 @@ class ServingMetrics:
                 "slot_count": self.slot_count,
                 "evictions": dict(self.evictions),
                 "engine_cache_evictions": self.engine_cache_evictions,
+                "retries_total": self.retries_total,
+                "watchdog_trips_total": self.watchdog_trips_total,
+                "slot_reprefills_total": self.slot_reprefills_total,
+                "breaker_open_total": self.breaker_open_total,
+                "breaker_state": self.breaker_state,
             }
+        from paddle_tpu.resilience import faults
+        out["faults_fired"] = faults.fired_counts()
         out["queue_depth"] = self.queue_depth()
         out["mean_occupancy"] = round(self.mean_occupancy, 3)
         out["padding_waste"] = round(self.padding_waste, 3)
@@ -290,4 +333,30 @@ class ServingMetrics:
         for q, v in tpot.items():
             lines.append(f'{n}_tpot_seconds{{quantile="0.{q}"}} {v:.6f}')
         lines.append(f"{n}_tpot_seconds_count {self.tpot.count}")
+
+        # ---- resilience (resilience/: faults, watchdog, breaker) ----
+        from paddle_tpu.resilience import faults
+        with self._lock:
+            res_counters = [
+                ("retries_total", self.retries_total,
+                 "transient submit failures absorbed by bounded retry"),
+                ("watchdog_trips_total", self.watchdog_trips_total,
+                 "decode steps abandoned past the watchdog deadline"),
+                ("slot_reprefills_total", self.slot_reprefills_total,
+                 "decode slots recovered by re-prefill after a rebuild"),
+                ("breaker_open_total", self.breaker_open_total,
+                 "times the circuit breaker tripped open"),
+            ]
+            breaker_state = self.breaker_state
+        for metric, value, help_ in res_counters:
+            emit(metric, value, help_, mtype="counter")
+        emit("breaker_state", breaker_state,
+             "circuit breaker state (0 closed, 1 half-open, 2 open)")
+        fired = faults.fired_counts()
+        lines.append(f"# HELP {n}_fault_injections_total injected faults "
+                     "fired, by point (resilience/faults.py)")
+        lines.append(f"# TYPE {n}_fault_injections_total counter")
+        for point in sorted(fired):
+            lines.append(f'{n}_fault_injections_total{{point="{point}"}} '
+                         f"{fired[point]}")
         return "\n".join(lines) + "\n"

@@ -10,8 +10,10 @@ Endpoints:
   POST /v1/infer   {"feed": {slot: array}, "deadline_ms": optional}
                    -> {"outputs": ..., "latency_ms": ...}
                    errors map to status codes: invalid feed/JSON 400,
-                   overload 429, shutdown 503, deadline 504, batch
-                   failure 500 — always a JSON body with "error".
+                   overload 429, shutdown/breaker 503, deadline 504,
+                   batch failure 500 — always a JSON body with "error";
+                   429/503 carry a Retry-After header (breaker- and
+                   queue-depth-derived; docs/serving.md §5).
   POST /v1/generate {"prompt": [ids], "max_tokens": N, "eos_id": opt,
                     "deadline_ms": opt, "stream": false}
                    -> {"tokens": [...], "finish_reason": "eos"|"length",
@@ -21,7 +23,11 @@ Endpoints:
                    true, ...} record) over chunked transfer encoding —
                    continuous-batching generation (decode_engine.py,
                    docs/serving.md §4); same error-code mapping.
-  GET  /healthz    200 {"status": "ok", ...} (503 once draining)
+  GET  /healthz    LIVENESS: 200 while the process is alive (even
+                   draining — a balancer uses /readyz to route)
+  GET  /readyz     READINESS: 200 when warm-up is complete, the circuit
+                   breaker is closed, and no drain has begun; 503 (with
+                   the blocking reasons and Retry-After) otherwise
   GET  /metrics    Prometheus text (serving/metrics.py)
 
 CLI (``python -m paddle_tpu.serving``):
@@ -59,13 +65,45 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 import jax
 
+from paddle_tpu.resilience.supervisor import (BreakerOpenError, Supervisor,
+                                              retry_transient)
 from paddle_tpu.serving.batcher import (Batcher, DeadlineExceededError,
                                         OverloadedError, ShutdownError)
 from paddle_tpu.serving.engine import InferenceEngine, InvalidRequestError
 from paddle_tpu.utils.logging import logger
 
 _STATUS = ((InvalidRequestError, 400), (OverloadedError, 429),
-           (ShutdownError, 503), (DeadlineExceededError, 504))
+           (BreakerOpenError, 503), (ShutdownError, 503),
+           (DeadlineExceededError, 504))
+
+
+def _retry_after_for(e, metrics, drain_timeout_s=None):
+    """Retry-After seconds for a shedding response (429/503), derived
+    from the shedding cause: breaker -> its remaining cooldown; overload
+    -> expected queue drain time (depth x recent p50 batch time);
+    drain -> the EFFECTIVE drain deadline (the --drain-timeout-s the
+    server was started with, not the raw flag — the process is going
+    away within that window)."""
+    if isinstance(e, BreakerOpenError):
+        return max(1, int(round(e.retry_after_s + 0.5)))
+    if isinstance(e, OverloadedError):
+        p50 = depth = 0
+        if metrics is not None:
+            # inference plane: per-batch engine time.  Generation plane:
+            # batch_time only sees prefill batches (decode time lands in
+            # tpot), so fall back to the request WALL latency — an over-
+            # estimate under load, which errs toward clients backing off
+            # longer (the safe direction), capped below.
+            p50 = metrics.batch_time.percentiles((50,)).get(50, 0.0) \
+                or metrics.latency.percentiles((50,)).get(50, 0.0)
+            depth = metrics.queue_depth()
+        return max(1, min(30, int(round(depth * p50 + 0.5))))
+    if isinstance(e, ShutdownError):
+        if drain_timeout_s is None:
+            from paddle_tpu.utils.flags import FLAGS
+            drain_timeout_s = FLAGS.serving_drain_timeout_s
+        return max(1, int(drain_timeout_s))
+    return None
 
 
 def _json_to_row(engine, obj):
@@ -110,12 +148,15 @@ class ServingHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):   # route access logs to our logger
         logger.debug("http: " + fmt, *args)
 
-    def _reply(self, code, payload, content_type="application/json"):
+    def _reply(self, code, payload, content_type="application/json",
+               headers=None):
         body = (payload if isinstance(payload, bytes)
                 else json.dumps(payload).encode())
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
@@ -123,22 +164,61 @@ class ServingHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         # one server serves an inference batcher, a generation batcher,
-        # or both; health/metrics report whichever exists — and draining
-        # on EITHER plane marks the node unhealthy (a balancer must stop
-        # routing as soon as any served endpoint starts rejecting 503)
+        # or both; health/metrics report whichever exists.  Liveness vs
+        # readiness (docs/serving.md §5): /healthz answers "is the
+        # process alive" — 200 as long as we can answer at all, so an
+        # orchestrator never kills a node that is merely draining or
+        # warming; /readyz answers "should a balancer route here" — 503
+        # while warm-up is incomplete, the circuit breaker is open, or a
+        # drain has begun on EITHER plane.
         batchers = [b for b in (self.server.batcher,
                                 self.server.gen_batcher) if b is not None]
         batcher = batchers[0]
         if self.path == "/healthz":
             draining = any(b.closed for b in batchers)
             engine = batcher.engine
-            self._reply(503 if draining else 200, {
-                "status": "draining" if draining else "ok",
+            self._reply(200, {
+                "status": "ok",
+                "draining": draining,
                 "model": engine.name,
                 "buckets": list(getattr(engine, "buckets", None)
                                 or getattr(engine, "prefill_buckets", ())),
                 "queue_depth": batcher.metrics.queue_depth(),
             })
+        elif self.path == "/readyz":
+            reasons = []
+            retry_after = 1.0
+            for b in batchers:
+                if b.closed:
+                    reasons.append("draining")
+                    # the process is going away within the drain window
+                    retry_after = max(
+                        retry_after,
+                        getattr(self.server, "drain_timeout_s", None)
+                        or 1.0)
+                elif not b.engine.ready:
+                    reasons.append("warming")
+                elif not b.ready:
+                    # warm and was accepting: either the breaker is open
+                    # (supervised generation plane) or a close() raced
+                    # these checks (any plane — report it as the drain
+                    # it is)
+                    sup = getattr(b, "supervisor", None)
+                    if sup is not None \
+                            and sup.breaker.state != "closed":
+                        reasons.append("breaker_open")
+                        retry_after = max(
+                            retry_after,
+                            sup.breaker.seconds_until_probe())
+                    else:
+                        reasons.append("draining")
+            reasons = sorted(set(reasons))
+            if reasons:
+                self._reply(503, {"status": "unready", "reasons": reasons},
+                            headers={"Retry-After":
+                                     max(1, int(round(retry_after)))})
+            else:
+                self._reply(200, {"status": "ready"})
         elif self.path == "/metrics":
             self._reply(200, batcher.metrics.render_prometheus().encode(),
                         content_type="text/plain; version=0.0.4")
@@ -167,13 +247,33 @@ class ServingHandler(BaseHTTPRequestHandler):
                                       "number")
         return deadline_ms
 
-    def _error_reply(self, e):
+    def _error_reply(self, e, metrics=None):
         for etype, code in _STATUS:
             if isinstance(e, etype):
                 break
         else:
             code = 500
-        self._reply(code, {"error": f"{type(e).__name__}: {e}"})
+        headers = {}
+        if code in (429, 503):
+            ra = _retry_after_for(
+                e, metrics,
+                drain_timeout_s=getattr(self.server, "drain_timeout_s",
+                                        None))
+            if ra is not None:
+                headers["Retry-After"] = ra
+        self._reply(code, {"error": f"{type(e).__name__}: {e}"},
+                    headers=headers)
+
+    def _submit_retrying(self, batcher, fn):
+        """Submit with the bounded transient-failure retry policy
+        (resilience/supervisor.py): exponential backoff + jitter, budget
+        from the resilience_retry_budget flag, retries counted into
+        /metrics.  Safe because submit's fault point fires before any
+        queue mutation (idempotent failed attempts)."""
+        from paddle_tpu.utils.flags import FLAGS
+        return retry_transient(
+            fn, budget=FLAGS.resilience_retry_budget,
+            on_retry=lambda _a, _e: batcher.metrics.observe_retry())
 
     def do_POST(self):
         if self.path == "/v1/generate":
@@ -194,7 +294,9 @@ class ServingHandler(BaseHTTPRequestHandler):
                 raise InvalidRequestError('body must be {"feed": {...}}')
             deadline_ms = self._deadline_ms(req)
             row = _json_to_row(batcher.engine, req["feed"])
-            fut = batcher.submit(row, deadline_ms=deadline_ms)
+            fut = self._submit_retrying(
+                batcher, lambda: batcher.submit(row,
+                                                deadline_ms=deadline_ms))
             # bounded wait: batch errors surface here; the timeout is a
             # backstop against a wedged engine, not a policy knob (use
             # deadline_ms for per-request deadlines)
@@ -204,7 +306,7 @@ class ServingHandler(BaseHTTPRequestHandler):
                 "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
             })
         except Exception as e:    # noqa: BLE001 — every error is a response
-            self._error_reply(e)
+            self._error_reply(e, metrics=batcher.metrics)
 
     # ------------------------------------------------------- POST generate
 
@@ -239,12 +341,13 @@ class ServingHandler(BaseHTTPRequestHandler):
             if req.get("stream"):
                 self._generate_stream(gen, prompt, kw, t0)
                 return
-            out = gen.submit(prompt, **kw).result(timeout=600)
+            out = self._submit_retrying(
+                gen, lambda: gen.submit(prompt, **kw)).result(timeout=600)
             out = dict(out)
             out["latency_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
             self._reply(200, out)
         except Exception as e:    # noqa: BLE001 — every error is a response
-            self._error_reply(e)
+            self._error_reply(e, metrics=gen.metrics)
 
     def _generate_stream(self, gen, prompt, kw, t0):
         """Chunked-transfer NDJSON stream: one {"token": id} record per
@@ -254,8 +357,9 @@ class ServingHandler(BaseHTTPRequestHandler):
         codes; a failure mid-stream terminates with an {"error": ...}
         record instead (the status line is already on the wire)."""
         events = _queue.Queue()
-        fut = gen.submit(prompt,
-                         on_token=lambda t: events.put(("token", t)), **kw)
+        fut = self._submit_retrying(
+            gen, lambda: gen.submit(
+                prompt, on_token=lambda t: events.put(("token", t)), **kw))
         # the callback fires in the engine thread strictly before the
         # future resolves, so the queue orders tokens before done
         fut.add_done_callback(lambda f: events.put(("done", f)))
@@ -328,6 +432,9 @@ def make_server(batcher, host="127.0.0.1", port=0, gen_batcher=None):
     httpd.batcher = batcher
     httpd.gen_batcher = gen_batcher
     httpd.port = httpd.server_address[1]
+    # effective drain deadline (drives the ShutdownError Retry-After);
+    # _serve overwrites it with the CLI's --drain-timeout-s
+    httpd.drain_timeout_s = None
     return httpd
 
 
@@ -371,9 +478,18 @@ def _demo_gen_batcher(args, tiny=False, metrics=None):
     engine = DecodeEngine(params, num_heads=2, num_slots=slots,
                           max_len=max_len, prefill_buckets=buckets,
                           name="demo_lm", metrics=metrics)
+    # supervision on by default for the generation plane: the breaker
+    # and recovery are pure host bookkeeping (zero cost absent failures);
+    # the step watchdog only arms when a deadline is configured
+    sup = Supervisor(
+        step_deadline_s=(args.step_deadline_ms / 1e3
+                         if args.step_deadline_ms else None),
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=args.breaker_cooldown_s)
     return GenerationBatcher(engine, queue_size=args.queue_size,
                              default_deadline_ms=args.deadline_ms,
-                             default_max_tokens=args.gen_max_tokens)
+                             default_max_tokens=args.gen_max_tokens,
+                             supervisor=sup)
 
 
 def _build_engine(args):
@@ -444,6 +560,9 @@ def _smoke(batcher, n_requests=8):
         bad_status = e.code
     with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
         health = json.loads(r.read())
+    # readiness split (§5): a warm, serving, non-draining node is ready
+    with urllib.request.urlopen(f"{base}/readyz", timeout=30) as r:
+        ready = json.loads(r.read())
     with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
         metrics_text = r.read().decode()
 
@@ -461,6 +580,7 @@ def _smoke(batcher, n_requests=8):
         "vs_baseline": None,
         "bad_request_status": bad_status,
         "healthz": health.get("status"),
+        "readyz": ready.get("status"),
         "metrics_sane": bool(metrics_sane),
         "mean_occupancy": snap["mean_occupancy"],
         "p50_ms": snap["latency_ms"]["p50"],
@@ -472,7 +592,8 @@ def _smoke(batcher, n_requests=8):
     batcher.close()
     print(json.dumps(out), flush=True)
     passed = (ok[0] == n_requests and bad_status == 400
-              and health.get("status") == "ok" and metrics_sane)
+              and health.get("status") == "ok"
+              and ready.get("status") == "ready" and metrics_sane)
     return 0 if passed else 2
 
 
@@ -549,6 +670,8 @@ def _smoke_generate(gen, n_requests=6):
     except Exception as e:    # noqa: BLE001
         errs.append(f"probe: {type(e).__name__}: {e}")
 
+    with urllib.request.urlopen(f"{base}/readyz", timeout=30) as r:
+        ready = json.loads(r.read())
     with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
         metrics_text = r.read().decode()
     snap = gen.metrics.snapshot()
@@ -565,6 +688,7 @@ def _smoke_generate(gen, n_requests=6):
         "vs_baseline": None,
         "stream_ok": bool(stream_ok),
         "eos_early_finish": bool(eos_ok),
+        "readyz": ready.get("status"),
         "metrics_sane": bool(metrics_sane),
         "mean_slot_occupancy": snap["mean_slot_occupancy"],
         "gen_tokens_total": snap["gen_tokens_total"],
@@ -577,7 +701,8 @@ def _smoke_generate(gen, n_requests=6):
     httpd.shutdown()
     gen.close()
     print(json.dumps(out), flush=True)
-    passed = (ok == n_requests and stream_ok and eos_ok and metrics_sane)
+    passed = (ok == n_requests and stream_ok and eos_ok and metrics_sane
+              and ready.get("status") == "ready")
     return 0 if passed else 2
 
 
@@ -620,7 +745,25 @@ def main(argv=None):
     ap.add_argument("--smoke-generate", action="store_true",
                     help="generation self-test on an ephemeral port, "
                          "print one JSON line, exit")
+    # ---- resilience (docs/serving.md §5) ----
+    ap.add_argument("--drain-timeout-s", type=float,
+                    default=FLAGS.serving_drain_timeout_s,
+                    help="hard deadline for the SIGTERM graceful drain")
+    ap.add_argument("--step-deadline-ms", type=float,
+                    default=FLAGS.resilience_step_deadline_ms or None,
+                    help="decode-step watchdog deadline (0/unset = off)")
+    ap.add_argument("--breaker-threshold", type=int,
+                    default=FLAGS.resilience_breaker_threshold)
+    ap.add_argument("--breaker-cooldown-s", type=float,
+                    default=FLAGS.resilience_breaker_cooldown_s)
+    ap.add_argument("--fault-spec", default=FLAGS.resilience_fault_spec,
+                    help="deterministic fault-injection spec "
+                         "(resilience/faults.py; chaos testing only)")
     args = ap.parse_args(argv)
+    if args.fault_spec:
+        from paddle_tpu.resilience import faults
+        faults.install_spec(args.fault_spec)
+        logger.warning("fault injection ACTIVE: %s", args.fault_spec)
     if args.smoke and not (args.artifact or args.artifacts):
         args.demo = True
     if args.smoke:
@@ -641,7 +784,8 @@ def main(argv=None):
                     "max_len %d)", gen_batcher.engine.name, args.host,
                     httpd.port, gen_batcher.engine.num_slots,
                     gen_batcher.engine.max_len)
-        return _serve(httpd, None, gen_batcher)
+        return _serve(httpd, None, gen_batcher,
+                      drain_timeout_s=args.drain_timeout_s)
 
     engine = _build_engine(args)
     batcher = Batcher(engine, max_batch_size=args.max_batch_size,
@@ -660,15 +804,50 @@ def main(argv=None):
     logger.info("serving %s on http://%s:%d (buckets %s, max_delay %.1fms, "
                 "queue %d)", engine.name, args.host, httpd.port,
                 list(engine.buckets), args.max_delay_ms, args.queue_size)
-    return _serve(httpd, batcher, gen_batcher)
+    return _serve(httpd, batcher, gen_batcher,
+                  drain_timeout_s=args.drain_timeout_s)
 
 
-def _serve(httpd, batcher, gen_batcher):
+def _make_drain_handler(httpd, state, drain_timeout_s, force_exit):
+    """The SIGTERM/SIGINT handler with a HARD deadline (docs/serving.md
+    §5): the first signal starts a graceful drain AND arms a watchdog —
+    if the drain has not completed within ``drain_timeout_s`` (a wedged
+    in-flight batch, a handler stuck on a dead socket), the process
+    force-exits instead of hanging shutdown forever.  A SECOND signal
+    force-exits immediately.  Factored out (and ``force_exit``
+    injectable) so both paths are unit-testable without killing the
+    test runner."""
 
     def _drain(signum, frame):
+        state["signals"] = state.get("signals", 0) + 1
+        if state["signals"] > 1:
+            logger.warning("second SIGTERM: forcing immediate exit")
+            force_exit(130)
+            return
         logger.info("SIGTERM: draining (no new admissions, finishing "
-                    "queued requests)")
+                    "queued requests; hard deadline %.0fs, second "
+                    "SIGTERM forces exit)", drain_timeout_s or 0.0)
         threading.Thread(target=httpd.shutdown, daemon=True).start()
+        if drain_timeout_s and drain_timeout_s > 0:
+            def watchdog():
+                time.sleep(drain_timeout_s)
+                if not state.get("drained"):
+                    logger.warning("drain did not complete within %.0fs; "
+                                   "forcing exit", drain_timeout_s)
+                    force_exit(3)
+            threading.Thread(target=watchdog, daemon=True,
+                             name="drain-deadline").start()
+    return _drain
+
+
+def _serve(httpd, batcher, gen_batcher, drain_timeout_s=None):
+    import os
+    if drain_timeout_s is None:
+        from paddle_tpu.utils.flags import FLAGS
+        drain_timeout_s = FLAGS.serving_drain_timeout_s
+    httpd.drain_timeout_s = drain_timeout_s
+    state = {}
+    _drain = _make_drain_handler(httpd, state, drain_timeout_s, os._exit)
     try:
         signal.signal(signal.SIGTERM, _drain)
         signal.signal(signal.SIGINT, _drain)
@@ -685,6 +864,7 @@ def _serve(httpd, batcher, gen_batcher):
             batcher.close(drain=True)
         if gen_batcher is not None:
             gen_batcher.close(drain=True)
+        state["drained"] = True     # disarms the drain-deadline watchdog
         httpd.server_close()
         metrics = (batcher or gen_batcher).metrics
         logger.info("serving stopped; %d responses served",

@@ -140,6 +140,14 @@ def save_checkpoint(save_dir, pass_id, params, opt_state=None, model_state=None,
         os.chmod(tmp, os.stat(save_dir).st_mode & 0o777)
         try:
             np.savez(os.path.join(tmp, "params.npz"), **_flatten(host_params))
+            # chaos hook MID-WRITE (resilience/faults.py): arrays are on
+            # disk but the dir is still the hidden .tmp- staging name.  An
+            # injected error unwinds into the rmtree below; an injected
+            # hang holds the window open for a kill -9 — either way the
+            # partial can never be renamed into a pass dir, which is
+            # exactly what the crash-resume tests prove load never picks.
+            from paddle_tpu.resilience import faults as _faults
+            _faults.hit("trainer.checkpoint.write")
             if host_opt is not None:
                 np.savez(os.path.join(tmp, "opt_state.npz"),
                          **_flatten(host_opt))

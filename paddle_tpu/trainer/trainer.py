@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.sequence import (NestedSequenceBatch,
                                       SequenceBatch)
+from paddle_tpu.resilience import faults as _faults
 from paddle_tpu.data.feeder import DataFeeder
 from paddle_tpu.data import reader as reader_mod
 from paddle_tpu.layers.graph import Topology, LayerOutput
@@ -543,9 +544,27 @@ class SGD:
               save_dir=None, saving_period=1, save_only_one=False,
               test_reader=None, test_period=0, log_period=100,
               buffered_batches=4, show_parameter_stats_period=0,
-              save_on_signal=True, prefetch=0, progress_timeout_s=600.0):
+              save_on_signal=True, prefetch=0, progress_timeout_s=600.0,
+              resume=False):
         """reader: callable -> iterator of batches (lists of samples).
         feeding: {data_layer_name: InputType} or a DataFeeder.
+
+        resume: crash-resume (resilience layer).  When True and save_dir
+        holds checkpoints, load the latest COMPLETE pass dir (the atomic
+        writer guarantees a kill -9 mid-save can only ever leave a
+        hidden ``.tmp-`` staging dir, which is never eligible), restore
+        params/opt/model state AND the training rng stream from it, and
+        continue at the following pass — so a killed-and-restarted run's
+        final parameters are bit-identical to an uninterrupted one
+        (tests/test_resilience.py pins it, kill -9 included).  A SIGTERM
+        preemption checkpoint is MID-pass: its meta carries
+        ``batches_done``, and resume re-enters that same pass skipping
+        exactly those batches (no step, no rng split), so preemption
+        resume is bit-identical too — provided the reader replays the
+        same batches per pass (a deterministic reader, the same contract
+        the pass loop already assumes).  With no checkpoint yet,
+        training starts fresh — ``resume=True`` is safe as the default
+        posture of a supervised job.
 
         prefetch: run feeder conversion AND the H2D transfer on a bounded
         background thread, `prefetch` batches ahead of the step
@@ -587,6 +606,40 @@ class SGD:
         event_handler = event_handler or (lambda e: None)
         feeder = feeding if isinstance(feeding, DataFeeder) else (
             DataFeeder(feeding) if feeding else None)
+
+        first_pass = 0
+        resume_skip_batches = 0
+        if resume:
+            if not save_dir:
+                raise ConfigError("train(resume=True) needs save_dir=")
+            try:
+                meta = self.load(save_dir)
+            except FileNotFoundError:
+                meta = None     # nothing saved yet: a fresh run
+            if meta is not None:
+                if meta.get("preempted") and meta.get("batches_done") \
+                        is not None:
+                    # a preemption checkpoint is MID-pass: re-enter that
+                    # pass and skip exactly the batches it already
+                    # trained (no step, no rng split), so the remainder
+                    # replays bit-identically
+                    first_pass = int(meta["pass_id"])
+                    resume_skip_batches = int(meta["batches_done"])
+                else:
+                    first_pass = int(meta["pass_id"]) + 1
+                if meta.get("rng") is not None:
+                    # the per-batch rng stream continues exactly where
+                    # the checkpointed pass left it — resumed training
+                    # is bit-identical to uninterrupted
+                    self.rng = jnp.asarray(np.asarray(meta["rng"],
+                                                      np.uint32))
+                logger.info(
+                    "resume: loaded pass %d from %s%s; continuing at "
+                    "pass %d%s", meta["pass_id"], save_dir,
+                    " (preemption checkpoint)" if meta.get("preempted")
+                    else "", first_pass,
+                    f" batch {resume_skip_batches}"
+                    if resume_skip_batches else "")
 
         self._stop_signal = None
         prev_handler = None
@@ -637,7 +690,7 @@ class SGD:
             return (" Eval: " + " ".join(parts)) if parts else ""
 
         try:
-            for pass_id in range(num_passes):
+            for pass_id in range(first_pass, num_passes):
                 event_handler(events.BeginPass(pass_id))
                 for spec in self.evaluators:
                     spec.reset()
@@ -668,6 +721,12 @@ class SGD:
                     cost_sum = self._globalize(
                         cost_sum, replicated_shardings(cost_sum, self.mesh))
                 n_batches = 0
+                # preemption resume: the first resumed pass consumes-and-
+                # skips the batches the checkpoint already trained
+                skip_left, resume_skip_batches = resume_skip_batches, 0
+                pass_skip = skip_left   # already-trained prefix of this
+                #                         pass (for a re-preemption's
+                #                         batches_done accounting)
                 window = []
                 skew_window = []     # host-side step wall times this pass
                 h2d_window = 0.0     # input wait this log period (seconds)
@@ -686,6 +745,13 @@ class SGD:
                             item = next(feed_iter)
                         except StopIteration:
                             break
+                        if skip_left > 0:
+                            # already trained before the preemption: no
+                            # step, no rng split, no events — the
+                            # checkpointed rng/params sit exactly here
+                            skip_left -= 1
+                            batch_id += 1
+                            continue
                         feed = item if prefetcher is not None else \
                             convert(item)
                         h2d_dt = time.perf_counter() - t_in
@@ -715,6 +781,12 @@ class SGD:
                             h2d_dt += time.perf_counter() - t_g
                         global_stats.get("h2d_wait").add(h2d_dt)
                         h2d_window += h2d_dt
+                        # chaos hook (resilience/faults.py), host-side so
+                        # the compiled step is untouched; an injected
+                        # fault unwinds like any real step crash (the
+                        # finally blocks still close the prefetcher,
+                        # land pending saves, restore the handler)
+                        _faults.hit("trainer.step")
                         step_fn = self._dispatch_step(feed)
                         t_step = time.perf_counter()
                         with timer("train_step"):
@@ -829,12 +901,17 @@ class SGD:
                         # preemption checkpoint: blocking (the process is
                         # about to be reaped — there may be no later sync
                         # point)
+                        # batches_done lets train(resume=True) re-enter
+                        # THIS pass skipping exactly the trained prefix
+                        # (bit-identical preemption resume)
                         path = self.save(save_dir, pass_id,
                                          save_only_one=save_only_one,
                                          block=True,
                                          extra={"preempted": True,
                                                 "signal":
-                                                int(self._stop_signal)})
+                                                int(self._stop_signal),
+                                                "batches_done":
+                                                pass_skip + n_batches})
                         if path:
                             logger.info("preemption checkpoint %s; stopping "
                                         "after pass %d", path, pass_id)
@@ -942,6 +1019,14 @@ class SGD:
                 return None
         extra = dict(extra or {})
         extra.setdefault("grad_accum_steps", self.grad_accum_steps)
+        try:
+            # the rng stream rides in meta so train(resume=True) can
+            # continue it bit-identically (raw uint32 keys; typed-key
+            # arrays would fail the cast and simply skip the field)
+            extra.setdefault("rng", np.asarray(
+                jax.device_get(self.rng), np.uint32).tolist())
+        except (TypeError, ValueError):
+            pass
         path = save_checkpoint(save_dir, pass_id, params,
                                opt_state, self.model_state, extra=extra,
                                save_only_one=save_only_one, block=block)

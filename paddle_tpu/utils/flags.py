@@ -97,6 +97,15 @@ class Flags:
     serving_gen_max_len: int = 256      # KV slab length (prompt + output)
     serving_gen_prefill_buckets: str = "32,64"  # prompt-length ladder
     serving_gen_max_tokens: int = 64    # default per-request emission cap
+    # ---- resilience (resilience/: deterministic fault injection +
+    # supervised recovery; docs/serving.md §5)
+    serving_drain_timeout_s: float = 30.0  # SIGTERM drain hard deadline
+    resilience_fault_spec: str = ""     # chaos-only fault plan, e.g.
+    #                                     "serving.decode_step:at=5"
+    resilience_step_deadline_ms: float = 0.0  # decode watchdog (0 = off)
+    resilience_breaker_threshold: int = 5     # consecutive failures -> open
+    resilience_breaker_cooldown_s: float = 5.0  # open -> half-open probe
+    resilience_retry_budget: int = 3    # transient submit retries
 
     # ---- observability (new floor; reference had host timers only)
     profile_dir: Optional[str] = None   # capture an xprof trace of training
@@ -137,6 +146,9 @@ class Flags:
             jax.config.update("jax_debug_nans", True)
         if self.jax_compilation_cache_dir:
             set_compilation_cache_dir(self.jax_compilation_cache_dir)
+        if self.resilience_fault_spec:
+            from paddle_tpu.resilience import faults
+            faults.install_spec(self.resilience_fault_spec)
 
 
 def set_compilation_cache_dir(path):
@@ -248,6 +260,25 @@ FLAG_DOCS = {
                                     "—"),
     "serving_gen_max_tokens": ("default per-request emission cap for "
                                "/v1/generate", "—"),
+    "serving_drain_timeout_s": ("hard deadline for the SIGTERM graceful "
+                                "drain; a wedged batch can no longer "
+                                "hang shutdown (second SIGTERM forces "
+                                "exit)", "—"),
+    "resilience_fault_spec": ("deterministic fault-injection plan "
+                              "(point:at=N/every=K/p=x,seed=S,"
+                              "action=error/hang) — chaos testing "
+                              "only, strictly no-op when empty", "—"),
+    "resilience_step_deadline_ms": ("decode-step watchdog deadline; a "
+                                    "hung step is abandoned, the slab "
+                                    "rebuilt, slots re-prefilled "
+                                    "(0 = off)", "—"),
+    "resilience_breaker_threshold": ("consecutive step failures that "
+                                     "open the circuit breaker (shed "
+                                     "503 + Retry-After)", "—"),
+    "resilience_breaker_cooldown_s": ("open-breaker cooldown before the "
+                                      "half-open probe", "—"),
+    "resilience_retry_budget": ("bounded retries (exp backoff + jitter) "
+                                "for transient submit failures", "—"),
     "profile_dir": ("capture an xprof/TensorBoard device trace", "—"),
     "debug_nans": ("fail fast on the op producing a NaN",
                    "feenableexcept (TrainerMain.cpp)"),

@@ -359,6 +359,40 @@ def test_drain_finishes_queued_and_inflight(engine):
         engine.step = orig
 
 
+@pytest.mark.parametrize("drain", [True, False])
+def test_close_during_inflight_prefill_resolves_submitter(engine, drain):
+    """The batcher-close-during-in-flight-prefill race: close() while a
+    prefill future is outstanding must RESOLVE the submitter (result on
+    drain=True, ShutdownError on drain=False) — never strand it.  The
+    worker is provably inside the prefill when close() lands."""
+    orig = engine.prefill
+    inside = threading.Event()
+
+    def slow(prompts, lengths):
+        inside.set()
+        time.sleep(0.3)
+        return orig(prompts, lengths)
+    engine.prefill = slow
+    try:
+        bat = GenerationBatcher(engine, default_max_tokens=4)
+        rng = np.random.RandomState(13)
+        fut = bat.submit(rng.randint(1, VOCAB, 4).astype(np.int32))
+        assert inside.wait(10)          # worker is mid-prefill NOW
+        closer = threading.Thread(target=bat.close,
+                                  kwargs={"drain": drain})
+        closer.start()
+        if drain:
+            assert len(fut.result(30)["tokens"]) == 4
+        else:
+            with pytest.raises((ShutdownError, BatchExecutionError)):
+                fut.result(30)          # resolved, not stranded
+        closer.join(30)
+        assert not closer.is_alive(), "close() wedged on the prefill"
+        assert engine.free_slots == SLOTS
+    finally:
+        engine.prefill = orig
+
+
 def test_close_without_drain_fails_inflight_and_queued(engine):
     orig = _stall_engine(engine, 0.1)
     try:

@@ -46,7 +46,8 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "tpu_differential_pytest.log", "nmt_scale.json",
                  "perf_report.md", "analytic.json",
                  "analytic_snapshot.json", "serving_smoke.json",
-                 "serving_gen_smoke.json", "WINDOW_DONE"):
+                 "serving_gen_smoke.json", "chaos_smoke.json",
+                 "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -83,6 +84,16 @@ def test_dryrun_executes_every_phase(tmp_path):
     assert smoke_gen["eos_early_finish"] is True, smoke_gen
     assert smoke_gen["metrics_sane"] is True, smoke_gen
     assert smoke_gen["gen_tokens_total"] > 0, smoke_gen
+    assert smoke_gen["readyz"] == "ready", smoke_gen
+    # the chaos smoke really exercised the resilience layer: the injected
+    # decode-step fault fired, recovered streams stayed bit-identical,
+    # and the kill-9'd trainer resumed to bit-identical params
+    chaos = json.loads((art / "chaos_smoke.json").read_text())
+    assert chaos["value"] == int(chaos["unit"].split("/")[1]), chaos
+    assert chaos["faults_fired"] >= 1, chaos
+    assert chaos["bit_identical"] is True, chaos
+    assert chaos["victim_killed"] is True, chaos
+    assert chaos["resume_bit_identical"] is True, chaos
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows
