@@ -26,7 +26,10 @@ closed-loop load levels and reports batched vs batch-size-1 throughput,
 tail latency, and mean batch occupancy; `python bench.py serving_generate`
 drives the continuous-batching GENERATION engine (serving/decode_engine)
 against the sequential whole-batch policy at 2/8/32 clients and reports
-useful tokens/s, p99 TTFT, and slot occupancy for both.  Other overrides:
+useful tokens/s, p99 TTFT, and slot occupancy for both;
+`python bench.py serving_fleet` drives the REPLICATED tier (fleet
+supervisor + health-checked router over replica subprocesses) at 1 vs 2
+replicas with a kill-9 mid-stream failover latency probe.  Other overrides:
 BENCH_STEPS, BENCH_BATCH, BENCH_INIT_TIMEOUT, BENCH_BUILD_TIMEOUT (eager
 param init; wider default since each distinct shape compiles through the
 tunnel), BENCH_COMPILE_TIMEOUT,
@@ -1101,6 +1104,201 @@ def bench_serving_generate(slots=8, n_requests=64, vocab=256, d_model=128,
         f"max_tokens {gen_short}/{gen_long})"), extras
 
 
+def bench_serving_fleet(replicas=2, n_requests=16, vocab=256, max_len=64,
+                        prefill_buckets=(8, 16), gen_short=8, gen_long=24,
+                        seed=0):
+    """Replicated serving tier (serving/fleet.py + serving/router.py):
+    closed-loop clients drive /v1/generate through the health-checked
+    ROUTER over 1 vs ``replicas`` fleet-supervised demo-LM replica
+    SUBPROCESSES — the cross-process scaling the single-process
+    serving_generate row cannot show.  extras carry the 8/32-client
+    sweep for both fleet sizes (useful tokens/s, p99 TTFT, p99 wall),
+    the 2-vs-1 replica speedup, and the FAILOVER-ADDED LATENCY probe:
+    one streaming request whose replica is kill -9'd mid-stream, timed
+    against the same stream uninterrupted (the router's continuation
+    resubmit keeps it bit-identical; the delta is what the failover
+    costs).
+
+    The router is host-side only — its AOT hook is the SAME slab decode
+    step the replicas run (a local DecodeEngine, never executed here),
+    so the analytic row gates the serving hot path and the fleet adds
+    zero new traces by construction."""
+    import atexit
+    import json as _json
+    import signal as _signal
+    import urllib.request
+    import jax
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving.decode_engine import DecodeEngine
+
+    d_model, heads, dff, layers = 32, 2, 64, 2   # the --demo-generate trunk
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=1, d_model=d_model, num_heads=heads,
+                              dff=dff, enc_layers=layers, dec_layers=0,
+                              max_len=max_len)
+    slots = 8
+    local = DecodeEngine(params, num_heads=heads, num_slots=slots,
+                         max_len=max_len, prefill_buckets=prefill_buckets,
+                         name="bench_fleet", warm=False)
+    extras = {"lower": lambda: local.lower()}
+    rng = np.random.RandomState(seed)
+    reqs = [(rng.randint(1, vocab, rng.randint(3, prefill_buckets[-1] + 1)
+                         ).tolist(),
+             gen_long if i % 4 == 0 else gen_short)
+            for i in range(n_requests)]
+    replica_args = ["--gen-slots", str(slots), "--gen-max-len",
+                    str(max_len), "--gen-prefill-buckets",
+                    ",".join(str(b) for b in prefill_buckets),
+                    "--gen-max-tokens", str(max_len - prefill_buckets[-1])]
+    state = {}
+
+    def _spawn(n_rep):
+        from paddle_tpu.serving.fleet import ReplicaSupervisor
+        from paddle_tpu.serving.router import Router
+        sup = ReplicaSupervisor(n_replicas=n_rep, extra_args=replica_args,
+                                name=f"bench_fleet{n_rep}").start()
+        if not sup.wait_ready(timeout=300):
+            sup.stop()
+            raise RuntimeError(f"{n_rep}-replica fleet never became ready")
+        router = Router(supervisor=sup, poll_interval_s=0.1)
+        httpd = router.start(port=0)
+        t0 = time.perf_counter()
+        while not router.ready():
+            if time.perf_counter() - t0 > 30:
+                raise RuntimeError("router never saw a ready replica")
+            time.sleep(0.05)
+        return sup, router, httpd.port
+
+    def _post(port, body, timeout=300):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate",
+            data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return _json.loads(r.read())
+
+    def drive(port, n_clients, reqs):
+        lats, ttfts, tokens = [], [], [0]
+        lock, nxt = threading.Lock(), [0]
+
+        def client():
+            while True:
+                with lock:
+                    i = nxt[0]
+                    if i >= len(reqs):
+                        return
+                    nxt[0] += 1
+                prompt, mt = reqs[i]
+                t0 = time.perf_counter()
+                out = _post(port, {"prompt": prompt, "max_tokens": mt})
+                with lock:
+                    lats.append(time.perf_counter() - t0)
+                    ttfts.append(out["ttft_ms"])
+                    tokens[0] += len(out["tokens"])
+
+        ts = [threading.Thread(target=client) for _ in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        lats.sort()
+        ttfts.sort()
+        return {"clients": n_clients,
+                "tokens_per_s": round(tokens[0] / dt, 1),
+                "ttft_p99_ms": round(ttfts[min(len(ttfts) - 1,
+                                               int(len(ttfts) * 0.99))], 2),
+                "p99_ms": round(lats[min(len(lats) - 1,
+                                         int(len(lats) * 0.99))] * 1e3, 2)}
+
+    def _stream_ms(port, prompt, mt, kill=None):
+        """Wall time of one streaming request; kill=(sup, router) fires
+        kill -9 at the replica that OWNS the stream (the router's live
+        in-flight gauge names it) after the first token — the failover
+        probe."""
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=300)
+        t0 = time.perf_counter()
+        conn.request("POST", "/v1/generate",
+                     _json.dumps({"prompt": prompt, "max_tokens": mt,
+                                  "stream": True}).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        n = 0
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            rec = _json.loads(line)
+            if "token" in rec:
+                n += 1
+                if n == 1 and kill is not None:
+                    sup_, router_ = kill
+                    owner = [rid for rid, st
+                             in router_.replica_states().items()
+                             if st["inflight"] >= 1]
+                    if owner:
+                        sup_.kill(owner[0], _signal.SIGKILL)
+            if rec.get("done"):
+                break
+        conn.close()
+        return (time.perf_counter() - t0) * 1e3
+
+    if os.environ.get("BENCH_ANALYTIC_BUILD") != "1":
+        sweep = []
+        fleet_sizes = (1,) if int(replicas) == 1 else (1, int(replicas))
+        for n_rep in fleet_sizes:
+            sup, router, port = _spawn(n_rep)
+            try:
+                drive(port, 8, reqs[:8])            # warm the whole path
+                for c in (8, 32):
+                    row = drive(port, c, reqs)
+                    row["replicas"] = n_rep
+                    sweep.append(row)
+            finally:
+                if n_rep != int(replicas):
+                    router.close()
+                    sup.stop()
+        # the N-replica fleet stays up for run() and the failover probe
+        state.update(sup=sup, router=router, port=port)
+        atexit.register(lambda: (router.close(), sup.stop()))
+        probe_prompt, probe_mt = reqs[0][0], max_len - prefill_buckets[-1]
+        clean_ms = _stream_ms(port, probe_prompt, probe_mt)
+        failover_ms = _stream_ms(port, probe_prompt, probe_mt,
+                                 kill=(sup, router))
+        snap = router.metrics.snapshot()
+        at8 = {r["replicas"]: r for r in sweep if r["clients"] == 8}
+        extras.update(
+            load_sweep=sweep,
+            fleet_tokens_per_s=at8[int(replicas)]["tokens_per_s"],
+            fleet_ttft_p99_ms=at8[int(replicas)]["ttft_p99_ms"],
+            single_tokens_per_s=at8[1]["tokens_per_s"],
+            fleet_speedup=round(at8[int(replicas)]["tokens_per_s"]
+                                / at8[1]["tokens_per_s"], 2),
+            clean_stream_ms=round(clean_ms, 1),
+            failover_stream_ms=round(failover_ms, 1),
+            failover_added_ms=round(failover_ms - clean_ms, 1),
+            midstream_failovers=snap["midstream_failovers_total"])
+        # let the killed replica's restart settle before the timed runs
+        sup.wait_ready(timeout=300)
+
+    def run(s):
+        r = drive(state["port"], 8, reqs)
+        return np.float32(r["tokens_per_s"])
+
+    total_tokens = sum(mt for _, mt in reqs)
+    per_tok = layers * (6 * d_model ** 2 + 2 * d_model * dff) \
+        + d_model * vocab
+    attn = layers * 4.0 * d_model * max_len * max_len / 2
+    flops = (2.0 * per_tok + attn / max_len) * slots \
+        * (total_tokens / slots)
+    return run, flops, None, (
+        f"replicated serving ms/burst ({n_requests} reqs, 8 clients, "
+        f"{replicas} replica subprocesses behind the router, "
+        f"max_tokens {gen_short}/{gen_long})"), extras
+
+
 def bench_trainer_prefetch(batch=64, dim=256, hidden=512, n_batches=24,
                            host_ms=4.0):
     """Trainer hot-loop input overlap: steps/s with the input pipeline
@@ -1217,6 +1415,10 @@ _BENCHES = {
     # slot-based KV-slab decode vs sequential whole-batch at 2/8/32
     # clients; b = the slot count
     "serving_generate": (lambda b: bench_serving_generate(slots=b), 8),
+    # replicated serving tier (serving/fleet.py + router.py): router over
+    # 1 vs b fleet-supervised replica subprocesses + the kill-9 failover
+    # latency probe; b = the replica count
+    "serving_fleet": (lambda b: bench_serving_fleet(replicas=b), 2),
     "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),
     # input-pipeline overlap row: steps/s at train(prefetch=0) vs 2 on a
     # synthetic input-bound workload (the ShardedPrefetcher's win)

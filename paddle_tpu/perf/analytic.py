@@ -62,6 +62,10 @@ FAMILIES = [
     # continuous-batching generation (serving/decode_engine.py): the slab
     # decode step via DecodeEngine.lower — the per-token serving hot path
     ("serving_generate", "serving_generate", None),
+    # replicated serving tier (serving/fleet.py + router.py): the router
+    # is host-side only, so its analytic row is the SAME slab decode step
+    # the replicas run — the fleet adds zero new traces by construction
+    ("serving_fleet", "serving_fleet", None),
     ("trainer_prefetch", "trainer_prefetch", None),
 ]
 
@@ -119,7 +123,8 @@ def capture(name, model, batch=None, chips=("v5e", "v5p")):
     # stream/burst — the lowered program there is one batch, so scopes
     # differ and the cross-check is omitted for them.
     bps = extras.get("batches_per_step")
-    if model in ("transformer_serving", "serving", "serving_generate"):
+    if model in ("transformer_serving", "serving", "serving_generate",
+                 "serving_fleet"):
         # the lowered program is one batch/slab step while the bench FLOPs
         # model covers the whole stream/burst — scopes differ, no cross-check
         row["bench_model_flops"] = None

@@ -64,6 +64,8 @@ FAULT_POINTS = (
     "data.prefetch.h2d",           # ShardedPrefetcher producer placement
     "trainer.step",                # SGD.train hot loop, before dispatch
     "trainer.checkpoint.write",    # checkpoint.save_checkpoint mid-write
+    "router.dispatch",             # Router._dispatch, the router->replica
+    #                                network boundary (serving/router.py)
 )
 
 

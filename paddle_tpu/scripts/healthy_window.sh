@@ -32,6 +32,11 @@
 #                                        injected decode-step fault, slot
 #                                        re-prefill recovery bit-identical;
 #                                        kill-9 trainer + resume)
+# 10. fleet smoke                       (replicated serving tier: 2 replica
+#                                        subprocesses behind the router,
+#                                        kill-9 one mid-stream, streams
+#                                        bit-identical via cross-replica
+#                                        failover; supervisor restarts it)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -201,6 +206,17 @@ log "phase 9: chaos smoke (fault injection + supervised recovery)"
 timeout "$T_SERVE" python -m paddle_tpu.resilience --smoke \
     > "$ART/chaos_smoke.json" 2> "$ART/chaos_smoke.log"
 log "chaos smoke rc=$? -> $ART/chaos_smoke.json"
+
+log "phase 10: fleet smoke (replica supervisor + health-checked router)"
+# 2 tiny replica subprocesses on ephemeral ports behind the router;
+# concurrent streaming /v1/generate clients; kill -9 one replica
+# MID-STREAM — every stream must finish bit-identical to lm_generate via
+# the router's cross-replica continuation failover, /metrics must show
+# it, and the supervisor must restart the victim to readiness — one JSON
+# line (python -m paddle_tpu.serving.router --smoke; docs/serving.md §6)
+timeout "$T_SERVE" python -m paddle_tpu.serving.router --smoke \
+    > "$ART/fleet_smoke.json" 2> "$ART/fleet_smoke.log"
+log "fleet smoke rc=$? -> $ART/fleet_smoke.json"
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

@@ -19,9 +19,17 @@ executables (docs/serving.md §3) and continuous-batching generation
     metrics.py       ServingMetrics — latency/TTFT/TPOT percentiles,
                      occupancy, padding waste, slot evictions, queue
                      depth; Prometheus text at /metrics
+    fleet.py         ReplicaSupervisor — spawn/health/restart N replica
+                     subprocesses (exp backoff + seeded jitter, restart-
+                     storm breaker, rolling drain; docs/serving.md §6)
+    router.py        Router — readiness-gated least-loaded dispatch,
+                     outlier ejection, bounded retry, hedging, and
+                     cross-replica MID-STREAM failover (bit-identical
+                     greedy streams; docs/serving.md §6)
 
     python -m paddle_tpu.serving --artifacts 'model.b*.shlo' --port 8080
     python -m paddle_tpu.serving --demo-generate --port 8080
+    python -m paddle_tpu.serving.router --replicas 2 --port 8000
 """
 
 from paddle_tpu.resilience.supervisor import BreakerOpenError, Supervisor
@@ -31,13 +39,15 @@ from paddle_tpu.serving.batcher import (BatchExecutionError, Batcher,
 from paddle_tpu.serving.decode_engine import DecodeEngine, GenerationBatcher
 from paddle_tpu.serving.engine import (DEFAULT_BUCKETS, InferenceEngine,
                                        InvalidRequestError)
+from paddle_tpu.serving.fleet import ReplicaSupervisor
 from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.router import Router, RouterMetrics
 from paddle_tpu.serving.server import make_server
 
 __all__ = [
     "Batcher", "BatchExecutionError", "BreakerOpenError",
     "DeadlineExceededError", "DecodeEngine", "DEFAULT_BUCKETS",
     "GenerationBatcher", "InferenceEngine", "InvalidRequestError",
-    "OverloadedError", "ServingMetrics", "ShutdownError", "Supervisor",
-    "make_server",
+    "OverloadedError", "ReplicaSupervisor", "Router", "RouterMetrics",
+    "ServingMetrics", "ShutdownError", "Supervisor", "make_server",
 ]

@@ -390,20 +390,26 @@ class DecodeEngine:
 
     # ------------------------------------------------------------ validate
 
-    def validate_request(self, prompt, max_tokens):
-        """Admission-control checks, raised BEFORE the queue."""
-        prompt = np.asarray(prompt)
-        if prompt.ndim != 1 or prompt.size < 1:
+    def _validate_ids(self, name, ids):
+        """Shared admission check: a non-empty 1-D integer id sequence
+        within the vocab.  Returns the array."""
+        ids = np.asarray(ids)
+        if ids.ndim != 1 or ids.size < 1:
             raise InvalidRequestError(
-                f"prompt must be a non-empty 1-D id sequence, got shape "
-                f"{prompt.shape}")
-        if not np.issubdtype(prompt.dtype, np.integer):
+                f"{name} must be a non-empty 1-D id sequence, got shape "
+                f"{ids.shape}")
+        if not np.issubdtype(ids.dtype, np.integer):
             raise InvalidRequestError(
-                f"prompt must be integer token ids, got {prompt.dtype}")
-        if prompt.size > self.prefill_buckets[-1]:
+                f"{name} must be integer token ids, got {ids.dtype}")
+        vocab = self.params["src_emb"].shape[0]
+        if int(ids.min()) < 0 or int(ids.max()) >= vocab:
             raise InvalidRequestError(
-                f"prompt length {prompt.size} exceeds the prefill ladder "
-                f"top {self.prefill_buckets[-1]}")
+                f"{name} ids must be in [0, {vocab}); got "
+                f"[{int(ids.min())}, {int(ids.max())}]")
+        return ids
+
+    @staticmethod
+    def _parse_max_tokens(max_tokens):
         try:
             max_tokens = int(max_tokens)
         except (TypeError, ValueError):
@@ -412,29 +418,58 @@ class DecodeEngine:
         if max_tokens < 1:
             raise InvalidRequestError(f"max_tokens={max_tokens} must be "
                                       ">= 1")
+        return max_tokens
+
+    def validate_request(self, prompt, max_tokens):
+        """Admission-control checks, raised BEFORE the queue."""
+        prompt = self._validate_ids("prompt", prompt)
+        if prompt.size > self.prefill_buckets[-1]:
+            raise InvalidRequestError(
+                f"prompt length {prompt.size} exceeds the prefill ladder "
+                f"top {self.prefill_buckets[-1]}")
+        max_tokens = self._parse_max_tokens(max_tokens)
         if prompt.size + max_tokens > self.max_len:
             raise InvalidRequestError(
                 f"prompt ({prompt.size}) + max_tokens ({max_tokens}) "
                 f"exceeds the engine max_len ({self.max_len})")
-        vocab = self.params["src_emb"].shape[0]
-        if prompt.size and (int(prompt.min()) < 0
-                            or int(prompt.max()) >= vocab):
-            raise InvalidRequestError(
-                f"prompt ids must be in [0, {vocab}); got "
-                f"[{int(prompt.min())}, {int(prompt.max())}]")
         return prompt.astype(np.int32), max_tokens
+
+    def validate_continuation(self, prompt, replay, max_tokens):
+        """Admission checks for a mid-stream CONTINUATION: ``replay``
+        tokens were already delivered to the caller by a previous serving
+        of this stream (a router failing over off a dead replica —
+        docs/serving.md §6) and must be teacher-forced, never re-emitted.
+        Unlike a fresh prompt, the combined context may exceed the
+        prefill ladder top — seating re-prefills the longest
+        ladder-covered prefix and replays the remainder through the slab
+        step (the exact ``Supervisor.reprefill`` contract), so only the
+        slab length bounds it: ``len(prompt) + len(replay) + max_tokens
+        <= max_len``."""
+        prompt = self._validate_ids("prompt", prompt)
+        replay = self._validate_ids("replay", replay)
+        max_tokens = self._parse_max_tokens(max_tokens)
+        if prompt.size + replay.size + max_tokens > self.max_len:
+            raise InvalidRequestError(
+                f"prompt ({prompt.size}) + replay ({replay.size}) + "
+                f"max_tokens ({max_tokens}) exceeds the engine max_len "
+                f"({self.max_len})")
+        return prompt.astype(np.int32), replay.astype(np.int32), max_tokens
 
 
 class _GenRequest:
     __slots__ = ("prompt", "max_tokens", "eos_id", "future", "deadline",
                  "t_submit", "t_first", "on_token", "tokens", "slot",
-                 "abandoned", "recoveries", "replay_feed")
+                 "abandoned", "recoveries", "replay_feed", "replay_ctx")
 
-    def __init__(self, prompt, max_tokens, eos_id, deadline, on_token):
+    def __init__(self, prompt, max_tokens, eos_id, deadline, on_token,
+                 replay_ctx=None):
         self.abandoned = False
         self.recoveries = 0
         self.replay_feed = []     # recovery replay: recorded tokens still
         #                           to teacher-force through the slab step
+        self.replay_ctx = replay_ctx   # continuation context: tokens a
+        #                                previous serving of this stream
+        #                                already delivered (never re-emitted)
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.eos_id = eos_id
@@ -445,6 +480,15 @@ class _GenRequest:
         self.on_token = on_token
         self.tokens = []
         self.slot = None
+
+    @property
+    def context(self):
+        """Every token the stream holds BEFORE its first new emission:
+        the prompt plus (for a continuation) the already-delivered replay
+        tokens — what slot recovery must reconstruct."""
+        if self.replay_ctx is None:
+            return self.prompt
+        return np.concatenate([self.prompt, self.replay_ctx])
 
     def fail(self, exc):
         try:
@@ -524,7 +568,7 @@ class GenerationBatcher:
     # ------------------------------------------------------------ submit
 
     def submit(self, prompt, max_tokens=None, eos_id=None, deadline_ms=None,
-               on_token=None):
+               on_token=None, replay=None):
         """Admit one generation request; returns a Future resolving to
         ``{"tokens": [ids...], "finish_reason": "eos"|"length",
         "ttft_ms": float}``.
@@ -535,6 +579,18 @@ class GenerationBatcher:
         token override (None = the engine default); on_token: optional
         callable invoked per emitted token from the engine thread (the
         streaming hook — exceptions are logged, never fatal).
+
+        replay: mid-stream CONTINUATION — tokens a previous serving of
+        this stream already delivered (a router failing over off a dead
+        replica, docs/serving.md §6).  Seating re-prefills the longest
+        ladder-covered prefix of ``prompt + replay`` and teacher-forces
+        the remainder through the slab step with re-derived emissions
+        swallowed (``Supervisor.reprefill`` semantics), so the result's
+        ``tokens`` are ONLY the new emissions and — greedy decode being
+        deterministic — the concatenated stream is bit-identical to the
+        uninterrupted one.  ``max_tokens`` counts new emissions;
+        ``len(prompt) + len(replay) + max_tokens <= engine.max_len``
+        (the ladder top does NOT cap the combined context).
 
         Raises synchronously: ``InvalidRequestError``,
         ``OverloadedError`` (queue full), ``ShutdownError`` (draining),
@@ -548,9 +604,16 @@ class GenerationBatcher:
             self.metrics.reject("shutdown")
             raise ShutdownError(f"{self.name} is draining; submit rejected")
         try:
-            prompt, max_tokens = self.engine.validate_request(
-                prompt, max_tokens if max_tokens is not None
-                else self.default_max_tokens)
+            if replay is None:
+                prompt, max_tokens = self.engine.validate_request(
+                    prompt, max_tokens if max_tokens is not None
+                    else self.default_max_tokens)
+            else:
+                prompt, replay, max_tokens = \
+                    self.engine.validate_continuation(
+                        prompt, replay,
+                        max_tokens if max_tokens is not None
+                        else self.default_max_tokens)
         except InvalidRequestError:
             self.metrics.reject("invalid")
             raise
@@ -570,7 +633,7 @@ class GenerationBatcher:
         req = _GenRequest(prompt, max_tokens,
                           self.engine.eos_id if eos_id is None else eos_id,
                           time.perf_counter() + dl_s if dl_s else None,
-                          on_token)
+                          on_token, replay_ctx=replay)
         with self._admit_lock:
             if self._closed.is_set():   # close() raced the check above
                 self.metrics.reject("shutdown")
@@ -669,16 +732,30 @@ class GenerationBatcher:
             picked.append(req)
         if not picked:
             return
+        # seat prefix per request: a fresh prompt prefills WHOLE and its
+        # first emission is delivered; a continuation (replay_ctx set)
+        # prefills the longest ladder-covered prefix of prompt + replay
+        # and teacher-forces the rest — its prefill emission re-derives
+        # an already-delivered token, so it is swallowed, never emitted
+        top = self.engine.prefill_buckets[-1]
+        prefixes = {}
+        for req in picked:
+            if req.replay_ctx is None:
+                prefixes[id(req)] = req.prompt
+            else:
+                full = req.context
+                prefixes[id(req)] = full[:min(full.size - 1, top)]
         groups = {}
         for req in picked:
-            b = self.engine.prefill_bucket_for(req.prompt.size)
+            b = self.engine.prefill_bucket_for(prefixes[id(req)].size)
             groups.setdefault(b, []).append(req)
         for bucket, reqs in sorted(groups.items()):
             prompts = np.zeros((len(reqs), bucket), np.int32)
             lengths = np.zeros((len(reqs),), np.int32)
             for i, req in enumerate(reqs):
-                prompts[i, :req.prompt.size] = req.prompt
-                lengths[i] = req.prompt.size
+                pre = prefixes[id(req)]
+                prompts[i, :pre.size] = pre
+                lengths[i] = pre.size
             try:
                 first, rows = self.engine.prefill(prompts, lengths)
             except Exception as e:    # noqa: BLE001 — isolate to THIS group
@@ -693,6 +770,26 @@ class GenerationBatcher:
                 if req.future in self._abandoned:
                     self._abandoned.discard(req.future)
                     req.abandoned = True
+                if req.replay_ctx is not None:
+                    if req.abandoned:
+                        self._resolve(req, "abandoned")
+                        continue
+                    # continuation: arm with the recorded stream's next
+                    # token (the prefill emission is discarded — inside
+                    # the recorded stream the model's re-derivation is
+                    # identical anyway) and queue the remainder for the
+                    # teacher-forced replay leg in _loop
+                    full, pre = req.context, int(lengths[i])
+                    try:
+                        req.slot = self.engine.admit(
+                            np.int32(full[pre]), rows[i], np.int32(pre))
+                    except Exception as e:    # noqa: BLE001 — see below
+                        self._fail_all_inflight(
+                            e, extra=[req] + reqs[i + 1:])
+                        break
+                    req.replay_feed = [int(t) for t in full[pre + 1:]]
+                    self._by_slot[req.slot] = req
+                    continue
                 req.emit(first[i], self.name)
                 self.metrics.observe_ttft(req.t_first - req.t_submit)
                 self.metrics.observe_gen_tokens(1)
@@ -767,7 +864,7 @@ class GenerationBatcher:
         # result is (slot, replay_feed) or the exception for that victim
         try:
             outcomes = sup.reprefill(self.engine,
-                                     [(req.prompt, req.tokens)
+                                     [(req.context, req.tokens)
                                       for req in recoverable])
         except Exception as re:    # noqa: BLE001 — an unexpected recovery
             # crash must fail the victims, never the worker thread
@@ -862,7 +959,12 @@ class GenerationBatcher:
                     self.engine.advance(slot, req.replay_feed.pop(0))
                     continue
                 tok = int(nxt[slot])
+                first_emit = req.t_first is None
                 req.emit(tok, self.name)
+                if first_emit:
+                    # a continuation's first NEW token is its TTFT (the
+                    # fresh-prompt path records it at prefill instead)
+                    self.metrics.observe_ttft(req.t_first - req.t_submit)
                 self.metrics.observe_gen_tokens(1)
                 if req.eos_id is not None and tok == req.eos_id:
                     self._finish(req, "eos")

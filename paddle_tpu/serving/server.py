@@ -336,8 +336,24 @@ class ServingHandler(BaseHTTPRequestHandler):
                 raise InvalidRequestError(
                     f"prompt ids out of range: {e}") from e
             deadline_ms = self._deadline_ms(req)
+            replay = req.get("replay")
+            if replay is not None:
+                # mid-stream continuation (a router failing over off a
+                # dead replica, docs/serving.md §6): these tokens were
+                # already delivered — teacher-forced, never re-emitted
+                if not isinstance(replay, list) or not replay \
+                        or not all(isinstance(t, int) for t in replay):
+                    raise InvalidRequestError(
+                        "'replay' must be a non-empty list of int token "
+                        "ids")
+                try:
+                    replay = np.asarray(replay, np.int64)
+                except (OverflowError, ValueError) as e:
+                    raise InvalidRequestError(
+                        f"replay ids out of range: {e}") from e
             kw = dict(max_tokens=req.get("max_tokens"),
-                      eos_id=req.get("eos_id"), deadline_ms=deadline_ms)
+                      eos_id=req.get("eos_id"), deadline_ms=deadline_ms,
+                      replay=replay)
             if req.get("stream"):
                 self._generate_stream(gen, prompt, kw, t0)
                 return
@@ -706,6 +722,17 @@ def _smoke_generate(gen, n_requests=6):
     return 0 if passed else 2
 
 
+def _write_port_file(path, port):
+    """Publish the BOUND port (meaningful with --port 0) atomically —
+    the fleet supervisor (serving/fleet.py) spawns replicas on ephemeral
+    ports and discovers them here; a partial read must be impossible."""
+    import os
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"{port}\n")
+    os.replace(tmp, path)
+
+
 def main(argv=None):
     from paddle_tpu.utils.flags import FLAGS
     ap = argparse.ArgumentParser(
@@ -731,6 +758,10 @@ def main(argv=None):
                     default=FLAGS.serving_gen_max_tokens)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=FLAGS.serving_port)
+    ap.add_argument("--port-file",
+                    help="write the BOUND port here once listening "
+                         "(atomic; pairs with --port 0 for fleet-managed "
+                         "replicas, serving/fleet.py)")
     ap.add_argument("--max-batch-size", type=int,
                     default=FLAGS.serving_max_batch_size or None)
     ap.add_argument("--max-delay-ms", type=float,
@@ -780,6 +811,8 @@ def main(argv=None):
         gen_batcher = _demo_gen_batcher(args)
         httpd = make_server(None, args.host, args.port,
                             gen_batcher=gen_batcher)
+        if args.port_file:
+            _write_port_file(args.port_file, httpd.port)
         logger.info("serving %s on http://%s:%d (/v1/generate: %d slots, "
                     "max_len %d)", gen_batcher.engine.name, args.host,
                     httpd.port, gen_batcher.engine.num_slots,
@@ -801,6 +834,8 @@ def main(argv=None):
                    if args.demo_generate else None)
     httpd = make_server(batcher, args.host, args.port,
                         gen_batcher=gen_batcher)
+    if args.port_file:
+        _write_port_file(args.port_file, httpd.port)
     logger.info("serving %s on http://%s:%d (buckets %s, max_delay %.1fms, "
                 "queue %d)", engine.name, args.host, httpd.port,
                 list(engine.buckets), args.max_delay_ms, args.queue_size)

@@ -97,6 +97,28 @@ class Flags:
     serving_gen_max_len: int = 256      # KV slab length (prompt + output)
     serving_gen_prefill_buckets: str = "32,64"  # prompt-length ladder
     serving_gen_max_tokens: int = 64    # default per-request emission cap
+    # ---- replicated serving tier (serving/fleet.py supervisor +
+    # serving/router.py health-checked router; docs/serving.md §6)
+    router_port: int = 8000             # HTTP port for the router CLI
+    router_poll_interval_s: float = 0.25  # /readyz + /metrics poll cadence
+    router_unready_grace_s: float = 2.0  # on an all-unready pick miss,
+    #                                     probe + wait this long before
+    #                                     failing the request (covers the
+    #                                     poller's view lag of a freshly
+    #                                     restarted replica)
+    router_eject_threshold: int = 3     # consecutive dispatch failures
+    #                                     that eject a replica (outlier
+    #                                     ejection, breaker-style)
+    router_eject_cooldown_s: float = 2.0  # ejected -> half-open probe
+    router_retry_budget: int = 2        # cross-replica retries/failovers
+    router_hedge_ms: float = 0.0        # hedged /v1/infer: 0 off, >0 a
+    #                                     fixed delay, <0 p99-derived
+    fleet_replicas: int = 2             # replicas the supervisor spawns
+    fleet_backoff_base_s: float = 0.5   # crash-restart backoff base
+    fleet_backoff_max_s: float = 10.0   # crash-restart backoff cap
+    fleet_storm_threshold: int = 5      # crashes within the window that
+    #                                     trip the restart-storm breaker
+    fleet_storm_window_s: float = 30.0  # the restart-storm window
     # ---- resilience (resilience/: deterministic fault injection +
     # supervised recovery; docs/serving.md §5)
     serving_drain_timeout_s: float = 30.0  # SIGTERM drain hard deadline
@@ -260,6 +282,37 @@ FLAG_DOCS = {
                                     "—"),
     "serving_gen_max_tokens": ("default per-request emission cap for "
                                "/v1/generate", "—"),
+    "router_port": ("HTTP port for python -m paddle_tpu.serving.router",
+                    "—"),
+    "router_poll_interval_s": ("how often the router polls each "
+                               "replica's /readyz + /metrics (readiness "
+                               "gating, least-loaded dispatch)", "—"),
+    "router_unready_grace_s": ("when no replica looks eligible, the "
+                               "router probes /readyz itself and waits "
+                               "up to this long before failing the "
+                               "request — the health poller's view of "
+                               "a freshly restarted replica lags by up "
+                               "to a poll interval", "—"),
+    "router_eject_threshold": ("consecutive dispatch failures that "
+                               "eject a replica from rotation "
+                               "(half-open probe readmits)", "—"),
+    "router_eject_cooldown_s": ("ejected-replica cooldown before the "
+                                "half-open readmission probe", "—"),
+    "router_retry_budget": ("bounded cross-replica retries (idempotent "
+                            "infer) / mid-stream failovers (generate)",
+                            "—"),
+    "router_hedge_ms": ("hedged /v1/infer requests: 0 = off, >0 = fire "
+                        "the hedge after that fixed delay, <0 = "
+                        "p99-derived from recent router latency", "—"),
+    "fleet_replicas": ("serving replica subprocesses the fleet "
+                       "supervisor spawns", "—"),
+    "fleet_backoff_base_s": ("crash-restart exponential-backoff base "
+                             "(seeded jitter on top)", "—"),
+    "fleet_backoff_max_s": ("crash-restart backoff cap", "—"),
+    "fleet_storm_threshold": ("replica crashes within the storm window "
+                              "that stop further restarts (restart-"
+                              "storm breaker)", "—"),
+    "fleet_storm_window_s": ("the restart-storm counting window", "—"),
     "serving_drain_timeout_s": ("hard deadline for the SIGTERM graceful "
                                 "drain; a wedged batch can no longer "
                                 "hang shutdown (second SIGTERM forces "

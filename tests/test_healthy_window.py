@@ -47,7 +47,7 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "perf_report.md", "analytic.json",
                  "analytic_snapshot.json", "serving_smoke.json",
                  "serving_gen_smoke.json", "chaos_smoke.json",
-                 "WINDOW_DONE"):
+                 "fleet_smoke.json", "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -94,6 +94,17 @@ def test_dryrun_executes_every_phase(tmp_path):
     assert chaos["bit_identical"] is True, chaos
     assert chaos["victim_killed"] is True, chaos
     assert chaos["resume_bit_identical"] is True, chaos
+    # the fleet smoke really failed over: 2 replica subprocesses behind
+    # the router, one kill -9'd mid-stream, every stream bit-identical
+    # via the cross-replica continuation, and the supervisor restarted
+    # the victim to readiness
+    fleet = json.loads((art / "fleet_smoke.json").read_text())
+    assert fleet["value"] == int(fleet["unit"].split("/")[1]), fleet
+    assert fleet["bit_identical"] is True, fleet
+    assert fleet["victim_killed"] is True, fleet
+    assert fleet["midstream_failovers"] >= 1, fleet
+    assert fleet["restarted_ready"] is True, fleet
+    assert fleet["victim_restarts"] >= 1, fleet
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows
