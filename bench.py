@@ -29,7 +29,12 @@ against the sequential whole-batch policy at 2/8/32 clients and reports
 useful tokens/s, p99 TTFT, and slot occupancy for both;
 `python bench.py serving_fleet` drives the REPLICATED tier (fleet
 supervisor + health-checked router over replica subprocesses) at 1 vs 2
-replicas with a kill-9 mid-stream failover latency probe.  Other overrides:
+replicas with a kill-9 mid-stream failover latency probe;
+`python bench.py serving_paged` drives the PAGED KV-cache layout
+(serving/kv_pool.py block pool + prefix sharing) against the slab at a
+fixed KV-byte budget on mixed-length and shared-prefix workloads and
+reports useful tokens/s, p99 TTFT, effective concurrent streams, and the
+prefill-compute elimination rate.  Other overrides:
 BENCH_STEPS, BENCH_BATCH, BENCH_INIT_TIMEOUT, BENCH_BUILD_TIMEOUT (eager
 param init; wider default since each distinct shape compiles through the
 tunnel), BENCH_COMPILE_TIMEOUT,
@@ -1104,6 +1109,178 @@ def bench_serving_generate(slots=8, n_requests=64, vocab=256, d_model=128,
         f"max_tokens {gen_short}/{gen_long})"), extras
 
 
+def bench_serving_paged(slots=8, n_requests=160, vocab=256, d_model=128,
+                        dff=256, layers=3, heads=2, block_size=8, seed=0):
+    """Paged KV-cache serving (serving/kv_pool.py + DecodeEngine
+    kv_layout="paged") vs the PR-5 slab, at a FIXED KV-BYTE BUDGET:
+    both layouts get exactly ``slots * max_len`` KV positions of memory;
+    the slab spends them as ``slots`` fixed reservations while the paged
+    pool commits blocks as streams actually grow (plus prefix sharing).
+    Two workloads:
+
+    * MIXED LENGTH (the reservation-waste case): mostly-short
+      completions with a head of long ones (issued first, so their
+      gen_long-step decode floor — neither layout can finish a stream
+      in fewer steps than its token count — overlaps the short traffic
+      instead of riding out alone), driven closed-loop at 48 clients.
+      The paged engine opens 4x the slot count over the same bytes and
+      packs by ACTUAL length — headline ``useful tokens/s`` plus
+      ``effective_streams`` (mean active slots per decode step) for
+      both layouts; the acceptance bar is paged >= 2x slab effective
+      streams.
+    * SHARED PREFIX (the duplicate-prefill case): every request is one
+      long system prompt + a short divergent question.  The first
+      request registers the prefix chains; the rest admit by reference,
+      so ``prefill_elimination`` (1 - prefilled positions / total
+      prompt positions) must clear 90%.
+
+    Same compiled trunk for all engines; greedy streams are verified
+    IDENTICAL between layouts inside the drive (any divergence fails
+    the bench).  extras["lower"] is the paged slab step's Lowered — the
+    analytic row gating the gather/scatter step structure."""
+    import jax
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving import GenerationBatcher, ServingMetrics
+    from paddle_tpu.serving.decode_engine import DecodeEngine
+
+    prefill_buckets = (8, 16)
+    gen_short, gen_long = 6, 48
+    max_len = prefill_buckets[-1] + gen_long
+    budget_positions = slots * max_len          # the fixed KV budget
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=1, d_model=d_model, dff=dff,
+                              enc_layers=layers, dec_layers=0,
+                              max_len=max_len, num_heads=heads)
+    warm = os.environ.get("BENCH_ANALYTIC_BUILD") != "1"
+
+    def make_engine(layout, n_slots, name):
+        return DecodeEngine(
+            params, num_heads=heads, num_slots=n_slots, max_len=max_len,
+            prefill_buckets=prefill_buckets,
+            prefill_batch_buckets=(1, 8), name=name, warm=warm,
+            kv_layout=layout, kv_block_size=block_size,
+            kv_num_blocks=(budget_positions // block_size + 1
+                           if layout == "paged" else 0))
+
+    # slab: budget / max_len slots.  paged: SAME bytes, 4x the slots —
+    # concurrency is bounded by blocks actually used, not reservations
+    slab = make_engine("slab", slots, "bench_paged_slab")
+    paged = make_engine("paged", 4 * slots, "bench_paged_pool")
+    rng = np.random.RandomState(seed)
+    # the long completions go FIRST: closed-loop clients pull in order,
+    # so the longs' decode floor (gen_long steps — neither layout can
+    # finish sooner) overlaps the short traffic instead of riding out
+    # alone at the tail of the drive
+    mixed = [(rng.randint(1, vocab, rng.randint(3, 9)).astype(np.int32),
+              gen_long if i < slots // 2 else gen_short)
+             for i in range(n_requests)]
+    # system prompt: one full block + a partial tail, question keeps the
+    # total at the ladder top (the LEADER's whole-prompt prefill must fit
+    # the ladder; followers seat by reference and never prefill)
+    sys_prompt = rng.randint(1, vocab, block_size + block_size // 2) \
+        .astype(np.int32)
+    shared = [(np.concatenate([sys_prompt,
+                               rng.randint(1, vocab, 4).astype(np.int32)]),
+               gen_short) for _ in range(n_requests // 2)]
+
+    def drive(engine, n_clients, reqs):
+        engine.metrics = ServingMetrics()
+        bat = GenerationBatcher(engine, queue_size=4096)
+        lock, nxt, tokens, ttfts = threading.Lock(), [0], [0], []
+        outs = [None] * len(reqs)
+
+        def client():
+            while True:
+                with lock:
+                    i = nxt[0]
+                    if i >= len(reqs):
+                        return
+                    nxt[0] += 1
+                prompt, mt = reqs[i]
+                out = bat.submit(prompt, max_tokens=mt).result(300)
+                outs[i] = out["tokens"]
+                with lock:
+                    ttfts.append(out["ttft_ms"])
+                    tokens[0] += len(out["tokens"])
+
+        ts = [threading.Thread(target=client) for _ in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        bat.close()
+        if not ttfts:
+            raise RuntimeError(f"{engine.name}: no request completed")
+        ttfts.sort()
+        snap = engine.metrics.snapshot()
+        return {"tokens_per_s": round(tokens[0] / dt, 1),
+                "ttft_p99_ms": round(ttfts[min(len(ttfts) - 1,
+                                               int(len(ttfts) * 0.99))], 2),
+                "effective_streams": snap["mean_slot_occupancy"],
+                "pool_exhausted": snap["evictions"]["pool_exhausted"],
+                "outs": outs}
+
+    extras = {"lower": lambda: paged.lower()}
+    if warm:
+        drive(paged, 8, mixed[:8])              # warm the whole path
+        drive(slab, 8, mixed[:8])
+
+        def best_of(engine, n_clients, reqs, n=2):
+            runs = [drive(engine, n_clients, reqs) for _ in range(n)]
+            return max(runs, key=lambda r: r["tokens_per_s"])
+
+        pg = best_of(paged, 48, mixed)
+        sl = best_of(slab, 48, mixed)
+        if pg.pop("outs") != sl.pop("outs"):
+            raise AssertionError("paged and slab greedy streams diverged")
+        # shared-prefix leg: prefill-compute elimination via the
+        # engine's prefilled-positions ledger (delta over the drive).
+        # The leader request seats (and registers the prefix chains)
+        # BEFORE the concurrent followers race the index.
+        pre0 = paged.prefill_positions_total
+        drive(paged, 1, shared[:1])
+        ps = drive(paged, 8, shared[1:])
+        ps.pop("outs")
+        prefilled = paged.prefill_positions_total - pre0
+        total_prompt = sum(p.size for p, _ in shared)
+        hits = paged.metrics.snapshot()["prefix_cache_hits_total"]
+        extras.update(
+            paged_tokens_per_s=pg["tokens_per_s"],
+            slab_tokens_per_s=sl["tokens_per_s"],
+            paged_ttft_p99_ms=pg["ttft_p99_ms"],
+            slab_ttft_p99_ms=sl["ttft_p99_ms"],
+            paged_effective_streams=pg["effective_streams"],
+            slab_effective_streams=sl["effective_streams"],
+            effective_stream_gain=round(pg["effective_streams"]
+                                        / sl["effective_streams"], 2),
+            pool_exhausted_evictions=pg["pool_exhausted"],
+            kv_budget_positions=budget_positions,
+            shared_prefix_tokens_per_s=ps["tokens_per_s"],
+            shared_prefix_hits=hits,
+            prefill_positions=prefilled,
+            prompt_positions=total_prompt,
+            prefill_elimination=round(1.0 - prefilled / total_prompt, 4))
+
+    def run(s):
+        r = drive(paged, 48, mixed)
+        return np.float32(r["tokens_per_s"])
+
+    # decode compute of one mixed burst at ideal paged occupancy: every
+    # step runs the whole [4*slots]-row gather step
+    total_tokens = sum(mt for _, mt in mixed)
+    per_tok = layers * (6 * d_model ** 2 + 2 * d_model * dff) \
+        + d_model * vocab
+    attn = layers * 4.0 * d_model * max_len * max_len / 2
+    flops = (2.0 * per_tok + attn / max_len) * 4 * slots \
+        * (total_tokens / (4 * slots))
+    return run, flops, None, (
+        f"paged KV serving ms/burst ({n_requests} reqs, 48 clients, "
+        f"{4 * slots} paged slots vs {slots} slab slots at "
+        f"{budget_positions} KV positions, block {block_size})"), extras
+
+
 def bench_serving_fleet(replicas=2, n_requests=16, vocab=256, max_len=64,
                         prefill_buckets=(8, 16), gen_short=8, gen_long=24,
                         seed=0):
@@ -1419,6 +1596,11 @@ _BENCHES = {
     # 1 vs b fleet-supervised replica subprocesses + the kill-9 failover
     # latency probe; b = the replica count
     "serving_fleet": (lambda b: bench_serving_fleet(replicas=b), 2),
+    # paged KV-cache serving (serving/kv_pool.py): block-pool layout vs
+    # the PR-5 slab at a fixed KV-byte budget — mixed-length packing +
+    # shared-prefix prefill elimination; b = the slab slot count (the
+    # paged engine gets 4*b slots over the same bytes)
+    "serving_paged": (lambda b: bench_serving_paged(slots=b), 8),
     "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),
     # input-pipeline overlap row: steps/s at train(prefetch=0) vs 2 on a
     # synthetic input-bound workload (the ShardedPrefetcher's win)

@@ -757,6 +757,108 @@ def lm_decode_step_slots(params, prev_ids, positions, cache, num_heads=8,
     return _lm_project(params, x)[:, 0], new_cache
 
 
+def _cached_self_attn_paged(blk, x, c, positions, tables, pos_mask,
+                            num_heads, rope_pos=None):
+    """``_cached_self_attn_slots`` over a PAGED KV pool: the cache is a
+    shared pool of fixed-size blocks ``[num_blocks, block_size, Dkv]``
+    and each row's K/V live wherever its block table says (``tables``
+    [S, blocks_per_row] int32 of physical block ids).  Row r writes its
+    new K/V into block ``tables[r, p // bs]`` at offset ``p % bs`` (host
+    scheduling guarantees writer exclusivity: a block being written has
+    pool refcount 1 — the copy-on-write fork in serving/kv_pool.py; free
+    rows all target the reserved scratch block 0, whose contents are
+    never attended) and attends over the GATHER of its own chain —
+    ``pool[tables[r]]`` flattened back to a contiguous [S, T, Dkv] view.
+    The gathered values at positions <= positions[r] are exactly what
+    the slab holds at those logical positions, and masked positions
+    contribute exp(-1e30) = 0.0, so row r's numerics are bit-identical
+    to ``_cached_self_attn_slots`` — shared physical blocks and all."""
+    s = positions.shape[0]
+    block_size = c["k"].shape[1]
+    h = _ln(blk["ln1"], x)
+    k_new = linear.matmul(h, blk["attn"]["wk"])
+    q = linear.matmul(h, blk["attn"]["wq"])
+    if rope_pos is not None:
+        dh = q.shape[-1] // num_heads
+        k_new = _rope_flat(k_new, rope_pos, dh)
+        q = _rope_flat(q, rope_pos, dh)
+    v_new = linear.matmul(h, blk["attn"]["wv"])
+    rows = jnp.arange(s)
+    bids = tables[rows, positions // block_size]
+    offs = positions % block_size
+    k = c["k"].at[bids, offs].set(k_new[:, 0])
+    v = c["v"].at[bids, offs].set(v_new[:, 0])
+    # chain gather: [S, blocks_per_row, bs, Dkv] -> [S, T, Dkv] where
+    # T = blocks_per_row * bs covers every position a row can hold
+    k_rows = k[tables].reshape(s, -1, k.shape[-1])
+    v_rows = v[tables].reshape(s, -1, v.shape[-1])
+    att = _attend(q, k_rows, v_rows, num_heads, pos_mask)
+    return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
+
+
+def lm_decode_step_paged(params, prev_ids, positions, cache, tables,
+                         num_heads=8, moe_top_k=2, pos_type="learned"):
+    """One incremental decode position for every row of a PAGED slot
+    slab — the block-pool twin of ``lm_decode_step_slots``.
+
+    prev_ids [S], positions [S] int32; cache: per-enc-layer K/V pools
+    ``[num_blocks, block_size, Dkv]`` (``init_lm_cache_paged``); tables:
+    [S, blocks_per_row] int32 physical block ids (block 0 = the reserved
+    scratch block free rows point at) -> (logits [S, V], new cache).
+    Row r computes exactly ``lm_decode_step_slots``'s result at
+    t=positions[r]: same gathered K/V values at every unmasked position,
+    same masked-softmax width semantics (-1e30 logits exp to exactly
+    0.0).  The block table is DATA, not shape: admission, eviction and
+    copy-on-write forks churn ``tables`` between steps without ever
+    retracing (tests/test_kv_pool.py pins 1 warm-up trace, 0 after)."""
+    s = prev_ids.shape[0]
+    block_size = cache[0]["k"].shape[1]
+    t_span = tables.shape[1] * block_size
+    x = emb_ops.embedding_lookup(params["src_emb"], prev_ids)[:, None]
+    x = x * math.sqrt(x.shape[-1])
+    if pos_type == "learned":
+        x = x + params["pos"][positions][:, None]
+    rope_pos = positions[:, None] if pos_type == "rope" else None
+    pos_mask = jnp.arange(t_span)[None, :] <= positions[:, None]
+    pos_mask = jnp.broadcast_to(pos_mask, (s, t_span))
+    new_cache = []
+    for blk, c in zip(params["enc"], cache):
+        x, nc = _cached_self_attn_paged(blk, x, c, positions, tables,
+                                        pos_mask, num_heads, rope_pos)
+        x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
+        new_cache.append(nc)
+    return _lm_project(params, x)[:, 0], new_cache
+
+
+def init_lm_cache_paged(params, num_blocks, block_size, max_len=None):
+    """K/V block pools for ``lm_decode_step_paged``: per enc layer
+    ``{"k","v"}`` of ``[num_blocks, block_size, Dkv]`` — the paged twin
+    of ``init_lm_cache`` (same per-block KV width inference, so GQA
+    trunks get proportionally smaller blocks).  Block 0 is reserved as
+    the scratch block free rows read/write; the allocator
+    (serving/kv_pool.py BlockPool) hands out ids 1..num_blocks-1.
+    ``max_len``: the logical per-row span, validated against the learned
+    positional table exactly like ``init_lm_cache`` (a rope trunk has no
+    cap)."""
+    if num_blocks < 2 or block_size < 1:
+        raise ValueError(
+            f"paged cache needs num_blocks >= 2 (one is the reserved "
+            f"scratch block) and block_size >= 1; got {num_blocks}, "
+            f"{block_size}")
+    if max_len is not None and "pos" in params \
+            and max_len > params["pos"].shape[0]:
+        raise ValueError(
+            f"lm decode max_len {max_len} exceeds the positional table "
+            f"({params['pos'].shape[0]}); re-init with a larger max_len "
+            "or use pos_type='rope'")
+    dt = params["src_emb"].dtype
+    return [{"k": jnp.zeros((num_blocks, block_size,
+                             blk["attn"]["wk"].shape[1]), dt),
+             "v": jnp.zeros((num_blocks, block_size,
+                             blk["attn"]["wv"].shape[1]), dt)}
+            for blk in params["enc"]]
+
+
 def init_lm_cache(params, batch, max_len):
     """K/V buffers for lm_decode_step (mirrors init_decode_cache, but for
     the enc stack the LM trunk runs)."""

@@ -66,6 +66,11 @@ FAMILIES = [
     # is host-side only, so its analytic row is the SAME slab decode step
     # the replicas run — the fleet adds zero new traces by construction
     ("serving_fleet", "serving_fleet", None),
+    # paged KV-cache serving (serving/kv_pool.py + kv_layout="paged"):
+    # the PAGED decode step via DecodeEngine.lower — gates the
+    # block-gather/scatter step's structure (the block table is data, so
+    # allocator churn can never change this program)
+    ("serving_paged", "serving_paged", None),
     ("trainer_prefetch", "trainer_prefetch", None),
 ]
 
@@ -124,7 +129,7 @@ def capture(name, model, batch=None, chips=("v5e", "v5p")):
     # differ and the cross-check is omitted for them.
     bps = extras.get("batches_per_step")
     if model in ("transformer_serving", "serving", "serving_generate",
-                 "serving_fleet"):
+                 "serving_fleet", "serving_paged"):
         # the lowered program is one batch/slab step while the bench FLOPs
         # model covers the whole stream/burst — scopes differ, no cross-check
         row["bench_model_flops"] = None

@@ -14,7 +14,7 @@
                    under an injected decode fault + kill-9 trainer
                    resume, one JSON line
 
-Docs: docs/serving.md §5.  Flags: resilience_* in utils/flags.py.
+Docs: docs/serving.md §6.  Flags: resilience_* in utils/flags.py.
 """
 
 from paddle_tpu.resilience.faults import (FAULT_POINTS, FaultPlan,

@@ -199,7 +199,7 @@ class Supervisor:
 
     step_deadline_s: watchdog deadline for one slab step (None = off,
     the step runs inline with zero overhead).  breaker_threshold /
-    breaker_cooldown_s: circuit-breaker tuning (docs/serving.md §5).
+    breaker_cooldown_s: circuit-breaker tuning (docs/serving.md §6).
     max_request_recoveries: how many times ONE request may be re-
     prefilled before it is failed (bounds the work a permanently
     poisoned step can burn).
@@ -273,7 +273,7 @@ class Supervisor:
     def reprefill(self, engine, items):
         """Rebuild interrupted requests' slots on a freshly reset
         engine.  ``items`` is a list of ``(prompt, tokens)``; for each,
-        the lost slab held K/V for ``full[0:R]`` with the last delivered
+        the lost cache held K/V for ``full[0:R]`` with the last delivered
         token armed at position R, where ``full = prompt + tokens`` and
         ``R = len(full) - 1``.  Rebuild in two warm-executable legs:
 
@@ -292,44 +292,14 @@ class Supervisor:
         continues bit-identically — pinned by tests/test_resilience.py.
         Returns a list aligned with ``items``: ``(slot, replay_feed)``
         per recovered request, or the exception that failed it (one
-        victim's failure never blocks the others)."""
+        victim's failure never blocks the others).
+
+        The mechanics live in ``DecodeEngine.seat_prefilled`` — the ONE
+        seat-prefix helper this path shares with the batcher's
+        continuation-``replay`` leg, paged prefix-cache admission, and
+        pool-pressure re-seating (serving/kv_pool.py)."""
         import numpy as np
-        top = engine.prefill_buckets[-1]
-        prep = []
-        for prompt, tokens in items:
-            full = np.concatenate([np.asarray(prompt, np.int32),
-                                   np.asarray(tokens, np.int32)])
-            # the prefix is clamped to the ladder top, so it always
-            # fits: an admitted request's prompt fit by contract
-            prep.append((full, min(full.size - 1, top)))
-        results = [None] * len(items)
-        groups = {}
-        for i, (_full, pre) in enumerate(prep):
-            groups.setdefault(engine.prefill_bucket_for(pre),
-                              []).append(i)
-        for bucket, idxs in sorted(groups.items()):
-            prompts = np.zeros((len(idxs), bucket), np.int32)
-            lengths = np.zeros((len(idxs),), np.int32)
-            for j, i in enumerate(idxs):
-                full, pre = prep[i]
-                prompts[j, :pre] = full[:pre]
-                lengths[j] = pre
-            try:
-                _first, rows = engine.prefill(prompts, lengths)
-            except Exception as e:      # noqa: BLE001 — crosses to the
-                for i in idxs:          # batcher per victim
-                    results[i] = e
-                continue
-            for j, i in enumerate(idxs):
-                full, pre = prep[i]
-                try:
-                    # arm with the recorded stream's next token (inside
-                    # the prompt the model's own prediction is
-                    # irrelevant; past it, identical)
-                    slot = engine.admit(np.int32(full[pre]), rows[j],
-                                        np.int32(pre))
-                except Exception as e:  # noqa: BLE001
-                    results[i] = e
-                    continue
-                results[i] = (slot, [int(t) for t in full[pre + 1:]])
-        return results
+        return engine.seat_prefilled(
+            [np.concatenate([np.asarray(prompt, np.int32),
+                             np.asarray(tokens, np.int32)])
+             for prompt, tokens in items])

@@ -37,6 +37,11 @@
 #                                        kill-9 one mid-stream, streams
 #                                        bit-identical via cross-replica
 #                                        failover; supervisor restarts it)
+# 11. paged KV smoke                    (paged block-pool KV cache: two
+#                                        clients sharing a long system
+#                                        prompt + one divergent -> prefix
+#                                        hits + CoW fork recorded, streams
+#                                        bit-identical to the slab twin)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -202,7 +207,7 @@ log "phase 9: chaos smoke (fault injection + supervised recovery)"
 # serving under an injected decode-step fault (recovered streams must be
 # bit-identical to the clean run) + kill-9 trainer resume at smoke scale
 # — one JSON line, nonzero rc on any failed check
-# (python -m paddle_tpu.resilience --smoke; docs/serving.md §5)
+# (python -m paddle_tpu.resilience --smoke; docs/serving.md §6)
 timeout "$T_SERVE" python -m paddle_tpu.resilience --smoke \
     > "$ART/chaos_smoke.json" 2> "$ART/chaos_smoke.log"
 log "chaos smoke rc=$? -> $ART/chaos_smoke.json"
@@ -213,10 +218,21 @@ log "phase 10: fleet smoke (replica supervisor + health-checked router)"
 # MID-STREAM — every stream must finish bit-identical to lm_generate via
 # the router's cross-replica continuation failover, /metrics must show
 # it, and the supervisor must restart the victim to readiness — one JSON
-# line (python -m paddle_tpu.serving.router --smoke; docs/serving.md §6)
+# line (python -m paddle_tpu.serving.router --smoke; docs/serving.md §7)
 timeout "$T_SERVE" python -m paddle_tpu.serving.router --smoke \
     > "$ART/fleet_smoke.json" 2> "$ART/fleet_smoke.log"
 log "fleet smoke rc=$? -> $ART/fleet_smoke.json"
+
+log "phase 11: paged KV smoke (block pool + prefix sharing + CoW)"
+# kv_layout=paged demo server: one leader client registers a long
+# system-prompt chain, an exact-duplicate client must hit + CoW-fork,
+# a divergent client must hit the shared prefix — every stream
+# bit-identical to the same prompts through a slab-layout twin — one
+# JSON line (python -m paddle_tpu.serving --smoke-paged;
+# docs/serving.md §5)
+timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-paged \
+    > "$ART/paged_smoke.json" 2> "$ART/paged_smoke.log"
+log "paged smoke rc=$? -> $ART/paged_smoke.json"
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

@@ -15,17 +15,17 @@ executables (docs/serving.md §3) and continuous-batching generation
     server.py        JSON/HTTP front-end (/v1/infer, /v1/generate,
                      /healthz liveness, /readyz readiness, /metrics)
                      + CLI; 429/503 carry Retry-After, SIGTERM drain
-                     under a hard deadline (docs/serving.md §5)
+                     under a hard deadline (docs/serving.md §6)
     metrics.py       ServingMetrics — latency/TTFT/TPOT percentiles,
                      occupancy, padding waste, slot evictions, queue
                      depth; Prometheus text at /metrics
     fleet.py         ReplicaSupervisor — spawn/health/restart N replica
                      subprocesses (exp backoff + seeded jitter, restart-
-                     storm breaker, rolling drain; docs/serving.md §6)
+                     storm breaker, rolling drain; docs/serving.md §7)
     router.py        Router — readiness-gated least-loaded dispatch,
                      outlier ejection, bounded retry, hedging, and
                      cross-replica MID-STREAM failover (bit-identical
-                     greedy streams; docs/serving.md §6)
+                     greedy streams; docs/serving.md §7)
 
     python -m paddle_tpu.serving --artifacts 'model.b*.shlo' --port 8080
     python -m paddle_tpu.serving --demo-generate --port 8080

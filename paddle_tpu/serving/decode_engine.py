@@ -18,6 +18,21 @@ slab):
   never again (``expect_traces`` discipline, shared with
   ``InferenceEngine.warmup`` and ``SGD.precompile``).
 
+* ``DecodeEngine(kv_layout="paged")`` — the same engine over a PAGED
+  KV cache (docs/serving.md §5): per layer a block POOL ``[num_blocks,
+  block_size, Dkv]`` plus per-slot block tables, managed by the
+  host-side allocator in ``serving/kv_pool.py`` (free list, per-block
+  refcounts, copy-on-write forks, prefix index).  Memory is committed
+  per BLOCK as a stream actually grows instead of ``max_len`` up front,
+  so mixed-length traffic packs by actual length, and requests sharing
+  a prompt prefix map their leading blocks to the SAME physical blocks
+  (admission takes references instead of re-prefilling — the vLLM/
+  PagedAttention memory tier over the Orca scheduler above).  Still ONE
+  jitted step (``lm_decode_step_paged``): the block table is data, not
+  shape, so admission/eviction/fork churn never retraces, and greedy
+  streams stay bit-identical to the slab and to ``lm_generate``
+  (tests/test_kv_pool.py).  The slab stays the default layout.
+
 * Prefill rides the existing bucketed ``InferenceEngine`` ladder: one
   engine per prompt-LENGTH bucket (each with its own batch-bucket
   ladder), whose forward is ``lm_prefill`` + the last-real-position
@@ -38,6 +53,7 @@ deterministic serving mode whose numerics the oracle tests can pin.
 Sampling stays on ``lm_generate``.
 """
 
+import collections
 import queue
 import threading
 import time
@@ -54,12 +70,26 @@ from paddle_tpu.serving.batcher import (BatchExecutionError,
                                         DeadlineExceededError,
                                         OverloadedError, ShutdownError)
 from paddle_tpu.serving.engine import InferenceEngine, InvalidRequestError
+from paddle_tpu.serving.kv_pool import (InsufficientBlocksError,
+                                        PagedKVState)
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.testing.trace import expect_traces
 from paddle_tpu.utils.error import ConfigError
 from paddle_tpu.utils.logging import logger
 
 DEFAULT_PREFILL_BUCKETS = (32, 64)
+
+
+def _block_chunk(row, j, block_size):
+    """Block ``j`` of a prefill cache row ``[bucket, Dkv]`` as an exact
+    ``[block_size, Dkv]`` chunk (zero-padded past the bucket — those
+    positions are masked until the decode step overwrites them)."""
+    piece = np.asarray(row)[j * block_size:(j + 1) * block_size]
+    if piece.shape[0] == block_size:
+        return piece
+    pad = np.zeros((block_size - piece.shape[0],) + piece.shape[1:],
+                   piece.dtype)
+    return np.concatenate([piece, pad], axis=0)
 
 
 class DecodeEngine:
@@ -74,17 +104,29 @@ class DecodeEngine:
     prefill engine compiles; eos_id: default stop token (None = run to
     max_tokens; per-request override at submit).
 
+    kv_layout: ``"slab"`` (default — one ``[num_slots, max_len, Dkv]``
+    row per slot) or ``"paged"`` (a shared ``[kv_num_blocks,
+    kv_block_size, Dkv]`` block pool + per-slot block tables,
+    serving/kv_pool.py; docs/serving.md §5).  Paged-only knobs:
+    kv_block_size (positions per block); kv_num_blocks (pool size
+    including the reserved scratch block 0; 0 = auto-size to the slab
+    equivalent ``num_slots * ceil(max_len / block_size) + 1`` — same KV
+    bytes, strictly more packable); prefix_cache (share resident prompt-
+    prefix blocks across requests, copy-on-write on divergence).
+
     Slot lifecycle (docs/serving.md §4): FREE -> (prefill) -> ACTIVE
     -> one emitted token per ``step()`` -> EVICTED (eos | length |
-    error | shutdown) -> FREE.  All bookkeeping is host-side numpy; the
-    device only ever sees the fixed-shape slab step and the fixed-shape
-    admission write.
+    error | shutdown | pool_exhausted) -> FREE.  All bookkeeping is
+    host-side numpy; the device only ever sees the fixed-shape slab/pool
+    step and the fixed-shape admission writes.
     """
 
     def __init__(self, params, *, num_heads=8, num_slots=8, max_len=256,
                  prefill_buckets=DEFAULT_PREFILL_BUCKETS,
                  prefill_batch_buckets=(1, 4), eos_id=None, moe_top_k=2,
-                 pos_type="learned", metrics=None, name="lm", warm=True):
+                 pos_type="learned", metrics=None, name="lm", warm=True,
+                 kv_layout="slab", kv_block_size=16, kv_num_blocks=0,
+                 prefix_cache=True):
         from paddle_tpu.models import transformer
         self._transformer = transformer
         if params.get("dec"):
@@ -111,9 +153,34 @@ class DecodeEngine:
                 f"room to generate within max_len={self.max_len}")
         if self.num_slots < 1:
             raise ConfigError("num_slots must be >= 1")
-        # init_lm_cache validates max_len against the positional table
-        self._cache = transformer.init_lm_cache(params, self.num_slots,
-                                                self.max_len)
+        if kv_layout not in ("slab", "paged"):
+            raise ConfigError(f"kv_layout={kv_layout!r} (supported: "
+                              "'slab', 'paged')")
+        self.kv_layout = kv_layout
+        self._paged = None
+        if kv_layout == "paged":
+            self.block_size = int(kv_block_size)
+            if self.block_size < 1:
+                raise ConfigError("kv_block_size must be >= 1")
+            blocks_per_row = -(-self.max_len // self.block_size)
+            num_blocks = (int(kv_num_blocks) if kv_num_blocks
+                          else self.num_slots * blocks_per_row + 1)
+            # host allocator + prefix index + per-slot block tables
+            self._paged = PagedKVState(self.num_slots, num_blocks,
+                                       self.block_size, self.max_len,
+                                       prefix_cache=prefix_cache)
+            # per-layer [num_blocks, block_size, Dkv] pools (block 0 is
+            # the scratch block free slot rows point at)
+            self._cache = transformer.init_lm_cache_paged(
+                params, num_blocks, self.block_size, max_len=self.max_len)
+        else:
+            # init_lm_cache validates max_len against the positional table
+            self._cache = transformer.init_lm_cache(params, self.num_slots,
+                                                    self.max_len)
+        # prefill-compute ledger: real positions run through the prefill
+        # ladder (the paged prefix cache's whole point is to NOT grow
+        # this; bench.py serving_paged reads it for the elimination rate)
+        self.prefill_positions_total = 0
         # host-side slot state: token fed at the NEXT step and the
         # position it sits at; free slots idle at (0, 0) — their compute
         # is discarded and their cache row is overwritten at admission
@@ -132,16 +199,24 @@ class DecodeEngine:
         self._prefill_engines = {}     # length bucket -> InferenceEngine
         self._step_traces = [0]
 
-        def _step_fn(p, cache, tokens, pos):
-            self._step_traces[0] += 1      # runs only under tracing
-            logits, cache = transformer.lm_decode_step_slots(
-                p, tokens, pos, cache, self.num_heads, self.moe_top_k,
-                self.pos_type)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+        if self.kv_layout == "paged":
+            def _step_fn(p, cache, tokens, pos, tables):
+                self._step_traces[0] += 1  # runs only under tracing
+                logits, cache = transformer.lm_decode_step_paged(
+                    p, tokens, pos, cache, tables, self.num_heads,
+                    self.moe_top_k, self.pos_type)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+        else:
+            def _step_fn(p, cache, tokens, pos):
+                self._step_traces[0] += 1  # runs only under tracing
+                logits, cache = transformer.lm_decode_step_slots(
+                    p, tokens, pos, cache, self.num_heads, self.moe_top_k,
+                    self.pos_type)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
-        # donate the slab: the step rewrites one position per row, the
+        # donate the cache: the step rewrites one position per row, the
         # rest is carried through — without donation every step would copy
-        # the whole [S, max_len, Dkv] cache
+        # the whole slab/pool
         self._jit_step = jax.jit(_step_fn, donate_argnums=(1,))
 
         def _admit_fn(cache, row, slot):
@@ -152,8 +227,28 @@ class DecodeEngine:
 
         self._admit_traces = [0]
         # jax.jit compiles one executable per distinct row prefix length
-        # (= prefill bucket); warm-up pays each bucket's trace up front
+        # (= prefill bucket); warm-up pays each bucket's trace up front.
+        # (slab layout only — paged admission goes through _jit_write)
         self._jit_admit = jax.jit(_admit_fn, donate_argnums=(0,))
+
+        def _write_fn(cache, chunk, bid):
+            self._write_traces[0] += 1
+            return jax.tree_util.tree_map(
+                lambda c, ch: c.at[bid].set(ch.astype(c.dtype)),
+                cache, chunk)
+
+        def _copy_fn(cache, src, dst):
+            self._copy_traces[0] += 1
+            return jax.tree_util.tree_map(
+                lambda c: c.at[dst].set(c[src]), cache)
+
+        # paged device ops: ONE fixed [block_size, Dkv] write shape
+        # regardless of prompt bucket (one trace total), and the
+        # copy-on-write block fork
+        self._write_traces = [0]
+        self._copy_traces = [0]
+        self._jit_write = jax.jit(_write_fn, donate_argnums=(0,))
+        self._jit_copy = jax.jit(_copy_fn, donate_argnums=(0,))
         self._warm = False
         if warm:
             self.warmup()
@@ -223,6 +318,7 @@ class DecodeEngine:
         if t < bucket:
             prompts = np.concatenate(
                 [prompts, np.zeros((n, bucket - t), np.int32)], axis=1)
+        self.prefill_positions_total += int(lengths.sum())
         out = self._prefill_engine(bucket).infer(
             {"prompt": prompts, "length": lengths})
         first = np.argmax(out["first_logits"], axis=-1).astype(np.int32)
@@ -266,27 +362,224 @@ class DecodeEngine:
         for eng in self._prefill_engines.values():
             eng.metrics = m
 
-    def admit(self, first_token, cache_row, length):
-        """Seat one prefilled request: write its bucket-length cache rows
-        into positions [0, bucket) of a free slot's slab row and arm the
-        slot at (first_token, position=length).  The row tail past the
+    def admit(self, first_token, cache_row, length, tokens=None):
+        """Seat one prefilled request and arm the slot at (first_token,
+        position=length).  Returns the slot id; raises if no slot is
+        free (callers check ``free_slots`` — the batcher never
+        over-admits).
+
+        Slab: write the bucket-length cache rows into positions
+        [0, bucket) of a free slot's slab row.  The row tail past the
         bucket keeps whatever the previous occupant left there — safe by
         the same argument that covers prompt padding: position p is
         scatter-overwritten by the decode step in the same step that
-        first unmasks it.  Returns the slot id; raises if no slot is free
-        (callers check ``free_slots`` — the batcher never over-admits)."""
+        first unmasks it.
+
+        Paged: claim ``ceil(length / block_size)`` private blocks, chop
+        the prefill rows into block-sized chunks and write each into its
+        block (ONE compiled write shape — no per-bucket executables),
+        then, when ``tokens`` (the real prefix ids) are given and the
+        prefix cache is on, publish the full-block prefixes so later
+        requests admit by reference.  Raises ``InsufficientBlocksError``
+        (nothing claimed) when the pool is dry — the batcher defers the
+        request instead of failing it."""
         if not self._free:
             raise RuntimeError(f"{self.name}: no free decode slot")
-        slot = self._free.pop()
-        self._cache = self._jit_admit(self._cache, cache_row,
-                                      np.int32(slot))
+        if self.kv_layout == "paged":
+            slot = self._free.pop()
+            try:
+                chain = self._paged.seat_fresh(slot, int(length))
+            except InsufficientBlocksError:
+                self._free.append(slot)
+                raise
+            bs = self.block_size
+            for j, bid in enumerate(chain):
+                chunk = jax.tree_util.tree_map(
+                    lambda l, j=j: _block_chunk(l, j, bs), cache_row)
+                self._cache = self._jit_write(self._cache, chunk,
+                                              np.int32(bid))
+            if tokens is not None:
+                self._paged.register_prefix(
+                    np.asarray(tokens)[:int(length)], slot)
+        else:
+            slot = self._free.pop()
+            self._cache = self._jit_admit(self._cache, cache_row,
+                                          np.int32(slot))
         self._tokens[slot] = first_token
         self._pos[slot] = length
         return slot
 
+    def seat_cached(self, full, covered, chain):
+        """Seat one request whose leading ``covered`` positions are
+        already RESIDENT in ``chain`` (a prefix-cache hit, paged layout
+        only): take shared references on the physical blocks — no
+        prefill, no copy — arm the slot at ``pre = min(covered,
+        len(full) - 1)`` with ``full[pre]``, and return ``(slot,
+        replay_feed)`` where replay_feed is the teacher-forced remainder
+        ``full[pre+1:]`` (its re-derived emissions are swallowed by the
+        batcher, so the stream is bit-identical to a fresh prefill).
+        The slot's first write lands either in a fresh block (divergent
+        suffix) or inside the last shared block — which ``prepare_step``
+        then copy-on-write forks before the step touches it."""
+        if not self._free:
+            raise RuntimeError(f"{self.name}: no free decode slot")
+        full = np.asarray(full, np.int32)
+        pre = min(int(covered), full.size - 1)
+        slot = self._free.pop()
+        try:
+            self._paged.seat_shared(slot, chain, pre + 1)
+        except Exception:
+            self._free.append(slot)
+            raise
+        self._tokens[slot] = full[pre]
+        self._pos[slot] = pre
+        return slot, [int(t) for t in full[pre + 1:]]
+
+    def seat_prefilled(self, fulls):
+        """THE seat-prefix helper (one definition, four callers:
+        ``Supervisor.reprefill`` slot recovery, the batcher's
+        continuation-``replay`` leg, paged prefix-cache admission, and
+        pool-pressure re-seating).  For each 1-D ``full`` context array,
+        reconstruct a slot holding K/V for its prefix with the following
+        token armed, WITHOUT re-emitting anything:
+
+        1. paged + prefix cache: a resident chain seats by REFERENCE
+           (``seat_cached`` — zero prefill compute);
+        2. otherwise re-PREFILL the longest ladder-covered prefix
+           ``full[:min(len(full) - 1, ladder_top)]`` — same-bucket items
+           as ONE engine batch — and seat it (``admit``).
+
+        Either way the remainder returns as the teacher-forced
+        ``replay_feed`` the batcher drains through the shared step with
+        re-derived emissions swallowed; greedy decode being
+        deterministic, the slot ends byte-for-byte at its target state.
+        Returns a list aligned with ``fulls``: ``(slot, replay_feed)``
+        per seated item, or the exception that failed it
+        (``InsufficientBlocksError`` means "defer and retry", not
+        "fail")."""
+        top = self.prefill_buckets[-1]
+        results = [None] * len(fulls)
+        prep = []
+        for i, full in enumerate(fulls):
+            full = np.asarray(full, np.int32)
+            if self.kv_layout == "paged":
+                covered, chain = self._paged.lookup_prefix(full)
+                if covered and self.cached_seat_worthwhile(covered,
+                                                           full.size):
+                    try:
+                        results[i] = self.seat_cached(full, covered, chain)
+                    except Exception as e:    # noqa: BLE001 — isolate
+                        results[i] = e        # to this item
+                    continue
+            pre = min(full.size - 1, top)
+            if self.kv_layout == "paged" and not self.can_admit(pre + 1):
+                # pool-dry fast path: admit() below would raise this
+                # AFTER the prefill ran; gate here so every defer-and-
+                # retry cycle costs zero device work while the pool
+                # stays dry (admit stays the authoritative backstop)
+                results[i] = InsufficientBlocksError(
+                    f"pool cannot hold {pre + 1} positions yet")
+                continue
+            prep.append((i, full, pre))
+        groups = {}
+        for item in prep:
+            groups.setdefault(self.prefill_bucket_for(item[2]),
+                              []).append(item)
+        for bucket, items in sorted(groups.items()):
+            prompts = np.zeros((len(items), bucket), np.int32)
+            lengths = np.zeros((len(items),), np.int32)
+            for j, (_i, full, pre) in enumerate(items):
+                prompts[j, :pre] = full[:pre]
+                lengths[j] = pre
+            try:
+                _first, rows = self.prefill(prompts, lengths)
+            except Exception as e:      # noqa: BLE001 — crosses to the
+                for i, _full, _pre in items:    # caller per item
+                    results[i] = e
+                continue
+            for j, (i, full, pre) in enumerate(items):
+                try:
+                    # arm with the recorded stream's next token (inside
+                    # the prompt the model's own prediction is
+                    # irrelevant; past it, identical)
+                    slot = self.admit(np.int32(full[pre]), rows[j],
+                                      np.int32(pre), tokens=full[:pre])
+                except Exception as e:  # noqa: BLE001
+                    results[i] = e
+                    continue
+                results[i] = (slot, [int(t) for t in full[pre + 1:]])
+        return results
+
+    def cached_seat_worthwhile(self, covered, size):
+        """Seat through the prefix cache only when the resident coverage
+        saves at least half the ladder-covered prefill: the uncovered
+        remainder teacher-forces ONE DECODE STEP PER TOKEN, so a short
+        shared preamble on a long prompt would cost more steps (and
+        worse TTFT) than the single whole-prompt prefill it avoids —
+        route those as ordinary misses instead."""
+        return covered * 2 >= min(int(size) - 1, self.prefill_buckets[-1])
+
+    def prefix_lookup(self, prompt):
+        """``(covered_positions, chain)`` of the longest cached block-
+        aligned prefix of ``prompt`` — ``(0, [])`` on a miss or on the
+        slab layout.  Read-only (an LRU touch); seating takes the
+        references."""
+        if self.kv_layout != "paged":
+            return 0, []
+        return self._paged.lookup_prefix(np.asarray(prompt))
+
+    def can_admit(self, n_positions):
+        """Paged admission gate: could the pool produce blocks covering
+        ``n_positions`` right now (free list + evictable prefix-index
+        entries)?  Always True on the slab layout (the slab reserves per
+        slot up front)."""
+        if self.kv_layout != "paged":
+            return True
+        return self._paged.can_admit(int(n_positions))
+
+    def prepare_step(self):
+        """Paged layout: make every active slot's CURRENT write position
+        exclusive before the step — grow chains into fresh blocks, and
+        copy-on-write fork blocks still shared with the prefix index or
+        another slot (``cow_forks_total``).  Under pool exhaustion,
+        preempt victim slots youngest-first (``evictions{reason=
+        "pool_exhausted"}``) and return their ids — the batcher re-seats
+        those requests through ``seat_prefilled`` once space frees, so
+        their streams continue bit-identically.  Slab layout: no-op."""
+        if self.kv_layout != "paged":
+            return []
+        victims = []
+        free_set = set(self._free)
+        for slot in range(self.num_slots):
+            if slot in free_set or slot in victims:
+                continue
+            while True:
+                try:
+                    plan = self._paged.write_plan(slot,
+                                                  int(self._pos[slot]))
+                except InsufficientBlocksError:
+                    v = self._paged.victim(exclude=set(victims) | {slot})
+                    if v is None:
+                        raise     # one lone request outgrew the pool —
+                        #           validate_request bounds this; backstop
+                    self.evict(v, "pool_exhausted")
+                    victims.append(v)
+                    continue
+                break
+            if plan is not None and plan[0] == "cow":
+                _tag, _j, src, dst = plan
+                self._cache = self._jit_copy(self._cache, np.int32(src),
+                                             np.int32(dst))
+                self.metrics.observe_cow_fork()
+        return victims
+
     def evict(self, slot, reason):
-        """Free a slot (between steps).  The cache row is left as-is —
-        the next admission overwrites it wholesale."""
+        """Free a slot (between steps).  Slab: the cache row is left
+        as-is — the next admission overwrites it wholesale.  Paged: the
+        slot's block references release (shared blocks stay resident for
+        their other sharers / the prefix index)."""
+        if self.kv_layout == "paged":
+            self._paged.evict(slot)
         self._tokens[slot] = 0
         self._pos[slot] = 0
         self._free.append(slot)
@@ -311,7 +604,13 @@ class DecodeEngine:
         # models a wedged device step for the watchdog to catch
         faults.hit("serving.decode_step")
         t0 = time.perf_counter()
-        nxt, cache = self._jit_step(params, cache, tokens, pos)
+        if self.kv_layout == "paged":
+            # block tables ride as DATA (snapshotted, like tokens/pos):
+            # table churn between steps never retraces
+            nxt, cache = self._jit_step(params, cache, tokens, pos,
+                                        self._paged.tables.copy())
+        else:
+            nxt, cache = self._jit_step(params, cache, tokens, pos)
         nxt = np.asarray(nxt)
         with self._epoch_lock:
             if epoch != self._epoch:
@@ -321,6 +620,9 @@ class DecodeEngine:
             self._cache = cache
         self.metrics.observe_decode_step(self.num_active, self.num_slots,
                                          time.perf_counter() - t0)
+        if self.kv_layout == "paged":
+            self.metrics.set_kv_pool(self._paged.pool.num_free,
+                                     self._paged.pool.num_allocatable)
         return nxt
 
     def advance(self, slot, token):
@@ -337,8 +639,23 @@ class DecodeEngine:
         and the epoch bump orphans any still-running stale step."""
         with self._epoch_lock:
             self._epoch += 1
-            self._cache = self._transformer.init_lm_cache(
-                self.params, self.num_slots, self.max_len)
+            if self.kv_layout == "paged":
+                # fresh pool + allocator + (empty) prefix index: the
+                # blocks' contents are gone, so every cached chain is
+                # invalid — recovery re-seats through seat_prefilled,
+                # which misses and re-prefills.  REPLACE the state (a
+                # watchdog-abandoned stale step may still be reading the
+                # old tables array).
+                old = self._paged
+                self._paged = PagedKVState(
+                    self.num_slots, old.pool.num_blocks, self.block_size,
+                    self.max_len, prefix_cache=old.index is not None)
+                self._cache = self._transformer.init_lm_cache_paged(
+                    self.params, old.pool.num_blocks, self.block_size,
+                    max_len=self.max_len)
+            else:
+                self._cache = self._transformer.init_lm_cache(
+                    self.params, self.num_slots, self.max_len)
         self._tokens[:] = 0
         self._pos[:] = 0
         self._free = list(range(self.num_slots))[::-1]
@@ -356,24 +673,49 @@ class DecodeEngine:
             self._prefill_engine(b).warmup()
         if self._warm:
             return
-        for b in self.prefill_buckets:
-            zero_row = jax.tree_util.tree_map(
-                lambda l: np.zeros((b,) + l.shape[2:], l.dtype),
-                self._cache)
-            with expect_traces(lambda: self._admit_traces[0], 1,
-                               f"decode[{self.name}]: bucket-{b} "
-                               "admission warm-up"):
-                self._cache = self._jit_admit(self._cache, zero_row,
+        if self.kv_layout == "paged":
+            # ONE block-write shape and ONE fork shape serve every
+            # bucket/admission/CoW — both warmed (and executed) against
+            # the scratch block, whose contents are never attended
+            chunk = jax.tree_util.tree_map(
+                lambda l: np.zeros(l.shape[1:], l.dtype), self._cache)
+            with expect_traces(lambda: self._write_traces[0], 1,
+                               f"decode[{self.name}]: block-write "
+                               "warm-up"):
+                self._cache = self._jit_write(self._cache, chunk,
                                               np.int32(0))
-        with expect_traces(lambda: self.step_trace_count, 1,
-                           f"decode[{self.name}]: slab step warm-up",
-                           hint="the decode step is not shape-stable"):
-            nxt, self._cache = self._jit_step(
-                self.params, self._cache, self._tokens, self._pos)
-            jax.block_until_ready(nxt)
+            with expect_traces(lambda: self._copy_traces[0], 1,
+                               f"decode[{self.name}]: block-fork "
+                               "warm-up"):
+                self._cache = self._jit_copy(self._cache, np.int32(0),
+                                             np.int32(0))
+            with expect_traces(lambda: self.step_trace_count, 1,
+                               f"decode[{self.name}]: paged step warm-up",
+                               hint="the decode step is not shape-stable"):
+                nxt, self._cache = self._jit_step(
+                    self.params, self._cache, self._tokens, self._pos,
+                    self._paged.tables.copy())
+                jax.block_until_ready(nxt)
+        else:
+            for b in self.prefill_buckets:
+                zero_row = jax.tree_util.tree_map(
+                    lambda l: np.zeros((b,) + l.shape[2:], l.dtype),
+                    self._cache)
+                with expect_traces(lambda: self._admit_traces[0], 1,
+                                   f"decode[{self.name}]: bucket-{b} "
+                                   "admission warm-up"):
+                    self._cache = self._jit_admit(self._cache, zero_row,
+                                                  np.int32(0))
+            with expect_traces(lambda: self.step_trace_count, 1,
+                               f"decode[{self.name}]: slab step warm-up",
+                               hint="the decode step is not shape-stable"):
+                nxt, self._cache = self._jit_step(
+                    self.params, self._cache, self._tokens, self._pos)
+                jax.block_until_ready(nxt)
         self._warm = True
-        logger.info("decode[%s]: warm (%d slots, max_len %d, prefill "
-                    "buckets %s)", self.name, self.num_slots, self.max_len,
+        logger.info("decode[%s]: warm (%d slots, max_len %d, kv %s, "
+                    "prefill buckets %s)", self.name, self.num_slots,
+                    self.max_len, self.kv_layout,
                     list(self.prefill_buckets))
 
     def lower(self, what="step"):
@@ -383,6 +725,10 @@ class DecodeEngine:
         re-stages the function (one extra trace), like
         ``InferenceEngine.lower``."""
         if what == "step":
+            if self.kv_layout == "paged":
+                return self._jit_step.lower(self.params, self._cache,
+                                            self._tokens, self._pos,
+                                            self._paged.tables)
             return self._jit_step.lower(self.params, self._cache,
                                         self._tokens, self._pos)
         return self._prefill_engine(int(what)).lower(
@@ -432,13 +778,27 @@ class DecodeEngine:
             raise InvalidRequestError(
                 f"prompt ({prompt.size}) + max_tokens ({max_tokens}) "
                 f"exceeds the engine max_len ({self.max_len})")
+        self._check_pool_fit(prompt.size + max_tokens)
         return prompt.astype(np.int32), max_tokens
+
+    def _check_pool_fit(self, n_positions):
+        """Paged: one request must fit the pool ALONE (the runtime
+        preemption path can evict every other slot but never this one —
+        docs/serving.md §5 pool sizing)."""
+        if self.kv_layout != "paged":
+            return
+        need = self._paged.blocks_for(n_positions)
+        if need > self._paged.pool.num_allocatable:
+            raise InvalidRequestError(
+                f"request needs {need} KV blocks of "
+                f"{self.block_size} positions but the pool only holds "
+                f"{self._paged.pool.num_allocatable}")
 
     def validate_continuation(self, prompt, replay, max_tokens):
         """Admission checks for a mid-stream CONTINUATION: ``replay``
         tokens were already delivered to the caller by a previous serving
         of this stream (a router failing over off a dead replica —
-        docs/serving.md §6) and must be teacher-forced, never re-emitted.
+        docs/serving.md §7) and must be teacher-forced, never re-emitted.
         Unlike a fresh prompt, the combined context may exceed the
         prefill ladder top — seating re-prefills the longest
         ladder-covered prefix and replays the remainder through the slab
@@ -453,23 +813,35 @@ class DecodeEngine:
                 f"prompt ({prompt.size}) + replay ({replay.size}) + "
                 f"max_tokens ({max_tokens}) exceeds the engine max_len "
                 f"({self.max_len})")
+        self._check_pool_fit(prompt.size + replay.size + max_tokens)
         return prompt.astype(np.int32), replay.astype(np.int32), max_tokens
 
 
 class _GenRequest:
     __slots__ = ("prompt", "max_tokens", "eos_id", "future", "deadline",
                  "t_submit", "t_first", "on_token", "tokens", "slot",
-                 "abandoned", "recoveries", "replay_feed", "replay_ctx")
+                 "abandoned", "recoveries", "replay_feed", "replay_ctx",
+                 "started", "admit_covered", "prefix_counted")
 
     def __init__(self, prompt, max_tokens, eos_id, deadline, on_token,
                  replay_ctx=None):
         self.abandoned = False
         self.recoveries = 0
+        self.started = False      # future marked running (a request can
+        #                           re-enter admission — pool-deferred —
+        #                           but the transition fires once)
         self.replay_feed = []     # recovery replay: recorded tokens still
         #                           to teacher-force through the slab step
         self.replay_ctx = replay_ctx   # continuation context: tokens a
         #                                previous serving of this stream
         #                                already delivered (never re-emitted)
+        self.admit_covered = 0    # this admission pass's prefix-cache
+        #                           lookup (positions covered), reused by
+        #                           routing so the pass looks up once
+        self.prefix_counted = False   # hit/miss observed (a pool-
+        #                               deferred request re-enters
+        #                               admission; the counter must see
+        #                               it once)
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.eos_id = eos_id
@@ -560,6 +932,14 @@ class GenerationBatcher:
         self._by_slot = {}          # slot -> _GenRequest
         self._abandoned = set()     # futures flagged mid-prefill (before
         #                             their request reached a slot)
+        # paged-layout overflow lanes (both worker-thread-only):
+        # _waiting: popped requests the pool cannot seat yet (retried
+        # ahead of the queue); _preempted: requests whose slot was
+        # evicted under pool pressure (reason="pool_exhausted") — they
+        # hold delivered tokens and re-seat through seat_prefilled, so
+        # their streams continue bit-identically
+        self._waiting = collections.deque()
+        self._preempted = []
         self.name = name or f"gen_batcher[{engine.name}]"
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=self.name)
@@ -582,7 +962,7 @@ class GenerationBatcher:
 
         replay: mid-stream CONTINUATION — tokens a previous serving of
         this stream already delivered (a router failing over off a dead
-        replica, docs/serving.md §6).  Seating re-prefills the longest
+        replica, docs/serving.md §7).  Seating re-prefills the longest
         ladder-covered prefix of ``prompt + replay`` and teacher-forces
         the remainder through the slab step with re-derived emissions
         swallowed (``Supervisor.reprefill`` semantics), so the result's
@@ -678,6 +1058,8 @@ class GenerationBatcher:
     # ------------------------------------------------------------ worker
 
     def _pull(self, block):
+        if self._waiting:               # pool-deferred requests go first
+            return self._waiting.popleft()
         try:
             return self._q.get(timeout=0.05) if block else \
                 self._q.get_nowait()
@@ -709,12 +1091,36 @@ class GenerationBatcher:
         except InvalidStateError:
             pass
 
+    def _flag_abandoned(self, req):
+        """Fold a mid-prefill ``abandon()`` into the request's flag."""
+        if req.future in self._abandoned:
+            self._abandoned.discard(req.future)
+            req.abandoned = True
+        return req.abandoned
+
     def _admit_from_queue(self, block):
         """Fill free slots from the queue; same-length-bucket prompts
-        prefill as ONE engine batch.  Runs strictly between steps."""
+        prefill as ONE engine batch.  Runs strictly between steps.
+
+        Fresh prompts prefill WHOLE and their first emission is
+        delivered at admission.  Everything that must be RECONSTRUCTED
+        instead — continuations (``replay_ctx``), fresh prompts whose
+        prefix is resident in the paged prefix cache, and pool-preempted
+        requests — seats through ``engine.seat_prefilled`` (the one
+        seat-prefix helper, shared with ``Supervisor.reprefill``):
+        teacher-forced remainder, re-derived emissions swallowed, so
+        every stream is bit-identical to an uninterrupted one.  On the
+        paged layout, requests the pool cannot hold yet are DEFERRED
+        (``_waiting`` / ``_preempted``), never failed."""
         if self._gang and self._by_slot:
             return          # whole-batch policy: drain before refilling
+        self._reseat_preempted()
+        block = block and not self._preempted
         picked = []
+        kv_budget = None
+        if self.engine.kv_layout == "paged":
+            kv_budget = [self.engine._paged.pool.num_free]
+        stashed = []
         while self.engine.free_slots > len(picked):
             req = self._pull(block and not picked)
             if req is None:
@@ -727,35 +1133,68 @@ class GenerationBatcher:
                     f"deadline exceeded after "
                     f"{(now - req.t_submit) * 1e3:.1f}ms in queue"))
                 continue
-            if not req.future.set_running_or_notify_cancel():
-                continue            # client cancelled while queued
+            if not req.started:
+                if not req.future.set_running_or_notify_cancel():
+                    continue        # client cancelled while queued
+                req.started = True
+            covered = 0
+            if kv_budget is not None and req.replay_ctx is None:
+                covered = self.engine.prefix_lookup(req.prompt)[0]
+                if not self.engine.cached_seat_worthwhile(
+                        covered, req.prompt.size):
+                    covered = 0    # short preamble: route (and budget)
+                    #                it as an ordinary whole-prompt miss
+                if not covered:
+                    # paged fresh miss: it will claim private blocks for
+                    # its whole prompt — defer it while the pool (free
+                    # blocks minus what this admission round already
+                    # earmarked) cannot hold them, instead of prefilling
+                    # just to fail
+                    need = self.engine._paged.blocks_for(
+                        req.prompt.size + 1)
+                    if need > kv_budget[0] \
+                            and not self.engine.can_admit(
+                                req.prompt.size + 1):
+                        stashed.append(req)
+                        continue
+                    kv_budget[0] -= need
+            req.admit_covered = covered
             picked.append(req)
+        self._waiting.extend(stashed)
         if not picked:
             return
-        # seat prefix per request: a fresh prompt prefills WHOLE and its
-        # first emission is delivered; a continuation (replay_ctx set)
-        # prefills the longest ladder-covered prefix of prompt + replay
-        # and teacher-forces the rest — its prefill emission re-derives
-        # an already-delivered token, so it is swallowed, never emitted
-        top = self.engine.prefill_buckets[-1]
-        prefixes = {}
+        # route: fresh misses prefill whole (emit at admission); fresh
+        # prefix-cache hits and continuations reconstruct via
+        # seat_prefilled (nothing re-emitted)
+        fresh, recon = [], []
         for req in picked:
-            if req.replay_ctx is None:
-                prefixes[id(req)] = req.prompt
-            else:
-                full = req.context
-                prefixes[id(req)] = full[:min(full.size - 1, top)]
+            if req.replay_ctx is not None:
+                recon.append(req)
+                continue
+            if self.engine.kv_layout == "paged":
+                # the budget-gate loop above already did this request's
+                # prefix lookup this pass; seat_prefilled re-looks-up at
+                # seating time (the pool may shift as items seat), so
+                # that one stays the authoritative reference-taker
+                covered = req.admit_covered
+                if not req.prefix_counted:
+                    req.prefix_counted = True
+                    self.metrics.observe_prefix_cache(hit=covered > 0)
+                if covered:
+                    recon.append(req)
+                    continue
+            fresh.append(req)
+        self._seat_reconstructed(recon)
         groups = {}
-        for req in picked:
-            b = self.engine.prefill_bucket_for(prefixes[id(req)].size)
+        for req in fresh:
+            b = self.engine.prefill_bucket_for(req.prompt.size)
             groups.setdefault(b, []).append(req)
         for bucket, reqs in sorted(groups.items()):
             prompts = np.zeros((len(reqs), bucket), np.int32)
             lengths = np.zeros((len(reqs),), np.int32)
             for i, req in enumerate(reqs):
-                pre = prefixes[id(req)]
-                prompts[i, :pre.size] = pre
-                lengths[i] = pre.size
+                prompts[i, :req.prompt.size] = req.prompt
+                lengths[i] = req.prompt.size
             try:
                 first, rows = self.engine.prefill(prompts, lengths)
             except Exception as e:    # noqa: BLE001 — isolate to THIS group
@@ -767,29 +1206,7 @@ class GenerationBatcher:
                         f"prefill failed: {type(e).__name__}: {e}"))
                 continue
             for i, req in enumerate(reqs):
-                if req.future in self._abandoned:
-                    self._abandoned.discard(req.future)
-                    req.abandoned = True
-                if req.replay_ctx is not None:
-                    if req.abandoned:
-                        self._resolve(req, "abandoned")
-                        continue
-                    # continuation: arm with the recorded stream's next
-                    # token (the prefill emission is discarded — inside
-                    # the recorded stream the model's re-derivation is
-                    # identical anyway) and queue the remainder for the
-                    # teacher-forced replay leg in _loop
-                    full, pre = req.context, int(lengths[i])
-                    try:
-                        req.slot = self.engine.admit(
-                            np.int32(full[pre]), rows[i], np.int32(pre))
-                    except Exception as e:    # noqa: BLE001 — see below
-                        self._fail_all_inflight(
-                            e, extra=[req] + reqs[i + 1:])
-                        break
-                    req.replay_feed = [int(t) for t in full[pre + 1:]]
-                    self._by_slot[req.slot] = req
-                    continue
+                self._flag_abandoned(req)
                 req.emit(first[i], self.name)
                 self.metrics.observe_ttft(req.t_first - req.t_submit)
                 self.metrics.observe_gen_tokens(1)
@@ -804,16 +1221,98 @@ class GenerationBatcher:
                 else:
                     try:
                         req.slot = self.engine.admit(first[i], rows[i],
-                                                     lengths[i])
+                                                     lengths[i],
+                                                     tokens=req.prompt)
+                    except InsufficientBlocksError:
+                        # the pool budget raced CoW growth: the token is
+                        # already delivered, so the request continues as
+                        # a preemption (re-seat + teacher-forced replay)
+                        self._preempted.append(req)
+                        continue
                     except Exception as e:    # noqa: BLE001 — the slot
                         # write is a device op like step/prefill; a
-                        # failure may have consumed the donated slab, so
+                        # failure may have consumed the donated cache, so
                         # fail everything in flight (incl. this group's
-                        # rest) and reset; later groups get the fresh slab
+                        # rest) and reset; later groups get fresh state
                         self._fail_all_inflight(
                             e, extra=[req] + reqs[i + 1:])
                         break
                     self._by_slot[req.slot] = req
+
+    def _seat_reconstructed(self, reqs):
+        """Seat requests whose context must be rebuilt without
+        re-emitting (continuations + paged prefix-cache hits) through
+        ``engine.seat_prefilled``; pool-dry items defer to ``_waiting``."""
+        live = []
+        for req in reqs:
+            if self._flag_abandoned(req):
+                self._resolve(req, "abandoned")
+            else:
+                live.append(req)
+        if not live:
+            return
+        outcomes = self.engine.seat_prefilled([r.context for r in live])
+        hard = None
+        for req, out in zip(live, outcomes):
+            if isinstance(out, InsufficientBlocksError):
+                self._waiting.append(req)     # space, not failure: retry
+            elif isinstance(out, BaseException):
+                hard = out
+                self.metrics.observe_error(1)
+                req.fail(BatchExecutionError(
+                    f"seat failed: {type(out).__name__}: {out}"))
+            else:
+                req.slot, req.replay_feed = out
+                self._by_slot[req.slot] = req
+        if hard is not None:
+            # the failed seat was a device op (prefill / admit /
+            # seat_cached) that may have consumed the donated cache —
+            # fail everything in flight and reset, exactly like the
+            # fresh-admission path, instead of stepping a possibly-
+            # deleted buffer
+            self._fail_all_inflight(hard)
+
+    def _reseat_preempted(self):
+        """Re-seat pool-preempted requests (oldest first) from prompt +
+        delivered tokens — ``seat_prefilled`` reconstructs the slot and
+        the teacher-forced replay swallows every re-derived emission, so
+        the client's stream continues bit-identically.  Items the pool
+        still cannot hold stay preempted for the next cycle."""
+        if not self._preempted or not self.engine.free_slots:
+            return
+        batch = self._preempted[:self.engine.free_slots]
+        rest = self._preempted[len(batch):]
+        self._preempted = rest
+        live, fulls = [], []
+        for req in batch:
+            if self._flag_abandoned(req):
+                self._resolve(req, "abandoned")
+                continue
+            live.append(req)
+            fulls.append(np.concatenate(
+                [req.context, np.asarray(req.tokens, np.int32)]))
+        if not live:
+            return
+        outcomes = self.engine.seat_prefilled(fulls)
+        hard = None
+        for req, out in zip(live, outcomes):
+            if isinstance(out, InsufficientBlocksError):
+                self._preempted.append(req)
+            elif isinstance(out, BaseException):
+                hard = out
+                self.metrics.observe_error(1)
+                req.fail(BatchExecutionError(
+                    f"re-seat after pool preemption failed: "
+                    f"{type(out).__name__}: {out}"))
+            else:
+                req.slot, req.replay_feed = out
+                self._by_slot[req.slot] = req
+                self.metrics.observe_slot_reprefill()
+        if hard is not None:
+            # same donated-cache safety as _seat_reconstructed: the
+            # failed seat was a device op — never step a possibly-
+            # consumed buffer
+            self._fail_all_inflight(hard)
 
     def _snap_breaker(self):
         """Mirror the breaker's state into the metrics gauge."""
@@ -870,6 +1369,15 @@ class GenerationBatcher:
             # crash must fail the victims, never the worker thread
             outcomes = [re] * len(recoverable)
         for req, out in zip(recoverable, outcomes):
+            if isinstance(out, InsufficientBlocksError):
+                # space, not failure: the rebuilt pool starts with an
+                # empty prefix index, so victims that shared blocks may
+                # not all fit privately at once.  Park the overflow —
+                # _reseat_preempted replays it bit-identically once
+                # blocks free up, same as any pool-pressure preemption.
+                self.metrics.evict_slot("pool_exhausted")
+                self._preempted.append(req)
+                continue
             if isinstance(out, BaseException):
                 self.metrics.evict_slot("error")
                 self.metrics.observe_error(1)
@@ -909,14 +1417,30 @@ class GenerationBatcher:
                         "generation batcher closed without drain"))
                     self.engine.evict(slot, "shutdown")
                 self._by_slot.clear()
+                for req in self._preempted + list(self._waiting):
+                    req.fail(ShutdownError(
+                        "generation batcher closed without drain"))
+                self._preempted, self._waiting = [], collections.deque()
                 return
             self._admit_from_queue(block=not self._by_slot)
             if not self._by_slot:
-                if self._closed.is_set() and self._q.empty():
+                if self._closed.is_set() and self._q.empty() \
+                        and not self._waiting and not self._preempted:
                     return
                 continue
             sup = self.supervisor
             try:
+                # paged layout: provision every active slot's write block
+                # (chain growth + copy-on-write forks) strictly BETWEEN
+                # steps; pool exhaustion preempts the youngest slots —
+                # their requests re-seat via _reseat_preempted and their
+                # streams continue bit-identically
+                for slot in self.engine.prepare_step():
+                    req = self._by_slot.pop(slot)
+                    req.slot = None
+                    self._preempted.append(req)
+                if not self._by_slot:
+                    continue        # everything was preempted
                 if sup is None:
                     nxt = self.engine.step()
                 else:

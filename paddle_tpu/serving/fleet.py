@@ -1,5 +1,5 @@
 """Supervised replica fleet: spawn, health-check, restart N serving
-replicas (docs/serving.md §6).
+replicas (docs/serving.md §7).
 
 One serving process (server.py) is one failure domain: a crash, a wedged
 drain, or a poisoned engine takes every resident stream with it, and

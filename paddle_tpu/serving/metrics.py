@@ -29,10 +29,12 @@ REJECT_REASONS = ("overload", "deadline", "invalid", "shutdown", "breaker")
 # reached, error = the slot's request failed with its batch, shutdown =
 # drain(False) failed it, abandoned = the caller disconnected mid-stream,
 # recovered = the slot was torn down by a step failure and re-prefilled
-# onto the rebuilt slab (resilience/supervisor.py).  Keys are part of
-# the /metrics surface.
+# onto the rebuilt slab (resilience/supervisor.py), pool_exhausted = the
+# paged KV block pool ran dry and the slot was preempted (its request
+# re-seats and continues bit-identically; serving/kv_pool.py).  Keys are
+# part of the /metrics surface.
 EVICT_REASONS = ("eos", "length", "error", "shutdown", "abandoned",
-                 "recovered")
+                 "recovered", "pool_exhausted")
 
 # circuit-breaker state gauge encoding (breaker_state metric)
 BREAKER_STATES = {"closed": 0, "half_open": 1, "open": 2}
@@ -75,6 +77,15 @@ class ServingMetrics:
         self.active_slot_steps_total = 0  # sum of active slots over steps
         self.slot_count = 0              # gauge, set by the decode engine
         self.evictions = {r: 0 for r in EVICT_REASONS}
+        # ---- paged KV cache (decode_engine.py kv_layout="paged" over
+        # serving/kv_pool.py): block-pool gauges + prefix-sharing and
+        # copy-on-write counters
+        self.kv_blocks_total = 0         # gauge: allocatable pool blocks
+        self.kv_blocks_free = 0          # gauge: free-list depth
+        self.prefix_cache_hits = 0       # fresh admissions seated from
+        #                                  resident prefix blocks
+        self.prefix_cache_misses = 0     # fresh admissions that prefilled
+        self.cow_forks = 0               # copy-on-write block forks
         # v2 Inference per-row-signature engine cache (satellite): LRU
         # evictions of whole compiled engines under ragged feed signatures
         self.engine_cache_evictions = 0
@@ -138,6 +149,27 @@ class ServingMetrics:
     def evict_engine_cache(self):
         with self._lock:
             self.engine_cache_evictions += 1
+
+    # ---- paged KV cache (decode_engine.py / serving/kv_pool.py) ----
+
+    def observe_prefix_cache(self, hit):
+        """One fresh admission's prefix-cache outcome: seated from
+        resident blocks (hit) or prefilled (miss)."""
+        with self._lock:
+            if hit:
+                self.prefix_cache_hits += 1
+            else:
+                self.prefix_cache_misses += 1
+
+    def observe_cow_fork(self, n=1):
+        with self._lock:
+            self.cow_forks += int(n)
+
+    def set_kv_pool(self, free, total):
+        """Snapshot the block pool's free/allocatable gauges."""
+        with self._lock:
+            self.kv_blocks_free = int(free)
+            self.kv_blocks_total = int(total)
 
     # ---- resilience events (resilience/supervisor.py callers) ----
 
@@ -213,6 +245,17 @@ class ServingMetrics:
                 "decode_steps_total": self.decode_steps_total,
                 "slot_count": self.slot_count,
                 "evictions": dict(self.evictions),
+                "kv_blocks_total": self.kv_blocks_total,
+                "kv_blocks_free": self.kv_blocks_free,
+                "kv_blocks_used": self.kv_blocks_total
+                - self.kv_blocks_free,
+                "kv_block_utilization": round(
+                    (self.kv_blocks_total - self.kv_blocks_free)
+                    / self.kv_blocks_total, 3) if self.kv_blocks_total
+                else 0.0,
+                "prefix_cache_hits_total": self.prefix_cache_hits,
+                "prefix_cache_misses_total": self.prefix_cache_misses,
+                "cow_forks_total": self.cow_forks,
                 "engine_cache_evictions": self.engine_cache_evictions,
                 "retries_total": self.retries_total,
                 "watchdog_trips_total": self.watchdog_trips_total,
@@ -307,11 +350,28 @@ class ServingMetrics:
                  self.engine_cache_evictions,
                  "compiled engines evicted from the per-row-signature "
                  "LRU cache"),
+                ("prefix_cache_hits_total", self.prefix_cache_hits,
+                 "fresh admissions seated from resident prefix blocks "
+                 "(paged KV cache)"),
+                ("prefix_cache_misses_total", self.prefix_cache_misses,
+                 "fresh admissions that re-prefilled (paged KV cache)"),
+                ("cow_forks_total", self.cow_forks,
+                 "copy-on-write KV block forks (paged KV cache)"),
             ]
             evictions = dict(self.evictions)
             slot_count = self.slot_count
+            kv_total = self.kv_blocks_total
+            kv_free = self.kv_blocks_free
         for metric, value, help_ in gen_counters:
             emit(metric, value, help_, mtype="counter")
+        emit("kv_blocks_total", kv_total,
+             "allocatable KV blocks in the paged pool (0 = slab layout)")
+        emit("kv_blocks_free", kv_free, "free KV blocks in the paged pool")
+        emit("kv_blocks_used", kv_total - kv_free,
+             "KV blocks held by slot chains / the prefix index")
+        emit("kv_block_utilization",
+             f"{((kv_total - kv_free) / kv_total if kv_total else 0.0):.6f}",
+             "fraction of the paged KV pool in use")
         lines.append(f"# HELP {n}_slot_evictions_total decode slots "
                      "evicted, by reason")
         lines.append(f"# TYPE {n}_slot_evictions_total counter")

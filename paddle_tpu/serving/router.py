@@ -1,5 +1,5 @@
 """Health-checked request router over serving replicas
-(docs/serving.md §6).
+(docs/serving.md §7).
 
 The fleet supervisor (serving/fleet.py) keeps N replica processes
 alive; this module is the front door that keeps one sick replica from
@@ -794,7 +794,7 @@ class RouterHandler(BaseHTTPRequestHandler):
             # compute a continuation's remaining budget.  This reads the
             # ROUTER process's flags — bit-identical failover for
             # requests that omit max_tokens requires the replicas to run
-            # with the same serving_gen_max_tokens (docs/serving.md §6
+            # with the same serving_gen_max_tokens (docs/serving.md §7
             # "Config parity caveat")
             from paddle_tpu.utils.flags import FLAGS
             eff_max = FLAGS.serving_gen_max_tokens
@@ -1173,7 +1173,7 @@ def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.serving.router",
         description="health-checked router over serving replicas "
-                    "(docs/serving.md §6)")
+                    "(docs/serving.md §7)")
     ap.add_argument("--replicas", type=int, default=FLAGS.fleet_replicas,
                     help="spawn a managed fleet of N demo-generate "
                          "replicas (serving/fleet.py)")

@@ -13,7 +13,7 @@ Endpoints:
                    overload 429, shutdown/breaker 503, deadline 504,
                    batch failure 500 — always a JSON body with "error";
                    429/503 carry a Retry-After header (breaker- and
-                   queue-depth-derived; docs/serving.md §5).
+                   queue-depth-derived; docs/serving.md §6).
   POST /v1/generate {"prompt": [ids], "max_tokens": N, "eos_id": opt,
                     "deadline_ms": opt, "stream": false}
                    -> {"tokens": [...], "finish_reason": "eos"|"length",
@@ -46,6 +46,14 @@ CLI (``python -m paddle_tpu.serving``):
                                    staggered /v1/generate requests,
                                    streaming, EOS early-finish, ONE JSON
                                    line (healthy_window.sh phase 8)
+  --kv-layout slab|paged           decode KV-cache layout (paged = block
+                                   pool + prefix sharing, kv_pool.py)
+  --kv-block-size --kv-num-blocks --kv-prefix-cache
+  --smoke-paged                    paged-KV self-test: shared-system-
+                                   prompt clients, prefix hits + CoW
+                                   fork, streams bit-identical to the
+                                   slab twin, ONE JSON line
+                                   (healthy_window.sh phase 11)
 
 The JSON front-end serves plain-array feed slots (dense/index vectors);
 structured SequenceBatch slots are an in-process engine feature.
@@ -165,7 +173,7 @@ class ServingHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         # one server serves an inference batcher, a generation batcher,
         # or both; health/metrics report whichever exists.  Liveness vs
-        # readiness (docs/serving.md §5): /healthz answers "is the
+        # readiness (docs/serving.md §6): /healthz answers "is the
         # process alive" — 200 as long as we can answer at all, so an
         # orchestrator never kills a node that is merely draining or
         # warming; /readyz answers "should a balancer route here" — 503
@@ -339,7 +347,7 @@ class ServingHandler(BaseHTTPRequestHandler):
             replay = req.get("replay")
             if replay is not None:
                 # mid-stream continuation (a router failing over off a
-                # dead replica, docs/serving.md §6): these tokens were
+                # dead replica, docs/serving.md §7): these tokens were
                 # already delivered — teacher-forced, never re-emitted
                 if not isinstance(replay, list) or not replay \
                         or not all(isinstance(t, int) for t in replay):
@@ -493,7 +501,11 @@ def _demo_gen_batcher(args, tiny=False, metrics=None):
                               max_len=max_len)
     engine = DecodeEngine(params, num_heads=2, num_slots=slots,
                           max_len=max_len, prefill_buckets=buckets,
-                          name="demo_lm", metrics=metrics)
+                          name="demo_lm", metrics=metrics,
+                          kv_layout=args.kv_layout,
+                          kv_block_size=args.kv_block_size,
+                          kv_num_blocks=args.kv_num_blocks,
+                          prefix_cache=args.kv_prefix_cache)
     # supervision on by default for the generation plane: the breaker
     # and recovery are pure host bookkeeping (zero cost absent failures);
     # the step watchdog only arms when a deadline is configured
@@ -722,6 +734,140 @@ def _smoke_generate(gen, n_requests=6):
     return 0 if passed else 2
 
 
+def _smoke_paged(args):
+    """Paged-KV-cache self-test (healthy_window.sh phase 11; docs/
+    serving.md §5): serve the demo LM with ``kv_layout="paged"`` on an
+    ephemeral port and drive the prefix-sharing scenario — one client
+    establishes a long system-prompt context (prefix-cache miss, chains
+    registered), then two clients sharing that system prompt (one the
+    EXACT prompt — its seat lands inside the shared tail block and must
+    copy-on-write fork it — one with a divergent question) admit by
+    reference.  Every stream must be bit-identical to the SAME prompts
+    served through a slab-layout twin engine (greedy decode — one
+    compiled trunk, two memory layouts, same tokens), /metrics must
+    show the hits, the fork, and the block-pool gauges.  Prints ONE
+    JSON line; returns the process exit code."""
+    import copy
+    import urllib.request
+
+    paged_args = copy.copy(args)
+    paged_args.kv_layout = "paged"
+    paged_args.kv_block_size = min(args.kv_block_size, 8)
+    gen = _demo_gen_batcher(paged_args, tiny=True)
+    slab_args = copy.copy(args)
+    slab_args.kv_layout = "slab"
+    slab = _demo_gen_batcher(slab_args, tiny=True)
+
+    httpd = make_server(None, port=0, gen_batcher=gen)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.port}"
+    bs = gen.engine.block_size
+    rng = np.random.RandomState(0)
+    # system prompt spanning one full block + a partial tail; questions
+    # keep the total inside the tiny prefill ladder (top bucket 16)
+    sys_prompt = rng.randint(1, 256, bs + bs // 2).tolist()
+    qa = rng.randint(1, 256, 4).tolist()
+    qb = rng.randint(1, 256, 4).tolist()
+    prompts = [sys_prompt + qa,         # leader: miss, registers chains
+               sys_prompt + qa,         # exact dup: hit + CoW fork
+               sys_prompt + qb]         # divergent: shared-prefix hit
+    n_tok = 8
+    errs = []
+
+    def post(body):
+        req = urllib.request.Request(
+            f"{base}/v1/generate", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, r.read()
+
+    def generate(i, stream):
+        try:
+            if stream:
+                _, raw = post({"prompt": prompts[i], "max_tokens": n_tok,
+                               "stream": True})
+                lines = [json.loads(ln) for ln in raw.decode().splitlines()
+                         if ln]
+                done = [ln for ln in lines if ln.get("done")]
+                toks = [ln["token"] for ln in lines if "token" in ln]
+                if not done or done[0]["tokens"] != toks:
+                    errs.append(f"client {i}: stream/done mismatch")
+                    return None
+                return toks
+            status, raw = post({"prompt": prompts[i],
+                                "max_tokens": n_tok})
+            resp = json.loads(raw)
+            if status != 200 or resp["finish_reason"] != "length":
+                errs.append(f"client {i}: {status} {resp}")
+                return None
+            return resp["tokens"]
+        except Exception as e:    # noqa: BLE001 — a probe failure must
+            # become a False flag in the ONE JSON line, never a traceback
+            errs.append(f"client {i}: {type(e).__name__}: {e}")
+            return None
+
+    results = [None] * len(prompts)
+    results[0] = generate(0, stream=False)      # leader registers first
+    follower_threads = [
+        threading.Thread(target=lambda i=i: results.__setitem__(
+            i, generate(i, stream=i == 1)))
+        for i in range(1, len(prompts))]
+    for t in follower_threads:
+        t.start()
+    for t in follower_threads:
+        t.join(120)
+    ok = sum(1 for r in results if r is not None)
+
+    # the slab twin serves the same prompts; greedy decode must agree
+    # token for token across the two memory layouts
+    bit_identical = False
+    try:
+        ref = [slab.submit(np.asarray(p, np.int64),
+                           max_tokens=n_tok).result(120)["tokens"]
+               for p in prompts]
+        bit_identical = all(r is not None and r == e
+                            for r, e in zip(results, ref))
+    except Exception as e:    # noqa: BLE001
+        errs.append(f"slab twin: {type(e).__name__}: {e}")
+
+    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+        metrics_text = r.read().decode()
+    snap = gen.metrics.snapshot()
+    name = gen.metrics.name
+    metrics_sane = (
+        f"{name}_prefix_cache_hits_total "
+        f"{snap['prefix_cache_hits_total']}" in metrics_text
+        and f"{name}_cow_forks_total {snap['cow_forks_total']}"
+        in metrics_text
+        and f"{name}_kv_blocks_total {snap['kv_blocks_total']}"
+        in metrics_text
+        and snap["kv_blocks_total"] > 0)
+    out = {
+        "metric": "paged KV serving smoke (prefix sharing + CoW + HTTP)",
+        "value": ok, "unit": f"requests_ok/{len(prompts)}",
+        "vs_baseline": None,
+        "bit_identical": bool(bit_identical),
+        "prefix_cache_hits": snap["prefix_cache_hits_total"],
+        "prefix_cache_misses": snap["prefix_cache_misses_total"],
+        "cow_forks": snap["cow_forks_total"],
+        "kv_blocks_total": snap["kv_blocks_total"],
+        "kv_blocks_used": snap["kv_blocks_used"],
+        "pool_exhausted_evictions": snap["evictions"]["pool_exhausted"],
+        "prefill_positions": gen.engine.prefill_positions_total,
+        "metrics_sane": bool(metrics_sane),
+    }
+    if errs:
+        out["errors"] = errs[:5]
+    httpd.shutdown()
+    gen.close()
+    slab.close()
+    print(json.dumps(out), flush=True)
+    passed = (ok == len(prompts) and bit_identical and metrics_sane
+              and snap["prefix_cache_hits_total"] >= 2
+              and snap["cow_forks_total"] >= 1)
+    return 0 if passed else 2
+
+
 def _write_port_file(path, port):
     """Publish the BOUND port (meaningful with --port 0) atomically —
     the fleet supervisor (serving/fleet.py) spawns replicas on ephemeral
@@ -756,6 +902,21 @@ def main(argv=None):
                     default=FLAGS.serving_gen_prefill_buckets)
     ap.add_argument("--gen-max-tokens", type=int,
                     default=FLAGS.serving_gen_max_tokens)
+    # ---- paged KV cache (serving/kv_pool.py; docs/serving.md §5) ----
+    ap.add_argument("--kv-layout", default=FLAGS.serving_kv_layout,
+                    choices=("slab", "paged"),
+                    help="decode KV-cache layout: slab reserves max_len "
+                         "per slot; paged packs a shared block pool with "
+                         "prefix sharing")
+    ap.add_argument("--kv-block-size", type=int,
+                    default=FLAGS.serving_kv_block_size)
+    ap.add_argument("--kv-num-blocks", type=int,
+                    default=FLAGS.serving_kv_num_blocks,
+                    help="paged pool size incl. the scratch block "
+                         "(0 = the slab-equivalent byte budget)")
+    ap.add_argument("--kv-prefix-cache",
+                    type=lambda v: v.lower() in ("1", "true", "yes"),
+                    default=FLAGS.serving_kv_prefix_cache)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=FLAGS.serving_port)
     ap.add_argument("--port-file",
@@ -776,7 +937,12 @@ def main(argv=None):
     ap.add_argument("--smoke-generate", action="store_true",
                     help="generation self-test on an ephemeral port, "
                          "print one JSON line, exit")
-    # ---- resilience (docs/serving.md §5) ----
+    ap.add_argument("--smoke-paged", action="store_true",
+                    help="paged-KV self-test: shared-system-prompt "
+                         "clients over kv_layout=paged, prefix hits + "
+                         "CoW fork recorded, streams bit-identical to "
+                         "the slab layout; one JSON line, exit")
+    # ---- resilience (docs/serving.md §6) ----
     ap.add_argument("--drain-timeout-s", type=float,
                     default=FLAGS.serving_drain_timeout_s,
                     help="hard deadline for the SIGTERM graceful drain")
@@ -805,6 +971,8 @@ def main(argv=None):
 
     if args.smoke_generate:
         return _smoke_generate(_demo_gen_batcher(args, tiny=True))
+    if args.smoke_paged:
+        return _smoke_paged(args)
     if args.demo_generate and not (args.artifact or args.artifacts
                                    or args.demo):
         # generation-only server: no /v1/infer batcher

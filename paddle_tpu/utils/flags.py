@@ -97,8 +97,16 @@ class Flags:
     serving_gen_max_len: int = 256      # KV slab length (prompt + output)
     serving_gen_prefill_buckets: str = "32,64"  # prompt-length ladder
     serving_gen_max_tokens: int = 64    # default per-request emission cap
+    # ---- paged KV cache (serving/kv_pool.py: block-pool allocator +
+    # copy-on-write prefix sharing; docs/serving.md §5)
+    serving_kv_layout: str = "slab"     # "slab" | "paged"
+    serving_kv_block_size: int = 16     # KV positions per paged block
+    serving_kv_num_blocks: int = 0      # pool size incl. scratch block
+    #                                     (0 = slab-equivalent bytes)
+    serving_kv_prefix_cache: bool = True  # share resident prompt-prefix
+    #                                       blocks across requests
     # ---- replicated serving tier (serving/fleet.py supervisor +
-    # serving/router.py health-checked router; docs/serving.md §6)
+    # serving/router.py health-checked router; docs/serving.md §7)
     router_port: int = 8000             # HTTP port for the router CLI
     router_poll_interval_s: float = 0.25  # /readyz + /metrics poll cadence
     router_unready_grace_s: float = 2.0  # on an all-unready pick miss,
@@ -120,7 +128,7 @@ class Flags:
     #                                     trip the restart-storm breaker
     fleet_storm_window_s: float = 30.0  # the restart-storm window
     # ---- resilience (resilience/: deterministic fault injection +
-    # supervised recovery; docs/serving.md §5)
+    # supervised recovery; docs/serving.md §6)
     serving_drain_timeout_s: float = 30.0  # SIGTERM drain hard deadline
     resilience_fault_spec: str = ""     # chaos-only fault plan, e.g.
     #                                     "serving.decode_step:at=5"
@@ -282,6 +290,17 @@ FLAG_DOCS = {
                                     "—"),
     "serving_gen_max_tokens": ("default per-request emission cap for "
                                "/v1/generate", "—"),
+    "serving_kv_layout": ("decode KV-cache layout: slab (max_len "
+                          "reserved per slot) or paged (block pool + "
+                          "per-slot block tables, prefix sharing)", "—"),
+    "serving_kv_block_size": ("KV positions per paged block", "—"),
+    "serving_kv_num_blocks": ("paged pool size incl. the reserved "
+                              "scratch block (0 = auto: the slab-"
+                              "equivalent slots*ceil(max_len/block_size)"
+                              "+1)", "—"),
+    "serving_kv_prefix_cache": ("share resident prompt-prefix blocks "
+                                "across requests (copy-on-write on "
+                                "divergence)", "—"),
     "router_port": ("HTTP port for python -m paddle_tpu.serving.router",
                     "—"),
     "router_poll_interval_s": ("how often the router polls each "

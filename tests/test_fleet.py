@@ -1,5 +1,5 @@
 """Replicated serving tier (serving/fleet.py + serving/router.py): the
-fleet-scope chaos matrix (docs/serving.md §6).
+fleet-scope chaos matrix (docs/serving.md §7).
 
 In-process half: the router's policies against scripted stub replicas
 (readiness gating, least-loaded dispatch, outlier ejection + half-open

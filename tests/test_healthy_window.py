@@ -47,7 +47,7 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "perf_report.md", "analytic.json",
                  "analytic_snapshot.json", "serving_smoke.json",
                  "serving_gen_smoke.json", "chaos_smoke.json",
-                 "fleet_smoke.json", "WINDOW_DONE"):
+                 "fleet_smoke.json", "paged_smoke.json", "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -105,6 +105,16 @@ def test_dryrun_executes_every_phase(tmp_path):
     assert fleet["midstream_failovers"] >= 1, fleet
     assert fleet["restarted_ready"] is True, fleet
     assert fleet["victim_restarts"] >= 1, fleet
+    # the paged smoke really shared: the exact-duplicate and divergent
+    # clients hit the leader's prefix chains, the duplicate's seat
+    # copy-on-write forked the shared tail block, and every stream came
+    # back bit-identical to the slab-layout twin
+    paged = json.loads((art / "paged_smoke.json").read_text())
+    assert paged["value"] == int(paged["unit"].split("/")[1]), paged
+    assert paged["bit_identical"] is True, paged
+    assert paged["prefix_cache_hits"] >= 2, paged
+    assert paged["cow_forks"] >= 1, paged
+    assert paged["metrics_sane"] is True, paged
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows
