@@ -64,6 +64,7 @@ def _fill(source, convert, place, stop, q):
     RUNNING Thread strongly references its target, so a method target
     would keep the prefetcher alive and its GC finalizer from ever
     firing."""
+    from paddle_tpu.obs import trace as obstrace
     from paddle_tpu.resilience import faults
     try:
         for batch in source():
@@ -74,7 +75,11 @@ def _fill(source, convert, place, stop, q):
             # injected failure crosses to the consumer like any real
             # placement error — surfaced at its next __next__
             faults.hit("data.prefetch.h2d")
-            feed = place(feed)
+            # tracing (obs/trace.py): the producer-side H2D transfer as
+            # a span, so a Chrome trace shows whether the pipeline hides
+            # it behind the train steps; strict no-op when disabled
+            with obstrace.span("data.h2d", root=False):
+                feed = place(feed)
             if not _bounded_put(q, stop, feed):
                 return
             # the queue now holds the ONLY producer-side reference: once

@@ -1,0 +1,22 @@
+"""Host-side observability: end-to-end request tracing (obs/trace.py).
+
+The third pillar next to aggregate metrics (serving/metrics.py,
+``/metrics``) and device profiling (utils/profiler.py): per-request
+spans, propagated across the router/replica fleet, exported as Chrome
+trace-event JSON.  See docs/observability.md.
+"""
+
+from paddle_tpu.obs.trace import (NULL, Span, Tracer, chrome_trace,
+                                  current, current_trace_id,
+                                  debug_payload, disable,
+                                  dump_chrome_trace, enable, enabled,
+                                  extract, get_tracer, inject, instant,
+                                  set_process, slowest, snapshot, span,
+                                  start_span)
+
+__all__ = [
+    "NULL", "Span", "Tracer", "chrome_trace", "current",
+    "current_trace_id", "debug_payload", "disable", "dump_chrome_trace",
+    "enable", "enabled", "extract", "get_tracer", "inject", "instant",
+    "set_process", "slowest", "snapshot", "span", "start_span",
+]

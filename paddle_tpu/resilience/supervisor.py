@@ -46,6 +46,7 @@ import random
 import threading
 import time
 
+from paddle_tpu.obs import trace as obstrace
 from paddle_tpu.resilience.faults import TransientError
 from paddle_tpu.utils.logging import logger
 
@@ -258,6 +259,8 @@ class Supervisor:
             self.watchdog_trips += 1
             inq.put(None)       # exit once the hung step unwedges
             self._worker = None
+            obstrace.instant("supervisor.watchdog_trip",
+                             deadline_s=self.step_deadline_s)
             logger.warning("watchdog: decode step exceeded %.3fs deadline; "
                            "abandoning it and rebuilding",
                            self.step_deadline_s)
@@ -299,7 +302,9 @@ class Supervisor:
         continuation-``replay`` leg, paged prefix-cache admission, and
         pool-pressure re-seating (serving/kv_pool.py)."""
         import numpy as np
-        return engine.seat_prefilled(
-            [np.concatenate([np.asarray(prompt, np.int32),
-                             np.asarray(tokens, np.int32)])
-             for prompt, tokens in items])
+        with obstrace.span("supervisor.reprefill", root=False,
+                           n=len(items)):
+            return engine.seat_prefilled(
+                [np.concatenate([np.asarray(prompt, np.int32),
+                                 np.asarray(tokens, np.int32)])
+                 for prompt, tokens in items])

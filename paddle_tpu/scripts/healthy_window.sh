@@ -42,6 +42,11 @@
 #                                        prompt + one divergent -> prefix
 #                                        hits + CoW fork recorded, streams
 #                                        bit-identical to the slab twin)
+# 12. trace smoke                       (end-to-end request tracing: 2
+#                                        traced replicas behind the router,
+#                                        kill -9 one mid-stream -> a single
+#                                        trace_id stitches router + both
+#                                        replicas; Chrome dump parses)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -233,6 +238,18 @@ log "phase 11: paged KV smoke (block pool + prefix sharing + CoW)"
 timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-paged \
     > "$ART/paged_smoke.json" 2> "$ART/paged_smoke.log"
 log "paged smoke rc=$? -> $ART/paged_smoke.json"
+
+log "phase 12: trace smoke (end-to-end request tracing across the fleet)"
+# 2 tracing-enabled replicas behind the router, concurrent paced streams,
+# kill -9 one replica mid-stream: ONE trace_id must stitch router -> the
+# dead replica (pre-kill /debug/traces snapshot) -> the continuation on
+# the survivor, and the merged Chrome trace-event dump must parse with
+# all three process names — one JSON line
+# (python -m paddle_tpu.obs --smoke; docs/observability.md)
+timeout "$T_SERVE" python -m paddle_tpu.obs --smoke \
+    --chrome-out "$ART/trace_chrome.json" \
+    > "$ART/trace_smoke.json" 2> "$ART/trace_smoke.log"
+log "trace smoke rc=$? -> $ART/trace_smoke.json"
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

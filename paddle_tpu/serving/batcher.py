@@ -32,6 +32,7 @@ from concurrent.futures import Future, InvalidStateError
 import jax
 import numpy as np
 
+from paddle_tpu.obs import trace as obstrace
 from paddle_tpu.resilience import faults
 from paddle_tpu.serving.engine import InvalidRequestError, _np_leaf
 from paddle_tpu.utils.logging import logger
@@ -56,17 +57,24 @@ class BatchExecutionError(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("feed", "future", "deadline", "t_submit")
+    __slots__ = ("feed", "future", "deadline", "t_submit", "queue_span")
 
     def __init__(self, feed, deadline):
         self.feed = feed
         self.future = Future()
         self.deadline = deadline          # absolute perf_counter() or None
         self.t_submit = time.perf_counter()
+        # async-seam span (obs/trace.py): submit() starts it AFTER the
+        # request is actually enqueued (a rejected submit must not leak
+        # a forever-active span); the worker ends it at batch pickup —
+        # the queue wait made visible
+        self.queue_span = obstrace.NULL
 
     def fail(self, exc):
         """Resolve with an exception, tolerating a client-side cancel that
         raced us — an InvalidStateError here must never kill the worker."""
+        self.queue_span.end()       # idempotent; a request failed while
+        #                             still queued must not leak its span
         try:
             self.future.set_exception(exc)
         except InvalidStateError:
@@ -136,14 +144,23 @@ class Batcher:
                 else self.default_deadline_s)
         req = _Request(feed_row,
                        time.perf_counter() + dl_s if dl_s else None)
+        # start the queue-wait span before the enqueue (the worker may
+        # pull the request the instant it lands); the rejection paths
+        # below end it so a refused submit leaks nothing
+        # root=False: driven without an HTTP request span (bench drives,
+        # embedded use) this must not mint a "request" for slowest()
+        req.queue_span = obstrace.start_span("batcher.queue_wait",
+                                             root=False)
         with self._admit_lock:
             if self._closed.is_set():   # close() raced the check above
+                req.queue_span.end()
                 self.metrics.reject("shutdown")
                 raise ShutdownError(
                     f"{self.name} is draining; submit rejected")
             try:
                 self._q.put_nowait(req)
             except queue.Full:
+                req.queue_span.end()
                 self.metrics.reject("overload")
                 raise OverloadedError(
                     f"{self.name}: queue full ({self._q.maxsize} waiting)") \
@@ -185,6 +202,7 @@ class Batcher:
         now = time.perf_counter()
         live = []
         for r in batch:
+            r.queue_span.end(batch_size=len(batch))
             if r.deadline is not None and now > r.deadline:
                 self.metrics.reject("deadline")
                 r.fail(DeadlineExceededError(
@@ -203,7 +221,11 @@ class Batcher:
             stacked = jax.tree_util.tree_map(
                 lambda *ls: np.stack([_np_leaf(l) for l in ls], axis=0),
                 *[r.feed for r in live])
-            out = self.engine.infer(stacked)    # host numpy leaves
+            # batch-assembly span: one per executed batch (the worker
+            # thread has no request context; root=False keeps it out of
+            # the slowest-requests table)
+            with obstrace.span("batcher.batch", root=False, n=len(live)):
+                out = self.engine.infer(stacked)    # host numpy leaves
         except Exception as e:    # noqa: BLE001 — isolate to THIS batch
             logger.warning("%s: batch of %d failed: %s: %s", self.name,
                            len(live), type(e).__name__, e)

@@ -63,6 +63,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.obs import trace as obstrace
 from paddle_tpu.resilience import faults
 from paddle_tpu.resilience.supervisor import (BreakerOpenError,
                                               WatchdogTimeout)
@@ -492,7 +493,11 @@ class DecodeEngine:
                 prompts[j, :pre] = full[:pre]
                 lengths[j] = pre
             try:
-                _first, rows = self.prefill(prompts, lengths)
+                # reconstruction prefill (recovery / continuation /
+                # pool re-seat): one standalone span per bucket batch
+                with obstrace.span("gen.prefill", root=False,
+                                   bucket=int(bucket), n=len(items)):
+                    _first, rows = self.prefill(prompts, lengths)
             except Exception as e:      # noqa: BLE001 — crosses to the
                 for i, _full, _pre in items:    # caller per item
                     results[i] = e
@@ -562,6 +567,7 @@ class DecodeEngine:
                     if v is None:
                         raise     # one lone request outgrew the pool —
                         #           validate_request bounds this; backstop
+                    obstrace.instant("kv.pool_exhausted_preempt", slot=v)
                     self.evict(v, "pool_exhausted")
                     victims.append(v)
                     continue
@@ -570,6 +576,8 @@ class DecodeEngine:
                 _tag, _j, src, dst = plan
                 self._cache = self._jit_copy(self._cache, np.int32(src),
                                              np.int32(dst))
+                obstrace.instant("kv.cow_fork", slot=slot, src=int(src),
+                                 dst=int(dst))
                 self.metrics.observe_cow_fork()
         return victims
 
@@ -821,10 +829,22 @@ class _GenRequest:
     __slots__ = ("prompt", "max_tokens", "eos_id", "future", "deadline",
                  "t_submit", "t_first", "on_token", "tokens", "slot",
                  "abandoned", "recoveries", "replay_feed", "replay_ctx",
-                 "started", "admit_covered", "prefix_counted")
+                 "started", "admit_covered", "prefix_counted",
+                 "trace_ctx", "queue_span", "slot_span")
 
     def __init__(self, prompt, max_tokens, eos_id, deadline, on_token,
                  replay_ctx=None):
+        # tracing (obs/trace.py): the submitting thread's context (the
+        # HTTP handler's request span) is captured HERE because the
+        # worker thread that seats and decodes this request has no
+        # ambient context of its own.  submit() starts queue_span only
+        # once the request is actually enqueued (a rejected submit must
+        # not leak a forever-active span); it ends at admission pickup.
+        # slot_span is the request's slot-LIFETIME span (seat ->
+        # eviction, carrying TTFT/recovery/preemption events).
+        self.trace_ctx = obstrace.current()
+        self.queue_span = obstrace.NULL
+        self.slot_span = obstrace.NULL
         self.abandoned = False
         self.recoveries = 0
         self.started = False      # future marked running (a request can
@@ -863,6 +883,12 @@ class _GenRequest:
         return np.concatenate([self.prompt, self.replay_ctx])
 
     def fail(self, exc):
+        # end both trace spans (idempotent): a request failed while
+        # queued or seated must not leak forever-active spans
+        self.queue_span.end()
+        self.slot_span.end(reason="failed",
+                           error=type(exc).__name__,
+                           tokens=len(self.tokens))
         try:
             self.future.set_exception(exc)
         except InvalidStateError:
@@ -1014,8 +1040,17 @@ class GenerationBatcher:
                           self.engine.eos_id if eos_id is None else eos_id,
                           time.perf_counter() + dl_s if dl_s else None,
                           on_token, replay_ctx=replay)
+        # start the queue-wait span before the enqueue (the worker may
+        # pull the request the instant it lands); the rejection paths
+        # below end it so a refused submit leaks nothing
+        # root=False: a direct (non-HTTP) submit has no request span,
+        # and infrastructure spans must not pollute slowest()
+        req.queue_span = obstrace.start_span("gen.queue_wait",
+                                             ctx=req.trace_ctx,
+                                             root=False)
         with self._admit_lock:
             if self._closed.is_set():   # close() raced the check above
+                req.queue_span.end()
                 self.metrics.reject("shutdown")
                 if self.supervisor is not None:     # the request never
                     self.supervisor.breaker.release_probe()   # ran: hand
@@ -1025,6 +1060,7 @@ class GenerationBatcher:
             try:
                 self._q.put_nowait(req)
             except queue.Full:
+                req.queue_span.end()
                 self.metrics.reject("overload")
                 if self.supervisor is not None:
                     self.supervisor.breaker.release_probe()
@@ -1061,10 +1097,14 @@ class GenerationBatcher:
         if self._waiting:               # pool-deferred requests go first
             return self._waiting.popleft()
         try:
-            return self._q.get(timeout=0.05) if block else \
+            req = self._q.get(timeout=0.05) if block else \
                 self._q.get_nowait()
         except queue.Empty:
             return None
+        # the queue wait ends at pickup (idempotent: a pool-deferred
+        # request re-enters admission but its wait ended the first time)
+        req.queue_span.end()
+        return req
 
     def _finish(self, req, reason):
         """Evict a slotted request and resolve its future."""
@@ -1081,6 +1121,11 @@ class GenerationBatcher:
         self._abandoned.discard(req.future)     # a late abandon() of a
         #                                         finished future is inert
         ttft = (req.t_first - req.t_submit) if req.t_first else 0.0
+        # the slot-lifetime span ends with the request, carrying the
+        # eviction reason next to TTFT (NULL no-op for requests that
+        # finished at prefill and never held a slot)
+        req.slot_span.end(reason=reason, tokens=len(req.tokens),
+                          ttft_ms=round(ttft * 1e3, 3))
         self.metrics.observe_response(time.perf_counter() - req.t_submit)
         try:
             req.future.set_result({
@@ -1196,7 +1241,13 @@ class GenerationBatcher:
                 prompts[i, :req.prompt.size] = req.prompt
                 lengths[i] = req.prompt.size
             try:
-                first, rows = self.engine.prefill(prompts, lengths)
+                # one span per admission prefill batch, parented to the
+                # FIRST rider's trace (a batch serves several requests;
+                # co-riders see the bucket on their slot span instead)
+                with obstrace.span("gen.prefill", ctx=reqs[0].trace_ctx,
+                                   root=False, bucket=int(bucket),
+                                   n=len(reqs)):
+                    first, rows = self.engine.prefill(prompts, lengths)
             except Exception as e:    # noqa: BLE001 — isolate to THIS group
                 logger.warning("%s: prefill of %d failed: %s: %s",
                                self.name, len(reqs), type(e).__name__, e)
@@ -1238,6 +1289,10 @@ class GenerationBatcher:
                             e, extra=[req] + reqs[i + 1:])
                         break
                     self._by_slot[req.slot] = req
+                    req.slot_span = obstrace.start_span(
+                        "slot", ctx=req.trace_ctx, root=False,
+                        slot=int(req.slot), mode="prefill",
+                        bucket=int(bucket))
 
     def _seat_reconstructed(self, reqs):
         """Seat requests whose context must be rebuilt without
@@ -1264,6 +1319,12 @@ class GenerationBatcher:
             else:
                 req.slot, req.replay_feed = out
                 self._by_slot[req.slot] = req
+                req.slot_span = obstrace.start_span(
+                    "slot", ctx=req.trace_ctx, root=False,
+                    slot=int(req.slot),
+                    mode=("continuation" if req.replay_ctx is not None
+                          else "prefix_hit"),
+                    teacher_forced=len(req.replay_feed))
         if hard is not None:
             # the failed seat was a device op (prefill / admit /
             # seat_cached) that may have consumed the donated cache —
@@ -1307,6 +1368,15 @@ class GenerationBatcher:
             else:
                 req.slot, req.replay_feed = out
                 self._by_slot[req.slot] = req
+                if req.slot_span is obstrace.NULL:
+                    req.slot_span = obstrace.start_span(
+                        "slot", ctx=req.trace_ctx, root=False,
+                        slot=int(req.slot), mode="reseat",
+                        teacher_forced=len(req.replay_feed))
+                else:
+                    req.slot_span.event("reseated", slot=int(req.slot),
+                                        teacher_forced=len(
+                                            req.replay_feed))
                 self.metrics.observe_slot_reprefill()
         if hard is not None:
             # same donated-cache safety as _seat_reconstructed: the
@@ -1334,6 +1404,11 @@ class GenerationBatcher:
         logger.warning("%s: supervised step over %d request(s) failed: "
                        "%s: %s — rebuilding slab + re-prefilling",
                        self.name, len(victims), type(e).__name__, e)
+        # the rebuild-and-reprefill window as one span: a recovered
+        # stream's trace shows exactly how long the failure stalled it
+        recover_sp = obstrace.start_span("supervisor.recover",
+                                         root=False, n=len(victims),
+                                         cause=type(e).__name__)
         self.engine.reset()     # bumps the epoch: a hung stale step can
         #                         never commit into the rebuilt slab
         # eviction reasons are counted per OUTCOME below: a victim that
@@ -1358,6 +1433,7 @@ class GenerationBatcher:
                 continue
             recoverable.append(req)
         if not recoverable:
+            recover_sp.end(recovered=0)
             return
         # same-bucket victims re-prefill as ONE engine batch; each
         # result is (slot, replay_feed) or the exception for that victim
@@ -1387,8 +1463,12 @@ class GenerationBatcher:
                 continue
             req.slot, req.replay_feed = out
             self._by_slot[req.slot] = req
+            req.slot_span.event("recovery_reprefill",
+                                slot=int(req.slot),
+                                teacher_forced=len(req.replay_feed))
             self.metrics.evict_slot("recovered")
             self.metrics.observe_slot_reprefill()
+        recover_sp.end(recovered=len(self._by_slot))
 
     def _fail_all_inflight(self, e, extra=()):
         """A device operation (step or slot admission) failed: fail every
@@ -1438,6 +1518,8 @@ class GenerationBatcher:
                 for slot in self.engine.prepare_step():
                     req = self._by_slot.pop(slot)
                     req.slot = None
+                    req.slot_span.event("preempted",
+                                        reason="pool_exhausted")
                     self._preempted.append(req)
                 if not self._by_slot:
                     continue        # everything was preempted
@@ -1488,6 +1570,7 @@ class GenerationBatcher:
                 if first_emit:
                     # a continuation's first NEW token is its TTFT (the
                     # fresh-prompt path records it at prefill instead)
+                    req.slot_span.event("first_token")
                     self.metrics.observe_ttft(req.t_first - req.t_submit)
                 self.metrics.observe_gen_tokens(1)
                 if req.eos_id is not None and tok == req.eos_id:

@@ -45,6 +45,7 @@ import collections
 
 import numpy as np
 
+from paddle_tpu.obs import trace as obstrace
 from paddle_tpu.utils.error import ConfigError
 
 SCRATCH_BLOCK = 0
@@ -296,6 +297,8 @@ class PagedKVState:
                     f"{self.pool.num_free} free")
             chain.append(bid)
         self._install(slot, chain)
+        obstrace.instant("kv.seat", slot=slot, blocks=len(chain),
+                         free=self.pool.num_free)
         return chain
 
     def seat_shared(self, slot, chain, n_positions):
@@ -306,6 +309,8 @@ class PagedKVState:
         take = [self.pool.share(b)
                 for b in chain[:self.blocks_for(n_positions)]]
         self._install(slot, take)
+        obstrace.instant("kv.seat_shared", slot=slot, blocks=len(take),
+                         free=self.pool.num_free)
         return take
 
     def _install(self, slot, chain):

@@ -66,9 +66,10 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlsplit
 
+from paddle_tpu.obs import trace as obstrace
 from paddle_tpu.resilience import faults
 from paddle_tpu.resilience.supervisor import CircuitBreaker
-from paddle_tpu.utils.logging import logger
+from paddle_tpu.utils.logging import log_context, logger
 from paddle_tpu.utils.stats import Histogram
 
 _QUANTILES = (50, 95, 99)
@@ -301,6 +302,7 @@ class Router:
             prev = self._breaker_state.get(rep.rid)
             if st == "open" and prev in (None, "closed", "half_open"):
                 self.metrics._bump(self.metrics.ejections_total, rep.rid)
+                obstrace.instant("router.ejected", replica=rep.rid)
                 logger.warning("%s: replica %s EJECTED (%d consecutive "
                                "dispatch failures); half-open probe in "
                                "%.1fs", self.name, rep.rid,
@@ -309,6 +311,7 @@ class Router:
             elif st == "closed" and prev in ("open", "half_open"):
                 self.metrics._bump(self.metrics.readmissions_total,
                                    rep.rid)
+                obstrace.instant("router.readmitted", replica=rep.rid)
                 logger.info("%s: replica %s readmitted (probe succeeded)",
                             self.name, rep.rid)
             self._breaker_state[rep.rid] = st
@@ -397,26 +400,38 @@ class Router:
     # ------------------------------------------------------------ dispatch
 
     def _dispatch(self, rep, method, path, body=None, timeout=None,
-                  stream=False):
+                  stream=False, ctx=None):
         """One upstream exchange against one replica.  The fault point
         sits HERE — the router->replica network boundary: an injected
         error models a failed dispatch, an injected hang a stalled one
         (both drive the same retry/failover paths a real network fault
         would).  stream=True returns (conn, resp) with the connection
-        left open; the caller owns closing it."""
+        left open; the caller owns closing it.
+
+        Tracing (obs/trace.py): each dispatch is a span (child of the
+        router's request root — or of ``ctx``, for hedge threads that
+        lose the ambient context), and its span id rides to the replica
+        in a ``traceparent`` header, so the replica's ``server.request``
+        span parents HERE and one trace_id stitches the whole hop."""
         self.metrics._bump(self.metrics.dispatch_total, rep.rid)
         faults.hit("router.dispatch")
+        sp = obstrace.start_span("router.dispatch", ctx=ctx,
+                                 replica=rep.rid, path=path)
         conn = http.client.HTTPConnection(
             rep.host, rep.port,
             timeout=timeout if timeout is not None
             else self.request_timeout_s)
         try:
             headers = {"Content-Type": "application/json"} if body else {}
+            if sp.trace_id:
+                obstrace.inject(headers, ctx=(sp.trace_id, sp.span_id))
             conn.request(method, path, body=body, headers=headers)
             resp = conn.getresponse()
-        except Exception:
+        except Exception as e:
+            sp.end(error=f"{type(e).__name__}: {e}")
             conn.close()
             raise
+        sp.end(status=resp.status)
         if stream:
             return conn, resp
         try:
@@ -450,13 +465,13 @@ class Router:
 
     # ------------------------------------------------------------ unary
 
-    def _call(self, rep, path, body):
+    def _call(self, rep, path, body, ctx=None):
         """One accounted unary dispatch: returns (status, headers, data);
         raises on transport failure (breaker charged)."""
         with self._lock:
             rep.inflight += 1
         try:
-            st, hd, data = self._dispatch(rep, "POST", path, body)
+            st, hd, data = self._dispatch(rep, "POST", path, body, ctx=ctx)
         except Exception:
             self._record(rep, ok=False)
             raise
@@ -486,10 +501,14 @@ class Router:
         if delay is None:
             return self._call(rep, path, body)
         results = _queue.Queue()
+        # hedge legs run on fresh threads, which do NOT inherit the
+        # handler's context-local span — hand them the parent explicitly
+        ctx = obstrace.current()
 
         def run(r, tag):
             try:
-                results.put((tag, self._call(r, path, body), None))
+                results.put((tag, self._call(r, path, body, ctx=ctx),
+                             None))
             except Exception as e:    # noqa: BLE001 — crosses threads
                 results.put((tag, None, e))
 
@@ -704,6 +723,9 @@ class Router:
 
 class RouterHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # the request's root span (obs/trace.py); NULL outside do_POST or
+    # with tracing disabled
+    _obs = obstrace.NULL
 
     def log_message(self, fmt, *args):
         logger.debug("router http: " + fmt, *args)
@@ -715,6 +737,8 @@ class RouterHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if self._obs.trace_id:
+            self.send_header("X-Trace-Id", self._obs.trace_id)
         for k, v in (headers or {}).items():
             self.send_header(k, str(v))
         self.end_headers()
@@ -723,6 +747,8 @@ class RouterHandler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------ GET
 
     def do_GET(self):
+        # keep-alive: drop any previous POST's span before replying
+        self._obs = obstrace.NULL
         router = self.server.router
         if self.path == "/healthz":
             self._reply(200, {"status": "ok",
@@ -738,6 +764,8 @@ class RouterHandler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             self._reply(200, router.render_prometheus().encode(),
                         content_type="text/plain; version=0.0.4")
+        elif self.path == "/debug/traces":
+            self._reply(200, obstrace.debug_payload())
         else:
             self._reply(404, {"error": f"no route {self.path!r}"})
 
@@ -748,6 +776,19 @@ class RouterHandler(BaseHTTPRequestHandler):
         return self.rfile.read(length)
 
     def do_POST(self):
+        # the fleet-wide request root: a downstream traceparent (another
+        # tier above us) continues that trace, a direct client starts
+        # one; every dispatch/leg below parents here and forwards the
+        # trace to the replicas.
+        ctx = obstrace.extract(self.headers.get("traceparent"))
+        with obstrace.span("router.request", ctx=ctx, root=True,
+                           route=self.path) as sp, \
+                log_context(trace_id=sp.trace_id,
+                            request_id=sp.span_id):
+            self._obs = sp
+            self._route_post()
+
+    def _route_post(self):
         router = self.server.router
         if self.path == "/v1/infer":
             body = self._read_body()
@@ -814,6 +855,8 @@ class RouterHandler(BaseHTTPRequestHandler):
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
+            if self._obs.trace_id:
+                self.send_header("X-Trace-Id", self._obs.trace_id)
             self.end_headers()
 
         def chunk(obj):
@@ -886,8 +929,12 @@ class RouterHandler(BaseHTTPRequestHandler):
             with router._lock:
                 rep.inflight += 1
             try:
-                outcome = self._proxy_leg(router, rep, leg, delivered,
-                                          send_headers, chunk, finish)
+                # one upstream leg = one span: a failed-over stream shows
+                # leg[replica=r0] then leg[replica=r1] on the same trace
+                with obstrace.span("router.leg", replica=rep.rid,
+                                   attempt=attempts, replay=len(replay)):
+                    outcome = self._proxy_leg(router, rep, leg, delivered,
+                                              send_headers, chunk, finish)
             finally:
                 with router._lock:
                     rep.inflight -= 1
@@ -928,6 +975,8 @@ class RouterHandler(BaseHTTPRequestHandler):
             attempts += 1
             if delivered:
                 m.count("midstream_failovers_total")
+                self._obs.event("midstream_failover", replica=rep.rid,
+                                delivered=len(delivered))
                 logger.warning(
                     "%s: replica %s died mid-stream after %d token(s); "
                     "failing over with a continuation", router.name,
@@ -977,6 +1026,8 @@ class RouterHandler(BaseHTTPRequestHandler):
                     return ("mid", "malformed upstream chunk")
                 if "token" in rec:
                     delivered.append(int(rec["token"]))
+                    if len(delivered) == 1:
+                        self._obs.event("first_token")
                     streamed_here = True
                     m.count("tokens_proxied_total")
                     try:
@@ -1199,6 +1250,16 @@ def main(argv=None):
     ap.add_argument("--fault-spec", default=FLAGS.resilience_fault_spec,
                     help="deterministic fault plan (router.dispatch is "
                          "the router-layer point; chaos testing only)")
+    ap.add_argument("--obs-trace",
+                    type=lambda v: v.lower() in ("1", "true", "yes"),
+                    default=FLAGS.obs_trace_enable,
+                    help="per-request span tracing (obs/trace.py): "
+                         "/debug/traces + traceparent propagation to "
+                         "the replicas")
+    ap.add_argument("--obs-trace-sample", type=float,
+                    default=FLAGS.obs_trace_sample)
+    ap.add_argument("--obs-trace-ring", type=int,
+                    default=FLAGS.obs_trace_ring)
     ap.add_argument("--smoke", action="store_true",
                     help="fleet self-test (2 replicas, kill -9 one "
                          "mid-stream), one JSON line, exit")
@@ -1208,6 +1269,9 @@ def main(argv=None):
     if args.fault_spec:
         faults.install_spec(args.fault_spec)
         logger.warning("fault injection ACTIVE: %s", args.fault_spec)
+    if args.obs_trace:
+        obstrace.enable(sample=args.obs_trace_sample,
+                        capacity=args.obs_trace_ring, process="router")
     sup = None
     if args.backends:
         router = Router(replicas=[u.strip() for u in

@@ -29,6 +29,9 @@ Endpoints:
                    breaker is closed, and no drain has begun; 503 (with
                    the blocking reasons and Retry-After) otherwise
   GET  /metrics    Prometheus text (serving/metrics.py)
+  GET  /debug/traces  recent request spans + slowest-request trace_ids
+                   (obs/trace.py; {"enabled": false} when tracing is
+                   off — enable with --obs-trace; docs/observability.md)
 
 CLI (``python -m paddle_tpu.serving``):
   --artifact model.shlo            one-bucket exported artifact
@@ -73,12 +76,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 import jax
 
+from paddle_tpu.obs import trace as obstrace
 from paddle_tpu.resilience.supervisor import (BreakerOpenError, Supervisor,
                                               retry_transient)
 from paddle_tpu.serving.batcher import (Batcher, DeadlineExceededError,
                                         OverloadedError, ShutdownError)
 from paddle_tpu.serving.engine import InferenceEngine, InvalidRequestError
-from paddle_tpu.utils.logging import logger
+from paddle_tpu.utils.logging import log_context, logger
 
 _STATUS = ((InvalidRequestError, 400), (OverloadedError, 429),
            (BreakerOpenError, 503), (ShutdownError, 503),
@@ -152,6 +156,9 @@ def _to_jsonable(tree):
 class ServingHandler(BaseHTTPRequestHandler):
     # one server == one model; the batcher hangs off the server object
     protocol_version = "HTTP/1.1"
+    # the request's root span (obs/trace.py), set by do_POST; GETs and
+    # disabled tracing leave the NULL singleton (empty trace_id)
+    _obs = obstrace.NULL
 
     def log_message(self, fmt, *args):   # route access logs to our logger
         logger.debug("http: " + fmt, *args)
@@ -163,6 +170,8 @@ class ServingHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if self._obs.trace_id:
+            self.send_header("X-Trace-Id", self._obs.trace_id)
         for k, v in (headers or {}).items():
             self.send_header(k, str(v))
         self.end_headers()
@@ -171,6 +180,9 @@ class ServingHandler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------ GET
 
     def do_GET(self):
+        # keep-alive: one handler instance serves several requests, so
+        # drop any previous POST's span before replying
+        self._obs = obstrace.NULL
         # one server serves an inference batcher, a generation batcher,
         # or both; health/metrics report whichever exists.  Liveness vs
         # readiness (docs/serving.md §6): /healthz answers "is the
@@ -230,6 +242,10 @@ class ServingHandler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             self._reply(200, batcher.metrics.render_prometheus().encode(),
                         content_type="text/plain; version=0.0.4")
+        elif self.path == "/debug/traces":
+            # recent spans + the slowest recent requests' trace_ids
+            # (obs/trace.py; {"enabled": false, ...} when tracing is off)
+            self._reply(200, obstrace.debug_payload())
         else:
             self._reply(404, {"error": f"no route {self.path!r}"})
 
@@ -284,6 +300,21 @@ class ServingHandler(BaseHTTPRequestHandler):
             on_retry=lambda _a, _e: batcher.metrics.observe_retry())
 
     def do_POST(self):
+        # root span for this request (obs/trace.py): a propagated
+        # traceparent (the router's dispatch) CONTINUES that trace — one
+        # trace_id then stitches router, every failover leg, and the
+        # slot timeline; a direct client starts a fresh trace.  The
+        # trace_id doubles as the log correlation id (log_context), is
+        # echoed in the response body and the X-Trace-Id header.
+        ctx = obstrace.extract(self.headers.get("traceparent"))
+        with obstrace.span("server.request", ctx=ctx, root=True,
+                           route=self.path) as sp, \
+                log_context(trace_id=sp.trace_id,
+                            request_id=sp.span_id):
+            self._obs = sp
+            self._route_post()
+
+    def _route_post(self):
         if self.path == "/v1/generate":
             self._post_generate()
             return
@@ -309,10 +340,13 @@ class ServingHandler(BaseHTTPRequestHandler):
             # backstop against a wedged engine, not a policy knob (use
             # deadline_ms for per-request deadlines)
             out = fut.result(timeout=600)
-            self._reply(200, {
+            resp = {
                 "outputs": _to_jsonable(out),
                 "latency_ms": round((time.perf_counter() - t0) * 1e3, 3),
-            })
+            }
+            if self._obs.trace_id:
+                resp["trace_id"] = self._obs.trace_id
+            self._reply(200, resp)
         except Exception as e:    # noqa: BLE001 — every error is a response
             self._error_reply(e, metrics=batcher.metrics)
 
@@ -369,6 +403,9 @@ class ServingHandler(BaseHTTPRequestHandler):
                 gen, lambda: gen.submit(prompt, **kw)).result(timeout=600)
             out = dict(out)
             out["latency_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+            if self._obs.trace_id:
+                out["trace_id"] = self._obs.trace_id
+            self._obs.set(ttft_ms=out.get("ttft_ms"))   # slowest(n) key
             self._reply(200, out)
         except Exception as e:    # noqa: BLE001 — every error is a response
             self._error_reply(e, metrics=gen.metrics)
@@ -391,6 +428,8 @@ class ServingHandler(BaseHTTPRequestHandler):
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
+            if self._obs.trace_id:
+                self.send_header("X-Trace-Id", self._obs.trace_id)
             self.end_headers()
         except Exception as e:    # noqa: BLE001 — peer gone before the
             # status line finished: a second reply would corrupt the
@@ -408,9 +447,13 @@ class ServingHandler(BaseHTTPRequestHandler):
         # the status line is on the wire: from here every failure must
         # terminate the chunk stream, never fall back to a second reply
         try:
+            streamed = 0
             while True:
                 kind, val = events.get(timeout=600)
                 if kind == "token":
+                    if streamed == 0:
+                        self._obs.event("first_token")
+                    streamed += 1
                     chunk({"token": int(val)})
                     continue
                 exc = val.exception()
@@ -421,6 +464,9 @@ class ServingHandler(BaseHTTPRequestHandler):
                     out["done"] = True
                     out["latency_ms"] = round(
                         (time.perf_counter() - t0) * 1e3, 3)
+                    if self._obs.trace_id:
+                        out["trace_id"] = self._obs.trace_id
+                    self._obs.set(ttft_ms=out.get("ttft_ms"))
                     chunk(out)
                 break
             self.wfile.write(b"0\r\n\r\n")
@@ -956,11 +1002,24 @@ def main(argv=None):
     ap.add_argument("--fault-spec", default=FLAGS.resilience_fault_spec,
                     help="deterministic fault-injection spec "
                          "(resilience/faults.py; chaos testing only)")
+    # ---- request tracing (obs/trace.py; docs/observability.md) ----
+    ap.add_argument("--obs-trace",
+                    type=lambda v: v.lower() in ("1", "true", "yes"),
+                    default=FLAGS.obs_trace_enable,
+                    help="per-request span tracing: /debug/traces + "
+                         "trace_id propagation/echo")
+    ap.add_argument("--obs-trace-sample", type=float,
+                    default=FLAGS.obs_trace_sample)
+    ap.add_argument("--obs-trace-ring", type=int,
+                    default=FLAGS.obs_trace_ring)
     args = ap.parse_args(argv)
     if args.fault_spec:
         from paddle_tpu.resilience import faults
         faults.install_spec(args.fault_spec)
         logger.warning("fault injection ACTIVE: %s", args.fault_spec)
+    if args.obs_trace:
+        obstrace.enable(sample=args.obs_trace_sample,
+                        capacity=args.obs_trace_ring)
     if args.smoke and not (args.artifact or args.artifacts):
         args.demo = True
     if args.smoke:
@@ -979,6 +1038,9 @@ def main(argv=None):
         gen_batcher = _demo_gen_batcher(args)
         httpd = make_server(None, args.host, args.port,
                             gen_batcher=gen_batcher)
+        # the bound port is the replica's identity in a merged fleet
+        # Chrome trace (processes = router/replicas)
+        obstrace.set_process(f"replica:{httpd.port}")
         if args.port_file:
             _write_port_file(args.port_file, httpd.port)
         logger.info("serving %s on http://%s:%d (/v1/generate: %d slots, "
@@ -1002,6 +1064,7 @@ def main(argv=None):
                    if args.demo_generate else None)
     httpd = make_server(batcher, args.host, args.port,
                         gen_batcher=gen_batcher)
+    obstrace.set_process(f"replica:{httpd.port}")
     if args.port_file:
         _write_port_file(args.port_file, httpd.port)
     logger.info("serving %s on http://%s:%d (buckets %s, max_delay %.1fms, "

@@ -131,13 +131,20 @@ def save_checkpoint(save_dir, pass_id, params, opt_state=None, model_state=None,
     meta.update(extra or {})
 
     def write():
-        os.makedirs(save_dir, exist_ok=True)
-        tmp = tempfile.mkdtemp(prefix=f".tmp-pass-{pass_id:05d}-",
-                               dir=save_dir)
-        # mkdtemp makes 0700; inherit the parent's perms so renamed pass
-        # dirs stay readable by whatever can read save_dir (as makedirs
-        # used to give)
-        os.chmod(tmp, os.stat(save_dir).st_mode & 0o777)
+        from paddle_tpu.obs import trace as _obstrace
+        _ckpt_span = _obstrace.start_span("trainer.checkpoint.write",
+                                          root=False, pass_id=pass_id)
+        try:
+            os.makedirs(save_dir, exist_ok=True)
+            tmp = tempfile.mkdtemp(prefix=f".tmp-pass-{pass_id:05d}-",
+                                   dir=save_dir)
+            # mkdtemp makes 0700; inherit the parent's perms so renamed
+            # pass dirs stay readable by whatever can read save_dir (as
+            # makedirs used to give)
+            os.chmod(tmp, os.stat(save_dir).st_mode & 0o777)
+        except BaseException as e:  # unwritable/full save_dir: the span
+            _ckpt_span.end(error=f"{type(e).__name__}: {e}")  # must not
+            raise                                       # leak as active
         try:
             np.savez(os.path.join(tmp, "params.npz"), **_flatten(host_params))
             # chaos hook MID-WRITE (resilience/faults.py): arrays are on
@@ -170,9 +177,11 @@ def save_checkpoint(save_dir, pass_id, params, opt_state=None, model_state=None,
             os.rename(tmp, final)
             if old is not None:
                 shutil.rmtree(old, ignore_errors=True)
-        except BaseException:
+        except BaseException as e:
             shutil.rmtree(tmp, ignore_errors=True)
+            _ckpt_span.end(error=f"{type(e).__name__}: {e}")
             raise
+        _ckpt_span.end(path=final)
         if save_only_one:
             for name in os.listdir(save_dir):
                 if (name.startswith("pass-")

@@ -20,6 +20,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.obs import trace as _obstrace
 from paddle_tpu.core.sequence import (NestedSequenceBatch,
                                       SequenceBatch)
 from paddle_tpu.resilience import faults as _faults
@@ -789,7 +790,16 @@ class SGD:
                         _faults.hit("trainer.step")
                         step_fn = self._dispatch_step(feed)
                         t_step = time.perf_counter()
-                        with timer("train_step"):
+                        # tracing hook (obs/trace.py), host-side like the
+                        # chaos hook above: the span wraps the step
+                        # DISPATCH and carries this batch's input wait,
+                        # so a Chrome trace shows train steps next to
+                        # h2d stalls; strict no-op when tracing is off
+                        with _obstrace.span(
+                                "trainer.step", root=False,
+                                pass_id=pass_id, batch=batch_id,
+                                h2d_wait_ms=round(h2d_dt * 1e3, 3)), \
+                                timer("train_step"):
                             (self.parameters, self.opt_state, self.model_state,
                              cost, extras) = step_fn(
                                 self.parameters, self.opt_state, self.model_state,

@@ -138,6 +138,13 @@ class Flags:
     resilience_retry_budget: int = 3    # transient submit retries
 
     # ---- observability (new floor; reference had host timers only)
+    # request tracing (obs/trace.py: host-side span recorder + cross-
+    # process propagation + Chrome-trace export; docs/observability.md)
+    obs_trace_enable: bool = False      # off in prod-style runs; tests/
+    #                                     smokes turn it on explicitly
+    obs_trace_sample: float = 1.0       # deterministic head sampling
+    #                                     keyed on the trace_id hash
+    obs_trace_ring: int = 4096          # completed spans kept (ring)
     profile_dir: Optional[str] = None   # capture an xprof trace of training
     debug_nans: bool = False            # NaN -> immediate error with op
     #                                     location (reference feenableexcept
@@ -179,6 +186,10 @@ class Flags:
         if self.resilience_fault_spec:
             from paddle_tpu.resilience import faults
             faults.install_spec(self.resilience_fault_spec)
+        if self.obs_trace_enable:
+            from paddle_tpu.obs import trace
+            trace.enable(sample=self.obs_trace_sample,
+                         capacity=self.obs_trace_ring)
 
 
 def set_compilation_cache_dir(path):
@@ -351,6 +362,14 @@ FLAG_DOCS = {
                                       "half-open probe", "—"),
     "resilience_retry_budget": ("bounded retries (exp backoff + jitter) "
                                 "for transient submit failures", "—"),
+    "obs_trace_enable": ("per-request span tracing (obs/trace.py): "
+                         "host-side recorder + /debug/traces + Chrome "
+                         "export; strictly no-op when off", "—"),
+    "obs_trace_sample": ("head-sampling rate, decided deterministically "
+                         "from the trace_id hash (every process keeps "
+                         "or drops the SAME traces)", "—"),
+    "obs_trace_ring": ("completed spans the tracer ring retains "
+                       "(oldest overwritten)", "—"),
     "profile_dir": ("capture an xprof/TensorBoard device trace", "—"),
     "debug_nans": ("fail fast on the op producing a NaN",
                    "feenableexcept (TrainerMain.cpp)"),

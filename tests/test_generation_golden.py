@@ -23,9 +23,14 @@ _V, _H = 23, 16
 GOLDEN_BEAM = [
     # (beam_size, expected token rows for beam 0 of each batch element) —
     # recorded from PRNGKey(42) weights + RandomState(7) sources; random
-    # weights make the model babble, which is fine: invariance is the test
-    (1, [[11, 21, 15, 11, 21, 15], [19, 0, 19, 0, 19, 0]]),
-    (3, [[19, 0, 19, 0, 19, 0], [19, 0, 19, 0, 19, 0]]),
+    # weights make the model babble, which is fine: invariance is the test.
+    # Re-pinned in PR 9 after a bisect showed the previous values failing
+    # at EVERY commit back to the seed import — the drift came from the
+    # environment's jax/XLA version changing PRNGKey(42) init numerics,
+    # not from any repo change (seq2seq.py and ops/beam.py are untouched
+    # since the seed; determinism and greedy==beam1 still hold).
+    (1, [[17, 11, 17, 11, 11, 17], [10, 18, 6, 18, 6, 18]]),
+    (3, [[17, 11, 1, 1, 1, 1], [10, 18, 6, 18, 22, 0]]),
 ]
 
 

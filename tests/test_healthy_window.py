@@ -47,7 +47,8 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "perf_report.md", "analytic.json",
                  "analytic_snapshot.json", "serving_smoke.json",
                  "serving_gen_smoke.json", "chaos_smoke.json",
-                 "fleet_smoke.json", "paged_smoke.json", "WINDOW_DONE"):
+                 "fleet_smoke.json", "paged_smoke.json",
+                 "trace_smoke.json", "trace_chrome.json", "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -115,6 +116,18 @@ def test_dryrun_executes_every_phase(tmp_path):
     assert paged["prefix_cache_hits"] >= 2, paged
     assert paged["cow_forks"] >= 1, paged
     assert paged["metrics_sane"] is True, paged
+    # the trace smoke really stitched: one trace_id crossed the router,
+    # the kill -9'd replica, and the failover continuation on the
+    # survivor, and the merged Chrome trace-event dump parsed with all
+    # three process names
+    tsm = json.loads((art / "trace_smoke.json").read_text())
+    assert tsm["value"] == int(tsm["unit"].split("/")[1]), tsm
+    assert tsm["victim_killed"] is True, tsm
+    assert tsm["stitched"] is True, tsm
+    assert tsm["chrome_parses"] is True, tsm
+    assert tsm["chrome_processes"] >= 3, tsm
+    chrome = json.loads((art / "trace_chrome.json").read_text())
+    assert chrome["traceEvents"], "empty Chrome trace dump"
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows
