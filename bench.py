@@ -1281,6 +1281,188 @@ def bench_serving_paged(slots=8, n_requests=160, vocab=256, d_model=128,
         f"{budget_positions} KV positions, block {block_size})"), extras
 
 
+def bench_serving_decode_fused(slots=16, vocab=256, d_model=128, dff=256,
+                               layers=3, heads=2, block_size=8,
+                               max_len=64, seed=0):
+    """Fused Pallas decode-attention kernels (ops/pallas/
+    decode_attention.py) vs the reference XLA step — the per-token
+    serving hot path A/B'd at the step level, slab AND paged layouts,
+    16/64 slots, at the serving_paged model scale (d=128, 3 layers,
+    block 8, max_len 64).
+
+    The analytic leg is the headline: extras["lower"] is the FUSED
+    paged step at the serving_paged slot scale, and extras["postcheck"]
+    (run by perf/analytic.capture) asserts the fusion PROOF — the
+    compiled fused HLO holds no full-chain [S, T, Dkv] gather buffer
+    (perf.analytic.assert_decode_fused), the reference step FAILS the
+    same gate, and the fused step's XLA-model bytes land strictly below
+    the reference step's — recording the before/after bytes in the
+    snapshot row before any chip time.  The timed leg runs one decode
+    step per layout/mode at 16/64 slots (CPU runs the kernels in
+    interpret mode; the real speed verdict needs a chip window, the
+    bytes verdict does not)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import decode_attention as decode_kernels
+    from paddle_tpu.perf import analytic as perf_analytic
+    from paddle_tpu.perf import cost as perf_cost
+
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=1, d_model=d_model, dff=dff,
+                              enc_layers=layers, dec_layers=0,
+                              max_len=max_len, num_heads=heads)
+    dkv = int(params["enc"][0]["attn"]["wk"].shape[1])
+    nb_row = -(-max_len // block_size)
+    rng = np.random.RandomState(seed)
+
+    def step_inputs(s, layout):
+        tokens = rng.randint(1, vocab, s).astype(np.int32)
+        pos = rng.randint(1, max_len - 1, s).astype(np.int32)
+        if layout == "slab":
+            cache = transformer.init_lm_cache(params, s, max_len)
+            return cache, tokens, pos, None
+        num_blocks = s * nb_row + 1
+        cache = transformer.init_lm_cache_paged(params, num_blocks,
+                                                block_size,
+                                                max_len=max_len)
+        # each row owns a private chain covering its position (block 0
+        # stays the reserved scratch block, exactly like the engine)
+        from paddle_tpu.testing.kernel_smoke import build_private_tables
+        tables = build_private_tables(pos, nb_row, block_size,
+                                      num_blocks)
+        return cache, tokens, pos, tables
+
+    def staged(s, layout, mode):
+        """jax.stages.Lowered of one decode step under one kernel mode
+        (fresh jit per mode — the dispatch is read at trace time)."""
+        cache, tokens, pos, tables = step_inputs(s, layout)
+        with decode_kernels.forced_mode(mode):
+            if layout == "slab":
+                def fn(p, c, tok, po):
+                    logits, c = transformer.lm_decode_step_slots(
+                        p, tok, po, c, heads)
+                    return jnp.argmax(logits, axis=-1), c
+                return jax.jit(fn).lower(params, cache, tokens, pos), \
+                    (params, cache, tokens, pos)
+            def fn(p, c, tok, po, tbl):
+                logits, c = transformer.lm_decode_step_paged(
+                    p, tok, po, c, tbl, heads)
+                return jnp.argmax(logits, axis=-1), c
+            return jax.jit(fn).lower(params, cache, tokens, pos,
+                                     tables), \
+                (params, cache, tokens, pos, tables)
+
+    paged_scale = 4 * 8     # the serving_paged family's paged slot count
+
+    def attn_region_bytes(s, layout):
+        """XLA-model bytes of ONE layer's reference attention region —
+        a real, standalone XLA program (chain gather / slab stripe +
+        the masked attend), so its cost numbers carry no interpreter
+        artifacts."""
+        rng2 = np.random.RandomState(1)
+        q = jnp.asarray(rng2.randn(s, d_model), jnp.float32)
+        cache, _tok, pos, tables = step_inputs(s, layout)
+        kl, vl = cache[0]["k"], cache[0]["v"]
+        t_span = nb_row * block_size if layout == "paged" else max_len
+
+        if layout == "paged":
+            def attn(q, kp, vp, po, tbl):
+                k_rows = kp[tbl].reshape(s, -1, dkv)
+                v_rows = vp[tbl].reshape(s, -1, dkv)
+                pm = jnp.arange(t_span)[None, :] <= po[:, None]
+                return transformer._attend(q[:, None], k_rows, v_rows,
+                                           heads, pm)
+            lowered = jax.jit(attn).lower(q, kl, vl, pos, tables)
+        else:
+            def attn(q, kc, vc, po):
+                pm = jnp.arange(t_span)[None, :] <= po[:, None]
+                return transformer._attend(q[:, None], kc, vc, heads, pm)
+            lowered = jax.jit(attn).lower(q, kl, vl, pos)
+        return perf_cost.extract(lowered.compile())["bytes_accessed"]
+
+    def kernel_bytes(s, layout):
+        t_span = nb_row * block_size if layout == "paged" else max_len
+        est = decode_kernels.kernel_cost(s, t_span, d_model, dkv)
+        return float(est.bytes_accessed)
+
+    def bytes_ab(s, layout, ref_compiled=None):
+        """Fused-vs-reference predicted step bytes at one (slots,
+        layout) point.  The reference side is MEASURED (XLA cost model
+        of the real reference step).  The fused side composes measured
+        + declared: reference step minus its per-layer attention region
+        (measured standalone) plus the kernel's ``pl.CostEstimate``
+        traffic per layer — exactly what the TPU cost model reports for
+        the Mosaic custom call (a CPU backend cannot compile Mosaic,
+        and the interpret-mode emulation's loop bookkeeping would
+        libel the kernel)."""
+        if ref_compiled is None:
+            ref_compiled = staged(s, layout, "off")[0].compile()
+        ref_bytes = perf_cost.extract(ref_compiled)["bytes_accessed"]
+        attn_bytes = attn_region_bytes(s, layout)
+        kern_bytes = kernel_bytes(s, layout)
+        fused = ref_bytes - layers * attn_bytes + layers * kern_bytes
+        return {"reference_bytes": ref_bytes,
+                "reference_attn_bytes_per_layer": attn_bytes,
+                "kernel_bytes_per_layer": kern_bytes,
+                "fused_bytes_predicted": fused,
+                "bytes_saved_frac": round(1 - fused / ref_bytes, 4)}
+
+    def postcheck(compiled):
+        """The fusion-proof gate (perf/analytic.capture runs this on the
+        fused lowered step): prove the chain gather's ABSENCE on the
+        fused HLO, prove the same gate CATCHES the reference step, and
+        record the fused-vs-reference bytes verdict at the
+        serving_paged scale."""
+        t_span = nb_row * block_size
+        perf_analytic.assert_decode_fused(compiled.as_text(),
+                                          paged_scale, t_span, dkv)
+        ref_compiled = staged(paged_scale, "paged", "off")[0].compile()
+        ref_hits = perf_analytic.chain_buffer_instrs(
+            ref_compiled.as_text(), paged_scale, t_span, dkv)
+        if not ref_hits:
+            raise AssertionError(
+                "fusion-proof gate failed to flag the reference "
+                "chain-gather step — the detector is broken")
+        ab = bytes_ab(paged_scale, "paged", ref_compiled=ref_compiled)
+        if not ab["fused_bytes_predicted"] < ab["reference_bytes"]:
+            raise AssertionError(
+                f"fused paged step bytes "
+                f"{ab['fused_bytes_predicted']:.3g} not below the "
+                f"reference step's {ab['reference_bytes']:.3g}")
+        ab.update(fusion_proof="pass",
+                  reference_chain_gather_instrs=len(ref_hits))
+        return ab
+
+    extras = {"lower": lambda: staged(paged_scale, "paged", "always")[0],
+              "postcheck": postcheck}
+    if os.environ.get("BENCH_ANALYTIC_BUILD") != "1":
+        # fused-vs-reference bytes matrix for docs/perf.md: 16/64
+        # slots x slab/paged (no execution — lower + cost model only)
+        extras["bytes_matrix"] = {
+            f"{layout}@{s}": bytes_ab(s, layout)
+            for s in (16, 64) for layout in ("slab", "paged")}
+
+    def run(_s):
+        """Wall-clock of one fused decode step at `slots` (paged) —
+        interpret-mode on CPU, the real kernel through Mosaic on TPU."""
+        lowered, args = staged(slots, "paged", "always")
+        compiled = lowered.compile()
+        jax.block_until_ready(compiled(*args))          # warm execute
+        t0 = time.perf_counter()
+        jax.block_until_ready(compiled(*args))
+        return np.float32((time.perf_counter() - t0) * 1e3)
+
+    per_tok = layers * (6 * d_model ** 2 + 2 * d_model * dff) \
+        + d_model * vocab
+    attn = layers * 4.0 * d_model * max_len / 2
+    flops = (2.0 * per_tok + attn) * slots
+    return run, flops, None, (
+        f"fused decode step ms ({slots} paged slots, block "
+        f"{block_size}, d={d_model}, {layers} layers; analytic "
+        f"fused-vs-reference bytes at 16/64 slots both layouts)"), extras
+
+
 def bench_serving_fleet(replicas=2, n_requests=16, vocab=256, max_len=64,
                         prefill_buckets=(8, 16), gen_short=8, gen_long=24,
                         seed=0):
@@ -1601,6 +1783,12 @@ _BENCHES = {
     # shared-prefix prefill elimination; b = the slab slot count (the
     # paged engine gets 4*b slots over the same bytes)
     "serving_paged": (lambda b: bench_serving_paged(slots=b), 8),
+    # fused Pallas decode-attention step vs the reference XLA step
+    # (ops/pallas/decode_attention.py): analytic fused-vs-reference
+    # bytes at 16/64 slots x slab/paged + the fusion-proof gate; b =
+    # the timed paged slot count
+    "serving_decode_fused": (lambda b: bench_serving_decode_fused(
+        slots=b), 16),
     "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),
     # input-pipeline overlap row: steps/s at train(prefetch=0) vs 2 on a
     # synthetic input-bound workload (the ShardedPrefetcher's win)
@@ -1903,11 +2091,12 @@ def main():
     # any other extras pass through verbatim (remat, pack_efficiency,
     # quant, the trainer_prefetch steps/s pair, ...) so a family can add
     # a column without touching the harness; keys the harness itself
-    # consumed are not metrics and stay out of the row ("lower" is the
-    # AOT hook for the analytic perf layer — a callable, not a metric)
+    # consumed are not metrics and stay out of the row, and callables
+    # ("lower" — the analytic AOT hook — and "postcheck", the analytic
+    # acceptance gate) are hooks, not metrics
     for k, v in extras.items():
-        if k not in ("tokens_per_step", "batches_per_step", "lower") \
-                and k not in out:
+        if k not in ("tokens_per_step", "batches_per_step") \
+                and not callable(v) and k not in out:
             out[k] = v
     if fused_rnn_fallback:
         out["fused_rnn_fallback"] = True

@@ -720,7 +720,16 @@ def _cached_self_attn_slots(blk, x, c, positions, pos_mask, num_heads,
     rows = jnp.arange(positions.shape[0])
     k = c["k"].at[rows, positions].set(k_new[:, 0])
     v = c["v"].at[rows, positions].set(v_new[:, 0])
-    att = _attend(q, k, v, num_heads, pos_mask)
+    # fused Pallas decode kernel (ops/pallas/decode_attention.py): the
+    # row's stripe streams HBM->VMEM once, no score matrix, grouped KV
+    # expanded in registers.  None -> the reference XLA path (the CPU
+    # tier-1 default; pallas_decode flag gates — see maybe_slab).
+    from paddle_tpu.ops.pallas import decode_attention as _decode_kernels
+    att = _decode_kernels.maybe_slab(q[:, 0], k, v, positions, num_heads)
+    if att is None:
+        att = _attend(q, k, v, num_heads, pos_mask)
+    else:
+        att = att[:, None]
     return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
 
 
@@ -788,11 +797,23 @@ def _cached_self_attn_paged(blk, x, c, positions, tables, pos_mask,
     offs = positions % block_size
     k = c["k"].at[bids, offs].set(k_new[:, 0])
     v = c["v"].at[bids, offs].set(v_new[:, 0])
-    # chain gather: [S, blocks_per_row, bs, Dkv] -> [S, T, Dkv] where
-    # T = blocks_per_row * bs covers every position a row can hold
-    k_rows = k[tables].reshape(s, -1, k.shape[-1])
-    v_rows = v[tables].reshape(s, -1, v.shape[-1])
-    att = _attend(q, k_rows, v_rows, num_heads, pos_mask)
+    # fused Pallas paged kernel (ops/pallas/decode_attention.py): the
+    # block table rides as scalar-prefetch data and the kernel walks
+    # each row's chain in place — no [S, T, Dkv] gathered copy, no
+    # score matrix (perf/analytic.py's fusion-proof gate pins the
+    # gather's absence).  None -> the reference chain-gather path.
+    from paddle_tpu.ops.pallas import decode_attention as _decode_kernels
+    att = _decode_kernels.maybe_paged(q[:, 0], k, v, positions, tables,
+                                      num_heads)
+    if att is not None:
+        att = att[:, None]
+    else:
+        # chain gather: [S, blocks_per_row, bs, Dkv] -> [S, T, Dkv]
+        # where T = blocks_per_row * bs covers every position a row can
+        # hold
+        k_rows = k[tables].reshape(s, -1, k.shape[-1])
+        v_rows = v[tables].reshape(s, -1, v.shape[-1])
+        att = _attend(q, k_rows, v_rows, num_heads, pos_mask)
     return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
 
 

@@ -282,11 +282,12 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
     # GQA: k/v project to fewer heads (wk/wv are [D, dh*Hkv]) and each
     # serves a GROUP of query heads — the serving lever is the smaller
     # KV cache (models/transformer init_lm_cache sizes off these
-    # shapes); compute repeats them up to full heads HERE, so the
-    # ring/chunked paths downstream still move full-width K/V (keeping
-    # grouped heads through the ring is a future bandwidth lever)
-    k = repeat_kv_heads(split(x_kv, wk, tk, hkv), num_heads)
-    v = repeat_kv_heads(split(x_kv, wv, tk, hkv), num_heads)
+    # shapes).  The ring paths carry the GROUPED stripes through the
+    # ppermute hops and expand per hop in registers (ring traffic
+    # shrinks by num_heads/Hkv); the local paths repeat up to full
+    # heads below, after the ring decision.
+    k = split(x_kv, wk, tk, hkv)
+    v = split(x_kv, wv, tk, hkv)
     if rope_positions is not None:
         # rotary positions on q/k before any masking or sharding
         # (self-attention: one positions array serves both sides)
@@ -329,7 +330,9 @@ def multi_head_attention(x_q, x_kv, wq, wk, wv, wo, num_heads, mask=None,
                                  q_segment_ids=q_segment_ids,
                                  kv_segment_ids=kv_segment_ids)
     else:
-        out = dot_product_attention(q, k, v, mask=mask, causal=causal,
+        out = dot_product_attention(q, repeat_kv_heads(k, num_heads),
+                                    repeat_kv_heads(v, num_heads),
+                                    mask=mask, causal=causal,
                                     key_mask=key_mask,
                                     q_segment_ids=q_segment_ids,
                                     kv_segment_ids=kv_segment_ids)

@@ -9,6 +9,12 @@ already optimal:
                     kernels, custom_vjp), the MXU/HBM-friendly formulation
                     of attention for the transformer/NMT model families.
 
+  decode_attention — fused slab/paged decode attention for the serving
+                    hot path (one KV read per step, block table walked
+                    via scalar prefetch; gated by the trace-time
+                    `pallas_decode` flag — see that module's docstring
+                    and docs/perf.md "Fused decode kernels").
+
 Kernels run on TPU; on CPU they fall back to interpret mode (tests) or the
 XLA reference implementation (callers check `use_pallas()`).
 """

@@ -1,0 +1,426 @@
+"""Fused decode-attention kernels (Pallas TPU): read the KV cache ONCE
+per step.
+
+The serving decode hot path is memory-bound on every analytic family
+(BENCH_ANALYTIC_r06.json), so bytes — not FLOPs — set the step time.
+The reference XLA paths in ``models/transformer`` pay for the KV cache
+more than once per layer per step:
+
+* slab (``_cached_self_attn_slots``): ``repeat_kv_heads`` widens the
+  grouped K/V to full head width and the dense attention materializes
+  the ``[S, H, T]`` score matrix in HBM before the softmax reads it
+  back;
+* paged (``_cached_self_attn_paged``): the per-row chain gather
+  ``pool[tables]`` copies every row's blocks into a contiguous
+  ``[S, T, Dkv]`` HBM buffer — a second full read AND a full write of
+  the logical cache — before the same widened-score dance.
+
+The two kernels here delete all of that traffic.  Per row the K/V
+stripe streams HBM -> VMEM exactly once; the masked online softmax
+(flash-style running max/sum, the ``flash_attention.py`` recipe) and
+the grouped-KV -> full-head expansion happen in VMEM/registers; neither
+the score matrix nor a second KV copy ever exists in HBM.
+
+* ``decode_attention_slab``: grid ``(S, T/blk)`` with the kv dimension
+  innermost; per-row ``positions`` ride as SCALAR-PREFETCH data
+  (``pltpu.PrefetchScalarGridSpec``) so the k-block index map CLAMPS at
+  the row's position — blocks past a row's live prefix map to the same
+  block id, which the Pallas pipeline recognizes and never re-fetches.
+
+* ``decode_attention_paged``: the per-slot block TABLE is the second
+  scalar-prefetch operand and the kernel walks it directly — the
+  ``[1, block_size, Dkv]`` k/v specs index ``pool[tables[r, j]]``, so a
+  row reads ONLY the physical blocks it owns (clamped at its position,
+  like the slab) and the chain gather disappears from the HLO entirely
+  (perf/analytic.py's fusion-proof gate pins exactly that).
+
+Masking matches ``_attend`` exactly: cols > positions[r] sit at -1e30,
+whose exp is 0.0 — cache width beyond a row's position never perturbs
+its numerics, so greedy streams through the kernels stay token-for-token
+identical to ``lm_generate`` (tests/test_pallas_decode.py pins it across
+admission/eviction/CoW churn and supervisor recovery).
+
+Dispatch: callers go through ``maybe_slab`` / ``maybe_paged``, which
+return None (caller falls back to the reference XLA path) unless the
+``pallas_decode`` flag enables the kernels — ``auto`` follows
+``use_pallas()`` (TPU only; the CPU tier-1 default stays the reference
+path, preserving the greedy bit-identity discipline), ``always`` forces
+them anywhere (interpret mode off-TPU — the CPU test/smoke mode), ``off``
+disables.  The flag is read at TRACE time: set it before constructing
+the engine/jitting the step.
+"""
+
+import contextlib
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.ops.pallas.common import LANES as _LANES, lanes as _lanes
+
+_NEG = -1e30
+
+# test/bench override for the pallas_decode flag: None = read FLAGS
+# (utils/flags.py), else one of "auto" | "always" | "off" — same values
+# the flag takes.  The FUSED_LSTM pattern (ops/rnn.py).
+MODE = None
+
+
+def _mode():
+    if MODE is not None:
+        return MODE
+    from paddle_tpu.utils.flags import FLAGS
+    return getattr(FLAGS, "pallas_decode", "auto")
+
+
+@contextlib.contextmanager
+def forced_mode(mode):
+    """Temporarily force the kernel dispatch mode ("always" | "off" |
+    "auto") — tests and the A/B bench.  The mode is read at TRACE time,
+    so wrap the jit/lower call, not just the execution."""
+    global MODE
+    old = MODE
+    MODE = mode
+    try:
+        yield
+    finally:
+        MODE = old
+
+
+def decode_kernels_enabled():
+    """True when the fused decode kernels should serve the slot/paged
+    steps (read at trace time by ``models/transformer``)."""
+    m = str(_mode()).lower()
+    if m in ("0", "off", "false", "no"):
+        return False
+    if m in ("1", "on", "always", "true", "yes"):
+        return True
+    if m != "auto":
+        raise ValueError(f"pallas_decode={m!r} (takes auto | always | off)")
+    from paddle_tpu.ops import pallas as pk
+    return pk.use_pallas()
+
+
+def _block_k_cap():
+    from paddle_tpu.utils.flags import FLAGS
+    return int(getattr(FLAGS, "pallas_decode_block_k", 512))
+
+
+def _interpret(interpret):
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
+def _head_split(d, dkv, num_heads):
+    """(dh, hkv, group) from the projection widths, or None when the
+    widths don't describe a grouped-head layout the kernels handle."""
+    if num_heads < 1 or d % num_heads:
+        return None
+    dh = d // num_heads
+    if dh < 1 or dkv % dh:
+        return None
+    hkv = dkv // dh
+    if hkv < 1 or num_heads % hkv:
+        return None
+    return dh, hkv, num_heads // hkv
+
+
+def _lane_tileable(n):
+    """common.lanes() can slice (n <= LANES) or tile (n % LANES == 0)."""
+    return n <= _LANES or n % _LANES == 0
+
+
+def _pick_block_k(t, cap, interpret):
+    """Largest k-tile <= cap dividing the slab length, compatible with
+    the lane-replicated running-stat layout (<= LANES or a LANES
+    multiple).  Single-block (blk == t) when the whole stripe fits the
+    cap — the common serving shape, where the online softmax degenerates
+    to one plain masked softmax.  Compiled mode additionally wants
+    8-sublane-divisible tiles; interpret mode takes any shape."""
+    if t < 1:
+        return None
+    b = min(t, cap)
+    while b >= 1:
+        if t % b == 0 and _lane_tileable(b) \
+                and (interpret or b % 8 == 0):
+            return b
+        b -= 1
+    return None
+
+
+def _mosaic_ok(blk, dkv, dh, interpret):
+    """Tiling constraints.  The lane-replicated running stats require a
+    lane-tileable k-tile AND head dim in EVERY mode — ``_lanes`` can
+    only slice (n <= LANES) or tile (n % LANES == 0), so e.g. a paged
+    block_size of 136 must fall back to the reference path rather than
+    fail mid-trace.  Compiled mode additionally wants 8-divisible
+    sublane tiles and a lane-tileable Dkv."""
+    if not (_lane_tileable(blk) and _lane_tileable(dh)):
+        return False
+    if interpret:
+        return True
+    return blk % 8 == 0 and _lane_tileable(dkv)
+
+
+# ------------------------------------------------------------ kernel body
+
+def _accumulate(q, kb, vb, col0, blk, pos, m_scr, l_scr, acc_scr, *,
+                num_heads, hkv, dh, scale):
+    """One K/V block of the masked online softmax for one row.
+
+    q: [H, dh] f32; kb/vb: [blk, Dkv] f32; col0: first global column of
+    this block; pos: the row's position (cols > pos masked to -1e30).
+    Grouped KV expands in REGISTERS: each kv head's [dh]-slice meets its
+    query group's rows — no widened K/V ever exists in memory."""
+    group = num_heads // hkv
+    parts = []
+    for g in range(hkv):
+        qg = q[g * group:(g + 1) * group]              # [group, dh]
+        kg = kb[:, g * dh:(g + 1) * dh]                # [blk, dh]
+        parts.append(jax.lax.dot_general(
+            qg, kg, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32))       # [group, blk]
+    s = (jnp.concatenate(parts, axis=0) if hkv > 1 else parts[0]) * scale
+    cols = jax.lax.broadcasted_iota(jnp.int32, (num_heads, blk), 1) + col0
+    s = jnp.where(cols <= pos, s, _NEG)
+    m_prev, l_prev = m_scr[:], l_scr[:]                # [H, LANES]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - _lanes(m_new, blk))
+    alpha = jnp.exp(m_prev - m_new)
+    m_scr[:] = m_new
+    l_scr[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    parts = []
+    for g in range(hkv):
+        pg = p[g * group:(g + 1) * group]              # [group, blk]
+        vg = vb[:, g * dh:(g + 1) * dh]                # [blk, dh]
+        parts.append(jax.lax.dot_general(
+            pg, vg, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32))       # [group, dh]
+    av = jnp.concatenate(parts, axis=0) if hkv > 1 else parts[0]
+    acc_scr[:] = acc_scr[:] * _lanes(alpha, dh) + av
+
+
+def kernel_cost(s, t_span, d, dkv, itemsize=4):
+    """The kernel's declared traffic/compute — the ``pl.CostEstimate``
+    handed to Mosaic, and the number a TPU cost model reports for the
+    fused custom call.  Bytes are the whole point: q in + out + each
+    row's K AND V stripe read ONCE (worst case — the clamped index maps
+    stop at each row's position, so the real stream is shorter), plus
+    the scalar operands.  No score matrix, no second KV copy."""
+    kv_bytes = 2 * s * t_span * dkv * itemsize
+    io_bytes = 2 * s * d * itemsize + s * 4     # + int32 positions
+    #           (the paged block table adds s * nb_row * 4 more — noise)
+    heads_flops = 2 * 2 * s * t_span * d        # qk^T + p@v
+    return pl.CostEstimate(flops=heads_flops,
+                           bytes_accessed=kv_bytes + io_bytes,
+                           transcendentals=s * t_span)
+
+
+def _init_row(m_scr, l_scr, acc_scr):
+    m_scr[:] = jnp.full_like(m_scr, _NEG)
+    l_scr[:] = jnp.zeros_like(l_scr)
+    acc_scr[:] = jnp.zeros_like(acc_scr)
+
+
+def _finalize(o_ref, l_scr, acc_scr, dh):
+    l = jnp.maximum(l_scr[:], 1e-30)
+    o_ref[0] = (acc_scr[:] / _lanes(l, dh)).astype(o_ref.dtype)
+
+
+def _slab_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                 acc_scr, *, blk, num_heads, hkv, dh, scale):
+    r = pl.program_id(0)
+    j = pl.program_id(1)
+    pos = pos_ref[r]
+
+    @pl.when(j == 0)
+    def _():
+        _init_row(m_scr, l_scr, acc_scr)
+
+    @pl.when(j * blk <= pos)
+    def _():
+        _accumulate(q_ref[0].astype(jnp.float32),
+                    k_ref[0].astype(jnp.float32),
+                    v_ref[0].astype(jnp.float32),
+                    j * blk, blk, pos, m_scr, l_scr, acc_scr,
+                    num_heads=num_heads, hkv=hkv, dh=dh, scale=scale)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        _finalize(o_ref, l_scr, acc_scr, dh)
+
+
+def _paged_kernel(pos_ref, tbl_ref, *args, **kw):
+    """Same body as the slab kernel — the block table shapes the DMA
+    stream through the index maps, not the compute; ``tbl_ref`` is
+    consumed entirely by the BlockSpecs."""
+    del tbl_ref
+    _slab_kernel(pos_ref, *args, **kw)
+
+
+# ------------------------------------------------------------ public API
+
+def decode_attention_slab(q, k, v, positions, num_heads, *, block_k=None,
+                          interpret=None):
+    """Fused slab decode attention: q [S, D], k/v [S, T, Dkv] (the
+    already-updated cache), positions [S] int32 -> [S, D].  Row r
+    attends its own stripe at cols <= positions[r]; the stripe is read
+    from HBM exactly once and no score matrix is ever materialized.
+    Raises ValueError on shapes the kernel doesn't cover — callers use
+    ``maybe_slab``."""
+    interpret = _interpret(interpret)
+    s, d = q.shape
+    t, dkv = k.shape[1], k.shape[2]
+    split = _head_split(d, dkv, num_heads)
+    blk = _pick_block_k(t, block_k or _block_k_cap(), interpret)
+    if split is None or blk is None:
+        raise ValueError(
+            f"decode_attention_slab: unsupported shape q={q.shape} "
+            f"k={k.shape} heads={num_heads}")
+    dh, hkv, _group = split
+    if not _mosaic_ok(blk, dkv, dh, interpret):
+        raise ValueError(
+            f"decode_attention_slab: untileable blk={blk} dkv={dkv} "
+            f"dh={dh} for the compiled backend")
+    scale = 1.0 / math.sqrt(dh)
+    kernel = functools.partial(_slab_kernel, blk=blk, num_heads=num_heads,
+                               hkv=hkv, dh=dh, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s, t // blk),
+        in_specs=[
+            pl.BlockSpec((1, num_heads, dh), lambda r, j, pos: (r, 0, 0)),
+            # clamp at the row's live prefix: blocks past positions[r]
+            # re-map to the last needed block — same index, no re-fetch
+            pl.BlockSpec((1, blk, dkv),
+                         lambda r, j, pos: (r, jnp.minimum(j, pos[r] // blk),
+                                            0)),
+            pl.BlockSpec((1, blk, dkv),
+                         lambda r, j, pos: (r, jnp.minimum(j, pos[r] // blk),
+                                            0)),
+        ],
+        out_specs=pl.BlockSpec((1, num_heads, dh),
+                               lambda r, j, pos: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((num_heads, _LANES), jnp.float32),
+            pltpu.VMEM((num_heads, _LANES), jnp.float32),
+            pltpu.VMEM((num_heads, dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, num_heads, dh), q.dtype),
+        cost_estimate=kernel_cost(s, t, d, dkv, q.dtype.itemsize),
+        interpret=interpret,
+    )(jnp.asarray(positions, jnp.int32),
+      q.reshape(s, num_heads, dh), k, v)
+    return out.reshape(s, d)
+
+
+def decode_attention_paged(q, k, v, positions, tables, num_heads, *,
+                           interpret=None):
+    """Fused paged decode attention: q [S, D], k/v [num_blocks,
+    block_size, Dkv] (the shared block POOL, already scatter-updated),
+    positions [S] int32, tables [S, blocks_per_row] int32 -> [S, D].
+
+    The block table is the kernel's second scalar-prefetch operand: the
+    k/v index maps read ``tables[r, j]`` directly, so row r's DMA stream
+    is exactly the physical blocks it owns (clamped at its position) —
+    the ``pool[tables]`` chain gather and its [S, T, Dkv] HBM buffer
+    are gone, not fused.  Raises ValueError on shapes the kernel doesn't
+    cover — callers use ``maybe_paged``."""
+    interpret = _interpret(interpret)
+    s, d = q.shape
+    bs, dkv = k.shape[1], k.shape[2]
+    nb_row = tables.shape[1]
+    split = _head_split(d, dkv, num_heads)
+    if split is None:
+        raise ValueError(
+            f"decode_attention_paged: unsupported shape q={q.shape} "
+            f"pool={k.shape} heads={num_heads}")
+    dh, hkv, _group = split
+    if not _mosaic_ok(bs, dkv, dh, interpret):
+        raise ValueError(
+            f"decode_attention_paged: untileable block_size={bs} "
+            f"dkv={dkv} dh={dh} for the compiled backend")
+    scale = 1.0 / math.sqrt(dh)
+    kernel = functools.partial(_paged_kernel, blk=bs,
+                               num_heads=num_heads, hkv=hkv, dh=dh,
+                               scale=scale)
+
+    def _kv_map(r, j, pos, tbl):
+        # walk the row's chain, clamped at its live prefix: entries past
+        # positions[r] (scratch/stale ids) are never even addressed
+        return (tbl[r, jnp.minimum(j, pos[r] // bs)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, nb_row),
+        in_specs=[
+            pl.BlockSpec((1, num_heads, dh),
+                         lambda r, j, pos, tbl: (r, 0, 0)),
+            pl.BlockSpec((1, bs, dkv), _kv_map),
+            pl.BlockSpec((1, bs, dkv), _kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, num_heads, dh),
+                               lambda r, j, pos, tbl: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((num_heads, _LANES), jnp.float32),
+            pltpu.VMEM((num_heads, _LANES), jnp.float32),
+            pltpu.VMEM((num_heads, dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, num_heads, dh), q.dtype),
+        cost_estimate=kernel_cost(s, nb_row * bs, d, dkv,
+                                  q.dtype.itemsize),
+        interpret=interpret,
+    )(jnp.asarray(positions, jnp.int32),
+      jnp.asarray(tables, jnp.int32),
+      q.reshape(s, num_heads, dh), k, v)
+    return out.reshape(s, d)
+
+
+# ------------------------------------------------------------ dispatch
+
+def covers(num_heads, d, dkv, blk_len, paged=False):
+    """THE dispatch predicate (flag + shape support), shared by
+    ``maybe_slab``/``maybe_paged`` and by ``DecodeEngine.warmup``'s
+    resolved-path log — one definition, so the engine can never report
+    a path its compiled step didn't take.  ``blk_len``: the slab length
+    (slab) or the pool block size (paged)."""
+    if not decode_kernels_enabled():
+        return False
+    interpret = _interpret(None)
+    split = _head_split(d, dkv, num_heads)
+    if split is None:
+        return False
+    if paged:
+        return _mosaic_ok(blk_len, dkv, split[0], interpret)
+    blk = _pick_block_k(blk_len, _block_k_cap(), interpret)
+    return blk is not None and _mosaic_ok(blk, dkv, split[0], interpret)
+
+
+def maybe_slab(q, k, v, positions, num_heads):
+    """Kernel output [S, D] when the fused slab kernel is enabled and
+    covers these shapes; None -> caller takes the reference XLA path."""
+    if not covers(num_heads, q.shape[1], k.shape[2], k.shape[1],
+                  paged=False):
+        return None
+    return decode_attention_slab(q, k, v, positions, num_heads,
+                                 interpret=_interpret(None))
+
+
+def maybe_paged(q, k, v, positions, tables, num_heads):
+    """Kernel output [S, D] when the fused paged kernel is enabled and
+    covers these shapes; None -> caller takes the chain-gather path."""
+    if not covers(num_heads, q.shape[1], k.shape[2], k.shape[1],
+                  paged=True):
+        return None
+    return decode_attention_paged(q, k, v, positions, tables, num_heads,
+                                  interpret=_interpret(None))

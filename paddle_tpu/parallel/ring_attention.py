@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from paddle_tpu.ops.attention import repeat_kv_heads
 from paddle_tpu.parallel.sharding import shard_map
 
 _NEG = -1e30
@@ -30,6 +31,21 @@ def _block_attn(q, k, v, m_prev, l_prev, acc, mask=None, scale=1.0):
     from paddle_tpu.ops.attention import online_softmax_block
     return online_softmax_block(q, k, v, m_prev, l_prev, acc, mask=mask,
                                 scale=scale)
+
+
+def _kv_group(q, k):
+    """Query heads per KV head (GQA): the ring carries k/v GROUPED —
+    [B, Hkv, T/n, D] travels each ppermute hop, shrinking ring traffic
+    by H/Hkv vs repeating to full width before dispatch — and each hop
+    expands the received stripe in registers via the shared
+    ``ops.attention.repeat_kv_heads`` right before its
+    block-attention.  Fail-fast validation only; the expansion itself
+    has ONE implementation."""
+    h, hkv = q.shape[1], k.shape[1]
+    if hkv < 1 or h % hkv:
+        raise ValueError(f"query heads {h} not a multiple of KV heads "
+                         f"{hkv} — not a grouped-KV layout")
+    return h // hkv
 
 
 def _resolve_segments(q, k, q_segment_ids, kv_segment_ids):
@@ -62,8 +78,11 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
                    q_segment_ids=None, kv_segment_ids=None):
     """Sequence-parallel attention under shard_map.
 
-    q/k/v: [B, H, T, D] GLOBAL shapes, sharded over T on `axis_name`
-    (caller annotates; this function builds its own shard_map).
+    q: [B, H, T, D]; k/v: [B, Hkv, T, D] GLOBAL shapes, sharded over T
+    on `axis_name` (caller annotates; this function builds its own
+    shard_map).  Hkv may be a DIVISOR of H (grouped-query attention):
+    the grouped stripes travel the ppermute ring as-is — H/Hkv less
+    ring traffic than pre-repeating — and expand per hop in registers.
     q_mask/kv_mask: [B, T] validity (global, sharded the same way).
     q_segment_ids/kv_segment_ids: [B, T] int labels for PACKED rows
     (core.sequence.pack_sequences) — the KV labels rotate around the
@@ -73,11 +92,13 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
     """
     n = mesh.shape[axis_name]
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    _kv_group(q, k)
     segmented, q_seg, kv_seg = _resolve_segments(
         q, k, q_segment_ids, kv_segment_ids)
 
     def local_fn(q_l, k_l, v_l, qm_l, kvm_l, qseg_l, kvseg_l):
-        # local shapes: [B, H, T/n, D]
+        # local shapes: q [B, H, T/n, D]; k/v [B, Hkv, T/n, D] (grouped
+        # KV rides the ring; expanded per hop at the attend below)
         b, h, tq, d = q_l.shape
         my = jax.lax.axis_index(axis_name)
 
@@ -104,8 +125,9 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=False,
                     kpos = src * tq + jnp.arange(tq)
                     cm = (qpos[:, None] >= kpos[None, :])[None, None]
                     mask = cm if mask is None else (mask & cm)
-                return _block_attn(q_l, k_blk, v_blk, m, l, acc, mask,
-                                   scale)
+                return _block_attn(q_l, repeat_kv_heads(k_blk, h),
+                                   repeat_kv_heads(v_blk, h), m, l, acc,
+                                   mask, scale)
             if causal:
                 # skip blocks entirely above the diagonal.  NOTE: with the
                 # contiguous T sharding used here this saves FLOPs/energy
@@ -199,9 +221,11 @@ def ring_attention_zigzag(q, k, v, mesh: Mesh, axis_name="seq",
     my == src), halving causal attention cost AND balancing it, so the
     saving is real throughput.
 
-    q/k/v: [B, H, T, D] GLOBAL, already zigzag_permute'd and sharded over
-    T on `axis_name`; q_mask/kv_mask [B, T] likewise (q_mask zeroes
-    padded query rows, matching ring_attention).
+    q: [B, H, T, D]; k/v: [B, Hkv, T, D] (Hkv | H — grouped KV travels
+    the ring, expanded per hop like ring_attention) GLOBAL, already
+    zigzag_permute'd and sharded over T on `axis_name`; q_mask/kv_mask
+    [B, T] likewise (q_mask zeroes padded query rows, matching
+    ring_attention).
     q_segment_ids/kv_segment_ids: [B, T] PACKED-row labels, zigzag-
     permuted like everything else — the segment-equality mask depends
     only on label pairs, so it composes with any storage order, and the
@@ -210,10 +234,13 @@ def ring_attention_zigzag(q, k, v, mesh: Mesh, axis_name="seq",
     output sharded like q (zigzag_unpermute to restore order)."""
     n = mesh.shape[axis_name]
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    _kv_group(q, k)
     segmented, q_seg, kv_seg = _resolve_segments(
         q, k, q_segment_ids, kv_segment_ids)
 
     def local_fn(q_l, k_l, v_l, qm_l, kvm_l, qseg_l, kvseg_l):
+        # q [B, H, T/n, D]; k/v [B, Hkv, T/n, D] — grouped KV rides the
+        # ring, expanded per half-block at the attends below
         b, h, tq, d = q_l.shape
         half = tq // 2
         my = jax.lax.axis_index(axis_name)
@@ -249,7 +276,9 @@ def ring_attention_zigzag(q, k, v, mesh: Mesh, axis_name="seq",
                 if need_causal:
                     cm = pos(qc)[:, None] >= pos(kc)[None, :]
                     mask = mask & cm[None, None]
-                return _block_attn(q_, k_, v_, m, l, acc, mask, scale)
+                return _block_attn(q_, repeat_kv_heads(k_, h),
+                                   repeat_kv_heads(v_, h), m, l, acc,
+                                   mask, scale)
 
             # qhi x klo: always fully below the diagonal — padding mask
             # only, no causal comparison to build

@@ -71,12 +71,83 @@ FAMILIES = [
     # block-gather/scatter step's structure (the block table is data, so
     # allocator churn can never change this program)
     ("serving_paged", "serving_paged", None),
+    # fused Pallas decode-attention kernels (ops/pallas/decode_
+    # attention.py): extras["lower"] is the FUSED paged step at the
+    # serving_paged scale, and the factory's postcheck runs the
+    # fusion-proof gate (assert_decode_fused: no full-chain gather
+    # buffer in the HLO; reference step must FAIL the same gate) and
+    # records the fused-vs-reference predicted-bytes win — before any
+    # chip time
+    ("serving_decode_fused", "serving_decode_fused", None),
     ("trainer_prefetch", "trainer_prefetch", None),
 ]
 
 
 def _log(msg):
     print(f"[analytic] {msg}", file=sys.stderr, flush=True)
+
+
+# ----------------------------------------------------- fusion-proof gate
+
+def chain_buffer_instrs(hlo_text, num_rows, t_span, dkv):
+    """Instructions whose RESULT materializes a full-chain KV buffer —
+    the PR-3 de-fusion detector run in REVERSE.
+
+    The reference paged-decode step gathers every row's block chain into
+    a contiguous ``[S, blocks_per_row, bs, Dkv]`` HBM buffer (and its
+    ``[S, T, Dkv]`` reshape) before attending; the fused Pallas kernel
+    walks the block table in place and that buffer must not exist.  An
+    instruction matches when its result shape leads with ``num_rows``
+    and holds exactly ``num_rows * t_span * dkv`` elements — the chain
+    buffer's signature under any dim factoring (the per-layer block
+    POOL never matches: it leads with num_blocks, not S).  Returns the
+    offending instruction lines (empty = fusion proven).
+    """
+    import re
+    from paddle_tpu.perf import cost as _cost
+    target = int(num_rows) * int(t_span) * int(dkv)
+    shape_re = re.compile(r"\b[a-z][a-z0-9]*\[([0-9,]+)\]")
+    hits = []
+    for line in hlo_text.splitlines():
+        m = _cost._INSTR_RE.match(line)
+        if not m:
+            continue
+        rhs = m.group(1)
+        op = _cost._op_of(rhs)
+        if op is None or op in _cost._SKIP_OPS:
+            continue
+        # result type: the leading whitespace-free token, or the
+        # balanced-paren tuple type for multi-result instructions
+        if rhs.startswith("("):
+            depth, ty = 0, rhs
+            for i, ch in enumerate(rhs):
+                depth += ch == "("
+                depth -= ch == ")"
+                if depth == 0:
+                    ty = rhs[:i + 1]
+                    break
+        else:
+            ty = rhs.split(None, 1)[0]
+        for dims in shape_re.findall(ty):
+            shape = [int(d) for d in dims.split(",")]
+            n = 1
+            for d in shape:
+                n *= d
+            if shape and shape[0] == int(num_rows) and n == target:
+                hits.append(line.strip())
+                break
+    return hits
+
+
+def assert_decode_fused(hlo_text, num_rows, t_span, dkv):
+    """Raise AssertionError when the paged-decode HLO still materializes
+    the full-chain gather buffer (kernels were supposed to be ON)."""
+    hits = chain_buffer_instrs(hlo_text, num_rows, t_span, dkv)
+    if hits:
+        raise AssertionError(
+            f"paged decode step materializes a full-chain "
+            f"[{num_rows}, {t_span}, {dkv}]-element KV buffer — the "
+            f"fused kernel did not engage:\n  " + "\n  ".join(hits[:4]))
 
 
 def _import_bench():
@@ -112,6 +183,14 @@ def capture(name, model, batch=None, chips=("v5e", "v5p")):
         # BENCH_PLATFORM override), and one family's extraction failure
         # must degrade to an error row, not kill the snapshot
         row = cost.extract(compiled)
+        # structural acceptance gate hook: a family may ship a
+        # postcheck(compiled) -> dict that ASSERTS on the compiled
+        # program (e.g. serving_decode_fused's fusion proof) and
+        # returns extra row fields; a failed assertion degrades this
+        # family to an error row like any other capture failure
+        postcheck = extras.get("postcheck")
+        if postcheck is not None:
+            row.update(postcheck(compiled))
     except Exception as e:    # noqa: BLE001 — per-family isolation
         return {"model": model, "batch": batch,
                 "error": f"{type(e).__name__}: {e}"[:500]}
@@ -129,7 +208,8 @@ def capture(name, model, batch=None, chips=("v5e", "v5p")):
     # differ and the cross-check is omitted for them.
     bps = extras.get("batches_per_step")
     if model in ("transformer_serving", "serving", "serving_generate",
-                 "serving_fleet", "serving_paged"):
+                 "serving_fleet", "serving_paged",
+                 "serving_decode_fused"):
         # the lowered program is one batch/slab step while the bench FLOPs
         # model covers the whole stream/burst — scopes differ, no cross-check
         row["bench_model_flops"] = None

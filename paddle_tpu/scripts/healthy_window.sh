@@ -47,6 +47,10 @@
 #                                        kill -9 one mid-stream -> a single
 #                                        trace_id stitches router + both
 #                                        replicas; Chrome dump parses)
+# 13. fused decode-kernel smoke         (pallas_decode generation drive,
+#                                        slab + paged kernels compiled in:
+#                                        streams bit-identical to the
+#                                        reference-path twin, 0 retraces)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -250,6 +254,17 @@ timeout "$T_SERVE" python -m paddle_tpu.obs --smoke \
     --chrome-out "$ART/trace_chrome.json" \
     > "$ART/trace_smoke.json" 2> "$ART/trace_smoke.log"
 log "trace smoke rc=$? -> $ART/trace_smoke.json"
+
+log "phase 13: fused decode-kernel smoke (pallas_decode vs reference twin)"
+# the demo generation drive with the Pallas decode-attention kernels
+# compiled into the slab AND paged steps (interpret mode on CPU, Mosaic
+# on TPU): staggered streams must come back bit-identical to a
+# reference-path twin engine with 0 retraces — one JSON line
+# (python -m paddle_tpu.serving --smoke-decode-fused; docs/perf.md
+# "Fused decode kernels")
+timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-decode-fused \
+    > "$ART/decode_fused_smoke.json" 2> "$ART/decode_fused_smoke.log"
+log "decode-fused smoke rc=$? -> $ART/decode_fused_smoke.json"
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

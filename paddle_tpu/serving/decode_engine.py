@@ -199,6 +199,9 @@ class DecodeEngine:
         self._prefill_batch_buckets = tuple(prefill_batch_buckets)
         self._prefill_engines = {}     # length bucket -> InferenceEngine
         self._step_traces = [0]
+        # resolved at warm-up (the step's trace time): did the compiled
+        # step take the fused Pallas decode-attention path?
+        self.decode_kernels = False
 
         if self.kv_layout == "paged":
             def _step_fn(p, cache, tokens, pos, tables):
@@ -681,6 +684,19 @@ class DecodeEngine:
             self._prefill_engine(b).warmup()
         if self._warm:
             return
+        # resolve the kernel path NOW — warm-up is the step's one trace,
+        # so this is the selection the compiled step actually took
+        # (ops/pallas/decode_attention.py; pallas_decode flag)
+        from paddle_tpu.ops.pallas import decode_attention as _dk
+        enc = self.params.get("enc") or []
+        if enc:
+            d = int(self.params["src_emb"].shape[1])
+            dkv = int(enc[0]["attn"]["wk"].shape[1])
+            blk_len = (self.block_size if self.kv_layout == "paged"
+                       else self.max_len)
+            self.decode_kernels = _dk.covers(
+                self.num_heads, d, dkv, blk_len,
+                paged=self.kv_layout == "paged")
         if self.kv_layout == "paged":
             # ONE block-write shape and ONE fork shape serve every
             # bucket/admission/CoW — both warmed (and executed) against
@@ -722,8 +738,9 @@ class DecodeEngine:
                 jax.block_until_ready(nxt)
         self._warm = True
         logger.info("decode[%s]: warm (%d slots, max_len %d, kv %s, "
-                    "prefill buckets %s)", self.name, self.num_slots,
-                    self.max_len, self.kv_layout,
+                    "decode kernels %s, prefill buckets %s)", self.name,
+                    self.num_slots, self.max_len, self.kv_layout,
+                    "fused-pallas" if self.decode_kernels else "xla-ref",
                     list(self.prefill_buckets))
 
     def lower(self, what="step"):

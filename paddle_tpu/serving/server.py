@@ -914,6 +914,80 @@ def _smoke_paged(args):
     return 0 if passed else 2
 
 
+def _smoke_decode_fused(args):
+    """Fused decode-kernel self-test (healthy_window.sh phase 13;
+    docs/perf.md "Fused decode kernels"): the demo generation drive with
+    ``pallas_decode=always`` — the Pallas decode-attention kernels
+    compiled INTO the slab and paged steps (interpret mode on CPU, the
+    real Mosaic kernels on TPU) — against a reference-path twin engine
+    serving the same staggered prompts.  Every greedy stream must be
+    bit-identical between the two steps, both fused engines must hold
+    the 1-warm-up-trace/0-retrace discipline across the churn, and both
+    kernels (slab + paged) must actually have engaged (engine
+    ``decode_kernels`` resolution).  Prints ONE JSON line; returns the
+    process exit code."""
+    import copy
+
+    from paddle_tpu.ops.pallas import decode_attention as decode_kernels
+
+    rng = np.random.RandomState(0)
+    n_tok = 8
+    prompts = [rng.randint(1, 256, rng.randint(3, 17)).astype(np.int64)
+               for _ in range(6)]
+    errs = []
+    out = {"metric": "fused decode-kernel smoke (pallas_decode vs "
+                     "reference twin)",
+           "vs_baseline": None}
+    ok_layouts = 0
+    for layout in ("slab", "paged"):
+        a = copy.copy(args)
+        a.kv_layout = layout
+        a.kv_block_size = min(args.kv_block_size, 8)
+        with decode_kernels.forced_mode("always"):
+            fused = _demo_gen_batcher(a, tiny=True)
+        # the twin must force the kernels OFF: on TPU the default
+        # "auto" would fuse it too and the comparison would be
+        # fused-vs-fused
+        with decode_kernels.forced_mode("off"):
+            ref = _demo_gen_batcher(a, tiny=True)
+        engaged = bool(fused.engine.decode_kernels)
+        traces0 = fused.engine.step_trace_count
+
+        def drive(bat):
+            futs, res = [], []
+            for i, p in enumerate(prompts):
+                futs.append(bat.submit(p, max_tokens=n_tok))
+                if i % 2:
+                    time.sleep(0.01)    # staggered: admissions land
+                    #                     mid-decode, slots churn
+            for f in futs:
+                res.append(f.result(120)["tokens"])
+            return res
+
+        try:
+            got = drive(fused)
+            want = drive(ref)
+            identical = got == want
+        except Exception as e:  # noqa: BLE001 — a drive failure must
+            # become a False flag in the ONE JSON line, not a traceback
+            errs.append(f"{layout}: {type(e).__name__}: {e}")
+            identical = False
+        retraced = fused.engine.step_trace_count - traces0
+        fused.close()
+        ref.close()
+        out[f"{layout}_kernel_engaged"] = engaged
+        out[f"{layout}_bit_identical"] = bool(identical)
+        out[f"{layout}_retraces"] = int(retraced)
+        if engaged and identical and retraced == 0:
+            ok_layouts += 1
+    out["value"] = ok_layouts
+    out["unit"] = "layouts_ok/2"
+    if errs:
+        out["errors"] = errs[:5]
+    print(json.dumps(out), flush=True)
+    return 0 if ok_layouts == 2 else 2
+
+
 def _write_port_file(path, port):
     """Publish the BOUND port (meaningful with --port 0) atomically —
     the fleet supervisor (serving/fleet.py) spawns replicas on ephemeral
@@ -963,6 +1037,11 @@ def main(argv=None):
     ap.add_argument("--kv-prefix-cache",
                     type=lambda v: v.lower() in ("1", "true", "yes"),
                     default=FLAGS.serving_kv_prefix_cache)
+    ap.add_argument("--pallas-decode", default=FLAGS.pallas_decode,
+                    help="fused decode-attention kernels for the decode "
+                         "step: auto (TPU only) | always (interpret "
+                         "off-TPU) | off — docs/perf.md 'Fused decode "
+                         "kernels'")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=FLAGS.serving_port)
     ap.add_argument("--port-file",
@@ -988,6 +1067,12 @@ def main(argv=None):
                          "clients over kv_layout=paged, prefix hits + "
                          "CoW fork recorded, streams bit-identical to "
                          "the slab layout; one JSON line, exit")
+    ap.add_argument("--smoke-decode-fused", action="store_true",
+                    help="fused decode-kernel self-test: the demo "
+                         "generation drive with pallas_decode=always "
+                         "(slab + paged), streams bit-identical to a "
+                         "reference-path twin, 0 retraces; one JSON "
+                         "line, exit")
     # ---- resilience (docs/serving.md §6) ----
     ap.add_argument("--drain-timeout-s", type=float,
                     default=FLAGS.serving_drain_timeout_s,
@@ -1013,6 +1098,9 @@ def main(argv=None):
     ap.add_argument("--obs-trace-ring", type=int,
                     default=FLAGS.obs_trace_ring)
     args = ap.parse_args(argv)
+    # kernel selection is read at TRACE time — push the flag before any
+    # engine is constructed
+    FLAGS.pallas_decode = args.pallas_decode
     if args.fault_spec:
         from paddle_tpu.resilience import faults
         faults.install_spec(args.fault_spec)
@@ -1032,6 +1120,8 @@ def main(argv=None):
         return _smoke_generate(_demo_gen_batcher(args, tiny=True))
     if args.smoke_paged:
         return _smoke_paged(args)
+    if args.smoke_decode_fused:
+        return _smoke_decode_fused(args)
     if args.demo_generate and not (args.artifact or args.artifacts
                                    or args.demo):
         # generation-only server: no /v1/infer batcher

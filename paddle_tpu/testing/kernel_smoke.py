@@ -178,6 +178,86 @@ def _lstm_blocked_case(tol=1e-2):
     return err
 
 
+def build_private_tables(positions, nb_row, block_size, num_blocks):
+    """Per-row PRIVATE block chains for decode-kernel drives: row r owns
+    ``pos // block_size + 1`` distinct block ids from 1..num_blocks-1,
+    unowned table slots stay 0 (the reserved scratch block) — the layout
+    serving/kv_pool.py's allocator produces.  One definition for the
+    smoke case here, bench.py's serving_decode_fused inputs, and
+    tests/test_pallas_decode.py."""
+    tables = np.zeros((len(positions), nb_row), np.int32)
+    nxt = 1
+    for r, p in enumerate(positions):
+        for j in range(int(p) // block_size + 1):
+            if nxt >= num_blocks:
+                raise ValueError(
+                    f"pool of {num_blocks} blocks cannot hold private "
+                    f"chains for positions {list(positions)}")
+            tables[r, j] = nxt
+            nxt += 1
+    return tables
+
+
+def _decode_slab_case(tol=1e-4):
+    """Fused slab decode-attention kernel vs the masked-XLA oracle
+    (models/transformer._attend) — forward only (the decode hot path has
+    no backward), through a real Mosaic compile on TPU / interpret mode
+    on CPU.  GQA widths (Hkv < H) included: the in-register group
+    expansion is the subtle Mosaic surface."""
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import decode_attention as dk
+
+    errs = []
+    for h, hkv, dh, s, t in ((8, 8, 128, 16, 256), (8, 2, 128, 16, 256)):
+        d, dkv = h * dh, hkv * dh
+        rng = np.random.RandomState(h * 10 + hkv)
+        q = jnp.asarray(rng.randn(s, d) * 0.5, jnp.float32)
+        k = jnp.asarray(rng.randn(s, t, dkv) * 0.5, jnp.float32)
+        v = jnp.asarray(rng.randn(s, t, dkv) * 0.5, jnp.float32)
+        pos = jnp.asarray(rng.randint(0, t, s), jnp.int32)
+        with dk.forced_mode("always"):
+            out = jax.jit(lambda q, k, v, pos: dk.maybe_slab(
+                q, k, v, pos, h))(q, k, v, pos)
+        assert out is not None, "slab kernel declined a supported shape"
+        pm = jnp.arange(t)[None, :] <= pos[:, None]
+        want = transformer._attend(q[:, None], k, v, h,
+                                   jnp.broadcast_to(pm, (s, t)))[:, 0]
+        errs.append(_max_err(out, want))
+    err = max(errs)
+    assert err <= tol, f"decode_slab max err {err:.3e} > tol {tol}"
+    return err
+
+
+def _decode_paged_case(tol=1e-4):
+    """Fused paged decode-attention kernel (block-table scalar prefetch)
+    vs the chain-gather oracle, real Mosaic compile on TPU."""
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import decode_attention as dk
+
+    h, hkv, dh, s, bs, nb_row = 8, 2, 128, 16, 16, 8
+    d, dkv = h * dh, hkv * dh
+    nb = s * nb_row + 1
+    t = nb_row * bs
+    rng = np.random.RandomState(9)
+    q = jnp.asarray(rng.randn(s, d) * 0.5, jnp.float32)
+    kp = jnp.asarray(rng.randn(nb, bs, dkv) * 0.5, jnp.float32)
+    vp = jnp.asarray(rng.randn(nb, bs, dkv) * 0.5, jnp.float32)
+    pos = np.asarray(rng.randint(0, t, s), np.int32)
+    tables = build_private_tables(pos, nb_row, bs, nb)
+    with dk.forced_mode("always"):
+        out = jax.jit(lambda q, kp, vp, pos, tbl: dk.maybe_paged(
+            q, kp, vp, pos, tbl, h))(q, kp, vp, jnp.asarray(pos),
+                                     jnp.asarray(tables))
+    assert out is not None, "paged kernel declined a supported shape"
+    k_rows = kp[jnp.asarray(tables)].reshape(s, -1, dkv)
+    v_rows = vp[jnp.asarray(tables)].reshape(s, -1, dkv)
+    pm = jnp.asarray(np.arange(t)[None, :] <= pos[:, None])
+    want = transformer._attend(q[:, None], k_rows, v_rows, h, pm)[:, 0]
+    err = _max_err(out, want)
+    assert err <= tol, f"decode_paged max err {err:.3e} > tol {tol}"
+    return err
+
+
 CASES = {
     "lstm_fused": lambda: _rnn_case("lstm"),
     "lstm_blocked": _lstm_blocked_case,
@@ -185,4 +265,6 @@ CASES = {
     "simple_rnn_fused": lambda: _rnn_case("simple_rnn"),
     "flash_attention": lambda: _flash_case(causal=False),
     "flash_attention_causal": lambda: _flash_case(causal=True),
+    "decode_attention_slab": _decode_slab_case,
+    "decode_attention_paged": _decode_paged_case,
 }

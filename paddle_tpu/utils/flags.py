@@ -105,6 +105,11 @@ class Flags:
     #                                     (0 = slab-equivalent bytes)
     serving_kv_prefix_cache: bool = True  # share resident prompt-prefix
     #                                       blocks across requests
+    # ---- fused decode kernels (ops/pallas/decode_attention.py: read
+    # the KV cache once per step; docs/perf.md "Fused decode kernels")
+    pallas_decode: str = "auto"         # auto (use_pallas(): TPU only) |
+    #                                     always (interpret off-TPU) | off
+    pallas_decode_block_k: int = 512    # slab kernel k-tile cap
     # ---- replicated serving tier (serving/fleet.py supervisor +
     # serving/router.py health-checked router; docs/serving.md §7)
     router_port: int = 8000             # HTTP port for the router CLI
@@ -312,6 +317,16 @@ FLAG_DOCS = {
     "serving_kv_prefix_cache": ("share resident prompt-prefix blocks "
                                 "across requests (copy-on-write on "
                                 "divergence)", "—"),
+    "pallas_decode": ("fused Pallas decode-attention kernels for the "
+                      "slot/paged serving steps: auto = on when the "
+                      "backend compiles Pallas natively (TPU), always = "
+                      "force (interpret mode off-TPU — tests/smokes), "
+                      "off = reference XLA path.  Read at trace time: "
+                      "set before constructing the decode engine", "—"),
+    "pallas_decode_block_k": ("slab decode kernel k-tile cap (positions "
+                              "per KV block streamed through VMEM); the "
+                              "kernel picks the largest tileable divisor "
+                              "of max_len under this", "—"),
     "router_port": ("HTTP port for python -m paddle_tpu.serving.router",
                     "—"),
     "router_poll_interval_s": ("how often the router polls each "

@@ -48,7 +48,8 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "analytic_snapshot.json", "serving_smoke.json",
                  "serving_gen_smoke.json", "chaos_smoke.json",
                  "fleet_smoke.json", "paged_smoke.json",
-                 "trace_smoke.json", "trace_chrome.json", "WINDOW_DONE"):
+                 "trace_smoke.json", "trace_chrome.json",
+                 "decode_fused_smoke.json", "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -128,6 +129,15 @@ def test_dryrun_executes_every_phase(tmp_path):
     assert tsm["chrome_processes"] >= 3, tsm
     chrome = json.loads((art / "trace_chrome.json").read_text())
     assert chrome["traceEvents"], "empty Chrome trace dump"
+    # the decode-fused smoke really fused: both kernels (slab + paged)
+    # compiled into the demo engines' steps, every staggered stream
+    # bit-identical to the reference-path twin, zero retraces
+    fused = json.loads((art / "decode_fused_smoke.json").read_text())
+    assert fused["value"] == int(fused["unit"].split("/")[1]), fused
+    for layout in ("slab", "paged"):
+        assert fused[f"{layout}_kernel_engaged"] is True, fused
+        assert fused[f"{layout}_bit_identical"] is True, fused
+        assert fused[f"{layout}_retraces"] == 0, fused
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows

@@ -1,0 +1,465 @@
+"""Fused Pallas decode-attention kernels (ops/pallas/decode_attention.py).
+
+Interpret-mode (CPU) coverage of the two serving decode kernels — the
+slab stripe kernel and the block-table-walking paged kernel — against
+the reference XLA paths in ``models/transformer``:
+
+* kernel numerics vs ``_attend`` / the chain-gather path (allclose,
+  incl. grouped-KV head layouts);
+* masked-width semantics at block boundaries (a position on the last
+  slot of a block must not read the next block);
+* the reserved scratch block 0 is NEVER attended by an active row
+  (poisoned with NaN, outputs unchanged);
+* engine-level greedy streams token-identical to ``lm_generate`` with
+  the kernels compiled into the step — across staggered admissions,
+  prefix-cache hits, CoW forks, and PR-6 supervisor recovery — at
+  exactly 1 warm-up trace and 0 retraces under churn;
+* the fusion-proof analytic gate (perf/analytic.assert_decode_fused)
+  passes on the fused step's HLO and FAILS on the reference step's.
+
+The kernels are forced via ``decode_attention.forced_mode("always")``
+(interpret mode off-TPU); the default CPU path stays the reference XLA
+implementation, so every other test file keeps pinning bit-identity
+against it.  The chaos-recovery, rope-trunk, and fusion-gate cases ride
+the slow lane (each builds/lowers an extra engine or step); the kernel
+numerics and both engine bit-identity drives stay in the fast lane.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import transformer
+from paddle_tpu.ops.pallas import decode_attention as dk
+from paddle_tpu.perf import analytic as perf_analytic
+from paddle_tpu.resilience import Supervisor, faults
+from paddle_tpu.serving import GenerationBatcher, ServingMetrics
+from paddle_tpu.serving.decode_engine import DecodeEngine
+from paddle_tpu.testing import assert_no_retrace
+
+VOCAB, D_MODEL, LAYERS, HEADS = 64, 32, 2, 2
+MAX_LEN, SLOTS, BUCKETS, BS = 48, 4, (8, 16), 8
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_plan():
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return transformer.init(jax.random.PRNGKey(0), src_vocab=VOCAB,
+                            trg_vocab=1, d_model=D_MODEL, num_heads=HEADS,
+                            dff=64, enc_layers=LAYERS, dec_layers=0,
+                            max_len=MAX_LEN)
+
+
+@pytest.fixture(scope="module")
+def slab_engine(params):
+    """Slab engine whose step COMPILED the fused kernel in (the mode is
+    read at trace time = warm-up; later drives run the baked step)."""
+    with dk.forced_mode("always"):
+        eng = DecodeEngine(params, num_heads=HEADS, num_slots=SLOTS,
+                           max_len=MAX_LEN, prefill_buckets=BUCKETS,
+                           name="kern_slab")
+    assert eng.decode_kernels
+    return eng
+
+
+@pytest.fixture(scope="module")
+def paged_engine(params):
+    with dk.forced_mode("always"):
+        eng = DecodeEngine(params, num_heads=HEADS, num_slots=SLOTS,
+                           max_len=MAX_LEN, prefill_buckets=BUCKETS,
+                           name="kern_paged", kv_layout="paged",
+                           kv_block_size=BS)
+    assert eng.decode_kernels
+    return eng
+
+
+def _prompt(rng, n=None):
+    return rng.randint(1, VOCAB, n or rng.randint(3, BUCKETS[-1] + 1)
+                       ).astype(np.int32)
+
+
+def _oracle(params, engine, prompt, n_tokens):
+    """Single-request greedy lm_generate — runs the REFERENCE XLA path
+    (kernels are off outside forced_mode on CPU), so engine-vs-oracle
+    equality crosses the kernel/reference boundary."""
+    bucket = engine.prefill_bucket_for(prompt.size)
+    padded = np.zeros((1, bucket), np.int32)
+    padded[0, :prompt.size] = prompt
+    ids = np.asarray(transformer.lm_generate(
+        params, padded, max_len=engine.max_len, num_heads=HEADS,
+        prompt_lengths=np.asarray([prompt.size])))
+    return ids[0, prompt.size:prompt.size + n_tokens].tolist()
+
+
+def _drive(bat, cases, stagger_s=0.004):
+    results, excs = [None] * len(cases), [None] * len(cases)
+
+    def client(i):
+        prompt, n = cases[i]
+        try:
+            time.sleep(stagger_s * i)
+            results[i] = bat.submit(prompt, max_tokens=n).result(120)
+        except Exception as e:      # noqa: BLE001
+            excs[i] = e
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(cases))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(180)
+        assert not t.is_alive(), "client thread wedged: DEADLOCK"
+    return results, excs
+
+
+# --------------------------------------------------------- kernel numerics
+
+
+def _ref_slab(q, k, v, positions, num_heads):
+    t = k.shape[1]
+    pm = jnp.arange(t)[None, :] <= jnp.asarray(positions)[:, None]
+    return np.asarray(transformer._attend(
+        jnp.asarray(q)[:, None], jnp.asarray(k), jnp.asarray(v),
+        num_heads, jnp.broadcast_to(pm, (q.shape[0], t))))[:, 0]
+
+
+@pytest.mark.parametrize("h,hkv,dh,t", [(2, 2, 16, 24), (4, 2, 8, 48),
+                                        (4, 1, 32, 16), (2, 2, 64, 130)])
+def test_slab_kernel_matches_attend(h, hkv, dh, t):
+    rng = np.random.RandomState(h * 100 + t)
+    s, d, dkv = 5, h * dh, hkv * dh
+    q = rng.randn(s, d).astype(np.float32)
+    k = rng.randn(s, t, dkv).astype(np.float32)
+    v = rng.randn(s, t, dkv).astype(np.float32)
+    pos = rng.randint(0, t, s).astype(np.int32)
+    with dk.forced_mode("always"):
+        out = dk.maybe_slab(jnp.asarray(q), jnp.asarray(k),
+                            jnp.asarray(v), jnp.asarray(pos), h)
+    assert out is not None
+    np.testing.assert_allclose(np.asarray(out),
+                               _ref_slab(q, k, v, pos, h),
+                               rtol=1e-5, atol=1e-5)
+
+
+def _paged_setup(rng, s, nb, bs, nb_row, dkv, d):
+    """Random pool + per-row private chains (block 0 stays scratch) —
+    the shared builder from testing/kernel_smoke."""
+    from paddle_tpu.testing.kernel_smoke import build_private_tables
+    t = nb_row * bs
+    q = rng.randn(s, d).astype(np.float32)
+    kp = rng.randn(nb, bs, dkv).astype(np.float32)
+    vp = rng.randn(nb, bs, dkv).astype(np.float32)
+    pos = rng.randint(0, t, s).astype(np.int32)
+    tables = build_private_tables(pos, nb_row, bs, nb)
+    return q, kp, vp, pos, tables, t
+
+
+def _ref_paged(q, kp, vp, pos, tables, num_heads):
+    s = q.shape[0]
+    dkv = kp.shape[-1]
+    t = tables.shape[1] * kp.shape[1]
+    k_rows = kp[tables].reshape(s, -1, dkv)
+    v_rows = vp[tables].reshape(s, -1, dkv)
+    pm = np.arange(t)[None, :] <= pos[:, None]
+    return np.asarray(transformer._attend(
+        jnp.asarray(q)[:, None], jnp.asarray(k_rows),
+        jnp.asarray(v_rows), num_heads, jnp.asarray(pm)))[:, 0]
+
+
+@pytest.mark.parametrize("h,hkv,dh,bs", [(2, 2, 16, 8), (4, 2, 8, 4)])
+def test_paged_kernel_matches_chain_gather(h, hkv, dh, bs):
+    rng = np.random.RandomState(h * 10 + bs)
+    s, nb_row = 4, 3
+    d, dkv = h * dh, hkv * dh
+    q, kp, vp, pos, tables, _t = _paged_setup(rng, s, 13, bs, nb_row,
+                                              dkv, d)
+    with dk.forced_mode("always"):
+        out = dk.maybe_paged(jnp.asarray(q), jnp.asarray(kp),
+                             jnp.asarray(vp), jnp.asarray(pos),
+                             jnp.asarray(tables), h)
+    assert out is not None
+    np.testing.assert_allclose(np.asarray(out),
+                               _ref_paged(q, kp, vp, pos, tables, h),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_block_boundary_positions():
+    """Masked-width semantics at the block seams: a row whose position
+    sits on the LAST slot of a block (p % bs == bs-1) must attend that
+    whole block and nothing of the next; the first slot of a block
+    (p % bs == 0) must attend exactly one position of it."""
+    rng = np.random.RandomState(3)
+    h, dh, bs, nb_row = 2, 16, 8, 3
+    d = dkv = h * dh
+    s = 4
+    q, kp, vp, _pos, _tables, t = _paged_setup(rng, s, 13, bs, nb_row,
+                                               dkv, d)
+    from paddle_tpu.testing.kernel_smoke import build_private_tables
+    pos = np.asarray([bs - 1, bs, 2 * bs - 1, 0], np.int32)
+    tables = build_private_tables(pos, nb_row, bs, 13)
+    with dk.forced_mode("always"):
+        out = dk.maybe_paged(jnp.asarray(q), jnp.asarray(kp),
+                             jnp.asarray(vp), jnp.asarray(pos),
+                             jnp.asarray(tables), h)
+    np.testing.assert_allclose(np.asarray(out),
+                               _ref_paged(q, kp, vp, pos, tables, h),
+                               rtol=1e-5, atol=1e-5)
+    # slab twin at the same boundary positions
+    ks = rng.randn(s, t, dkv).astype(np.float32)
+    vs = rng.randn(s, t, dkv).astype(np.float32)
+    with dk.forced_mode("always"):
+        out_s = dk.maybe_slab(jnp.asarray(q), jnp.asarray(ks),
+                              jnp.asarray(vs), jnp.asarray(pos), h)
+    np.testing.assert_allclose(np.asarray(out_s),
+                               _ref_slab(q, ks, vs, pos, h),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_scratch_block_rows_never_attended():
+    """Poison the reserved scratch block 0 with NaN: every ACTIVE row's
+    output must be bit-identical to the clean-pool kernel run — the
+    clamped table walk never even addresses block 0 for a row that owns
+    its chain."""
+    rng = np.random.RandomState(4)
+    h, dh, bs, nb_row = 2, 16, 8, 3
+    d = dkv = h * dh
+    q, kp, vp, pos, tables, _t = _paged_setup(rng, 6, 19, bs, nb_row,
+                                              dkv, d)
+    with dk.forced_mode("always"):
+        clean = dk.maybe_paged(jnp.asarray(q), jnp.asarray(kp),
+                               jnp.asarray(vp), jnp.asarray(pos),
+                               jnp.asarray(tables), h)
+        kp2, vp2 = kp.copy(), vp.copy()
+        kp2[0] = np.nan
+        vp2[0] = np.nan
+        poisoned = dk.maybe_paged(jnp.asarray(q), jnp.asarray(kp2),
+                                  jnp.asarray(vp2), jnp.asarray(pos),
+                                  jnp.asarray(tables), h)
+    np.testing.assert_array_equal(np.asarray(poisoned),
+                                  np.asarray(clean))
+    assert np.all(np.isfinite(np.asarray(poisoned)))
+
+
+def test_dispatch_gating():
+    """auto on CPU -> reference path (None); off -> None even when
+    forced upstream; always -> kernel output; bad mode -> error."""
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(2, 32), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 16, 32), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 16, 32), jnp.float32)
+    pos = jnp.asarray([3, 7], jnp.int32)
+    with dk.forced_mode("auto"):
+        assert dk.maybe_slab(q, k, v, pos, 2) is None   # CPU backend
+    with dk.forced_mode("off"):
+        assert dk.maybe_slab(q, k, v, pos, 2) is None
+    with dk.forced_mode("always"):
+        assert dk.maybe_slab(q, k, v, pos, 2) is not None
+    with dk.forced_mode("bogus"), pytest.raises(ValueError,
+                                                match="pallas_decode"):
+        dk.decode_kernels_enabled()
+    # the FLAGS path (MODE=None reads utils.flags.FLAGS.pallas_decode)
+    from paddle_tpu.utils.flags import FLAGS
+    old = FLAGS.pallas_decode
+    try:
+        FLAGS.pallas_decode = "always"
+        assert dk.decode_kernels_enabled()
+        FLAGS.pallas_decode = "off"
+        assert not dk.decode_kernels_enabled()
+    finally:
+        FLAGS.pallas_decode = old
+
+
+def test_untileable_shapes_fall_back_not_crash():
+    """Shapes the lane-replicated stat layout cannot express must
+    DECLINE (None -> reference path), never fail mid-trace: a paged
+    block_size of 136 (> LANES, not a LANES multiple — `_lanes` can
+    neither slice nor tile it) and an interpret-mode head dim of 136
+    both go through `covers` -> False."""
+    rng = np.random.RandomState(6)
+    with dk.forced_mode("always"):
+        assert not dk.covers(2, 32, 32, 136, paged=True)
+        q = jnp.asarray(rng.randn(2, 32), jnp.float32)
+        kp = jnp.asarray(rng.randn(5, 136, 32), jnp.float32)
+        tbl = jnp.zeros((2, 2), jnp.int32)
+        pos = jnp.asarray([3, 7], jnp.int32)
+        assert dk.maybe_paged(q, kp, kp, pos, tbl, 2) is None
+        # dh = 136: _lanes on the [H, dh] accumulator can't tile either
+        assert not dk.covers(2, 272, 272, 16, paged=True)
+        q2 = jnp.asarray(rng.randn(2, 272), jnp.float32)
+        k2 = jnp.asarray(rng.randn(2, 16, 272), jnp.float32)
+        assert dk.maybe_slab(q2, k2, k2, pos, 2) is None
+
+
+# ------------------------------------------------------- engine parity
+
+
+def test_slab_engine_greedy_bit_identical_no_retrace(params, slab_engine):
+    """Staggered admissions through the KERNEL-compiled slab step: every
+    greedy stream token-identical to the reference-path lm_generate
+    oracle; 1 warm-up trace, 0 retraces across churn."""
+    eng = slab_engine
+    eng.metrics = ServingMetrics()
+    assert eng.step_trace_count == 1
+    rng = np.random.RandomState(11)
+    cases = [(_prompt(rng), int(rng.randint(2, 13))) for _ in range(6)]
+    with assert_no_retrace(lambda: eng.step_trace_count,
+                           "fused slab churn"):
+        bat = GenerationBatcher(eng, default_max_tokens=8)
+        results, excs = _drive(bat, cases)
+        bat.close()
+    assert all(e is None for e in excs), excs
+    for (prompt, n), res in zip(cases, results):
+        assert res["tokens"] == _oracle(params, eng, prompt, n), \
+            f"prompt len {prompt.size}, n {n}"
+    assert eng.free_slots == SLOTS
+
+
+def test_paged_engine_greedy_bit_identical_under_churn(params,
+                                                      paged_engine):
+    """The paged kernel under real allocator churn: shared prompts
+    (prefix hit + CoW fork), mixed lengths, slot reuse — streams
+    token-identical to the oracle, zero retraces of step/write/fork."""
+    eng = paged_engine
+    eng.metrics = ServingMetrics()
+    rng = np.random.RandomState(12)
+    shared = _prompt(rng, BS + 3)
+    cases = [(shared, 8), (shared, 8)]
+    cases += [(_prompt(rng), int(rng.randint(2, 11))) for _ in range(5)]
+    with assert_no_retrace(lambda: eng.step_trace_count
+                           + eng._write_traces[0] + eng._copy_traces[0],
+                           "fused paged churn (admit/CoW/evict)"):
+        bat = GenerationBatcher(eng, default_max_tokens=8)
+        results, excs = _drive(bat, cases)
+        bat.close()
+    assert all(e is None for e in excs), excs
+    for (prompt, n), res in zip(cases, results):
+        assert res["tokens"] == _oracle(params, eng, prompt, n), \
+            f"prompt len {prompt.size}, n {n}"
+    snap = eng.metrics.snapshot()
+    assert snap["prefix_cache_hits_total"] >= 1
+    assert snap["cow_forks_total"] >= 1
+    eng._paged.check()
+
+
+@pytest.mark.slow
+def test_supervisor_recovery_with_kernels_bit_identical(params,
+                                                        paged_engine):
+    """The PR-6 chaos case with the kernels compiled in: an injected
+    decode-step fault rebuilds the pool and the supervisor re-seats
+    every in-flight stream — all streams bit-identical to the
+    reference-path oracle, ZERO extra traces (recovery re-runs the same
+    compiled kernel step), exact fault counts, ledger balanced."""
+    eng = paged_engine
+    eng.metrics = ServingMetrics()
+    rng = np.random.RandomState(13)
+    cases = [(_prompt(rng), 4 + (i % 5)) for i in range(8)]
+    ref = [_oracle(params, eng, p, n) for p, n in cases]
+    sup = Supervisor(breaker_threshold=10)
+    bat = GenerationBatcher(eng, supervisor=sup)
+    faults.install_spec("serving.decode_step:at=6")
+    with assert_no_retrace(lambda: eng.step_trace_count,
+                           "fused paged chaos recovery"):
+        results, excs = _drive(bat, cases)
+        bat.close()
+    assert faults.fired_counts() == {"serving.decode_step": 1}
+    faults.clear()
+    assert all(e is None for e in excs), excs
+    assert [r["tokens"] for r in results] == ref
+    snap = eng.metrics.snapshot()
+    assert snap["evictions"]["recovered"] >= 1
+    assert snap["slot_reprefills_total"] >= 1
+    eng._paged.check()
+    assert eng.free_slots == eng.num_slots
+
+
+# --------------------------------------------------- fusion-proof gate
+
+
+@pytest.mark.slow
+def test_fusion_proof_gate_both_directions(paged_engine):
+    """perf/analytic.assert_decode_fused: clean on the fused step's
+    post-optimization HLO, and the SAME detector flags the reference
+    chain-gather step — the PR-3 de-fusion detector run in reverse."""
+    eng = paged_engine
+    t_span = eng._paged.tables.shape[1] * eng.block_size
+    dkv = int(eng.params["enc"][0]["attn"]["wk"].shape[1])
+    with dk.forced_mode("always"):
+        fused_text = eng.lower().compile().as_text()
+    perf_analytic.assert_decode_fused(fused_text, eng.num_slots, t_span,
+                                      dkv)
+
+    def staged(mode):
+        # a FRESH jit wrapper per mode: the dispatch is read at trace
+        # time and pjit caches the engine step's jaxpr by avals, so
+        # flipping the mode around eng.lower() would silently reuse the
+        # warm-up trace
+        with dk.forced_mode(mode):
+            def fn(p, c, tok, po, tbl):
+                return transformer.lm_decode_step_paged(p, tok, po, c,
+                                                        tbl, HEADS)
+            return jax.jit(fn).lower(
+                eng.params, eng._cache, eng._tokens, eng._pos,
+                eng._paged.tables).compile().as_text()
+
+    ref_text = staged("off")
+    hits = perf_analytic.chain_buffer_instrs(ref_text, eng.num_slots,
+                                             t_span, dkv)
+    assert hits, "detector missed the reference chain gather"
+    with pytest.raises(AssertionError, match="full-chain"):
+        perf_analytic.assert_decode_fused(ref_text, eng.num_slots,
+                                          t_span, dkv)
+
+
+def test_chain_buffer_detector_shapes():
+    """The detector keys on leading-dim == S and exact element count, so
+    the pool itself (leading dim num_blocks) and small row buffers never
+    false-positive."""
+    hlo = """ENTRY main {
+  %p = f32[257,8,128]{2,1,0} parameter(0)
+  %g = f32[4,6,8,32]{3,2,1,0} gather(f32[49,8,32]{2,1,0} %p2, s32[4,6,1]{2,1,0} %i)
+  %r = f32[4,48,32]{2,1,0} reshape(f32[4,6,8,32]{3,2,1,0} %g)
+  %small = f32[4,32]{1,0} add(f32[4,32]{1,0} %a, f32[4,32]{1,0} %b)
+}"""
+    hits = perf_analytic.chain_buffer_instrs(hlo, 4, 48, 32)
+    assert len(hits) == 2           # the gather and its reshape
+    assert not perf_analytic.chain_buffer_instrs(hlo, 8, 48, 32)
+
+
+# ------------------------------------------------------------- rope
+
+
+@pytest.mark.slow
+def test_rope_trunk_slab_kernel_bit_identical():
+    """Rope rotation happens BEFORE the kernel (q/k_new pre-rotated, the
+    cache stores rotated keys) — the kernel path must keep the rope
+    trunk's engine streams token-identical to lm_generate too."""
+    rope_params = transformer.init(jax.random.PRNGKey(2), src_vocab=VOCAB,
+                                   trg_vocab=1, d_model=D_MODEL,
+                                   num_heads=HEADS, dff=64,
+                                   enc_layers=LAYERS, dec_layers=0,
+                                   max_len=MAX_LEN, pos_type="rope")
+    with dk.forced_mode("always"):
+        eng = DecodeEngine(rope_params, num_heads=HEADS, num_slots=2,
+                           max_len=MAX_LEN, prefill_buckets=(8,),
+                           name="kern_rope", pos_type="rope")
+    assert eng.decode_kernels
+    bat = GenerationBatcher(eng, default_max_tokens=6)
+    rng = np.random.RandomState(14)
+    prompt = _prompt(rng, 6)
+    res = bat.submit(prompt, max_tokens=6).result(60)
+    bat.close()
+    padded = np.zeros((1, 8), np.int32)
+    padded[0, :prompt.size] = prompt
+    ids = np.asarray(transformer.lm_generate(
+        rope_params, padded, max_len=MAX_LEN, num_heads=HEADS,
+        prompt_lengths=np.asarray([prompt.size]), pos_type="rope"))
+    assert res["tokens"] == ids[0, prompt.size:prompt.size + 6].tolist()
