@@ -1658,6 +1658,238 @@ def bench_serving_fleet(replicas=2, n_requests=16, vocab=256, max_len=64,
         f"max_tokens {gen_short}/{gen_long})"), extras
 
 
+def bench_serving_autoscale(replicas=2, n_requests=24, n_clients=8,
+                            vocab=256, max_len=64, prefill_buckets=(8, 16),
+                            gen_tokens=12, seed=0):
+    """SLO-holding control plane (serving/autoscaler.py + serving/
+    overload.py; docs/serving.md §8): the SAME seeded load spike driven
+    through the router twice — once over a FIXED 1-replica fleet (what
+    static provisioning gives you when the operator guessed low), once
+    over an AUTOSCALED fleet (min 1, max ``replicas``) whose control
+    loop watches the router's recent-window TTFT p99 and scales out
+    mid-spike.  extras carry goodput (useful tokens/s), p99 TTFT, and
+    the overload controller's shed rate for BOTH sides, plus the
+    autoscaler's decision evidence (scale-outs, journal length).
+
+    The autoscaler and overload controller are host-side only — the AOT
+    hook is the SAME slab decode step the replicas run (a local
+    DecodeEngine, never executed here), so the analytic row gates the
+    serving hot path and the control plane adds zero new traces by
+    construction."""
+    import atexit
+    import json as _json
+    import urllib.error
+    import urllib.request
+    import jax
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving.autoscaler import Autoscaler
+    from paddle_tpu.serving.decode_engine import DecodeEngine
+    from paddle_tpu.serving.overload import AIMDLimiter, OverloadController
+
+    d_model, heads, dff, layers = 32, 2, 64, 2   # the --demo-generate trunk
+    slots = 4                                    # small slab: 16 clients queue
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=1, d_model=d_model, num_heads=heads,
+                              dff=dff, enc_layers=layers, dec_layers=0,
+                              max_len=max_len)
+    local = DecodeEngine(params, num_heads=heads, num_slots=slots,
+                         max_len=max_len, prefill_buckets=prefill_buckets,
+                         name="bench_autoscale", warm=False)
+    extras = {"lower": lambda: local.lower()}
+    rng = np.random.RandomState(seed)
+    reqs = [(rng.randint(1, vocab,
+                         rng.randint(3, prefill_buckets[-1] + 1)).tolist(),
+             gen_tokens) for _ in range(n_requests)]
+    # the injected decode-step hang paces tokens (~20ms each): queue
+    # pressure then comes from PACING, not CPU saturation, so the
+    # 8-client spike breaches TTFT deterministically even on a 1-core
+    # CI host (sleeping server threads don't starve the clients)
+    replica_args = ["--gen-slots", str(slots), "--gen-max-len",
+                    str(max_len), "--gen-prefill-buckets",
+                    ",".join(str(b) for b in prefill_buckets),
+                    "--gen-max-tokens", str(gen_tokens),
+                    "--fault-spec",
+                    "serving.decode_step:every=1,action=hang,hang_s=0.02"]
+    state = {}
+
+    def _controller():
+        # a modest AIMD limit so the spike actually exercises the
+        # shedding path on the under-provisioned side
+        return OverloadController(limiter=AIMDLimiter(
+            initial=6, min_limit=2, max_limit=64))
+
+    def _spawn(autoscale):
+        from paddle_tpu.serving.fleet import ReplicaSupervisor
+        from paddle_tpu.serving.router import Router
+        sup = ReplicaSupervisor(
+            n_replicas=1, extra_args=replica_args,
+            name=f"bench_autoscale{'_as' if autoscale else '_fixed'}"
+        ).start()
+        if not sup.wait_ready(timeout=300):
+            sup.stop()
+            raise RuntimeError("seed replica never became ready")
+        router = Router(supervisor=sup, poll_interval_s=0.1,
+                        overload=_controller())
+        scaler = None
+        if autoscale:
+            scaler = Autoscaler(sup, router, poll_interval_s=0.25,
+                                target_ttft_ms=150.0, hysteresis=0.2,
+                                breach_polls=2, slack_polls=1 << 30,
+                                cooldown_out_s=1.0, cooldown_in_s=1e9,
+                                min_replicas=1, max_replicas=int(replicas),
+                                window_s=5.0, seed=seed).start()
+        httpd = router.start(port=0)
+        t0 = time.perf_counter()
+        while not router.ready():
+            if time.perf_counter() - t0 > 30:
+                raise RuntimeError("router never saw a ready replica")
+            time.sleep(0.05)
+        return sup, router, scaler, httpd.port
+
+    def drive(port, reqs):
+        """Closed-loop seeded spike: n_clients workers drain the request
+        list.  A 429 shed is counted as BACKPRESSURE and the client
+        honors its Retry-After (capped for bench scale) before retrying
+        the same request; any other failure (5xx, starved socket) is
+        counted separately as an error — so shed_rate measures real
+        overload shedding, not restart-window noise, and a request that
+        exhausts its retries is reported as LOST, never silently
+        dropped from the goodput denominator."""
+        ttfts, tokens, sheds, errors, lost = [], [0], [0], [0], [0]
+        lock, nxt = threading.Lock(), [0]
+
+        def client():
+            while True:
+                with lock:
+                    i = nxt[0]
+                    if i >= len(reqs):
+                        return
+                    nxt[0] += 1
+                prompt, mt = reqs[i]
+                body = _json.dumps({"prompt": prompt,
+                                    "max_tokens": mt}).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/generate", data=body,
+                    headers={"Content-Type": "application/json"})
+                for attempt in range(50):
+                    try:
+                        with urllib.request.urlopen(req, timeout=300) as r:
+                            out = _json.loads(r.read())
+                    except urllib.error.HTTPError as e:
+                        ra = e.headers.get("Retry-After") \
+                            if e.code == 429 else None
+                        with lock:
+                            if e.code == 429:
+                                sheds[0] += 1
+                            else:
+                                errors[0] += 1
+                        e.read()
+                        e.close()
+                        try:
+                            backoff = float(ra)
+                        except (TypeError, ValueError):
+                            backoff = 0.05
+                        time.sleep(min(backoff, 0.25))
+                        continue
+                    except Exception:   # noqa: BLE001 — a starved socket
+                        with lock:      # on a loaded CI host: brief
+                            errors[0] += 1  # backoff, retry
+                        time.sleep(0.05)
+                        continue
+                    with lock:
+                        ttfts.append(out["ttft_ms"])
+                        tokens[0] += len(out["tokens"])
+                    break
+                else:
+                    with lock:
+                        lost[0] += 1    # retries exhausted: visible,
+                    #                     not silently dropped
+
+        ts = [threading.Thread(target=client) for _ in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        ttfts.sort()
+        return {
+            "tokens_per_s": round(tokens[0] / dt, 1),
+            "ttft_p99_ms": round(
+                ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))], 2)
+            if ttfts else None,
+            "completed": len(ttfts),
+            "shed": sheds[0],
+            "errors": errors[0],
+            "lost": lost[0],
+            "shed_rate": round(sheds[0] / max(1, sheds[0] + len(ttfts)),
+                               3),
+        }
+
+    if os.environ.get("BENCH_ANALYTIC_BUILD") != "1":
+        # ---- fixed 1-replica side: the same spike, nowhere to grow
+        sup_f, router_f, _, port_f = _spawn(autoscale=False)
+        try:
+            drive(port_f, reqs[:8])             # warm the path
+            fixed = drive(port_f, reqs)
+        finally:
+            router_f.close()
+            sup_f.stop()
+        # ---- autoscaled side: spike until the loop scales out, then
+        # the measured drive runs on the adapted fleet
+        sup, router, scaler, port = _spawn(autoscale=True)
+        state.update(sup=sup, router=router, scaler=scaler, port=port)
+        atexit.register(lambda: (scaler.close(), router.close(),
+                                 sup.stop()))
+        drive(port, reqs[:8])                   # warm
+        t0 = time.perf_counter()
+        while len(sup.replicas) < int(replicas) \
+                and time.perf_counter() - t0 < 300:
+            drive(port, reqs)                   # spike pressure
+        sup.wait_ready(timeout=300)
+        # let the router's poller actually see the new replica before
+        # the measured drive, or the first batch still queues on r0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 30 and sum(
+                1 for st in router.replica_states().values()
+                if st["ready"]) < int(replicas):
+            time.sleep(0.1)
+        scaled = drive(port, reqs)
+        snap = scaler.snapshot()
+        extras.update(
+            fixed_tokens_per_s=fixed["tokens_per_s"],
+            fixed_ttft_p99_ms=fixed["ttft_p99_ms"],
+            fixed_shed_rate=fixed["shed_rate"],
+            fixed_errors=fixed["errors"],
+            fixed_lost=fixed["lost"],
+            autoscaled_tokens_per_s=scaled["tokens_per_s"],
+            autoscaled_ttft_p99_ms=scaled["ttft_p99_ms"],
+            autoscaled_shed_rate=scaled["shed_rate"],
+            autoscaled_errors=scaled["errors"],
+            autoscaled_lost=scaled["lost"],
+            autoscaled_replicas=len(sup.replicas),
+            goodput_speedup=round(scaled["tokens_per_s"]
+                                  / max(fixed["tokens_per_s"], 1e-9), 2),
+            scale_outs=snap["scales_total"]["out"],
+            scale_failures=snap["scale_failures_total"],
+            journal_len=snap["journal_len"])
+
+    def run(s):
+        r = drive(state["port"], reqs)
+        return np.float32(r["tokens_per_s"])
+
+    total_tokens = sum(mt for _, mt in reqs)
+    per_tok = layers * (6 * d_model ** 2 + 2 * d_model * dff) \
+        + d_model * vocab
+    attn = layers * 4.0 * d_model * max_len * max_len / 2
+    flops = (2.0 * per_tok + attn / max_len) * slots \
+        * (total_tokens / slots)
+    return run, flops, None, (
+        f"autoscaled serving ms/burst ({n_requests} reqs, {n_clients} "
+        f"clients, fixed 1 replica vs autoscaled 1->{replicas}, "
+        f"max_tokens {gen_tokens})"), extras
+
+
 def bench_trainer_prefetch(batch=64, dim=256, hidden=512, n_batches=24,
                            host_ms=4.0):
     """Trainer hot-loop input overlap: steps/s with the input pipeline
@@ -1778,6 +2010,11 @@ _BENCHES = {
     # 1 vs b fleet-supervised replica subprocesses + the kill-9 failover
     # latency probe; b = the replica count
     "serving_fleet": (lambda b: bench_serving_fleet(replicas=b), 2),
+    # SLO-holding control plane (serving/autoscaler.py + overload.py):
+    # the same seeded spike over a fixed 1-replica fleet vs an
+    # autoscaled 1->b fleet — goodput, p99 TTFT, shed rate; b = the
+    # autoscaler's max_replicas
+    "serving_autoscale": (lambda b: bench_serving_autoscale(replicas=b), 2),
     # paged KV-cache serving (serving/kv_pool.py): block-pool layout vs
     # the PR-5 slab at a fixed KV-byte budget — mixed-length packing +
     # shared-prefix prefill elimination; b = the slab slot count (the

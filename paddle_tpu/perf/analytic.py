@@ -66,6 +66,11 @@ FAMILIES = [
     # is host-side only, so its analytic row is the SAME slab decode step
     # the replicas run — the fleet adds zero new traces by construction
     ("serving_fleet", "serving_fleet", None),
+    # SLO-holding control plane (serving/autoscaler.py + overload.py):
+    # autoscaler + overload controller are host-side only, so this row
+    # is again the slab decode step the replicas run — the control
+    # plane adds zero new traces by construction
+    ("serving_autoscale", "serving_autoscale", None),
     # paged KV-cache serving (serving/kv_pool.py + kv_layout="paged"):
     # the PAGED decode step via DecodeEngine.lower — gates the
     # block-gather/scatter step's structure (the block table is data, so
@@ -209,7 +214,7 @@ def capture(name, model, batch=None, chips=("v5e", "v5p")):
     bps = extras.get("batches_per_step")
     if model in ("transformer_serving", "serving", "serving_generate",
                  "serving_fleet", "serving_paged",
-                 "serving_decode_fused"):
+                 "serving_decode_fused", "serving_autoscale"):
         # the lowered program is one batch/slab step while the bench FLOPs
         # model covers the whole stream/burst — scopes differ, no cross-check
         row["bench_model_flops"] = None

@@ -66,6 +66,15 @@ FAULT_POINTS = (
     "trainer.checkpoint.write",    # checkpoint.save_checkpoint mid-write
     "router.dispatch",             # Router._dispatch, the router->replica
     #                                network boundary (serving/router.py)
+    "fleet.spawn",                 # ReplicaSupervisor._spawn, before the
+    #                                subprocess exists (serving/fleet.py):
+    #                                a replica that fails/hangs AT spawn,
+    #                                before it could ever answer /readyz
+    "autoscaler.scale",            # Autoscaler actuation (serving/
+    #                                autoscaler.py): a scale decision
+    #                                whose execution fails — the control
+    #                                loop must retry with backoff, never
+    #                                count an unready replica as capacity
 )
 
 

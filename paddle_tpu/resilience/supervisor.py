@@ -70,11 +70,14 @@ class CircuitBreaker:
     -> half_open -> one probe -> closed | open.  Thread-safe; all state
     is host-side counters, so an always-closed breaker costs nothing."""
 
-    def __init__(self, threshold=5, cooldown_s=5.0):
+    def __init__(self, threshold=5, cooldown_s=5.0, clock=None):
         if int(threshold) < 1:
             raise ValueError("breaker threshold must be >= 1")
         self.threshold = int(threshold)
         self.cooldown_s = float(cooldown_s)
+        # injectable monotonic clock (default real): cooldown tests run
+        # on a simulated clock instead of sleeping the cooldown out
+        self._clock = clock or time.monotonic
         self._lock = threading.Lock()
         self._failures = 0
         self._state = "closed"
@@ -90,7 +93,7 @@ class CircuitBreaker:
 
     def _state_locked(self):
         if self._state == "open" and not self._probe_out \
-                and time.monotonic() - self._opened_at >= self.cooldown_s:
+                and self._clock() - self._opened_at >= self.cooldown_s:
             self._state = "half_open"
         return self._state
 
@@ -107,12 +110,12 @@ class CircuitBreaker:
                 # open/half-open/open node visible in breaker_open_total
                 # instead of looking like one long-ago blip.
                 self._state = "open"
-                self._opened_at = time.monotonic()
+                self._opened_at = self._clock()
                 self.opened_total += 1
                 return True
             if self._state == "closed" and self._failures >= self.threshold:
                 self._state = "open"
-                self._opened_at = time.monotonic()
+                self._opened_at = self._clock()
                 self.opened_total += 1
                 return True
             return False
@@ -144,7 +147,7 @@ class CircuitBreaker:
             if self._state_locked() == "closed":
                 return 0.0
             return max(0.05, self.cooldown_s
-                       - (time.monotonic() - self._opened_at))
+                       - (self._clock() - self._opened_at))
 
     def admit(self):
         """Admission check: (True, None) to admit; (False, retry_after_s)
@@ -154,7 +157,7 @@ class CircuitBreaker:
             st = self._state_locked()
             if st == "closed":
                 return True, None
-            now = time.monotonic()
+            now = self._clock()
             # half-open: one probe per cooldown window.  A probe that
             # never resolves through a step (e.g. it finished at
             # prefill) must not wedge admissions forever — after a

@@ -51,6 +51,12 @@
 #                                        slab + paged kernels compiled in:
 #                                        streams bit-identical to the
 #                                        reference-path twin, 0 retraces)
+# 14. autoscale smoke                    (SLO-holding control plane: 1
+#                                        replica + seeded load spike ->
+#                                        scale-out to 2 and p99 TTFT back
+#                                        under target, spike ends ->
+#                                        rolling scale-in; zero failed
+#                                        requests)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -265,6 +271,18 @@ log "phase 13: fused decode-kernel smoke (pallas_decode vs reference twin)"
 timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-decode-fused \
     > "$ART/decode_fused_smoke.json" 2> "$ART/decode_fused_smoke.log"
 log "decode-fused smoke rc=$? -> $ART/decode_fused_smoke.json"
+
+log "phase 14: autoscale smoke (SLO-holding control plane)"
+# 1 tiny replica + router + autoscaler (min 1, max 2): a seeded load
+# spike breaches the TTFT target -> the control loop scales out to 2
+# (spawn-to-readiness), a post-scale steady drive sits back under
+# target, the spike ends -> sustained slack scales back in through the
+# rolling drain — ZERO failed requests, every completed stream
+# bit-identical to lm_generate — one JSON line
+# (python -m paddle_tpu.serving.autoscaler --smoke; docs/serving.md §8)
+timeout "$T_SERVE" python -m paddle_tpu.serving.autoscaler --smoke \
+    > "$ART/autoscale_smoke.json" 2> "$ART/autoscale_smoke.log"
+log "autoscale smoke rc=$? -> $ART/autoscale_smoke.json"
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

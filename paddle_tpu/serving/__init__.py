@@ -26,13 +26,25 @@ executables (docs/serving.md §3) and continuous-batching generation
                      outlier ejection, bounded retry, hedging, and
                      cross-replica MID-STREAM failover (bit-identical
                      greedy streams; docs/serving.md §7)
+    overload.py      OverloadController — AIMD concurrency limit ahead
+                     of dispatch, priority-class + deadline-aware
+                     shedding (honest 429 + Retry-After), brownout
+                     ladder under sustained SLO breach
+                     (docs/serving.md §8)
+    autoscaler.py    Autoscaler — trace-driven control loop sizing the
+                     replica fleet to its TTFT SLO: target tracking
+                     with hysteresis + cooldowns, spawn-to-readiness
+                     scale-out, zero-failure drain scale-in, journaled
+                     replayable decisions (docs/serving.md §8)
 
     python -m paddle_tpu.serving --artifacts 'model.b*.shlo' --port 8080
     python -m paddle_tpu.serving --demo-generate --port 8080
     python -m paddle_tpu.serving.router --replicas 2 --port 8000
+    python -m paddle_tpu.serving.autoscaler --min-replicas 1 --max-replicas 4
 """
 
 from paddle_tpu.resilience.supervisor import BreakerOpenError, Supervisor
+from paddle_tpu.serving.autoscaler import Autoscaler
 from paddle_tpu.serving.batcher import (BatchExecutionError, Batcher,
                                         DeadlineExceededError,
                                         OverloadedError, ShutdownError)
@@ -41,13 +53,17 @@ from paddle_tpu.serving.engine import (DEFAULT_BUCKETS, InferenceEngine,
                                        InvalidRequestError)
 from paddle_tpu.serving.fleet import ReplicaSupervisor
 from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.overload import (AIMDLimiter, BrownoutLadder,
+                                         OverloadController, ShedError)
 from paddle_tpu.serving.router import Router, RouterMetrics
 from paddle_tpu.serving.server import make_server
 
 __all__ = [
-    "Batcher", "BatchExecutionError", "BreakerOpenError",
-    "DeadlineExceededError", "DecodeEngine", "DEFAULT_BUCKETS",
-    "GenerationBatcher", "InferenceEngine", "InvalidRequestError",
-    "OverloadedError", "ReplicaSupervisor", "Router", "RouterMetrics",
-    "ServingMetrics", "ShutdownError", "Supervisor", "make_server",
+    "AIMDLimiter", "Autoscaler", "Batcher", "BatchExecutionError",
+    "BreakerOpenError", "BrownoutLadder", "DeadlineExceededError",
+    "DecodeEngine", "DEFAULT_BUCKETS", "GenerationBatcher",
+    "InferenceEngine", "InvalidRequestError", "OverloadedError",
+    "OverloadController", "ReplicaSupervisor", "Router", "RouterMetrics",
+    "ServingMetrics", "ShedError", "ShutdownError", "Supervisor",
+    "make_server",
 ]

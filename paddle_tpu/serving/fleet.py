@@ -41,6 +41,7 @@ import tempfile
 import threading
 import time
 
+from paddle_tpu.resilience import faults
 from paddle_tpu.utils.logging import logger
 
 # the default replica: the built-in tiny-LM generation server (bring-up/
@@ -113,6 +114,7 @@ class ReplicaSupervisor:
                 + (list(cmd) if cmd is not None
                    else list(DEFAULT_REPLICA_CMD))
                 + list(extra_args))
+        self._base_cmd = base       # template for add_replica clones
         self._lock = threading.RLock()
         self._stopping = False
         self.replicas = {}
@@ -125,6 +127,9 @@ class ReplicaSupervisor:
             # one seeded jitter stream per replica: deterministic replays
             # under test, de-synchronized restarts in production
             self._rngs[rid] = random.Random(self.seed * 7919 + i)
+        self._next_idx = int(n_replicas)    # rids are never reused: a
+        #                                     scaled-in then scaled-out
+        #                                     replica is a NEW identity
         self._monitor = None
 
     # ------------------------------------------------------------ lifecycle
@@ -136,7 +141,7 @@ class ReplicaSupervisor:
             for rep in self.replicas.values():
                 if rep.proc is None or rep.proc.poll() is not None:
                     if not rep.storm_tripped:
-                        self._spawn(rep)
+                        self._try_spawn(rep)
             if self._monitor is None or not self._monitor.is_alive():
                 self._monitor = threading.Thread(
                     target=self._monitor_loop, daemon=True,
@@ -145,6 +150,12 @@ class ReplicaSupervisor:
         return self
 
     def _spawn(self, rep):
+        # the fleet.spawn fault point models a replica that fails (or
+        # hangs) AT spawn, before it could ever publish a port or answer
+        # /readyz — the autoscaler's scale-out chaos case.  An injected
+        # error propagates to the caller exactly like a real Popen
+        # failure (OSError); _try_spawn turns both into backoff restarts.
+        faults.hit("fleet.spawn")
         try:
             os.remove(rep.port_file)
         except OSError:
@@ -160,6 +171,20 @@ class ReplicaSupervisor:
         rep.state = "starting"
         logger.info("%s: %s spawned (pid %d)", self.name, rep.rid,
                     rep.proc.pid)
+
+    def _try_spawn(self, rep):
+        """_spawn, with a failed spawn (injected fleet.spawn fault, a
+        real fork/exec failure) accounted like an instant crash: backoff
+        restart or storm trip — never an unhandled exception in the
+        monitor thread.  Returns True when the subprocess exists."""
+        try:
+            self._spawn(rep)
+            return True
+        except Exception as e:    # noqa: BLE001 — spawn failure == crash
+            logger.warning("%s: %s spawn failed: %s: %s", self.name,
+                           rep.rid, type(e).__name__, e)
+            self._on_spawn_failure(rep, time.monotonic())
+            return False
 
     def _read_port(self, rep):
         if rep.port is None:
@@ -190,14 +215,23 @@ class ReplicaSupervisor:
                             self._on_crash(rep, now)
                     elif rep.state == "backoff" \
                             and now >= rep.next_restart_at:
-                        rep.restarts_total += 1
-                        self._spawn(rep)
+                        if self._try_spawn(rep):
+                            rep.restarts_total += 1
             time.sleep(0.05)
 
     def _on_crash(self, rep, now):
         """An exit nobody asked for (crash, OOM kill, kill -9): schedule
         a backoff restart, or trip the storm breaker."""
-        rc = rep.proc.returncode
+        self._schedule_restart(rep, now, rep.proc.returncode)
+
+    def _on_spawn_failure(self, rep, now):
+        """The subprocess never came to exist (fleet.spawn fault, fork/
+        exec failure): same backoff/storm accounting as an instant
+        crash."""
+        rep.state = "backoff"       # there is no proc to poll
+        self._schedule_restart(rep, now, "spawn_failed")
+
+    def _schedule_restart(self, rep, now, rc):
         rep.consecutive_failures += 1
         rep.crash_times.append(now)
         in_window = [t for t in rep.crash_times
@@ -219,6 +253,81 @@ class ReplicaSupervisor:
         logger.warning("%s: %s exited rc=%s (crash #%d); restarting in "
                        "%.2fs", self.name, rep.rid, rc,
                        rep.consecutive_failures, delay)
+
+    # ------------------------------------------------------------ scaling
+
+    def add_replica(self):
+        """Scale-out primitive (serving/autoscaler.py): spawn ONE new
+        replica under supervision and return its rid.  The rid is fresh
+        (never reuses a removed replica's identity, so the router builds
+        a clean view with a fresh breaker).  Raises when the spawn
+        itself fails (fleet.spawn fault, fork/exec failure) — the caller
+        owns the retry policy; nothing is registered on failure, so a
+        failed scale-out leaves the fleet exactly as it was."""
+        with self._lock:
+            if self._stopping:
+                raise RuntimeError(f"{self.name} is stopping")
+            i = self._next_idx
+            rid = f"r{i}"
+            pf = os.path.join(self.base_dir, f"{rid}.port")
+            rep = _Replica(rid, self._base_cmd, pf,
+                           os.path.join(self.base_dir, f"{rid}.log"))
+            self._spawn(rep)        # raises on failure: register nothing
+            self._next_idx = i + 1
+            self.replicas[rid] = rep
+            self._rngs[rid] = random.Random(self.seed * 7919 + i)
+        logger.info("%s: scaled OUT to %d replicas (+%s)", self.name,
+                    len(self.replicas), rid)
+        return rid
+
+    def remove_replica(self, rid, drain_timeout=60.0):
+        """Scale-in primitive: gracefully drain the replica (SIGTERM —
+        it finishes queued work under its drain deadline while the
+        router routes around it), then FORGET it (endpoints()/snapshot()
+        no longer list it; the monitor never restarts it).  A replica
+        with no live process (spawn failed, backoff, storm-tripped) is
+        CLAIMED under the monitor's lock before being forgotten — the
+        not-running check and the state flip happen in ONE lock
+        acquisition, so the monitor's backoff branch can never respawn
+        a replica this removal is about to drop (which would leak an
+        orphaned, unsupervised subprocess)."""
+        for _ in range(3):
+            with self._lock:
+                rep = self.replicas.get(rid)
+                if rep is None:
+                    return
+                if rep.proc is None or rep.proc.poll() is not None:
+                    # dead/backoff: state leaves the monitor's respawn
+                    # set ATOMICALLY with the liveness check
+                    rep.expected_exit = True
+                    rep.state = "stopped"
+                    self.replicas.pop(rid, None)
+                    self._rngs.pop(rid, None)
+                    n = len(self.replicas)
+                    logger.info("%s: scaled IN to %d replicas (-%s, "
+                                "was not running)", self.name, n, rid)
+                    return
+            try:
+                self.drain(rid, timeout=drain_timeout, restart=False)
+                break
+            except RuntimeError:
+                # the process exited between the check and the drain
+                # (crash, or the monitor replaced it) — re-examine
+                continue
+        with self._lock:
+            rep = self.replicas.pop(rid, None)
+            self._rngs.pop(rid, None)
+            if rep is not None and rep.proc is not None \
+                    and rep.proc.poll() is None:
+                # backstop (retry loop exhausted by repeated races): a
+                # forgotten replica must never keep a live process
+                rep.expected_exit = True
+                try:
+                    os.kill(rep.proc.pid, signal.SIGTERM)
+                except OSError:
+                    pass
+        logger.info("%s: scaled IN to %d replicas (-%s)", self.name,
+                    len(self.replicas), rid)
 
     # ------------------------------------------------------------ chaos/ops
 
@@ -253,7 +362,7 @@ class ReplicaSupervisor:
         with self._lock:
             rep.drains_total += 1
             if restart and not self._stopping:
-                self._spawn(rep)
+                self._try_spawn(rep)
             else:
                 rep.state = "stopped"
 

@@ -43,10 +43,19 @@ _QUANTILES = (50, 95, 99)
 
 
 class ServingMetrics:
-    """Thread-safe counters + latency/batch histograms for one engine."""
+    """Thread-safe counters + latency/batch histograms for one engine.
 
-    def __init__(self, name="paddle_tpu_serving", max_samples=100000):
+    clock: injectable zero-arg monotonic clock threaded into every
+    recent-window histogram (default: ``time.monotonic`` — zero behavior
+    change), so the autoscaler's windowed SLO reads
+    (``ttft.percentiles(window_s=...)``) and the tests that drive them
+    run on a simulated clock instead of wall-clock sleeps."""
+
+    def __init__(self, name="paddle_tpu_serving", max_samples=100000,
+                 clock=None):
+        import time as _time
         self.name = name
+        self.clock = clock or _time.monotonic
         self._lock = threading.Lock()
         self.requests_total = 0          # accepted into the queue
         self.responses_total = 0         # futures resolved with a result
@@ -57,21 +66,22 @@ class ServingMetrics:
         self.batch_slots_total = 0       # padded bucket slots executed
         # request wall latency submit -> future resolved (seconds)
         self.latency = Histogram(f"{name}_latency", max_samples=max_samples,
-                                 keep="last")
+                                 keep="last", clock=self.clock)
         # engine batch execution time (seconds)
         self.batch_time = Histogram(f"{name}_batch_time",
-                                    max_samples=max_samples, keep="last")
+                                    max_samples=max_samples, keep="last",
+                                    clock=self.clock)
         # ---- generation serving (decode_engine.py) ----
         # time-to-first-token: submit -> the request's first token exists
         # (prefill done); the latency a chat user feels before anything
         # streams
         self.ttft = Histogram(f"{name}_ttft", max_samples=max_samples,
-                              keep="last")
+                              keep="last", clock=self.clock)
         # time-per-output-token: one slab decode step's wall time — every
         # active request emits exactly one token per step, so this IS the
         # per-token latency of the stream
         self.tpot = Histogram(f"{name}_tpot", max_samples=max_samples,
-                              keep="last")
+                              keep="last", clock=self.clock)
         self.gen_tokens_total = 0        # useful (delivered) tokens
         self.decode_steps_total = 0
         self.active_slot_steps_total = 0  # sum of active slots over steps

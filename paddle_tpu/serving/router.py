@@ -75,17 +75,25 @@ from paddle_tpu.utils.stats import Histogram
 _QUANTILES = (50, 95, 99)
 _QDEPTH_RE = re.compile(r"^\S*_queue_depth (\d+)\s*$", re.MULTILINE)
 
-# router-side rejection reasons (part of the /metrics surface)
-ROUTER_REJECT_REASONS = ("unready", "exhausted")
+# router-side rejection reasons (part of the /metrics surface);
+# shed = the adaptive overload controller refused it (serving/overload.py)
+ROUTER_REJECT_REASONS = ("unready", "exhausted", "shed")
 
 
 class RouterMetrics:
-    """Thread-safe router-side counters + latency histogram.  Replica
-    gauges (ready/queue depth/breaker state) are rendered live by the
-    Router from its replica views."""
+    """Thread-safe router-side counters + latency/TTFT histograms.
+    Replica gauges (ready/queue depth/breaker state) are rendered live
+    by the Router from its replica views.
 
-    def __init__(self, name="paddle_tpu_router", max_samples=100000):
+    clock: injectable zero-arg monotonic clock threaded into the
+    recent-window histograms (default real clock, zero behavior change)
+    so the autoscaler's windowed SLO reads — ``slo_p99_recent_s`` — are
+    deterministically testable on a simulated clock."""
+
+    def __init__(self, name="paddle_tpu_router", max_samples=100000,
+                 clock=None):
         self.name = name
+        self.clock = clock or time.monotonic
         self._lock = threading.Lock()
         self.requests_total = {"infer": 0, "generate": 0}
         self.responses_total = 0
@@ -102,7 +110,35 @@ class RouterMetrics:
         self.client_disconnects_total = 0
         self.tokens_proxied_total = 0
         self.latency = Histogram(f"{name}_latency", max_samples=max_samples,
-                                 keep="last")
+                                 keep="last", clock=self.clock)
+        # fleet-wide time-to-first-token as the ROUTER's clients feel it
+        # (streaming: first forwarded token; unary generate: the
+        # replica-reported ttft_ms) — the autoscaler's primary SLO signal
+        self.ttft = Histogram(f"{name}_ttft", max_samples=max_samples,
+                              keep="last", clock=self.clock)
+
+    def observe_ttft(self, seconds):
+        self.ttft.add(seconds)
+
+    def slo_p99_recent_s(self, window_s=None):
+        """The control loops' SLO signal: recent-window TTFT p99, falling
+        back to request-latency p99 when no generation traffic has
+        produced TTFT samples (an infer-only fleet still gets latency-
+        based control).  Returns None when NEITHER histogram holds a
+        sample in the window — during a total stall nothing completes,
+        and an absent signal must never read as 'healthy 0ms' (the
+        brownout ladder holds its rung; the autoscaler treats no-signal
+        as slack only when the fleet is provably idle)."""
+        import numpy as np
+        for hist in (self.ttft, self.latency):
+            # ONE filtered read per histogram: checking emptiness and
+            # computing the percentile from the same snapshot (two
+            # separate windowed calls could race the window edge and
+            # fabricate a healthy 0.0)
+            samples = hist.recent_samples(window_s)
+            if samples:
+                return float(np.percentile(np.asarray(samples), 99))
+        return None
 
     def _bump(self, table, rid, n=1):
         with self._lock:
@@ -148,6 +184,9 @@ class RouterMetrics:
         out["faults_fired"] = faults.fired_counts()
         out["latency_ms"] = {f"p{q}": round(v * 1e3, 3)
                              for q, v in lat.items()}
+        out["ttft_ms"] = {f"p{q}": round(v * 1e3, 3)
+                          for q, v in self.ttft.percentiles(
+                              _QUANTILES).items()}
         return out
 
 
@@ -157,12 +196,14 @@ class _ReplicaView:
     a new URL gets a FRESH view (fresh breaker — a new process has no
     failure history)."""
 
-    def __init__(self, rid, base_url, eject_threshold, eject_cooldown_s):
+    def __init__(self, rid, base_url, eject_threshold, eject_cooldown_s,
+                 clock=None):
         self.rid = rid
         self.base_url = base_url.rstrip("/")
         u = urlsplit(self.base_url)
         self.host, self.port = u.hostname, u.port
-        self.breaker = CircuitBreaker(eject_threshold, eject_cooldown_s)
+        self.breaker = CircuitBreaker(eject_threshold, eject_cooldown_s,
+                                      clock=clock)
         self.ready = False
         self.not_before = 0.0         # honored Retry-After (monotonic)
         self.queue_depth = 0
@@ -182,13 +223,23 @@ class Router:
                  poll_interval_s=None, unready_grace_s=None,
                  eject_threshold=None,
                  eject_cooldown_s=None, retry_budget=None, hedge_ms=None,
-                 request_timeout_s=300.0, name="router", metrics=None):
+                 request_timeout_s=300.0, name="router", metrics=None,
+                 overload=None, slo_ttft_ms=None, slo_window_s=None,
+                 clock=None):
+        from paddle_tpu.serving.overload import (AIMDLimiter,
+                                                 BrownoutLadder,
+                                                 OverloadController)
         from paddle_tpu.utils.flags import FLAGS
         if (replicas is None) == (supervisor is None):
             raise ValueError("Router needs exactly one of replicas= "
                              "(static URLs) or supervisor= (managed "
                              "fleet)")
         self.supervisor = supervisor
+        # injectable monotonic clock: every time comparison the router
+        # makes (Retry-After penalties, grace deadlines, SLO windows)
+        # reads it, so tests drive the control surfaces on a simulated
+        # clock instead of wall-clock sleeps (default: time.monotonic)
+        self._clock = clock or time.monotonic
         self.poll_interval_s = float(
             poll_interval_s if poll_interval_s is not None
             else FLAGS.router_poll_interval_s)
@@ -207,7 +258,37 @@ class Router:
                               else FLAGS.router_hedge_ms)
         self.request_timeout_s = float(request_timeout_s)
         self.name = name
-        self.metrics = metrics or RouterMetrics()
+        self.metrics = metrics or RouterMetrics(clock=self._clock)
+        # adaptive overload control (serving/overload.py): AIMD
+        # concurrency limit + priority shedding ahead of dispatch, and
+        # the brownout ladder driven by the poll loop's SLO reads.  The
+        # default ladder is DISABLED (overload_slo_ttft_ms = 0) and the
+        # default limiter bounds sit far above normal load, so a router
+        # constructed without arguments behaves exactly as before.
+        self.slo_ttft_ms = float(slo_ttft_ms if slo_ttft_ms is not None
+                                 else FLAGS.overload_slo_ttft_ms)
+        self.slo_window_s = float(slo_window_s if slo_window_s is not None
+                                  else FLAGS.overload_window_s)
+        self.overload = overload or OverloadController(
+            limiter=AIMDLimiter(
+                initial=FLAGS.overload_limit_initial,
+                min_limit=FLAGS.overload_limit_min,
+                max_limit=FLAGS.overload_limit_max,
+                increase=FLAGS.overload_aimd_increase,
+                decrease=FLAGS.overload_aimd_decrease,
+                clock=self._clock),
+            ladder=BrownoutLadder(
+                slo_ttft_s=self.slo_ttft_ms / 1e3,
+                enter_hold_s=FLAGS.overload_brownout_hold_s,
+                exit_hold_s=FLAGS.overload_brownout_exit_s,
+                clock=self._clock),
+            drain_window_s=self.slo_window_s,
+            brownout_max_tokens=FLAGS.overload_brownout_max_tokens,
+            clock=self._clock)
+        # extra /metrics contributors (the autoscaler appends its
+        # autoscaler_* lines here); each is a zero-arg -> [str]
+        self.extra_render_fns = [
+            lambda: self.overload.render_lines(self.metrics.name)]
         self._lock = threading.Lock()
         self._replicas = {}
         self._affinity = {}           # session key -> replica id
@@ -219,7 +300,7 @@ class Router:
             for i, url in enumerate(replicas):
                 self._replicas[f"r{i}"] = _ReplicaView(
                     f"r{i}", url, self.eject_threshold,
-                    self.eject_cooldown_s)
+                    self.eject_cooldown_s, clock=self._clock)
         self._closed = threading.Event()
         self._httpd = None
         self._poller = threading.Thread(target=self._poll_loop, daemon=True,
@@ -239,7 +320,7 @@ class Router:
                     # new or restarted-at-a-new-port replica: fresh view
                     self._replicas[rid] = _ReplicaView(
                         rid, url, self.eject_threshold,
-                        self.eject_cooldown_s)
+                        self.eject_cooldown_s, clock=self._clock)
             for rid in [r for r in self._replicas if r not in eps]:
                 del self._replicas[rid]
 
@@ -259,7 +340,7 @@ class Router:
             ra = e.headers.get("Retry-After")
             if ra is not None:
                 try:
-                    rep.not_before = time.monotonic() + float(ra)
+                    rep.not_before = self._clock() + float(ra)
                 except ValueError:
                     pass
             e.close()
@@ -284,6 +365,22 @@ class Router:
             for rep in reps:
                 self._probe(rep)
             self._track_breakers()
+            # one SLO evaluation per poll: the brownout ladder sees the
+            # recent-window TTFT p99 on the same cadence the replicas
+            # are probed.  Gated on the ROUTER's slo_ttft_ms (not just
+            # the ladder's) so tests can drive an enabled ladder by hand
+            # on a simulated clock without the poll thread racing it.
+            if self.slo_ttft_ms > 0 and self.overload.ladder.enabled:
+                p99 = self.metrics.slo_p99_recent_s(self.slo_window_s)
+                # an empty window (total stall: nothing completed) is NOT
+                # health — hold the current rung rather than walk down
+                if p99 is not None:
+                    rung = self.overload.observe_slo(p99)
+                    if rung != getattr(self, "_last_rung", 0):
+                        obstrace.instant("router.brownout", rung=rung)
+                        logger.warning("%s: brownout rung -> %d",
+                                       self.name, rung)
+                        self._last_rung = rung
             self._closed.wait(self.poll_interval_s)
 
     def _track_breakers(self):
@@ -322,7 +419,7 @@ class Router:
         """Least-loaded eligible replica, or None.  ``session`` pins a
         conversation to its previous replica while that replica stays
         eligible (re-pinned on failover)."""
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             cands = sorted(
                 (r for r in self._replicas.values()
@@ -363,7 +460,7 @@ class Router:
         rep = self._pick_eligible(exclude, session)
         if rep is not None:
             return rep
-        deadline = time.monotonic() + self.unready_grace_s
+        deadline = self._clock() + self.unready_grace_s
         while not self._closed.is_set():
             self._sync_replicas()     # a restarted replica may have just
             #                           appeared at a new port
@@ -374,7 +471,7 @@ class Router:
             if stale:
                 self._track_breakers()
             rep = self._pick_eligible(exclude, session)
-            if rep is not None or time.monotonic() >= deadline:
+            if rep is not None or self._clock() >= deadline:
                 return rep
             self._closed.wait(0.05)
         return None
@@ -383,7 +480,7 @@ class Router:
         """Seconds until routing could plausibly succeed — min over
         replicas of (Retry-After remaining, breaker probe delay, one
         poll interval)."""
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             reps = list(self._replicas.values())
         if not reps:
@@ -458,9 +555,9 @@ class Router:
         rep.ready = False
         ra = (headers or {}).get("Retry-After")
         try:
-            rep.not_before = time.monotonic() + float(ra)
+            rep.not_before = self._clock() + float(ra)
         except (TypeError, ValueError):
-            rep.not_before = time.monotonic() + self.poll_interval_s
+            rep.not_before = self._clock() + self.poll_interval_s
         rep.breaker.release_probe()
 
     # ------------------------------------------------------------ unary
@@ -582,8 +679,14 @@ class Router:
             return st, fwd, data
         if last_503 is not None:
             st, hd, data = last_503
-            return st, {k: v for k, v in hd.items()
-                        if k.lower() == "retry-after"}, data
+            fwd = {k: v for k, v in hd.items()
+                   if k.lower() == "retry-after"}
+            # internal marker (stripped by the handler): this 503 came
+            # FROM a replica — real upstream backpressure, unlike the
+            # router's own no-ready-replica 503 below, which must not
+            # drive the AIMD multiplicative decrease
+            fwd["X-Upstream-Shed"] = "1"
+            return st, fwd, data
         if last_exc is not None:
             self.metrics.reject("exhausted")
             return 502, {}, json.dumps(
@@ -597,7 +700,7 @@ class Router:
     # ------------------------------------------------------------ render
 
     def ready(self):
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             return any(r.ready and now >= r.not_before
                        and r.breaker.state != "open"
@@ -667,6 +770,13 @@ class Router:
             lines.append(f'{n}_latency_seconds{{quantile="0.{q}"}} '
                          f"{v:.6f}")
         lines.append(f"{n}_latency_seconds_count {m.latency.count}")
+        lines.append(f"# HELP {n}_ttft_seconds fleet-wide time to first "
+                     "token as routed clients feel it, recent-window "
+                     "quantiles")
+        lines.append(f"# TYPE {n}_ttft_seconds summary")
+        for q, v in m.ttft.percentiles(_QUANTILES).items():
+            lines.append(f'{n}_ttft_seconds{{quantile="0.{q}"}} {v:.6f}')
+        lines.append(f"{n}_ttft_seconds_count {m.ttft.count}")
         from paddle_tpu.serving.metrics import BREAKER_STATES
         states = self.replica_states()
         for metric, key, help_ in (
@@ -688,6 +798,14 @@ class Router:
             lines.append(
                 f'{n}_replica_breaker_state{{replica="{rid}"}} '
                 f"{BREAKER_STATES.get(states[rid]['breaker'], 0)}")
+        # contributed sections: the overload controller's overload_*/
+        # brownout_* lines, plus anything registered on
+        # extra_render_fns (the autoscaler's autoscaler_* lines)
+        for fn in list(self.extra_render_fns):
+            try:
+                lines.extend(fn())
+            except Exception:   # noqa: BLE001 — a dying contributor
+                pass            # must not kill /metrics
         return "\n".join(lines) + "\n"
 
     # ------------------------------------------------------------ serve
@@ -726,6 +844,16 @@ class RouterHandler(BaseHTTPRequestHandler):
     # the request's root span (obs/trace.py); NULL outside do_POST or
     # with tracing disabled
     _obs = obstrace.NULL
+    # final status code sent downstream this request (drives the AIMD
+    # release: 429/503 = upstream congestion, 200 = clean completion)
+    _status = None
+    # True when this request's shedding response originated at a REPLICA
+    # (real backpressure) rather than the router itself
+    _upstream_shed = False
+    # streaming outcome: None for unary, True when the done record went
+    # out, False when the stream broke after headers (status frozen at
+    # 200 — must not count as a completion for AIMD/drain-rate)
+    _stream_ok = None
 
     def log_message(self, fmt, *args):
         logger.debug("router http: " + fmt, *args)
@@ -734,6 +862,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                headers=None):
         body = (payload if isinstance(payload, bytes)
                 else json.dumps(payload).encode())
+        self._status = code
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
@@ -789,28 +918,112 @@ class RouterHandler(BaseHTTPRequestHandler):
             self._route_post()
 
     def _route_post(self):
+        from paddle_tpu.serving.overload import (OverloadController,
+                                                 ShedError)
         router = self.server.router
-        if self.path == "/v1/infer":
-            body = self._read_body()
-            st, hd, data = router.route_unary(
-                "infer", "/v1/infer", body, hedge=router.hedge_ms != 0)
-            self._reply(st, data, headers=hd)
-            return
-        if self.path != "/v1/generate":
+        if self.path not in ("/v1/infer", "/v1/generate"):
             self._reply(404, {"error": f"no route {self.path!r}"})
             return
         body = self._read_body()
+        req = None
+        if self.path == "/v1/generate":
+            try:
+                req = json.loads(body)
+                assert isinstance(req, dict)
+            except Exception:   # noqa: BLE001 — malformed: a replica
+                req = None      #                 will 400 it
+        # adaptive overload control (serving/overload.py): one permit
+        # per request, held across every retry/failover leg.  Priority
+        # rides the body ("priority") or the X-Priority header; a shed
+        # is an honest 429 with a drain-rate-derived Retry-After.
+        priority = OverloadController.parse_priority(
+            (req or {}).get("priority") or self.headers.get("X-Priority"))
+        deadline_ms = (req or {}).get("deadline_ms")
+        if not isinstance(deadline_ms, (int, float)) or deadline_ms <= 0:
+            deadline_ms = None
         try:
-            req = json.loads(body)
-            assert isinstance(req, dict)
-        except Exception:   # noqa: BLE001 — malformed: any replica 400s it
-            req = None
+            router.overload.admit(priority, deadline_ms=deadline_ms)
+        except ShedError as e:
+            router.metrics.reject("shed")
+            self._obs.set(shed=e.reason, priority=priority)
+            self._reply(429, {"error": f"overloaded ({e.reason}): {e}",
+                              "priority": priority},
+                        headers={"Retry-After": e.retry_after_s})
+            return
+        self._status = None
+        # overloaded=True only for REPLICA-origin backpressure: a 429
+        # here is always an upstream pass-through (the router's own shed
+        # raised above, before the permit existed), a 503 only when the
+        # upstream marker says so — the router's own "no ready replica"
+        # 503 (a restart window, not congestion) must not collapse the
+        # AIMD limit
+        self._upstream_shed = False
+        self._stream_ok = None
+        try:
+            self._route_admitted(router, body, req)
+        finally:
+            st = self._status
+            # a stream whose status line froze at 200 but later broke
+            # (failover budget exhausted, client gone) is NOT a
+            # completion — it must feed neither the drain-rate estimate
+            # nor the additive limit increase
+            router.overload.release(
+                overloaded=st == 429
+                or (st == 503 and self._upstream_shed),
+                completed=st == 200 and self._stream_ok is not False)
+
+    def _strip_shed_marker(self, hd):
+        if hd.pop("X-Upstream-Shed", None) is not None:
+            self._upstream_shed = True
+        return hd
+
+    def _route_admitted(self, router, body, req):
+        if self.path == "/v1/infer":
+            st, hd, data = router.route_unary(
+                "infer", "/v1/infer", body,
+                hedge=router.hedge_ms != 0
+                and router.overload.hedging_allowed())
+            self._reply(st, data, headers=self._strip_shed_marker(hd))
+            return
         session = (req or {}).get("session")
         if not isinstance(session, str):
             session = None          # affinity keys must be hashable strs
         if req is None or not req.get("stream"):
+            # brownout rung 2: cap the effective max_tokens of a unary
+            # generate before it reaches a replica
+            if req is not None \
+                    and router.overload.ladder.capping_tokens():
+                cur = req.get("max_tokens")
+                if not isinstance(cur, int) or cur < 1:
+                    from paddle_tpu.utils.flags import FLAGS
+                    cur = FLAGS.serving_gen_max_tokens
+                req["max_tokens"] = router.overload.cap_max_tokens(cur)
+                body = json.dumps(req).encode()
+            t_start = time.perf_counter()
             st, hd, data = router.route_unary(
                 "generate", "/v1/generate", body, session=session)
+            self._strip_shed_marker(hd)
+            if st == 200:
+                # fleet-wide TTFT as the CLIENT felt it: the replica-
+                # reported ttft_ms misses router-side queueing/retry/
+                # failover time (exactly the wait the autoscaler must
+                # see), so add back everything the router spent beyond
+                # the replica's own post-first-token generation time
+                try:
+                    out = json.loads(data)
+                    rep_ttft = out.get("ttft_ms")
+                    rep_lat = out.get("latency_ms")
+                    if isinstance(rep_ttft, (int, float)):
+                        ttft_ms = rep_ttft
+                        if isinstance(rep_lat, (int, float)) \
+                                and rep_lat >= rep_ttft:
+                            elapsed_ms = (time.perf_counter()
+                                          - t_start) * 1e3
+                            ttft_ms = max(rep_ttft, elapsed_ms
+                                          - (rep_lat - rep_ttft))
+                        router.metrics.observe_ttft(ttft_ms / 1e3)
+                except Exception:   # noqa: BLE001 — advisory only
+                    pass
             self._reply(st, data, headers=hd)
             return
         self._generate_stream(router, req, session)
@@ -839,6 +1052,10 @@ class RouterHandler(BaseHTTPRequestHandler):
             # "Config parity caveat")
             from paddle_tpu.utils.flags import FLAGS
             eff_max = FLAGS.serving_gen_max_tokens
+        # brownout rung 2: cap the stream's token budget (greedy decode
+        # means the capped stream is a bit-identical PREFIX of the full
+        # one — quality degrades to a shorter answer, never a wrong one)
+        eff_max = router.overload.cap_max_tokens(eff_max)
         eos_id = req.get("eos_id")
         delivered = []                # NEW tokens forwarded downstream
         state = {"headers_sent": False}   # shared with the leg proxy: a
@@ -852,6 +1069,7 @@ class RouterHandler(BaseHTTPRequestHandler):
             if state["headers_sent"]:
                 return
             state["headers_sent"] = True
+            self._status = 200
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
@@ -870,9 +1088,11 @@ class RouterHandler(BaseHTTPRequestHandler):
             out["latency_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
             chunk(out)
             self.wfile.write(b"0\r\n\r\n")
+            self._stream_ok = True
             m.observe_response(time.perf_counter() - t0)
 
         def fail_stream(msg):
+            self._stream_ok = False
             if not state["headers_sent"]:
                 self._reply(502, {"error": msg})
                 return
@@ -905,6 +1125,7 @@ class RouterHandler(BaseHTTPRequestHandler):
             if rep is None:
                 if last_shed is not None and not state["headers_sent"]:
                     st, hd, data = last_shed
+                    self._upstream_shed = True    # replica-origin 503
                     self._reply(st, data,
                                 headers={k: v for k, v in hd.items()
                                          if k.lower() == "retry-after"})
@@ -934,7 +1155,8 @@ class RouterHandler(BaseHTTPRequestHandler):
                 with obstrace.span("router.leg", replica=rep.rid,
                                    attempt=attempts, replay=len(replay)):
                     outcome = self._proxy_leg(router, rep, leg, delivered,
-                                              send_headers, chunk, finish)
+                                              send_headers, chunk, finish,
+                                              t0)
             finally:
                 with router._lock:
                     rep.inflight -= 1
@@ -943,7 +1165,9 @@ class RouterHandler(BaseHTTPRequestHandler):
                 return
             if outcome[0] == "client_gone":
                 # the downstream reader left: upstream already closed
-                # (abandon() fires on the replica); nothing more to say
+                # (abandon() fires on the replica); nothing more to say.
+                # Not a completion — the work was abandoned, not drained
+                self._stream_ok = False
                 m.count("client_disconnects_total")
                 router._record(rep, ok=True)
                 self.close_connection = True
@@ -966,7 +1190,14 @@ class RouterHandler(BaseHTTPRequestHandler):
                     fail_stream(f"failover leg rejected with {st}: "
                                 f"{data.decode(errors='replace')[:200]}")
                 else:
-                    self._reply(st, data)
+                    # a replica-origin 429 (its generation queue is
+                    # full) is a SHED: the Retry-After must survive the
+                    # pass-through — every shed is an honest 429
+                    if st == 429:
+                        self._upstream_shed = True
+                    self._reply(st, data,
+                                headers={k: v for k, v in hd.items()
+                                         if k.lower() == "retry-after"})
                 return
             # upstream failed (transport death, 5xx, error record):
             # charge the breaker and fail over with the delivered prefix
@@ -984,7 +1215,7 @@ class RouterHandler(BaseHTTPRequestHandler):
             m.count("failovers_total")
 
     def _proxy_leg(self, router, rep, leg, delivered,
-                   send_headers, chunk, finish):
+                   send_headers, chunk, finish, t0):
         """One upstream streaming leg.  Returns a tagged outcome:
         ("done",) — the stream completed downstream;
         ("client_gone",) — the downstream client dropped;
@@ -1028,6 +1259,7 @@ class RouterHandler(BaseHTTPRequestHandler):
                     delivered.append(int(rec["token"]))
                     if len(delivered) == 1:
                         self._obs.event("first_token")
+                        m.observe_ttft(time.perf_counter() - t0)
                     streamed_here = True
                     m.count("tokens_proxied_total")
                     try:

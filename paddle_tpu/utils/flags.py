@@ -132,6 +132,42 @@ class Flags:
     fleet_storm_threshold: int = 5      # crashes within the window that
     #                                     trip the restart-storm breaker
     fleet_storm_window_s: float = 30.0  # the restart-storm window
+    # ---- adaptive overload control (serving/overload.py wired into
+    # router.py: AIMD concurrency limit, priority shedding, brownout
+    # ladder; docs/serving.md §8)
+    overload_limit_initial: float = 64.0   # AIMD limit starting point
+    overload_limit_min: float = 4.0        # multiplicative-decrease floor
+    overload_limit_max: float = 4096.0     # additive-increase ceiling
+    overload_aimd_increase: float = 1.0    # +increase/limit per completion
+    overload_aimd_decrease: float = 0.5    # limit *= decrease on overload
+    overload_slo_ttft_ms: float = 0.0      # brownout SLO target (0 = the
+    #                                        ladder is disabled)
+    overload_window_s: float = 30.0        # recent window for the SLO
+    #                                        p99 + the drain-rate estimate
+    overload_brownout_hold_s: float = 3.0  # sustained breach before a
+    #                                        rung is entered
+    overload_brownout_exit_s: float = 5.0  # sustained health before a
+    #                                        rung is exited
+    overload_brownout_max_tokens: int = 32  # rung-2 per-request token cap
+    # ---- autoscaler (serving/autoscaler.py: trace-driven control loop
+    # over the replica fleet; docs/serving.md §8)
+    autoscaler_poll_interval_s: float = 1.0  # metrics poll cadence
+    autoscaler_target_ttft_ms: float = 500.0  # the SLO the loop tracks
+    autoscaler_hysteresis: float = 0.2  # dead band around the target:
+    #                                     out above target*(1+h), in below
+    #                                     target*(1-h) only
+    autoscaler_breach_polls: int = 3    # consecutive breach polls before
+    #                                     a scale-out fires
+    autoscaler_slack_polls: int = 6     # consecutive slack polls before
+    #                                     a scale-in fires
+    autoscaler_cooldown_out_s: float = 10.0  # min gap after ANY scale
+    #                                          before an out fires
+    autoscaler_cooldown_in_s: float = 60.0   # min gap after ANY scale
+    #                                          before an in fires
+    autoscaler_min_replicas: int = 1
+    autoscaler_max_replicas: int = 4
+    autoscaler_window_s: float = 30.0   # recent window for the SLO p99
+    autoscaler_seed: int = 0            # poll jitter + backoff streams
     # ---- resilience (resilience/: deterministic fault injection +
     # supervised recovery; docs/serving.md §6)
     serving_drain_timeout_s: float = 30.0  # SIGTERM drain hard deadline
@@ -358,6 +394,58 @@ FLAG_DOCS = {
                               "that stop further restarts (restart-"
                               "storm breaker)", "—"),
     "fleet_storm_window_s": ("the restart-storm counting window", "—"),
+    "overload_limit_initial": ("router AIMD concurrency limit starting "
+                               "point (serving/overload.py)", "—"),
+    "overload_limit_min": ("AIMD multiplicative-decrease floor", "—"),
+    "overload_limit_max": ("AIMD additive-increase ceiling", "—"),
+    "overload_aimd_increase": ("additive increase applied as "
+                               "increase/limit per clean completion "
+                               "(~ +increase per full window)", "—"),
+    "overload_aimd_decrease": ("multiplicative factor on an upstream "
+                               "overload signal (replica 429/503), at "
+                               "most once per congestion cooldown", "—"),
+    "overload_slo_ttft_ms": ("brownout-ladder SLO target on the "
+                             "router's recent-window TTFT p99; 0 "
+                             "disables the ladder (default)", "—"),
+    "overload_window_s": ("recent window for the SLO p99 and the "
+                          "drain-rate estimate behind Retry-After", "—"),
+    "overload_brownout_hold_s": ("sustained SLO breach before the "
+                                 "ladder steps UP one rung", "—"),
+    "overload_brownout_exit_s": ("sustained health before the ladder "
+                                 "steps DOWN one rung", "—"),
+    "overload_brownout_max_tokens": ("per-request max_tokens cap "
+                                     "applied at brownout rung 2 "
+                                     "(capped streams stay bit-identical "
+                                     "prefixes)", "—"),
+    "autoscaler_poll_interval_s": ("how often the autoscaler reads the "
+                                   "router/replica metrics surface and "
+                                   "evaluates the control law", "—"),
+    "autoscaler_target_ttft_ms": ("the TTFT p99 target the control "
+                                  "loop tracks (serving/autoscaler.py)",
+                                  "—"),
+    "autoscaler_hysteresis": ("dead band around the target: scale out "
+                              "above target*(1+h), scale in below "
+                              "target*(1-h) only — flap damping", "—"),
+    "autoscaler_breach_polls": ("consecutive breach polls before a "
+                                "scale-out fires", "—"),
+    "autoscaler_slack_polls": ("consecutive slack polls before a "
+                               "scale-in fires", "—"),
+    "autoscaler_cooldown_out_s": ("minimum gap after ANY scale action "
+                                  "before a scale-out may fire (short: "
+                                  "react to load fast)", "—"),
+    "autoscaler_cooldown_in_s": ("minimum gap after ANY scale action "
+                                 "before a scale-in may fire (long: a "
+                                 "scale-in cannot promptly undo a "
+                                 "scale-out — flap damping)", "—"),
+    "autoscaler_min_replicas": ("fleet size floor the autoscaler may "
+                                "never go below", "—"),
+    "autoscaler_max_replicas": ("fleet size ceiling the autoscaler may "
+                                "never exceed", "—"),
+    "autoscaler_window_s": ("recent window for the SLO p99 the control "
+                            "law evaluates", "—"),
+    "autoscaler_seed": ("seed for the poll-jitter and actuation-retry "
+                        "backoff streams (decisions replay bit-for-bit)",
+                        "—"),
     "serving_drain_timeout_s": ("hard deadline for the SIGTERM graceful "
                                 "drain; a wedged batch can no longer "
                                 "hang shutdown (second SIGTERM forces "

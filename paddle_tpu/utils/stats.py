@@ -102,9 +102,17 @@ class Histogram:
     right bound for a training pass that resets each pass.  keep="last"
     turns the buffer into a ring holding the most recent max_samples — the
     right bound for a long-running server whose recent latency is the one
-    that matters (serving/metrics.py)."""
+    that matters (serving/metrics.py).
 
-    def __init__(self, name, max_samples=10000, keep="first"):
+    clock: optional zero-arg monotonic clock.  When given, every sample
+    is timestamped at add() time and ``percentiles(qs, window_s=W)``
+    summarizes only the samples observed within the last W seconds of
+    ``clock()`` — the SLO windows the autoscaler's control loop tracks
+    (serving/autoscaler.py).  Tests inject a simulated clock so window
+    expiry is deterministic instead of a wall-clock sleep; with the
+    default real clock the un-windowed behavior is unchanged."""
+
+    def __init__(self, name, max_samples=10000, keep="first", clock=None):
         self.name = name
         self.samples = []
         self.max_samples = max_samples
@@ -112,19 +120,56 @@ class Histogram:
             raise ValueError(f"keep={keep!r} (supported: 'first', 'last')")
         self.keep = keep
         self.count = 0          # total observed, including evicted
+        self.clock = clock
+        self.times = [] if clock is not None else None
 
     def add(self, seconds):
         self.count += 1
+        t = self.clock() if self.clock is not None else None
         if len(self.samples) < self.max_samples:
             self.samples.append(seconds)
+            if self.times is not None:
+                self.times.append(t)
         elif self.keep == "last":
-            self.samples[(self.count - 1) % self.max_samples] = seconds
+            i = (self.count - 1) % self.max_samples
+            self.samples[i] = seconds
+            if self.times is not None:
+                self.times[i] = t
 
-    def percentiles(self, qs=(50, 90, 99)):
+    def recent_samples(self, window_s=None):
+        """The retained samples inside the window (all of them when
+        window_s is None), filtered in ONE pass — callers that need
+        both 'is there a signal' and 'what is its percentile' read this
+        once instead of racing two clock reads against window expiry."""
+        if window_s is None:
+            return list(self.samples)
+        if self.times is None:
+            raise ValueError(
+                f"Histogram {self.name!r} has no clock; window_s "
+                "needs Histogram(clock=...)")
+        cutoff = self.clock() - float(window_s)
+        return [s for s, t in zip(self.samples, self.times)
+                if t >= cutoff]
+
+    def n_recent(self, window_s=None):
+        """How many retained samples fall inside the window — lets
+        callers distinguish 'no signal' from a true 0.0 percentile."""
+        return len(self.recent_samples(window_s))
+
+    def percentiles(self, qs=(50, 90, 99), window_s=None):
         import numpy as np
-        if not self.samples:
+        samples = self.samples
+        if window_s is not None:
+            if self.times is None:
+                raise ValueError(
+                    f"Histogram {self.name!r} has no clock; window_s "
+                    "needs Histogram(clock=...)")
+            cutoff = self.clock() - float(window_s)
+            samples = [s for s, t in zip(self.samples, self.times)
+                       if t >= cutoff]
+        if not samples:
             return {q: 0.0 for q in qs}
-        arr = np.asarray(self.samples)
+        arr = np.asarray(samples)
         return {q: float(np.percentile(arr, q)) for q in qs}
 
     def summary(self):

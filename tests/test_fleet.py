@@ -296,12 +296,25 @@ def test_router_ejection_and_halfopen_readmission():
     """Consecutive dispatch failures eject the replica (requests keep
     succeeding via retry on the healthy one); after the cooldown ONE
     half-open probe readmits it on success — counters count both
-    transitions."""
+    transitions.  The cooldown elapses on the router's INJECTABLE clock
+    (advanced by hand) instead of a wall-clock sleep."""
+
+    class _Clock:
+        def __init__(self):
+            self.t = time.monotonic()
+
+        def __call__(self):
+            return self.t
+
+        def advance(self, dt):
+            self.t += dt
+
+    clock = _Clock()
     a = _StubReplica(infer_mode="fail")     # r0 wins the load tie
     b = _StubReplica()
     router = Router(replicas=[a.url, b.url], poll_interval_s=0.05,
                     eject_threshold=2, eject_cooldown_s=0.4,
-                    retry_budget=2, hedge_ms=0)
+                    retry_budget=2, hedge_ms=0, clock=clock)
     httpd = router.start(port=0)
     try:
         assert _wait(router.ready, 10)
@@ -315,10 +328,11 @@ def test_router_ejection_and_halfopen_readmission():
         hits_after_eject = a.infer_hits
         _post(httpd.port, "/v1/infer", {"feed": {}})
         assert a.infer_hits == hits_after_eject    # ejected: not dialed
-        # heal the replica; after the cooldown the half-open probe lands
-        # on it (load tie -> r0 first) and recloses the breaker
+        # heal the replica; ADVANCE the injected clock past the cooldown
+        # (no wall-clock sleep) — the half-open probe lands on it (load
+        # tie -> r0 first) and recloses the breaker
         a.infer_mode = "ok"
-        time.sleep(0.5)
+        clock.advance(0.5)
         st, _out = _post(httpd.port, "/v1/infer", {"feed": {}})
         assert st == 200
         assert _wait(lambda: router.metrics.snapshot()

@@ -49,7 +49,8 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "serving_gen_smoke.json", "chaos_smoke.json",
                  "fleet_smoke.json", "paged_smoke.json",
                  "trace_smoke.json", "trace_chrome.json",
-                 "decode_fused_smoke.json", "WINDOW_DONE"):
+                 "decode_fused_smoke.json", "autoscale_smoke.json",
+                 "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -138,6 +139,17 @@ def test_dryrun_executes_every_phase(tmp_path):
         assert fused[f"{layout}_kernel_engaged"] is True, fused
         assert fused[f"{layout}_bit_identical"] is True, fused
         assert fused[f"{layout}_retraces"] == 0, fused
+    # the autoscale smoke really closed the loop: the seeded spike
+    # breached the TTFT target, the control loop scaled 1 -> 2 to
+    # readiness, the post-scale drive sat back under target, and the
+    # fleet scaled back in — with zero failed requests
+    asc = json.loads((art / "autoscale_smoke.json").read_text())
+    assert asc["value"] == int(asc["unit"].split("/")[1]), asc
+    assert asc["scaled_out"] is True, asc
+    assert asc["scaled_in"] is True, asc
+    assert asc["recovered_under_target"] is True, asc
+    assert asc["failed"] == 0 and asc["completed"] > 0, asc
+    assert asc["decisions_out"] >= 1 and asc["decisions_in"] >= 1, asc
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows
