@@ -1463,6 +1463,211 @@ def bench_serving_decode_fused(slots=16, vocab=256, d_model=128, dff=256,
         f"fused-vs-reference bytes at 16/64 slots both layouts)"), extras
 
 
+def bench_serving_chunked_prefill(slots=8, n_requests=36, vocab=256,
+                                  d_model=128, dff=256, layers=3, heads=2,
+                                  chunk=8, long_prompt=64, seed=0):
+    """Unified chunked-prefill serving (decode_engine.py prefill_chunk)
+    vs the legacy per-bucket prefill ladder, under MIXED long-prompt /
+    decode traffic: a steady population of short-prompt decode streams
+    plus periodic 64-token-prompt admissions.  The ladder runs each
+    admission's prefill as one monolithic batched pass BETWEEN steps —
+    every in-flight stream stalls for it (the TTFT/TPOT spikes in the
+    PR-9 slot-lifetime traces); the unified engine feeds the same
+    prompt as K-token chunks INSIDE the shared step, bounding per-step
+    work.  Reported per mode: useful tokens/s, long-admission TTFT p99,
+    the recent-window TPOT p99/p50 jitter ratio, and the worst decode
+    stream's max/median inter-token gap (the stall, seen from one
+    stream).
+
+    The analytic leg is the acceptance bar: extras["lower"] is THE one
+    unified chunked step (Tq=chunk kernels forced on) and
+    extras["postcheck"] proves BOTH score matrices dead — no [K, T]
+    buffer in the unified step's HLO, no [Tp, Tp] buffer in the
+    flash-routed legacy prefill — with each detector also shown to fire
+    on its reference twin (perf/analytic.score_matrix_instrs)."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import decode_attention as decode_kernels
+    from paddle_tpu.perf import analytic as perf_analytic
+    from paddle_tpu.serving import GenerationBatcher, ServingMetrics
+    from paddle_tpu.serving.decode_engine import DecodeEngine
+
+    flash_mod = importlib.import_module(
+        "paddle_tpu.ops.pallas.flash_attention")
+    max_len = long_prompt + 32
+    buckets = (8, long_prompt)      # the twin's ladder covers the long
+    #                                 prompts the unified engine chunks
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=1, d_model=d_model, dff=dff,
+                              enc_layers=layers, dec_layers=0,
+                              max_len=max_len, num_heads=heads)
+    warm = os.environ.get("BENCH_ANALYTIC_BUILD") != "1"
+
+    def make_engine(mode):
+        return DecodeEngine(params, num_heads=heads, num_slots=slots,
+                            max_len=max_len, prefill_buckets=buckets,
+                            name=f"bench_cp_{mode}", warm=warm,
+                            prefill_chunk=chunk if mode == "chunked"
+                            else 0)
+
+    rng = np.random.RandomState(seed)
+    # the serving-shaped mix: 3 steady decode streams per 1 long-prompt
+    # admission (short prompt + long emission vs long prompt + short
+    # emission — the exact shape where the ladder's monolithic prefill
+    # spikes every in-flight stream's TPOT)
+    reqs = []
+    for i in range(n_requests):
+        if i % 4 == 3:
+            reqs.append(("long",
+                         rng.randint(1, vocab, long_prompt
+                                     ).astype(np.int32), 4))
+        else:
+            reqs.append(("decode",
+                         rng.randint(1, vocab, rng.randint(4, 9)
+                                     ).astype(np.int32), 24))
+
+    def drive(mode, n_clients=6):
+        engine = make_engine(mode)
+        engine.metrics = ServingMetrics()
+        bat = GenerationBatcher(engine, queue_size=4096)
+        lock, nxt, tokens = threading.Lock(), [0], [0]
+        ttft_long, gaps_by_req = [], []
+
+        def client():
+            while True:
+                with lock:
+                    i = nxt[0]
+                    if i >= len(reqs):
+                        return
+                    nxt[0] += 1
+                klass, prompt, mt = reqs[i]
+                times = []
+                out = bat.submit(prompt, max_tokens=mt,
+                                 on_token=lambda _t:
+                                 times.append(time.perf_counter())
+                                 ).result(300)
+                with lock:
+                    tokens[0] += len(out["tokens"])
+                    if klass == "long":
+                        ttft_long.append(out["ttft_ms"])
+                    elif len(times) >= 8:
+                        g = np.diff(np.asarray(times))
+                        gaps_by_req.append(
+                            float(np.max(g) / max(np.median(g), 1e-9)))
+
+        ts = [threading.Thread(target=client) for _ in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        snap = engine.metrics.snapshot()
+        bat.close()
+        ttft_long.sort()
+        return {
+            "mode": mode,
+            "tokens_per_s": round(tokens[0] / dt, 1),
+            "ttft_long_p99_ms": round(
+                ttft_long[min(len(ttft_long) - 1,
+                              int(len(ttft_long) * 0.99))], 2)
+            if ttft_long else None,
+            "tpot_jitter_p99_p50": snap["tpot_jitter_p99_p50"],
+            "worst_stream_stall_ratio": round(max(gaps_by_req), 2)
+            if gaps_by_req else None,
+            "prefill_chunks_total": snap["prefill_chunks_total"],
+            "mean_prefill_chunk_occupancy":
+                snap["mean_prefill_chunk_occupancy"],
+        }
+
+    def lower_unified():
+        engine = make_engine("chunked")
+        with decode_kernels.forced_mode("always"):
+            return engine.lower()
+
+    def postcheck(compiled):
+        """No serving path materializes a score matrix (the analytic
+        acceptance gate): the unified step's HLO holds no [K, T] score
+        buffer (chunk kernels on; the reference step must trip the same
+        detector), and the legacy prefill routed through flash holds no
+        [Tp, Tp] buffer (the masked reference must trip it too)."""
+        hits = perf_analytic.score_matrix_instrs(compiled.as_text(),
+                                                 chunk, max_len)
+        if hits:
+            raise AssertionError(
+                f"unified chunked step materializes a [{chunk}, "
+                f"{max_len}] score matrix — the Tq=chunk kernel did "
+                "not engage:\n  " + "\n  ".join(hits[:4]))
+        with decode_kernels.forced_mode("off"):
+            ref_hlo = make_engine("chunked").lower().compile().as_text()
+        if not perf_analytic.score_matrix_instrs(ref_hlo, chunk,
+                                                 max_len):
+            raise AssertionError(
+                "score-matrix gate failed to flag the reference "
+                "chunked step — the detector is broken")
+        # legacy prefill half: Tp large enough that flash really blocks
+        # (a single-block run would legitimately hold a [Tp, Tp] tile)
+        tp = 640
+        pf_params = transformer.init(
+            jax.random.PRNGKey(1), src_vocab=vocab, trg_vocab=1,
+            d_model=64, dff=64, enc_layers=1, dec_layers=0,
+            max_len=tp, num_heads=1)
+
+        spec = jax.ShapeDtypeStruct((1, tp), jnp.int32)
+
+        def lower_prefill():
+            # a FRESH closure per mode: the flash routing is read at
+            # trace time, and jax caches traces on the function object
+            # — reusing one closure would hand mode B mode A's trace
+            def prefill_fn(prompt):
+                return transformer.lm_prefill(pf_params, prompt, tp, 1)
+            return jax.jit(prefill_fn).lower(spec).compile().as_text()
+
+        with flash_mod.forced_prefill_mode("always"):
+            flash_hlo = lower_prefill()
+        perf_analytic.assert_prefill_flash(flash_hlo, tp)
+        with flash_mod.forced_prefill_mode("off"):
+            ref_pf_hlo = lower_prefill()
+        if not perf_analytic.score_matrix_instrs(ref_pf_hlo, tp, tp):
+            raise AssertionError(
+                "prefill-flash gate failed to flag the masked XLA "
+                "prefill — the detector is broken")
+        return {"score_matrix_proof": "pass",
+                "prefill_flash_proof": "pass",
+                "prefill_flash_tp": tp}
+
+    extras = {"lower": lower_unified, "postcheck": postcheck}
+    if warm:
+        chunked = drive("chunked")
+        ladder = drive("ladder")
+        extras.update(chunked=chunked, ladder=ladder,
+                      ttft_long_p99_speedup=round(
+                          (ladder["ttft_long_p99_ms"] or 0)
+                          / max(chunked["ttft_long_p99_ms"] or 1e-9,
+                                1e-9), 2),
+                      jitter_ratio_ladder_over_chunked=round(
+                          ladder["tpot_jitter_p99_p50"]
+                          / max(chunked["tpot_jitter_p99_p50"], 1e-9),
+                          2))
+
+    def run(_s):
+        return np.float32(drive("chunked")["tokens_per_s"])
+
+    total_tokens = sum(mt for _k, _p, mt in reqs)
+    prefill_tokens = sum(p.size for _k, p, _mt in reqs)
+    per_tok = layers * (6 * d_model ** 2 + 2 * d_model * dff) \
+        + d_model * vocab
+    attn = layers * 4.0 * d_model * max_len / 2
+    flops = (2.0 * per_tok + attn) * (total_tokens + prefill_tokens)
+    return run, flops, None, (
+        f"chunked-prefill serving tokens/s ({n_requests} reqs, 6 "
+        f"clients, {slots} slots, chunk {chunk}, long prompts "
+        f"{long_prompt}; unified step vs legacy ladder)"), extras
+
+
 def bench_serving_fleet(replicas=2, n_requests=16, vocab=256, max_len=64,
                         prefill_buckets=(8, 16), gen_short=8, gen_long=24,
                         seed=0):
@@ -2026,6 +2231,12 @@ _BENCHES = {
     # the timed paged slot count
     "serving_decode_fused": (lambda b: bench_serving_decode_fused(
         slots=b), 16),
+    # unified chunked-prefill serving vs the legacy prefill ladder
+    # under mixed long-prompt/decode traffic (decode_engine.py
+    # prefill_chunk): TPOT jitter + long-admission TTFT both modes +
+    # the no-score-matrix analytic proof; b = slots
+    "serving_chunked_prefill": (lambda b: bench_serving_chunked_prefill(
+        slots=b), 8),
     "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),
     # input-pipeline overlap row: steps/s at train(prefetch=0) vs 2 on a
     # synthetic input-bound workload (the ShardedPrefetcher's win)

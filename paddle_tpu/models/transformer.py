@@ -484,7 +484,9 @@ def cross_kv(params, enc_out):
 
 
 def _attend(q, k, v, num_heads, mask):
-    """q: [B, Tq, D] against k/v: [B, T, Dkv] with mask [B, T] ->
+    """q: [B, Tq, D] against k/v: [B, T, Dkv] with mask [B, T] (shared
+    by every query lane) or [B, Tq, T] (per-lane — the chunked-prefill
+    step, where lane i of row r attends cols <= positions[r] + i) ->
     [B, Tq, D].  Tiny-Tq attention: always the masked XLA path (flash
     needs big tiles).  Dkv < D means grouped KV heads (GQA) — repeated
     up to full heads here, so the CACHE stays small."""
@@ -497,8 +499,10 @@ def _attend(q, k, v, num_heads, mask):
         k.reshape(b, tk, hkv, dh).transpose(0, 2, 1, 3), num_heads)
     vh = attn_ops.repeat_kv_heads(
         v.reshape(b, tk, hkv, dh).transpose(0, 2, 1, 3), num_heads)
+    mh = (mask[:, None, None, :] if mask.ndim == 2
+          else mask[:, None, :, :])
     out = attn_ops.dot_product_attention(
-        qh, kh, vh, mask=mask[:, None, None, :], use_flash=False)
+        qh, kh, vh, mask=mh, use_flash=False)
     return out.transpose(0, 2, 1, 3).reshape(b, tq, d)
 
 
@@ -658,11 +662,23 @@ def lm_prefill(params, prompt, max_len, num_heads=8, moe_top_k=2,
         hkv = k.shape[-1] // dh
         split = lambda a, hh: a.reshape(b, tp, hh, dh).transpose(
             0, 2, 1, 3)
+        # batched causal pass: the pallas_prefill flag (trace-time, like
+        # pallas_decode) routes it through ops/pallas/flash_attention —
+        # O(Tp) HBM, no [Tp, Tp] score matrix (perf/analytic.py's
+        # prefill-flash gate pins its absence).  The CPU tier-1 default
+        # stays the masked XLA reference so greedy bit-identity
+        # discipline is untouched; flash_attention itself falls back on
+        # shapes its blocking cannot cover.
+        import importlib
+        # importlib: the ops.pallas package re-exports the
+        # flash_attention FUNCTION, shadowing the submodule attribute
+        _flash_mod = importlib.import_module(
+            "paddle_tpu.ops.pallas.flash_attention")
         att = attn_ops.dot_product_attention(
             split(q, num_heads),
             attn_ops.repeat_kv_heads(split(k, hkv), num_heads),
             attn_ops.repeat_kv_heads(split(v, hkv), num_heads),
-            causal=True, use_flash=False)
+            causal=True, use_flash=_flash_mod.prefill_flash_enabled())
         att = att.transpose(0, 2, 1, 3).reshape(b, tp, d)
         x = x + linear.matmul(att, blk["attn"]["wo"])
         x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
@@ -849,6 +865,161 @@ def lm_decode_step_paged(params, prev_ids, positions, cache, tables,
         x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
         new_cache.append(nc)
     return _lm_project(params, x)[:, 0], new_cache
+
+
+# ------------------------------------------------ chunked decode steps
+#
+# The unified chunked-prefill serving step (serving/decode_engine.py
+# prefill_chunk > 0; docs/serving.md "Chunked prefill"): ONE jitted step
+# advances a MIX of decode rows (1 token) and prompt-ingesting rows (up
+# to K tokens — Sarathi-style chunked prefill on the Orca-style slot
+# scheduler).  Row r feeds tokens[r, :lengths[r]] at positions
+# positions[r] .. positions[r]+lengths[r]-1; lane i attends causally
+# within the chunk AND over the row's live prefix (cols <= its own
+# position), and the returned logits are each row's LAST fed lane —
+# exactly what lm_prefill + lm_decode_step compose to, so greedy
+# streams stay bit-identical to lm_generate.  lengths is DATA: the
+# per-step chunk budget never retraces.
+
+
+def _chunk_lanes(positions, lengths, kk):
+    """(clamped lane indices [S, K], per-lane query positions [S, K]).
+    Lanes past a row's ``lengths`` clamp to its LAST active lane: they
+    re-compute (and re-write) the last real token's K/V — identical
+    values at an identical target, so the duplicate scatter is
+    deterministic and no garbage ever lands in the cache."""
+    lane = jnp.arange(kk)[None, :]
+    li = jnp.minimum(lane, lengths[:, None] - 1)
+    return li, positions[:, None] + li
+
+
+def _cached_self_attn_chunk(blk, x, c, li, qpos, pos_mask, num_heads,
+                            rope_pos=None):
+    """``_cached_self_attn_slots`` at Tq=K: row r writes lane i's K/V at
+    its own ``qpos[r, i]`` and lane i attends under its own mask row
+    (cols <= qpos[r, i] — causal within the chunk, clamped at the live
+    prefix).  Writes happen BEFORE the attention, so within-chunk
+    causality falls out of the ordinary masked cache read.  Lane
+    numerics are position-local (batched matmuls over the flattened
+    [S*K] leading axis), so each lane computes exactly what the Tq=1
+    step computes at that position."""
+    s, kk, _d = x.shape
+    h = _ln(blk["ln1"], x)
+    k_new = linear.matmul(h, blk["attn"]["wk"])
+    q = linear.matmul(h, blk["attn"]["wq"])
+    if rope_pos is not None:
+        dh = q.shape[-1] // num_heads
+        k_new = _rope_flat(k_new, rope_pos, dh)
+        q = _rope_flat(q, rope_pos, dh)
+    v_new = linear.matmul(h, blk["attn"]["wv"])
+    # clamped-lane selection: inactive lanes take the last active lane's
+    # values, so their (duplicate-target) writes are bit-identical
+    k_sel = jnp.take_along_axis(k_new, li[:, :, None], axis=1)
+    v_sel = jnp.take_along_axis(v_new, li[:, :, None], axis=1)
+    rows = jnp.arange(s)[:, None]
+    k = c["k"].at[rows, qpos].set(k_sel)
+    v = c["v"].at[rows, qpos].set(v_sel)
+    # fused Tq=chunk Pallas kernel (ops/pallas/decode_attention.py):
+    # each row's stripe streams HBM->VMEM once and every lane consumes
+    # it in VMEM — no [S, K, T] score matrix.  None -> reference path.
+    from paddle_tpu.ops.pallas import decode_attention as _decode_kernels
+    att = _decode_kernels.maybe_slab_chunk(q, k, v, qpos, num_heads)
+    if att is None:
+        att = _attend(q, k, v, num_heads, pos_mask)
+    return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
+
+
+def lm_decode_chunk_slots(params, tokens, positions, lengths, cache,
+                          num_heads=8, moe_top_k=2, pos_type="learned"):
+    """The Tq=chunk generalization of ``lm_decode_step_slots``: every
+    row advances ``lengths[r]`` (1..K) positions in ONE step.
+
+    tokens [S, K] int32 (row r's lanes < lengths[r] are fed; the rest
+    are ignored — callers pad with anything in-vocab), positions [S]
+    (lane 0's position), lengths [S] in [1, K]; cache as
+    ``init_lm_cache`` -> (logits [S, V] at each row's LAST fed lane,
+    new cache).  A row with lengths[r]=1 computes exactly
+    ``lm_decode_step_slots``'s result; a row chunking through its prompt
+    computes exactly what sequential steps would — tokens and lengths
+    are DATA, so mixing decode and prefill rows never retraces."""
+    s, kk = tokens.shape
+    max_len = cache[0]["k"].shape[1]
+    li, qpos = _chunk_lanes(positions, lengths, kk)
+    x = emb_ops.embedding_lookup(params["src_emb"], tokens)
+    x = x * math.sqrt(x.shape[-1])
+    if pos_type == "learned":
+        x = x + params["pos"][qpos]
+    rope_pos = qpos if pos_type == "rope" else None
+    pos_mask = jnp.arange(max_len)[None, None, :] <= qpos[:, :, None]
+    new_cache = []
+    for blk, c in zip(params["enc"], cache):
+        x, nc = _cached_self_attn_chunk(blk, x, c, li, qpos, pos_mask,
+                                        num_heads, rope_pos)
+        x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
+        new_cache.append(nc)
+    h_last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
+    return _lm_project(params, h_last)[:, 0], new_cache
+
+
+def _cached_self_attn_chunk_paged(blk, x, c, li, qpos, tables, pos_mask,
+                                  num_heads, rope_pos=None):
+    """``_cached_self_attn_chunk`` over the paged block pool: lane i of
+    row r scatter-writes into ``pool[tables[r, qpos//bs], qpos % bs]``
+    (host scheduling provisions exclusive blocks for the WHOLE span
+    before the step — ``PagedKVState.write_plan_span``) and attends over
+    the gather of its own chain."""
+    s = qpos.shape[0]
+    block_size = c["k"].shape[1]
+    h = _ln(blk["ln1"], x)
+    k_new = linear.matmul(h, blk["attn"]["wk"])
+    q = linear.matmul(h, blk["attn"]["wq"])
+    if rope_pos is not None:
+        dh = q.shape[-1] // num_heads
+        k_new = _rope_flat(k_new, rope_pos, dh)
+        q = _rope_flat(q, rope_pos, dh)
+    v_new = linear.matmul(h, blk["attn"]["wv"])
+    k_sel = jnp.take_along_axis(k_new, li[:, :, None], axis=1)
+    v_sel = jnp.take_along_axis(v_new, li[:, :, None], axis=1)
+    rows = jnp.arange(s)[:, None]
+    bids = tables[rows, qpos // block_size]
+    offs = qpos % block_size
+    k = c["k"].at[bids, offs].set(k_sel)
+    v = c["v"].at[bids, offs].set(v_sel)
+    from paddle_tpu.ops.pallas import decode_attention as _decode_kernels
+    att = _decode_kernels.maybe_paged_chunk(q, k, v, qpos, tables,
+                                            num_heads)
+    if att is None:
+        k_rows = k[tables].reshape(s, -1, k.shape[-1])
+        v_rows = v[tables].reshape(s, -1, v.shape[-1])
+        att = _attend(q, k_rows, v_rows, num_heads, pos_mask)
+    return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
+
+
+def lm_decode_chunk_paged(params, tokens, positions, lengths, cache,
+                          tables, num_heads=8, moe_top_k=2,
+                          pos_type="learned"):
+    """The Tq=chunk generalization of ``lm_decode_step_paged`` — the
+    paged twin of ``lm_decode_chunk_slots`` (same lane semantics, block
+    tables as DATA)."""
+    s, kk = tokens.shape
+    block_size = cache[0]["k"].shape[1]
+    t_span = tables.shape[1] * block_size
+    li, qpos = _chunk_lanes(positions, lengths, kk)
+    x = emb_ops.embedding_lookup(params["src_emb"], tokens)
+    x = x * math.sqrt(x.shape[-1])
+    if pos_type == "learned":
+        x = x + params["pos"][qpos]
+    rope_pos = qpos if pos_type == "rope" else None
+    pos_mask = jnp.arange(t_span)[None, None, :] <= qpos[:, :, None]
+    new_cache = []
+    for blk, c in zip(params["enc"], cache):
+        x, nc = _cached_self_attn_chunk_paged(blk, x, c, li, qpos,
+                                              tables, pos_mask,
+                                              num_heads, rope_pos)
+        x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
+        new_cache.append(nc)
+    h_last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
+    return _lm_project(params, h_last)[:, 0], new_cache
 
 
 def init_lm_cache_paged(params, num_blocks, block_size, max_len=None):

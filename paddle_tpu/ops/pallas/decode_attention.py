@@ -169,13 +169,18 @@ def _mosaic_ok(blk, dkv, dh, interpret):
 # ------------------------------------------------------------ kernel body
 
 def _accumulate(q, kb, vb, col0, blk, pos, m_scr, l_scr, acc_scr, *,
-                num_heads, hkv, dh, scale):
-    """One K/V block of the masked online softmax for one row.
+                num_heads, hkv, dh, scale, sl=slice(None)):
+    """One K/V block of the masked online softmax for one query lane.
 
     q: [H, dh] f32; kb/vb: [blk, Dkv] f32; col0: first global column of
-    this block; pos: the row's position (cols > pos masked to -1e30).
+    this block; pos: the LANE's position (cols > pos masked to -1e30).
     Grouped KV expands in REGISTERS: each kv head's [dh]-slice meets its
-    query group's rows — no widened K/V ever exists in memory."""
+    query group's rows — no widened K/V ever exists in memory.  ``sl``
+    selects this lane's running-stat rows inside scratch shaped
+    [K*H, ...] (the Tq=chunk kernels; Tq=1 passes the whole scratch).
+    A block entirely past ``pos`` is a BIT-EXACT no-op: every score
+    masks to -1e30, so p underflows to exactly 0.0 and alpha is exactly
+    1.0 — the chunk kernels rely on this for their shorter lanes."""
     group = num_heads // hkv
     parts = []
     for g in range(hkv):
@@ -187,12 +192,12 @@ def _accumulate(q, kb, vb, col0, blk, pos, m_scr, l_scr, acc_scr, *,
     s = (jnp.concatenate(parts, axis=0) if hkv > 1 else parts[0]) * scale
     cols = jax.lax.broadcasted_iota(jnp.int32, (num_heads, blk), 1) + col0
     s = jnp.where(cols <= pos, s, _NEG)
-    m_prev, l_prev = m_scr[:], l_scr[:]                # [H, LANES]
+    m_prev, l_prev = m_scr[sl], l_scr[sl]              # [H, LANES]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - _lanes(m_new, blk))
     alpha = jnp.exp(m_prev - m_new)
-    m_scr[:] = m_new
-    l_scr[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    m_scr[sl] = m_new
+    l_scr[sl] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
     parts = []
     for g in range(hkv):
         pg = p[g * group:(g + 1) * group]              # [group, blk]
@@ -201,23 +206,26 @@ def _accumulate(q, kb, vb, col0, blk, pos, m_scr, l_scr, acc_scr, *,
             pg, vg, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32))       # [group, dh]
     av = jnp.concatenate(parts, axis=0) if hkv > 1 else parts[0]
-    acc_scr[:] = acc_scr[:] * _lanes(alpha, dh) + av
+    acc_scr[sl] = acc_scr[sl] * _lanes(alpha, dh) + av
 
 
-def kernel_cost(s, t_span, d, dkv, itemsize=4):
+def kernel_cost(s, t_span, d, dkv, itemsize=4, tq=1):
     """The kernel's declared traffic/compute — the ``pl.CostEstimate``
     handed to Mosaic, and the number a TPU cost model reports for the
     fused custom call.  Bytes are the whole point: q in + out + each
     row's K AND V stripe read ONCE (worst case — the clamped index maps
     stop at each row's position, so the real stream is shorter), plus
-    the scalar operands.  No score matrix, no second KV copy."""
+    the scalar operands.  No score matrix, no second KV copy.  ``tq``:
+    query lanes per row (1 = plain decode; K = the chunked-prefill
+    step — the KV stream is UNCHANGED, every lane consumes it in
+    VMEM)."""
     kv_bytes = 2 * s * t_span * dkv * itemsize
-    io_bytes = 2 * s * d * itemsize + s * 4     # + int32 positions
+    io_bytes = 2 * s * tq * d * itemsize + s * tq * 4  # + int32 positions
     #           (the paged block table adds s * nb_row * 4 more — noise)
-    heads_flops = 2 * 2 * s * t_span * d        # qk^T + p@v
+    heads_flops = 2 * 2 * s * tq * t_span * d   # qk^T + p@v
     return pl.CostEstimate(flops=heads_flops,
                            bytes_accessed=kv_bytes + io_bytes,
-                           transcendentals=s * t_span)
+                           transcendentals=s * tq * t_span)
 
 
 def _init_row(m_scr, l_scr, acc_scr):
@@ -260,6 +268,45 @@ def _paged_kernel(pos_ref, tbl_ref, *args, **kw):
     consumed entirely by the BlockSpecs."""
     del tbl_ref
     _slab_kernel(pos_ref, *args, **kw)
+
+
+def _chunk_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
+                  acc_scr, *, blk, kk, num_heads, hkv, dh, scale):
+    """Tq=chunk body: ``kk`` query lanes per row share each streamed K/V
+    block.  pos_ref [S, K] carries every lane's own position (the
+    engine's clamped ``qpos`` — non-decreasing per row, inactive lanes
+    repeat the last active lane's), so lane i's mask is causal within
+    the chunk AND clamped at the row's live prefix.  Lane stats live in
+    [K*H, .]-shaped scratch, sliced per lane; the K/V stripe is read
+    from HBM exactly once per row — the chunk consumes it in VMEM."""
+    r = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        _init_row(m_scr, l_scr, acc_scr)
+
+    # the row's furthest lane gates the block (per-lane masking inside
+    # _accumulate makes an out-of-range lane's visit a bit-exact no-op)
+    @pl.when(j * blk <= pos_ref[r, kk - 1])
+    def _():
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        for i in range(kk):
+            sl = slice(i * num_heads, (i + 1) * num_heads)
+            _accumulate(q_ref[0, sl].astype(jnp.float32), kb, vb,
+                        j * blk, blk, pos_ref[r, i], m_scr, l_scr,
+                        acc_scr, num_heads=num_heads, hkv=hkv, dh=dh,
+                        scale=scale, sl=sl)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        _finalize(o_ref, l_scr, acc_scr, dh)
+
+
+def _paged_chunk_kernel(pos_ref, tbl_ref, *args, **kw):
+    del tbl_ref
+    _chunk_kernel(pos_ref, *args, **kw)
 
 
 # ------------------------------------------------------------ public API
@@ -386,19 +433,150 @@ def decode_attention_paged(q, k, v, positions, tables, num_heads, *,
     return out.reshape(s, d)
 
 
+def decode_attention_slab_chunk(q, k, v, qpos, num_heads, *,
+                                block_k=None, interpret=None):
+    """Fused Tq=chunk slab decode attention (the unified chunked-prefill
+    step): q [S, K, D], k/v [S, T, Dkv] (the already-updated cache),
+    qpos [S, K] int32 per-LANE positions (non-decreasing per row; the
+    engine clamps inactive lanes to the last active one) -> [S, K, D].
+    Lane (r, i) attends row r's stripe at cols <= qpos[r, i]; the
+    stripe streams HBM -> VMEM once per row and every lane consumes it
+    there — no [S, K, T] score matrix.  Raises ValueError on shapes the
+    kernel doesn't cover — callers use ``maybe_slab_chunk``."""
+    interpret = _interpret(interpret)
+    s, kk, d = q.shape
+    t, dkv = k.shape[1], k.shape[2]
+    split = _head_split(d, dkv, num_heads)
+    blk = _pick_block_k(t, block_k or _block_k_cap(), interpret)
+    if split is None or blk is None or not _chunk_ok(kk, num_heads,
+                                                    interpret):
+        raise ValueError(
+            f"decode_attention_slab_chunk: unsupported shape q={q.shape} "
+            f"k={k.shape} heads={num_heads}")
+    dh, hkv, _group = split
+    if not _mosaic_ok(blk, dkv, dh, interpret):
+        raise ValueError(
+            f"decode_attention_slab_chunk: untileable blk={blk} "
+            f"dkv={dkv} dh={dh} for the compiled backend")
+    scale = 1.0 / math.sqrt(dh)
+    kernel = functools.partial(_chunk_kernel, blk=blk, kk=kk,
+                               num_heads=num_heads, hkv=hkv, dh=dh,
+                               scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s, t // blk),
+        in_specs=[
+            pl.BlockSpec((1, kk * num_heads, dh),
+                         lambda r, j, pos: (r, 0, 0)),
+            # clamp at the row's FURTHEST lane: blocks past it re-map to
+            # the last needed block — same index, no re-fetch
+            pl.BlockSpec((1, blk, dkv),
+                         lambda r, j, pos: (
+                             r, jnp.minimum(j, pos[r, kk - 1] // blk), 0)),
+            pl.BlockSpec((1, blk, dkv),
+                         lambda r, j, pos: (
+                             r, jnp.minimum(j, pos[r, kk - 1] // blk), 0)),
+        ],
+        out_specs=pl.BlockSpec((1, kk * num_heads, dh),
+                               lambda r, j, pos: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((kk * num_heads, _LANES), jnp.float32),
+            pltpu.VMEM((kk * num_heads, _LANES), jnp.float32),
+            pltpu.VMEM((kk * num_heads, dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, kk * num_heads, dh), q.dtype),
+        cost_estimate=kernel_cost(s, t, d, dkv, q.dtype.itemsize, tq=kk),
+        interpret=interpret,
+    )(jnp.asarray(qpos, jnp.int32),
+      q.reshape(s, kk * num_heads, dh), k, v)
+    return out.reshape(s, kk, d)
+
+
+def decode_attention_paged_chunk(q, k, v, qpos, tables, num_heads, *,
+                                 interpret=None):
+    """Fused Tq=chunk PAGED decode attention: q [S, K, D], k/v
+    [num_blocks, block_size, Dkv] (the shared pool, already
+    scatter-updated for the whole chunk span), qpos [S, K], tables
+    [S, blocks_per_row] int32 -> [S, K, D].  The block table stays the
+    second scalar-prefetch operand: a row's DMA stream is exactly the
+    physical blocks it owns, clamped at its furthest lane."""
+    interpret = _interpret(interpret)
+    s, kk, d = q.shape
+    bs, dkv = k.shape[1], k.shape[2]
+    nb_row = tables.shape[1]
+    split = _head_split(d, dkv, num_heads)
+    if split is None or not _chunk_ok(kk, num_heads, interpret):
+        raise ValueError(
+            f"decode_attention_paged_chunk: unsupported shape "
+            f"q={q.shape} pool={k.shape} heads={num_heads}")
+    dh, hkv, _group = split
+    if not _mosaic_ok(bs, dkv, dh, interpret):
+        raise ValueError(
+            f"decode_attention_paged_chunk: untileable block_size={bs} "
+            f"dkv={dkv} dh={dh} for the compiled backend")
+    scale = 1.0 / math.sqrt(dh)
+    kernel = functools.partial(_paged_chunk_kernel, blk=bs, kk=kk,
+                               num_heads=num_heads, hkv=hkv, dh=dh,
+                               scale=scale)
+
+    def _kv_map(r, j, pos, tbl):
+        return (tbl[r, jnp.minimum(j, pos[r, kk - 1] // bs)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, nb_row),
+        in_specs=[
+            pl.BlockSpec((1, kk * num_heads, dh),
+                         lambda r, j, pos, tbl: (r, 0, 0)),
+            pl.BlockSpec((1, bs, dkv), _kv_map),
+            pl.BlockSpec((1, bs, dkv), _kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, kk * num_heads, dh),
+                               lambda r, j, pos, tbl: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((kk * num_heads, _LANES), jnp.float32),
+            pltpu.VMEM((kk * num_heads, _LANES), jnp.float32),
+            pltpu.VMEM((kk * num_heads, dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, kk * num_heads, dh), q.dtype),
+        cost_estimate=kernel_cost(s, nb_row * bs, d, dkv,
+                                  q.dtype.itemsize, tq=kk),
+        interpret=interpret,
+    )(jnp.asarray(qpos, jnp.int32),
+      jnp.asarray(tables, jnp.int32),
+      q.reshape(s, kk * num_heads, dh), k, v)
+    return out.reshape(s, kk, d)
+
+
 # ------------------------------------------------------------ dispatch
 
-def covers(num_heads, d, dkv, blk_len, paged=False):
+def _chunk_ok(kk, num_heads, interpret):
+    """Chunk-lane tiling: the lane-stacked scratch/q blocks are
+    [K*H, .]-shaped — any K in interpret mode; the compiled backend
+    wants an 8-divisible sublane dim."""
+    if kk < 1:
+        return False
+    return interpret or (kk * num_heads) % 8 == 0
+
+
+def covers(num_heads, d, dkv, blk_len, paged=False, chunk=1):
     """THE dispatch predicate (flag + shape support), shared by
     ``maybe_slab``/``maybe_paged`` and by ``DecodeEngine.warmup``'s
     resolved-path log — one definition, so the engine can never report
     a path its compiled step didn't take.  ``blk_len``: the slab length
-    (slab) or the pool block size (paged)."""
+    (slab) or the pool block size (paged).  ``chunk``: query lanes per
+    row (1 = plain decode; >1 = the chunked-prefill step)."""
     if not decode_kernels_enabled():
         return False
     interpret = _interpret(None)
     split = _head_split(d, dkv, num_heads)
-    if split is None:
+    if split is None or not _chunk_ok(chunk, num_heads, interpret):
         return False
     if paged:
         return _mosaic_ok(blk_len, dkv, split[0], interpret)
@@ -424,3 +602,23 @@ def maybe_paged(q, k, v, positions, tables, num_heads):
         return None
     return decode_attention_paged(q, k, v, positions, tables, num_heads,
                                   interpret=_interpret(None))
+
+
+def maybe_slab_chunk(q, k, v, qpos, num_heads):
+    """Kernel output [S, K, D] when the fused Tq=chunk slab kernel is
+    enabled and covers these shapes; None -> the reference XLA path."""
+    if not covers(num_heads, q.shape[2], k.shape[2], k.shape[1],
+                  paged=False, chunk=q.shape[1]):
+        return None
+    return decode_attention_slab_chunk(q, k, v, qpos, num_heads,
+                                       interpret=_interpret(None))
+
+
+def maybe_paged_chunk(q, k, v, qpos, tables, num_heads):
+    """Kernel output [S, K, D] when the fused Tq=chunk paged kernel is
+    enabled and covers these shapes; None -> the chain-gather path."""
+    if not covers(num_heads, q.shape[2], k.shape[2], k.shape[1],
+                  paged=True, chunk=q.shape[1]):
+        return None
+    return decode_attention_paged_chunk(q, k, v, qpos, tables, num_heads,
+                                        interpret=_interpret(None))

@@ -19,6 +19,7 @@ one kernel streams q blocks per kv block for dk/dv, one streams kv blocks
 per q block for dq, both recomputing p from (q, k, lse).
 """
 
+import contextlib
 import functools
 import math
 
@@ -28,6 +29,55 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -1e30
+
+# test/bench override for the pallas_prefill flag: None = read FLAGS
+# (utils/flags.py), else "auto" | "always" | "off" — the
+# decode_attention.MODE pattern.  Gates the serving PREFILL routing
+# (models/transformer.lm_prefill's batched causal pass) through this
+# kernel so no serving path materializes the [Tp, Tp] score matrix;
+# "auto" follows use_pallas() (TPU only — the CPU tier-1 default stays
+# the masked XLA reference path, preserving greedy bit-identity),
+# "always" forces it anywhere (interpret mode off-TPU — the test/smoke
+# mode).  Read at TRACE time.
+PREFILL_MODE = None
+
+
+def _prefill_mode():
+    if PREFILL_MODE is not None:
+        return PREFILL_MODE
+    from paddle_tpu.utils.flags import FLAGS
+    return getattr(FLAGS, "pallas_prefill", "auto")
+
+
+@contextlib.contextmanager
+def forced_prefill_mode(mode):
+    """Temporarily force the prefill-flash routing ("always" | "off" |
+    "auto") — tests, the analytic gate, and the A/B bench.  Trace-time:
+    wrap the jit/lower call, not just the execution."""
+    global PREFILL_MODE
+    old = PREFILL_MODE
+    PREFILL_MODE = mode
+    try:
+        yield
+    finally:
+        PREFILL_MODE = old
+
+
+def prefill_flash_enabled():
+    """True when ``lm_prefill``'s batched causal pass should route
+    through ``flash_attention`` (read at trace time by
+    ``models/transformer``).  Shape coverage stays flash_attention's
+    own: uncoverable blockings fall back to the masked path inside."""
+    m = str(_prefill_mode()).lower()
+    if m in ("0", "off", "false", "no"):
+        return False
+    if m in ("1", "on", "always", "true", "yes"):
+        return True
+    if m != "auto":
+        raise ValueError(f"pallas_prefill={m!r} (takes auto | always | "
+                         "off)")
+    from paddle_tpu.ops import pallas as pk
+    return pk.use_pallas()
 
 # Per-row statistics (running max/sum, lse, delta) live lane-REPLICATED in
 # [rows, 128] tiles — the same layout

@@ -84,6 +84,13 @@ FAMILIES = [
     # records the fused-vs-reference predicted-bytes win — before any
     # chip time
     ("serving_decode_fused", "serving_decode_fused", None),
+    # unified chunked-prefill serving (decode_engine.py prefill_chunk):
+    # extras["lower"] is THE one unified step (decode rows + prefill
+    # chunks in one executable, Tq=chunk kernels forced on) and the
+    # factory's postcheck proves the score matrices are gone — no
+    # [K, T] buffer in the unified step, no [Tp, Tp] buffer in the
+    # flash-routed legacy prefill — with both gates tested in reverse
+    ("serving_chunked_prefill", "serving_chunked_prefill", None),
     ("trainer_prefetch", "trainer_prefetch", None),
 ]
 
@@ -142,6 +149,57 @@ def chain_buffer_instrs(hlo_text, num_rows, t_span, dkv):
                 hits.append(line.strip())
                 break
     return hits
+
+
+def score_matrix_instrs(hlo_text, tq, tk):
+    """Instructions whose RESULT materializes an attention SCORE matrix:
+    a float-typed buffer whose trailing two dims are exactly
+    ``(tq, tk)`` — ``[.., Tp, Tp]`` for the batched causal prefill,
+    ``[.., K, T]`` for the unified chunked step's reference path.  The
+    flash/chunk kernels compute scores block-by-block in VMEM, so with
+    them engaged NO such buffer may exist in the HLO (and the reference
+    path must trip this same detector — the gate is tested in reverse).
+    Returns the offending instruction lines (empty = proven)."""
+    import re
+    from paddle_tpu.perf import cost as _cost
+    shape_re = re.compile(r"\b(f32|bf16|f16|f64)\[([0-9,]+)\]")
+    hits = []
+    for line in hlo_text.splitlines():
+        m = _cost._INSTR_RE.match(line)
+        if not m:
+            continue
+        rhs = m.group(1)
+        op = _cost._op_of(rhs)
+        if op is None or op in _cost._SKIP_OPS:
+            continue
+        if rhs.startswith("("):
+            depth, ty = 0, rhs
+            for i, ch in enumerate(rhs):
+                depth += ch == "("
+                depth -= ch == ")"
+                if depth == 0:
+                    ty = rhs[:i + 1]
+                    break
+        else:
+            ty = rhs.split(None, 1)[0]
+        for _dt, dims in shape_re.findall(ty):
+            shape = [int(d) for d in dims.split(",")]
+            if len(shape) >= 2 and shape[-2] == int(tq) \
+                    and shape[-1] == int(tk):
+                hits.append(line.strip())
+                break
+    return hits
+
+
+def assert_prefill_flash(hlo_text, tp):
+    """Raise AssertionError when a batched causal prefill HLO still
+    materializes the ``[Tp, Tp]`` score matrix (the flash routing was
+    supposed to be ON)."""
+    hits = score_matrix_instrs(hlo_text, tp, tp)
+    if hits:
+        raise AssertionError(
+            f"prefill materializes a [{tp}, {tp}] score matrix — the "
+            f"flash routing did not engage:\n  " + "\n  ".join(hits[:4]))
 
 
 def assert_decode_fused(hlo_text, num_rows, t_span, dkv):
@@ -214,7 +272,8 @@ def capture(name, model, batch=None, chips=("v5e", "v5p")):
     bps = extras.get("batches_per_step")
     if model in ("transformer_serving", "serving", "serving_generate",
                  "serving_fleet", "serving_paged",
-                 "serving_decode_fused", "serving_autoscale"):
+                 "serving_decode_fused", "serving_autoscale",
+                 "serving_chunked_prefill"):
         # the lowered program is one batch/slab step while the bench FLOPs
         # model covers the whole stream/burst — scopes differ, no cross-check
         row["bench_model_flops"] = None

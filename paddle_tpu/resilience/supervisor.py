@@ -303,7 +303,13 @@ class Supervisor:
         The mechanics live in ``DecodeEngine.seat_prefilled`` — the ONE
         seat-prefix helper this path shares with the batcher's
         continuation-``replay`` leg, paged prefix-cache admission, and
-        pool-pressure re-seating (serving/kv_pool.py)."""
+        pool-pressure re-seating (serving/kv_pool.py).  On a CHUNKED
+        engine (prefill_chunk > 0) recovery rides chunks: leg 1's
+        ladder re-prefill disappears and the whole context returns as
+        the feed, drained up to K lanes per step through the one
+        unified executable (docs/serving.md "Chunked prefill") — K×
+        fewer recovery steps than per-token teacher-forcing, still
+        bit-identical, still zero new traces."""
         import numpy as np
         with obstrace.span("supervisor.reprefill", root=False,
                            n=len(items)):

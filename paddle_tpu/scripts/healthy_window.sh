@@ -284,6 +284,17 @@ timeout "$T_SERVE" python -m paddle_tpu.serving.autoscaler --smoke \
     > "$ART/autoscale_smoke.json" 2> "$ART/autoscale_smoke.log"
 log "autoscale smoke rc=$? -> $ART/autoscale_smoke.json"
 
+log "phase 15: chunked-prefill smoke (unified step vs legacy ladder)"
+# prompt ingestion folded into the ONE jitted decode step: a long prompt
+# admitted MID-DECODE must chunk through the shared step while the
+# in-flight stream keeps emitting (interleaved tokens >= 1), and every
+# stream must be bit-identical to the legacy-ladder twin — one JSON line
+# (python -m paddle_tpu.serving --smoke-chunked; docs/serving.md
+# "Chunked prefill")
+timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-chunked \
+    > "$ART/chunked_smoke.json" 2> "$ART/chunked_smoke.log"
+log "chunked smoke rc=$? -> $ART/chunked_smoke.json"
+
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)
 bench_cache.json now holds the live rows; README's headline caveat and

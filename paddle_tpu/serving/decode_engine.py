@@ -33,13 +33,26 @@ slab):
   streams stay bit-identical to the slab and to ``lm_generate``
   (tests/test_kv_pool.py).  The slab stays the default layout.
 
-* Prefill rides the existing bucketed ``InferenceEngine`` ladder: one
-  engine per prompt-LENGTH bucket (each with its own batch-bucket
-  ladder), whose forward is ``lm_prefill`` + the last-real-position
-  logits — the exact composition ``lm_generate`` uses, so a request's
-  greedy stream is bit-identical to running it alone (the parity tests
-  pin this token for token).  Prompt compile cost is paid once per
-  (length bucket, batch bucket), never per request.
+* ``DecodeEngine(prefill_chunk=K)`` — UNIFIED CHUNKED PREFILL (the
+  serving CLI default; docs/serving.md "Chunked prefill"): prompt
+  ingestion folds into the one jitted step itself
+  (``lm_decode_chunk_slots``/``_paged`` — Sarathi-style chunked
+  prefill on the Orca scheduler).  Each step advances a MIX of decode
+  rows (1 token) and admitting rows (up to K prompt tokens, re-derived
+  emissions swallowed until the last chunk, whose output is the first
+  real token).  Tokens, positions AND per-slot lane counts are data,
+  so the chunk budget tunes without retracing; there is no admission
+  write, no prefill ladder, and no prompt cap below ``max_len`` —
+  ONE executable is the whole serving hot path.
+
+* Legacy mode (``prefill_chunk=0``): prefill rides the bucketed
+  ``InferenceEngine`` ladder — one engine per prompt-LENGTH bucket
+  (each with its own batch-bucket ladder), whose forward is
+  ``lm_prefill`` + the last-real-position logits — the exact
+  composition ``lm_generate`` uses, so a request's greedy stream is
+  bit-identical to running it alone (the parity tests pin this token
+  for token).  Prompt compile cost is paid once per (length bucket,
+  batch bucket), never per request.
 
 * ``GenerationBatcher`` — the request front: bounded queue, per-request
   deadlines (``DeadlineExceededError`` while queued), admission control
@@ -105,6 +118,13 @@ class DecodeEngine:
     prefill engine compiles; eos_id: default stop token (None = run to
     max_tokens; per-request override at submit).
 
+    prefill_chunk: 0 (legacy ladder prefill) or K > 0 — unified chunked
+    prefill: prompts ingest through the one decode step as up-to-K-token
+    chunks (``[S, K]`` token lanes; docs/serving.md "Chunked prefill").
+    prefill_chunk_budget: max teacher-forced lanes one step may feed
+    across all slots (0 = unbounded) — pure data, bounds per-step
+    prefill work and hence TPOT jitter.
+
     kv_layout: ``"slab"`` (default — one ``[num_slots, max_len, Dkv]``
     row per slot) or ``"paged"`` (a shared ``[kv_num_blocks,
     kv_block_size, Dkv]`` block pool + per-slot block tables,
@@ -127,7 +147,8 @@ class DecodeEngine:
                  prefill_batch_buckets=(1, 4), eos_id=None, moe_top_k=2,
                  pos_type="learned", metrics=None, name="lm", warm=True,
                  kv_layout="slab", kv_block_size=16, kv_num_blocks=0,
-                 prefix_cache=True):
+                 prefix_cache=True, prefill_chunk=0,
+                 prefill_chunk_budget=0):
         from paddle_tpu.models import transformer
         self._transformer = transformer
         if params.get("dec"):
@@ -144,11 +165,29 @@ class DecodeEngine:
         self.pos_type = pos_type
         self.name = name
         self._metrics = metrics or ServingMetrics()
+        # unified chunked prefill (docs/serving.md "Chunked prefill"):
+        # prefill_chunk = K > 0 folds prompt ingestion into the ONE
+        # jitted decode step — each step advances a mix of decode rows
+        # (1 token) and admitting rows (up to K prompt tokens, logits
+        # discarded until the last chunk).  The separate prefill
+        # InferenceEngine ladder below is the opt-in LEGACY mode
+        # (prefill_chunk=0).  prefill_chunk_budget: max teacher-forced
+        # lanes per step across all slots (0 = unbounded) — data, not
+        # shape, so tuning it never retraces.
+        self.prefill_chunk = int(prefill_chunk or 0)
+        self.prefill_chunk_budget = int(prefill_chunk_budget or 0)
+        if self.prefill_chunk < 0 or self.prefill_chunk > self.max_len:
+            raise ConfigError(
+                f"prefill_chunk={prefill_chunk} must be in "
+                f"[0, max_len={self.max_len}]")
         self.prefill_buckets = tuple(sorted(set(int(b)
                                                 for b in prefill_buckets)))
         if not self.prefill_buckets or self.prefill_buckets[0] < 1:
             raise ConfigError(f"bad prefill ladder {prefill_buckets!r}")
-        if self.prefill_buckets[-1] >= self.max_len:
+        if not self.prefill_chunk \
+                and self.prefill_buckets[-1] >= self.max_len:
+            # chunked mode never builds the ladder, so its shape cannot
+            # invalidate a chunked engine
             raise ConfigError(
                 f"prefill bucket top {self.prefill_buckets[-1]} leaves no "
                 f"room to generate within max_len={self.max_len}")
@@ -182,10 +221,19 @@ class DecodeEngine:
         # ladder (the paged prefix cache's whole point is to NOT grow
         # this; bench.py serving_paged reads it for the elimination rate)
         self.prefill_positions_total = 0
-        # host-side slot state: token fed at the NEXT step and the
-        # position it sits at; free slots idle at (0, 0) — their compute
-        # is discarded and their cache row is overwritten at admission
-        self._tokens = np.zeros((self.num_slots,), np.int32)
+        # host-side slot state: token(s) fed at the NEXT step and the
+        # position lane 0 sits at; free slots idle at (0, 0) — their
+        # compute is discarded and their cache row is overwritten at
+        # admission.  Chunked mode widens the token row to K lanes and
+        # adds the per-slot lane count (_len — per-slot variable
+        # advance, the generalized position counter).
+        if self.prefill_chunk:
+            self._tokens = np.zeros((self.num_slots, self.prefill_chunk),
+                                    np.int32)
+            self._len = np.ones((self.num_slots,), np.int32)
+        else:
+            self._tokens = np.zeros((self.num_slots,), np.int32)
+            self._len = None
         self._pos = np.zeros((self.num_slots,), np.int32)
         self._free = list(range(self.num_slots))[::-1]   # pop() -> slot 0 first
         # epoch guard: reset() bumps it, step() refuses to commit across
@@ -203,7 +251,21 @@ class DecodeEngine:
         # step take the fused Pallas decode-attention path?
         self.decode_kernels = False
 
-        if self.kv_layout == "paged":
+        if self.prefill_chunk and self.kv_layout == "paged":
+            def _step_fn(p, cache, tokens, pos, lens, tables):
+                self._step_traces[0] += 1  # runs only under tracing
+                logits, cache = transformer.lm_decode_chunk_paged(
+                    p, tokens, pos, lens, cache, tables, self.num_heads,
+                    self.moe_top_k, self.pos_type)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+        elif self.prefill_chunk:
+            def _step_fn(p, cache, tokens, pos, lens):
+                self._step_traces[0] += 1  # runs only under tracing
+                logits, cache = transformer.lm_decode_chunk_slots(
+                    p, tokens, pos, lens, cache, self.num_heads,
+                    self.moe_top_k, self.pos_type)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+        elif self.kv_layout == "paged":
             def _step_fn(p, cache, tokens, pos, tables):
                 self._step_traces[0] += 1  # runs only under tracing
                 logits, cache = transformer.lm_decode_step_paged(
@@ -333,6 +395,23 @@ class DecodeEngine:
     # ------------------------------------------------------------ slots
 
     @property
+    def chunked(self):
+        """True when prompt ingestion rides the unified chunked step
+        (``prefill_chunk > 0``) instead of the legacy prefill ladder."""
+        return self.prefill_chunk > 0
+
+    def _arm(self, slot, token, pos):
+        """Point a slot at (token, position) for the next step — the one
+        place the two token-state layouts ([S] vs [S, K]) meet."""
+        if self.prefill_chunk:
+            self._tokens[slot, :] = 0
+            self._tokens[slot, 0] = token
+            self._len[slot] = 1
+        else:
+            self._tokens[slot] = token
+        self._pos[slot] = pos
+
+    @property
     def free_slots(self):
         return len(self._free)
 
@@ -361,8 +440,10 @@ class DecodeEngine:
     def metrics(self, m):
         # rewire the cached prefill engines too, so a metrics swap (the
         # bench's per-drive reset) never strands the prefill plane's
-        # batch/latency stats on an orphaned object
+        # batch/latency stats on an orphaned object; the chunk-size
+        # gauge is config, so the fresh object inherits it immediately
         self._metrics = m
+        m.set_prefill_chunk(self.prefill_chunk)
         for eng in self._prefill_engines.values():
             eng.metrics = m
 
@@ -409,8 +490,7 @@ class DecodeEngine:
             slot = self._free.pop()
             self._cache = self._jit_admit(self._cache, cache_row,
                                           np.int32(slot))
-        self._tokens[slot] = first_token
-        self._pos[slot] = length
+        self._arm(slot, first_token, length)
         return slot
 
     def seat_cached(self, full, covered, chain):
@@ -435,9 +515,59 @@ class DecodeEngine:
         except Exception:
             self._free.append(slot)
             raise
-        self._tokens[slot] = full[pre]
-        self._pos[slot] = pre
+        self._arm(slot, full[pre], pre)
         return slot, [int(t) for t in full[pre + 1:]]
+
+    def seat_chunked(self, full):
+        """Seat one request for CHUNKED ingestion (prefill_chunk > 0):
+        arm a free slot at (``full[0]``, position 0) and return
+        ``(slot, feed)`` where ``feed = full[1:]`` is what the batcher
+        chunk-loads through the unified step (its re-derived emissions
+        swallowed until the last token is fed — whose step output IS the
+        first real emission).  No prefill ladder, no bulk admission
+        write: the slab layout touches no device state at all, and the
+        paged layout seats an EMPTY chain that ``prepare_step`` grows
+        block by block as the span advances."""
+        if not self._free:
+            raise RuntimeError(f"{self.name}: no free decode slot")
+        full = np.asarray(full, np.int32)
+        slot = self._free.pop()
+        if self.kv_layout == "paged":
+            try:
+                self._paged.seat_fresh(slot, 0)
+            except InsufficientBlocksError:
+                self._free.append(slot)
+                raise
+        self._arm(slot, full[0], 0)
+        return slot, [int(t) for t in full[1:]]
+
+    def load_chunk(self, slot, toks):
+        """Arm lanes 1..n of ``slot`` for the NEXT step: after the
+        slot's current token, feed ``toks`` (the next teacher-forced
+        prompt/replay tokens) in the same step.  Chunked mode only;
+        called by the batcher strictly BETWEEN steps — lane counts are
+        data, so loading never retraces."""
+        n = len(toks)
+        if not self.prefill_chunk or n >= self.prefill_chunk:
+            raise RuntimeError(
+                f"{self.name}: load_chunk({n}) needs prefill_chunk > "
+                f"{n} (engine has {self.prefill_chunk})")
+        self._tokens[slot, 1:1 + n] = toks
+        self._len[slot] = 1 + n
+        self.metrics.observe_prefill_chunk(n)
+
+    def chunk_len(self, slot):
+        """Lanes the next/current step feeds for ``slot`` (1 = plain
+        decode)."""
+        return int(self._len[slot]) if self.prefill_chunk else 1
+
+    def register_context(self, slot, tokens):
+        """Publish a fully-ingested context's prompt prefix into the
+        paged prefix index (chunked admission's twin of the ``admit``
+        registration; no-op on slab / with the cache off)."""
+        if self.kv_layout == "paged":
+            self._paged.register_prefix(np.asarray(tokens, np.int32),
+                                        slot)
 
     def seat_prefilled(self, fulls):
         """THE seat-prefix helper (one definition, four callers:
@@ -460,7 +590,16 @@ class DecodeEngine:
         Returns a list aligned with ``fulls``: ``(slot, replay_feed)``
         per seated item, or the exception that failed it
         (``InsufficientBlocksError`` means "defer and retry", not
-        "fail")."""
+        "fail").
+
+        CHUNKED mode (prefill_chunk > 0) replaces leg 2 entirely: there
+        is no ladder, so the whole uncovered context returns as the
+        feed and the batcher drains it K lanes per step through the ONE
+        unified executable — supervisor recovery and continuation
+        replay ride chunks instead of one teacher-forced token per
+        step."""
+        if self.prefill_chunk:
+            return self._seat_prefilled_chunked(fulls)
         top = self.prefill_buckets[-1]
         results = [None] * len(fulls)
         prep = []
@@ -518,13 +657,54 @@ class DecodeEngine:
                 results[i] = (slot, [int(t) for t in full[pre + 1:]])
         return results
 
+    def _seat_prefilled_chunked(self, fulls):
+        """``seat_prefilled`` for the unified chunked engine: resident
+        prefixes still seat by REFERENCE (paged prefix cache); every
+        other context seats via ``seat_chunked`` with the WHOLE context
+        as the feed.  Same per-item isolation / defer-and-retry
+        contract."""
+        results = [None] * len(fulls)
+        for i, full in enumerate(fulls):
+            full = np.asarray(full, np.int32)
+            if self.kv_layout == "paged":
+                covered, chain = self._paged.lookup_prefix(full)
+                if covered and self.cached_seat_worthwhile(covered,
+                                                           full.size):
+                    try:
+                        results[i] = self.seat_cached(full, covered,
+                                                      chain)
+                        self.prefill_positions_total += max(
+                            0, int(full.size) - int(covered))
+                    except Exception as e:  # noqa: BLE001 — isolate
+                        results[i] = e      # to this item
+                    continue
+                if not self.can_admit(full.size + 1):
+                    # pool-dry fast path: defer before burning any work
+                    # (growth preemption covers transient shortfalls,
+                    # but a context the pool can't plausibly hold yet
+                    # should wait, not thrash victims)
+                    results[i] = InsufficientBlocksError(
+                        f"pool cannot hold {int(full.size) + 1} "
+                        "positions yet")
+                    continue
+            try:
+                results[i] = self.seat_chunked(full)
+                self.prefill_positions_total += int(full.size)
+            except Exception as e:  # noqa: BLE001 — per-item isolation
+                results[i] = e
+        return results
+
     def cached_seat_worthwhile(self, covered, size):
         """Seat through the prefix cache only when the resident coverage
         saves at least half the ladder-covered prefill: the uncovered
         remainder teacher-forces ONE DECODE STEP PER TOKEN, so a short
         shared preamble on a long prompt would cost more steps (and
         worse TTFT) than the single whole-prompt prefill it avoids —
-        route those as ordinary misses instead."""
+        route those as ordinary misses instead.  CHUNKED mode has no
+        ladder and the remainder rides K-lane chunks, so ANY resident
+        coverage strictly shrinks the feed: always worthwhile."""
+        if self.prefill_chunk:
+            return covered > 0
         return covered * 2 >= min(int(size) - 1, self.prefill_buckets[-1])
 
     def prefix_lookup(self, prompt):
@@ -558,30 +738,42 @@ class DecodeEngine:
             return []
         victims = []
         free_set = set(self._free)
+        bs = self.block_size
         for slot in range(self.num_slots):
             if slot in free_set or slot in victims:
                 continue
-            while True:
-                try:
-                    plan = self._paged.write_plan(slot,
-                                                  int(self._pos[slot]))
-                except InsufficientBlocksError:
-                    v = self._paged.victim(exclude=set(victims) | {slot})
-                    if v is None:
-                        raise     # one lone request outgrew the pool —
-                        #           validate_request bounds this; backstop
-                    obstrace.instant("kv.pool_exhausted_preempt", slot=v)
-                    self.evict(v, "pool_exhausted")
-                    victims.append(v)
-                    continue
-                break
-            if plan is not None and plan[0] == "cow":
-                _tag, _j, src, dst = plan
-                self._cache = self._jit_copy(self._cache, np.int32(src),
-                                             np.int32(dst))
-                obstrace.instant("kv.cow_fork", slot=slot, src=int(src),
-                                 dst=int(dst))
-                self.metrics.observe_cow_fork()
+            pos = int(self._pos[slot])
+            # chunked mode writes a SPAN this step (lane 0 .. lane
+            # _len-1): provision every touched block, in order, each
+            # CoW executed immediately so a mid-span exhaustion can
+            # never orphan a planned fork
+            n = int(self._len[slot]) if self.prefill_chunk else 1
+            for j in range(pos // bs, (pos + n - 1) // bs + 1):
+                p = pos if j == pos // bs else j * bs
+                while True:
+                    try:
+                        plan = self._paged.write_plan(slot, p)
+                    except InsufficientBlocksError:
+                        v = self._paged.victim(
+                            exclude=set(victims) | {slot})
+                        if v is None:
+                            raise     # one lone request outgrew the pool
+                            #           — validate_request bounds this;
+                            #           backstop
+                        obstrace.instant("kv.pool_exhausted_preempt",
+                                         slot=v)
+                        self.evict(v, "pool_exhausted")
+                        victims.append(v)
+                        continue
+                    break
+                if plan is not None and plan[0] == "cow":
+                    _tag, _j, src, dst = plan
+                    self._cache = self._jit_copy(self._cache,
+                                                 np.int32(src),
+                                                 np.int32(dst))
+                    obstrace.instant("kv.cow_fork", slot=slot,
+                                     src=int(src), dst=int(dst))
+                    self.metrics.observe_cow_fork()
         return victims
 
     def evict(self, slot, reason):
@@ -591,8 +783,7 @@ class DecodeEngine:
         their other sharers / the prefix index)."""
         if self.kv_layout == "paged":
             self._paged.evict(slot)
-        self._tokens[slot] = 0
-        self._pos[slot] = 0
+        self._arm(slot, 0, 0)
         self._free.append(slot)
         self.metrics.evict_slot(reason)
 
@@ -611,11 +802,17 @@ class DecodeEngine:
         epoch = self._epoch
         params, cache = self.params, self._cache
         tokens, pos = self._tokens.copy(), self._pos.copy()
+        lens = self._len.copy() if self.prefill_chunk else None
         # the fault point sits at the device-step boundary: a hang here
         # models a wedged device step for the watchdog to catch
         faults.hit("serving.decode_step")
         t0 = time.perf_counter()
-        if self.kv_layout == "paged":
+        if self.prefill_chunk and self.kv_layout == "paged":
+            nxt, cache = self._jit_step(params, cache, tokens, pos, lens,
+                                        self._paged.tables.copy())
+        elif self.prefill_chunk:
+            nxt, cache = self._jit_step(params, cache, tokens, pos, lens)
+        elif self.kv_layout == "paged":
             # block tables ride as DATA (snapshotted, like tokens/pos):
             # table churn between steps never retraces
             nxt, cache = self._jit_step(params, cache, tokens, pos,
@@ -629,18 +826,29 @@ class DecodeEngine:
                     f"{self.name}: engine was reset mid-step; stale step "
                     "result discarded")
             self._cache = cache
+        # teacher-forced lanes this step fed beyond the per-slot token
+        # (the chunked-prefill occupancy surface)
+        chunk_lanes = int(lens.sum() - self.num_slots) if lens is not None \
+            else 0
         self.metrics.observe_decode_step(self.num_active, self.num_slots,
-                                         time.perf_counter() - t0)
+                                         time.perf_counter() - t0,
+                                         prefill_lanes=chunk_lanes)
         if self.kv_layout == "paged":
             self.metrics.set_kv_pool(self._paged.pool.num_free,
                                      self._paged.pool.num_allocatable)
         return nxt
 
-    def advance(self, slot, token):
-        """Record the token just emitted for ``slot``: it is fed at the
-        next step, one position further along."""
-        self._tokens[slot] = token
-        self._pos[slot] += 1
+    def advance(self, slot, token, consumed=1):
+        """Record the token fed at the next step for ``slot``, advanced
+        past the ``consumed`` lanes the last step processed (1 = plain
+        decode; a chunked step advances by its lane count — the
+        per-slot variable advance)."""
+        if self.prefill_chunk:
+            self._tokens[slot, 0] = token
+            self._len[slot] = 1
+        else:
+            self._tokens[slot] = token
+        self._pos[slot] += consumed
 
     def reset(self):
         """Drop all slot state and re-zero the cache slab (the batch-
@@ -669,6 +877,8 @@ class DecodeEngine:
                     self.params, self.num_slots, self.max_len)
         self._tokens[:] = 0
         self._pos[:] = 0
+        if self.prefill_chunk:
+            self._len[:] = 1
         self._free = list(range(self.num_slots))[::-1]
 
     # ------------------------------------------------------------ warm-up
@@ -680,8 +890,12 @@ class DecodeEngine:
         never again in steady state (admission/eviction are host-side, so
         churn cannot retrace by construction — the churn test pins it).
         Idempotent: a second call only warms prefill buckets added since."""
-        for b in self.prefill_buckets:
-            self._prefill_engine(b).warmup()
+        if not self.prefill_chunk:
+            # the legacy ladder: one engine per prompt-length bucket.
+            # The chunked engine has NO prefill plane to warm — the one
+            # step below is the entire serving hot path.
+            for b in self.prefill_buckets:
+                self._prefill_engine(b).warmup()
         if self._warm:
             return
         # resolve the kernel path NOW — warm-up is the step's one trace,
@@ -696,7 +910,45 @@ class DecodeEngine:
                        else self.max_len)
             self.decode_kernels = _dk.covers(
                 self.num_heads, d, dkv, blk_len,
-                paged=self.kv_layout == "paged")
+                paged=self.kv_layout == "paged",
+                chunk=self.prefill_chunk or 1)
+        self.metrics.set_prefill_chunk(self.prefill_chunk)
+        if self.prefill_chunk:
+            if self.kv_layout == "paged":
+                # the CoW fork is the only other device op the chunked
+                # paged engine uses (block writes ride the step itself)
+                with expect_traces(lambda: self._copy_traces[0], 1,
+                                   f"decode[{self.name}]: block-fork "
+                                   "warm-up"):
+                    self._cache = self._jit_copy(self._cache, np.int32(0),
+                                                 np.int32(0))
+                with expect_traces(
+                        lambda: self.step_trace_count, 1,
+                        f"decode[{self.name}]: chunked paged step "
+                        "warm-up",
+                        hint="the chunked step is not shape-stable"):
+                    nxt, self._cache = self._jit_step(
+                        self.params, self._cache, self._tokens,
+                        self._pos, self._len, self._paged.tables.copy())
+                    jax.block_until_ready(nxt)
+            else:
+                with expect_traces(
+                        lambda: self.step_trace_count, 1,
+                        f"decode[{self.name}]: chunked slab step "
+                        "warm-up",
+                        hint="the chunked step is not shape-stable"):
+                    nxt, self._cache = self._jit_step(
+                        self.params, self._cache, self._tokens,
+                        self._pos, self._len)
+                    jax.block_until_ready(nxt)
+            self._warm = True
+            logger.info(
+                "decode[%s]: warm (%d slots, max_len %d, kv %s, decode "
+                "kernels %s, chunked prefill K=%d budget=%s)", self.name,
+                self.num_slots, self.max_len, self.kv_layout,
+                "fused-pallas" if self.decode_kernels else "xla-ref",
+                self.prefill_chunk, self.prefill_chunk_budget or "inf")
+            return
         if self.kv_layout == "paged":
             # ONE block-write shape and ONE fork shape serve every
             # bucket/admission/CoW — both warmed (and executed) against
@@ -750,6 +1002,15 @@ class DecodeEngine:
         re-stages the function (one extra trace), like
         ``InferenceEngine.lower``."""
         if what == "step":
+            if self.prefill_chunk and self.kv_layout == "paged":
+                return self._jit_step.lower(self.params, self._cache,
+                                            self._tokens, self._pos,
+                                            self._len,
+                                            self._paged.tables)
+            if self.prefill_chunk:
+                return self._jit_step.lower(self.params, self._cache,
+                                            self._tokens, self._pos,
+                                            self._len)
             if self.kv_layout == "paged":
                 return self._jit_step.lower(self.params, self._cache,
                                             self._tokens, self._pos,
@@ -792,9 +1053,12 @@ class DecodeEngine:
         return max_tokens
 
     def validate_request(self, prompt, max_tokens):
-        """Admission-control checks, raised BEFORE the queue."""
+        """Admission-control checks, raised BEFORE the queue.  The
+        chunked engine has no ladder, so only ``max_len`` caps the
+        prompt (chunks bound per-STEP work instead)."""
         prompt = self._validate_ids("prompt", prompt)
-        if prompt.size > self.prefill_buckets[-1]:
+        if not self.prefill_chunk \
+                and prompt.size > self.prefill_buckets[-1]:
             raise InvalidRequestError(
                 f"prompt length {prompt.size} exceeds the prefill ladder "
                 f"top {self.prefill_buckets[-1]}")
@@ -1227,9 +1491,21 @@ class GenerationBatcher:
             return
         # route: fresh misses prefill whole (emit at admission); fresh
         # prefix-cache hits and continuations reconstruct via
-        # seat_prefilled (nothing re-emitted)
+        # seat_prefilled (nothing re-emitted).  The CHUNKED engine has
+        # no prefill plane at all: EVERY request seats through
+        # seat_prefilled and its context drains through the unified
+        # step as K-lane chunks (first emission at the last chunk).
         fresh, recon = [], []
         for req in picked:
+            if self.engine.chunked:
+                if self.engine.kv_layout == "paged" \
+                        and req.replay_ctx is None \
+                        and not req.prefix_counted:
+                    req.prefix_counted = True
+                    self.metrics.observe_prefix_cache(
+                        hit=req.admit_covered > 0)
+                recon.append(req)
+                continue
             if req.replay_ctx is not None:
                 recon.append(req)
                 continue
@@ -1336,11 +1612,16 @@ class GenerationBatcher:
             else:
                 req.slot, req.replay_feed = out
                 self._by_slot[req.slot] = req
+                if req.replay_ctx is not None:
+                    mode = "continuation"
+                elif not self.engine.chunked or req.admit_covered:
+                    mode = "prefix_hit"
+                else:
+                    mode = "prefill"        # fresh chunked admission
                 req.slot_span = obstrace.start_span(
                     "slot", ctx=req.trace_ctx, root=False,
-                    slot=int(req.slot),
-                    mode=("continuation" if req.replay_ctx is not None
-                          else "prefix_hit"),
+                    slot=int(req.slot), mode=mode,
+                    chunked=self.engine.chunked,
                     teacher_forced=len(req.replay_feed))
         if hard is not None:
             # the failed seat was a device op (prefill / admit /
@@ -1400,6 +1681,31 @@ class GenerationBatcher:
             # failed seat was a device op — never step a possibly-
             # consumed buffer
             self._fail_all_inflight(hard)
+
+    def _load_chunks(self):
+        """Chunked mode, strictly between steps: arm each feeding slot's
+        next up-to-(K-1)-token chunk (prompt ingestion, continuation
+        replay, recovery replay — one mechanism), bounded by the
+        engine's per-step chunk budget.  Lane counts are DATA: mixing
+        decode rows with chunking rows never retraces.  A slot that gets
+        no lanes this step (budget spent) still advances one
+        teacher-forced token through its lane 0, so feeding always makes
+        progress."""
+        kk = self.engine.prefill_chunk
+        budget = self.engine.prefill_chunk_budget
+        used = 0
+        for slot, req in self._by_slot.items():
+            if not req.replay_feed or kk < 2:
+                continue
+            n = min(kk - 1, len(req.replay_feed))
+            if budget:
+                n = min(n, budget - used)
+            if n <= 0:
+                continue
+            self.engine.load_chunk(slot, req.replay_feed[:n])
+            used += n
+            req.slot_span.event("prefill_chunk", lanes=int(n),
+                                pos=int(self.engine._pos[slot]))
 
     def _snap_breaker(self):
         """Mirror the breaker's state into the metrics gauge."""
@@ -1526,6 +1832,8 @@ class GenerationBatcher:
                     return
                 continue
             sup = self.supervisor
+            if self.engine.chunked:
+                self._load_chunks()
             try:
                 # paged layout: provision every active slot's write block
                 # (chain growth + copy-on-write forks) strictly BETWEEN
@@ -1574,28 +1882,45 @@ class GenerationBatcher:
                 if req.abandoned:
                     self._finish(req, "abandoned")
                     continue
+                # lanes this step processed for the slot (1 = plain
+                # decode; >1 = a prefill/replay chunk, chunked mode)
+                consumed = self.engine.chunk_len(slot)
                 if req.replay_feed:
-                    # recovery replay (teacher-forced): this step's
-                    # emission re-derives an already-delivered token —
-                    # swallow it and feed the recorded stream instead,
-                    # until the slot regains its pre-failure position
-                    self.engine.advance(slot, req.replay_feed.pop(0))
-                    continue
+                    if len(req.replay_feed) >= consumed:
+                        # teacher-forced feeding continues: this step's
+                        # emission re-derives an already-known token —
+                        # swallow it and feed the recorded stream, until
+                        # the slot reaches the end of its context
+                        self.engine.advance(
+                            slot, req.replay_feed[consumed - 1],
+                            consumed)
+                        del req.replay_feed[:consumed]
+                        continue
+                    # the feed drained EXACTLY at this step's last lane:
+                    # its emission is the first real one — fall through
+                    del req.replay_feed[:]
                 tok = int(nxt[slot])
                 first_emit = req.t_first is None
                 req.emit(tok, self.name)
                 if first_emit:
-                    # a continuation's first NEW token is its TTFT (the
-                    # fresh-prompt path records it at prefill instead)
+                    # chunked admissions and continuations reach their
+                    # first token HERE (the fresh-prompt ladder path
+                    # records it at prefill instead)
                     req.slot_span.event("first_token")
                     self.metrics.observe_ttft(req.t_first - req.t_submit)
+                    if self.engine.chunked and req.replay_ctx is None:
+                        # the prompt's K/V is fully resident exactly
+                        # now: publish it to the paged prefix index
+                        # (no-op on slab), the chunked twin of the
+                        # ladder path's admit-time registration
+                        self.engine.register_context(slot, req.prompt)
                 self.metrics.observe_gen_tokens(1)
                 if req.eos_id is not None and tok == req.eos_id:
                     self._finish(req, "eos")
                 elif len(req.tokens) >= req.max_tokens:
                     self._finish(req, "length")
                 else:
-                    self.engine.advance(slot, tok)
+                    self.engine.advance(slot, tok, consumed)
 
     # ------------------------------------------------------------ shutdown
 

@@ -86,6 +86,12 @@ class ServingMetrics:
         self.decode_steps_total = 0
         self.active_slot_steps_total = 0  # sum of active slots over steps
         self.slot_count = 0              # gauge, set by the decode engine
+        # ---- unified chunked prefill (decode_engine.py prefill_chunk):
+        # prompt ingestion folded into the decode step as K-lane chunks
+        self.prefill_chunks_total = 0    # chunks loaded into steps
+        self.prefill_chunk_lanes_total = 0  # teacher-forced lanes loaded
+        self.prefill_lane_steps_total = 0   # sum of per-step chunk lanes
+        self.prefill_chunk_size = 0      # gauge: engine K (0 = ladder)
         self.evictions = {r: 0 for r in EVICT_REASONS}
         # ---- paged KV cache (decode_engine.py kv_layout="paged" over
         # serving/kv_pool.py): block-pool gauges + prefix-sharing and
@@ -140,13 +146,29 @@ class ServingMetrics:
     def observe_ttft(self, seconds):
         self.ttft.add(seconds)
 
-    def observe_decode_step(self, n_active, n_slots, seconds):
-        """One slab decode step: n_active of n_slots held live requests."""
+    def observe_decode_step(self, n_active, n_slots, seconds,
+                            prefill_lanes=0):
+        """One slab decode step: n_active of n_slots held live requests;
+        prefill_lanes = teacher-forced chunk lanes the step fed beyond
+        each slot's own token (0 outside chunked-prefill mode)."""
         with self._lock:
             self.decode_steps_total += 1
             self.active_slot_steps_total += int(n_active)
             self.slot_count = int(n_slots)
+            self.prefill_lane_steps_total += int(prefill_lanes)
         self.tpot.add(seconds)
+
+    def observe_prefill_chunk(self, lanes):
+        """One prefill chunk loaded into the next step (``lanes``
+        teacher-forced lanes beyond the slot's armed token)."""
+        with self._lock:
+            self.prefill_chunks_total += 1
+            self.prefill_chunk_lanes_total += int(lanes)
+
+    def set_prefill_chunk(self, k):
+        """Gauge: the engine's chunk size K (0 = legacy ladder mode)."""
+        with self._lock:
+            self.prefill_chunk_size = int(k)
 
     def observe_gen_tokens(self, n=1):
         with self._lock:
@@ -227,6 +249,26 @@ class ServingMetrics:
             return (self.active_slot_steps_total / self.decode_steps_total
                     if self.decode_steps_total else 0.0)
 
+    @property
+    def mean_prefill_chunk_occupancy(self):
+        """Fraction of the per-step chunk-lane capacity
+        (``slots * (K - 1)`` teacher-forced lanes) actually fed, over
+        the steps executed — how much of each unified step is prompt
+        ingestion vs decode.  0.0 outside chunked mode."""
+        with self._lock:
+            cap = (self.decode_steps_total * self.slot_count
+                   * max(0, self.prefill_chunk_size - 1))
+            return (self.prefill_lane_steps_total / cap) if cap else 0.0
+
+    def tpot_jitter(self):
+        """Recent-window TPOT p99/p50 ratio — the jitter a long-prompt
+        admission injects into in-flight streams' token cadence (1.0 =
+        perfectly steady; the chunked-prefill acceptance metric).  0.0
+        with no samples."""
+        pct = self.tpot.percentiles((50, 99))
+        p50, p99 = pct.get(50, 0.0), pct.get(99, 0.0)
+        return (p99 / p50) if p50 > 0 else 0.0
+
     def queue_depth(self):
         total = 0
         for fn in list(self.queue_depth_fns):
@@ -254,6 +296,10 @@ class ServingMetrics:
                 "gen_tokens_total": self.gen_tokens_total,
                 "decode_steps_total": self.decode_steps_total,
                 "slot_count": self.slot_count,
+                "prefill_chunks_total": self.prefill_chunks_total,
+                "prefill_chunk_lanes_total":
+                    self.prefill_chunk_lanes_total,
+                "prefill_chunk_size": self.prefill_chunk_size,
                 "evictions": dict(self.evictions),
                 "kv_blocks_total": self.kv_blocks_total,
                 "kv_blocks_free": self.kv_blocks_free,
@@ -279,6 +325,9 @@ class ServingMetrics:
         out["mean_occupancy"] = round(self.mean_occupancy, 3)
         out["padding_waste"] = round(self.padding_waste, 3)
         out["mean_slot_occupancy"] = round(self.mean_slot_occupancy, 3)
+        out["mean_prefill_chunk_occupancy"] = round(
+            self.mean_prefill_chunk_occupancy, 4)
+        out["tpot_jitter_p99_p50"] = round(self.tpot_jitter(), 3)
         out["latency_ms"] = {f"p{q}": round(v * 1e3, 3)
                              for q, v in lat.items()}
         out["batch_time_ms"] = {f"p{q}": round(v * 1e3, 3)
@@ -367,13 +416,28 @@ class ServingMetrics:
                  "fresh admissions that re-prefilled (paged KV cache)"),
                 ("cow_forks_total", self.cow_forks,
                  "copy-on-write KV block forks (paged KV cache)"),
+                ("prefill_chunks_total", self.prefill_chunks_total,
+                 "prompt-ingestion chunks fed through the unified "
+                 "decode step (chunked prefill)"),
+                ("prefill_chunk_lanes_total",
+                 self.prefill_chunk_lanes_total,
+                 "teacher-forced chunk lanes fed through the unified "
+                 "decode step (chunked prefill)"),
             ]
             evictions = dict(self.evictions)
             slot_count = self.slot_count
             kv_total = self.kv_blocks_total
             kv_free = self.kv_blocks_free
+            chunk_size = self.prefill_chunk_size
         for metric, value, help_ in gen_counters:
             emit(metric, value, help_, mtype="counter")
+        emit("prefill_chunk_size", chunk_size,
+             "chunked-prefill lanes per step (K; 0 = legacy ladder)")
+        emit("prefill_chunk_occupancy_mean",
+             f"{self.mean_prefill_chunk_occupancy:.6f}",
+             "fraction of per-step chunk-lane capacity fed")
+        emit("tpot_jitter_p99_p50", f"{self.tpot_jitter():.6f}",
+             "recent-window TPOT p99/p50 ratio (token-cadence jitter)")
         emit("kv_blocks_total", kv_total,
              "allocatable KV blocks in the paged pool (0 = slab layout)")
         emit("kv_blocks_free", kv_free, "free KV blocks in the paged pool")

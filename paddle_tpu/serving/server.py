@@ -57,6 +57,18 @@ CLI (``python -m paddle_tpu.serving``):
                                    fork, streams bit-identical to the
                                    slab twin, ONE JSON line
                                    (healthy_window.sh phase 11)
+  --prefill-chunk K                unified chunked prefill (the
+                                   default): prompt ingestion rides the
+                                   ONE decode step as K-token chunks;
+                                   0 = the legacy prefill ladder
+                                   (docs/serving.md "Chunked prefill")
+  --smoke-chunked                  chunked-prefill self-test: a long
+                                   prompt admitted MID-DECODE chunks
+                                   through the step while in-flight
+                                   streams keep emitting, all streams
+                                   bit-identical to the ladder twin,
+                                   ONE JSON line (healthy_window.sh
+                                   phase 15)
 
 The JSON front-end serves plain-array feed slots (dense/index vectors);
 structured SequenceBatch slots are an in-process engine feature.
@@ -551,7 +563,10 @@ def _demo_gen_batcher(args, tiny=False, metrics=None):
                           kv_layout=args.kv_layout,
                           kv_block_size=args.kv_block_size,
                           kv_num_blocks=args.kv_num_blocks,
-                          prefix_cache=args.kv_prefix_cache)
+                          prefix_cache=args.kv_prefix_cache,
+                          prefill_chunk=getattr(args, "prefill_chunk", 0),
+                          prefill_chunk_budget=getattr(
+                              args, "prefill_chunk_budget", 0))
     # supervision on by default for the generation plane: the breaker
     # and recovery are pure host bookkeeping (zero cost absent failures);
     # the step watchdog only arms when a deadline is configured
@@ -988,6 +1003,93 @@ def _smoke_decode_fused(args):
     return 0 if ok_layouts == 2 else 2
 
 
+def _smoke_chunked(args):
+    """Chunked-prefill self-test (healthy_window.sh phase 15; docs/
+    serving.md "Chunked prefill"): the demo LM with prompt ingestion
+    folded into the unified decode step.  A short stream is put
+    mid-decode, then a LONG prompt (the legacy ladder's whole top
+    bucket) is admitted: its ingestion must ride the step as chunks
+    (``prefill_chunks_total``), the in-flight stream must KEEP EMITTING
+    between the newcomer's submit and its first token (the TPOT-
+    bounding property the legacy ladder lacks — its monolithic prefill
+    stalls every in-flight row), and every stream must come back
+    bit-identical to the same prompts served through a legacy-ladder
+    twin engine (one compiled trunk, two ingestion modes, same greedy
+    tokens).  Prints ONE JSON line; returns the process exit code."""
+    import copy
+
+    chunk_args = copy.copy(args)
+    chunk_args.prefill_chunk = min(4, args.prefill_chunk or 4) or 4
+    gen = _demo_gen_batcher(chunk_args, tiny=True)
+    ladder_args = copy.copy(args)
+    ladder_args.prefill_chunk = 0
+    ladder = _demo_gen_batcher(ladder_args, tiny=True)
+    kk = gen.engine.prefill_chunk
+    rng = np.random.RandomState(0)
+    short = rng.randint(1, 256, 4).astype(np.int64)
+    long_p = rng.randint(1, 256, 16).astype(np.int64)  # tiny ladder top
+    n_short, n_long = 40, 6
+    errs = []
+    a_tokens = []               # appended on the worker thread, so the
+    #                             counts below are step-ordered, not
+    #                             wall-clock-dependent
+    a_count_at_b = [None]
+    out = {"metric": "chunked-prefill smoke (unified step vs legacy "
+                     "ladder twin)", "vs_baseline": None,
+           "prefill_chunk": kk}
+    try:
+        fut_a = gen.submit(short, max_tokens=n_short,
+                           on_token=lambda _t:
+                           a_tokens.append(time.perf_counter()))
+        deadline = time.perf_counter() + 60
+        while not a_tokens and time.perf_counter() < deadline:
+            time.sleep(0.002)       # put A provably mid-decode
+        a_count_submit = len(a_tokens)
+        fut_b = gen.submit(long_p, max_tokens=n_long,
+                           on_token=lambda _t, s=a_count_at_b:
+                           s.__setitem__(0, s[0] if s[0] is not None
+                                         else len(a_tokens)))
+        res_b = fut_b.result(120)
+        res_a = fut_a.result(120)
+        # decode tokens A emitted between B's submit and B's first token
+        # — every one delivered WHILE B's prompt was chunking through
+        # the shared step (both counters advance on the worker thread)
+        interleaved = max(0, (a_count_at_b[0] or 0) - a_count_submit)
+        ref_a = ladder.submit(short, max_tokens=n_short).result(120)
+        ref_b = ladder.submit(long_p, max_tokens=n_long).result(120)
+        bit_identical = (res_a["tokens"] == ref_a["tokens"]
+                         and res_b["tokens"] == ref_b["tokens"])
+        requests_ok = 2
+    except Exception as e:      # noqa: BLE001 — a probe failure must
+        # become a failed flag in the ONE JSON line, not a traceback
+        errs.append(f"{type(e).__name__}: {e}")
+        requests_ok, interleaved, bit_identical = 0, 0, False
+    snap = gen.metrics.snapshot()
+    min_chunks = -(-int(long_p.size - 1) // max(1, kk - 1))
+    out.update({
+        "value": requests_ok, "unit": "requests_ok/2",
+        "bit_identical": bool(bit_identical),
+        # decode tokens the in-flight stream received while the long
+        # prompt was being ingested — the ladder's monolithic prefill
+        # yields 0 here by construction
+        "interleaved_tokens": int(interleaved),
+        "prefill_chunks_total": snap["prefill_chunks_total"],
+        "prefill_chunk_lanes_total": snap["prefill_chunk_lanes_total"],
+        "mean_prefill_chunk_occupancy":
+            snap["mean_prefill_chunk_occupancy"],
+        "tpot_jitter_p99_p50": snap["tpot_jitter_p99_p50"],
+        "ttft_long_ms": snap["ttft_ms"]["p99"],
+    })
+    if errs:
+        out["errors"] = errs[:5]
+    gen.close()
+    ladder.close()
+    print(json.dumps(out), flush=True)
+    passed = (requests_ok == 2 and bit_identical and interleaved >= 1
+              and snap["prefill_chunks_total"] >= min_chunks)
+    return 0 if passed else 2
+
+
 def _write_port_file(path, port):
     """Publish the BOUND port (meaningful with --port 0) atomically —
     the fleet supervisor (serving/fleet.py) spawns replicas on ephemeral
@@ -1042,6 +1144,22 @@ def main(argv=None):
                          "step: auto (TPU only) | always (interpret "
                          "off-TPU) | off — docs/perf.md 'Fused decode "
                          "kernels'")
+    # ---- unified chunked prefill (docs/serving.md "Chunked prefill") --
+    ap.add_argument("--prefill-chunk", type=int,
+                    default=FLAGS.serving_prefill_chunk,
+                    help="fold prompt ingestion into the one decode "
+                         "step as up-to-K-token chunks per slot per "
+                         "step (the default serving mode); 0 = the "
+                         "legacy per-bucket prefill ladder")
+    ap.add_argument("--prefill-chunk-budget", type=int,
+                    default=FLAGS.serving_prefill_chunk_budget,
+                    help="max teacher-forced chunk lanes per step "
+                         "across all slots (bounds TPOT jitter; "
+                         "0 = unbounded)")
+    ap.add_argument("--pallas-prefill", default=FLAGS.pallas_prefill,
+                    help="route the legacy ladder's lm_prefill causal "
+                         "pass through the flash kernel (no [Tp, Tp] "
+                         "scores): auto (TPU only) | always | off")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=FLAGS.serving_port)
     ap.add_argument("--port-file",
@@ -1073,6 +1191,12 @@ def main(argv=None):
                          "(slab + paged), streams bit-identical to a "
                          "reference-path twin, 0 retraces; one JSON "
                          "line, exit")
+    ap.add_argument("--smoke-chunked", action="store_true",
+                    help="chunked-prefill self-test: a long prompt "
+                         "admitted MID-DECODE must chunk through the "
+                         "unified step while in-flight streams keep "
+                         "emitting, every stream bit-identical to the "
+                         "legacy-ladder twin; one JSON line, exit")
     # ---- resilience (docs/serving.md §6) ----
     ap.add_argument("--drain-timeout-s", type=float,
                     default=FLAGS.serving_drain_timeout_s,
@@ -1098,9 +1222,10 @@ def main(argv=None):
     ap.add_argument("--obs-trace-ring", type=int,
                     default=FLAGS.obs_trace_ring)
     args = ap.parse_args(argv)
-    # kernel selection is read at TRACE time — push the flag before any
+    # kernel selection is read at TRACE time — push the flags before any
     # engine is constructed
     FLAGS.pallas_decode = args.pallas_decode
+    FLAGS.pallas_prefill = args.pallas_prefill
     if args.fault_spec:
         from paddle_tpu.resilience import faults
         faults.install_spec(args.fault_spec)
@@ -1122,6 +1247,8 @@ def main(argv=None):
         return _smoke_paged(args)
     if args.smoke_decode_fused:
         return _smoke_decode_fused(args)
+    if args.smoke_chunked:
+        return _smoke_chunked(args)
     if args.demo_generate and not (args.artifact or args.artifacts
                                    or args.demo):
         # generation-only server: no /v1/infer batcher

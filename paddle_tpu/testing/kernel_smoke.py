@@ -258,6 +258,76 @@ def _decode_paged_case(tol=1e-4):
     return err
 
 
+def _chunk_lanes_ref(positions, lengths, kk):
+    li = np.minimum(np.arange(kk)[None, :], lengths[:, None] - 1)
+    return (positions[:, None] + li).astype(np.int32)
+
+
+def _decode_slab_chunk_case(tol=1e-4):
+    """Tq=chunk slab kernel (the unified chunked-prefill step's
+    attention) vs the per-lane masked-XLA oracle: mixed decode rows
+    (1 lane) and chunking rows (full K lanes), GQA width included."""
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import decode_attention as dk
+
+    errs = []
+    for h, hkv, dh, s, t, kk in ((8, 8, 128, 8, 256, 4),
+                                 (8, 2, 128, 8, 256, 8)):
+        d, dkv = h * dh, hkv * dh
+        rng = np.random.RandomState(h * 10 + hkv + kk)
+        q = jnp.asarray(rng.randn(s, kk, d) * 0.5, jnp.float32)
+        k = jnp.asarray(rng.randn(s, t, dkv) * 0.5, jnp.float32)
+        v = jnp.asarray(rng.randn(s, t, dkv) * 0.5, jnp.float32)
+        pos = rng.randint(0, t - kk, s).astype(np.int32)
+        lens = rng.randint(1, kk + 1, s).astype(np.int32)
+        lens[0], lens[-1] = 1, kk       # pin both extremes
+        qpos = _chunk_lanes_ref(pos, lens, kk)
+        with dk.forced_mode("always"):
+            out = jax.jit(lambda q, k, v, qp: dk.maybe_slab_chunk(
+                q, k, v, qp, h))(q, k, v, jnp.asarray(qpos))
+        assert out is not None, \
+            "slab chunk kernel declined a supported shape"
+        pm = jnp.asarray(np.arange(t)[None, None, :]
+                         <= qpos[:, :, None])
+        want = transformer._attend(q, k, v, h, pm)
+        errs.append(_max_err(out, want))
+    err = max(errs)
+    assert err <= tol, f"decode_slab_chunk max err {err:.3e} > tol {tol}"
+    return err
+
+
+def _decode_paged_chunk_case(tol=1e-4):
+    """Tq=chunk paged kernel (block-table scalar prefetch, chunk lanes
+    sharing each streamed block) vs the chain-gather oracle."""
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import decode_attention as dk
+
+    h, hkv, dh, s, bs, nb_row, kk = 8, 2, 128, 8, 16, 8, 8
+    d, dkv = h * dh, hkv * dh
+    nb = s * nb_row + 1
+    t = nb_row * bs
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(s, kk, d) * 0.5, jnp.float32)
+    kp = jnp.asarray(rng.randn(nb, bs, dkv) * 0.5, jnp.float32)
+    vp = jnp.asarray(rng.randn(nb, bs, dkv) * 0.5, jnp.float32)
+    pos = rng.randint(0, t - kk, s).astype(np.int32)
+    lens = rng.randint(1, kk + 1, s).astype(np.int32)
+    qpos = _chunk_lanes_ref(pos, lens, kk)
+    tables = build_private_tables(qpos[:, -1], nb_row, bs, nb)
+    with dk.forced_mode("always"):
+        out = jax.jit(lambda q, kp, vp, qp, tbl: dk.maybe_paged_chunk(
+            q, kp, vp, qp, tbl, h))(q, kp, vp, jnp.asarray(qpos),
+                                    jnp.asarray(tables))
+    assert out is not None, "paged chunk kernel declined a supported shape"
+    k_rows = kp[jnp.asarray(tables)].reshape(s, -1, dkv)
+    v_rows = vp[jnp.asarray(tables)].reshape(s, -1, dkv)
+    pm = jnp.asarray(np.arange(t)[None, None, :] <= qpos[:, :, None])
+    want = transformer._attend(q, k_rows, v_rows, h, pm)
+    err = _max_err(out, want)
+    assert err <= tol, f"decode_paged_chunk max err {err:.3e} > tol {tol}"
+    return err
+
+
 CASES = {
     "lstm_fused": lambda: _rnn_case("lstm"),
     "lstm_blocked": _lstm_blocked_case,
@@ -267,4 +337,6 @@ CASES = {
     "flash_attention_causal": lambda: _flash_case(causal=True),
     "decode_attention_slab": _decode_slab_case,
     "decode_attention_paged": _decode_paged_case,
+    "decode_attention_slab_chunk": _decode_slab_chunk_case,
+    "decode_attention_paged_chunk": _decode_paged_chunk_case,
 }

@@ -105,11 +105,27 @@ class Flags:
     #                                     (0 = slab-equivalent bytes)
     serving_kv_prefix_cache: bool = True  # share resident prompt-prefix
     #                                       blocks across requests
+    # ---- unified chunked prefill (decode_engine.py prefill_chunk:
+    # prompt ingestion folded into the ONE jitted decode step as K-lane
+    # chunks; docs/serving.md "Chunked prefill").  The serving CLI
+    # defaults to chunked; 0 demotes to the legacy per-bucket prefill
+    # ladder.
+    serving_prefill_chunk: int = 8      # lanes per chunked-prefill step
+    #                                     (K; 0 = legacy ladder prefill)
+    serving_prefill_chunk_budget: int = 0  # max teacher-forced lanes per
+    #                                        step across all slots
+    #                                        (0 = unbounded); data, not
+    #                                        shape — tuning never
+    #                                        retraces
     # ---- fused decode kernels (ops/pallas/decode_attention.py: read
     # the KV cache once per step; docs/perf.md "Fused decode kernels")
     pallas_decode: str = "auto"         # auto (use_pallas(): TPU only) |
     #                                     always (interpret off-TPU) | off
     pallas_decode_block_k: int = 512    # slab kernel k-tile cap
+    pallas_prefill: str = "auto"        # route lm_prefill's batched
+    #                                     causal pass through the flash
+    #                                     kernel (no [Tp, Tp] scores):
+    #                                     auto (TPU only) | always | off
     # ---- replicated serving tier (serving/fleet.py supervisor +
     # serving/router.py health-checked router; docs/serving.md §7)
     router_port: int = 8000             # HTTP port for the router CLI
@@ -353,6 +369,18 @@ FLAG_DOCS = {
     "serving_kv_prefix_cache": ("share resident prompt-prefix blocks "
                                 "across requests (copy-on-write on "
                                 "divergence)", "—"),
+    "serving_prefill_chunk": ("unified chunked prefill: prompt "
+                              "ingestion rides the ONE jitted decode "
+                              "step as up-to-K-token chunks per slot "
+                              "per step (first token at the last "
+                              "chunk); 0 = the legacy per-bucket "
+                              "prefill InferenceEngine ladder", "—"),
+    "serving_prefill_chunk_budget": ("max teacher-forced chunk lanes "
+                                     "one step may feed across all "
+                                     "slots (bounds per-step prefill "
+                                     "work, hence TPOT jitter; 0 = "
+                                     "unbounded).  Fed as data — "
+                                     "tuning it never retraces", "—"),
     "pallas_decode": ("fused Pallas decode-attention kernels for the "
                       "slot/paged serving steps: auto = on when the "
                       "backend compiles Pallas natively (TPU), always = "
@@ -363,6 +391,13 @@ FLAG_DOCS = {
                               "per KV block streamed through VMEM); the "
                               "kernel picks the largest tileable divisor "
                               "of max_len under this", "—"),
+    "pallas_prefill": ("route lm_prefill/lm_generate's batched causal "
+                       "pass through ops/pallas/flash_attention (no "
+                       "[Tp, Tp] score matrix): auto = TPU only (the "
+                       "CPU default stays the masked XLA reference, "
+                       "preserving bit-identity discipline), always = "
+                       "force (interpret off-TPU), off.  Read at trace "
+                       "time", "—"),
     "router_port": ("HTTP port for python -m paddle_tpu.serving.router",
                     "—"),
     "router_poll_interval_s": ("how often the router polls each "

@@ -50,7 +50,7 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "fleet_smoke.json", "paged_smoke.json",
                  "trace_smoke.json", "trace_chrome.json",
                  "decode_fused_smoke.json", "autoscale_smoke.json",
-                 "WINDOW_DONE"):
+                 "chunked_smoke.json", "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -150,6 +150,16 @@ def test_dryrun_executes_every_phase(tmp_path):
     assert asc["recovered_under_target"] is True, asc
     assert asc["failed"] == 0 and asc["completed"] > 0, asc
     assert asc["decisions_out"] >= 1 and asc["decisions_in"] >= 1, asc
+    # the chunked-prefill smoke really unified: the long prompt chunked
+    # through the shared decode step (>= ceil(15/(K-1)) chunks), the
+    # in-flight stream kept emitting while it ingested, and both streams
+    # came back bit-identical to the legacy-ladder twin
+    chk = json.loads((art / "chunked_smoke.json").read_text())
+    assert chk["value"] == int(chk["unit"].split("/")[1]), chk
+    assert chk["bit_identical"] is True, chk
+    assert chk["interleaved_tokens"] >= 1, chk
+    assert chk["prefill_chunks_total"] >= 2, chk
+    assert chk["prefill_chunk_lanes_total"] >= 15, chk
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows
