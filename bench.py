@@ -1668,6 +1668,292 @@ def bench_serving_chunked_prefill(slots=8, n_requests=36, vocab=256,
         f"{long_prompt}; unified step vs legacy ladder)"), extras
 
 
+def bench_serving_quant(slots=8, n_requests=48, vocab=256, d_model=128,
+                        dff=256, layers=3, heads=2, block_size=8, seed=0):
+    """Quantized serving (paddle_tpu/quant/; docs/serving.md "Quantized
+    serving"): fp32 vs int8-KV vs int8-KV+int8-weights at a FIXED
+    KV-BYTE budget.  The fp32 paged engine gets ``slots * ceil(max_len
+    / block_size)`` blocks; the int8 engines get DOUBLE the block count
+    — and 2x the slot count — inside the same bytes (an int8 block plus
+    its f32 per-head scale sidecar costs (1/4 + 1/head_dim) of the f32
+    block; serving/kv_pool.slab_equivalent_blocks).  Closed-loop
+    mixed-length traffic at 48 clients reports per variant: useful
+    tokens/s, p99 TTFT, effective streams (mean active slots/step), and
+    the quality evidence — every int8 stream inside the COMMITTED
+    quality budget vs the fp32 engine's stream for the same request
+    (quant/kv.py GREEDY_PREFIX_MIN_FULL; exact-match counts recorded),
+    and the full-quant engine TOKEN-EXACT against the quantized
+    ``lm_generate`` oracle on a probe set (greedy determinism inside
+    one quantization mode).
+
+    The analytic leg is the acceptance bar (perf/analytic.capture runs
+    extras["postcheck"] on extras["lower"] — the int8-KV+weights paged
+    step with the fused kernels forced): (a) every quantized weight
+    enters the compiled step as an s8 parameter and no float parameter
+    of that shape exists (assert_weights_quantized — the fp32 twin must
+    FAIL the same gate), (b) no widened-KV [S, T, Dkv] float buffer in
+    the kernel-forced HLO (assert_kv_quantized — the kernels-off int8
+    reference must TRIP the same detector: it dequantizes the gathered
+    stripe), and (c) predicted decode-step bytes
+    (perf/analytic.predicted_decode_step_bytes — first-principles: the
+    XLA-CPU cost model materializes the dequant converts the TPU fuses,
+    so like serving_decode_fused the prediction composes declared
+    traffic) shrink >= 35% for int8-KV+weights vs fp32."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import decode_attention as decode_kernels
+    from paddle_tpu.perf import analytic as perf_analytic
+    from paddle_tpu.quant import kv as quant_kv
+    from paddle_tpu.quant import weights as quant_weights
+    from paddle_tpu.serving import GenerationBatcher, ServingMetrics
+    from paddle_tpu.serving.decode_engine import DecodeEngine
+
+    prefill_buckets = (8, 16)
+    gen_short, gen_long = 6, 48
+    max_len = prefill_buckets[-1] + gen_long
+    nb_row = -(-max_len // block_size)
+    budget_blocks = slots * nb_row          # the fixed f32 byte budget
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=1, d_model=d_model, dff=dff,
+                              enc_layers=layers, dec_layers=0,
+                              max_len=max_len, num_heads=heads)
+    qparams = quant_weights.quantize_lm(params)
+    dkv = int(quant_weights.weight_shape(
+        params["enc"][0]["attn"]["wk"])[1])
+    warm = os.environ.get("BENCH_ANALYTIC_BUILD") != "1"
+
+    def make_engine(name, p, kv_dtype, n_slots, n_blocks):
+        return DecodeEngine(
+            p, num_heads=heads, num_slots=n_slots, max_len=max_len,
+            prefill_buckets=prefill_buckets,
+            prefill_batch_buckets=(1, 8), name=name, warm=warm,
+            kv_layout="paged", kv_block_size=block_size,
+            kv_num_blocks=n_blocks + 1, kv_dtype=kv_dtype)
+
+    # fp32: the budget as-is.  int8: 2x blocks AND 2x slots in the SAME
+    # bytes — concurrency bounded by blocks actually used
+    f32 = make_engine("bench_q_f32", params, "float32", slots,
+                      budget_blocks)
+    i8 = make_engine("bench_q_i8kv", params, "int8", 2 * slots,
+                     2 * budget_blocks)
+    i8w = make_engine("bench_q_i8kv_w", qparams, "int8", 2 * slots,
+                      2 * budget_blocks)
+    rng = np.random.RandomState(seed)
+    mixed = [(rng.randint(1, vocab, rng.randint(3, 9)).astype(np.int32),
+              gen_long if i < slots // 2 else gen_short)
+             for i in range(n_requests)]
+
+    def drive(engine, n_clients, reqs):
+        engine.metrics = ServingMetrics()
+        bat = GenerationBatcher(engine, queue_size=4096)
+        lock, nxt, tokens, ttfts = threading.Lock(), [0], [0], []
+        outs = [None] * len(reqs)
+
+        def client():
+            while True:
+                with lock:
+                    i = nxt[0]
+                    if i >= len(reqs):
+                        return
+                    nxt[0] += 1
+                prompt, mt = reqs[i]
+                out = bat.submit(prompt, max_tokens=mt).result(300)
+                outs[i] = out["tokens"]
+                with lock:
+                    ttfts.append(out["ttft_ms"])
+                    tokens[0] += len(out["tokens"])
+
+        ts = [threading.Thread(target=client) for _ in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        bat.close()
+        ttfts.sort()
+        snap = engine.metrics.snapshot()
+        return {"tokens_per_s": round(tokens[0] / dt, 1),
+                "ttft_p99_ms": round(ttfts[min(len(ttfts) - 1,
+                                               int(len(ttfts) * 0.99))],
+                                     2),
+                "effective_streams": snap["mean_slot_occupancy"],
+                "kv_blocks_total": snap["kv_blocks_total"],
+                "outs": outs}
+
+    # ---- analytic leg: the standalone quantized paged step ----------
+    s_an = 4 * slots
+    t_span = nb_row * block_size
+    an_rng = np.random.RandomState(1)
+    an_tokens = an_rng.randint(1, vocab, s_an).astype(np.int32)
+    an_pos = an_rng.randint(1, max_len - 1, s_an).astype(np.int32)
+    an_blocks = s_an * nb_row + 1
+    from paddle_tpu.testing.kernel_smoke import build_private_tables
+    an_tables = build_private_tables(an_pos, nb_row, block_size,
+                                     an_blocks)
+
+    def staged(p, kv_dtype, mode):
+        cache = transformer.init_lm_cache_paged(
+            p, an_blocks, block_size, max_len=max_len,
+            kv_dtype=kv_dtype, num_heads=heads)
+        with decode_kernels.forced_mode(mode):
+            def fn(pp, c, tok, po, tbl):
+                logits, c = transformer.lm_decode_step_paged(
+                    pp, tok, po, c, tbl, heads)
+                return jnp.argmax(logits, axis=-1), c
+            return jax.jit(fn).lower(p, cache, an_tokens, an_pos,
+                                     an_tables)
+
+    def predicted_bytes():
+        b_f32 = perf_analytic.predicted_decode_step_bytes(
+            params, s_an, t_span, heads, "float32")
+        b_i8kv = perf_analytic.predicted_decode_step_bytes(
+            params, s_an, t_span, heads, "int8")
+        b_full = perf_analytic.predicted_decode_step_bytes(
+            qparams, s_an, t_span, heads, "int8")
+        return {"predicted_step_bytes_f32": b_f32,
+                "predicted_step_bytes_i8kv": b_i8kv,
+                "predicted_step_bytes_i8kv_w": b_full,
+                "predicted_bytes_reduction_i8kv":
+                    round(1 - b_i8kv / b_f32, 4),
+                "predicted_bytes_reduction_i8kv_w":
+                    round(1 - b_full / b_f32, 4)}
+
+    def postcheck(compiled):
+        """The quantization structural gates + the bytes verdict (see
+        the factory docstring) — every detector also proven to fire on
+        its unquantized/unfused twin."""
+        txt = compiled.as_text()
+        shapes = quant_weights.quantized_weight_shapes(qparams)
+        floats = quant_weights.float_leaf_shapes(qparams)
+        perf_analytic.assert_weights_quantized(txt, shapes, floats)
+        f32_hlo = staged(params, "float32", "off").compile().as_text()
+        try:
+            perf_analytic.assert_weights_quantized(f32_hlo, shapes,
+                                                   floats)
+        except AssertionError:
+            pass
+        else:
+            raise AssertionError(
+                "weights-quantized gate failed to flag the fp32 step — "
+                "the detector is broken")
+        perf_analytic.assert_kv_quantized(txt, s_an, t_span, dkv)
+        ref_hlo = staged(qparams, "int8", "off").compile().as_text()
+        if not perf_analytic.widened_kv_instrs(ref_hlo, s_an, t_span,
+                                               dkv):
+            raise AssertionError(
+                "widened-KV gate failed to flag the kernels-off int8 "
+                "reference step — the detector is broken")
+        out = predicted_bytes()
+        if out["predicted_bytes_reduction_i8kv_w"] < 0.35:
+            raise AssertionError(
+                f"int8-KV+weights predicted step bytes shrink only "
+                f"{out['predicted_bytes_reduction_i8kv_w']:.1%} "
+                "(< the 35% acceptance bar)")
+        out.update(weights_quantized_proof="pass",
+                   kv_quantized_proof="pass",
+                   widened_kv_instrs_reference=len(
+                       perf_analytic.widened_kv_instrs(
+                           ref_hlo, s_an, t_span, dkv)))
+        return out
+
+    extras = {"lower": lambda: staged(qparams, "int8", "always"),
+              "postcheck": postcheck}
+    if warm:
+        drive(i8, 8, mixed[:8])             # warm the whole path
+        drive(f32, 8, mixed[:8])
+        drive(i8w, 8, mixed[:8])
+        fp = drive(f32, 48, mixed)
+        qv = drive(i8, 48, mixed)
+        qw_ = drive(i8w, 48, mixed)
+        ref_outs = fp.pop("outs")
+        bar = quant_kv.GREEDY_PREFIX_MIN_FULL
+
+        def quality(outs, p):
+            """Served-stream quality vs the fp32 engine: exact-match
+            and prefix>=bar counts (informational — a random-init trunk
+            babbles with near-tied logits, so single-token argmax flips
+            are expected), plus the COMMITTED budget check: teacher-
+            force every served stream through both parameterizations
+            and bound the max |logit error| (LOGIT_ERR_BUDGET) — tie-
+            insensitive, so it must hold for EVERY driven stream."""
+            within = exact = 0
+            ctxs = np.zeros((len(outs), max_len), np.int32)
+            lens = np.zeros((len(outs),), np.int32)
+            for i, ((prompt, _mt), got, want) in enumerate(
+                    zip(mixed, outs, ref_outs)):
+                n = quant_kv.greedy_prefix_len(got, want)
+                within += int(n >= min(bar, len(want)))
+                exact += int(got == want)
+                ctx = np.concatenate([prompt,
+                                      np.asarray(got, np.int32)])
+                ctxs[i, :ctx.size] = ctx
+                lens[i] = ctx.size
+            h32, _ = transformer.lm_prefill(params, ctxs, max_len,
+                                            heads)
+            l32 = transformer._lm_project(params, h32)
+            h8, _ = transformer.lm_prefill(p, ctxs, max_len, heads,
+                                           kv_dtype="int8")
+            l8 = transformer._lm_project(p, h8)
+            err = np.abs(np.asarray(l32) - np.asarray(l8)).max(axis=-1)
+            valid = np.arange(max_len)[None, :] < lens[:, None]
+            per_stream = np.where(valid, err, 0.0).max(axis=1)
+            in_budget = int((per_stream
+                             <= quant_kv.LOGIT_ERR_BUDGET).sum())
+            return within, exact, in_budget, float(per_stream.max())
+
+        i8_within, i8_exact, i8_budget, i8_err = quality(
+            qv.pop("outs"), params)
+        w_within, w_exact, w_budget, w_err = quality(
+            qw_.pop("outs"), qparams)
+        # full-quant determinism probe: the engine must reproduce the
+        # quantized lm_generate oracle token for token
+        oracle_exact = 0
+        probes = mixed[:4]
+        bat = GenerationBatcher(i8w, queue_size=64)
+        for prompt, mt in probes:
+            got = bat.submit(prompt, max_tokens=mt).result(300)["tokens"]
+            ids = np.asarray(transformer.lm_generate(
+                qparams, prompt[None], prompt.size + mt, heads,
+                kv_dtype="int8"))[0, prompt.size:]
+            oracle_exact += int(got == [int(t) for t in ids])
+        bat.close()
+        extras.update(
+            f32=fp, i8kv=qv, i8kv_w=qw_,
+            kv_budget_blocks=budget_blocks,
+            kv_blocks_doubled=qv["kv_blocks_total"]
+            == 2 * fp["kv_blocks_total"],
+            i8kv_streams_in_logit_budget=i8_budget,
+            i8kv_max_logit_err=round(i8_err, 4),
+            i8kv_prefix_ge_bar=i8_within,
+            i8kv_exact=i8_exact,
+            i8kv_w_streams_in_logit_budget=w_budget,
+            i8kv_w_max_logit_err=round(w_err, 4),
+            i8kv_w_prefix_ge_bar=w_within,
+            i8kv_w_exact=w_exact,
+            logit_err_budget=quant_kv.LOGIT_ERR_BUDGET,
+            quality_prefix_bar=bar,
+            full_quant_oracle_exact=f"{oracle_exact}/{len(probes)}",
+            n_streams=len(ref_outs),
+            **predicted_bytes())
+
+    def run(_s):
+        r = drive(i8w, 48, mixed)
+        return np.float32(r["tokens_per_s"])
+
+    total_tokens = sum(mt for _, mt in mixed)
+    per_tok = layers * (6 * d_model ** 2 + 2 * d_model * dff) \
+        + d_model * vocab
+    attn = layers * 4.0 * d_model * max_len * max_len / 2
+    flops = (2.0 * per_tok + attn / max_len) * total_tokens
+    return run, flops, None, (
+        f"quantized serving ms/burst ({n_requests} reqs, 48 clients, "
+        f"fp32 {slots} slots vs int8 {2 * slots} slots at "
+        f"{budget_blocks} f32-budget blocks, block {block_size})"), \
+        extras
+
+
 def bench_serving_fleet(replicas=2, n_requests=16, vocab=256, max_len=64,
                         prefill_buckets=(8, 16), gen_short=8, gen_long=24,
                         seed=0):
@@ -2237,6 +2523,12 @@ _BENCHES = {
     # the no-score-matrix analytic proof; b = slots
     "serving_chunked_prefill": (lambda b: bench_serving_chunked_prefill(
         slots=b), 8),
+    # quantized serving (paddle_tpu/quant/): fp32 vs int8-KV vs
+    # int8-KV+weights at a fixed KV-byte budget — 2x slots at equal
+    # bytes, committed quality budget, and the >= 35% predicted
+    # step-bytes reduction gate; b = the fp32 slot count (int8 engines
+    # get 2*b slots over the same bytes)
+    "serving_quant": (lambda b: bench_serving_quant(slots=b), 8),
     "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),
     # input-pipeline overlap row: steps/s at train(prefetch=0) vs 2 on a
     # synthetic input-bound workload (the ShardedPrefetcher's win)

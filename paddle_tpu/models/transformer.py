@@ -15,6 +15,10 @@ from paddle_tpu.ops import linear, losses, embedding as emb_ops
 from paddle_tpu.ops import attention as attn_ops
 from paddle_tpu.ops import beam as beam_ops
 from paddle_tpu.ops.norm import layer_norm
+from paddle_tpu.quant import kv as kvq
+from paddle_tpu.quant.weights import (is_quantized_leaf as _w_quantized,
+                                      maybe_dequant as _maybe_dequant,
+                                      weight_shape as _w_shape)
 
 
 def _dense(rng, din, dout, scale=None):
@@ -227,6 +231,10 @@ def encode(params, src: SequenceBatch, num_heads=8, remat=False,
     seq>1 mesh only) processes the stream in zigzag storage order so the
     causal self-attention rides the balanced ring; the returned hidden
     states are in zigzag order (lm_loss aligns its labels the same way)."""
+    # quantized trunks (quant/weights.py) dequantize at the matmul
+    # boundary: XLA fuses convert(int8)*scale into each consuming
+    # matmul's operand read — a float tree passes through untouched
+    params = _maybe_dequant(params)
     t = src.data.shape[1]
     if (pos_type == "learned") != ("pos" in params):
         raise ValueError(
@@ -436,7 +444,10 @@ def lm_loss(params, tokens: SequenceBatch, num_heads=8, segment_ids=None,
 def _lm_project(params, h):
     """Final LN + tied-embedding projection (the GPT/pre-LN convention,
     same ln_f as decode): without the LN the un-normalized residual
-    stream's depth-growing magnitude would set the softmax temperature."""
+    stream's depth-growing magnitude would set the softmax temperature.
+    Accepts a quantized tree too (idempotent dequant — external callers
+    like the prefill ladder hand it raw engine params)."""
+    params = _maybe_dequant(params)
     return linear.matmul(_ln(params["ln_f"], h), params["src_emb"].T)
 
 
@@ -605,11 +616,48 @@ def _rope_flat(x_btd, positions, head_dim):
     return xh.transpose(0, 2, 1, 3).reshape(b, t, d)
 
 
+def _kv_writes(c, k_new, v_new):
+    """The ONE quantize-on-write decision every cached-attn variant
+    shares: an int8 cache (``"ks" in c`` — quant/kv sidecars) quantizes
+    the new K/V per (position, head) and returns the int8 values plus
+    their scales; a float cache passes through (scales None).  K and V
+    each use their OWN sidecar's head count, matching
+    ``_kv_layer_buffers``' per-projection sizing."""
+    if "ks" in c:
+        k_set, sk = kvq.quantize_heads(k_new, c["ks"].shape[-1])
+        v_set, sv = kvq.quantize_heads(v_new, c["vs"].shape[-1])
+        return k_set, v_set, sk, sv
+    return k_new, v_new, None, None
+
+
+def _kv_view(k, ks):
+    """The matching read: dequantize an int8 buffer by its sidecar
+    (``ks`` is None on the float path — identity).  Every position's
+    K/V — including the step's own write — goes through the same
+    quantize->dequantize round trip, so prefill/step composition and
+    replay stay exact under quantization."""
+    return kvq.dequantize_heads(k, ks) if ks is not None else k
+
+
+def _kv_commit(c, upd, k_set, v_set, sk, sv):
+    """Apply the K/V (+ sidecar) cache writes through the variant's
+    ``upd(buffer, value)`` indexer — the ONE cache-update assembly all
+    cached-attn variants and the prefill share.  Returns ``(nc, ks,
+    vs)`` with ks/vs None on the float path (``_kv_writes``'s twin)."""
+    nc = {"k": upd(c["k"], k_set), "v": upd(c["v"], v_set)}
+    if sk is None:
+        return nc, None, None
+    ks, vs = upd(c["ks"], sk), upd(c["vs"], sv)
+    nc.update(ks=ks, vs=vs)
+    return nc, ks, vs
+
+
 def _cached_self_attn(blk, x, c, t, pos_mask, num_heads, rope_pos=None):
     """Shared incremental self-attention block: write this position's K/V
     into the cache, attend over positions <= t, residual-add — ONE
     definition for decode_step_cached and lm_decode_step so the two
-    cached steps cannot drift."""
+    cached steps cannot drift.  An int8 cache quantizes the write and
+    attends over the dequantized view (``_kv_writes``/``_kv_view``)."""
     h = _ln(blk["ln1"], x)
     k_new = linear.matmul(h, blk["attn"]["wk"])
     q = linear.matmul(h, blk["attn"]["wq"])
@@ -617,15 +665,18 @@ def _cached_self_attn(blk, x, c, t, pos_mask, num_heads, rope_pos=None):
         dh = q.shape[-1] // num_heads
         k_new = _rope_flat(k_new, rope_pos, dh)
         q = _rope_flat(q, rope_pos, dh)
-    k = jax.lax.dynamic_update_slice_in_dim(c["k"], k_new, t, axis=1)
-    v = jax.lax.dynamic_update_slice_in_dim(
-        c["v"], linear.matmul(h, blk["attn"]["wv"]), t, axis=1)
-    att = _attend(q, k, v, num_heads, pos_mask)
-    return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
+    v_new = linear.matmul(h, blk["attn"]["wv"])
+    k_set, v_set, sk, sv = _kv_writes(c, k_new, v_new)
+    upd = lambda buf, val: jax.lax.dynamic_update_slice_in_dim(
+        buf, val, t, axis=1)
+    nc, ks, vs = _kv_commit(c, upd, k_set, v_set, sk, sv)
+    att = _attend(q, _kv_view(nc["k"], ks), _kv_view(nc["v"], vs),
+                  num_heads, pos_mask)
+    return x + linear.matmul(att, blk["attn"]["wo"]), nc
 
 
 def lm_prefill(params, prompt, max_len, num_heads=8, moe_top_k=2,
-               pos_type="learned"):
+               pos_type="learned", kv_dtype=None):
     """Batched causal prefill: run the trunk over the WHOLE prompt in one
     pass (the MXU-friendly leg), writing every position's K/V into fresh
     decode caches.  Returns (per-position hidden states [B, Tp, D],
@@ -635,8 +686,17 @@ def lm_prefill(params, prompt, max_len, num_heads=8, moe_top_k=2,
     most expensive matmul by Tp).  Equivalent to Tp sequential
     lm_decode_step calls (the generation oracle test covers the
     composition), ~Tp x fewer serial steps.  With ragged prompts
-    causality keeps padding positions out of real ones."""
+    causality keeps padding positions out of real ones.
+
+    kv_dtype="int8" (quant/kv.py) quantizes each position's K/V on the
+    way into the cache AND attends over the quantize->dequantize round
+    trip — exactly what sequential quantized decode steps compute, so
+    the prefill/step composition stays exact under quantization (slot
+    recovery, CoW re-seating and continuation replay depend on it)."""
     b, tp = prompt.shape
+    cache = init_lm_cache(params, b, max_len, kv_dtype=kv_dtype,
+                          num_heads=num_heads)
+    params = _maybe_dequant(params)
     if (pos_type == "learned") != ("pos" in params):
         raise ValueError(
             f"pos_type={pos_type!r} but params were initialized "
@@ -646,7 +706,6 @@ def lm_prefill(params, prompt, max_len, num_heads=8, moe_top_k=2,
     x = x * math.sqrt(x.shape[-1])
     if pos_type == "learned":
         x = x + params["pos"][:tp][None]
-    cache = init_lm_cache(params, b, max_len)
     new_cache = []
     for blk, c in zip(params["enc"], cache):
         h = _ln(blk["ln1"], x)
@@ -660,6 +719,12 @@ def lm_prefill(params, prompt, max_len, num_heads=8, moe_top_k=2,
             k = _rope_flat(k, jnp.arange(tp), dh)
             q = _rope_flat(q, jnp.arange(tp), dh)
         hkv = k.shape[-1] // dh
+        k_set, v_set, sk, sv = _kv_writes(c, k, v)
+        if sk is not None:
+            # quantize-on-write + attend over the round trip: position
+            # p's K/V is quantized BEFORE any later position attends it,
+            # so the batched pass equals sequential quantized steps
+            k, v = _kv_view(k_set, sk), _kv_view(v_set, sv)
         split = lambda a, hh: a.reshape(b, tp, hh, dh).transpose(
             0, 2, 1, 3)
         # batched causal pass: the pallas_prefill flag (trace-time, like
@@ -682,10 +747,10 @@ def lm_prefill(params, prompt, max_len, num_heads=8, moe_top_k=2,
         att = att.transpose(0, 2, 1, 3).reshape(b, tp, d)
         x = x + linear.matmul(att, blk["attn"]["wo"])
         x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
+        upd = lambda buf, val: jax.lax.dynamic_update_slice_in_dim(
+            buf, val, 0, axis=1)
         new_cache.append(
-            {"k": jax.lax.dynamic_update_slice_in_dim(c["k"], k, 0, axis=1),
-             "v": jax.lax.dynamic_update_slice_in_dim(c["v"], v, 0,
-                                                      axis=1)})
+            _kv_commit(c, upd, k_set, v_set, sk, sv)[0])
     return x, new_cache
 
 
@@ -697,6 +762,7 @@ def lm_decode_step(params, prev_ids, t, cache, num_heads=8,
     [B, max_len, Dkv] where Dkv is each block's KV projection width —
     d_model normally, num_kv_heads*head_dim on a GQA trunk
     (init_lm_cache sizes off the weights)."""
+    params = _maybe_dequant(params)
     b = prev_ids.shape[0]
     max_len = cache[0]["k"].shape[1]
     x = emb_ops.embedding_lookup(params["src_emb"], prev_ids)[:, None]
@@ -734,19 +800,26 @@ def _cached_self_attn_slots(blk, x, c, positions, pos_mask, num_heads,
         q = _rope_flat(q, rope_pos, dh)
     v_new = linear.matmul(h, blk["attn"]["wv"])
     rows = jnp.arange(positions.shape[0])
-    k = c["k"].at[rows, positions].set(k_new[:, 0])
-    v = c["v"].at[rows, positions].set(v_new[:, 0])
+    # quantize-on-write for an int8 cache (scales None on the f32 path)
+    k_set, v_set, sk, sv = _kv_writes(c, k_new[:, 0], v_new[:, 0])
+    upd = lambda buf, val: buf.at[rows, positions].set(val)
+    nc, ks, vs = _kv_commit(c, upd, k_set, v_set, sk, sv)
+    k, v = nc["k"], nc["v"]
     # fused Pallas decode kernel (ops/pallas/decode_attention.py): the
     # row's stripe streams HBM->VMEM once, no score matrix, grouped KV
-    # expanded in registers.  None -> the reference XLA path (the CPU
-    # tier-1 default; pallas_decode flag gates — see maybe_slab).
+    # expanded in registers (int8: + scale sidecars dequantized there
+    # too).  None -> the reference XLA path (the CPU tier-1 default;
+    # pallas_decode flag gates — see maybe_slab), which widens the
+    # stripe via _kv_view — same math as the kernel's register dequant.
     from paddle_tpu.ops.pallas import decode_attention as _decode_kernels
-    att = _decode_kernels.maybe_slab(q[:, 0], k, v, positions, num_heads)
+    att = _decode_kernels.maybe_slab(q[:, 0], k, v, positions, num_heads,
+                                     kscale=ks, vscale=vs)
     if att is None:
-        att = _attend(q, k, v, num_heads, pos_mask)
+        att = _attend(q, _kv_view(k, ks), _kv_view(v, vs), num_heads,
+                      pos_mask)
     else:
         att = att[:, None]
-    return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
+    return x + linear.matmul(att, blk["attn"]["wo"]), nc
 
 
 def lm_decode_step_slots(params, prev_ids, positions, cache, num_heads=8,
@@ -764,6 +837,7 @@ def lm_decode_step_slots(params, prev_ids, positions, cache, num_heads=8,
     whose exp is exactly 0.0, so cache width beyond a row's position never
     perturbs its numerics).  tests/test_decode_engine.py pins the
     per-request bit-identity against ``lm_generate``."""
+    params = _maybe_dequant(params)
     s = prev_ids.shape[0]
     max_len = cache[0]["k"].shape[1]
     x = emb_ops.embedding_lookup(params["src_emb"], prev_ids)[:, None]
@@ -811,26 +885,34 @@ def _cached_self_attn_paged(blk, x, c, positions, tables, pos_mask,
     rows = jnp.arange(s)
     bids = tables[rows, positions // block_size]
     offs = positions % block_size
-    k = c["k"].at[bids, offs].set(k_new[:, 0])
-    v = c["v"].at[bids, offs].set(v_new[:, 0])
+    # quantize-on-write for an int8 pool (scales None on the f32 path)
+    k_set, v_set, sk, sv = _kv_writes(c, k_new[:, 0], v_new[:, 0])
+    upd = lambda buf, val: buf.at[bids, offs].set(val)
+    nc, ks, vs = _kv_commit(c, upd, k_set, v_set, sk, sv)
+    k, v = nc["k"], nc["v"]
     # fused Pallas paged kernel (ops/pallas/decode_attention.py): the
     # block table rides as scalar-prefetch data and the kernel walks
     # each row's chain in place — no [S, T, Dkv] gathered copy, no
     # score matrix (perf/analytic.py's fusion-proof gate pins the
-    # gather's absence).  None -> the reference chain-gather path.
+    # gather's absence; int8 sidecar blocks ride the same walk).
+    # None -> the reference chain-gather path.
     from paddle_tpu.ops.pallas import decode_attention as _decode_kernels
     att = _decode_kernels.maybe_paged(q[:, 0], k, v, positions, tables,
-                                      num_heads)
+                                      num_heads, kscale=ks, vscale=vs)
     if att is not None:
         att = att[:, None]
     else:
         # chain gather: [S, blocks_per_row, bs, Dkv] -> [S, T, Dkv]
         # where T = blocks_per_row * bs covers every position a row can
-        # hold
-        k_rows = k[tables].reshape(s, -1, k.shape[-1])
-        v_rows = v[tables].reshape(s, -1, v.shape[-1])
+        # hold (int8: the gathered chain widens via its gathered scales)
+        k_rows = _kv_view(k[tables],
+                          None if ks is None else ks[tables]) \
+            .reshape(s, -1, k.shape[-1])
+        v_rows = _kv_view(v[tables],
+                          None if vs is None else vs[tables]) \
+            .reshape(s, -1, v.shape[-1])
         att = _attend(q, k_rows, v_rows, num_heads, pos_mask)
-    return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
+    return x + linear.matmul(att, blk["attn"]["wo"]), nc
 
 
 def lm_decode_step_paged(params, prev_ids, positions, cache, tables,
@@ -848,6 +930,7 @@ def lm_decode_step_paged(params, prev_ids, positions, cache, tables,
     0.0).  The block table is DATA, not shape: admission, eviction and
     copy-on-write forks churn ``tables`` between steps without ever
     retracing (tests/test_kv_pool.py pins 1 warm-up trace, 0 after)."""
+    params = _maybe_dequant(params)
     s = prev_ids.shape[0]
     block_size = cache[0]["k"].shape[1]
     t_span = tables.shape[1] * block_size
@@ -917,16 +1000,23 @@ def _cached_self_attn_chunk(blk, x, c, li, qpos, pos_mask, num_heads,
     k_sel = jnp.take_along_axis(k_new, li[:, :, None], axis=1)
     v_sel = jnp.take_along_axis(v_new, li[:, :, None], axis=1)
     rows = jnp.arange(s)[:, None]
-    k = c["k"].at[rows, qpos].set(k_sel)
-    v = c["v"].at[rows, qpos].set(v_sel)
+    # quantize-on-write (int8 cache): duplicate clamped lanes quantize
+    # identical values to identical targets, so the scatter stays
+    # deterministic; scales None on the f32 path
+    k_set, v_set, sk, sv = _kv_writes(c, k_sel, v_sel)
+    upd = lambda buf, val: buf.at[rows, qpos].set(val)
+    nc, ks, vs = _kv_commit(c, upd, k_set, v_set, sk, sv)
+    k, v = nc["k"], nc["v"]
     # fused Tq=chunk Pallas kernel (ops/pallas/decode_attention.py):
     # each row's stripe streams HBM->VMEM once and every lane consumes
     # it in VMEM — no [S, K, T] score matrix.  None -> reference path.
     from paddle_tpu.ops.pallas import decode_attention as _decode_kernels
-    att = _decode_kernels.maybe_slab_chunk(q, k, v, qpos, num_heads)
+    att = _decode_kernels.maybe_slab_chunk(q, k, v, qpos, num_heads,
+                                           kscale=ks, vscale=vs)
     if att is None:
-        att = _attend(q, k, v, num_heads, pos_mask)
-    return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
+        att = _attend(q, _kv_view(k, ks), _kv_view(v, vs), num_heads,
+                      pos_mask)
+    return x + linear.matmul(att, blk["attn"]["wo"]), nc
 
 
 def lm_decode_chunk_slots(params, tokens, positions, lengths, cache,
@@ -942,6 +1032,7 @@ def lm_decode_chunk_slots(params, tokens, positions, lengths, cache,
     ``lm_decode_step_slots``'s result; a row chunking through its prompt
     computes exactly what sequential steps would — tokens and lengths
     are DATA, so mixing decode and prefill rows never retraces."""
+    params = _maybe_dequant(params)
     s, kk = tokens.shape
     max_len = cache[0]["k"].shape[1]
     li, qpos = _chunk_lanes(positions, lengths, kk)
@@ -983,16 +1074,23 @@ def _cached_self_attn_chunk_paged(blk, x, c, li, qpos, tables, pos_mask,
     rows = jnp.arange(s)[:, None]
     bids = tables[rows, qpos // block_size]
     offs = qpos % block_size
-    k = c["k"].at[bids, offs].set(k_sel)
-    v = c["v"].at[bids, offs].set(v_sel)
+    k_set, v_set, sk, sv = _kv_writes(c, k_sel, v_sel)
+    upd = lambda buf, val: buf.at[bids, offs].set(val)
+    nc, ks, vs = _kv_commit(c, upd, k_set, v_set, sk, sv)
+    k, v = nc["k"], nc["v"]
     from paddle_tpu.ops.pallas import decode_attention as _decode_kernels
     att = _decode_kernels.maybe_paged_chunk(q, k, v, qpos, tables,
-                                            num_heads)
+                                            num_heads, kscale=ks,
+                                            vscale=vs)
     if att is None:
-        k_rows = k[tables].reshape(s, -1, k.shape[-1])
-        v_rows = v[tables].reshape(s, -1, v.shape[-1])
+        k_rows = _kv_view(k[tables],
+                          None if ks is None else ks[tables]) \
+            .reshape(s, -1, k.shape[-1])
+        v_rows = _kv_view(v[tables],
+                          None if vs is None else vs[tables]) \
+            .reshape(s, -1, v.shape[-1])
         att = _attend(q, k_rows, v_rows, num_heads, pos_mask)
-    return x + linear.matmul(att, blk["attn"]["wo"]), {"k": k, "v": v}
+    return x + linear.matmul(att, blk["attn"]["wo"]), nc
 
 
 def lm_decode_chunk_paged(params, tokens, positions, lengths, cache,
@@ -1001,6 +1099,7 @@ def lm_decode_chunk_paged(params, tokens, positions, lengths, cache,
     """The Tq=chunk generalization of ``lm_decode_step_paged`` — the
     paged twin of ``lm_decode_chunk_slots`` (same lane semantics, block
     tables as DATA)."""
+    params = _maybe_dequant(params)
     s, kk = tokens.shape
     block_size = cache[0]["k"].shape[1]
     t_span = tables.shape[1] * block_size
@@ -1022,7 +1121,52 @@ def lm_decode_chunk_paged(params, tokens, positions, lengths, cache,
     return _lm_project(params, h_last)[:, 0], new_cache
 
 
-def init_lm_cache_paged(params, num_blocks, block_size, max_len=None):
+def _kv_layer_buffers(params, lead_shape, kv_dtype, num_heads):
+    """One layer list of K/V buffers shaped ``lead_shape + (Dkv,)`` —
+    the shared core of ``init_lm_cache``/``init_lm_cache_paged``.
+    ``kv_dtype="int8"`` adds the per-(position, head) f32 scale
+    sidecars ``{"ks", "vs"}`` of ``lead_shape + (Hkv,)`` (quant/kv.py);
+    None/"float32" keeps the float layout byte-identical to before.
+    The sidecar width derives from ``num_heads``, so int8 REQUIRES the
+    trunk's real head count — a defaulted/wrong one would silently
+    quantize at the wrong granularity."""
+    if kv_dtype not in (None, "float32", "int8"):
+        raise ValueError(f"kv_dtype={kv_dtype!r} (supported: "
+                         "'float32', 'int8')")
+    emb = params["src_emb"]
+    dt = jnp.float32 if _w_quantized(emb) else emb.dtype
+    d = _w_shape(emb)[1]
+    if kv_dtype == "int8":
+        if num_heads is None:
+            raise ValueError(
+                "kv_dtype='int8' needs the trunk's num_heads: the "
+                "per-(position, head) scale sidecar is sized Hkv = "
+                "Dkv / (d_model / num_heads)")
+        if d % num_heads:
+            raise ValueError(f"num_heads={num_heads} does not divide "
+                             f"d_model={d}")
+    layers = []
+    for blk in params["enc"]:
+        dkv = _w_shape(blk["attn"]["wk"])[1]
+        dkv_v = _w_shape(blk["attn"]["wv"])[1]
+        c = {"k": jnp.zeros(lead_shape + (dkv,),
+                            jnp.int8 if kv_dtype == "int8" else dt),
+             "v": jnp.zeros(lead_shape + (dkv_v,),
+                            jnp.int8 if kv_dtype == "int8" else dt)}
+        if kv_dtype == "int8":
+            dh = d // num_heads
+            if dkv % dh or dkv_v % dh:
+                raise ValueError(
+                    f"head_dim {dh} (d_model {d} / num_heads "
+                    f"{num_heads}) does not divide Dkv {dkv}/{dkv_v}")
+            c["ks"] = jnp.zeros(lead_shape + (dkv // dh,), jnp.float32)
+            c["vs"] = jnp.zeros(lead_shape + (dkv_v // dh,), jnp.float32)
+        layers.append(c)
+    return layers
+
+
+def init_lm_cache_paged(params, num_blocks, block_size, max_len=None,
+                        kv_dtype=None, num_heads=None):
     """K/V block pools for ``lm_decode_step_paged``: per enc layer
     ``{"k","v"}`` of ``[num_blocks, block_size, Dkv]`` — the paged twin
     of ``init_lm_cache`` (same per-block KV width inference, so GQA
@@ -1031,49 +1175,46 @@ def init_lm_cache_paged(params, num_blocks, block_size, max_len=None):
     (serving/kv_pool.py BlockPool) hands out ids 1..num_blocks-1.
     ``max_len``: the logical per-row span, validated against the learned
     positional table exactly like ``init_lm_cache`` (a rope trunk has no
-    cap)."""
+    cap).  ``kv_dtype="int8"``: int8 pools + per-(position, head) scale
+    sidecar pools ``[num_blocks, block_size, Hkv]`` — ~4x smaller
+    blocks, so a fixed byte budget holds ~2x the block count
+    (serving/kv_pool.slab_equivalent_blocks)."""
     if num_blocks < 2 or block_size < 1:
         raise ValueError(
             f"paged cache needs num_blocks >= 2 (one is the reserved "
             f"scratch block) and block_size >= 1; got {num_blocks}, "
             f"{block_size}")
     if max_len is not None and "pos" in params \
-            and max_len > params["pos"].shape[0]:
+            and max_len > _w_shape(params["pos"])[0]:
         raise ValueError(
             f"lm decode max_len {max_len} exceeds the positional table "
-            f"({params['pos'].shape[0]}); re-init with a larger max_len "
+            f"({_w_shape(params['pos'])[0]}); re-init with a larger max_len "
             "or use pos_type='rope'")
-    dt = params["src_emb"].dtype
-    return [{"k": jnp.zeros((num_blocks, block_size,
-                             blk["attn"]["wk"].shape[1]), dt),
-             "v": jnp.zeros((num_blocks, block_size,
-                             blk["attn"]["wv"].shape[1]), dt)}
-            for blk in params["enc"]]
+    return _kv_layer_buffers(params, (num_blocks, block_size), kv_dtype,
+                             num_heads)
 
 
-def init_lm_cache(params, batch, max_len):
+def init_lm_cache(params, batch, max_len, kv_dtype=None,
+                  num_heads=None):
     """K/V buffers for lm_decode_step (mirrors init_decode_cache, but for
-    the enc stack the LM trunk runs)."""
-    if "pos" in params and max_len > params["pos"].shape[0]:
+    the enc stack the LM trunk runs).  ``kv_dtype="int8"``: int8 slab +
+    per-(position, head) f32 scale sidecars (quant/kv.py)."""
+    if "pos" in params and max_len > _w_shape(params["pos"])[0]:
         # learned table caps the length; a rope trunk has no cap
         raise ValueError(
             f"lm decode max_len {max_len} exceeds the positional table "
-            f"({params['pos'].shape[0]}); re-init with a larger max_len "
+            f"({_w_shape(params['pos'])[0]}); re-init with a larger max_len "
             "or use pos_type='rope'")
-    dt = params["src_emb"].dtype
     # per-block KV width from the projection itself: grouped-KV trunks
     # (init num_kv_heads=) get the proportionally smaller cache — the
     # point of GQA at serving time
-    return [{"k": jnp.zeros((batch, max_len,
-                             blk["attn"]["wk"].shape[1]), dt),
-             "v": jnp.zeros((batch, max_len,
-                             blk["attn"]["wv"].shape[1]), dt)}
-            for blk in params["enc"]]
+    return _kv_layer_buffers(params, (batch, max_len), kv_dtype,
+                             num_heads)
 
 
 def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
                 top_k=0, rng=None, eos_id=None, prompt_lengths=None,
-                moe_top_k=2, pos_type="learned"):
+                moe_top_k=2, pos_type="learned", kv_dtype=None):
     """Autoregressive sampling from the decoder-only LM (KV-cached, one
     jittable lax.scan): prompt [B, Tp] int ids -> ids [B, max_len]
     beginning with each row's prompt.  prompt_lengths [B] supports
@@ -1092,7 +1233,12 @@ def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
     MXU-friendly leg that fills the KV cache for all Tp positions at
     once); the per-token scan starts at the SHORTEST row's length and
     re-feeds longer rows' remaining prompt tokens (their K/V rewrites
-    are identical — projections are position-local)."""
+    are identical — projections are position-local).
+
+    kv_dtype="int8": the scan runs on the quantized KV cache
+    (quant/kv.py) — the single-batch oracle for the quantized serving
+    engines, exactly as the f32 path is for theirs."""
+    params = _maybe_dequant(params)
     prompt = jnp.asarray(prompt, jnp.int32)
     b, tp = prompt.shape
     if not (0 < tp <= max_len):
@@ -1141,7 +1287,7 @@ def lm_generate(params, prompt, max_len, num_heads=8, temperature=0.0,
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     hidden, cache = lm_prefill(params, prompt, max_len, num_heads,
-                               moe_top_k, pos_type)
+                               moe_top_k, pos_type, kv_dtype=kv_dtype)
     # each row's first generated token comes from ITS last real
     # position — gather the hidden state first, project ONE position
     # (the d_model x vocab matmul is the expensive part)

@@ -40,6 +40,15 @@ its numerics, so greedy streams through the kernels stay token-for-token
 identical to ``lm_generate`` (tests/test_pallas_decode.py pins it across
 admission/eviction/CoW churn and supervisor recovery).
 
+INT8 K/V (quant/kv.py; docs/serving.md "Quantized serving"): every
+kernel takes optional ``kscale``/``vscale`` per-(position, head) f32
+sidecars marking a quantized cache.  The sidecar blocks ride the SAME
+clamped/table-walked DMA stream as the int8 K/V blocks, and the
+widening happens in REGISTERS inside ``_accumulate`` (one broadcast
+multiply per KV-head group panel) — int8 is what streams from HBM and
+the widened K/V never exists in any memory.  ``kernel_cost`` declares
+the honest int8 byte counts (1-byte elements + the f32 sidecar).
+
 Dispatch: callers go through ``maybe_slab`` / ``maybe_paged``, which
 return None (caller falls back to the reference XLA path) unless the
 ``pallas_decode`` flag enables the kernels — ``auto`` follows
@@ -134,42 +143,50 @@ def _lane_tileable(n):
     return n <= _LANES or n % _LANES == 0
 
 
-def _pick_block_k(t, cap, interpret):
+def _pick_block_k(t, cap, interpret, quant=False):
     """Largest k-tile <= cap dividing the slab length, compatible with
     the lane-replicated running-stat layout (<= LANES or a LANES
     multiple).  Single-block (blk == t) when the whole stripe fits the
     cap — the common serving shape, where the online softmax degenerates
     to one plain masked softmax.  Compiled mode additionally wants
-    8-sublane-divisible tiles; interpret mode takes any shape."""
+    8-sublane-divisible tiles — 32 for int8 K/V (``quant``; the s8 VMEM
+    tile is (32, 128)), applied HERE so a 32-divisible tile is found
+    whenever one exists rather than the largest-divisor pick being
+    rejected downstream; interpret mode takes any shape."""
     if t < 1:
         return None
+    sublane = 32 if quant else 8
     b = min(t, cap)
     while b >= 1:
         if t % b == 0 and _lane_tileable(b) \
-                and (interpret or b % 8 == 0):
+                and (interpret or b % sublane == 0):
             return b
         b -= 1
     return None
 
 
-def _mosaic_ok(blk, dkv, dh, interpret):
+def _mosaic_ok(blk, dkv, dh, interpret, quant=False):
     """Tiling constraints.  The lane-replicated running stats require a
     lane-tileable k-tile AND head dim in EVERY mode — ``_lanes`` can
     only slice (n <= LANES) or tile (n % LANES == 0), so e.g. a paged
     block_size of 136 must fall back to the reference path rather than
     fail mid-trace.  Compiled mode additionally wants 8-divisible
-    sublane tiles and a lane-tileable Dkv."""
+    sublane tiles and a lane-tileable Dkv; int8 K/V (``quant``) raises
+    the sublane requirement to 32 — the s8 VMEM tile is (32, 128)."""
     if not (_lane_tileable(blk) and _lane_tileable(dh)):
         return False
     if interpret:
         return True
+    if quant and blk % 32:
+        return False
     return blk % 8 == 0 and _lane_tileable(dkv)
 
 
 # ------------------------------------------------------------ kernel body
 
 def _accumulate(q, kb, vb, col0, blk, pos, m_scr, l_scr, acc_scr, *,
-                num_heads, hkv, dh, scale, sl=slice(None)):
+                num_heads, hkv, dh, scale, sl=slice(None), ks=None,
+                vs=None):
     """One K/V block of the masked online softmax for one query lane.
 
     q: [H, dh] f32; kb/vb: [blk, Dkv] f32; col0: first global column of
@@ -180,12 +197,20 @@ def _accumulate(q, kb, vb, col0, blk, pos, m_scr, l_scr, acc_scr, *,
     [K*H, ...] (the Tq=chunk kernels; Tq=1 passes the whole scratch).
     A block entirely past ``pos`` is a BIT-EXACT no-op: every score
     masks to -1e30, so p underflows to exactly 0.0 and alpha is exactly
-    1.0 — the chunk kernels rely on this for their shorter lanes."""
+    1.0 — the chunk kernels rely on this for their shorter lanes.
+
+    ks/vs: [blk, Hkv] f32 per-(position, head) scale panels for int8
+    K/V (quant/kv.py): the caller hands kb/vb already CONVERTED s8 ->
+    f32 and the per-head scale multiplies each group's panel here — the
+    in-register dequant; the widened stripe never exists in memory,
+    int8 is what streamed from HBM."""
     group = num_heads // hkv
     parts = []
     for g in range(hkv):
         qg = q[g * group:(g + 1) * group]              # [group, dh]
         kg = kb[:, g * dh:(g + 1) * dh]                # [blk, dh]
+        if ks is not None:
+            kg = kg * ks[:, g:g + 1]
         parts.append(jax.lax.dot_general(
             qg, kg, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32))       # [group, blk]
@@ -202,6 +227,8 @@ def _accumulate(q, kb, vb, col0, blk, pos, m_scr, l_scr, acc_scr, *,
     for g in range(hkv):
         pg = p[g * group:(g + 1) * group]              # [group, blk]
         vg = vb[:, g * dh:(g + 1) * dh]                # [blk, dh]
+        if vs is not None:
+            vg = vg * vs[:, g:g + 1]
         parts.append(jax.lax.dot_general(
             pg, vg, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32))       # [group, dh]
@@ -209,7 +236,8 @@ def _accumulate(q, kb, vb, col0, blk, pos, m_scr, l_scr, acc_scr, *,
     acc_scr[sl] = acc_scr[sl] * _lanes(alpha, dh) + av
 
 
-def kernel_cost(s, t_span, d, dkv, itemsize=4, tq=1):
+def kernel_cost(s, t_span, d, dkv, itemsize=4, tq=1, kv_itemsize=None,
+                scale_hkv=0):
     """The kernel's declared traffic/compute — the ``pl.CostEstimate``
     handed to Mosaic, and the number a TPU cost model reports for the
     fused custom call.  Bytes are the whole point: q in + out + each
@@ -218,8 +246,12 @@ def kernel_cost(s, t_span, d, dkv, itemsize=4, tq=1):
     the scalar operands.  No score matrix, no second KV copy.  ``tq``:
     query lanes per row (1 = plain decode; K = the chunked-prefill
     step — the KV stream is UNCHANGED, every lane consumes it in
-    VMEM)."""
-    kv_bytes = 2 * s * t_span * dkv * itemsize
+    VMEM).  ``kv_itemsize``/``scale_hkv``: the honest int8 accounting —
+    1-byte K/V elements plus the f32 per-(position, head) scale sidecar
+    (2 * s * t_span * scale_hkv * 4 bytes); 0 = no sidecar."""
+    kv_itemsize = itemsize if kv_itemsize is None else kv_itemsize
+    kv_bytes = 2 * s * t_span * dkv * kv_itemsize \
+        + 2 * s * t_span * scale_hkv * 4
     io_bytes = 2 * s * tq * d * itemsize + s * tq * 4  # + int32 positions
     #           (the paged block table adds s * nb_row * 4 more — noise)
     heads_flops = 2 * 2 * s * tq * t_span * d   # qk^T + p@v
@@ -239,8 +271,16 @@ def _finalize(o_ref, l_scr, acc_scr, dh):
     o_ref[0] = (acc_scr[:] / _lanes(l, dh)).astype(o_ref.dtype)
 
 
-def _slab_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-                 acc_scr, *, blk, num_heads, hkv, dh, scale):
+def _slab_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, blk, num_heads,
+                 hkv, dh, scale):
+    # int8 K/V adds two scale-sidecar operands between v and the output
+    # (quantized dispatch appends their BlockSpecs); the f32 layout is
+    # unchanged
+    if len(rest) == 6:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = rest
     r = pl.program_id(0)
     j = pl.program_id(1)
     pos = pos_ref[r]
@@ -255,7 +295,9 @@ def _slab_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
                     k_ref[0].astype(jnp.float32),
                     v_ref[0].astype(jnp.float32),
                     j * blk, blk, pos, m_scr, l_scr, acc_scr,
-                    num_heads=num_heads, hkv=hkv, dh=dh, scale=scale)
+                    num_heads=num_heads, hkv=hkv, dh=dh, scale=scale,
+                    ks=None if ks_ref is None else ks_ref[0],
+                    vs=None if vs_ref is None else vs_ref[0])
 
     @pl.when(j == pl.num_programs(1) - 1)
     def _():
@@ -270,15 +312,22 @@ def _paged_kernel(pos_ref, tbl_ref, *args, **kw):
     _slab_kernel(pos_ref, *args, **kw)
 
 
-def _chunk_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
-                  acc_scr, *, blk, kk, num_heads, hkv, dh, scale):
+def _chunk_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, blk, kk,
+                  num_heads, hkv, dh, scale):
     """Tq=chunk body: ``kk`` query lanes per row share each streamed K/V
     block.  pos_ref [S, K] carries every lane's own position (the
     engine's clamped ``qpos`` — non-decreasing per row, inactive lanes
     repeat the last active lane's), so lane i's mask is causal within
     the chunk AND clamped at the row's live prefix.  Lane stats live in
     [K*H, .]-shaped scratch, sliced per lane; the K/V stripe is read
-    from HBM exactly once per row — the chunk consumes it in VMEM."""
+    from HBM exactly once per row — the chunk consumes it in VMEM (and
+    for int8 K/V every lane shares the same in-register dequant panels:
+    the scale sidecars ride the same block stream)."""
+    if len(rest) == 6:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = rest
     r = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -292,12 +341,14 @@ def _chunk_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr,
     def _():
         kb = k_ref[0].astype(jnp.float32)
         vb = v_ref[0].astype(jnp.float32)
+        ks = None if ks_ref is None else ks_ref[0]
+        vs = None if vs_ref is None else vs_ref[0]
         for i in range(kk):
             sl = slice(i * num_heads, (i + 1) * num_heads)
             _accumulate(q_ref[0, sl].astype(jnp.float32), kb, vb,
                         j * blk, blk, pos_ref[r, i], m_scr, l_scr,
                         acc_scr, num_heads=num_heads, hkv=hkv, dh=dh,
-                        scale=scale, sl=sl)
+                        scale=scale, sl=sl, ks=ks, vs=vs)
 
     @pl.when(j == pl.num_programs(1) - 1)
     def _():
@@ -311,45 +362,69 @@ def _paged_chunk_kernel(pos_ref, tbl_ref, *args, **kw):
 
 # ------------------------------------------------------------ public API
 
+def _check_scales(name, kscale, vscale, lead_shape, hkv):
+    """Validate the int8 scale sidecars (both or neither; shapes match
+    the K/V buffers with Hkv trailing).  Returns True when quantized."""
+    if kscale is None and vscale is None:
+        return False
+    if kscale is None or vscale is None:
+        raise ValueError(f"{name}: kscale and vscale come together")
+    want = lead_shape + (hkv,)
+    if tuple(kscale.shape) != want or tuple(vscale.shape) != want:
+        raise ValueError(
+            f"{name}: scale sidecars must be {want}, got "
+            f"{kscale.shape}/{vscale.shape}")
+    return True
+
+
 def decode_attention_slab(q, k, v, positions, num_heads, *, block_k=None,
-                          interpret=None):
+                          interpret=None, kscale=None, vscale=None):
     """Fused slab decode attention: q [S, D], k/v [S, T, Dkv] (the
     already-updated cache), positions [S] int32 -> [S, D].  Row r
     attends its own stripe at cols <= positions[r]; the stripe is read
     from HBM exactly once and no score matrix is ever materialized.
-    Raises ValueError on shapes the kernel doesn't cover — callers use
-    ``maybe_slab``."""
+    kscale/vscale [S, T, Hkv] f32 mark an INT8 cache (quant/kv.py): the
+    kernel DMAs the int8 stripe + its scale sidecar and widens in
+    registers inside the accumulator — the widened K/V never exists in
+    any memory.  Raises ValueError on shapes the kernel doesn't cover —
+    callers use ``maybe_slab``."""
     interpret = _interpret(interpret)
     s, d = q.shape
     t, dkv = k.shape[1], k.shape[2]
     split = _head_split(d, dkv, num_heads)
-    blk = _pick_block_k(t, block_k or _block_k_cap(), interpret)
+    blk = _pick_block_k(t, block_k or _block_k_cap(), interpret,
+                        quant=kscale is not None)
     if split is None or blk is None:
         raise ValueError(
             f"decode_attention_slab: unsupported shape q={q.shape} "
             f"k={k.shape} heads={num_heads}")
     dh, hkv, _group = split
-    if not _mosaic_ok(blk, dkv, dh, interpret):
+    quant = _check_scales("decode_attention_slab", kscale, vscale,
+                          (s, t), hkv)
+    if not _mosaic_ok(blk, dkv, dh, interpret, quant=quant):
         raise ValueError(
             f"decode_attention_slab: untileable blk={blk} dkv={dkv} "
             f"dh={dh} for the compiled backend")
     scale = 1.0 / math.sqrt(dh)
     kernel = functools.partial(_slab_kernel, blk=blk, num_heads=num_heads,
                                hkv=hkv, dh=dh, scale=scale)
+    # clamp at the row's live prefix: blocks past positions[r] re-map
+    # to the last needed block — same index, no re-fetch
+    kv_map = lambda r, j, pos: (r, jnp.minimum(j, pos[r] // blk), 0)
+    in_specs = [
+        pl.BlockSpec((1, num_heads, dh), lambda r, j, pos: (r, 0, 0)),
+        pl.BlockSpec((1, blk, dkv), kv_map),
+        pl.BlockSpec((1, blk, dkv), kv_map),
+    ]
+    operands = [q.reshape(s, num_heads, dh), k, v]
+    if quant:
+        in_specs += [pl.BlockSpec((1, blk, hkv), kv_map),
+                     pl.BlockSpec((1, blk, hkv), kv_map)]
+        operands += [kscale, vscale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(s, t // blk),
-        in_specs=[
-            pl.BlockSpec((1, num_heads, dh), lambda r, j, pos: (r, 0, 0)),
-            # clamp at the row's live prefix: blocks past positions[r]
-            # re-map to the last needed block — same index, no re-fetch
-            pl.BlockSpec((1, blk, dkv),
-                         lambda r, j, pos: (r, jnp.minimum(j, pos[r] // blk),
-                                            0)),
-            pl.BlockSpec((1, blk, dkv),
-                         lambda r, j, pos: (r, jnp.minimum(j, pos[r] // blk),
-                                            0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, num_heads, dh),
                                lambda r, j, pos: (r, 0, 0)),
         scratch_shapes=[
@@ -361,15 +436,17 @@ def decode_attention_slab(q, k, v, positions, num_heads, *, block_k=None,
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s, num_heads, dh), q.dtype),
-        cost_estimate=kernel_cost(s, t, d, dkv, q.dtype.itemsize),
+        cost_estimate=kernel_cost(
+            s, t, d, dkv, q.dtype.itemsize,
+            kv_itemsize=k.dtype.itemsize,
+            scale_hkv=hkv if quant else 0),
         interpret=interpret,
-    )(jnp.asarray(positions, jnp.int32),
-      q.reshape(s, num_heads, dh), k, v)
+    )(jnp.asarray(positions, jnp.int32), *operands)
     return out.reshape(s, d)
 
 
 def decode_attention_paged(q, k, v, positions, tables, num_heads, *,
-                           interpret=None):
+                           interpret=None, kscale=None, vscale=None):
     """Fused paged decode attention: q [S, D], k/v [num_blocks,
     block_size, Dkv] (the shared block POOL, already scatter-updated),
     positions [S] int32, tables [S, blocks_per_row] int32 -> [S, D].
@@ -378,8 +455,11 @@ def decode_attention_paged(q, k, v, positions, tables, num_heads, *,
     k/v index maps read ``tables[r, j]`` directly, so row r's DMA stream
     is exactly the physical blocks it owns (clamped at its position) —
     the ``pool[tables]`` chain gather and its [S, T, Dkv] HBM buffer
-    are gone, not fused.  Raises ValueError on shapes the kernel doesn't
-    cover — callers use ``maybe_paged``."""
+    are gone, not fused.  kscale/vscale [num_blocks, block_size, Hkv]
+    f32 mark an INT8 pool (quant/kv.py): the sidecar blocks ride the
+    SAME table-walked stream and the widening happens in registers.
+    Raises ValueError on shapes the kernel doesn't cover — callers use
+    ``maybe_paged``."""
     interpret = _interpret(interpret)
     s, d = q.shape
     bs, dkv = k.shape[1], k.shape[2]
@@ -390,7 +470,9 @@ def decode_attention_paged(q, k, v, positions, tables, num_heads, *,
             f"decode_attention_paged: unsupported shape q={q.shape} "
             f"pool={k.shape} heads={num_heads}")
     dh, hkv, _group = split
-    if not _mosaic_ok(bs, dkv, dh, interpret):
+    quant = _check_scales("decode_attention_paged", kscale, vscale,
+                          (k.shape[0], bs), hkv)
+    if not _mosaic_ok(bs, dkv, dh, interpret, quant=quant):
         raise ValueError(
             f"decode_attention_paged: untileable block_size={bs} "
             f"dkv={dkv} dh={dh} for the compiled backend")
@@ -404,15 +486,21 @@ def decode_attention_paged(q, k, v, positions, tables, num_heads, *,
         # positions[r] (scratch/stale ids) are never even addressed
         return (tbl[r, jnp.minimum(j, pos[r] // bs)], 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, num_heads, dh),
+                     lambda r, j, pos, tbl: (r, 0, 0)),
+        pl.BlockSpec((1, bs, dkv), _kv_map),
+        pl.BlockSpec((1, bs, dkv), _kv_map),
+    ]
+    operands = [q.reshape(s, num_heads, dh), k, v]
+    if quant:
+        in_specs += [pl.BlockSpec((1, bs, hkv), _kv_map),
+                     pl.BlockSpec((1, bs, hkv), _kv_map)]
+        operands += [kscale, vscale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(s, nb_row),
-        in_specs=[
-            pl.BlockSpec((1, num_heads, dh),
-                         lambda r, j, pos, tbl: (r, 0, 0)),
-            pl.BlockSpec((1, bs, dkv), _kv_map),
-            pl.BlockSpec((1, bs, dkv), _kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, num_heads, dh),
                                lambda r, j, pos, tbl: (r, 0, 0)),
         scratch_shapes=[
@@ -424,37 +512,44 @@ def decode_attention_paged(q, k, v, positions, tables, num_heads, *,
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s, num_heads, dh), q.dtype),
-        cost_estimate=kernel_cost(s, nb_row * bs, d, dkv,
-                                  q.dtype.itemsize),
+        cost_estimate=kernel_cost(
+            s, nb_row * bs, d, dkv, q.dtype.itemsize,
+            kv_itemsize=k.dtype.itemsize,
+            scale_hkv=hkv if quant else 0),
         interpret=interpret,
     )(jnp.asarray(positions, jnp.int32),
-      jnp.asarray(tables, jnp.int32),
-      q.reshape(s, num_heads, dh), k, v)
+      jnp.asarray(tables, jnp.int32), *operands)
     return out.reshape(s, d)
 
 
 def decode_attention_slab_chunk(q, k, v, qpos, num_heads, *,
-                                block_k=None, interpret=None):
+                                block_k=None, interpret=None,
+                                kscale=None, vscale=None):
     """Fused Tq=chunk slab decode attention (the unified chunked-prefill
     step): q [S, K, D], k/v [S, T, Dkv] (the already-updated cache),
     qpos [S, K] int32 per-LANE positions (non-decreasing per row; the
     engine clamps inactive lanes to the last active one) -> [S, K, D].
     Lane (r, i) attends row r's stripe at cols <= qpos[r, i]; the
     stripe streams HBM -> VMEM once per row and every lane consumes it
-    there — no [S, K, T] score matrix.  Raises ValueError on shapes the
-    kernel doesn't cover — callers use ``maybe_slab_chunk``."""
+    there — no [S, K, T] score matrix.  kscale/vscale [S, T, Hkv] f32
+    mark an INT8 cache — in-register dequant, every lane sharing the
+    widened panels.  Raises ValueError on shapes the kernel doesn't
+    cover — callers use ``maybe_slab_chunk``."""
     interpret = _interpret(interpret)
     s, kk, d = q.shape
     t, dkv = k.shape[1], k.shape[2]
     split = _head_split(d, dkv, num_heads)
-    blk = _pick_block_k(t, block_k or _block_k_cap(), interpret)
+    blk = _pick_block_k(t, block_k or _block_k_cap(), interpret,
+                        quant=kscale is not None)
     if split is None or blk is None or not _chunk_ok(kk, num_heads,
                                                     interpret):
         raise ValueError(
             f"decode_attention_slab_chunk: unsupported shape q={q.shape} "
             f"k={k.shape} heads={num_heads}")
     dh, hkv, _group = split
-    if not _mosaic_ok(blk, dkv, dh, interpret):
+    quant = _check_scales("decode_attention_slab_chunk", kscale, vscale,
+                          (s, t), hkv)
+    if not _mosaic_ok(blk, dkv, dh, interpret, quant=quant):
         raise ValueError(
             f"decode_attention_slab_chunk: untileable blk={blk} "
             f"dkv={dkv} dh={dh} for the compiled backend")
@@ -462,21 +557,25 @@ def decode_attention_slab_chunk(q, k, v, qpos, num_heads, *,
     kernel = functools.partial(_chunk_kernel, blk=blk, kk=kk,
                                num_heads=num_heads, hkv=hkv, dh=dh,
                                scale=scale)
+    # clamp at the row's FURTHEST lane: blocks past it re-map to the
+    # last needed block — same index, no re-fetch
+    kv_map = lambda r, j, pos: (
+        r, jnp.minimum(j, pos[r, kk - 1] // blk), 0)
+    in_specs = [
+        pl.BlockSpec((1, kk * num_heads, dh),
+                     lambda r, j, pos: (r, 0, 0)),
+        pl.BlockSpec((1, blk, dkv), kv_map),
+        pl.BlockSpec((1, blk, dkv), kv_map),
+    ]
+    operands = [q.reshape(s, kk * num_heads, dh), k, v]
+    if quant:
+        in_specs += [pl.BlockSpec((1, blk, hkv), kv_map),
+                     pl.BlockSpec((1, blk, hkv), kv_map)]
+        operands += [kscale, vscale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(s, t // blk),
-        in_specs=[
-            pl.BlockSpec((1, kk * num_heads, dh),
-                         lambda r, j, pos: (r, 0, 0)),
-            # clamp at the row's FURTHEST lane: blocks past it re-map to
-            # the last needed block — same index, no re-fetch
-            pl.BlockSpec((1, blk, dkv),
-                         lambda r, j, pos: (
-                             r, jnp.minimum(j, pos[r, kk - 1] // blk), 0)),
-            pl.BlockSpec((1, blk, dkv),
-                         lambda r, j, pos: (
-                             r, jnp.minimum(j, pos[r, kk - 1] // blk), 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, kk * num_heads, dh),
                                lambda r, j, pos: (r, 0, 0)),
         scratch_shapes=[
@@ -488,21 +587,26 @@ def decode_attention_slab_chunk(q, k, v, qpos, num_heads, *,
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s, kk * num_heads, dh), q.dtype),
-        cost_estimate=kernel_cost(s, t, d, dkv, q.dtype.itemsize, tq=kk),
+        cost_estimate=kernel_cost(
+            s, t, d, dkv, q.dtype.itemsize, tq=kk,
+            kv_itemsize=k.dtype.itemsize,
+            scale_hkv=hkv if quant else 0),
         interpret=interpret,
-    )(jnp.asarray(qpos, jnp.int32),
-      q.reshape(s, kk * num_heads, dh), k, v)
+    )(jnp.asarray(qpos, jnp.int32), *operands)
     return out.reshape(s, kk, d)
 
 
 def decode_attention_paged_chunk(q, k, v, qpos, tables, num_heads, *,
-                                 interpret=None):
+                                 interpret=None, kscale=None,
+                                 vscale=None):
     """Fused Tq=chunk PAGED decode attention: q [S, K, D], k/v
     [num_blocks, block_size, Dkv] (the shared pool, already
     scatter-updated for the whole chunk span), qpos [S, K], tables
     [S, blocks_per_row] int32 -> [S, K, D].  The block table stays the
     second scalar-prefetch operand: a row's DMA stream is exactly the
-    physical blocks it owns, clamped at its furthest lane."""
+    physical blocks it owns, clamped at its furthest lane.  kscale/
+    vscale [num_blocks, block_size, Hkv] f32 mark an INT8 pool —
+    sidecar blocks ride the same stream, dequant in registers."""
     interpret = _interpret(interpret)
     s, kk, d = q.shape
     bs, dkv = k.shape[1], k.shape[2]
@@ -513,7 +617,9 @@ def decode_attention_paged_chunk(q, k, v, qpos, tables, num_heads, *,
             f"decode_attention_paged_chunk: unsupported shape "
             f"q={q.shape} pool={k.shape} heads={num_heads}")
     dh, hkv, _group = split
-    if not _mosaic_ok(bs, dkv, dh, interpret):
+    quant = _check_scales("decode_attention_paged_chunk", kscale,
+                          vscale, (k.shape[0], bs), hkv)
+    if not _mosaic_ok(bs, dkv, dh, interpret, quant=quant):
         raise ValueError(
             f"decode_attention_paged_chunk: untileable block_size={bs} "
             f"dkv={dkv} dh={dh} for the compiled backend")
@@ -525,15 +631,21 @@ def decode_attention_paged_chunk(q, k, v, qpos, tables, num_heads, *,
     def _kv_map(r, j, pos, tbl):
         return (tbl[r, jnp.minimum(j, pos[r, kk - 1] // bs)], 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, kk * num_heads, dh),
+                     lambda r, j, pos, tbl: (r, 0, 0)),
+        pl.BlockSpec((1, bs, dkv), _kv_map),
+        pl.BlockSpec((1, bs, dkv), _kv_map),
+    ]
+    operands = [q.reshape(s, kk * num_heads, dh), k, v]
+    if quant:
+        in_specs += [pl.BlockSpec((1, bs, hkv), _kv_map),
+                     pl.BlockSpec((1, bs, hkv), _kv_map)]
+        operands += [kscale, vscale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(s, nb_row),
-        in_specs=[
-            pl.BlockSpec((1, kk * num_heads, dh),
-                         lambda r, j, pos, tbl: (r, 0, 0)),
-            pl.BlockSpec((1, bs, dkv), _kv_map),
-            pl.BlockSpec((1, bs, dkv), _kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, kk * num_heads, dh),
                                lambda r, j, pos, tbl: (r, 0, 0)),
         scratch_shapes=[
@@ -545,12 +657,13 @@ def decode_attention_paged_chunk(q, k, v, qpos, tables, num_heads, *,
     out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s, kk * num_heads, dh), q.dtype),
-        cost_estimate=kernel_cost(s, nb_row * bs, d, dkv,
-                                  q.dtype.itemsize, tq=kk),
+        cost_estimate=kernel_cost(
+            s, nb_row * bs, d, dkv, q.dtype.itemsize, tq=kk,
+            kv_itemsize=k.dtype.itemsize,
+            scale_hkv=hkv if quant else 0),
         interpret=interpret,
     )(jnp.asarray(qpos, jnp.int32),
-      jnp.asarray(tables, jnp.int32),
-      q.reshape(s, kk * num_heads, dh), k, v)
+      jnp.asarray(tables, jnp.int32), *operands)
     return out.reshape(s, kk, d)
 
 
@@ -565,13 +678,14 @@ def _chunk_ok(kk, num_heads, interpret):
     return interpret or (kk * num_heads) % 8 == 0
 
 
-def covers(num_heads, d, dkv, blk_len, paged=False, chunk=1):
+def covers(num_heads, d, dkv, blk_len, paged=False, chunk=1, quant=False):
     """THE dispatch predicate (flag + shape support), shared by
     ``maybe_slab``/``maybe_paged`` and by ``DecodeEngine.warmup``'s
     resolved-path log — one definition, so the engine can never report
     a path its compiled step didn't take.  ``blk_len``: the slab length
     (slab) or the pool block size (paged).  ``chunk``: query lanes per
-    row (1 = plain decode; >1 = the chunked-prefill step)."""
+    row (1 = plain decode; >1 = the chunked-prefill step).  ``quant``:
+    int8 K/V (tighter sublane tiling on the compiled backend)."""
     if not decode_kernels_enabled():
         return False
     interpret = _interpret(None)
@@ -579,46 +693,56 @@ def covers(num_heads, d, dkv, blk_len, paged=False, chunk=1):
     if split is None or not _chunk_ok(chunk, num_heads, interpret):
         return False
     if paged:
-        return _mosaic_ok(blk_len, dkv, split[0], interpret)
-    blk = _pick_block_k(blk_len, _block_k_cap(), interpret)
-    return blk is not None and _mosaic_ok(blk, dkv, split[0], interpret)
+        return _mosaic_ok(blk_len, dkv, split[0], interpret, quant=quant)
+    blk = _pick_block_k(blk_len, _block_k_cap(), interpret,
+                        quant=quant)
+    return blk is not None and _mosaic_ok(blk, dkv, split[0], interpret,
+                                          quant=quant)
 
 
-def maybe_slab(q, k, v, positions, num_heads):
+def maybe_slab(q, k, v, positions, num_heads, kscale=None, vscale=None):
     """Kernel output [S, D] when the fused slab kernel is enabled and
     covers these shapes; None -> caller takes the reference XLA path."""
     if not covers(num_heads, q.shape[1], k.shape[2], k.shape[1],
-                  paged=False):
+                  paged=False, quant=kscale is not None):
         return None
     return decode_attention_slab(q, k, v, positions, num_heads,
-                                 interpret=_interpret(None))
+                                 interpret=_interpret(None),
+                                 kscale=kscale, vscale=vscale)
 
 
-def maybe_paged(q, k, v, positions, tables, num_heads):
+def maybe_paged(q, k, v, positions, tables, num_heads, kscale=None,
+                vscale=None):
     """Kernel output [S, D] when the fused paged kernel is enabled and
     covers these shapes; None -> caller takes the chain-gather path."""
     if not covers(num_heads, q.shape[1], k.shape[2], k.shape[1],
-                  paged=True):
+                  paged=True, quant=kscale is not None):
         return None
     return decode_attention_paged(q, k, v, positions, tables, num_heads,
-                                  interpret=_interpret(None))
+                                  interpret=_interpret(None),
+                                  kscale=kscale, vscale=vscale)
 
 
-def maybe_slab_chunk(q, k, v, qpos, num_heads):
+def maybe_slab_chunk(q, k, v, qpos, num_heads, kscale=None, vscale=None):
     """Kernel output [S, K, D] when the fused Tq=chunk slab kernel is
     enabled and covers these shapes; None -> the reference XLA path."""
     if not covers(num_heads, q.shape[2], k.shape[2], k.shape[1],
-                  paged=False, chunk=q.shape[1]):
+                  paged=False, chunk=q.shape[1],
+                  quant=kscale is not None):
         return None
     return decode_attention_slab_chunk(q, k, v, qpos, num_heads,
-                                       interpret=_interpret(None))
+                                       interpret=_interpret(None),
+                                       kscale=kscale, vscale=vscale)
 
 
-def maybe_paged_chunk(q, k, v, qpos, tables, num_heads):
+def maybe_paged_chunk(q, k, v, qpos, tables, num_heads, kscale=None,
+                      vscale=None):
     """Kernel output [S, K, D] when the fused Tq=chunk paged kernel is
     enabled and covers these shapes; None -> the chain-gather path."""
     if not covers(num_heads, q.shape[2], k.shape[2], k.shape[1],
-                  paged=True, chunk=q.shape[1]):
+                  paged=True, chunk=q.shape[1],
+                  quant=kscale is not None):
         return None
     return decode_attention_paged_chunk(q, k, v, qpos, tables, num_heads,
-                                        interpret=_interpret(None))
+                                        interpret=_interpret(None),
+                                        kscale=kscale, vscale=vscale)

@@ -91,6 +91,17 @@ FAMILIES = [
     # [K, T] buffer in the unified step, no [Tp, Tp] buffer in the
     # flash-routed legacy prefill — with both gates tested in reverse
     ("serving_chunked_prefill", "serving_chunked_prefill", None),
+    # quantized serving (paddle_tpu/quant/: int8 weights + int8 KV with
+    # in-register dequant in the fused kernels): extras["lower"] is the
+    # int8-KV + int8-weight paged step with kernels forced, and the
+    # postcheck proves (a) every quantized weight enters the program as
+    # s8 — no fp32 weight copy resident (assert_weights_quantized,
+    # failed by the fp32 twin), (b) no widened-KV [S, T, Dkv] float
+    # buffer exists in the kernel-forced HLO (assert_kv_quantized,
+    # failed by the kernels-off reference twin), and (c) the predicted
+    # decode-step bytes (predicted_decode_step_bytes) shrink >= 35% —
+    # all before any chip time
+    ("serving_quant", "serving_quant", None),
     ("trainer_prefetch", "trainer_prefetch", None),
 ]
 
@@ -213,6 +224,151 @@ def assert_decode_fused(hlo_text, num_rows, t_span, dkv):
             f"fused kernel did not engage:\n  " + "\n  ".join(hits[:4]))
 
 
+# -------------------------------------------------- quantized-serving gates
+
+def widened_kv_instrs(hlo_text, num_rows, t_span, dkv):
+    """Instructions whose RESULT materializes a widened (FLOAT) full
+    KV view of an int8 cache: a float-typed buffer leading with
+    ``num_rows`` and holding exactly ``num_rows * t_span * dkv``
+    elements.  The int8-KV reference path dequantizes the whole
+    gathered stripe into exactly such a buffer before attending; the
+    fused kernels widen block-by-block in registers, so with them
+    engaged NO such buffer may exist.  (The int8 cache itself never
+    matches: the dtype filter is float-only, and the paged pool leads
+    with num_blocks, not S.)  Returns the offending lines."""
+    import re
+    from paddle_tpu.perf import cost as _cost
+    target = int(num_rows) * int(t_span) * int(dkv)
+    shape_re = re.compile(r"\b(f32|bf16|f16|f64)\[([0-9,]+)\]")
+    hits = []
+    for line in hlo_text.splitlines():
+        m = _cost._INSTR_RE.match(line)
+        if not m:
+            continue
+        rhs = m.group(1)
+        op = _cost._op_of(rhs)
+        if op is None or op in _cost._SKIP_OPS:
+            continue
+        if rhs.startswith("("):
+            depth, ty = 0, rhs
+            for i, ch in enumerate(rhs):
+                depth += ch == "("
+                depth -= ch == ")"
+                if depth == 0:
+                    ty = rhs[:i + 1]
+                    break
+        else:
+            ty = rhs.split(None, 1)[0]
+        for _dt, dims in shape_re.findall(ty):
+            shape = [int(d) for d in dims.split(",")]
+            n = 1
+            for d in shape:
+                n *= d
+            if shape and shape[0] == int(num_rows) and n == target:
+                hits.append(line.strip())
+                break
+    return hits
+
+
+def assert_kv_quantized(hlo_text, num_rows, t_span, dkv):
+    """Raise AssertionError when an int8-KV decode HLO still widens the
+    whole cache into a float [num_rows, t_span, dkv]-element buffer
+    (the kernels were supposed to dequantize in registers)."""
+    hits = widened_kv_instrs(hlo_text, num_rows, t_span, dkv)
+    if hits:
+        raise AssertionError(
+            f"int8-KV decode step materializes a widened float "
+            f"[{num_rows}, {t_span}, {dkv}]-element KV buffer — the "
+            f"in-register dequant did not engage:\n  "
+            + "\n  ".join(hits[:4]))
+
+
+def entry_param_types(hlo_text):
+    """(dtype, dims-tuple) of every ENTRY parameter, parsed from the
+    module's ``entry_computation_layout`` — the program's resident
+    interface (what is fed and carried between steps)."""
+    import re
+    m = re.search(r"entry_computation_layout=\{\((.*?)\)->", hlo_text,
+                  re.S)
+    if not m:
+        return []
+    out = []
+    for dt, dims in re.findall(r"([a-z][a-z0-9]*)\[([0-9,]*)\]",
+                               m.group(1)):
+        out.append((dt, tuple(int(d) for d in dims.split(",") if d)))
+    return out
+
+
+def assert_weights_quantized(hlo_text, weight_shapes, float_shapes=()):
+    """Raise AssertionError unless every quantized weight enters the
+    compiled step as an s8 ENTRY PARAMETER and no EXTRA float parameter
+    of that shape exists — i.e. no fp32 (or bf16) weight copy is ever
+    RESIDENT across steps; the dequantized view lives only inside the
+    step, fused into each consuming matmul's operand read on TPU.
+    COUNT-based per shape: ``weight_shapes``
+    (quant.weights.quantized_weight_shapes) sets how many s8 params a
+    shape needs, and ``float_shapes``
+    (quant.weights.float_leaf_shapes) allows the tree's legitimate
+    float leaves — so a non-weight f32 param whose shape collides with
+    a quantized weight's (e.g. the positional table vs an FFN weight
+    at max_len == dff) never reads as a widened copy.  The fp32 twin
+    step must FAIL this gate (its weights enter f32, no s8 params) —
+    the reverse test the serving_quant postcheck runs."""
+    import collections
+    params = entry_param_types(hlo_text)
+    s8 = collections.Counter(dims for dt, dims in params if dt == "s8")
+    fl = collections.Counter(dims for dt, dims in params
+                             if dt in ("f32", "bf16", "f16", "f64"))
+    need = collections.Counter(tuple(int(d) for d in s)
+                               for s in weight_shapes)
+    allow = collections.Counter(tuple(int(d) for d in s)
+                                for s in float_shapes)
+    for shape, n in need.items():
+        if s8[shape] < n:
+            raise AssertionError(
+                f"only {s8[shape]} of {n} quantized weights of shape "
+                f"{list(shape)} enter the step as s8 parameters — the "
+                "int8 tree was not threaded through")
+        if fl[shape] > allow[shape]:
+            raise AssertionError(
+                f"{fl[shape]} float parameter(s) of quantized-weight "
+                f"shape {list(shape)} exist but only {allow[shape]} "
+                "float leaf(s) of that shape are in the tree — a "
+                "widened weight copy is being fed to the step")
+
+
+def predicted_decode_step_bytes(params, s, t_span, num_heads,
+                                kv_dtype="float32"):
+    """First-principles HBM traffic of ONE serving decode step — the
+    quantized-serving bytes model (the XLA-CPU cost model cannot show
+    the int8 win: it materializes the dequant converts the TPU backend
+    fuses into the MXU/kernel operand reads, so like PR 10's fused-
+    kernel row the prediction composes declared traffic instead).
+
+    Terms, each read/written exactly once per step on the memory-bound
+    path: every trunk weight as STORED (int8 data + f32 scales for a
+    quantized tree — quant.weights.param_bytes), each of the S rows'
+    K/V stripe streamed once per layer (the fused kernels' declared
+    stream, including the int8 scale sidecar), one position's K/V
+    written per row per layer, the inter-layer activations, and the
+    token-ids-in / logits-out io.  Returns the byte total; the
+    serving_quant postcheck gates int8 vs f32 at >= 35% reduction."""
+    from paddle_tpu.quant import kv as kvq
+    from paddle_tpu.quant import weights as qw
+    enc = params["enc"]
+    layers = len(enc)
+    vocab, d = qw.weight_shape(params["src_emb"])
+    dkv = qw.weight_shape(enc[0]["attn"]["wk"])[1]
+    hkv = dkv // (d // num_heads)
+    kv_isz = 1 if kv_dtype == "int8" else 4
+    sidecar = 2 * s * t_span * hkv * 4 if kv_dtype == "int8" else 0
+    kv_read = layers * (2 * s * t_span * dkv * kv_isz + sidecar)
+    kv_write = layers * s * kvq.kv_bytes_per_position(dkv, hkv, kv_dtype)
+    acts = layers * 2 * s * d * 4          # residual stream in/out
+    io = s * 4 + s * vocab * 4             # ids in, logits out
+    return qw.param_bytes(params) + kv_read + kv_write + acts + io
+
+
 def _import_bench():
     if _REPO not in sys.path:
         sys.path.insert(0, _REPO)
@@ -273,7 +429,7 @@ def capture(name, model, batch=None, chips=("v5e", "v5p")):
     if model in ("transformer_serving", "serving", "serving_generate",
                  "serving_fleet", "serving_paged",
                  "serving_decode_fused", "serving_autoscale",
-                 "serving_chunked_prefill"):
+                 "serving_chunked_prefill", "serving_quant"):
         # the lowered program is one batch/slab step while the bench FLOPs
         # model covers the whole stream/burst — scopes differ, no cross-check
         row["bench_model_flops"] = None

@@ -1,0 +1,41 @@
+"""Quantized serving (docs/serving.md "Quantized serving").
+
+Every bench family in the committed analytic snapshot is MEMORY-bound
+(BENCH_ANALYTIC_r06.json names bytes, not FLOPs, as the #1 bottleneck
+for all 22 families), so after PR 10/13 fused the decode hot path the
+next independent attack on the memory wall is shrinking the bytes
+themselves.  Two composable halves:
+
+* ``quant.weights`` — per-channel symmetric int8 quantization of the LM
+  trunk's matmul weights: the params pytree stores int8 data + small
+  f32 scale sidecars, and every model entry point dequantizes at the
+  matmul boundary (``(int8_w * scale) @ x``, fused into the MXU operand
+  read by XLA on TPU) — no fp32 weight copy is ever fed to or carried
+  by the jitted step.
+
+* ``quant.kv`` — int8 KV cache with per-(position, head) scales: the
+  decode cache (slab rows or paged blocks) stores int8 K/V plus an
+  ``[..., Hkv]`` f32 scale sidecar, scatter-writes quantize on the way
+  in, and the fused decode kernels (ops/pallas/decode_attention.py)
+  DMA the quantized blocks HBM -> VMEM and widen IN REGISTERS inside
+  the online-softmax accumulator.  On the paged layout the ~4x smaller
+  blocks double the effective slot count at a fixed pool-byte budget
+  (DecodeEngine(kv_dtype="int8") auto-doubles ``kv_num_blocks``).
+"""
+
+from paddle_tpu.quant.weights import (dequant_tree, is_quantized_leaf,
+                                      is_quantized_tree, maybe_dequant,
+                                      param_bytes, quantize_lm,
+                                      weight_shape)
+from paddle_tpu.quant.kv import (GREEDY_PREFIX_MIN, GREEDY_PREFIX_MIN_FULL,
+                                 KV_DTYPES, LOGIT_ERR_BUDGET,
+                                 dequantize_heads, greedy_prefix_len,
+                                 kv_bytes_per_position, quantize_heads)
+
+__all__ = [
+    "quantize_lm", "maybe_dequant", "dequant_tree", "is_quantized_leaf",
+    "is_quantized_tree", "weight_shape", "param_bytes",
+    "quantize_heads", "dequantize_heads", "kv_bytes_per_position",
+    "greedy_prefix_len", "KV_DTYPES", "GREEDY_PREFIX_MIN",
+    "GREEDY_PREFIX_MIN_FULL", "LOGIT_ERR_BUDGET",
+]

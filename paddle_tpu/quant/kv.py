@@ -1,0 +1,105 @@
+"""Int8 KV cache: per-(position, head) symmetric scales.
+
+Scale granularity — per written POSITION per KV HEAD — chosen over the
+alternatives deliberately (docs/serving.md "Quantized serving"):
+
+* per-tensor / per-layer static scales need a calibration pass and go
+  stale as traffic shifts; a wrong static scale clips silently.
+  Per-position scales are computed FROM the value being written, so no
+  calibration exists to go stale and the quantize math is a pure
+  deterministic function of the written K/V — exactly what the engine's
+  replay machinery (recovery re-prefill, CoW re-seating, continuation
+  teacher-forcing) needs to land a rebuilt slot bit-identically.
+* per-block scales (one scale per paged block) would couple a
+  position's quantization to its neighbors: a later write into the
+  block would have to re-quantize earlier positions (or accept stale
+  scales), breaking the scatter-write-once contract.
+* per-head (not per-position-only) keeps outlier heads from crushing
+  quiet heads' resolution, and the ``[.., Hkv]`` sidecar slots directly
+  into the fused kernels' per-KV-head group loop — the in-register
+  dequant is one broadcast multiply per group panel.
+
+Cost: the sidecar is ``4 / head_dim`` of the int8 data (2 f32 scales
+per 2·head_dim int8 values), so k+v at head_dim 16 stream at ~0.31x
+the f32 bytes — and a paged block shrinks enough that DOUBLING the
+block count stays inside the f32 byte budget for head_dim >= 4
+(serving/kv_pool.slab_equivalent_blocks).
+
+Identity-scale exactness: with scale 1 and integer values in
+[-127, 127], quantize->dequantize is BIT-exact (round half-to-even,
+clip, convert, multiply by 1.0) — tests/test_quant.py pins it, so the
+quantize/dequant math itself is proven bias-free.
+"""
+
+import jax.numpy as jnp
+
+KV_DTYPES = ("float32", "int8")
+
+# Quality budget (committed; tests/test_quant.py + the --smoke-quant
+# phase assert against these): an int8-KV greedy stream must match its
+# fp32 twin for at least GREEDY_PREFIX_MIN tokens on the seeded test
+# trunks (measured: the full 32-token streams match — 2x headroom), an
+# int8-KV + int8-WEIGHT stream for at least GREEDY_PREFIX_MIN_FULL
+# (random-init test trunks babble with near-tied logits, so the full-
+# quant argmax flips earlier than any trained trunk's would; measured
+# 6-12), and the max |logit error| of a quantized prefill vs the fp32
+# twin must stay under LOGIT_ERR_BUDGET (measured 0.004-0.012 — ~5x
+# headroom).
+GREEDY_PREFIX_MIN = 16
+GREEDY_PREFIX_MIN_FULL = 4
+LOGIT_ERR_BUDGET = 0.06
+
+
+def _split_heads(x, hkv):
+    dkv = x.shape[-1]
+    if hkv < 1 or dkv % hkv:
+        raise ValueError(f"Dkv={dkv} not divisible by Hkv={hkv}")
+    return x.reshape(x.shape[:-1] + (hkv, dkv // hkv))
+
+
+def quantize_heads(x, hkv):
+    """Quantize ``x`` [..., Dkv] f32 per (leading index, KV head):
+    returns ``(q int8 [..., Dkv], s f32 [..., Hkv])`` with
+    ``s = amax_over_head / 127`` (0 for an all-zero head — dequant
+    rebuilds exact zeros).  The math the reference step, the fused
+    kernels' producers, and the quantized prefill all share."""
+    xh = _split_heads(x, hkv)
+    amax = jnp.max(jnp.abs(xh), axis=-1)
+    s = amax / 127.0
+    safe = jnp.where(s > 0, s, 1.0)[..., None]
+    q = jnp.clip(jnp.round(xh / safe), -127, 127).astype(jnp.int8)
+    return q.reshape(x.shape), s.astype(jnp.float32)
+
+
+def dequantize_heads(q, s):
+    """Widen ``q`` [..., Dkv] int8 by its per-head scales ``s``
+    [..., Hkv] -> f32 [..., Dkv] — the reference (XLA) read path; the
+    fused kernels do the same multiply in registers per group panel."""
+    hkv = s.shape[-1]
+    qh = _split_heads(q.astype(jnp.float32), hkv)
+    return (qh * s[..., None]).reshape(q.shape)
+
+
+def greedy_prefix_len(a, b):
+    """Length of the common leading run of two token streams — THE
+    comparison the greedy-prefix quality budget (GREEDY_PREFIX_MIN*)
+    is defined over, shared by tests/test_quant.py, the serving_quant
+    bench, and the --smoke-quant phase so all three measure the same
+    thing."""
+    n = 0
+    if a is None or b is None:
+        return 0
+    for x, y in zip(a, b):
+        if int(x) != int(y):
+            break
+        n += 1
+    return n
+
+
+def kv_bytes_per_position(dkv, hkv, kv_dtype):
+    """HBM bytes one cached position costs (K and V, sidecar included)
+    — the KV term of the serving_quant predicted-bytes model and the
+    pool-sizing math in serving/kv_pool.py."""
+    if kv_dtype == "int8":
+        return 2 * dkv * 1 + 2 * hkv * 4
+    return 2 * dkv * 4

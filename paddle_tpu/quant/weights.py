@@ -1,0 +1,194 @@
+"""Per-channel symmetric int8 weight quantization for the LM trunk.
+
+The serving decode step reads every trunk weight once per step and the
+analytic layer says bytes set the step time, so int8 storage quarters
+the weight stream.  The scheme is ``export.quantize_params``'s (weight-
+only, symmetric, per-OUT-channel scales over the last axis) specialized
+for the decode hot path:
+
+* only 2-D float32 matmul weights with >= ``min_size`` elements
+  quantize (attention projections, FFN, the tied embedding); biases,
+  layer norms and the positional table stay f32 — their bytes are
+  noise and their precision is not;
+* a quantized leaf is ``{"q": int8 [.., dout], "s": f32 [1, dout]}``
+  (same marker-free shape either way: ``is_quantized_leaf`` keys on the
+  dict structure), so the params pytree fed to the jitted step holds
+  int8 data + small scale sidecars and NO fp32 weight copy is ever
+  resident between steps;
+* dequantization happens at the matmul boundary inside the step
+  (``maybe_dequant`` at each model entry point): XLA sees
+  ``convert(int8) * scale`` feeding each consuming matmul, which the
+  TPU backend fuses into the MXU operand read — the int8 bytes stream
+  from HBM and widen in registers.  (The CPU backend materializes the
+  widened operand as a transient fusion output; its cost model
+  therefore cannot show the win — perf/analytic's serving_quant row
+  predicts it compositionally instead, the PR-10 methodology.)
+
+Identity-scale exactness (pinned by tests/test_quant.py): with scale 1
+and integer values in [-127, 127] the round-trip ``dequant(quantize)``
+is BIT-exact — ``jnp.round`` half-to-even, clip, convert — so the
+quantize/dequant math itself carries no hidden bias.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# leaf formats recognized everywhere below: this module's {"q","s"} and
+# export.quantize_params' {"__int8__","__scale__"} (same per-out-channel
+# symmetric scheme — an artifact-exported int8 tree feeds the serving
+# engine directly)
+_LEAF_KEYS = (("q", "s"), ("__int8__", "__scale__"))
+
+
+def _leaf_keys(leaf):
+    if isinstance(leaf, dict):
+        for qk, sk in _LEAF_KEYS:
+            if qk in leaf and sk in leaf \
+                    and getattr(leaf[qk], "dtype", None) == jnp.int8:
+                return qk, sk
+    return None
+
+
+def is_quantized_leaf(leaf):
+    """True for a quantized-weight leaf — this module's ``{"q", "s"}``
+    or ``export.quantize_params``' ``{"__int8__", "__scale__"}``."""
+    return _leaf_keys(leaf) is not None
+
+
+def quantize_leaf(w, axis=None):
+    """Symmetric per-channel int8: scales over every axis but the last
+    (``axis=None``) -> ``{"q", "s"}``.  A zero channel quantizes to
+    zeros with scale 0 (dequant rebuilds exact zeros)."""
+    w = jnp.asarray(w)
+    axes = axis if axis is not None else tuple(range(w.ndim - 1))
+    amax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+    s = amax / 127.0
+    safe = jnp.where(s > 0, s, 1.0)
+    q = jnp.clip(jnp.round(w / safe), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s.astype(jnp.float32)}
+
+
+def dequantize_leaf(leaf):
+    qk, sk = _leaf_keys(leaf)
+    return leaf[qk].astype(jnp.float32) * leaf[sk]
+
+
+def quantize_lm(params, min_size=1024):
+    """Quantize a ``models/transformer`` decoder-only trunk: every 2-D
+    f32 weight with >= ``min_size`` elements becomes a ``{"q", "s"}``
+    pair; everything else (biases, norms, ``pos``) passes through.
+    Returns the quantized pytree — feed it anywhere the f32 tree went
+    (``DecodeEngine``, ``lm_prefill``, ``lm_logits``): the model entry
+    points dequantize at the matmul boundary via ``maybe_dequant``.
+
+    The learned positional table (``params["pos"]``) stays f32: it is
+    added to activations, not consumed by a matmul, so quantizing it
+    would buy no fused dequant — and it is one row-gather per step."""
+
+    def q(x):
+        if getattr(x, "dtype", None) != jnp.float32 or x.ndim != 2 \
+                or int(np.prod(x.shape)) < min_size:
+            return x
+        return quantize_leaf(x)
+
+    pos = params.get("pos") if isinstance(params, dict) else None
+    if pos is not None:
+        params = dict(params, pos=None)
+    out = jax.tree_util.tree_map(q, params)
+    if pos is not None:
+        out["pos"] = pos
+    return out
+
+
+def dequant_tree(params):
+    """Rebuild the float tree: quantized leaves widen at their consuming
+    matmul (XLA fuses the convert+scale into the operand read on TPU);
+    float leaves pass through untouched."""
+    return jax.tree_util.tree_map(
+        lambda l: dequantize_leaf(l) if is_quantized_leaf(l) else l,
+        params, is_leaf=is_quantized_leaf)
+
+
+def is_quantized_tree(params):
+    """True when any leaf of ``params`` is a quantized weight."""
+    found = [False]
+
+    def visit(l):
+        if is_quantized_leaf(l):
+            found[0] = True
+        return l
+
+    jax.tree_util.tree_map(visit, params, is_leaf=is_quantized_leaf)
+    return found[0]
+
+
+def maybe_dequant(params):
+    """THE model-entry-point hook (``models/transformer`` lm_* paths):
+    dequantize a quantized tree, pass a float tree through untouched —
+    one ``is_quantized_tree`` walk, zero cost on the f32 path."""
+    if is_quantized_tree(params):
+        return dequant_tree(params)
+    return params
+
+
+def weight_shape(leaf):
+    """Logical (pre-quantization) shape of a weight leaf — quantized or
+    not — for the host-side config reads (vocab/d_model/Dkv)."""
+    keys = _leaf_keys(leaf)
+    if keys is not None:
+        return tuple(leaf[keys[0]].shape)
+    return tuple(np.shape(leaf))
+
+
+def quantized_weight_shapes(params):
+    """Shapes of every quantized weight in the tree — the analytic
+    gate's target list (perf/analytic.assert_weights_quantized checks
+    the compiled step feeds each as int8 and none as f32)."""
+    shapes = []
+
+    def visit(l):
+        if is_quantized_leaf(l):
+            shapes.append(weight_shape(l))
+        return l
+
+    jax.tree_util.tree_map(visit, params, is_leaf=is_quantized_leaf)
+    return shapes
+
+
+def float_leaf_shapes(params):
+    """Shapes of the tree's NON-quantized array leaves — the float
+    parameters the compiled step legitimately takes.  The analytic
+    weights gate's allow-list: a float entry param whose shape happens
+    to collide with a quantized weight's (e.g. the positional table
+    [max_len, d] vs an FFN weight when max_len == dff) must not read
+    as a widened weight copy."""
+    shapes = []
+
+    def visit(l):
+        if not is_quantized_leaf(l) and hasattr(l, "dtype") \
+                and np.issubdtype(l.dtype, np.floating):
+            shapes.append(tuple(np.shape(l)))
+        return l
+
+    jax.tree_util.tree_map(visit, params, is_leaf=is_quantized_leaf)
+    return shapes
+
+
+def param_bytes(params):
+    """Total resident bytes of a params pytree as STORED (int8 data +
+    scale sidecars for a quantized tree) — the weight-stream term of the
+    serving_quant predicted-bytes model."""
+    total = [0]
+
+    def visit(l):
+        keys = _leaf_keys(l)
+        if keys is not None:
+            total[0] += l[keys[0]].size * 1 + l[keys[1]].size * 4
+        elif hasattr(l, "dtype"):
+            total[0] += int(np.prod(np.shape(l))) * np.dtype(l.dtype).itemsize
+        return l
+
+    jax.tree_util.tree_map(visit, params, is_leaf=is_quantized_leaf)
+    return total[0]

@@ -57,6 +57,14 @@
 #                                        under target, spike ends ->
 #                                        rolling scale-in; zero failed
 #                                        requests)
+# 15. chunked-prefill smoke              (unified step vs legacy ladder:
+#                                        long prompt chunked mid-decode,
+#                                        in-flight streams keep emitting)
+# 16. quantized serving smoke            (int8-KV paged engine within the
+#                                        committed quality budget vs the
+#                                        fp32 twin, int8+weights exact vs
+#                                        the quantized oracle, blocks
+#                                        doubled at equal bytes)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -294,6 +302,18 @@ log "phase 15: chunked-prefill smoke (unified step vs legacy ladder)"
 timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-chunked \
     > "$ART/chunked_smoke.json" 2> "$ART/chunked_smoke.log"
 log "chunked smoke rc=$? -> $ART/chunked_smoke.json"
+
+log "phase 16: quantized serving smoke (int8 KV + int8 weights)"
+# int8-KV paged engine (kv_num_blocks auto-DOUBLED at the slab-
+# equivalent byte budget) vs a fp32 twin: every HTTP stream inside the
+# committed quality budget, the int8-KV+weights engine token-EXACT vs
+# the quantized lm_generate oracle, /metrics showing kv_blocks_total
+# doubled at equal bytes + kv_cache_int8 1 — one JSON line
+# (python -m paddle_tpu.serving --smoke-quant; docs/serving.md
+# "Quantized serving")
+timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-quant \
+    > "$ART/quant_smoke.json" 2> "$ART/quant_smoke.log"
+log "quant smoke rc=$? -> $ART/quant_smoke.json"
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

@@ -60,9 +60,13 @@ _WAIT = re.compile(
     r"Await|block_until_ready|try_to_block|wait for", re.I)
 
 # host-runtime bookkeeping events that would double-count over the real op
-# events nested under them (or alongside them on the same track)
+# events nested under them (or alongside them on the same track).
+# TfrtCpu* is the newer jax CPU runtime's name for the same executor
+# events PjRtCpu* used to carry (TfrtCpuExecutable::Execute nests over
+# every real op of the launch — counting it drowned the categories in
+# "other" and broke the matmul-attribution assertion on newer jax).
 _SKIP = re.compile(
-    r"PjitFunction|ExecuteHelper|PjRtCpu|ParseArguments|"
+    r"PjitFunction|ExecuteHelper|PjRtCpu|TfrtCpu|ParseArguments|"
     r"CollectGarbage|Handle inputs|holds|ThreadpoolListener|"
     r"CreateOutputs|TransferTo|BufferFromHost|^end: |^Thread |^run_|"
     # python frames ($file:line fn) and executor bookkeeping nest OVER the

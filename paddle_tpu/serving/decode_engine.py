@@ -84,8 +84,11 @@ from paddle_tpu.serving.batcher import (BatchExecutionError,
                                         DeadlineExceededError,
                                         OverloadedError, ShutdownError)
 from paddle_tpu.serving.engine import InferenceEngine, InvalidRequestError
+from paddle_tpu.quant.kv import KV_DTYPES
+from paddle_tpu.quant.weights import weight_shape as _w_shape
 from paddle_tpu.serving.kv_pool import (InsufficientBlocksError,
-                                        PagedKVState)
+                                        PagedKVState,
+                                        slab_equivalent_blocks)
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.testing.trace import expect_traces
 from paddle_tpu.utils.error import ConfigError
@@ -135,6 +138,14 @@ class DecodeEngine:
     bytes, strictly more packable); prefix_cache (share resident prompt-
     prefix blocks across requests, copy-on-write on divergence).
 
+    kv_dtype: ``"float32"`` (default) or ``"int8"`` — quantized serving
+    (quant/kv.py; docs/serving.md "Quantized serving"): the cache
+    stores int8 K/V + per-(position, head) f32 scale sidecars, every
+    scatter-write quantizes on the way in, the fused kernels widen in
+    registers, and the paged auto-sizing DOUBLES ``kv_num_blocks`` at
+    the same byte budget.  Composable with quantized weights
+    (quant/weights.quantize_lm — just pass the quantized params tree).
+
     Slot lifecycle (docs/serving.md §4): FREE -> (prefill) -> ACTIVE
     -> one emitted token per ``step()`` -> EVICTED (eos | length |
     error | shutdown | pool_exhausted) -> FREE.  All bookkeeping is
@@ -148,7 +159,7 @@ class DecodeEngine:
                  pos_type="learned", metrics=None, name="lm", warm=True,
                  kv_layout="slab", kv_block_size=16, kv_num_blocks=0,
                  prefix_cache=True, prefill_chunk=0,
-                 prefill_chunk_budget=0):
+                 prefill_chunk_budget=0, kv_dtype="float32"):
         from paddle_tpu.models import transformer
         self._transformer = transformer
         if params.get("dec"):
@@ -196,15 +207,29 @@ class DecodeEngine:
         if kv_layout not in ("slab", "paged"):
             raise ConfigError(f"kv_layout={kv_layout!r} (supported: "
                               "'slab', 'paged')")
+        if kv_dtype not in KV_DTYPES:
+            raise ConfigError(f"kv_dtype={kv_dtype!r} (supported: "
+                              f"{KV_DTYPES})")
         self.kv_layout = kv_layout
+        # int8 KV (quant/kv.py; docs/serving.md "Quantized serving"):
+        # the cache stores int8 K/V + per-(position, head) f32 scale
+        # sidecars, the step quantizes scatter-writes on the way in, and
+        # the fused kernels widen in registers.  The pytree structure is
+        # what threads it — no step-signature change, so the 1-trace/
+        # 0-retrace discipline is untouched.
+        self.kv_dtype = kv_dtype
         self._paged = None
         if kv_layout == "paged":
             self.block_size = int(kv_block_size)
             if self.block_size < 1:
                 raise ConfigError("kv_block_size must be >= 1")
-            blocks_per_row = -(-self.max_len // self.block_size)
+            # kv_num_blocks=0 auto-sizes to the SLAB-EQUIVALENT byte
+            # budget — and int8 blocks are small enough that the same
+            # budget holds 2x the count (slab_equivalent_blocks)
             num_blocks = (int(kv_num_blocks) if kv_num_blocks
-                          else self.num_slots * blocks_per_row + 1)
+                          else slab_equivalent_blocks(
+                              self.num_slots, self.max_len,
+                              self.block_size, kv_dtype))
             # host allocator + prefix index + per-slot block tables
             self._paged = PagedKVState(self.num_slots, num_blocks,
                                        self.block_size, self.max_len,
@@ -212,11 +237,13 @@ class DecodeEngine:
             # per-layer [num_blocks, block_size, Dkv] pools (block 0 is
             # the scratch block free slot rows point at)
             self._cache = transformer.init_lm_cache_paged(
-                params, num_blocks, self.block_size, max_len=self.max_len)
+                params, num_blocks, self.block_size, max_len=self.max_len,
+                kv_dtype=kv_dtype, num_heads=self.num_heads)
         else:
             # init_lm_cache validates max_len against the positional table
-            self._cache = transformer.init_lm_cache(params, self.num_slots,
-                                                    self.max_len)
+            self._cache = transformer.init_lm_cache(
+                params, self.num_slots, self.max_len, kv_dtype=kv_dtype,
+                num_heads=self.num_heads)
         # prefill-compute ledger: real positions run through the prefill
         # ladder (the paged prefix cache's whole point is to NOT grow
         # this; bench.py serving_paged reads it for the elimination rate)
@@ -340,10 +367,14 @@ class DecodeEngine:
             # cache at BUCKET length, not slab length: the admission
             # write only needs the prompt prefix, so the device<->host
             # round-trip per admission moves bucket-sized rows instead
-            # of max_len-sized ones
+            # of max_len-sized ones.  kv_dtype threads through: an int8
+            # engine's prefill returns int8 rows + scale sidecars, so
+            # the admission write's tree_map dtypes line up
             hidden, cache = transformer.lm_prefill(
                 params, feed["prompt"], bucket, self.num_heads,
-                self.moe_top_k, self.pos_type)
+                self.moe_top_k, self.pos_type,
+                kv_dtype=None if self.kv_dtype == "float32"
+                else self.kv_dtype)
             # the request's FIRST token comes from its last real
             # position's hidden state — gather BEFORE the d_model x vocab
             # projection, exactly like lm_generate
@@ -444,6 +475,7 @@ class DecodeEngine:
         # gauge is config, so the fresh object inherits it immediately
         self._metrics = m
         m.set_prefill_chunk(self.prefill_chunk)
+        m.set_kv_dtype(self.kv_dtype)
         for eng in self._prefill_engines.values():
             eng.metrics = m
 
@@ -871,10 +903,12 @@ class DecodeEngine:
                     self.max_len, prefix_cache=old.index is not None)
                 self._cache = self._transformer.init_lm_cache_paged(
                     self.params, old.pool.num_blocks, self.block_size,
-                    max_len=self.max_len)
+                    max_len=self.max_len, kv_dtype=self.kv_dtype,
+                    num_heads=self.num_heads)
             else:
                 self._cache = self._transformer.init_lm_cache(
-                    self.params, self.num_slots, self.max_len)
+                    self.params, self.num_slots, self.max_len,
+                    kv_dtype=self.kv_dtype, num_heads=self.num_heads)
         self._tokens[:] = 0
         self._pos[:] = 0
         if self.prefill_chunk:
@@ -904,15 +938,17 @@ class DecodeEngine:
         from paddle_tpu.ops.pallas import decode_attention as _dk
         enc = self.params.get("enc") or []
         if enc:
-            d = int(self.params["src_emb"].shape[1])
-            dkv = int(enc[0]["attn"]["wk"].shape[1])
+            d = int(_w_shape(self.params["src_emb"])[1])
+            dkv = int(_w_shape(enc[0]["attn"]["wk"])[1])
             blk_len = (self.block_size if self.kv_layout == "paged"
                        else self.max_len)
             self.decode_kernels = _dk.covers(
                 self.num_heads, d, dkv, blk_len,
                 paged=self.kv_layout == "paged",
-                chunk=self.prefill_chunk or 1)
+                chunk=self.prefill_chunk or 1,
+                quant=self.kv_dtype == "int8")
         self.metrics.set_prefill_chunk(self.prefill_chunk)
+        self.metrics.set_kv_dtype(self.kv_dtype)
         if self.prefill_chunk:
             if self.kv_layout == "paged":
                 # the CoW fork is the only other device op the chunked
@@ -943,9 +979,10 @@ class DecodeEngine:
                     jax.block_until_ready(nxt)
             self._warm = True
             logger.info(
-                "decode[%s]: warm (%d slots, max_len %d, kv %s, decode "
+                "decode[%s]: warm (%d slots, max_len %d, kv %s/%s, decode "
                 "kernels %s, chunked prefill K=%d budget=%s)", self.name,
                 self.num_slots, self.max_len, self.kv_layout,
+                self.kv_dtype,
                 "fused-pallas" if self.decode_kernels else "xla-ref",
                 self.prefill_chunk, self.prefill_chunk_budget or "inf")
             return
@@ -989,9 +1026,10 @@ class DecodeEngine:
                     self.params, self._cache, self._tokens, self._pos)
                 jax.block_until_ready(nxt)
         self._warm = True
-        logger.info("decode[%s]: warm (%d slots, max_len %d, kv %s, "
+        logger.info("decode[%s]: warm (%d slots, max_len %d, kv %s/%s, "
                     "decode kernels %s, prefill buckets %s)", self.name,
                     self.num_slots, self.max_len, self.kv_layout,
+                    self.kv_dtype,
                     "fused-pallas" if self.decode_kernels else "xla-ref",
                     list(self.prefill_buckets))
 
@@ -1033,7 +1071,7 @@ class DecodeEngine:
         if not np.issubdtype(ids.dtype, np.integer):
             raise InvalidRequestError(
                 f"{name} must be integer token ids, got {ids.dtype}")
-        vocab = self.params["src_emb"].shape[0]
+        vocab = _w_shape(self.params["src_emb"])[0]
         if int(ids.min()) < 0 or int(ids.max()) >= vocab:
             raise InvalidRequestError(
                 f"{name} ids must be in [0, {vocab}); got "

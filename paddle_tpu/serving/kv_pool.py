@@ -51,6 +51,25 @@ from paddle_tpu.utils.error import ConfigError
 SCRATCH_BLOCK = 0
 
 
+def slab_equivalent_blocks(num_slots, max_len, block_size,
+                           kv_dtype="float32"):
+    """Auto pool size (``DecodeEngine(kv_num_blocks=0)``) at the SLAB-
+    EQUIVALENT byte budget: an f32 pool gets exactly the slab's
+    ``num_slots * ceil(max_len / block_size)`` blocks (same KV bytes,
+    strictly more packable).  ``kv_dtype="int8"`` DOUBLES the block
+    count inside that same budget: an int8 block plus its f32
+    per-(position, head) scale sidecar costs ``(1/4 + 1/head_dim)`` of
+    the f32 block's bytes (quant/kv.kv_bytes_per_position), i.e. at
+    most half for head_dim >= 4 — so twice the blocks still fit, with
+    headroom that grows with head_dim.  +1 everywhere for the reserved
+    scratch block 0."""
+    per_row = -(-int(max_len) // int(block_size))
+    blocks = int(num_slots) * per_row
+    if kv_dtype == "int8":
+        blocks *= 2
+    return blocks + 1
+
+
 class InsufficientBlocksError(RuntimeError):
     """The pool cannot supply the requested blocks even after evicting
     every prefix-index entry.  Admission defers the request (it is NOT a

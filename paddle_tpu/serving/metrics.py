@@ -98,6 +98,8 @@ class ServingMetrics:
         # copy-on-write counters
         self.kv_blocks_total = 0         # gauge: allocatable pool blocks
         self.kv_blocks_free = 0          # gauge: free-list depth
+        self.kv_dtype = "float32"        # gauge: cache storage dtype
+        #                                  ("int8" = quantized serving)
         self.prefix_cache_hits = 0       # fresh admissions seated from
         #                                  resident prefix blocks
         self.prefix_cache_misses = 0     # fresh admissions that prefilled
@@ -203,6 +205,12 @@ class ServingMetrics:
             self.kv_blocks_free = int(free)
             self.kv_blocks_total = int(total)
 
+    def set_kv_dtype(self, kv_dtype):
+        """Gauge: the engine's KV-cache storage dtype (quantized
+        serving: "int8" -> ``kv_cache_int8 1`` on /metrics)."""
+        with self._lock:
+            self.kv_dtype = str(kv_dtype)
+
     # ---- resilience events (resilience/supervisor.py callers) ----
 
     def observe_retry(self, n=1):
@@ -303,6 +311,7 @@ class ServingMetrics:
                 "evictions": dict(self.evictions),
                 "kv_blocks_total": self.kv_blocks_total,
                 "kv_blocks_free": self.kv_blocks_free,
+                "kv_dtype": self.kv_dtype,
                 "kv_blocks_used": self.kv_blocks_total
                 - self.kv_blocks_free,
                 "kv_block_utilization": round(
@@ -428,6 +437,7 @@ class ServingMetrics:
             slot_count = self.slot_count
             kv_total = self.kv_blocks_total
             kv_free = self.kv_blocks_free
+            kv_int8 = self.kv_dtype == "int8"
             chunk_size = self.prefill_chunk_size
         for metric, value, help_ in gen_counters:
             emit(metric, value, help_, mtype="counter")
@@ -446,6 +456,9 @@ class ServingMetrics:
         emit("kv_block_utilization",
              f"{((kv_total - kv_free) / kv_total if kv_total else 0.0):.6f}",
              "fraction of the paged KV pool in use")
+        emit("kv_cache_int8", int(kv_int8),
+             "1 when the KV cache stores int8 + per-head scale sidecars "
+             "(quantized serving; docs/serving.md)")
         lines.append(f"# HELP {n}_slot_evictions_total decode slots "
                      "evicted, by reason")
         lines.append(f"# TYPE {n}_slot_evictions_total counter")

@@ -69,6 +69,19 @@ CLI (``python -m paddle_tpu.serving``):
                                    bit-identical to the ladder twin,
                                    ONE JSON line (healthy_window.sh
                                    phase 15)
+  --kv-dtype float32|int8          quantized KV cache (int8 + per-head
+                                   scale sidecars; paged auto-sizing
+                                   doubles the block count at equal
+                                   bytes — docs/serving.md "Quantized
+                                   serving")
+  --quant-weights 1                per-channel int8 trunk weights
+                                   (quant/weights.py)
+  --smoke-quant                    quantized-serving self-test: int8-KV
+                                   engine within the committed quality
+                                   budget vs the fp32 twin, int8+weights
+                                   exact vs the quantized oracle,
+                                   kv_blocks_total doubled, ONE JSON
+                                   line (healthy_window.sh phase 16)
 
 The JSON front-end serves plain-array feed slots (dense/index vectors);
 structured SequenceBatch slots are an in-process engine feature.
@@ -557,6 +570,11 @@ def _demo_gen_batcher(args, tiny=False, metrics=None):
                               trg_vocab=1, d_model=32, num_heads=2,
                               dff=64, enc_layers=2, dec_layers=0,
                               max_len=max_len)
+    if getattr(args, "quant_weights", False):
+        # per-channel int8 trunk weights (quant/weights.py): the engine
+        # and every step variant accept the quantized tree directly
+        from paddle_tpu.quant.weights import quantize_lm
+        params = quantize_lm(params)
     engine = DecodeEngine(params, num_heads=2, num_slots=slots,
                           max_len=max_len, prefill_buckets=buckets,
                           name="demo_lm", metrics=metrics,
@@ -564,6 +582,7 @@ def _demo_gen_batcher(args, tiny=False, metrics=None):
                           kv_block_size=args.kv_block_size,
                           kv_num_blocks=args.kv_num_blocks,
                           prefix_cache=args.kv_prefix_cache,
+                          kv_dtype=getattr(args, "kv_dtype", "float32"),
                           prefill_chunk=getattr(args, "prefill_chunk", 0),
                           prefill_chunk_budget=getattr(
                               args, "prefill_chunk_budget", 0))
@@ -1090,6 +1109,136 @@ def _smoke_chunked(args):
     return 0 if passed else 2
 
 
+def _smoke_quant(args):
+    """Quantized-serving self-test (healthy_window.sh phase 16; docs/
+    serving.md "Quantized serving"): the demo LM behind an INT8-KV
+    paged engine (kv_num_blocks auto-DOUBLED at the slab-equivalent
+    byte budget) serving HTTP /v1/generate, its streams compared
+    against a fp32-twin engine under the COMMITTED quality budget
+    (quant/kv.py: every stream's common prefix >= GREEDY_PREFIX_MIN_FULL
+    and at least half the streams token-exact — the demo trunk is a
+    random-init babbler with near-tied logits, so the budget, not
+    bit-identity, is the fp32 contract).  An int8-KV + int8-WEIGHT
+    engine must additionally reproduce the QUANTIZED ``lm_generate``
+    oracle token-EXACTLY — inside one quantization mode greedy decode
+    stays fully deterministic, so the engine/oracle bit-identity
+    discipline carries over unchanged.  /metrics must show
+    ``kv_blocks_total`` exactly DOUBLE the fp32 twin's at equal pool
+    bytes and ``kv_cache_int8 1``.  Prints ONE JSON line; returns the
+    process exit code."""
+    import copy
+    import urllib.request
+
+    from paddle_tpu.quant.kv import (GREEDY_PREFIX_MIN_FULL,
+                                     greedy_prefix_len)
+
+    i8_args = copy.copy(args)
+    i8_args.kv_layout = "paged"
+    i8_args.kv_block_size = min(args.kv_block_size, 8)
+    i8_args.kv_num_blocks = 0           # auto: slab-equivalent bytes
+    i8_args.kv_dtype = "int8"
+    gen = _demo_gen_batcher(i8_args, tiny=True)
+    f32_args = copy.copy(i8_args)
+    f32_args.kv_dtype = "float32"
+    twin = _demo_gen_batcher(f32_args, tiny=True)
+    full_args = copy.copy(i8_args)
+    full_args.quant_weights = True
+    full = _demo_gen_batcher(full_args, tiny=True)
+
+    httpd = make_server(None, port=0, gen_batcher=gen)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.port}"
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, rng.randint(3, 15)).tolist()
+               for _ in range(6)]
+    n_tok = 10
+    errs = []
+
+    def post(body):
+        req = urllib.request.Request(
+            f"{base}/v1/generate", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    def hit(i, out):
+        try:
+            time.sleep(0.005 * i)       # staggered: slots churn
+            out[i] = post({"prompt": prompts[i],
+                           "max_tokens": n_tok})["tokens"]
+        except Exception as e:    # noqa: BLE001 — a probe failure must
+            errs.append(f"client {i}: {type(e).__name__}: {e}")
+
+    results = [None] * len(prompts)
+    threads = [threading.Thread(target=hit, args=(i, results))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    ok = sum(1 for r in results if r is not None)
+
+    within_budget = exact = full_exact = 0
+    try:
+        from paddle_tpu.models import transformer
+        for i, p in enumerate(prompts):
+            want = twin.submit(np.asarray(p, np.int64),
+                               max_tokens=n_tok).result(120)["tokens"]
+            pre = greedy_prefix_len(results[i], want)
+            within_budget += int(pre >= min(GREEDY_PREFIX_MIN_FULL,
+                                            n_tok))
+            exact += int(results[i] == want)
+            # full-quant engine vs the QUANTIZED lm_generate oracle:
+            # token-exact (bit-identity inside the int8 mode)
+            fgot = full.submit(np.asarray(p, np.int64),
+                               max_tokens=n_tok).result(120)["tokens"]
+            arr = np.asarray(p, np.int32)[None]
+            oracle = np.asarray(transformer.lm_generate(
+                full.engine.params, arr, arr.size + n_tok, num_heads=2,
+                kv_dtype="int8"))[0, arr.size:].tolist()
+            full_exact += int(fgot == oracle)
+    except Exception as e:    # noqa: BLE001
+        errs.append(f"twin: {type(e).__name__}: {e}")
+
+    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+        metrics_text = r.read().decode()
+    snap = gen.metrics.snapshot()
+    twin_blocks = twin.engine._paged.pool.num_allocatable
+    name = gen.metrics.name
+    metrics_sane = (
+        f"{name}_kv_blocks_total {snap['kv_blocks_total']}"
+        in metrics_text
+        and f"{name}_kv_cache_int8 1" in metrics_text
+        and snap["kv_dtype"] == "int8")
+    blocks_doubled = snap["kv_blocks_total"] == 2 * twin_blocks
+    out = {
+        "metric": "quantized serving smoke (int8 KV + int8 weights vs "
+                  "fp32 twin)",
+        "value": ok, "unit": f"requests_ok/{len(prompts)}",
+        "vs_baseline": None,
+        "within_budget": within_budget,
+        "token_exact": exact,
+        "full_quant_oracle_exact": full_exact,
+        "kv_blocks_total": snap["kv_blocks_total"],
+        "f32_twin_blocks": twin_blocks,
+        "kv_blocks_doubled": bool(blocks_doubled),
+        "kv_dtype": snap["kv_dtype"],
+        "metrics_sane": bool(metrics_sane),
+    }
+    if errs:
+        out["errors"] = errs[:5]
+    httpd.shutdown()
+    gen.close()
+    twin.close()
+    full.close()
+    print(json.dumps(out), flush=True)
+    passed = (ok == len(prompts) and blocks_doubled and metrics_sane
+              and within_budget == len(prompts)
+              and full_exact == len(prompts)
+              and exact * 2 >= len(prompts))
+    return 0 if passed else 2
+
+
 def _write_port_file(path, port):
     """Publish the BOUND port (meaningful with --port 0) atomically —
     the fleet supervisor (serving/fleet.py) spawns replicas on ephemeral
@@ -1139,6 +1288,19 @@ def main(argv=None):
     ap.add_argument("--kv-prefix-cache",
                     type=lambda v: v.lower() in ("1", "true", "yes"),
                     default=FLAGS.serving_kv_prefix_cache)
+    # ---- quantized serving (quant/; docs/serving.md) ----
+    ap.add_argument("--kv-dtype", default=FLAGS.serving_kv_dtype,
+                    choices=("float32", "int8"),
+                    help="KV-cache storage dtype: int8 stores quantized "
+                         "K/V + per-head scale sidecars (halved+ KV "
+                         "bytes; paged auto-sizing doubles the block "
+                         "count at the same byte budget)")
+    ap.add_argument("--quant-weights",
+                    type=lambda v: v.lower() in ("1", "true", "yes"),
+                    default=FLAGS.quant_weights,
+                    help="serve per-channel int8 trunk weights "
+                         "(quant/weights.py): int8 data + f32 scales "
+                         "resident, dequant fused into each matmul")
     ap.add_argument("--pallas-decode", default=FLAGS.pallas_decode,
                     help="fused decode-attention kernels for the decode "
                          "step: auto (TPU only) | always (interpret "
@@ -1197,6 +1359,12 @@ def main(argv=None):
                          "unified step while in-flight streams keep "
                          "emitting, every stream bit-identical to the "
                          "legacy-ladder twin; one JSON line, exit")
+    ap.add_argument("--smoke-quant", action="store_true",
+                    help="quantized-serving self-test: int8-KV paged "
+                         "engine vs a fp32 twin within the committed "
+                         "quality budget, int8-KV+weights engine exact "
+                         "vs the quantized oracle, kv_blocks_total "
+                         "doubled at equal bytes; one JSON line, exit")
     # ---- resilience (docs/serving.md §6) ----
     ap.add_argument("--drain-timeout-s", type=float,
                     default=FLAGS.serving_drain_timeout_s,
@@ -1249,6 +1417,8 @@ def main(argv=None):
         return _smoke_decode_fused(args)
     if args.smoke_chunked:
         return _smoke_chunked(args)
+    if args.smoke_quant:
+        return _smoke_quant(args)
     if args.demo_generate and not (args.artifact or args.artifacts
                                    or args.demo):
         # generation-only server: no /v1/infer batcher

@@ -328,6 +328,155 @@ def _decode_paged_chunk_case(tol=1e-4):
     return err
 
 
+def _quantize_kv(arr, hkv, seed):
+    """Random f32 K/V quantized to (int8, per-(position, head) scales)
+    — the int8 smoke cases' shared input builder (quant/kv.py math)."""
+    from paddle_tpu.quant import kv as kvq
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(*arr) * 0.5, jnp.float32)
+    return kvq.quantize_heads(x, hkv)
+
+
+def _decode_slab_int8_case(tol=1e-4):
+    """Int8-KV slab decode kernel (scale-sidecar operands, in-register
+    dequant) vs the dequantize-then-attend oracle — the quantized twin
+    of ``_decode_slab_case``, GQA width included.  Note the compiled
+    backend wants 32-sublane int8 tiles: t is a multiple of 32."""
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import decode_attention as dk
+    from paddle_tpu.quant import kv as kvq
+
+    errs = []
+    # GQA width only: the per-group scale panels are the subtle surface
+    # (the full-width case shares every code path with hkv=2)
+    for h, hkv, dh, s, t in ((8, 2, 128, 16, 256),):
+        d, dkv = h * dh, hkv * dh
+        rng = np.random.RandomState(h * 10 + hkv + 1)
+        q = jnp.asarray(rng.randn(s, d) * 0.5, jnp.float32)
+        qk, sk = _quantize_kv((s, t, dkv), hkv, seed=h + hkv)
+        qv, sv = _quantize_kv((s, t, dkv), hkv, seed=h + hkv + 1)
+        pos = jnp.asarray(rng.randint(0, t, s), jnp.int32)
+        with dk.forced_mode("always"):
+            out = jax.jit(lambda q, k, v, ks, vs, pos: dk.maybe_slab(
+                q, k, v, pos, h, kscale=ks, vscale=vs))(
+                    q, qk, qv, sk, sv, pos)
+        assert out is not None, "int8 slab kernel declined a supported shape"
+        pm = jnp.arange(t)[None, :] <= pos[:, None]
+        want = transformer._attend(
+            q[:, None], kvq.dequantize_heads(qk, sk),
+            kvq.dequantize_heads(qv, sv), h,
+            jnp.broadcast_to(pm, (s, t)))[:, 0]
+        errs.append(_max_err(out, want))
+    err = max(errs)
+    assert err <= tol, f"decode_slab_int8 max err {err:.3e} > tol {tol}"
+    return err
+
+
+def _decode_paged_int8_case(tol=1e-4):
+    """Int8-KV paged decode kernel: the scale-sidecar pools ride the
+    same block-table-walked DMA stream as the int8 K/V pools."""
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import decode_attention as dk
+    from paddle_tpu.quant import kv as kvq
+
+    h, hkv, dh, s, bs, nb_row = 8, 2, 128, 16, 32, 4
+    d, dkv = h * dh, hkv * dh
+    nb = s * nb_row + 1
+    t = nb_row * bs
+    rng = np.random.RandomState(21)
+    q = jnp.asarray(rng.randn(s, d) * 0.5, jnp.float32)
+    qk, sk = _quantize_kv((nb, bs, dkv), hkv, seed=3)
+    qv, sv = _quantize_kv((nb, bs, dkv), hkv, seed=4)
+    pos = np.asarray(rng.randint(0, t, s), np.int32)
+    tables = build_private_tables(pos, nb_row, bs, nb)
+    with dk.forced_mode("always"):
+        out = jax.jit(lambda q, k, v, ks, vs, pos, tbl: dk.maybe_paged(
+            q, k, v, pos, tbl, h, kscale=ks, vscale=vs))(
+                q, qk, qv, sk, sv, jnp.asarray(pos),
+                jnp.asarray(tables))
+    assert out is not None, "int8 paged kernel declined a supported shape"
+    kf = kvq.dequantize_heads(qk, sk)
+    vf = kvq.dequantize_heads(qv, sv)
+    k_rows = kf[jnp.asarray(tables)].reshape(s, -1, dkv)
+    v_rows = vf[jnp.asarray(tables)].reshape(s, -1, dkv)
+    pm = jnp.asarray(np.arange(t)[None, :] <= pos[:, None])
+    want = transformer._attend(q[:, None], k_rows, v_rows, h, pm)[:, 0]
+    err = _max_err(out, want)
+    assert err <= tol, f"decode_paged_int8 max err {err:.3e} > tol {tol}"
+    return err
+
+
+def _decode_slab_chunk_int8_case(tol=1e-4):
+    """Int8-KV Tq=chunk slab kernel: every lane shares each streamed
+    int8 block's in-register dequant panels."""
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import decode_attention as dk
+    from paddle_tpu.quant import kv as kvq
+
+    h, hkv, dh, s, t, kk = 8, 2, 128, 8, 256, 8
+    d, dkv = h * dh, hkv * dh
+    rng = np.random.RandomState(31)
+    q = jnp.asarray(rng.randn(s, kk, d) * 0.5, jnp.float32)
+    qk, sk = _quantize_kv((s, t, dkv), hkv, seed=5)
+    qv, sv = _quantize_kv((s, t, dkv), hkv, seed=6)
+    pos = rng.randint(0, t - kk, s).astype(np.int32)
+    lens = rng.randint(1, kk + 1, s).astype(np.int32)
+    lens[0], lens[-1] = 1, kk       # pin both extremes
+    qpos = _chunk_lanes_ref(pos, lens, kk)
+    with dk.forced_mode("always"):
+        out = jax.jit(lambda q, k, v, ks, vs, qp: dk.maybe_slab_chunk(
+            q, k, v, qp, h, kscale=ks, vscale=vs))(
+                q, qk, qv, sk, sv, jnp.asarray(qpos))
+    assert out is not None, \
+        "int8 slab chunk kernel declined a supported shape"
+    pm = jnp.asarray(np.arange(t)[None, None, :] <= qpos[:, :, None])
+    want = transformer._attend(q, kvq.dequantize_heads(qk, sk),
+                               kvq.dequantize_heads(qv, sv), h, pm)
+    err = _max_err(out, want)
+    assert err <= tol, \
+        f"decode_slab_chunk_int8 max err {err:.3e} > tol {tol}"
+    return err
+
+
+def _decode_paged_chunk_int8_case(tol=1e-4):
+    """Int8-KV Tq=chunk paged kernel — the full quantized unified-step
+    attention surface."""
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import decode_attention as dk
+    from paddle_tpu.quant import kv as kvq
+
+    h, hkv, dh, s, bs, nb_row, kk = 8, 2, 128, 8, 32, 4, 8
+    d, dkv = h * dh, hkv * dh
+    nb = s * nb_row + 1
+    t = nb_row * bs
+    rng = np.random.RandomState(41)
+    q = jnp.asarray(rng.randn(s, kk, d) * 0.5, jnp.float32)
+    qk, sk = _quantize_kv((nb, bs, dkv), hkv, seed=7)
+    qv, sv = _quantize_kv((nb, bs, dkv), hkv, seed=8)
+    pos = rng.randint(0, t - kk, s).astype(np.int32)
+    lens = rng.randint(1, kk + 1, s).astype(np.int32)
+    qpos = _chunk_lanes_ref(pos, lens, kk)
+    tables = build_private_tables(qpos[:, -1], nb_row, bs, nb)
+    with dk.forced_mode("always"):
+        out = jax.jit(
+            lambda q, k, v, ks, vs, qp, tbl: dk.maybe_paged_chunk(
+                q, k, v, qp, tbl, h, kscale=ks, vscale=vs))(
+                    q, qk, qv, sk, sv, jnp.asarray(qpos),
+                    jnp.asarray(tables))
+    assert out is not None, \
+        "int8 paged chunk kernel declined a supported shape"
+    kf = kvq.dequantize_heads(qk, sk)
+    vf = kvq.dequantize_heads(qv, sv)
+    k_rows = kf[jnp.asarray(tables)].reshape(s, -1, dkv)
+    v_rows = vf[jnp.asarray(tables)].reshape(s, -1, dkv)
+    pm = jnp.asarray(np.arange(t)[None, None, :] <= qpos[:, :, None])
+    want = transformer._attend(q, k_rows, v_rows, h, pm)
+    err = _max_err(out, want)
+    assert err <= tol, \
+        f"decode_paged_chunk_int8 max err {err:.3e} > tol {tol}"
+    return err
+
+
 CASES = {
     "lstm_fused": lambda: _rnn_case("lstm"),
     "lstm_blocked": _lstm_blocked_case,
@@ -339,4 +488,8 @@ CASES = {
     "decode_attention_paged": _decode_paged_case,
     "decode_attention_slab_chunk": _decode_slab_chunk_case,
     "decode_attention_paged_chunk": _decode_paged_chunk_case,
+    "decode_attention_slab_int8": _decode_slab_int8_case,
+    "decode_attention_paged_int8": _decode_paged_int8_case,
+    "decode_attention_slab_chunk_int8": _decode_slab_chunk_int8_case,
+    "decode_attention_paged_chunk_int8": _decode_paged_chunk_int8_case,
 }

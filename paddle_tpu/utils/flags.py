@@ -105,6 +105,15 @@ class Flags:
     #                                     (0 = slab-equivalent bytes)
     serving_kv_prefix_cache: bool = True  # share resident prompt-prefix
     #                                       blocks across requests
+    # ---- quantized serving (paddle_tpu/quant/: int8 weights + int8 KV
+    # cache with in-register dequant in the fused decode kernels;
+    # docs/serving.md "Quantized serving")
+    serving_kv_dtype: str = "float32"   # "float32" | "int8" (quantized
+    #                                     KV + per-head scale sidecars;
+    #                                     paged auto-sizing doubles the
+    #                                     block count at equal bytes)
+    quant_weights: bool = False         # serve per-channel int8 trunk
+    #                                     weights (quant/weights.py)
     # ---- unified chunked prefill (decode_engine.py prefill_chunk:
     # prompt ingestion folded into the ONE jitted decode step as K-lane
     # chunks; docs/serving.md "Chunked prefill").  The serving CLI
@@ -369,6 +378,16 @@ FLAG_DOCS = {
     "serving_kv_prefix_cache": ("share resident prompt-prefix blocks "
                                 "across requests (copy-on-write on "
                                 "divergence)", "—"),
+    "serving_kv_dtype": ("decode KV-cache storage dtype: float32, or "
+                         "int8 (quantized K/V + per-(position, head) "
+                         "f32 scale sidecars, dequantized in-register "
+                         "by the fused decode kernels; the paged "
+                         "auto-sizing doubles kv_num_blocks at the "
+                         "slab-equivalent byte budget)", "—"),
+    "quant_weights": ("serve per-channel symmetric int8 trunk weights "
+                      "(quant/weights.py): int8 data + f32 scale "
+                      "sidecars are what stays resident; dequant fuses "
+                      "into each consuming matmul's operand read", "—"),
     "serving_prefill_chunk": ("unified chunked prefill: prompt "
                               "ingestion rides the ONE jitted decode "
                               "step as up-to-K-token chunks per slot "

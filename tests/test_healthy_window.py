@@ -50,7 +50,8 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "fleet_smoke.json", "paged_smoke.json",
                  "trace_smoke.json", "trace_chrome.json",
                  "decode_fused_smoke.json", "autoscale_smoke.json",
-                 "chunked_smoke.json", "WINDOW_DONE"):
+                 "chunked_smoke.json", "quant_smoke.json",
+                 "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -160,6 +161,17 @@ def test_dryrun_executes_every_phase(tmp_path):
     assert chk["interleaved_tokens"] >= 1, chk
     assert chk["prefill_chunks_total"] >= 2, chk
     assert chk["prefill_chunk_lanes_total"] >= 15, chk
+    # the quant smoke really quantized: every int8-KV stream inside the
+    # committed quality budget vs the fp32 twin, the int8-KV+weights
+    # engine token-exact vs the quantized lm_generate oracle, and the
+    # int8 pool holding exactly DOUBLE the twin's blocks at equal bytes
+    qsm = json.loads((art / "quant_smoke.json").read_text())
+    assert qsm["value"] == int(qsm["unit"].split("/")[1]), qsm
+    assert qsm["within_budget"] == qsm["value"], qsm
+    assert qsm["full_quant_oracle_exact"] == qsm["value"], qsm
+    assert qsm["kv_blocks_doubled"] is True, qsm
+    assert qsm["kv_blocks_total"] == 2 * qsm["f32_twin_blocks"], qsm
+    assert qsm["kv_dtype"] == "int8" and qsm["metrics_sane"] is True, qsm
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows
