@@ -1,0 +1,166 @@
+"""CLI gate: ``python -m paddle_tpu.analysis --check all --json``.
+
+Exit codes: 0 = clean (every finding baselined or none), 1 = new
+findings (or stale baseline entries under --strict), 2 = usage /
+internal error (unknown check, unreadable baseline).
+
+The healthy-window playbook runs this as phase 17 and fails the window
+on rc != 0; tests/test_analysis.py runs the same entry in-process
+(reverse gates against analysis/fixtures/, clean-tree gate on HEAD).
+
+Fixture/reverse-gate plumbing: ``--root mod:qualname`` replaces the
+registered jit roots (all params data), ``--lock-paths`` replaces the
+lock pass's scan set, ``--no-baseline`` ignores the committed
+allow-list — so one seeded-violation module can prove every rule fires.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from paddle_tpu.analysis import baseline as baseline_mod
+from paddle_tpu.analysis import callgraph, locks, purity, retrace
+from paddle_tpu.analysis.roots import Root, all_roots
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(
+    _REPO, "paddle_tpu", "analysis", "baseline.json")
+
+CHECKS = ("all", "jit", "retrace", "locks")
+
+
+def run_checks(check="all", roots=None, lock_paths=None, repo=_REPO,
+               extra_paths=(), package="paddle_tpu"):
+    """-> (project, [Finding]) — the in-process API the tests use."""
+    project = callgraph.Project(repo, package=package,
+                                extra_paths=extra_paths)
+    roots = list(roots) if roots is not None else all_roots()
+    findings = []
+    if check in ("all", "jit"):
+        findings += purity.run(project, roots)
+    if check in ("all", "retrace"):
+        findings += retrace.run(project, roots)
+    if check in ("all", "locks"):
+        findings += locks.run(project, lock_paths or locks.DEFAULT_SCAN)
+    findings.sort(key=lambda f: (f.check, f.rule, f.path, f.line, f.key))
+    return project, findings
+
+
+def main(argv=None):
+    try:
+        from paddle_tpu.utils.flags import FLAGS
+        flag_baseline = getattr(FLAGS, "analysis_baseline", None)
+        flag_strict = bool(getattr(FLAGS, "analysis_strict", False))
+    except Exception:   # noqa: BLE001 — the gate must not need the runtime
+        flag_baseline, flag_strict = None, False
+
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="static invariant analyzer (docs/analysis.md): "
+                    "jit-purity, retrace-hazard and lock-order passes")
+    ap.add_argument("--check", default="all", choices=CHECKS)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=flag_baseline or DEFAULT_BASELINE,
+                    help="allow-list path (default: the committed "
+                         "paddle_tpu/analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the allow-list (fixture/reverse gates)")
+    ap.add_argument("--strict", action="store_true", default=flag_strict,
+                    help="stale baseline entries fail the gate too")
+    ap.add_argument("--root", action="append", default=None,
+                    metavar="MOD:QUALNAME",
+                    help="replace the registered jit roots (repeatable; "
+                         "every param is data)")
+    ap.add_argument("--lock-paths", nargs="+", default=None,
+                    metavar="PATH",
+                    help="replace the lock pass scan set (repo-relative)")
+    ap.add_argument("--scan-package", default="paddle_tpu",
+                    metavar="DIR",
+                    help="restrict the AST scan to this repo-relative "
+                         "subtree (fixture gates keep the fast test "
+                         "lane lean; the real gate scans the default)")
+    ap.add_argument("--write-baseline", metavar="PATH", default=None,
+                    help="write every CURRENT finding as a baseline to "
+                         "PATH (reasons stubbed 'TODO: justify') and "
+                         "exit 0 — a bootstrapping aid, never the gate")
+    args = ap.parse_args(argv)
+
+    roots = None
+    if args.root:
+        bad = [r for r in args.root if ":" not in r]
+        if bad:
+            print(f"[analysis] --root needs MOD:QUALNAME, got {bad}",
+                  file=sys.stderr)
+            return 2
+        roots = [Root(name=r.split(":", 1)[1], ref=r) for r in args.root]
+    # fixture refs live under paddle_tpu/, already scanned; --lock-paths
+    # outside the package (none today) would need extra_paths
+    project, findings = run_checks(check=args.check, roots=roots,
+                                   lock_paths=args.lock_paths,
+                                   package=args.scan_package)
+
+    if args.write_baseline:
+        entries = {f.key: "TODO: justify" for f in findings}
+        baseline_mod.dump(args.write_baseline, entries)
+        print(f"wrote {len(entries)} entries to {args.write_baseline}",
+              file=sys.stderr)
+        return 0
+
+    stale = []
+    if args.no_baseline:
+        new = list(findings)
+    else:
+        try:
+            bl = (baseline_mod.load(args.baseline)
+                  if os.path.exists(args.baseline) else {})
+        except (ValueError, OSError) as e:
+            print(f"[analysis] unusable baseline: {e}", file=sys.stderr)
+            return 2
+        # staleness is judged only against the checks that RAN: a
+        # single-pass invocation must not flag the other passes'
+        # still-valid entries as stale (nor fail them under --strict)
+        scope = (("jit", "retrace", "locks") if args.check == "all"
+                 else (args.check,))
+        bl = {k: v for k, v in bl.items()
+              if k.split(":", 1)[0] in scope}
+        new, stale = baseline_mod.apply(findings, bl)
+
+    if args.json:
+        counts = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print(json.dumps({
+            "schema": 1,
+            "kind": "paddle_tpu static-analysis report",
+            "check": args.check,
+            "findings": [f.to_json() for f in findings],
+            "counts": counts,
+            "new": len(new),
+            "baselined": len(findings) - len(new),
+            "stale_baseline_keys": stale,
+            "roots": [r.ref for r in (roots or all_roots())],
+        }, indent=1, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.render())
+        if stale:
+            print("[analysis] stale baseline entries (violation no "
+                  "longer exists — delete them):", file=sys.stderr)
+            for k in stale:
+                print(f"    {k}", file=sys.stderr)
+        print(f"[analysis] check={args.check}: {len(findings)} "
+              f"finding(s), {len(new)} new, "
+              f"{len(findings) - len(new)} baselined, "
+              f"{len(stale)} stale baseline entr(ies)", file=sys.stderr)
+    if new:
+        return 1
+    if stale and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

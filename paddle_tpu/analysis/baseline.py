@@ -1,0 +1,111 @@
+"""Findings + the committed allow-list (baseline) format.
+
+A finding's ``key`` is STABLE across unrelated edits — it names the
+rule, the function (module:qualname), and the offending detail (callee
+dotted name / flag name / lock pair / attribute), never a line number.
+The committed baseline (`paddle_tpu/analysis/baseline.json`) is a list
+of ``{"key": ..., "reason": ...}`` entries: intentional, justified
+exceptions.  An empty reason is rejected — a baseline entry without a
+WHY is just a suppressed bug.
+
+Semantics at gate time (``python -m paddle_tpu.analysis``):
+
+* finding with a matching baseline entry  -> reported as baselined,
+  does NOT fail the gate;
+* finding without an entry               -> fails the gate (rc 1);
+* entry matching no finding (stale)      -> warned; fails only under
+  ``--strict`` (the entry documents a violation that no longer exists
+  and should be deleted).
+"""
+
+import dataclasses
+import json
+
+
+SCHEMA = 1
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation.
+
+    ``check``: jit | retrace | locks; ``rule``: the specific rule id
+    (docs/analysis.md's inventory); ``key``: stable baseline key;
+    ``path``/``line``: where to look; ``func``: module:qualname;
+    ``message``: human sentence; ``chain``: how the analyzer got there
+    (root -> ... -> offender), empty for non-reachability rules.
+    """
+    check: str
+    rule: str
+    key: str
+    path: str
+    line: int
+    func: str
+    message: str
+    chain: tuple = ()
+    baselined: bool = False
+    reason: str = ""
+
+    def to_json(self):
+        d = dataclasses.asdict(self)
+        d["chain"] = list(self.chain)
+        return d
+
+    def render(self):
+        tag = f" [baselined: {self.reason}]" if self.baselined else ""
+        out = (f"{self.path}:{self.line}: [{self.check}:{self.rule}] "
+               f"{self.message}{tag}\n    key: {self.key}")
+        if self.chain:
+            out += "\n    via: " + " -> ".join(self.chain)
+        return out
+
+
+def load(path):
+    """Parse a baseline file -> {key: reason}.  Raises ValueError on a
+    malformed file (the git gate test asserts the committed one parses)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("schema") != SCHEMA:
+        raise ValueError(f"{path}: not a schema-{SCHEMA} analysis baseline")
+    entries = doc.get("entries")
+    if not isinstance(entries, list):
+        raise ValueError(f"{path}: 'entries' must be a list")
+    out = {}
+    for e in entries:
+        key, reason = e.get("key"), e.get("reason", "")
+        if not key or not str(reason).strip():
+            raise ValueError(
+                f"{path}: every entry needs a key AND a non-empty reason "
+                f"(offender: {e!r})")
+        if key in out:
+            raise ValueError(f"{path}: duplicate baseline key {key!r}")
+        out[key] = str(reason)
+    return out
+
+
+def dump(path, entries):
+    """Write {key: reason} as a committed-friendly baseline file."""
+    doc = {
+        "schema": SCHEMA,
+        "kind": "paddle_tpu static-analysis allow-list (docs/analysis.md)",
+        "entries": [{"key": k, "reason": entries[k]}
+                    for k in sorted(entries)],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def apply(findings, baseline):
+    """Mark baselined findings in place; return (new, stale_keys)."""
+    matched = set()
+    new = []
+    for f in findings:
+        if f.key in baseline:
+            f.baselined, f.reason = True, baseline[f.key]
+            matched.add(f.key)
+        else:
+            new.append(f)
+    stale = sorted(set(baseline) - matched)
+    return new, stale

@@ -1,0 +1,533 @@
+"""AST project index + best-effort static name/call resolution.
+
+Parses every ``.py`` under the package root ONCE (no imports are
+executed, jax is never touched) and answers the questions the three
+passes ask:
+
+* what function does this ``ast.Call`` target?  (``resolve_call``)
+* what dotted name does this expression denote?  (``resolve_dotted``)
+* which class attribute / module global is a ``threading.Lock``?
+* what project class does ``self._x`` hold?  (constructor-assignment
+  type inference: ``self._x = SomeClass(...)`` in ``__init__``)
+
+Resolution is deliberately OPTIMISTIC: a call the index cannot resolve
+(callbacks, dynamic dispatch, foreign objects) is skipped, never
+guessed — the passes built on top prefer missing an edge to inventing
+one, the same trade every practical linter makes.  What IS resolvable
+statically — module imports, local defs, ``self.method``, constructor-
+typed attributes, closure scopes — covers the hot paths the invariants
+live on.
+
+Qualnames follow Python's own convention: ``C.m`` for methods,
+``f.<locals>.g`` for closures.  Several functions may share a qualname
+(e.g. the four ``DecodeEngine.__init__.<locals>._step_fn`` layout
+variants); the index keeps ALL of them and reachability walks visit
+every variant.
+"""
+
+import ast
+import os
+
+
+LOCK_FACTORIES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+}
+
+
+def walk_scope(node):
+    """Yield every AST node in ``node``'s own scope: descends through
+    statements and lambdas but NOT into nested FunctionDef/ClassDef
+    bodies (those are separate scopes, indexed as their own entities)."""
+    stack = [node]
+    first = True
+    while stack:
+        n = stack.pop()
+        if not first and isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.ClassDef)):
+            continue
+        first = False
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class FuncInfo:
+    def __init__(self, module, qualname, node, cls=None, parent=None):
+        self.module = module
+        self.qualname = qualname
+        self.node = node
+        self.cls = cls              # enclosing ClassInfo for methods
+        self.parent = parent        # enclosing FuncInfo for closures
+        self.children = []          # nested FuncInfos
+        self._locals = None
+
+    @property
+    def key(self):
+        return f"{self.module.name}:{self.qualname}"
+
+    @property
+    def dotted(self):
+        return f"{self.module.name}.{self.qualname}"
+
+    @property
+    def path(self):
+        return self.module.relpath
+
+    @property
+    def line(self):
+        return self.node.lineno
+
+    def params(self):
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    # ---- scope-local bindings: imports, defs, simple aliases ----------
+
+    def local_bindings(self):
+        if self._locals is None:
+            by_name = {}
+            for c in self.children:
+                by_name.setdefault(c.node.name, []).append(c)
+            self._locals = _collect_bindings(self.module, self.node.body,
+                                             local_funcs=by_name)
+        return self._locals
+
+
+class ClassInfo:
+    def __init__(self, module, qualname, node):
+        self.module = module
+        self.qualname = qualname
+        self.node = node
+        self.methods = {}       # name -> [FuncInfo]
+        self.lock_attrs = {}    # attr -> "lock" | "rlock" | "condition"
+        self.attr_types = {}    # attr -> ClassInfo (constructor-typed)
+        self.base_exprs = list(node.bases)
+
+    @property
+    def key(self):
+        return f"{self.module.name}.{self.qualname}"
+
+
+class Module:
+    def __init__(self, name, path, relpath, tree):
+        self.name = name
+        self.path = path
+        self.relpath = relpath
+        self.tree = tree
+        self.funcs = {}         # qualname -> [FuncInfo]
+        self.classes = {}       # qualname -> ClassInfo
+        self.bindings = {}      # module-level name -> Binding tuple
+        self.lock_globals = {}  # global name -> lock kind
+
+
+def _bind_import(bindings, module, node):
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            if a.asname:
+                bindings[a.asname] = ("module", a.name)
+            else:
+                bindings[a.name.split(".")[0]] = \
+                    ("module", a.name.split(".")[0])
+    elif isinstance(node, ast.ImportFrom):
+        base = node.module or ""
+        if node.level:
+            pkg = module.name
+            if not module.path.endswith("__init__.py"):
+                pkg = pkg.rsplit(".", 1)[0] if "." in pkg else ""
+            for _ in range(node.level - 1):
+                pkg = pkg.rsplit(".", 1)[0] if "." in pkg else ""
+            base = f"{pkg}.{base}" if base else pkg
+        for a in node.names:
+            if a.name == "*":
+                continue
+            target = f"{base}.{a.name}" if base else a.name
+            bindings[a.asname or a.name] = ("dotted", target)
+
+
+def _collect_bindings(module, body, local_funcs=None):
+    """Name bindings visible in a statement list (one scope level):
+    imports anywhere in the scope's statements, local function defs,
+    and simple ``x = y`` / ``x = a if c else b`` function aliases."""
+    bindings = {}
+    local_funcs = local_funcs or {}
+
+    def visit(stmts):
+        for st in stmts:
+            if isinstance(st, (ast.Import, ast.ImportFrom)):
+                _bind_import(bindings, module, st)
+                continue
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                infos = local_funcs.get(st.name)
+                if infos:
+                    bindings[st.name] = ("func", list(infos))
+                continue                 # separate scope: don't descend
+            if isinstance(st, ast.ClassDef):
+                continue
+            if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                name = st.targets[0].id
+                cands = _alias_candidates(st.value, bindings, local_funcs)
+                if cands:
+                    bindings[name] = ("func", cands)
+            for field in ("body", "orelse", "finalbody"):
+                visit(getattr(st, field, None) or [])
+            for h in getattr(st, "handlers", None) or []:
+                visit(h.body)
+    visit(body)
+    return bindings
+
+
+def _alias_candidates(value, bindings, local_funcs):
+    """Function aliases: ``x = f``, ``x = f if c else g``."""
+    if isinstance(value, ast.Name):
+        b = bindings.get(value.id)
+        if b and b[0] == "func":
+            return list(b[1])
+        return list(local_funcs.get(value.id, []))
+    if isinstance(value, ast.IfExp):
+        return (_alias_candidates(value.body, bindings, local_funcs)
+                + _alias_candidates(value.orelse, bindings, local_funcs))
+    return []
+
+
+def _ctor_exprs(value):
+    """Call expressions that may produce the assigned value:
+    ``C(...)``, ``x or C(...)``, ``C(...) if cond else D(...)``."""
+    if isinstance(value, ast.Call):
+        return [value]
+    if isinstance(value, ast.BoolOp):
+        return [c for v in value.values for c in _ctor_exprs(v)]
+    if isinstance(value, ast.IfExp):
+        return _ctor_exprs(value.body) + _ctor_exprs(value.orelse)
+    return []
+
+
+class Project:
+    """The parsed tree.  ``root`` is the repository root; ``package``
+    the import root scanned (default paddle_tpu).  ``extra_paths`` adds
+    loose files/dirs (fixture scans) outside the package."""
+
+    def __init__(self, root, package="paddle_tpu", extra_paths=()):
+        self.root = os.path.abspath(root)
+        self.modules = {}
+        pkg_dir = os.path.join(self.root, package)
+        paths = []
+        if os.path.isdir(pkg_dir):
+            for dirpath, dirnames, filenames in os.walk(pkg_dir):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__",)]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        paths.append(os.path.join(dirpath, fn))
+        for p in extra_paths:
+            p = os.path.join(self.root, p)
+            if os.path.isdir(p):
+                for dirpath, _dn, filenames in os.walk(p):
+                    paths.extend(os.path.join(dirpath, fn)
+                                 for fn in sorted(filenames)
+                                 if fn.endswith(".py"))
+            elif os.path.isfile(p):
+                paths.append(p)
+        for path in paths:
+            self._load(path)
+        for m in self.modules.values():
+            self._infer_class_attrs(m)
+
+    # ------------------------------------------------------------- loading
+
+    def _module_name(self, path):
+        rel = os.path.relpath(path, self.root)
+        parts = rel[:-3].replace(os.sep, ".")
+        if parts.endswith(".__init__"):
+            parts = parts[:-len(".__init__")]
+        return parts
+
+    def _load(self, path):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError:
+            return
+        name = self._module_name(path)
+        if name in self.modules:
+            return
+        mod = Module(name, path, os.path.relpath(path, self.root), tree)
+        self.modules[name] = mod
+        self._index(mod, tree.body, prefix="", cls=None, parent=None,
+                    toplevel=True)
+
+    def _index(self, mod, body, prefix, cls, parent, toplevel=False):
+        for st in body:
+            if isinstance(st, (ast.Import, ast.ImportFrom)) and toplevel:
+                _bind_import(mod.bindings, mod, st)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{st.name}"
+                fi = FuncInfo(mod, qual, st, cls=cls, parent=parent)
+                mod.funcs.setdefault(qual, []).append(fi)
+                if parent is not None:
+                    parent.children.append(fi)
+                if cls is not None and parent is None:
+                    cls.methods.setdefault(st.name, []).append(fi)
+                if toplevel:
+                    mod.bindings.setdefault(st.name, ("func", []))
+                    if mod.bindings[st.name][0] == "func":
+                        mod.bindings[st.name][1].append(fi)
+                self._index(mod, st.body, prefix=f"{qual}.<locals>.",
+                            cls=None, parent=fi)
+            elif isinstance(st, ast.ClassDef):
+                qual = f"{prefix}{st.name}"
+                ci = ClassInfo(mod, qual, st)
+                mod.classes[qual] = ci
+                if toplevel:
+                    mod.bindings[st.name] = ("class", ci)
+                self._index(mod, st.body, prefix=f"{qual}.", cls=ci,
+                            parent=parent)
+            elif isinstance(st, ast.Assign) and toplevel \
+                    and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                name = st.targets[0].id
+                kind = self._lock_kind(mod, st.value)
+                if kind:
+                    mod.lock_globals[name] = kind
+                mod.bindings.setdefault(
+                    name, ("dotted", f"{mod.name}.{name}"))
+            else:
+                # defs nested in if/try/for/while/with bodies (e.g. the
+                # DecodeEngine layout-variant _step_fn closures) belong
+                # to the SAME scope
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(st, field, None)
+                    if sub and isinstance(sub, list):
+                        self._index(mod, sub, prefix, cls, parent,
+                                    toplevel=toplevel)
+                for h in getattr(st, "handlers", None) or []:
+                    self._index(mod, h.body, prefix, cls, parent,
+                                toplevel=toplevel)
+
+    def _lock_kind(self, mod, value, func=None):
+        if not isinstance(value, ast.Call):
+            return None
+        dotted = self.resolve_dotted(mod, value.func, func=func)
+        return LOCK_FACTORIES.get(dotted)
+
+    def _infer_class_attrs(self, mod):
+        for ci in mod.classes.values():
+            for infos in ci.methods.values():
+                for fi in infos:
+                    for n in walk_scope(fi.node):
+                        if not (isinstance(n, ast.Assign)
+                                and len(n.targets) == 1):
+                            continue
+                        t = n.targets[0]
+                        if not (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            continue
+                        kind = self._lock_kind(mod, n.value, func=fi)
+                        if kind:
+                            ci.lock_attrs[t.attr] = kind
+                            continue
+                        # constructor typing, incl. the `x or C(...)` /
+                        # ternary defaulting idioms
+                        for cand in _ctor_exprs(n.value):
+                            target = self.resolve_class(
+                                mod, cand.func, func=fi)
+                            if target is not None:
+                                ci.attr_types.setdefault(t.attr, target)
+                                break
+
+    # ---------------------------------------------------------- resolution
+
+    def _binding(self, mod, name, func):
+        f = func
+        while f is not None:
+            b = f.local_bindings().get(name)
+            if b is not None:
+                return b
+            f = f.parent
+        return mod.bindings.get(name)
+
+    def resolve_dotted(self, mod, expr, func=None):
+        """Expression -> dotted name ("time.sleep",
+        "paddle_tpu.resilience.faults.hook") or None."""
+        attrs = []
+        while isinstance(expr, ast.Attribute):
+            attrs.append(expr.attr)
+            expr = expr.value
+        if not isinstance(expr, ast.Name):
+            return None
+        attrs.reverse()
+        b = self._binding(mod, expr.id, func)
+        if b is None:
+            return None
+        kind, val = b[0], b[1]
+        if kind == "module":
+            return ".".join([val] + attrs) if attrs else val
+        if kind == "dotted":
+            return ".".join([val] + attrs)
+        if kind == "class" and attrs:
+            return ".".join([val.key] + attrs)
+        if kind == "func" and not attrs and val:
+            return val[0].dotted
+        return None
+
+    def dotted_function(self, dotted):
+        """Project FuncInfos for a dotted name, or []."""
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = self.modules.get(".".join(parts[:i]))
+            if mod is not None:
+                qual = ".".join(parts[i:])
+                infos = mod.funcs.get(qual)
+                if infos:
+                    return infos
+                ci = mod.classes.get(qual)
+                if ci is not None:
+                    return ci.methods.get("__init__", [])
+                return []
+        return []
+
+    def dotted_class(self, dotted):
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = self.modules.get(".".join(parts[:i]))
+            if mod is not None:
+                return mod.classes.get(".".join(parts[i:]))
+        return None
+
+    def resolve_class(self, mod, expr, func=None):
+        """Expression (in a constructor-call position) -> ClassInfo."""
+        if isinstance(expr, ast.Name):
+            b = self._binding(mod, expr.id, func)
+            if b and b[0] == "class":
+                return b[1]
+        dotted = self.resolve_dotted(mod, expr, func=func)
+        return self.dotted_class(dotted) if dotted else None
+
+    def attr_chain_class(self, ci, attrs):
+        """Walk ``self.a.b`` constructor-typed attributes: ClassInfo of
+        the object at the end of the chain (the chain may be empty)."""
+        for a in attrs:
+            if ci is None:
+                return None
+            ci = ci.attr_types.get(a)
+        return ci
+
+    def class_method(self, ci, name, _seen=None):
+        """Method lookup incl. project base classes."""
+        _seen = _seen or set()
+        if ci is None or ci.key in _seen:
+            return []
+        _seen.add(ci.key)
+        infos = ci.methods.get(name)
+        if infos:
+            return infos
+        for b in ci.base_exprs:
+            base = self.resolve_class(ci.module, b)
+            got = self.class_method(base, name, _seen)
+            if got:
+                return got
+        return []
+
+    def local_var_class(self, func, name):
+        """Class of a local constructed in the same scope:
+        ``x = SomeClass(...)``."""
+        for n in walk_scope(func.node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and n.targets[0].id == name \
+                    and isinstance(n.value, ast.Call):
+                ci = self.resolve_class(func.module, n.value.func,
+                                        func=func)
+                if ci is not None:
+                    return ci
+        return None
+
+    def resolve_call(self, func, call):
+        """(dotted_name_or_None, [FuncInfo] targets) for a Call seen
+        inside ``func``.  Either element may be empty — the dotted name
+        serves prefix checks (purity) even when the body is external."""
+        target = call.func
+        mod = func.module
+        # self.method() / self.attr.method()
+        if isinstance(target, ast.Attribute):
+            chain = []
+            base = target
+            while isinstance(base, ast.Attribute):
+                chain.append(base.attr)
+                base = base.value
+            chain.reverse()
+            if isinstance(base, ast.Name) and base.id == "self" \
+                    and func.cls is not None:
+                owner = self.attr_chain_class(func.cls, chain[:-1])
+                if owner is not None:
+                    infos = self.class_method(owner, chain[-1])
+                    return (f"{owner.key}.{chain[-1]}", infos)
+                return (None, [])
+            if isinstance(base, ast.Name):
+                ci = self.local_var_class(func, base.id)
+                owner = self.attr_chain_class(ci, chain[:-1]) \
+                    if ci is not None else None
+                if owner is not None:
+                    infos = self.class_method(owner, chain[-1])
+                    return (f"{owner.key}.{chain[-1]}", infos)
+        if isinstance(target, ast.Name):
+            b = self._binding(mod, target.id, func)
+            if b is not None:
+                kind, val = b[0], b[1]
+                if kind == "func":
+                    return (val[0].dotted if val else None, list(val))
+                if kind == "class":
+                    return (val.key, val.methods.get("__init__", []))
+                if kind == "dotted":
+                    return (val, self.dotted_function(val))
+                if kind == "module":
+                    return (val, [])
+            return (None, [])
+        dotted = self.resolve_dotted(mod, target, func=func)
+        if dotted is not None:
+            return (dotted, self.dotted_function(dotted))
+        return (None, [])
+
+    def function(self, ref):
+        """``"dotted.module:qualname"`` -> [FuncInfo] (all qualname
+        sharers), or []."""
+        modname, _, qual = ref.partition(":")
+        mod = self.modules.get(modname)
+        if mod is None:
+            return []
+        return list(mod.funcs.get(qual, []))
+
+    def flags_reads(self, func):
+        """(flag_name_or_None, lineno) for every FLAGS attribute read /
+        getattr(FLAGS, ...) in ``func``'s scope.  None = dynamic."""
+        out = []
+        for n in walk_scope(func.node):
+            if isinstance(n, ast.Attribute) \
+                    and isinstance(n.value, ast.Name) \
+                    and isinstance(n.ctx, ast.Load):
+                b = self._binding(func.module, n.value.id, func)
+                if b and b[0] == "dotted" \
+                        and b[1] == "paddle_tpu.utils.flags.FLAGS":
+                    out.append((n.attr, n.lineno))
+            elif isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id == "getattr" and n.args:
+                b = None
+                if isinstance(n.args[0], ast.Name):
+                    b = self._binding(func.module, n.args[0].id, func)
+                if b and b[0] == "dotted" \
+                        and b[1] == "paddle_tpu.utils.flags.FLAGS":
+                    name = None
+                    if len(n.args) > 1 and isinstance(n.args[1],
+                                                      ast.Constant):
+                        name = n.args[1].value
+                    out.append((name, n.lineno))
+        return out

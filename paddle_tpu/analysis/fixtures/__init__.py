@@ -1,0 +1,13 @@
+"""Seeded-violation fixtures: the analyzer's reverse gates.
+
+Each module here contains KNOWN violations — one per rule — that
+tests/test_analysis.py proves the analyzer catches (non-zero exit,
+every seeded rule id present).  A gate that cannot fail is no gate:
+this mirrors the perf/analytic.py discipline where every structural
+detector is also run against a twin that must TRIP it.
+
+These modules are PARSED by the analyzer, never imported by runtime
+code, and live outside the lock pass's default scan set — the
+violations are invisible to the real gate unless a test points the
+analyzer at them (``--root`` / ``--lock-paths``).
+"""

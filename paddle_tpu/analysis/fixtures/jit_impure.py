@@ -1,0 +1,60 @@
+"""jit-purity reverse-gate fixture: every forbidden namespace, reached
+from one fake "jitted step" root (``bad_step``) — some directly, one
+through a helper to prove the call-graph walk is transitive.
+
+NEVER import this from runtime code; the analyzer only parses it.
+Run the gate against it with:
+
+    python -m paddle_tpu.analysis --check jit --no-baseline \
+        --root paddle_tpu.analysis.fixtures.jit_impure:bad_step
+"""
+
+import random
+import threading
+import time
+
+from paddle_tpu.obs import trace as _obs_trace
+from paddle_tpu.resilience import faults as _faults
+from paddle_tpu.serving import metrics as _metrics
+from paddle_tpu.utils import logging as _logging
+from paddle_tpu.utils.flags import FLAGS
+
+
+def bad_step(params, tokens):
+    """One seeded violation per jit-purity rule."""
+    t0 = time.perf_counter()                 # V: time.*
+    jitter = random.random()                 # V: random.*
+    tid = threading.get_ident()              # V: threading.*
+    _faults.hit("fixture.step")              # V: resilience.faults
+    _metrics.ServingMetrics()                # V: serving.metrics
+    _obs_trace.enable()                      # V: obs.*
+    _logging.get_logger("fixture")           # V: utils.logging
+    slots = FLAGS.serving_gen_slots          # V: non-trace-time FLAGS read
+    return _impure_helper(params, tokens), (t0, jitter, tid, slots)
+
+
+def _impure_helper(params, tokens):
+    """Transitive reach: the violation sits one call away from the
+    root — a walk that only checks the root body misses it."""
+    time.sleep(0)                            # V: time.* (transitive)
+    return tokens
+
+
+def clean_step(params, tokens):
+    """The control: fully pure — the jit pass must report NOTHING when
+    rooted here (tests pin both directions)."""
+    return tokens
+
+
+# --- regression: qualname-sharing variants (review finding) -----------
+# Like DecodeEngine's four layout _step_fn closures, both defs below
+# share ONE qualname; the violation lives only in the SECOND, so a
+# visited-set keyed on qualname alone would silently skip it.
+
+if bool(int("0")):                           # parsed, branch irrelevant
+    def variant_step(params, tokens):
+        return tokens
+else:
+    def variant_step(params, tokens):
+        time.sleep(0)                        # V: only in variant #2
+        return tokens

@@ -1,0 +1,118 @@
+"""lock-order reverse-gate fixture: a seeded ordering cycle, a seeded
+self-deadlock, and a seeded mixed-guard mutation.
+
+The wiring below (mutually-constructing classes) is nonsense at
+runtime — it exists to be PARSED: the analyzer's constructor-typed
+attribute inference resolves ``self._peer.poke()`` to the class whose
+lock it takes.  The lock pass only sees this file when a test passes
+``--lock-paths paddle_tpu/analysis/fixtures/lock_disorder.py``.
+"""
+
+import threading
+
+
+class LockA:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._peer = LockB()
+
+    def forward(self):
+        with self._lock:                    # holds A...
+            self._peer.poke()               # ...acquires B: edge A -> B
+
+
+class LockB:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._back = LockA()                # parsed, never run
+
+    def poke(self):
+        with self._lock:
+            pass
+
+    def reverse(self):
+        with self._lock:                    # holds B...
+            self._back.forward()            # ...acquires A: edge B -> A
+            # V: lock-order-cycle {LockA._lock, LockB._lock}
+
+
+class Reacquirer:
+    def __init__(self):
+        self._lock = threading.Lock()       # NOT an RLock
+
+    def outer(self):
+        with self._lock:
+            self.inner()                    # V: lock-reacquire
+
+    def inner(self):
+        with self._lock:
+            pass
+
+
+class MixedGuard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def locked_inc(self):
+        with self._lock:
+            self.count += 1                 # guarded...
+
+    def racy_inc(self):
+        self.count += 1                     # V: lock-mixed-guard
+
+    def _bump_locked(self):
+        self.count += 1                     # *_locked convention: guarded
+
+
+# --- regression: an acquisition hidden behind a CALL CYCLE -------------
+# A naive closure memo caches the partial result computed while an
+# ancestor is on the recursion stack (the a<->b cycle), permanently
+# hiding _la from every later caller — the driver below forces that
+# poisoned-order computation first, and the H->X ordering cycle through
+# the hidden edge must STILL be reported (review finding, fixed in
+# locks.py: only outermost closure frames are memoized).
+
+class CycleInner:
+    def __init__(self):
+        self._la = threading.Lock()
+
+    def a(self):
+        with self._la:
+            pass
+        self.b()                            # a -> b
+
+    def b(self):
+        self.a()                            # b -> a: the back edge
+
+
+class CycleDriverEarly:
+    def __init__(self):
+        self._ld = threading.Lock()
+        self._inner = CycleInner()
+
+    def d(self):
+        with self._ld:
+            self._inner.a()                 # forces closure(a) FIRST —
+            #                                 the memo-poisoning order
+
+
+class CycleHolderH:
+    def __init__(self):
+        self._lh = threading.Lock()
+        self._inner = CycleInner()
+
+    def h(self):
+        with self._lh:
+            self._inner.b()                 # _lh -> _la THROUGH the cycle
+
+
+class CycleHolderX:
+    def __init__(self):
+        self._inner = CycleInner()
+        self._hold = CycleHolderH()
+
+    def x(self):
+        with self._inner._la:               # _la -> _lh: closes the
+            with self._hold._lh:            # V: lock-order-cycle
+                pass

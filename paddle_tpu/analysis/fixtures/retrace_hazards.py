@@ -1,0 +1,69 @@
+"""retrace-hazard reverse-gate fixture: one seeded violation per rule,
+in a fake jitted step whose every parameter is data (the --root CLI
+path treats all params as data).
+
+    python -m paddle_tpu.analysis --check retrace --no-baseline \
+        --root paddle_tpu.analysis.fixtures.retrace_hazards:hazard_step
+
+``branchy_step``/``masked_step`` double as the RUNTIME confirmation
+pair (tests/test_analysis.py): the statically-flagged shape of
+``branchy_step`` really does retrace per value when the varying input
+rides as a static arg, while ``masked_step`` — same computation, the
+variation fed as data — warms up in one trace and never retraces
+(testing/trace.forbid_retrace pins both).
+"""
+
+
+def hazard_step(params, tokens, positions, lengths):
+    acc = tokens
+    if positions[0] > 0:                     # V: retrace-data-branch (if)
+        acc = acc + 1
+    while lengths[0]:                        # V: retrace-data-branch (while)
+        break
+    n = int(tokens[0])                       # V: retrace-host-sync (int)
+    p = positions.item()                     # V: retrace-host-sync (.item)
+    key = f"bucket_{positions[0]}"           # V: retrace-shape-key
+    for b in {8, 16, 32}:                    # V: retrace-unordered-iter
+        acc = acc * 1
+    if tokens[1] in (0, 1):                  # V: data-branch — a tainted
+        acc = acc + 1                        # MEMBER is a value compare,
+        #                                      not a structure probe
+    return _hazard_helper(params, acc), (n, p, key)
+
+
+def _hazard_helper(params, x):
+    """Transitive taint: ``x`` arrives tainted from the root — the
+    branch here must be found through the call graph."""
+    if x[0] == 0:                            # V: data-branch (transitive)
+        return x
+    return x + 1
+
+
+def clean_step(params, tokens, positions, lengths):
+    """The control: variation handled as data / laundered statically —
+    the retrace pass must report NOTHING when rooted here."""
+    t = tokens.shape[0]                      # .shape launders
+    if t > 1:                                # static branch: fine
+        tokens = tokens + 0
+    if positions is None:                    # identity test launders
+        return tokens
+    if "ks" in params:                       # CONTAINER-side membership:
+        pass                                 # pytree structure is static
+    return tokens * (positions >= 0)         # masked, not branched
+
+
+# --- runtime-confirmation pair (see module docstring) -----------------
+
+def branchy_step(x, n):
+    """``n`` should be data; branching on it forces it static -> one
+    compiled program PER VALUE.  The static pass flags the ``if``; the
+    runtime test proves the retrace with jit(static_argnums=(1,))."""
+    if n > 0:                                # V: retrace-data-branch
+        return x * 2.0
+    return x
+
+
+def masked_step(x, keep):
+    """The fixed twin: the same choice fed as a data mask — one trace,
+    zero retraces across every value of ``keep``."""
+    return x * 2.0 * keep + x * (1.0 - keep)

@@ -1,0 +1,412 @@
+"""lock-order pass: the threaded serving tier stays deadlock-free.
+
+Scans the threaded modules (serving/, resilience/, data/prefetch.py by
+default) for lock ACQUISITIONS — ``with self._lock:`` on a class attr
+assigned ``threading.Lock()``/``RLock()``/``Condition()`` in
+``__init__``, ``with _global_lock:`` on a module-global, and the same
+through constructor-typed attributes (``self._fleet._lock``) — and
+builds the inter-lock ORDERING graph: an edge A -> B means some thread
+may acquire B while holding A, either lexically (a nested ``with``) or
+through a call made under A whose transitive callees acquire B
+(callgraph.py resolution; unresolvable calls are skipped).
+
+Rules (docs/analysis.md):
+  lock-order-cycle   a cycle in the ordering graph — two threads taking
+                     the locks in opposite orders can deadlock (the
+                     PR 12 remove_replica bug class)
+  lock-reacquire     a non-reentrant lock re-acquired while already
+                     held on the same path — self-deadlock (RLocks and
+                     the ``*_locked``-suffix callee convention are
+                     exempt by construction: ``*_locked`` helpers don't
+                     acquire, they document an already-held lock)
+  lock-mixed-guard   a class attribute mutated both UNDER one of the
+                     class's locks and OUTSIDE any of them (``__init__``
+                     and ``*_locked`` helpers count as guarded) — the
+                     exact bug class PR 12's remove_replica hardening
+                     fixed by hand
+
+Lock identity is ``module.Class.attr`` for instance locks (two classes'
+``_lock`` attrs are DIFFERENT locks) and ``module.name`` for globals.
+"""
+
+import ast
+import os
+
+from paddle_tpu.analysis import callgraph
+from paddle_tpu.analysis.baseline import Finding
+
+DEFAULT_SCAN = ("paddle_tpu/serving", "paddle_tpu/resilience",
+                "paddle_tpu/data/prefetch.py")
+
+
+class LockRef:
+    def __init__(self, key, kind, display):
+        self.key = key          # stable identity
+        self.kind = kind        # "lock" | "rlock" | "condition"
+        self.display = display
+
+    @property
+    def reentrant(self):
+        return self.kind == "rlock"
+
+
+def _resolve_lock(project, fi, expr):
+    """A with-item context expression -> LockRef, or None when it is
+    not (recognizably) a lock."""
+    mod = fi.module
+    # bare name: module-global lock, or a local assigned threading.Lock()
+    if isinstance(expr, ast.Name):
+        kind = mod.lock_globals.get(expr.id)
+        if kind:
+            return LockRef(f"{mod.name}.{expr.id}", kind,
+                           f"{mod.name}.{expr.id}")
+        for n in callgraph.walk_scope(fi.node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and n.targets[0].id == expr.id:
+                kind = project._lock_kind(mod, n.value, func=fi)
+                if kind:
+                    return LockRef(
+                        f"{mod.name}:{fi.qualname}.{expr.id}", kind,
+                        f"{fi.qualname}'s local {expr.id}")
+        return None
+    if not isinstance(expr, ast.Attribute):
+        return None
+    chain = []
+    base = expr
+    while isinstance(base, ast.Attribute):
+        chain.append(base.attr)
+        base = base.value
+    chain.reverse()
+    if not isinstance(base, ast.Name):
+        return None
+    if base.id == "self" and fi.cls is not None:
+        owner = project.attr_chain_class(fi.cls, chain[:-1])
+        if owner is not None:
+            kind = owner.lock_attrs.get(chain[-1])
+            if kind:
+                key = f"{owner.key}.{chain[-1]}"
+                return LockRef(key, kind, key)
+        return None
+    ci = project.local_var_class(fi, base.id)
+    owner = project.attr_chain_class(ci, chain[:-1]) \
+        if ci is not None else None
+    if owner is not None:
+        kind = owner.lock_attrs.get(chain[-1])
+        if kind:
+            key = f"{owner.key}.{chain[-1]}"
+            return LockRef(key, kind, key)
+    return None
+
+
+def _scan_function(project, fi):
+    """Per-function lock facts:
+      acquires:   [(LockRef, lineno, held_keys_tuple)]
+      calls_held: [(held LockRef, call node, lineno)]
+    plus, for the mixed-guard rule, self-attribute mutations with the
+    set of held instance locks at the site."""
+    acquires, calls_held, mutations = [], [], []
+
+    def visit(stmts, held):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue       # separate scope/thread entry: not "held"
+            if isinstance(st, ast.With):
+                new_held = list(held)
+                for item in st.items:
+                    ref = _resolve_lock(project, fi, item.context_expr)
+                    if ref is not None:
+                        acquires.append(
+                            (ref, item.context_expr.lineno,
+                             tuple(h.key for h in new_held)))
+                        new_held = new_held + [ref]
+                visit(st.body, new_held)
+                continue
+            # calls made while holding something
+            for n in _scope_exprs(st):
+                if isinstance(n, ast.Call) and held:
+                    calls_held.append((list(held), n))
+            # self-attribute mutations
+            for tgt, aug in _mutation_targets(st):
+                attr = _self_attr(tgt)
+                if attr is not None:
+                    mutations.append((attr, st.lineno,
+                                      tuple(h.key for h in held)))
+            # recurse into compound statements
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(st, field, None)
+                if sub:
+                    visit(sub, held)
+            for h in getattr(st, "handlers", None) or []:
+                visit(h.body, held)
+
+    visit(fi.node.body, [])
+    return acquires, calls_held, mutations
+
+
+def _scope_exprs(st):
+    """Expression nodes of one statement, not descending into nested
+    statement bodies (those are visited with their own held-set) nor
+    nested def/class scopes."""
+    skip_fields = {"body", "orelse", "finalbody", "handlers"}
+    out = []
+    stack = [(st, True)]
+    while stack:
+        node, is_root = stack.pop()
+        if not is_root:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            out.append(node)
+        for field, value in ast.iter_fields(node):
+            if is_root and field in skip_fields:
+                continue
+            if isinstance(value, list):
+                stack.extend((v, False) for v in value
+                             if isinstance(v, ast.AST))
+            elif isinstance(value, ast.AST):
+                stack.append((value, False))
+    return out
+
+
+def _mutation_targets(st):
+    if isinstance(st, ast.Assign):
+        return [(t, False) for t in st.targets]
+    if isinstance(st, ast.AugAssign):
+        return [(st.target, True)]
+    if isinstance(st, ast.AnnAssign) and st.value is not None:
+        return [(st.target, False)]
+    return []
+
+
+def _self_attr(tgt):
+    """``self.X = ...`` or ``self.X[k] = ...`` -> "X" (the attribute
+    whose value/contents mutate)."""
+    if isinstance(tgt, ast.Subscript):
+        tgt = tgt.value
+    if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) \
+            and tgt.value.id == "self":
+        return tgt.attr
+    return None
+
+
+def run(project, scan_paths=DEFAULT_SCAN):
+    """-> [Finding] for the lock-order pass over modules under
+    ``scan_paths`` (repo-relative files or directories)."""
+    scan_paths = tuple(os.path.normpath(p) for p in scan_paths)
+
+    def in_scope(mod):
+        rel = os.path.normpath(mod.relpath)
+        return any(rel == p or rel.startswith(p + os.sep)
+                   for p in scan_paths)
+
+    mods = [m for m in project.modules.values() if in_scope(m)]
+    funcs = [fi for m in mods for infos in m.funcs.values()
+             for fi in infos]
+
+    facts = {}                  # id(fi) -> (acquires, calls_held, muts)
+    for fi in funcs:
+        facts[id(fi)] = _scan_function(project, fi)
+
+    # ---- transitive "locks acquired by calling f" closure -------------
+    direct = {}                 # id(fi) -> {lock key -> LockRef}
+    for fi in funcs:
+        direct[id(fi)] = {ref.key: ref
+                          for ref, _ln, _held in facts[id(fi)][0]}
+
+    def closure(fi, _stack=None):
+        if _stack is None:
+            _stack = set()
+        if id(fi) in _stack:
+            return {}                # cycle back-edge: ancestor's frame
+            #                          already unions its own locks
+        got = closure_memo.get(id(fi))
+        if got is not None:
+            return got
+        _stack.add(id(fi))
+        acc = dict(direct.get(id(fi), {}))
+        for n in callgraph.walk_scope(fi.node):
+            if isinstance(n, ast.Call):
+                _dotted, targets = project.resolve_call(fi, n)
+                for t in targets:
+                    if id(t) in facts:       # stay inside the scan set
+                        acc.update(closure(t, _stack))
+        _stack.discard(id(fi))
+        # memoize ONLY the outermost frame: a result computed while an
+        # ancestor sits on the recursion stack is PARTIAL (its pruned
+        # back-edges omit the ancestor's locks) — caching it would
+        # permanently hide lock acquisitions behind any call cycle
+        # (verified: a deadlock routed through a mutual-recursion pair
+        # went unreported with the naive memo)
+        if not _stack:
+            closure_memo[id(fi)] = acc
+        return acc
+
+    closure_memo = {}
+
+    # ---- ordering edges ----------------------------------------------
+    # edge (A, B) -> list of (path, line, how) provenance
+    edges = {}
+    refs = {}
+    for fi in funcs:                   # every acquired lock, with kind
+        for ref, _ln, _held in facts[id(fi)][0]:
+            refs.setdefault(ref.key, ref)
+
+    def add_edge(a, b, fi, line, how):
+        refs.setdefault(a.key, a)
+        refs.setdefault(b.key, b)
+        edges.setdefault((a.key, b.key), []).append(
+            (fi.path, line, how))
+
+    findings = []
+    for fi in funcs:
+        acquires, calls_held, _muts = facts[id(fi)]
+        for ref, line, held_keys in acquires:
+            for hk in held_keys:
+                add_edge(refs.get(hk) or LockRef(hk, "lock", hk), ref,
+                         fi, line, f"nested with in {fi.qualname}")
+        for held, call in calls_held:
+            _dotted, targets = project.resolve_call(fi, call)
+            for t in targets:
+                if id(t) not in facts:
+                    continue
+                for ref in closure(t).values():
+                    for h in held:
+                        add_edge(h, ref, fi, call.lineno,
+                                 f"{fi.qualname} calls {t.qualname} "
+                                 f"holding {h.display}")
+
+    # ---- rule: self-reacquire ----------------------------------------
+    for (a, b), prov in sorted(edges.items()):
+        if a != b:
+            continue
+        ref = refs[a]
+        if ref.reentrant:
+            continue
+        path, line, how = prov[0]
+        key = f"locks:lock-reacquire:{a}"
+        findings.append(Finding(
+            check="locks", rule="lock-reacquire", key=key, path=path,
+            line=line, func=how.split(" calls ")[0],
+            message=f"non-reentrant lock {ref.display} may be acquired "
+                    f"again while already held ({how}) — self-deadlock",
+        ))
+
+    # ---- rule: cycles (Tarjan SCC over the edge graph) ---------------
+    graph = {}
+    for (a, b) in edges:
+        if a != b:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+    for scc in _sccs(graph):
+        if len(scc) < 2:
+            continue
+        cyc = sorted(scc)
+        prov = []
+        for (a, b), pv in sorted(edges.items()):
+            if a in scc and b in scc and a != b:
+                p = pv[0]
+                prov.append(f"{refs[a].display} -> {refs[b].display} "
+                            f"({p[0]}:{p[1]}: {p[2]})")
+        path, line = "", 0
+        for (a, b), pv in sorted(edges.items()):
+            if a in scc and b in scc and a != b:
+                path, line = pv[0][0], pv[0][1]
+                break
+        key = "locks:lock-order-cycle:" + "<->".join(cyc)
+        findings.append(Finding(
+            check="locks", rule="lock-order-cycle", key=key, path=path,
+            line=line, func=cyc[0],
+            message="lock-ordering cycle among {" + ", ".join(cyc)
+                    + "} — threads taking these in different orders can "
+                    "deadlock", chain=tuple(prov)))
+
+    # ---- rule: mixed-guard mutations ---------------------------------
+    by_class = {}
+    for fi in funcs:
+        if fi.cls is None or not fi.cls.lock_attrs:
+            continue
+        method = fi.qualname.split(".")[-1] if fi.parent is None else None
+        if method in (None, "__init__", "__new__", "__del__"):
+            continue
+        locked_by_convention = method.endswith("_locked")
+        for attr, line, held_keys in facts[id(fi)][2]:
+            if attr in fi.cls.lock_attrs:
+                continue             # rebinding the lock itself
+            own_held = any(hk.startswith(fi.cls.key + ".")
+                           for hk in held_keys)
+            rec = by_class.setdefault((fi.cls, attr),
+                                      {"locked": [], "unlocked": []})
+            if own_held or locked_by_convention:
+                rec["locked"].append((fi, line))
+            else:
+                rec["unlocked"].append((fi, line))
+    for (ci, attr), rec in sorted(by_class.items(),
+                                  key=lambda kv: (kv[0][0].key, kv[0][1])):
+        if not rec["locked"] or not rec["unlocked"]:
+            continue
+        fi, line = rec["unlocked"][0]
+        lcount, ucount = len(rec["locked"]), len(rec["unlocked"])
+        where = ", ".join(sorted({f.qualname for f, _l
+                                  in rec["unlocked"]}))
+        key = f"locks:lock-mixed-guard:{ci.key}.{attr}"
+        findings.append(Finding(
+            check="locks", rule="lock-mixed-guard", key=key, path=fi.path,
+            line=line, func=fi.key,
+            message=f"self.{attr} is mutated {lcount}x under "
+                    f"{ci.qualname}'s lock but {ucount}x with no lock "
+                    f"held ({where}) — guard every mutation or document "
+                    "why the unguarded site is single-threaded"))
+    return findings
+
+
+def _sccs(graph):
+    """Tarjan strongly-connected components, iterative."""
+    index = {}
+    low = {}
+    on_stack = set()
+    stack = []
+    out = []
+    counter = [0]
+
+    def strongconnect(v0):
+        work = [(v0, iter(sorted(graph.get(v0, ()))))]
+        index[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        on_stack.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.add(w)
+                    if w == v:
+                        break
+                out.append(comp)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return out

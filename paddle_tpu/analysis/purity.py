@@ -1,0 +1,150 @@
+"""jit-purity pass: nothing host-side is reachable from a jitted step.
+
+Walks the call graph from every registered root (analysis/roots.py)
+and flags any reachable call whose resolved dotted target lands in a
+FORBIDDEN namespace — the host-side subsystems the repo's discipline
+keeps OUT of jit bodies (PR 6 "never inside a jit body", PR 9's
+host-side-only tracer) — plus any FLAGS read that is not on the
+documented trace-time allow-list (roots.py TRACE_TIME_FLAGS).
+
+Nested defs and lambdas of a reachable function are themselves
+reachable (scan bodies, tree_map lambdas — they run under the trace).
+Resolution is optimistic (callgraph.py): an unresolvable call is
+skipped, so the pass can under-report but never invents an edge; the
+reverse-gate fixtures prove it catches every rule it claims to.
+
+Rules (docs/analysis.md):
+  jit-forbidden-call   reachable call into obs/, resilience/faults,
+                       serving/metrics, utils/logging, time, random,
+                       threading
+  jit-flags-read       reachable FLAGS read off the trace-time
+                       allow-list (or a dynamic getattr(FLAGS, expr))
+"""
+
+from paddle_tpu.analysis import callgraph
+from paddle_tpu.analysis.baseline import Finding
+from paddle_tpu.analysis.roots import TRACE_TIME_FLAGS
+
+import ast
+
+# namespace -> why it may never run under a trace
+FORBIDDEN = [
+    ("paddle_tpu.obs", "host-side tracing (obs/) is host-only by design"),
+    ("paddle_tpu.resilience.faults",
+     "fault hooks are compiled into HOST hot paths only (PR 6)"),
+    ("paddle_tpu.serving.metrics",
+     "metrics mutate host state under a lock — a trace would bake one "
+     "observation in and sync the device"),
+    ("paddle_tpu.utils.logging", "logging is host I/O"),
+    ("time", "wall clocks read at trace time are frozen into the trace"),
+    ("random", "stdlib RNG is untraceable host state (use jax.random)"),
+    ("threading", "thread primitives cannot exist inside a jit body"),
+]
+
+
+def _forbidden(dotted):
+    if dotted is None:
+        return None
+    for ns, why in FORBIDDEN:
+        if dotted == ns or dotted.startswith(ns + "."):
+            return ns, why
+    return None
+
+
+def _uid(fi):
+    """Visit identity: qualname ALONE would merge the qualname-sharing
+    variants (e.g. the four DecodeEngine ``_step_fn`` layout closures)
+    and silently skip all but the first — the line disambiguates."""
+    return (fi.module.name, fi.qualname, fi.line)
+
+
+def _chain(parents, func):
+    k = _uid(func)
+    seen_keys = []
+    while k is not None:
+        seen_keys.append(f"{k[0]}:{k[1]}")
+        k = parents.get(k)
+    return tuple(reversed(seen_keys))
+
+
+def run(project, roots):
+    """-> [Finding].  ``roots`` is an iterable of roots.Root (or any
+    object with ``.ref``); every qualname sharer of a ref is walked."""
+    findings = []
+    seen = {}          # _uid -> FuncInfo (visited)
+    parents = {}       # _uid -> parent _uid (shortest via BFS)
+    queue = []
+    missing = []
+    for r in roots:
+        infos = project.function(r.ref)
+        if not infos:
+            missing.append(r.ref)
+        for fi in infos:
+            if _uid(fi) not in seen:
+                seen[_uid(fi)] = fi
+                parents[_uid(fi)] = None
+                queue.append(fi)
+    for ref in missing:
+        findings.append(Finding(
+            check="jit", rule="jit-root-missing",
+            key=f"jit:jit-root-missing:{ref}",
+            path="paddle_tpu/analysis/roots.py", line=1, func=ref,
+            message=f"registered jit root {ref!r} does not resolve in "
+                    "the AST index — the registry drifted from the code"))
+
+    reported = set()
+    while queue:
+        fi = queue.pop(0)
+
+        # nested defs/lambda-enclosing scopes run under the trace too
+        for child in fi.children:
+            if _uid(child) not in seen:
+                seen[_uid(child)] = child
+                parents[_uid(child)] = _uid(fi)
+                queue.append(child)
+
+        for node in callgraph.walk_scope(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted, targets = project.resolve_call(fi, node)
+            hit = _forbidden(dotted)
+            if hit is not None:
+                ns, why = hit
+                key = f"jit:jit-forbidden-call:{fi.module.name}:" \
+                      f"{fi.qualname}:{dotted}"
+                if key not in reported:
+                    reported.add(key)
+                    findings.append(Finding(
+                        check="jit", rule="jit-forbidden-call", key=key,
+                        path=fi.path, line=node.lineno, func=fi.key,
+                        message=f"call to {dotted} is reachable from a "
+                                f"jitted step — {why}",
+                        chain=_chain(parents, fi)))
+                continue
+            for t in targets:
+                # stay inside the scanned project; foreign bodies are
+                # opaque (their dotted name was already prefix-checked)
+                if _uid(t) not in seen:
+                    seen[_uid(t)] = t
+                    parents[_uid(t)] = _uid(fi)
+                    queue.append(t)
+
+        for flag, lineno in project.flags_reads(fi):
+            if flag in TRACE_TIME_FLAGS:
+                continue
+            detail = flag if flag is not None else "<dynamic>"
+            key = f"jit:jit-flags-read:{fi.module.name}:" \
+                  f"{fi.qualname}:{detail}"
+            if key in reported:
+                continue
+            reported.add(key)
+            what = (f"FLAGS.{flag}" if flag is not None
+                    else "a dynamic getattr(FLAGS, ...)")
+            findings.append(Finding(
+                check="jit", rule="jit-flags-read", key=key,
+                path=fi.path, line=lineno, func=fi.key,
+                message=f"{what} is read on a jit-reachable path but is "
+                        "not on the documented trace-time allow-list "
+                        "(analysis/roots.py TRACE_TIME_FLAGS)",
+                chain=_chain(parents, fi)))
+    return findings

@@ -1,0 +1,402 @@
+"""retrace-hazard pass: every serving/training step is 1-trace/0-retrace.
+
+Inside the jitted roots (analysis/roots.py) all variation must be DATA.
+This pass taints each root's data arguments (everything not declared
+``static_args``) and propagates forward through assignments, subscripts
+and attribute access; trace-static constructs LAUNDER the taint
+(``.shape``/``.dtype``/``.ndim``, ``len()``, ``isinstance()``,
+``is``/``is not``, ``in``/``not in`` — pytree STRUCTURE is static even
+when leaf values are tracers).  Call results are untainted (optimistic,
+like callgraph resolution), but calls into project functions propagate
+the taint INTO the callee's matching parameters, so a hazard buried two
+helpers deep under a data argument is still found.
+
+Rules (docs/analysis.md):
+  retrace-data-branch    ``if``/``while``/ternary/``assert`` on a
+                         tainted value — Python control flow on a
+                         tracer either crashes or bakes one branch in
+                         (and shape-dependent variants retrace per
+                         value)
+  retrace-host-sync      ``.item()``/``.tolist()`` anywhere, or
+                         ``int()``/``float()``/``bool()``/
+                         ``np.asarray()`` on a tainted value — a
+                         device sync inside the traced body
+  retrace-unordered-iter iteration over a ``set`` — dict/pytree order
+                         is insertion-stable, set order is not; a
+                         traced program must not depend on it
+  retrace-shape-key      f-string interpolating a tainted value —
+                         the "shape key built from non-static args"
+                         cache-key bug class
+"""
+
+import ast
+
+from paddle_tpu.analysis import callgraph
+from paddle_tpu.analysis.baseline import Finding
+
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "aval",
+                "sharding", "names"}
+SYNC_METHODS = {"item", "tolist"}
+SYNC_BUILTINS = {"int", "float", "bool"}
+SYNC_DOTTED = {"numpy.asarray", "numpy.array"}
+MAX_DEPTH = 20
+
+
+class _Pass:
+    def __init__(self, project):
+        self.project = project
+        self.findings = []
+        self.reported = set()
+        self.memo = set()
+
+    # ------------------------------------------------------------ plumbing
+
+    def _emit(self, rule, fi, node, detail, message, chain):
+        key = f"retrace:{rule}:{fi.module.name}:{fi.qualname}:{detail}"
+        if key in self.reported:
+            return
+        self.reported.add(key)
+        self.findings.append(Finding(
+            check="retrace", rule=rule, key=key, path=fi.path,
+            line=node.lineno, func=fi.key, message=message, chain=chain))
+
+    @staticmethod
+    def _tainted_names(expr, env):
+        names = sorted({n.id for n in ast.walk(expr)
+                        if isinstance(n, ast.Name) and env.get(n.id)})
+        return ",".join(names) or "<expr>"
+
+    # ------------------------------------------------------- function body
+
+    def analyze(self, fi, tainted_params, chain=(), depth=0):
+        key = (fi.module.name, fi.qualname, fi.line,
+               frozenset(tainted_params))
+        if key in self.memo or depth > MAX_DEPTH:
+            return
+        self.memo.add(key)
+        chain = chain + (fi.key,)
+        env = {p: (p in tainted_params) for p in fi.params()}
+        # two passes: loop-carried taint settles, the reported-set
+        # dedupes re-emitted findings
+        for _ in range(2):
+            self._visit_body(fi, fi.node.body, env, chain, depth)
+
+    def _visit_body(self, fi, body, env, chain, depth):
+        for st in body:
+            self._visit_stmt(fi, st, env, chain, depth)
+
+    def _visit_stmt(self, fi, st, env, chain, depth):
+        p = self.project
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: closure vars keep their taint, own params are
+            # untainted HERE — a call site with tainted actuals
+            # propagates through the normal interprocedural path
+            inner = dict(env)
+            child = next((c for c in fi.children
+                          if c.node is st), None)
+            scope = child if child is not None else fi
+            for prm in ([a.arg for a in st.args.posonlyargs
+                         + st.args.args + st.args.kwonlyargs]
+                        + ([st.args.vararg.arg] if st.args.vararg else [])
+                        + ([st.args.kwarg.arg] if st.args.kwarg else [])):
+                inner[prm] = False
+            self._visit_body(scope, st.body, inner, chain, depth)
+            return
+        if isinstance(st, ast.ClassDef):
+            return
+        if isinstance(st, (ast.Assign, ast.AnnAssign)):
+            value = st.value
+            if value is None:
+                return
+            t = self._expr(fi, value, env, chain, depth)
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            for tgt in targets:
+                self._bind(tgt, t, env)
+            return
+        if isinstance(st, ast.AugAssign):
+            t = self._expr(fi, st.value, env, chain, depth)
+            if isinstance(st.target, ast.Name):
+                env[st.target.id] = env.get(st.target.id, False) or t
+            return
+        if isinstance(st, ast.If):
+            # the repo-idiomatic concrete-only guard:
+            #   if isinstance(x, jax.core.Tracer): return
+            # launders x for the code below — inside a trace the body
+            # returns before anything concrete-only runs
+            guarded = self._tracer_guard(st)
+            if guarded is not None:
+                self._visit_body(fi, st.body, env, chain, depth)
+                env[guarded] = False
+                self._visit_body(fi, st.orelse, env, chain, depth)
+                return
+        if isinstance(st, (ast.If, ast.While)):
+            t = self._expr(fi, st.test, env, chain, depth)
+            if t:
+                kind = "if" if isinstance(st, ast.If) else "while"
+                detail = f"{kind}:{self._tainted_names(st.test, env)}"
+                self._emit(
+                    "retrace-data-branch", fi, st, detail,
+                    f"Python `{kind}` on runtime value(s) "
+                    f"{self._tainted_names(st.test, env)} — branch on "
+                    "data must be lax.cond/where or fed as data",
+                    chain)
+            self._visit_body(fi, st.body, env, chain, depth)
+            self._visit_body(fi, st.orelse, env, chain, depth)
+            return
+        if isinstance(st, ast.Assert):
+            if self._expr(fi, st.test, env, chain, depth):
+                detail = f"assert:{self._tainted_names(st.test, env)}"
+                self._emit(
+                    "retrace-data-branch", fi, st, detail,
+                    "assert on runtime value(s) "
+                    f"{self._tainted_names(st.test, env)} inside a "
+                    "jitted step — a tracer assert concretizes",
+                    chain)
+            return
+        if isinstance(st, ast.For):
+            self._check_unordered(fi, st.iter, env, chain)
+            t = self._expr(fi, st.iter, env, chain, depth)
+            self._bind(st.target, t, env)
+            self._visit_body(fi, st.body, env, chain, depth)
+            self._visit_body(fi, st.orelse, env, chain, depth)
+            return
+        if isinstance(st, ast.With):
+            for item in st.items:
+                self._expr(fi, item.context_expr, env, chain, depth)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, False, env)
+            self._visit_body(fi, st.body, env, chain, depth)
+            return
+        if isinstance(st, ast.Try):
+            self._visit_body(fi, st.body, env, chain, depth)
+            for h in st.handlers:
+                self._visit_body(fi, h.body, env, chain, depth)
+            self._visit_body(fi, st.orelse, env, chain, depth)
+            self._visit_body(fi, st.finalbody, env, chain, depth)
+            return
+        if isinstance(st, (ast.Return, ast.Expr, ast.Raise, ast.Delete)):
+            for v in ast.iter_child_nodes(st):
+                if isinstance(v, ast.expr):
+                    self._expr(fi, v, env, chain, depth)
+            return
+        # Pass/Break/Continue/Global/Nonlocal/Import: nothing to do
+
+    @staticmethod
+    def _tracer_guard(st):
+        """``if isinstance(NAME, ...Tracer): return/raise`` -> NAME."""
+        t = st.test
+        if not (isinstance(t, ast.Call) and isinstance(t.func, ast.Name)
+                and t.func.id == "isinstance" and len(t.args) == 2
+                and isinstance(t.args[0], ast.Name)):
+            return None
+        cls = t.args[1]
+        name = cls.attr if isinstance(cls, ast.Attribute) else \
+            (cls.id if isinstance(cls, ast.Name) else "")
+        if not str(name).endswith("Tracer"):
+            return None
+        if st.body and isinstance(st.body[-1], (ast.Return, ast.Raise)):
+            return t.args[0].id
+        return None
+
+    @staticmethod
+    def _bind(target, taint, env):
+        if isinstance(target, ast.Name):
+            env[target.id] = taint
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                _Pass._bind(e, taint, env)
+        elif isinstance(target, ast.Starred):
+            _Pass._bind(target.value, taint, env)
+        # Subscript/Attribute targets: container mutation, no binding
+
+    # --------------------------------------------------------- expressions
+
+    def _check_unordered(self, fi, it, env, chain):
+        bad = isinstance(it, ast.Set)
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id in ("set", "frozenset"):
+            bad = True
+        if bad:
+            self._emit(
+                "retrace-unordered-iter", fi, it, "set-iteration",
+                "iteration over a set inside a jitted step — set order "
+                "is not deterministic across processes; sort it or use "
+                "a dict/list", chain)
+
+    def _expr(self, fi, e, env, chain, depth):
+        if e is None or isinstance(e, ast.Constant):
+            return False
+        if isinstance(e, ast.Name):
+            return env.get(e.id, False)
+        if isinstance(e, ast.Attribute):
+            base = self._expr(fi, e.value, env, chain, depth)
+            return False if e.attr in STATIC_ATTRS else base
+        if isinstance(e, ast.Subscript):
+            return (self._expr(fi, e.value, env, chain, depth)
+                    or self._expr(fi, e.slice, env, chain, depth))
+        if isinstance(e, ast.Call):
+            return self._call(fi, e, env, chain, depth)
+        if isinstance(e, (ast.BinOp,)):
+            return (self._expr(fi, e.left, env, chain, depth)
+                    | self._expr(fi, e.right, env, chain, depth))
+        if isinstance(e, ast.UnaryOp):
+            return self._expr(fi, e.operand, env, chain, depth)
+        if isinstance(e, ast.BoolOp):
+            return any([self._expr(fi, v, env, chain, depth)
+                        for v in e.values])
+        if isinstance(e, ast.Compare):
+            left_t = self._expr(fi, e.left, env, chain, depth)
+            comp_ts = [self._expr(fi, v, env, chain, depth)
+                       for v in e.comparators]
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                return False          # identity: static at trace time
+            if all(isinstance(op, (ast.In, ast.NotIn)) for op in e.ops):
+                # membership launders the CONTAINER side only (pytree
+                # structure is static) — a tainted MEMBER (`tokens[0]
+                # in (0, 1)`) is a value comparison and stays tainted
+                # (review finding)
+                return left_t
+            return left_t or any(comp_ts)
+        if isinstance(e, ast.IfExp):
+            if self._expr(fi, e.test, env, chain, depth):
+                detail = f"ifexp:{self._tainted_names(e.test, env)}"
+                self._emit(
+                    "retrace-data-branch", fi, e, detail,
+                    "ternary on runtime value(s) "
+                    f"{self._tainted_names(e.test, env)} — use "
+                    "jnp.where/lax.cond", chain)
+            return (self._expr(fi, e.body, env, chain, depth)
+                    | self._expr(fi, e.orelse, env, chain, depth))
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any([self._expr(fi, v, env, chain, depth)
+                        for v in e.elts])
+        if isinstance(e, ast.Dict):
+            return any([self._expr(fi, v, env, chain, depth)
+                        for v in list(e.keys) + list(e.values)
+                        if v is not None])
+        if isinstance(e, ast.JoinedStr):
+            for part in e.values:
+                if isinstance(part, ast.FormattedValue) \
+                        and self._expr(fi, part.value, env, chain, depth):
+                    detail = "fstring:" \
+                        + self._tainted_names(part.value, env)
+                    self._emit(
+                        "retrace-shape-key", fi, part, detail,
+                        "f-string interpolates runtime value(s) "
+                        f"{self._tainted_names(part.value, env)} — a "
+                        "key/label built from non-static args retraces "
+                        "per value", chain)
+            return False
+        if isinstance(e, ast.Starred):
+            return self._expr(fi, e.value, env, chain, depth)
+        if isinstance(e, ast.NamedExpr):
+            t = self._expr(fi, e.value, env, chain, depth)
+            self._bind(e.target, t, env)
+            return t
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                          ast.DictComp)):
+            inner = dict(env)
+            for gen in e.generators:
+                self._check_unordered(fi, gen.iter, env, chain)
+                t = self._expr(fi, gen.iter, inner, chain, depth)
+                self._bind(gen.target, t, inner)
+                for cond in gen.ifs:
+                    self._expr(fi, cond, inner, chain, depth)
+            if isinstance(e, ast.DictComp):
+                return (self._expr(fi, e.key, inner, chain, depth)
+                        | self._expr(fi, e.value, inner, chain, depth))
+            return self._expr(fi, e.elt, inner, chain, depth)
+        if isinstance(e, ast.Lambda):
+            inner = dict(env)
+            for prm in ([a.arg for a in e.args.posonlyargs + e.args.args
+                         + e.args.kwonlyargs]
+                        + ([e.args.vararg.arg] if e.args.vararg else [])
+                        + ([e.args.kwarg.arg] if e.args.kwarg else [])):
+                inner[prm] = False
+            self._expr(fi, e.body, inner, chain, depth)
+            return False
+        if isinstance(e, ast.Await):
+            return self._expr(fi, e.value, env, chain, depth)
+        return False
+
+    def _call(self, fi, call, env, chain, depth):
+        # sink: .item()/.tolist() — a device sync, tainted base or not
+        # (an optimistically-untainted jnp result still syncs)
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in SYNC_METHODS and not call.args:
+            self._expr(fi, call.func.value, env, chain, depth)
+            self._emit(
+                "retrace-host-sync", fi, call, f"{call.func.attr}()",
+                f".{call.func.attr}() inside a jitted step forces a "
+                "host sync — keep the value on device or feed it as "
+                "data", chain)
+            return False
+        arg_taints = [self._expr(fi, a, env, chain, depth)
+                      for a in call.args]
+        kw_taints = {kw.arg: self._expr(fi, kw.value, env, chain, depth)
+                     for kw in call.keywords}
+        # sink: int()/float()/bool()/np.asarray() on a tainted value
+        if isinstance(call.func, ast.Name) \
+                and call.func.id in SYNC_BUILTINS and any(arg_taints):
+            self._emit(
+                "retrace-host-sync", fi, call,
+                f"{call.func.id}:{self._tainted_names(call, env)}",
+                f"{call.func.id}() on runtime value(s) "
+                f"{self._tainted_names(call, env)} concretizes a "
+                "tracer — feed it as data instead", chain)
+            return False
+        dotted, targets = self.project.resolve_call(fi, call)
+        if dotted in SYNC_DOTTED and (any(arg_taints)
+                                      or any(kw_taints.values())):
+            self._emit(
+                "retrace-host-sync", fi, call,
+                f"{dotted}:{self._tainted_names(call, env)}",
+                f"{dotted}() on runtime value(s) "
+                f"{self._tainted_names(call, env)} pulls the array to "
+                "host", chain)
+            return False
+        # interprocedural: push taint into project callees' params
+        if targets and (any(arg_taints) or any(kw_taints.values())):
+            for t in targets:
+                params = t.params()
+                formal = params[1:] if (t.cls is not None
+                                        and params[:1] == ["self"]) \
+                    else list(params)
+                tainted = set()
+                for i, taint in enumerate(arg_taints):
+                    if taint and i < len(formal):
+                        tainted.add(formal[i])
+                for name, taint in kw_taints.items():
+                    if taint and name in formal:
+                        tainted.add(name)
+                if tainted:
+                    self.analyze(t, frozenset(tainted), chain, depth + 1)
+        return False
+
+
+def run(project, roots):
+    """-> [Finding] for the retrace-hazard pass over the given roots.
+    Every parameter not named in a root's ``static_args`` (and not
+    ``self``) is data.  A root ref that does not resolve is itself a
+    finding — a retrace-only invocation must never go vacuously green
+    because the registry drifted (purity.run reports the same drift
+    under its own rule for jit runs)."""
+    p = _Pass(project)
+    for r in roots:
+        infos = project.function(r.ref)
+        if not infos:
+            p.findings.append(Finding(
+                check="retrace", rule="retrace-root-missing",
+                key=f"retrace:retrace-root-missing:{r.ref}",
+                path="paddle_tpu/analysis/roots.py", line=1, func=r.ref,
+                message=f"registered jit root {r.ref!r} does not "
+                        "resolve in the AST index — the registry "
+                        "drifted from the code"))
+            continue
+        for fi in infos:
+            static = set(getattr(r, "static_args", ()) or ())
+            data = frozenset(prm for prm in fi.params()
+                             if prm not in static and prm != "self")
+            p.analyze(fi, data)
+    return p.findings

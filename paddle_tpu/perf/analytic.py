@@ -35,75 +35,14 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 
 DEFAULT_OUT = os.path.join(_REPO, "BENCH_ANALYTIC_r06.json")
 
-# snapshot name -> (bench.py model, batch override or None = family
-# default).  Covers every bench family class (RNN, conv/image, seq2seq,
-# transformer train/packed/moe, LM + beam decode, serving, trainer loop)
-# plus the large-batch rows the round-5 verdict asked for: ResNet-50 at
-# bs 256, the 8k-slot packed transformer, LSTM h=2048.
-FAMILIES = [
-    ("lstm", "lstm", None),
-    ("lstm2048", "lstm2048", None),
-    ("smallnet", "smallnet", None),
-    ("alexnet", "alexnet", None),
-    ("resnet50", "resnet50", None),
-    ("resnet50@bs256", "resnet50", 256),
-    ("seq2seq", "seq2seq", None),
-    ("transformer", "transformer", None),
-    ("transformer_packed", "transformer_packed", None),
-    ("transformer_packed_8k", "transformer_packed_8k", None),
-    ("transformer_moe", "transformer_moe", None),
-    ("transformer_lm_decode", "transformer_lm_decode", None),
-    ("transformer_decode", "transformer_decode", None),
-    ("transformer_serving", "transformer_serving", None),
-    # the serving RUNTIME (paddle_tpu/serving): the engine's top-bucket
-    # executable via InferenceEngine.lower — gates the serving forward's
-    # structure like the training families
-    ("serving", "serving", None),
-    # continuous-batching generation (serving/decode_engine.py): the slab
-    # decode step via DecodeEngine.lower — the per-token serving hot path
-    ("serving_generate", "serving_generate", None),
-    # replicated serving tier (serving/fleet.py + router.py): the router
-    # is host-side only, so its analytic row is the SAME slab decode step
-    # the replicas run — the fleet adds zero new traces by construction
-    ("serving_fleet", "serving_fleet", None),
-    # SLO-holding control plane (serving/autoscaler.py + overload.py):
-    # autoscaler + overload controller are host-side only, so this row
-    # is again the slab decode step the replicas run — the control
-    # plane adds zero new traces by construction
-    ("serving_autoscale", "serving_autoscale", None),
-    # paged KV-cache serving (serving/kv_pool.py + kv_layout="paged"):
-    # the PAGED decode step via DecodeEngine.lower — gates the
-    # block-gather/scatter step's structure (the block table is data, so
-    # allocator churn can never change this program)
-    ("serving_paged", "serving_paged", None),
-    # fused Pallas decode-attention kernels (ops/pallas/decode_
-    # attention.py): extras["lower"] is the FUSED paged step at the
-    # serving_paged scale, and the factory's postcheck runs the
-    # fusion-proof gate (assert_decode_fused: no full-chain gather
-    # buffer in the HLO; reference step must FAIL the same gate) and
-    # records the fused-vs-reference predicted-bytes win — before any
-    # chip time
-    ("serving_decode_fused", "serving_decode_fused", None),
-    # unified chunked-prefill serving (decode_engine.py prefill_chunk):
-    # extras["lower"] is THE one unified step (decode rows + prefill
-    # chunks in one executable, Tq=chunk kernels forced on) and the
-    # factory's postcheck proves the score matrices are gone — no
-    # [K, T] buffer in the unified step, no [Tp, Tp] buffer in the
-    # flash-routed legacy prefill — with both gates tested in reverse
-    ("serving_chunked_prefill", "serving_chunked_prefill", None),
-    # quantized serving (paddle_tpu/quant/: int8 weights + int8 KV with
-    # in-register dequant in the fused kernels): extras["lower"] is the
-    # int8-KV + int8-weight paged step with kernels forced, and the
-    # postcheck proves (a) every quantized weight enters the program as
-    # s8 — no fp32 weight copy resident (assert_weights_quantized,
-    # failed by the fp32 twin), (b) no widened-KV [S, T, Dkv] float
-    # buffer exists in the kernel-forced HLO (assert_kv_quantized,
-    # failed by the kernels-off reference twin), and (c) the predicted
-    # decode-step bytes (predicted_decode_step_bytes) shrink >= 35% —
-    # all before any chip time
-    ("serving_quant", "serving_quant", None),
-    ("trainer_prefetch", "trainer_prefetch", None),
-]
+# The family registry moved to paddle_tpu/analysis/roots.py — ONE list
+# shared with the static invariant analyzer, so a new bench family
+# cannot add a jitted step the analyzer doesn't see (FAMILY_ROOTS maps
+# every family to the jit roots its extras["lower"] traces; the drift
+# test in tests/test_analysis.py keeps registry and code joined).  The
+# name stays importable from here for every existing consumer
+# (scripts/perf_report.py, tests/test_perf_analytic.py).
+from paddle_tpu.analysis.roots import FAMILIES  # noqa: E402,F401
 
 
 def _log(msg):

@@ -65,6 +65,12 @@
 #                                        fp32 twin, int8+weights exact vs
 #                                        the quantized oracle, blocks
 #                                        doubled at equal bytes)
+# 17. static invariant gate              (python -m paddle_tpu.analysis:
+#                                        jit-purity + retrace-hazard +
+#                                        lock-order passes vs the
+#                                        committed baseline — findings
+#                                        FAIL the window, no chip time
+#                                        needed)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -314,6 +320,23 @@ log "phase 16: quantized serving smoke (int8 KV + int8 weights)"
 timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-quant \
     > "$ART/quant_smoke.json" 2> "$ART/quant_smoke.log"
 log "quant smoke rc=$? -> $ART/quant_smoke.json"
+
+log "phase 17: static invariant gate (jit-purity / retrace / lock-order)"
+# chip-independent AST gate (docs/analysis.md): every finding must be
+# either fixed or baselined with a reason — a NEW finding fails the
+# whole window (rc propagated, WINDOW_DONE withheld) because a step
+# that retraces or deadlocks would poison every phase above on the
+# next revision.  Same command in dry-run and real windows: the
+# analyzer never touches a chip.
+timeout "$T_SERVE" python -m paddle_tpu.analysis --check all --json \
+    > "$ART/analysis_gate.json" 2> "$ART/analysis_gate.log"
+ANALYSIS_RC=$?
+log "analysis gate rc=$ANALYSIS_RC -> $ART/analysis_gate.json"
+if [ "$ANALYSIS_RC" != 0 ]; then
+    log "STATIC INVARIANT GATE FAILED — fix or baseline the findings in"
+    log "$ART/analysis_gate.json before trusting this window"
+    exit "$ANALYSIS_RC"
+fi
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

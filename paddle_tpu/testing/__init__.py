@@ -2,7 +2,8 @@
 trainer mode and gserver/tests/LayerGradUtil.h discipline)."""
 
 from paddle_tpu.testing.gradcheck import check_topology_grads, check_grads
-from paddle_tpu.testing.trace import assert_no_retrace, expect_traces
+from paddle_tpu.testing.trace import (assert_no_retrace, counting,
+                                      expect_traces, forbid_retrace)
 
 __all__ = ["check_topology_grads", "check_grads", "assert_no_retrace",
-           "expect_traces"]
+           "expect_traces", "forbid_retrace", "counting"]

@@ -41,3 +41,67 @@ def assert_no_retrace(get_count, what, hint="the compiled path retraced"):
     """``expect_traces(..., 0, ...)`` — the steady-state half of the
     discipline, named for readability at call sites."""
     return expect_traces(get_count, 0, what, hint=hint)
+
+
+def _as_counter(c):
+    """A trace-count source: a zero-arg callable, or any object exposing
+    ``step_trace_count`` / ``trace_count`` (the engines' counters)."""
+    if callable(c) and not hasattr(c, "step_trace_count") \
+            and not hasattr(c, "trace_count"):
+        return c
+    for attr in ("step_trace_count", "trace_count"):
+        if hasattr(c, attr):
+            return lambda o=c, a=attr: getattr(o, a)
+    raise TypeError(f"{c!r} is neither a callable counter nor an object "
+                    "with step_trace_count/trace_count")
+
+
+@contextlib.contextmanager
+def forbid_retrace(*counters, what="the compiled path", hint=None):
+    """Assert NONE of the given trace counters move inside the block —
+    the multi-surface replacement for the hand-rolled
+    ``t0 = eng.step_trace_count; ...; assert eng.step_trace_count - t0
+    == 0`` spies.  Counters may be zero-arg callables or engine-like
+    objects (``step_trace_count``/``trace_count`` read directly):
+
+        with forbid_retrace(eng, peng):   # churn must retrace NOTHING
+            drive(eng, peng)
+
+    Also the runtime half of the static retrace gate
+    (tests/test_analysis.py): the analyzer flags a hazard statically,
+    and forbid_retrace proves the same shape really retraces live.
+    """
+    getters = [_as_counter(c) for c in counters]
+    if not getters:
+        raise TypeError("forbid_retrace() needs at least one counter")
+    before = [g() for g in getters]
+    yield
+    for i, g in enumerate(getters):
+        actual = g() - before[i]
+        if actual:
+            msg = (f"{what}: counter #{i} traced {actual} time(s) "
+                   f"(expected 0)")
+            msg += f" — {hint or 'the compiled path retraced'}"
+            raise AssertionError(msg)
+
+
+def counting(fn):
+    """Wrap ``fn`` so each execution of its PYTHON BODY increments
+    ``wrapper.trace_count`` — under ``jax.jit`` the body runs only when
+    JAX stages the function, so the counter counts traces (the same
+    convention every engine's built-in counter follows).  For test
+    functions that have no engine counter:
+
+        step = counting(lambda x: x * 2)
+        jitted = jax.jit(step)
+        with forbid_retrace(step):
+            jitted(a); jitted(b)          # same shape: no retrace
+    """
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        wrapper.trace_count += 1
+        return fn(*args, **kwargs)
+    wrapper.trace_count = 0
+    return wrapper

@@ -203,6 +203,18 @@ class Flags:
     resilience_breaker_cooldown_s: float = 5.0  # open -> half-open probe
     resilience_retry_budget: int = 3    # transient submit retries
 
+    # ---- static invariant analyzer (paddle_tpu/analysis/: jit-purity,
+    # retrace-hazard and lock-order passes gated on every commit;
+    # docs/analysis.md)
+    analysis_baseline: Optional[str] = None  # allow-list path override
+    #                                     (None = the committed
+    #                                     paddle_tpu/analysis/
+    #                                     baseline.json)
+    analysis_strict: bool = False       # stale baseline entries (a
+    #                                     documented violation that no
+    #                                     longer exists) fail the gate
+    #                                     instead of warning
+
     # ---- observability (new floor; reference had host timers only)
     # request tracing (obs/trace.py: host-side span recorder + cross-
     # process propagation + Chrome-trace export; docs/observability.md)
@@ -519,6 +531,13 @@ FLAG_DOCS = {
                                       "half-open probe", "—"),
     "resilience_retry_budget": ("bounded retries (exp backoff + jitter) "
                                 "for transient submit failures", "—"),
+    "analysis_baseline": ("static-analyzer allow-list path for `python "
+                          "-m paddle_tpu.analysis` (None = the "
+                          "committed paddle_tpu/analysis/baseline.json)",
+                          "—"),
+    "analysis_strict": ("static analyzer: stale baseline entries fail "
+                        "the gate (rc 1) instead of warning — keeps the "
+                        "allow-list honest in CI", "—"),
     "obs_trace_enable": ("per-request span tracing (obs/trace.py): "
                          "host-side recorder + /debug/traces + Chrome "
                          "export; strictly no-op when off", "—"),

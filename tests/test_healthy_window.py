@@ -51,7 +51,7 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "trace_smoke.json", "trace_chrome.json",
                  "decode_fused_smoke.json", "autoscale_smoke.json",
                  "chunked_smoke.json", "quant_smoke.json",
-                 "WINDOW_DONE"):
+                 "analysis_gate.json", "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -172,6 +172,15 @@ def test_dryrun_executes_every_phase(tmp_path):
     assert qsm["kv_blocks_doubled"] is True, qsm
     assert qsm["kv_blocks_total"] == 2 * qsm["f32_twin_blocks"], qsm
     assert qsm["kv_dtype"] == "int8" and qsm["metrics_sane"] is True, qsm
+    # the static invariant gate really gated: all three passes ran
+    # against the committed baseline with ZERO new findings (a new
+    # finding exits nonzero and withholds WINDOW_DONE — asserted above
+    # via rc==0 + the file's existence)
+    gate = json.loads((art / "analysis_gate.json").read_text())
+    assert gate["check"] == "all", gate
+    assert gate["new"] == 0, gate
+    assert gate["roots"], "analysis gate ran with no jit roots"
+    assert gate["stale_baseline_keys"] == [], gate
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows

@@ -41,6 +41,26 @@ def test_no_binaries_committed():
         "native/build.py builds on demand")
 
 
+def test_analysis_baseline_committed_and_parseable():
+    """The static-analyzer allow-list rides the same git gate: the
+    committed baseline must exist IN git (not just on disk — an
+    untracked baseline silently vanishes for the next clone, turning
+    every documented exception into a red gate) and must parse under
+    the strict loader (every entry keyed + justified)."""
+    rel = os.path.join("paddle_tpu", "analysis", "baseline.json")
+    r = subprocess.run(["git", "ls-files", "--", rel], cwd=_ROOT,
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip("not a git checkout")
+    assert r.stdout.strip() == rel, (
+        f"{rel} is not committed — the analyzer gate needs its "
+        "allow-list in git")
+    from paddle_tpu.analysis import baseline
+    entries = baseline.load(os.path.join(_ROOT, rel))
+    for key, reason in entries.items():
+        assert reason.strip(), f"baseline entry {key} has no reason"
+
+
 @pytest.mark.slow   # full g++ rebuild in a subprocess; nightly lane
 def test_cold_build_from_binaryless_checkout(tmp_path):
     """A clean checkout has no .so: the first native touch must build it

@@ -33,7 +33,7 @@ from paddle_tpu.serving import (BatchExecutionError, Batcher,
                                 GenerationBatcher, InferenceEngine,
                                 ServingMetrics, make_server)
 from paddle_tpu.serving.decode_engine import DecodeEngine
-from paddle_tpu.testing import assert_no_retrace
+from paddle_tpu.testing import assert_no_retrace, forbid_retrace
 from paddle_tpu.utils.error import ConfigError
 
 VOCAB, HEADS, MAX_LEN, SLOTS, BUCKETS = 64, 2, 48, 4, (8, 16)
@@ -164,7 +164,6 @@ def test_decode_step_fault_recovery_bit_identical_under_load(engine):
     cases = [(p, 4 + (i % 5)) for i, p in enumerate(_prompts(1, 12))]
     ref = _reference(engine, cases)
     engine.metrics = ServingMetrics()
-    tr0 = engine.step_trace_count
     sup = Supervisor(breaker_threshold=10)
     bat = GenerationBatcher(engine, supervisor=sup)
     faults.install_spec("serving.decode_step:at=6")
@@ -181,7 +180,6 @@ def test_decode_step_fault_recovery_bit_identical_under_load(engine):
     assert snap["slot_reprefills_total"] >= 1
     assert snap["evictions"]["recovered"] >= 1
     assert engine.free_slots == SLOTS
-    assert engine.step_trace_count == tr0
 
 
 def test_decode_step_hang_watchdog_rebuild_bit_identical(engine):
@@ -191,12 +189,13 @@ def test_decode_step_hang_watchdog_rebuild_bit_identical(engine):
     cases = [(p, 5) for p in _prompts(2, 6)]
     ref = _reference(engine, cases)
     engine.metrics = ServingMetrics()
-    tr0 = engine.step_trace_count
     sup = Supervisor(step_deadline_s=0.25, breaker_threshold=10)
     bat = GenerationBatcher(engine, supervisor=sup)
     faults.install_spec("serving.decode_step:at=4,action=hang,hang_s=1.0")
-    results, excs = _drive_concurrent(bat, cases)
-    bat.close()
+    with forbid_retrace(engine, what="watchdog rebuild recovery",
+                        hint="the slab rebuild retraced the step"):
+        results, excs = _drive_concurrent(bat, cases)
+        bat.close()
     faults.clear()
     assert all(e is None for e in excs), excs
     for i, r in enumerate(results):
@@ -205,7 +204,6 @@ def test_decode_step_hang_watchdog_rebuild_bit_identical(engine):
     snap = engine.metrics.snapshot()
     assert snap["watchdog_trips_total"] == 1
     assert snap["slot_reprefills_total"] >= 1
-    assert engine.step_trace_count == tr0
     time.sleep(0.9)     # let the stale thread finish against the epoch
     #                     guard before the next test reuses the engine
 
