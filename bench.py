@@ -1954,6 +1954,214 @@ def bench_serving_quant(slots=8, n_requests=48, vocab=256, d_model=128,
         extras
 
 
+def bench_serving_speculative(slots=8, n_requests=32, vocab=256,
+                              d_model=128, dff=192, layers=3, heads=2,
+                              chunk=8, speculate_k=4, draft_layers=2,
+                              seed=0):
+    """Speculative decoding on the slot engine (serving/speculative.py;
+    docs/serving.md "Speculative decoding") vs the same chunked engine
+    without a draft, at 8 and 32 clients: a truncated-trunk draft
+    proposes ``speculate_k`` tokens per feeding slot and the target's
+    one chunk step scores every lane at once, so each target step nets
+    1 + accepted tokens instead of exactly 1.  Reported per mode and
+    client count: tokens/s, TTFT p99, TPOT p50/p99, and (spec only)
+    the acceptance rate + effective tokens per target step.  An
+    adversarial drive (a draft from a DIFFERENT seed — near-zero
+    acceptance) pins the floor: every step still nets >= 1 token and
+    the streams stay bit-identical, speculation only ever costs speed.
+
+    The analytic leg: extras["lower"] is the spec-mode unified step
+    (all_lanes projection live, Tq=chunk kernels forced on) and
+    extras["postcheck"] proves (1) the spec step materializes the
+    all-lanes [S, K, vocab] projection while the non-spec twin does
+    NOT (detector shown firing in both directions), and (2) the
+    predicted per-emitted-token bytes model
+    (perf/analytic.predicted_spec_bytes_per_token — kernel_cost(tq=
+    k+1) verify + k draft passes over expected emitted) shows a
+    reduction at a serving-representative scale AND a regression in
+    the adversarial direction (acceptance 0) — spec must never look
+    free."""
+    import jax
+    from paddle_tpu.models import transformer
+    from paddle_tpu.ops.pallas import decode_attention as decode_kernels
+    from paddle_tpu.perf import analytic as perf_analytic
+    from paddle_tpu.serving import GenerationBatcher, ServingMetrics
+    from paddle_tpu.serving.decode_engine import DecodeEngine
+    from paddle_tpu.serving.speculative import make_draft
+
+    max_len = 96
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=1, d_model=d_model, dff=dff,
+                              enc_layers=layers, dec_layers=0,
+                              max_len=max_len, num_heads=heads)
+    adv_params = transformer.init(jax.random.PRNGKey(7), src_vocab=vocab,
+                                  trg_vocab=1, d_model=d_model, dff=dff,
+                                  enc_layers=layers, dec_layers=0,
+                                  max_len=max_len, num_heads=heads)
+    warm = os.environ.get("BENCH_ANALYTIC_BUILD") != "1"
+
+    def make_engine(mode, draft_params=None):
+        spec = mode != "plain"
+        draft = make_draft(draft_params or params,
+                           layers=draft_layers) if spec else None
+        return DecodeEngine(params, num_heads=heads, num_slots=slots,
+                            max_len=max_len, prefill_chunk=chunk,
+                            name=f"bench_spec_{mode}", warm=warm,
+                            speculate_k=speculate_k if spec else 0,
+                            draft=draft)
+
+    rng = np.random.RandomState(seed)
+    reqs = [(rng.randint(1, vocab, rng.randint(4, 12)).astype(np.int32),
+             int(rng.randint(12, 21))) for _ in range(n_requests)]
+
+    def drive(mode, n_clients, draft_params=None):
+        engine = make_engine(mode, draft_params)
+        engine.metrics = ServingMetrics()
+        bat = GenerationBatcher(engine, queue_size=4096)
+        lock, nxt, tokens = threading.Lock(), [0], [0]
+        outs = {}
+
+        def client():
+            while True:
+                with lock:
+                    i = nxt[0]
+                    if i >= len(reqs):
+                        return
+                    nxt[0] += 1
+                prompt, mt = reqs[i]
+                out = bat.submit(prompt, max_tokens=mt).result(300)
+                with lock:
+                    tokens[0] += len(out["tokens"])
+                    outs[i] = out["tokens"]
+
+        ts = [threading.Thread(target=client) for _ in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        snap = engine.metrics.snapshot()
+        bat.close()
+        r = {"mode": mode, "clients": n_clients,
+             "tokens_per_s": round(tokens[0] / dt, 1),
+             "ttft_p99_ms": snap["ttft_ms"]["p99"],
+             "tpot_p50_ms": snap["tpot_ms"]["p50"],
+             "tpot_p99_ms": snap["tpot_ms"]["p99"],
+             "outs": outs}
+        if mode != "plain":
+            r["spec_acceptance_rate"] = snap["spec_acceptance_rate"]
+            r["spec_tokens_per_step"] = snap["spec_tokens_per_step"]
+        return r
+
+    def lower_spec():
+        engine = make_engine("spec")
+        with decode_kernels.forced_mode("always"):
+            return engine.lower()
+
+    kk = max(chunk, speculate_k + 1)
+
+    def postcheck(compiled):
+        """Both analytic gates, each proven in both directions."""
+        import re
+
+        def all_lanes_projection(hlo):
+            # the spec verify's vocab projection over EVERY lane — the
+            # [S, KK, vocab] buffer the non-spec step must not hold
+            # (it projects only the selected last-position lane)
+            pat = rf"f32\[{slots},{kk},{vocab}\]"
+            return [ln.strip() for ln in hlo.splitlines()
+                    if re.search(pat, ln)][:4]
+
+        if not all_lanes_projection(compiled.as_text()):
+            raise AssertionError(
+                f"spec step holds no [{slots}, {kk}, {vocab}] all-lanes "
+                "projection — the verify path is not scoring the "
+                "drafted lanes")
+        with decode_kernels.forced_mode("always"):
+            plain_hlo = make_engine("plain").lower().compile().as_text()
+        hits = all_lanes_projection(plain_hlo)
+        if hits:
+            raise AssertionError(
+                "non-spec twin materializes the all-lanes projection — "
+                "the detector (or the all_lanes gating) is broken:\n  "
+                + "\n  ".join(hits))
+        # bytes model, serving-representative scale (the toy bench dims
+        # are embedding-dominated: a draft sharing the target embedding
+        # is honestly predicted to LOSE there — recorded, not gated)
+        rep = dict(layers=48, d=2048, dff=8192, vocab=32000, s=slots,
+                   t_span=2048, num_heads=16,
+                   draft_layers=draft_layers, k=speculate_k)
+        spec_b, plain_b = perf_analytic.predicted_spec_bytes_per_token(
+            acceptance=0.6, **rep)
+        reduction = 1.0 - spec_b / plain_b
+        if not reduction > 0:
+            raise AssertionError(
+                f"predicted per-emitted-token bytes show no reduction "
+                f"at acceptance 0.6 ({reduction:+.2%}) — the spec "
+                "bytes model lost its win")
+        adv_b, _ = perf_analytic.predicted_spec_bytes_per_token(
+            acceptance=0.0, **rep)
+        if not adv_b > plain_b:
+            raise AssertionError(
+                "bytes model predicts a FREE lunch: acceptance-0 "
+                "speculation must cost more per token than plain "
+                "decode (draft passes + extra lanes are not free)")
+        toy_spec, toy_plain = perf_analytic.predicted_spec_bytes_per_token(
+            layers=layers, d=d_model, dff=dff, vocab=vocab, s=slots,
+            t_span=max_len, num_heads=heads, draft_layers=draft_layers,
+            k=speculate_k, acceptance=0.6)
+        return {"all_lanes_projection_proof": "pass",
+                "spec_bytes_reduction_rep": round(reduction, 4),
+                "spec_bytes_regression_adversarial":
+                    round(1.0 - adv_b / plain_b, 4),
+                "spec_bytes_reduction_toy":
+                    round(1.0 - toy_spec / toy_plain, 4)}
+
+    extras = {"lower": lower_spec, "postcheck": postcheck}
+    if warm:
+        rows, plain_outs = [], {}
+        for n_clients in (8, 32):
+            spec_r = drive("spec", n_clients)
+            plain_r = drive("plain", n_clients)
+            if spec_r.pop("outs") != (po := plain_r.pop("outs")):
+                raise AssertionError(
+                    f"spec streams diverged from non-spec at "
+                    f"{n_clients} clients — speculation changed OUTPUT")
+            plain_outs = po
+            if not spec_r["spec_tokens_per_step"] > 1.0:
+                raise AssertionError(
+                    "high-acceptance draft nets <= 1 token per target "
+                    f"step: {spec_r}")
+            rows += [spec_r, plain_r]
+        adv = drive("adversarial", 8, draft_params=adv_params)
+        if adv.pop("outs") != plain_outs:
+            raise AssertionError("adversarial-draft streams diverged — "
+                                 "speculation changed OUTPUT")
+        if not adv["spec_tokens_per_step"] >= 1.0:
+            raise AssertionError(
+                f"adversarial draft broke the >= 1 token/step floor: "
+                f"{adv}")
+        rows.append(adv)
+        extras.update(drives=rows)
+
+    def run(_s):
+        r = drive("spec", 8)
+        r.pop("outs")
+        return np.float32(r["tokens_per_s"])
+
+    total_tokens = sum(mt for _p, mt in reqs)
+    prefill_tokens = sum(p.size for p, _mt in reqs)
+    per_tok = layers * (6 * d_model ** 2 + 2 * d_model * dff) \
+        + d_model * vocab
+    attn = layers * 4.0 * d_model * max_len / 2
+    flops = (2.0 * per_tok + attn) * (total_tokens + prefill_tokens)
+    return run, flops, None, (
+        f"speculative serving tokens/s ({n_requests} reqs, 8/32 "
+        f"clients, {slots} slots, k={speculate_k}, {draft_layers}-of-"
+        f"{layers}-layer draft; spec vs plain vs adversarial)"), extras
+
+
 def bench_serving_fleet(replicas=2, n_requests=16, vocab=256, max_len=64,
                         prefill_buckets=(8, 16), gen_short=8, gen_long=24,
                         seed=0):
@@ -2529,6 +2737,12 @@ _BENCHES = {
     # step-bytes reduction gate; b = the fp32 slot count (int8 engines
     # get 2*b slots over the same bytes)
     "serving_quant": (lambda b: bench_serving_quant(slots=b), 8),
+    # speculative decoding (serving/speculative.py): draft-ahead +
+    # chunk-kernel verify vs the same chunked engine without a draft at
+    # 8/32 clients, the adversarial >= 1 token/step floor, and the
+    # all-lanes-projection + predicted-bytes analytic proofs; b = slots
+    "serving_speculative": (lambda b: bench_serving_speculative(
+        slots=b), 8),
     "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),
     # input-pipeline overlap row: steps/s at train(prefetch=0) vs 2 on a
     # synthetic input-bound workload (the ShardedPrefetcher's win)

@@ -51,6 +51,7 @@ FAMILIES = [
     ("serving_decode_fused", "serving_decode_fused", None),
     ("serving_chunked_prefill", "serving_chunked_prefill", None),
     ("serving_quant", "serving_quant", None),
+    ("serving_speculative", "serving_speculative", None),
     ("trainer_prefetch", "trainer_prefetch", None),
 ]
 
@@ -102,12 +103,14 @@ JIT_ROOTS = {r.name: r for r in [
          note="paged-KV decode step (block tables fed as data)"),
     Root("lm_decode_chunk_slots",
          "paddle_tpu.models.transformer:lm_decode_chunk_slots",
-         static_args=("num_heads", "moe_top_k", "pos_type"),
-         note="unified chunked-prefill step, slab layout"),
+         static_args=("num_heads", "moe_top_k", "pos_type", "all_lanes"),
+         note="unified chunked-prefill step, slab layout (all_lanes is "
+              "the spec-verify projection switch — trace-time only)"),
     Root("lm_decode_chunk_paged",
          "paddle_tpu.models.transformer:lm_decode_chunk_paged",
-         static_args=("num_heads", "moe_top_k", "pos_type"),
-         note="unified chunked-prefill step, paged layout"),
+         static_args=("num_heads", "moe_top_k", "pos_type", "all_lanes"),
+         note="unified chunked-prefill step, paged layout (all_lanes is "
+              "the spec-verify projection switch — trace-time only)"),
     # ---- engine-side jitted closures (serving/): the slot-step wrapper
     # plus the admission/write/fork device ops around it
     Root("decode_engine_step",
@@ -116,6 +119,13 @@ JIT_ROOTS = {r.name: r for r in [
          static_args=(),
          note="DecodeEngine's jitted step wrapper (all 4 layout/chunk "
               "variants share the qualname; every variant is analyzed)"),
+    Root("draft_rollout",
+         "paddle_tpu.serving.speculative:"
+         "DraftTrunk.__init__.<locals>._draft_fn",
+         static_args=(),
+         note="DraftTrunk's jitted k-token rollout (speculative "
+              "decoding); k/chunk are constructor constants baked into "
+              "the closure, feed lengths/positions are data"),
     Root("serving_fwd",
          "paddle_tpu.serving.engine:"
          "InferenceEngine.from_inferencer.<locals>.fwd",
@@ -190,6 +200,13 @@ FAMILY_ROOTS = {
                                 "flash_attention"),
     "serving_quant": ("decode_engine_step", "lm_decode_step_paged",
                       "decode_attention_paged", "lm_prefill"),
+    "serving_speculative": ("decode_engine_step", "draft_rollout",
+                            "lm_decode_chunk_slots",
+                            "lm_decode_chunk_paged",
+                            "lm_decode_step_slots", "lm_prefill",
+                            "decode_attention_slab_chunk",
+                            "decode_attention_paged_chunk",
+                            "flash_attention"),
     "trainer_prefetch": ("trainer_step",),
 }
 

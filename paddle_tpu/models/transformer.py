@@ -1020,7 +1020,8 @@ def _cached_self_attn_chunk(blk, x, c, li, qpos, pos_mask, num_heads,
 
 
 def lm_decode_chunk_slots(params, tokens, positions, lengths, cache,
-                          num_heads=8, moe_top_k=2, pos_type="learned"):
+                          num_heads=8, moe_top_k=2, pos_type="learned",
+                          all_lanes=False):
     """The Tq=chunk generalization of ``lm_decode_step_slots``: every
     row advances ``lengths[r]`` (1..K) positions in ONE step.
 
@@ -1031,7 +1032,14 @@ def lm_decode_chunk_slots(params, tokens, positions, lengths, cache,
     new cache).  A row with lengths[r]=1 computes exactly
     ``lm_decode_step_slots``'s result; a row chunking through its prompt
     computes exactly what sequential steps would — tokens and lengths
-    are DATA, so mixing decode and prefill rows never retraces."""
+    are DATA, so mixing decode and prefill rows never retraces.
+
+    all_lanes=True (a TRACE-TIME constant, like num_heads) projects
+    EVERY lane instead of only the last fed one -> logits [S, K, V]:
+    the speculative-decoding verify surface (serving/speculative.py) —
+    lane i's logits are the target's next-token distribution after the
+    prefix through lane i, so host-side acceptance can take the longest
+    matched greedy prefix from ONE step."""
     params = _maybe_dequant(params)
     s, kk = tokens.shape
     max_len = cache[0]["k"].shape[1]
@@ -1048,6 +1056,8 @@ def lm_decode_chunk_slots(params, tokens, positions, lengths, cache,
                                         num_heads, rope_pos)
         x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
         new_cache.append(nc)
+    if all_lanes:
+        return _lm_project(params, x), new_cache
     h_last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
     return _lm_project(params, h_last)[:, 0], new_cache
 
@@ -1095,10 +1105,10 @@ def _cached_self_attn_chunk_paged(blk, x, c, li, qpos, tables, pos_mask,
 
 def lm_decode_chunk_paged(params, tokens, positions, lengths, cache,
                           tables, num_heads=8, moe_top_k=2,
-                          pos_type="learned"):
+                          pos_type="learned", all_lanes=False):
     """The Tq=chunk generalization of ``lm_decode_step_paged`` — the
     paged twin of ``lm_decode_chunk_slots`` (same lane semantics, block
-    tables as DATA)."""
+    tables as DATA; ``all_lanes`` the same trace-time verify switch)."""
     params = _maybe_dequant(params)
     s, kk = tokens.shape
     block_size = cache[0]["k"].shape[1]
@@ -1117,6 +1127,8 @@ def lm_decode_chunk_paged(params, tokens, positions, lengths, cache,
                                               num_heads, rope_pos)
         x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
         new_cache.append(nc)
+    if all_lanes:
+        return _lm_project(params, x), new_cache
     h_last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
     return _lm_project(params, h_last)[:, 0], new_cache
 

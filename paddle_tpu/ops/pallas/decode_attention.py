@@ -343,12 +343,30 @@ def _chunk_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, blk, kk,
         vb = v_ref[0].astype(jnp.float32)
         ks = None if ks_ref is None else ks_ref[0]
         vs = None if vs_ref is None else vs_ref[0]
-        for i in range(kk):
-            sl = slice(i * num_heads, (i + 1) * num_heads)
+        def _lane(i, sl):
             _accumulate(q_ref[0, sl].astype(jnp.float32), kb, vb,
                         j * blk, blk, pos_ref[r, i], m_scr, l_scr,
                         acc_scr, num_heads=num_heads, hkv=hkv, dh=dh,
                         scale=scale, sl=sl, ks=ks, vs=vs)
+
+        _lane(0, slice(0, num_heads))    # lane 0 is always live
+
+        # the decode-row fast path: live lanes have strictly increasing
+        # positions and an inactive lane REPEATS the last live lane's
+        # clamped qpos (engine ``_chunk_lanes``), so last == first means
+        # the row has exactly ONE live lane — a plain decode row riding
+        # the chunk step — and every other lane's accumulate is skipped
+        # (their scratch keeps the _init_row zeros; _finalize's
+        # max(l, eps) emits deterministic zeros nothing reads).  The
+        # predicate is pos DATA — no retrace — and ONE conditional per
+        # kernel keeps the step's HLO structurally flat for the
+        # analytic-diff gate; partially-live rows (chunk-ingest tails,
+        # spec verify) still visit every lane, where per-lane masking
+        # makes the dead visits bit-exact no-ops.
+        @pl.when(pos_ref[r, kk - 1] != pos_ref[r, 0])
+        def _():
+            for i in range(1, kk):
+                _lane(i, slice(i * num_heads, (i + 1) * num_heads))
 
     @pl.when(j == pl.num_programs(1) - 1)
     def _():

@@ -308,6 +308,51 @@ def predicted_decode_step_bytes(params, s, t_span, num_heads,
     return qw.param_bytes(params) + kv_read + kv_write + acts + io
 
 
+def predicted_spec_bytes_per_token(layers, d, dff, vocab, s, t_span,
+                                   num_heads, draft_layers, k,
+                                   acceptance, dkv=None):
+    """First-principles HBM traffic per EMITTED token, speculative vs
+    plain decode — the serving_speculative bytes model (docs/serving.md
+    "Speculative decoding").  Returns ``(spec, nonspec)`` byte totals.
+
+    The target's verify step streams each row's K/V stripe ONCE no
+    matter how many query lanes ride it (the Tq=chunk kernels —
+    ``kernel_cost(tq=k+1)`` differs from ``tq=1`` only by the extra
+    q/o lanes and the all-lanes vocab projection), so verifying k
+    drafts costs nearly the same bytes as decoding one token.  The
+    draft rollout is the price: k sequential passes, each streaming
+    the draft's weights and its own K/V.  With expected emitted tokens
+    ``E = sum(a^i, i=0..k) = (1 - a^(k+1)) / (1 - a)`` per verify
+    step, spec wins iff ``(target_step + k * draft_pass) / E <
+    target_step`` — a cheap-enough draft and a real acceptance rate,
+    which is why the adversarial direction (a = 0, E = 1) must predict
+    a REGRESSION: the model is gated in both directions by the
+    serving_speculative postcheck."""
+    from paddle_tpu.ops.pallas.decode_attention import kernel_cost
+    dkv = d if dkv is None else dkv
+
+    def weight_bytes(n_layers, with_embed=True):
+        trunk = n_layers * (4 * d * d + 2 * d * dff + 9 * d) * 4
+        emb = (2 * vocab * d + t_span * d + 2 * d) * 4 if with_embed \
+            else 0
+        return trunk + emb
+
+    def step_bytes(n_layers, tq, vocab_lanes):
+        attn = n_layers * kernel_cost(s, t_span, d, dkv,
+                                      tq=tq).bytes_accessed
+        kv_write = n_layers * 2 * s * tq * dkv * 4
+        acts = n_layers * 2 * s * tq * d * 4
+        io = s * tq * 4 + s * vocab_lanes * vocab * 4
+        return weight_bytes(n_layers) + attn + kv_write + acts + io
+
+    a = min(max(float(acceptance), 0.0), 1.0 - 1e-9)
+    emitted = (1.0 - a ** (k + 1)) / (1.0 - a)
+    verify = step_bytes(layers, k + 1, k + 1)
+    draft = k * step_bytes(draft_layers, 1, 1)
+    nonspec = step_bytes(layers, 1, 1)
+    return (verify + draft) / emitted, float(nonspec)
+
+
 def _import_bench():
     if _REPO not in sys.path:
         sys.path.insert(0, _REPO)
@@ -368,7 +413,8 @@ def capture(name, model, batch=None, chips=("v5e", "v5p")):
     if model in ("transformer_serving", "serving", "serving_generate",
                  "serving_fleet", "serving_paged",
                  "serving_decode_fused", "serving_autoscale",
-                 "serving_chunked_prefill", "serving_quant"):
+                 "serving_chunked_prefill", "serving_quant",
+                 "serving_speculative"):
         # the lowered program is one batch/slab step while the bench FLOPs
         # model covers the whole stream/burst — scopes differ, no cross-check
         row["bench_model_flops"] = None

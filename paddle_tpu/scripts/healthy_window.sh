@@ -71,6 +71,11 @@
 #                                        committed baseline — findings
 #                                        FAIL the window, no chip time
 #                                        needed)
+# 18. speculative serving smoke          (draft-ahead decode engine vs
+#                                        its non-spec twin: streams
+#                                        bit-identical, acceptance-rate
+#                                        evidence in /metrics, zero
+#                                        retraces — one JSON line)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -337,6 +342,19 @@ if [ "$ANALYSIS_RC" != 0 ]; then
     log "$ART/analysis_gate.json before trusting this window"
     exit "$ANALYSIS_RC"
 fi
+
+log "phase 18: speculative serving smoke (draft-ahead vs non-spec twin)"
+# greedy speculative decoding on the slot engine: a k-lane draft rollout
+# feeds the ONE chunked verify step; every stream must be bit-identical
+# to the non-speculating twin regardless of draft quality, acceptance
+# evidence (drafted/accepted counters, acceptance rate, tokens/step)
+# must render on /metrics, and both engines must hold at 1 warm-up
+# trace / 0 retraces — one JSON line
+# (python -m paddle_tpu.serving --smoke-speculative; docs/serving.md
+# "Speculative decoding")
+timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-speculative \
+    > "$ART/spec_smoke.json" 2> "$ART/spec_smoke.log"
+log "speculative smoke rc=$? -> $ART/spec_smoke.json"
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

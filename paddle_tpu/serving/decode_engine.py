@@ -159,7 +159,8 @@ class DecodeEngine:
                  pos_type="learned", metrics=None, name="lm", warm=True,
                  kv_layout="slab", kv_block_size=16, kv_num_blocks=0,
                  prefix_cache=True, prefill_chunk=0,
-                 prefill_chunk_budget=0, kv_dtype="float32"):
+                 prefill_chunk_budget=0, kv_dtype="float32",
+                 speculate_k=0, draft=None):
         from paddle_tpu.models import transformer
         self._transformer = transformer
         if params.get("dec"):
@@ -191,6 +192,38 @@ class DecodeEngine:
             raise ConfigError(
                 f"prefill_chunk={prefill_chunk} must be in "
                 f"[0, max_len={self.max_len}]")
+        # speculative decoding (serving/speculative.py; docs/serving.md
+        # "Speculative decoding"): a draft trunk proposes up to
+        # speculate_k tokens per slot, the ONE chunked step scores them
+        # all as verify lanes, and host-side acceptance commits the
+        # longest greedily-matched prefix via advance(consumed=).  The
+        # draft only ever changes SPEED — acceptance keeps exactly what
+        # the target would have emitted, so streams stay bit-identical
+        # to lm_generate.  k/acceptance/per-slot draft state are DATA:
+        # churn never retraces.
+        self.speculate_k = int(speculate_k or 0)
+        if self.speculate_k < 0 or self.speculate_k >= self.max_len:
+            raise ConfigError(
+                f"speculate_k={speculate_k} must be in "
+                f"[0, max_len={self.max_len})")
+        if self.speculate_k and not self.prefill_chunk:
+            raise ConfigError(
+                "speculate_k needs the unified chunked step "
+                "(prefill_chunk > 0): the verify step IS the chunk "
+                "step scoring draft lanes")
+        if draft is not None and not self.speculate_k:
+            raise ConfigError("a draft trunk without speculate_k > 0 "
+                              "would never run")
+        if self.speculate_k and draft is None:
+            raise ConfigError(
+                "speculate_k > 0 needs a draft (a DraftTrunk, or a "
+                "params tree to build one from — serving/speculative."
+                "make_draft derives one from the target's)")
+        # token-lane width: the chunk step's K dimension must hold the
+        # larger of a prefill chunk and a full verify span (the
+        # committed token + speculate_k draft lanes)
+        self._kk = (max(self.prefill_chunk, self.speculate_k + 1)
+                    if self.prefill_chunk else 0)
         self.prefill_buckets = tuple(sorted(set(int(b)
                                                 for b in prefill_buckets)))
         if not self.prefill_buckets or self.prefill_buckets[0] < 1:
@@ -255,12 +288,44 @@ class DecodeEngine:
         # adds the per-slot lane count (_len — per-slot variable
         # advance, the generalized position counter).
         if self.prefill_chunk:
-            self._tokens = np.zeros((self.num_slots, self.prefill_chunk),
+            self._tokens = np.zeros((self.num_slots, self._kk),
                                     np.int32)
             self._len = np.ones((self.num_slots,), np.int32)
         else:
             self._tokens = np.zeros((self.num_slots,), np.int32)
             self._len = None
+        # draft-side host bookkeeping (speculative mode).  Invariant per
+        # active slot: _d_pos + len(_d_feed) == _pos + 1 — every
+        # committed token (and nothing else) either sits in the draft
+        # cache or waits in the feed.  Rollout writes past the committed
+        # stream are NEVER counted: they are re-fed on commit, and the
+        # chunk step writes lanes BEFORE attending, so stale draft K/V
+        # is overwritten before anything reads it.
+        self._draft = None
+        if self.speculate_k:
+            from paddle_tpu.serving.speculative import DraftTrunk
+            if not isinstance(draft, DraftTrunk):
+                draft = DraftTrunk(
+                    draft, k=self.speculate_k, num_slots=self.num_slots,
+                    max_len=self.max_len,
+                    chunk=max(self.speculate_k + 2, self.prefill_chunk),
+                    num_heads=self.num_heads, moe_top_k=self.moe_top_k,
+                    pos_type=self.pos_type, name=f"{self.name}.draft",
+                    warm=False)
+            elif (draft.k != self.speculate_k
+                  or draft.num_slots != self.num_slots
+                  or draft.max_len < self.max_len):
+                raise ConfigError(
+                    f"draft trunk (k={draft.k}, slots={draft.num_slots}, "
+                    f"max_len={draft.max_len}) does not match the engine "
+                    f"(k={self.speculate_k}, slots={self.num_slots}, "
+                    f"max_len={self.max_len})")
+            self._draft = draft
+            self._d_feed = [[] for _ in range(self.num_slots)]
+            self._d_pos = np.zeros((self.num_slots,), np.int32)
+            self._d_last = np.zeros((self.num_slots,), np.int32)
+            self._spec_armed = {}      # slot -> k_eff armed for the next step
+            self._spec_result = {}     # slot -> accepted emission run
         self._pos = np.zeros((self.num_slots,), np.int32)
         self._free = list(range(self.num_slots))[::-1]   # pop() -> slot 0 first
         # epoch guard: reset() bumps it, step() refuses to commit across
@@ -278,19 +343,24 @@ class DecodeEngine:
         # step take the fused Pallas decode-attention path?
         self.decode_kernels = False
 
+        # all_lanes is a TRACE-TIME constant: a speculating engine's
+        # step returns EVERY lane's argmax [S, K] (the verify surface —
+        # host acceptance needs the target's pick after each draft
+        # lane); a plain chunked engine keeps the last-lane [S] output
+        spec = bool(self.speculate_k)
         if self.prefill_chunk and self.kv_layout == "paged":
             def _step_fn(p, cache, tokens, pos, lens, tables):
                 self._step_traces[0] += 1  # runs only under tracing
                 logits, cache = transformer.lm_decode_chunk_paged(
                     p, tokens, pos, lens, cache, tables, self.num_heads,
-                    self.moe_top_k, self.pos_type)
+                    self.moe_top_k, self.pos_type, all_lanes=spec)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
         elif self.prefill_chunk:
             def _step_fn(p, cache, tokens, pos, lens):
                 self._step_traces[0] += 1  # runs only under tracing
                 logits, cache = transformer.lm_decode_chunk_slots(
                     p, tokens, pos, lens, cache, self.num_heads,
-                    self.moe_top_k, self.pos_type)
+                    self.moe_top_k, self.pos_type, all_lanes=spec)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
         elif self.kv_layout == "paged":
             def _step_fn(p, cache, tokens, pos, tables):
@@ -476,6 +546,7 @@ class DecodeEngine:
         self._metrics = m
         m.set_prefill_chunk(self.prefill_chunk)
         m.set_kv_dtype(self.kv_dtype)
+        m.set_speculate_k(self.speculate_k)
         for eng in self._prefill_engines.values():
             eng.metrics = m
 
@@ -548,6 +619,10 @@ class DecodeEngine:
             self._free.append(slot)
             raise
         self._arm(slot, full[pre], pre)
+        # the draft cache holds NOTHING for this slot (the prefix index
+        # is target-only): the covered prefix joins the feed and drains
+        # through the draft's chunk ingest before speculation starts
+        self._draft_seed(slot, full[:pre + 1])
         return slot, [int(t) for t in full[pre + 1:]]
 
     def seat_chunked(self, full):
@@ -571,6 +646,7 @@ class DecodeEngine:
                 self._free.append(slot)
                 raise
         self._arm(slot, full[0], 0)
+        self._draft_seed(slot, full[:1])
         return slot, [int(t) for t in full[1:]]
 
     def load_chunk(self, slot, toks):
@@ -592,6 +668,98 @@ class DecodeEngine:
         """Lanes the next/current step feeds for ``slot`` (1 = plain
         decode)."""
         return int(self._len[slot]) if self.prefill_chunk else 1
+
+    @property
+    def speculating(self):
+        """True when a draft trunk is attached (``speculate_k > 0``)."""
+        return self._draft is not None
+
+    @property
+    def draft(self):
+        """The attached ``DraftTrunk`` (None unless speculating)."""
+        return self._draft
+
+    def _draft_seed(self, slot, toks):
+        """(Re)start a slot's draft bookkeeping: the draft cache holds
+        nothing for it yet, so ``toks`` (its committed context so far)
+        becomes the feed the next ``speculate`` calls drain through the
+        draft's chunk ingest.  Called at every seat and at eviction —
+        recovery/re-seat paths rebuild the draft cache through the same
+        one mechanism."""
+        if self._draft is None:
+            return
+        self._d_feed[slot] = [int(t) for t in toks]
+        self._d_pos[slot] = 0
+        self._d_last[slot] = 0
+        self._spec_armed.pop(slot, None)
+        self._spec_result.pop(slot, None)
+
+    def speculate(self, budgets):
+        """ONE batched draft rollout, strictly between steps: drain up
+        to a chunk of every active slot's committed-token feed into the
+        draft cache, then arm draft lanes for each slot in ``budgets``
+        (slot -> remaining emission allowance) whose feed fully drained
+        THIS call — the rollout's candidates are only fresh for those.
+        Arms lanes 1..k_eff of the verify span (lane 0 stays the
+        committed token) with ``k_eff = min(speculate_k, budget - 1,
+        room to max_len)`` and returns {slot: k_eff}.  Everything here
+        is data — feed lengths, positions, acceptance — so speculation
+        churn never retraces the rollout or the step."""
+        if self._draft is None:
+            return {}
+        chunk = self._draft.chunk
+        tokens = np.zeros((self.num_slots, chunk), np.int32)
+        positions = np.zeros((self.num_slots,), np.int32)
+        lengths = np.ones((self.num_slots,), np.int32)
+        fed = {}
+        free_set = set(self._free)
+        for slot in range(self.num_slots):
+            if slot in free_set:
+                continue
+            feed = self._d_feed[slot]
+            take = min(chunk, len(feed))
+            if take:
+                tokens[slot, :take] = feed[:take]
+                positions[slot] = self._d_pos[slot]
+                lengths[slot] = take
+                fed[slot] = take
+            else:
+                # nothing pending: idempotently re-feed the last
+                # ingested token (identical K/V rewrite) instead of
+                # special-casing the row out of the fixed-shape call
+                tokens[slot, 0] = self._d_last[slot]
+                positions[slot] = max(int(self._d_pos[slot]) - 1, 0)
+        drafts = self._draft.rollout(tokens, positions, lengths)
+        if drafts is None:
+            return {}       # reset() raced the rollout: arm nothing
+        for slot, take in fed.items():
+            self._d_last[slot] = self._d_feed[slot][take - 1]
+            del self._d_feed[slot][:take]
+            self._d_pos[slot] += take
+        armed = {}
+        for slot, budget in budgets.items():
+            if fed.get(slot) is None or self._d_feed[slot]:
+                continue    # feed not fully drained: candidates stale
+            k_eff = min(self.speculate_k, int(budget) - 1,
+                        self.max_len - 1 - int(self._pos[slot]))
+            if k_eff < 1:
+                continue
+            self._tokens[slot, 1:1 + k_eff] = drafts[slot, :k_eff]
+            self._tokens[slot, 1 + k_eff:] = 0
+            self._len[slot] = 1 + k_eff
+            self._spec_armed[slot] = k_eff
+            armed[slot] = k_eff
+        return armed
+
+    def take_spec_result(self, slot):
+        """Pop the last step's accepted run for ``slot``: the matched
+        draft tokens followed by the target's own argmax at the first
+        mismatch (so a run is never empty — every verify step nets at
+        least the token a plain step would have produced).  None if the
+        slot was not speculating that step."""
+        if self._draft is None:
+            return None
+        return self._spec_result.pop(slot, None)
 
     def register_context(self, slot, tokens):
         """Publish a fully-ingested context's prompt prefix into the
@@ -816,6 +984,7 @@ class DecodeEngine:
         if self.kv_layout == "paged":
             self._paged.evict(slot)
         self._arm(slot, 0, 0)
+        self._draft_seed(slot, [])
         self._free.append(slot)
         self.metrics.evict_slot(reason)
 
@@ -835,6 +1004,12 @@ class DecodeEngine:
         params, cache = self.params, self._cache
         tokens, pos = self._tokens.copy(), self._pos.copy()
         lens = self._len.copy() if self.prefill_chunk else None
+        # verify spans armed for THIS step (speculative mode); popped
+        # with the snapshot so an eviction racing the step can never
+        # resurrect a stale acceptance
+        spec_armed = {}
+        if self._draft is not None:
+            spec_armed, self._spec_armed = self._spec_armed, {}
         # the fault point sits at the device-step boundary: a hang here
         # models a wedged device step for the watchdog to catch
         faults.hit("serving.decode_step")
@@ -862,9 +1037,39 @@ class DecodeEngine:
         # (the chunked-prefill occupancy surface)
         chunk_lanes = int(lens.sum() - self.num_slots) if lens is not None \
             else 0
+        kw = {}
+        if self._draft is not None:
+            # speculating step output is EVERY lane's argmax [S, K]:
+            # row[i] is the target's greedy pick after lane i.
+            # Acceptance per armed slot: lanes 1..k_eff held drafts
+            # d_1..d_k; the matched prefix is the run of d_{i+1} ==
+            # row[i], and row[j] at the first mismatch is the target's
+            # OWN next token — the accepted run row[:j+1] is exactly
+            # what sequential greedy decode would have emitted, which is
+            # the whole bit-identity argument.  Non-speculating rows
+            # reduce to their last fed lane, same as a plain engine.
+            rows = nxt
+            nxt = rows[np.arange(self.num_slots), lens - 1]
+            accepted = drafted = 0
+            for slot, k_eff in spec_armed.items():
+                row, want = rows[slot], tokens[slot, 1:1 + k_eff]
+                j = 0
+                while j < k_eff and int(row[j]) == int(want[j]):
+                    j += 1
+                self._spec_result[slot] = [int(t) for t in row[:j + 1]]
+                accepted += j
+                drafted += k_eff
+            # draft lanes are speculation, not prompt ingestion: keep
+            # them out of the prefill-occupancy surface
+            chunk_lanes -= drafted
+            # kwargs passed ONLY in spec mode: test spies subclassing
+            # observe_decode_step with the old signature stay valid on
+            # non-speculating engines
+            kw = dict(accepted_tokens=accepted, drafted_tokens=drafted,
+                      spec_slots=len(spec_armed))
         self.metrics.observe_decode_step(self.num_active, self.num_slots,
                                          time.perf_counter() - t0,
-                                         prefill_lanes=chunk_lanes)
+                                         prefill_lanes=chunk_lanes, **kw)
         if self.kv_layout == "paged":
             self.metrics.set_kv_pool(self._paged.pool.num_free,
                                      self._paged.pool.num_allocatable)
@@ -875,12 +1080,25 @@ class DecodeEngine:
         past the ``consumed`` lanes the last step processed (1 = plain
         decode; a chunked step advances by its lane count — the
         per-slot variable advance)."""
+        if self._draft is not None:
+            # every committed token re-feeds the draft cache (matched
+            # drafts rewrite identical K/V; a mismatch feeds the
+            # corrected token over the stale rollout write) — lanes
+            # 1..consumed-1 are read BEFORE lane 0 is overwritten
+            self._d_feed[slot].extend(
+                [int(t) for t in self._tokens[slot, 1:consumed]]
+                + [int(token)])
         if self.prefill_chunk:
             self._tokens[slot, 0] = token
             self._len[slot] = 1
         else:
             self._tokens[slot] = token
         self._pos[slot] += consumed
+        if self._draft is not None and self._paged is not None:
+            # paged rollback (kv_pool.truncate): release blocks the
+            # verify span provisioned past the committed stream —
+            # keeping the block the next write lands in
+            self._paged.truncate(slot, int(self._pos[slot]) + 1)
 
     def reset(self):
         """Drop all slot state and re-zero the cache slab (the batch-
@@ -914,6 +1132,15 @@ class DecodeEngine:
         if self.prefill_chunk:
             self._len[:] = 1
         self._free = list(range(self.num_slots))[::-1]
+        if self._draft is not None:
+            # BOTH caches rebuild: recovery re-seats every stream and
+            # its context re-feeds the draft through _draft_seed
+            self._draft.reset()
+            self._d_feed = [[] for _ in range(self.num_slots)]
+            self._d_pos[:] = 0
+            self._d_last[:] = 0
+            self._spec_armed.clear()
+            self._spec_result.clear()
 
     # ------------------------------------------------------------ warm-up
 
@@ -945,10 +1172,14 @@ class DecodeEngine:
             self.decode_kernels = _dk.covers(
                 self.num_heads, d, dkv, blk_len,
                 paged=self.kv_layout == "paged",
-                chunk=self.prefill_chunk or 1,
+                chunk=self._kk or 1,
                 quant=self.kv_dtype == "int8")
         self.metrics.set_prefill_chunk(self.prefill_chunk)
         self.metrics.set_kv_dtype(self.kv_dtype)
+        self.metrics.set_speculate_k(self.speculate_k)
+        if self._draft is not None:
+            # the draft rollout is its own ONE warm-up trace
+            self._draft.warmup()
         if self.prefill_chunk:
             if self.kv_layout == "paged":
                 # the CoW fork is the only other device op the chunked
@@ -980,11 +1211,13 @@ class DecodeEngine:
             self._warm = True
             logger.info(
                 "decode[%s]: warm (%d slots, max_len %d, kv %s/%s, decode "
-                "kernels %s, chunked prefill K=%d budget=%s)", self.name,
+                "kernels %s, chunked prefill K=%d budget=%s, "
+                "speculate_k=%d)", self.name,
                 self.num_slots, self.max_len, self.kv_layout,
                 self.kv_dtype,
                 "fused-pallas" if self.decode_kernels else "xla-ref",
-                self.prefill_chunk, self.prefill_chunk_budget or "inf")
+                self.prefill_chunk, self.prefill_chunk_budget or "inf",
+                self.speculate_k)
             return
         if self.kv_layout == "paged":
             # ONE block-write shape and ONE fork shape serve every
@@ -1036,9 +1269,15 @@ class DecodeEngine:
     def lower(self, what="step"):
         """``jax.stages.Lowered`` of the slab decode step (default) or of
         one prefill bucket (``what=<bucket int>``) — the ``extras
-        ["lower"]`` analytic hook (perf/analytic.py).  Offline tool: it
-        re-stages the function (one extra trace), like
+        ["lower"]`` analytic hook (perf/analytic.py).  ``what="draft"``
+        lowers the attached draft trunk's rollout instead.  Offline
+        tool: it re-stages the function (one extra trace), like
         ``InferenceEngine.lower``."""
+        if what == "draft":
+            if self._draft is None:
+                raise ConfigError(
+                    f"{self.name}: no draft trunk (speculate_k=0)")
+            return self._draft.lower()
         if what == "step":
             if self.prefill_chunk and self.kv_layout == "paged":
                 return self._jit_step.lower(self.params, self._cache,
@@ -1745,6 +1984,58 @@ class GenerationBatcher:
             req.slot_span.event("prefill_chunk", lanes=int(n),
                                 pos=int(self.engine._pos[slot]))
 
+    def _load_spec(self):
+        """Speculative mode, strictly between steps (after
+        ``_load_chunks``): one batched draft rollout drains every active
+        slot's committed-token feed, then draft lanes arm for the slots
+        that are PURELY decoding — a slot still chunk-ingesting keeps
+        its prefill lanes and joins speculation once its feed drains, so
+        ingestion and speculation coexist across slots in the SAME step.
+        Budgets cap each verify span at the request's remaining emission
+        allowance (a run can never overshoot max_tokens)."""
+        budgets = {}
+        for slot, req in self._by_slot.items():
+            if req.replay_feed or req.abandoned:
+                continue
+            budgets[slot] = req.max_tokens - len(req.tokens)
+        for slot, k_eff in self.engine.speculate(budgets).items():
+            self._by_slot[slot].slot_span.event(
+                "speculate", k=int(k_eff),
+                pos=int(self.engine._pos[slot]))
+
+    def _emit_spec_run(self, req, slot, run):
+        """Deliver one verify step's accepted run (matched drafts + the
+        target's own token at the first mismatch) with full per-token
+        semantics: EOS inside the run finishes the stream THERE (the
+        trailing accepted tokens are discarded — the engine never
+        advances past what was delivered), and max_tokens can end it
+        mid-run.  A surviving stream advances past the whole run in one
+        ``advance(consumed=)``."""
+        emitted = 0
+        for tok in run:
+            first_emit = req.t_first is None
+            req.emit(tok, self.name)
+            emitted += 1
+            if first_emit:
+                req.slot_span.event("first_token")
+                self.metrics.observe_ttft(req.t_first - req.t_submit)
+                if req.replay_ctx is None:
+                    self.engine.register_context(slot, req.prompt)
+            self.metrics.observe_gen_tokens(1)
+            if req.eos_id is not None and tok == req.eos_id:
+                req.slot_span.event("accept", accepted=len(run) - 1,
+                                    emitted=emitted, finish="eos")
+                self._finish(req, "eos")
+                return
+            if len(req.tokens) >= req.max_tokens:
+                req.slot_span.event("accept", accepted=len(run) - 1,
+                                    emitted=emitted, finish="length")
+                self._finish(req, "length")
+                return
+        req.slot_span.event("accept", accepted=len(run) - 1,
+                            emitted=emitted)
+        self.engine.advance(slot, run[-1], len(run))
+
     def _snap_breaker(self):
         """Mirror the breaker's state into the metrics gauge."""
         b = self.supervisor.breaker
@@ -1872,6 +2163,8 @@ class GenerationBatcher:
             sup = self.supervisor
             if self.engine.chunked:
                 self._load_chunks()
+                if self.engine.speculating:
+                    self._load_spec()
             try:
                 # paged layout: provision every active slot's write block
                 # (chain growth + copy-on-write forks) strictly BETWEEN
@@ -1937,6 +2230,13 @@ class GenerationBatcher:
                     # the feed drained EXACTLY at this step's last lane:
                     # its emission is the first real one — fall through
                     del req.replay_feed[:]
+                if self.engine.speculating:
+                    run = self.engine.take_spec_result(slot)
+                    if run is not None:
+                        # a verify step: the whole accepted run emits in
+                        # one go (and does its own advance/finish)
+                        self._emit_spec_run(req, slot, run)
+                        continue
                 tok = int(nxt[slot])
                 first_emit = req.t_first is None
                 req.emit(tok, self.name)

@@ -395,6 +395,32 @@ class PagedKVState:
         self.tables[slot, j] = dst
         return ("cow", j, src, dst)
 
+    def truncate(self, slot, n_positions):
+        """Roll back ``slot``'s chain to the blocks covering
+        ``[0, n_positions)`` — the speculative-decoding rejection path
+        (docs/serving.md "Speculative decoding"): ``prepare_step``
+        provisioned blocks for the whole drafted span before the verify
+        step, but acceptance committed fewer positions, so the tail
+        blocks past the committed span release back to the pool.  Their
+        contents need no scrubbing: the attention mask stops at each
+        lane's own position, and a later write into those positions
+        re-provisions a block and overwrites it in the same step that
+        first unmasks it.  A shared tail block (possible when a prefix
+        seat over-covered) only drops this slot's reference.  Returns
+        the number of blocks released."""
+        keep = self.blocks_for(n_positions)
+        chain = self._chains[slot]
+        dropped = 0
+        while len(chain) > keep:
+            bid = chain.pop()
+            self.tables[slot, len(chain)] = SCRATCH_BLOCK
+            self.pool.release(bid)
+            dropped += 1
+        if dropped:
+            obstrace.instant("kv.truncate", slot=slot, blocks=dropped,
+                             free=self.pool.num_free)
+        return dropped
+
     def victim(self, exclude):
         """Youngest active slot outside ``exclude`` (pool-pressure
         preemption order), or None."""

@@ -93,6 +93,13 @@ class ServingMetrics:
         self.prefill_lane_steps_total = 0   # sum of per-step chunk lanes
         self.prefill_chunk_size = 0      # gauge: engine K (0 = ladder)
         self.evictions = {r: 0 for r in EVICT_REASONS}
+        # ---- speculative decoding (serving/speculative.py): draft
+        # lanes scored by verify steps and how many the target accepted
+        self.speculate_k = 0             # gauge: draft lanes per slot (0=off)
+        self.drafted_tokens_total = 0    # draft lanes scored
+        self.accepted_tokens_total = 0   # draft lanes accepted (matched)
+        self.spec_steps_total = 0        # steps that verified >= 1 span
+        self.spec_slot_steps_total = 0   # sum of speculating slots over steps
         # ---- paged KV cache (decode_engine.py kv_layout="paged" over
         # serving/kv_pool.py): block-pool gauges + prefix-sharing and
         # copy-on-write counters
@@ -149,15 +156,26 @@ class ServingMetrics:
         self.ttft.add(seconds)
 
     def observe_decode_step(self, n_active, n_slots, seconds,
-                            prefill_lanes=0):
+                            prefill_lanes=0, accepted_tokens=0,
+                            drafted_tokens=0, spec_slots=0):
         """One slab decode step: n_active of n_slots held live requests;
         prefill_lanes = teacher-forced chunk lanes the step fed beyond
-        each slot's own token (0 outside chunked-prefill mode)."""
+        each slot's own token (0 outside chunked-prefill mode).
+        Speculative mode adds drafted_tokens (draft lanes the step
+        scored), accepted_tokens (lanes the target matched) and
+        spec_slots (slots that speculated) — the engine passes these
+        kwargs ONLY when a draft trunk is attached, so subclasses with
+        the pre-speculation signature keep working unchanged."""
         with self._lock:
             self.decode_steps_total += 1
             self.active_slot_steps_total += int(n_active)
             self.slot_count = int(n_slots)
             self.prefill_lane_steps_total += int(prefill_lanes)
+            self.drafted_tokens_total += int(drafted_tokens)
+            self.accepted_tokens_total += int(accepted_tokens)
+            if spec_slots:
+                self.spec_steps_total += 1
+                self.spec_slot_steps_total += int(spec_slots)
         self.tpot.add(seconds)
 
     def observe_prefill_chunk(self, lanes):
@@ -171,6 +189,13 @@ class ServingMetrics:
         """Gauge: the engine's chunk size K (0 = legacy ladder mode)."""
         with self._lock:
             self.prefill_chunk_size = int(k)
+
+    def set_speculate_k(self, k):
+        """Gauge: the engine's draft lanes per slot (0 = speculation
+        off).  Config, like the chunk gauge: the engine's metrics-swap
+        setter re-applies it so a fresh object inherits it."""
+        with self._lock:
+            self.speculate_k = int(k)
 
     def observe_gen_tokens(self, n=1):
         with self._lock:
@@ -268,6 +293,25 @@ class ServingMetrics:
                    * max(0, self.prefill_chunk_size - 1))
             return (self.prefill_lane_steps_total / cap) if cap else 0.0
 
+    @property
+    def spec_acceptance_rate(self):
+        """Fraction of drafted lanes the target accepted (speculative
+        decoding quality; 0.0 with no drafts scored)."""
+        with self._lock:
+            return (self.accepted_tokens_total / self.drafted_tokens_total
+                    if self.drafted_tokens_total else 0.0)
+
+    @property
+    def spec_tokens_per_step(self):
+        """Mean emitted tokens per speculating slot-step (each verify
+        span emits its accepted run + the target's own token, so this is
+        >= 1.0 whenever speculation ran; the headline effective-tokens-
+        per-target-step number).  0.0 with no speculation."""
+        with self._lock:
+            return ((self.accepted_tokens_total + self.spec_slot_steps_total)
+                    / self.spec_slot_steps_total
+                    if self.spec_slot_steps_total else 0.0)
+
     def tpot_jitter(self):
         """Recent-window TPOT p99/p50 ratio — the jitter a long-prompt
         admission injects into in-flight streams' token cadence (1.0 =
@@ -308,6 +352,11 @@ class ServingMetrics:
                 "prefill_chunk_lanes_total":
                     self.prefill_chunk_lanes_total,
                 "prefill_chunk_size": self.prefill_chunk_size,
+                "speculate_k": self.speculate_k,
+                "drafted_tokens_total": self.drafted_tokens_total,
+                "accepted_tokens_total": self.accepted_tokens_total,
+                "spec_steps_total": self.spec_steps_total,
+                "spec_slot_steps_total": self.spec_slot_steps_total,
                 "evictions": dict(self.evictions),
                 "kv_blocks_total": self.kv_blocks_total,
                 "kv_blocks_free": self.kv_blocks_free,
@@ -337,6 +386,8 @@ class ServingMetrics:
         out["mean_prefill_chunk_occupancy"] = round(
             self.mean_prefill_chunk_occupancy, 4)
         out["tpot_jitter_p99_p50"] = round(self.tpot_jitter(), 3)
+        out["spec_acceptance_rate"] = round(self.spec_acceptance_rate, 4)
+        out["spec_tokens_per_step"] = round(self.spec_tokens_per_step, 4)
         out["latency_ms"] = {f"p{q}": round(v * 1e3, 3)
                              for q, v in lat.items()}
         out["batch_time_ms"] = {f"p{q}": round(v * 1e3, 3)
@@ -432,6 +483,17 @@ class ServingMetrics:
                  self.prefill_chunk_lanes_total,
                  "teacher-forced chunk lanes fed through the unified "
                  "decode step (chunked prefill)"),
+                ("drafted_tokens_total", self.drafted_tokens_total,
+                 "draft lanes scored by verify steps (speculative "
+                 "decoding)"),
+                ("accepted_tokens_total", self.accepted_tokens_total,
+                 "draft lanes the target accepted (speculative "
+                 "decoding)"),
+                ("spec_steps_total", self.spec_steps_total,
+                 "decode steps that verified at least one draft span"),
+                ("spec_slot_steps_total", self.spec_slot_steps_total,
+                 "per-slot verify spans scored (speculating slots "
+                 "summed over steps)"),
             ]
             evictions = dict(self.evictions)
             slot_count = self.slot_count
@@ -439,6 +501,7 @@ class ServingMetrics:
             kv_free = self.kv_blocks_free
             kv_int8 = self.kv_dtype == "int8"
             chunk_size = self.prefill_chunk_size
+            spec_k = self.speculate_k
         for metric, value, help_ in gen_counters:
             emit(metric, value, help_, mtype="counter")
         emit("prefill_chunk_size", chunk_size,
@@ -446,6 +509,13 @@ class ServingMetrics:
         emit("prefill_chunk_occupancy_mean",
              f"{self.mean_prefill_chunk_occupancy:.6f}",
              "fraction of per-step chunk-lane capacity fed")
+        emit("speculate_k", spec_k,
+             "draft lanes per slot per verify step (0 = speculation off)")
+        emit("spec_acceptance_rate", f"{self.spec_acceptance_rate:.6f}",
+             "fraction of drafted lanes the target accepted")
+        emit("spec_tokens_per_step", f"{self.spec_tokens_per_step:.6f}",
+             "mean emitted tokens per speculating slot-step (>= 1 when "
+             "speculation runs)")
         emit("tpot_jitter_p99_p50", f"{self.tpot_jitter():.6f}",
              "recent-window TPOT p99/p50 ratio (token-cadence jitter)")
         emit("kv_blocks_total", kv_total,

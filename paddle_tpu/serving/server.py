@@ -82,6 +82,20 @@ CLI (``python -m paddle_tpu.serving``):
                                    exact vs the quantized oracle,
                                    kv_blocks_total doubled, ONE JSON
                                    line (healthy_window.sh phase 16)
+  --speculate-k K                  speculative decoding: a truncated-
+                                   trunk draft proposes K tokens per
+                                   slot, the one chunked step scores
+                                   every lane, each step nets >= 1
+                                   token; streams stay token-identical
+                                   (docs/serving.md "Speculative
+                                   decoding")
+  --draft-layers N                 trunk depth of the derived draft
+                                   (embedding/vocab shared)
+  --smoke-speculative              speculative-decoding self-test: spec
+                                   engine vs a non-spec twin, streams
+                                   bit-identical, acceptance evidence
+                                   in /metrics, ONE JSON line
+                                   (healthy_window.sh phase 18)
 
 The JSON front-end serves plain-array feed slots (dense/index vectors);
 structured SequenceBatch slots are an in-process engine feature.
@@ -575,6 +589,15 @@ def _demo_gen_batcher(args, tiny=False, metrics=None):
         # and every step variant accept the quantized tree directly
         from paddle_tpu.quant.weights import quantize_lm
         params = quantize_lm(params)
+    speculate_k = int(getattr(args, "speculate_k", 0) or 0)
+    draft = None
+    if speculate_k:
+        # the draft shares the target's embedding/vocab — a quantized
+        # target hands the draft its quantized tree, which every step
+        # variant dequantizes in place
+        from paddle_tpu.serving.speculative import make_draft
+        draft = make_draft(params,
+                           layers=getattr(args, "draft_layers", 1))
     engine = DecodeEngine(params, num_heads=2, num_slots=slots,
                           max_len=max_len, prefill_buckets=buckets,
                           name="demo_lm", metrics=metrics,
@@ -585,7 +608,8 @@ def _demo_gen_batcher(args, tiny=False, metrics=None):
                           kv_dtype=getattr(args, "kv_dtype", "float32"),
                           prefill_chunk=getattr(args, "prefill_chunk", 0),
                           prefill_chunk_budget=getattr(
-                              args, "prefill_chunk_budget", 0))
+                              args, "prefill_chunk_budget", 0),
+                          speculate_k=speculate_k, draft=draft)
     # supervision on by default for the generation plane: the breaker
     # and recovery are pure host bookkeeping (zero cost absent failures);
     # the step watchdog only arms when a deadline is configured
@@ -1239,6 +1263,91 @@ def _smoke_quant(args):
     return 0 if passed else 2
 
 
+def _smoke_speculative(args):
+    """Speculative-decoding self-test (healthy_window.sh phase 18;
+    docs/serving.md "Speculative decoding"): the demo LM behind a
+    speculating engine (1-layer draft riding the chunked step) serving
+    concurrent staggered clients, every stream compared byte-for-byte
+    against a NON-speculating twin of the same trunk — the draft may
+    only ever change speed.  Acceptance evidence must land on the
+    /metrics surface (drafted/accepted counters + the derived
+    acceptance rate the snapshot carries), and both engines must hold
+    the one-warm-up-trace discipline under acceptance churn.  Prints
+    ONE JSON line; returns the process exit code."""
+    import copy
+    import threading
+
+    spec_args = copy.copy(args)
+    spec_args.prefill_chunk = min(4, args.prefill_chunk or 4) or 4
+    spec_args.speculate_k = max(1, getattr(args, "speculate_k", 0) or 3)
+    spec_args.draft_layers = max(1, getattr(args, "draft_layers", 1) or 1)
+    gen = _demo_gen_batcher(spec_args, tiny=True)
+    twin_args = copy.copy(spec_args)
+    twin_args.speculate_k = 0
+    twin = _demo_gen_batcher(twin_args, tiny=True)
+    rng = np.random.RandomState(0)
+    cases = [(rng.randint(1, 256, int(n)).astype(np.int64), int(m))
+             for n, m in ((4, 12), (9, 8), (3, 14), (12, 10))]
+    errs, results, ref = [], [None] * len(cases), [None] * len(cases)
+    trace_spec = (gen.engine.step_trace_count,
+                  gen.engine.draft.trace_count)
+    try:
+        def client(bat, out, i):
+            p, mt = cases[i]
+            time.sleep(0.002 * i)
+            out[i] = bat.submit(p, max_tokens=mt).result(120)["tokens"]
+
+        for bat, out in ((gen, results), (twin, ref)):
+            ts = [threading.Thread(target=client, args=(bat, out, i))
+                  for i in range(len(cases))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(180)
+        requests_ok = sum(r is not None for r in results)
+        bit_identical = results == ref and None not in results
+    except Exception as e:      # noqa: BLE001 — a probe failure must
+        # become a failed flag in the ONE JSON line, not a traceback
+        errs.append(f"{type(e).__name__}: {e}")
+        requests_ok, bit_identical = 0, False
+    no_retrace = ((gen.engine.step_trace_count,
+                   gen.engine.draft.trace_count) == trace_spec == (1, 1))
+    snap = gen.metrics.snapshot()
+    metrics_text = gen.metrics.render_prometheus()
+    name = gen.metrics.name
+    metrics_sane = (
+        f"{name}_drafted_tokens_total "
+        f"{snap['drafted_tokens_total']}" in metrics_text
+        and f"{name}_accepted_tokens_total "
+            f"{snap['accepted_tokens_total']}" in metrics_text
+        and f"{name}_speculate_k {spec_args.speculate_k}" in metrics_text
+        and "_spec_acceptance_rate " in metrics_text)
+    out = {
+        "metric": "speculative serving smoke (spec engine vs non-spec "
+                  "twin)",
+        "value": requests_ok, "unit": f"requests_ok/{len(cases)}",
+        "vs_baseline": None,
+        "speculate_k": spec_args.speculate_k,
+        "draft_layers": spec_args.draft_layers,
+        "bit_identical": bool(bit_identical),
+        "drafted_tokens_total": snap["drafted_tokens_total"],
+        "accepted_tokens_total": snap["accepted_tokens_total"],
+        "spec_acceptance_rate": snap["spec_acceptance_rate"],
+        "spec_tokens_per_step": snap["spec_tokens_per_step"],
+        "no_retrace": bool(no_retrace),
+        "metrics_sane": bool(metrics_sane),
+    }
+    if errs:
+        out["errors"] = errs[:5]
+    gen.close()
+    twin.close()
+    print(json.dumps(out), flush=True)
+    passed = (requests_ok == len(cases) and bit_identical and no_retrace
+              and metrics_sane and snap["drafted_tokens_total"] > 0
+              and snap["spec_tokens_per_step"] >= 1.0)
+    return 0 if passed else 2
+
+
 def _write_port_file(path, port):
     """Publish the BOUND port (meaningful with --port 0) atomically —
     the fleet supervisor (serving/fleet.py) spawns replicas on ephemeral
@@ -1318,6 +1427,18 @@ def main(argv=None):
                     help="max teacher-forced chunk lanes per step "
                          "across all slots (bounds TPOT jitter; "
                          "0 = unbounded)")
+    # ---- speculative decoding (docs/serving.md "Speculative decoding")
+    ap.add_argument("--speculate-k", type=int,
+                    default=FLAGS.serving_speculate_k,
+                    help="draft tokens proposed per feeding slot per "
+                         "step; the one chunked step scores every "
+                         "drafted lane and each step nets 1 + accepted "
+                         "tokens (0 = off; requires --prefill-chunk)")
+    ap.add_argument("--draft-layers", type=int,
+                    default=FLAGS.serving_draft_layers,
+                    help="trunk depth of the draft derived from the "
+                         "target (first N enc blocks; embedding/vocab "
+                         "shared)")
     ap.add_argument("--pallas-prefill", default=FLAGS.pallas_prefill,
                     help="route the legacy ladder's lm_prefill causal "
                          "pass through the flash kernel (no [Tp, Tp] "
@@ -1365,6 +1486,11 @@ def main(argv=None):
                          "quality budget, int8-KV+weights engine exact "
                          "vs the quantized oracle, kv_blocks_total "
                          "doubled at equal bytes; one JSON line, exit")
+    ap.add_argument("--smoke-speculative", action="store_true",
+                    help="speculative-decoding self-test: spec engine "
+                         "vs a non-spec twin under concurrent clients, "
+                         "streams bit-identical, acceptance-rate "
+                         "evidence in /metrics; one JSON line, exit")
     # ---- resilience (docs/serving.md §6) ----
     ap.add_argument("--drain-timeout-s", type=float,
                     default=FLAGS.serving_drain_timeout_s,
@@ -1419,6 +1545,8 @@ def main(argv=None):
         return _smoke_chunked(args)
     if args.smoke_quant:
         return _smoke_quant(args)
+    if args.smoke_speculative:
+        return _smoke_speculative(args)
     if args.demo_generate and not (args.artifact or args.artifacts
                                    or args.demo):
         # generation-only server: no /v1/infer batcher

@@ -1,0 +1,182 @@
+"""Speculative decoding: the draft trunk and its jitted k-token rollout.
+
+Leviathan-style greedy draft/verify on the slot engine
+(docs/serving.md "Speculative decoding").  A small trunk — fewer layers
+(and optionally fewer heads / int8 weights) than the target, sharing the
+target's embedding and vocab — runs its OWN k-token autoregressive
+rollout per slot against a private slab KV cache, and the TARGET's one
+chunked step (``lm_decode_chunk_slots``/``_paged`` with
+``all_lanes=True``) then scores every drafted lane at once.  The draft
+only ever changes SPEED: acceptance keeps exactly the longest prefix the
+target itself would have emitted greedily, so streams stay token-
+identical to ``lm_generate`` no matter how good or bad the draft is.
+
+Trace discipline matches the target engine: ONE jitted rollout function
+(chunk-ingest the committed tokens, then k-1 static-unrolled single-
+position steps), warmed exactly once; k is a constructor constant and
+per-slot feed lengths/positions are data, so acceptance churn never
+retraces.  The draft cache is epoch-guarded like the target's
+(``reset()`` bumps the epoch; an in-flight rollout's cache commit is
+dropped if it lost the race) — PR 6 supervisor recovery resets BOTH
+caches and the re-seat replay rebuilds them through the same feed path.
+
+Bookkeeping contract with ``DecodeEngine`` (the ``_d_feed``/``_d_pos``
+invariant): rollout K/V writes past the committed stream are NEVER
+counted as ingested.  The engine re-feeds every committed token through
+``rollout`` (matched drafts re-feed identical values; mismatches feed
+the corrected token), and because the chunk step writes all lanes
+BEFORE attending, stale rollout writes at those positions are
+overwritten before anything reads them — the slab needs no rollback at
+all.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.models import transformer
+from paddle_tpu.testing.trace import expect_traces
+from paddle_tpu.utils.error import ConfigError
+
+
+def make_draft(params, layers=2, quantize=False):
+    """Derive a draft parameter tree from the target's: same embedding /
+    positional table / final LN / vocab (ARRAYS SHARED, not copied — the
+    draft adds only ``layers`` blocks of weight bytes), trunk truncated
+    to the first ``layers`` enc blocks.  The shallow trunk stays a
+    well-formed LM the transformer entry points accept unchanged; with
+    ``quantize=True`` the blocks are int8-quantized via PR 14's
+    ``quant/weights.py`` (the shared embedding is quantized too — the
+    target holds its own float copy, so this only narrows the draft's
+    weight stream)."""
+    n = len(params["enc"])
+    if not 1 <= layers <= n:
+        raise ConfigError(
+            f"draft layers must be in [1, {n}] (the target's enc depth), "
+            f"got {layers}")
+    draft = dict(params)
+    draft["enc"] = list(params["enc"][:layers])
+    if quantize:
+        from paddle_tpu.quant import weights as qw
+        draft = qw.quantize_lm(draft)
+    return draft
+
+
+class DraftTrunk:
+    """The draft model half of speculative decoding: slab KV cache with
+    the target engine's slot indexing, one jitted rollout producing k
+    greedy draft tokens per slot per call.
+
+    ``rollout(tokens, positions, lengths)``: chunk-ingest each row's
+    ``lengths[r]`` committed tokens starting at ``positions[r]`` (lanes
+    past the length are ignored), then unroll ``k - 1`` single-position
+    steps feeding the draft's own argmax back in.  Returns drafts
+    [num_slots, k] (row r's candidates for stream positions
+    ``positions[r] + lengths[r] ..``) — or None if ``reset()`` won the
+    epoch race mid-call (the caller arms nothing and retries next step).
+    """
+
+    def __init__(self, params, *, k, num_slots, max_len, chunk,
+                 num_heads=8, moe_top_k=2, pos_type="learned",
+                 name="draft", warm=False):
+        if k < 1:
+            raise ConfigError(f"speculate_k must be >= 1, got {k}")
+        if chunk < 1:
+            raise ConfigError(f"draft chunk must be >= 1, got {chunk}")
+        self.params = params
+        self.k = int(k)
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.chunk = int(chunk)
+        self.num_heads = num_heads
+        self.moe_top_k = moe_top_k
+        self.pos_type = pos_type
+        self.name = name
+        self._trace = [0]
+        self._warm = False
+        self._epoch = 0
+        self._epoch_lock = threading.Lock()
+        self._cache = transformer.init_lm_cache(params, self.num_slots,
+                                                self.max_len)
+
+        def _draft_fn(p, cache, tokens, positions, lengths):
+            self._trace[0] += 1
+            logits, cache = transformer.lm_decode_chunk_slots(
+                p, tokens, positions, lengths, cache, self.num_heads,
+                self.moe_top_k, self.pos_type)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            drafts = [nxt]
+            # rollout writes land past the committed stream; the clamp
+            # keeps the scatter in-bounds for rows parked at the cache
+            # edge (their junk write is re-fed before anything attends)
+            base = positions + lengths
+            for i in range(self.k - 1):
+                qp = jnp.minimum(base + i, self.max_len - 1)
+                logits, cache = transformer.lm_decode_step_slots(
+                    p, nxt, qp, cache, self.num_heads, self.moe_top_k,
+                    self.pos_type)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                drafts.append(nxt)
+            return jnp.stack(drafts, axis=1), cache
+
+        self._jit = jax.jit(_draft_fn, donate_argnums=(1,))
+        if warm:
+            self.warmup()
+
+    @property
+    def trace_count(self):
+        return self._trace[0]
+
+    def _dummy_feed(self):
+        tokens = np.zeros((self.num_slots, self.chunk), np.int32)
+        positions = np.zeros((self.num_slots,), np.int32)
+        lengths = np.ones((self.num_slots,), np.int32)
+        return tokens, positions, lengths
+
+    def rollout(self, tokens, positions, lengths):
+        with self._epoch_lock:
+            epoch = self._epoch
+        drafts, cache = self._jit(self.params, self._cache,
+                                  jnp.asarray(tokens, jnp.int32),
+                                  jnp.asarray(positions, jnp.int32),
+                                  jnp.asarray(lengths, jnp.int32))
+        with self._epoch_lock:
+            if epoch != self._epoch:
+                return None          # reset() raced us; drop the commit
+            self._cache = cache
+        return np.asarray(drafts)
+
+    def reset(self):
+        """Invalidate the draft cache (supervisor recovery / engine
+        reset): epoch bump drops any in-flight rollout's commit, fresh
+        slab rebuilt from the params.  Host-side feed bookkeeping lives
+        in the engine and is re-seeded by the re-seat paths."""
+        with self._epoch_lock:
+            self._epoch += 1
+            self._cache = transformer.init_lm_cache(
+                self.params, self.num_slots, self.max_len)
+
+    def warmup(self):
+        """Trace the rollout exactly once at the live shapes.
+        Idempotent, like the engine's."""
+        if self._warm:
+            return
+        self._warm = True
+        tokens, positions, lengths = self._dummy_feed()
+        with expect_traces(lambda: self._trace[0], 1,
+                           f"{self.name} rollout warmup",
+                           hint="draft rollout shapes must be fixed at "
+                                "construction (k/chunk/num_slots)"):
+            out = self.rollout(tokens, positions, lengths)
+        assert out is not None and out.shape == (self.num_slots, self.k)
+        self.reset()
+
+    def lower(self):
+        """Lowered (unspecialized-to-device-data) rollout for the
+        analytic bench's compiled-HLO inspection."""
+        tokens, positions, lengths = self._dummy_feed()
+        return self._jit.lower(self.params, self._cache,
+                               jnp.asarray(tokens), jnp.asarray(positions),
+                               jnp.asarray(lengths))

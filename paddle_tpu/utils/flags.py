@@ -126,6 +126,15 @@ class Flags:
     #                                        (0 = unbounded); data, not
     #                                        shape — tuning never
     #                                        retraces
+    # ---- speculative decoding (serving/speculative.py: a truncated-
+    # trunk draft proposes k tokens per slot, the one chunked step
+    # scores every lane; docs/serving.md "Speculative decoding")
+    serving_speculate_k: int = 0        # draft tokens per slot per step
+    #                                     (k; 0 = speculation off —
+    #                                     requires chunked prefill)
+    serving_draft_layers: int = 1       # trunk depth of the derived
+    #                                     draft (make_draft: first N enc
+    #                                     blocks, embedding shared)
     # ---- fused decode kernels (ops/pallas/decode_attention.py: read
     # the KV cache once per step; docs/perf.md "Fused decode kernels")
     pallas_decode: str = "auto"         # auto (use_pallas(): TPU only) |
@@ -412,6 +421,21 @@ FLAG_DOCS = {
                                      "work, hence TPOT jitter; 0 = "
                                      "unbounded).  Fed as data — "
                                      "tuning it never retraces", "—"),
+    "serving_speculate_k": ("speculative decoding: a small draft trunk "
+                            "proposes k greedy tokens per feeding slot "
+                            "and the target's ONE chunked step scores "
+                            "every drafted lane at once — each step "
+                            "nets 1 + accepted tokens, streams stay "
+                            "token-identical to lm_generate (the "
+                            "acceptance rule keeps exactly the greedy "
+                            "prefix).  0 = off; requires "
+                            "serving_prefill_chunk > 0", "—"),
+    "serving_draft_layers": ("trunk depth of the draft model derived "
+                             "from the target (speculative.make_draft: "
+                             "the first N enc blocks; embedding / final "
+                             "LN / vocab head SHARED with the target, "
+                             "so only the truncated trunk adds weight "
+                             "bytes)", "—"),
     "pallas_decode": ("fused Pallas decode-attention kernels for the "
                       "slot/paged serving steps: auto = on when the "
                       "backend compiles Pallas natively (TPU), always = "

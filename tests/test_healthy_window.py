@@ -51,7 +51,8 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "trace_smoke.json", "trace_chrome.json",
                  "decode_fused_smoke.json", "autoscale_smoke.json",
                  "chunked_smoke.json", "quant_smoke.json",
-                 "analysis_gate.json", "WINDOW_DONE"):
+                 "analysis_gate.json", "spec_smoke.json",
+                 "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -181,6 +182,17 @@ def test_dryrun_executes_every_phase(tmp_path):
     assert gate["new"] == 0, gate
     assert gate["roots"], "analysis gate ran with no jit roots"
     assert gate["stale_baseline_keys"] == [], gate
+    # the speculative smoke really speculated: every staggered stream
+    # bit-identical to the non-spec twin, draft lanes actually scored
+    # (acceptance evidence on /metrics), every verify step netting
+    # >= 1 token, and both engines at 1 warm-up trace / 0 retraces
+    spc = json.loads((art / "spec_smoke.json").read_text())
+    assert spc["value"] == int(spc["unit"].split("/")[1]), spc
+    assert spc["bit_identical"] is True, spc
+    assert spc["drafted_tokens_total"] > 0, spc
+    assert spc["spec_tokens_per_step"] >= 1.0, spc
+    assert spc["no_retrace"] is True, spc
+    assert spc["metrics_sane"] is True, spc
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows
