@@ -52,6 +52,7 @@ FAMILIES = [
     ("serving_chunked_prefill", "serving_chunked_prefill", None),
     ("serving_quant", "serving_quant", None),
     ("serving_speculative", "serving_speculative", None),
+    ("serving_sharded", "serving_sharded", None),
     ("trainer_prefetch", "trainer_prefetch", None),
 ]
 
@@ -95,22 +96,29 @@ JIT_ROOTS = {r.name: r for r in [
          note="single-stream incremental decode step"),
     Root("lm_decode_step_slots",
          "paddle_tpu.models.transformer:lm_decode_step_slots",
-         static_args=("num_heads", "moe_top_k", "pos_type"),
-         note="slab continuous-batching decode step (DecodeEngine)"),
+         static_args=("num_heads", "moe_top_k", "pos_type",
+                      "shard_axis"),
+         note="slab continuous-batching decode step (DecodeEngine); "
+              "shard_axis is the tensor-parallel mesh-axis name — a "
+              "trace-time constant like num_heads"),
     Root("lm_decode_step_paged",
          "paddle_tpu.models.transformer:lm_decode_step_paged",
          static_args=("num_heads", "moe_top_k", "pos_type"),
          note="paged-KV decode step (block tables fed as data)"),
     Root("lm_decode_chunk_slots",
          "paddle_tpu.models.transformer:lm_decode_chunk_slots",
-         static_args=("num_heads", "moe_top_k", "pos_type", "all_lanes"),
+         static_args=("num_heads", "moe_top_k", "pos_type", "all_lanes",
+                      "shard_axis"),
          note="unified chunked-prefill step, slab layout (all_lanes is "
-              "the spec-verify projection switch — trace-time only)"),
+              "the spec-verify projection switch, shard_axis the "
+              "tensor-parallel mesh axis — both trace-time only)"),
     Root("lm_decode_chunk_paged",
          "paddle_tpu.models.transformer:lm_decode_chunk_paged",
-         static_args=("num_heads", "moe_top_k", "pos_type", "all_lanes"),
+         static_args=("num_heads", "moe_top_k", "pos_type", "all_lanes",
+                      "shard_axis"),
          note="unified chunked-prefill step, paged layout (all_lanes is "
-              "the spec-verify projection switch — trace-time only)"),
+              "the spec-verify projection switch, shard_axis the "
+              "tensor-parallel mesh axis — both trace-time only)"),
     # ---- engine-side jitted closures (serving/): the slot-step wrapper
     # plus the admission/write/fork device ops around it
     Root("decode_engine_step",
@@ -207,6 +215,17 @@ FAMILY_ROOTS = {
                             "decode_attention_slab_chunk",
                             "decode_attention_paged_chunk",
                             "flash_attention"),
+    # serving_sharded traces the SAME engine/draft closures as the
+    # speculative family — the shard_map wrapper lives inside
+    # decode_engine_step/draft_rollout's `_model` body, so the analyzer
+    # walks it through the existing refs; no new qualnames appear.
+    "serving_sharded": ("decode_engine_step", "draft_rollout",
+                        "lm_decode_chunk_slots",
+                        "lm_decode_chunk_paged",
+                        "lm_decode_step_slots", "lm_prefill",
+                        "decode_attention_slab_chunk",
+                        "decode_attention_paged_chunk",
+                        "flash_attention"),
     "trainer_prefetch": ("trainer_step",),
 }
 

@@ -441,14 +441,43 @@ def lm_loss(params, tokens: SequenceBatch, num_heads=8, segment_ids=None,
     return ce + moe_aux_weight * aux
 
 
-def _lm_project(params, h):
+def _lm_project(params, h, shard_axis=None):
     """Final LN + tied-embedding projection (the GPT/pre-LN convention,
     same ln_f as decode): without the LN the un-normalized residual
     stream's depth-growing magnitude would set the softmax temperature.
     Accepts a quantized tree too (idempotent dequant — external callers
-    like the prefill ladder hand it raw engine params)."""
+    like the prefill ladder hand it raw engine params).
+
+    shard_axis (trace-time, like num_heads): inside the serving
+    shard_map, src_emb is a LOCAL vocab stripe [V/n, d] — each chip
+    computes its logit columns exactly as the single chip would (a
+    column slice of a matmul touches no other column's contraction) and
+    the tiled all-gather concatenates them back in device order, i.e.
+    the original column order.  This is the LOGITS seam of the sharded
+    decode step (docs/serving.md "Sharded decode")."""
     params = _maybe_dequant(params)
-    return linear.matmul(_ln(params["ln_f"], h), params["src_emb"].T)
+    local = linear.matmul(_ln(params["ln_f"], h), params["src_emb"].T)
+    if shard_axis is None:
+        return local
+    return jax.lax.all_gather(local, shard_axis, axis=-1, tiled=True)
+
+
+def _lm_embed(params, ids, shard_axis=None):
+    """Input-embedding gather, vocab-sharded under ``shard_axis``: each
+    chip looks up ``ids - its_stripe_offset`` against its local [V/n, d]
+    stripe — ``embedding_lookup`` returns EXACT zero rows for the
+    out-of-stripe (now out-of-range) ids, so the psum adds ``n-1`` exact
+    zeros to the one real row and reproduces the replicated gather
+    bit-for-bit (x + 0.0 == x).  The single-chip convention that
+    out-of-vocab ids embed to zeros is preserved: such ids miss EVERY
+    stripe.  This is the (cheap) third collective of the sharded step,
+    [tokens, d]-sized."""
+    emb = params["src_emb"]
+    if shard_axis is None:
+        return emb_ops.embedding_lookup(emb, ids)
+    off = jax.lax.axis_index(shard_axis) * emb.shape[0]
+    return jax.lax.psum(emb_ops.embedding_lookup(emb, ids - off),
+                        shard_axis)
 
 
 def lm_logits(params, tokens: SequenceBatch, num_heads=8,
@@ -781,8 +810,22 @@ def lm_decode_step(params, prev_ids, t, cache, num_heads=8,
     return _lm_project(params, x)[:, 0], new_cache
 
 
+def _shard_gather_att(att, shard_axis):
+    """The ATTENTION-OUTPUT seam of the sharded decode step: inside the
+    serving shard_map each chip's ``att`` is the contiguous head stripe
+    its local wq/wk/wv columns produced — numerically identical to the
+    same columns of the replicated computation (head h attends only to
+    its own KV stripe; a column slice of a matmul reorders nothing).
+    The tiled all-gather concatenates the stripes in device order =
+    head order, so the replicated wo contraction that follows runs on a
+    bit-identical [.., d] input.  No-op when unsharded."""
+    if shard_axis is None:
+        return att
+    return jax.lax.all_gather(att, shard_axis, axis=-1, tiled=True)
+
+
 def _cached_self_attn_slots(blk, x, c, positions, pos_mask, num_heads,
-                            rope_pos=None):
+                            rope_pos=None, shard_axis=None):
     """``_cached_self_attn`` with a PER-ROW position vector: row r writes
     its K/V at its own ``positions[r]`` (scatter instead of a shared
     dynamic slice) and attends under its own mask row.  Row r's compute is
@@ -790,7 +833,13 @@ def _cached_self_attn_slots(blk, x, c, positions, pos_mask, num_heads,
     is batched over the leading axis ([S, 1, D] @ [D, H]), so a row's
     numerics do not depend on what the other slots are doing.  The
     continuous-batching decode slab (serving/decode_engine.py) runs on
-    this."""
+    this.
+
+    shard_axis: set inside the serving shard_map — blk's wq/wk/wv are
+    local head stripes, c local KV stripes, num_heads the LOCAL count;
+    everything below computes the stripe exactly as the single chip
+    computes those heads, and ``_shard_gather_att`` reassembles before
+    the replicated wo."""
     h = _ln(blk["ln1"], x)
     k_new = linear.matmul(h, blk["attn"]["wk"])
     q = linear.matmul(h, blk["attn"]["wq"])
@@ -819,11 +868,13 @@ def _cached_self_attn_slots(blk, x, c, positions, pos_mask, num_heads,
                       pos_mask)
     else:
         att = att[:, None]
+    att = _shard_gather_att(att, shard_axis)
     return x + linear.matmul(att, blk["attn"]["wo"]), nc
 
 
 def lm_decode_step_slots(params, prev_ids, positions, cache, num_heads=8,
-                         moe_top_k=2, pos_type="learned"):
+                         moe_top_k=2, pos_type="learned",
+                         shard_axis=None):
     """One incremental decode position for EVERY row of a slot slab, each
     row at its OWN position — the continuous-batching twin of
     ``lm_decode_step`` (which advances the whole batch at one shared t).
@@ -836,11 +887,17 @@ def lm_decode_step_slots(params, prev_ids, positions, cache, num_heads=8,
     — same values, same masked-softmax width (masked logits sit at -1e30,
     whose exp is exactly 0.0, so cache width beyond a row's position never
     perturbs its numerics).  tests/test_decode_engine.py pins the
-    per-request bit-identity against ``lm_generate``."""
+    per-request bit-identity against ``lm_generate``.
+
+    shard_axis (trace-time): the tensor-parallel serving path — params/
+    cache are local stripes and num_heads the LOCAL head count (src_emb
+    shards its VOCAB axis, so the embedded x keeps the full width d and
+    the sqrt(d) scale is untouched).  The draft trunk's rollout runs
+    through here inside its own shard_map."""
     params = _maybe_dequant(params)
     s = prev_ids.shape[0]
     max_len = cache[0]["k"].shape[1]
-    x = emb_ops.embedding_lookup(params["src_emb"], prev_ids)[:, None]
+    x = _lm_embed(params, prev_ids, shard_axis)[:, None]
     x = x * math.sqrt(x.shape[-1])
     if pos_type == "learned":
         x = x + params["pos"][positions][:, None]
@@ -850,10 +907,10 @@ def lm_decode_step_slots(params, prev_ids, positions, cache, num_heads=8,
     new_cache = []
     for blk, c in zip(params["enc"], cache):
         x, nc = _cached_self_attn_slots(blk, x, c, positions, pos_mask,
-                                        num_heads, rope_pos)
+                                        num_heads, rope_pos, shard_axis)
         x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
         new_cache.append(nc)
-    return _lm_project(params, x)[:, 0], new_cache
+    return _lm_project(params, x, shard_axis)[:, 0], new_cache
 
 
 def _cached_self_attn_paged(blk, x, c, positions, tables, pos_mask,
@@ -977,7 +1034,7 @@ def _chunk_lanes(positions, lengths, kk):
 
 
 def _cached_self_attn_chunk(blk, x, c, li, qpos, pos_mask, num_heads,
-                            rope_pos=None):
+                            rope_pos=None, shard_axis=None):
     """``_cached_self_attn_slots`` at Tq=K: row r writes lane i's K/V at
     its own ``qpos[r, i]`` and lane i attends under its own mask row
     (cols <= qpos[r, i] — causal within the chunk, clamped at the live
@@ -1016,12 +1073,13 @@ def _cached_self_attn_chunk(blk, x, c, li, qpos, pos_mask, num_heads,
     if att is None:
         att = _attend(q, _kv_view(k, ks), _kv_view(v, vs), num_heads,
                       pos_mask)
+    att = _shard_gather_att(att, shard_axis)
     return x + linear.matmul(att, blk["attn"]["wo"]), nc
 
 
 def lm_decode_chunk_slots(params, tokens, positions, lengths, cache,
                           num_heads=8, moe_top_k=2, pos_type="learned",
-                          all_lanes=False):
+                          all_lanes=False, shard_axis=None):
     """The Tq=chunk generalization of ``lm_decode_step_slots``: every
     row advances ``lengths[r]`` (1..K) positions in ONE step.
 
@@ -1039,12 +1097,18 @@ def lm_decode_chunk_slots(params, tokens, positions, lengths, cache,
     the speculative-decoding verify surface (serving/speculative.py) —
     lane i's logits are the target's next-token distribution after the
     prefix through lane i, so host-side acceptance can take the longest
-    matched greedy prefix from ONE step."""
+    matched greedy prefix from ONE step.
+
+    shard_axis (trace-time): the tensor-parallel serving path
+    (docs/serving.md "Sharded decode") — inside the engine's shard_map
+    params/cache are local head/vocab stripes and num_heads the LOCAL
+    count; the two all-gather seams (attention output, logits) plus the
+    embedding psum reassemble bit-identically to the single chip."""
     params = _maybe_dequant(params)
     s, kk = tokens.shape
     max_len = cache[0]["k"].shape[1]
     li, qpos = _chunk_lanes(positions, lengths, kk)
-    x = emb_ops.embedding_lookup(params["src_emb"], tokens)
+    x = _lm_embed(params, tokens, shard_axis)
     x = x * math.sqrt(x.shape[-1])
     if pos_type == "learned":
         x = x + params["pos"][qpos]
@@ -1053,17 +1117,18 @@ def lm_decode_chunk_slots(params, tokens, positions, lengths, cache,
     new_cache = []
     for blk, c in zip(params["enc"], cache):
         x, nc = _cached_self_attn_chunk(blk, x, c, li, qpos, pos_mask,
-                                        num_heads, rope_pos)
+                                        num_heads, rope_pos, shard_axis)
         x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
         new_cache.append(nc)
     if all_lanes:
-        return _lm_project(params, x), new_cache
+        return _lm_project(params, x, shard_axis), new_cache
     h_last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
-    return _lm_project(params, h_last)[:, 0], new_cache
+    return _lm_project(params, h_last, shard_axis)[:, 0], new_cache
 
 
 def _cached_self_attn_chunk_paged(blk, x, c, li, qpos, tables, pos_mask,
-                                  num_heads, rope_pos=None):
+                                  num_heads, rope_pos=None,
+                                  shard_axis=None):
     """``_cached_self_attn_chunk`` over the paged block pool: lane i of
     row r scatter-writes into ``pool[tables[r, qpos//bs], qpos % bs]``
     (host scheduling provisions exclusive blocks for the WHOLE span
@@ -1100,21 +1165,26 @@ def _cached_self_attn_chunk_paged(blk, x, c, li, qpos, tables, pos_mask,
                           None if vs is None else vs[tables]) \
             .reshape(s, -1, v.shape[-1])
         att = _attend(q, k_rows, v_rows, num_heads, pos_mask)
+    att = _shard_gather_att(att, shard_axis)
     return x + linear.matmul(att, blk["attn"]["wo"]), nc
 
 
 def lm_decode_chunk_paged(params, tokens, positions, lengths, cache,
                           tables, num_heads=8, moe_top_k=2,
-                          pos_type="learned", all_lanes=False):
+                          pos_type="learned", all_lanes=False,
+                          shard_axis=None):
     """The Tq=chunk generalization of ``lm_decode_step_paged`` — the
     paged twin of ``lm_decode_chunk_slots`` (same lane semantics, block
-    tables as DATA; ``all_lanes`` the same trace-time verify switch)."""
+    tables as DATA; ``all_lanes`` the same trace-time verify switch;
+    ``shard_axis`` the same tensor-parallel switch — each chip walks
+    the SAME replicated block tables over its local Hkv/n stripe of
+    every pool block)."""
     params = _maybe_dequant(params)
     s, kk = tokens.shape
     block_size = cache[0]["k"].shape[1]
     t_span = tables.shape[1] * block_size
     li, qpos = _chunk_lanes(positions, lengths, kk)
-    x = emb_ops.embedding_lookup(params["src_emb"], tokens)
+    x = _lm_embed(params, tokens, shard_axis)
     x = x * math.sqrt(x.shape[-1])
     if pos_type == "learned":
         x = x + params["pos"][qpos]
@@ -1124,13 +1194,14 @@ def lm_decode_chunk_paged(params, tokens, positions, lengths, cache,
     for blk, c in zip(params["enc"], cache):
         x, nc = _cached_self_attn_chunk_paged(blk, x, c, li, qpos,
                                               tables, pos_mask,
-                                              num_heads, rope_pos)
+                                              num_heads, rope_pos,
+                                              shard_axis)
         x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
         new_cache.append(nc)
     if all_lanes:
-        return _lm_project(params, x), new_cache
+        return _lm_project(params, x, shard_axis), new_cache
     h_last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
-    return _lm_project(params, h_last)[:, 0], new_cache
+    return _lm_project(params, h_last, shard_axis)[:, 0], new_cache
 
 
 def _kv_layer_buffers(params, lead_shape, kv_dtype, num_heads):

@@ -696,16 +696,34 @@ def _chunk_ok(kk, num_heads, interpret):
     return interpret or (kk * num_heads) % 8 == 0
 
 
-def covers(num_heads, d, dkv, blk_len, paged=False, chunk=1, quant=False):
+def covers(num_heads, d, dkv, blk_len, paged=False, chunk=1, quant=False,
+           shards=1):
     """THE dispatch predicate (flag + shape support), shared by
     ``maybe_slab``/``maybe_paged`` and by ``DecodeEngine.warmup``'s
     resolved-path log — one definition, so the engine can never report
     a path its compiled step didn't take.  ``blk_len``: the slab length
     (slab) or the pool block size (paged).  ``chunk``: query lanes per
     row (1 = plain decode; >1 = the chunked-prefill step).  ``quant``:
-    int8 K/V (tighter sublane tiling on the compiled backend)."""
+    int8 K/V (tighter sublane tiling on the compiled backend).
+
+    ``shards``: a tensor-parallel mesh (docs/serving.md "Sharded
+    decode") hands each chip the PER-CHIP stripe — ``num_heads/n``
+    query heads, ``d/n``-wide q, ``dkv/n``-wide K/V — and coverage must
+    be judged on THAT: a kernel that covers 8 KV heads may not cover
+    the 4-head shard (lane-tiling of the narrower Dkv, the smaller
+    ``chunk*H`` sublane dim).  The maybe_* call sites inside the
+    shard_map see the local widths naturally; this localizes the
+    warm-up prediction to match, rejecting to the reference path
+    whenever any local width stops tiling."""
     if not decode_kernels_enabled():
         return False
+    shards = max(1, int(shards))
+    if shards > 1:
+        if num_heads % shards or d % shards or dkv % shards:
+            return False        # uneven stripes never reach the kernels
+        num_heads //= shards
+        d //= shards
+        dkv //= shards
     interpret = _interpret(None)
     split = _head_split(d, dkv, num_heads)
     if split is None or not _chunk_ok(chunk, num_heads, interpret):

@@ -162,6 +162,107 @@ def replicated_shardings(tree, mesh: Mesh):
         lambda _: NamedSharding(mesh, P()), tree)
 
 
+# ------------------------------------------------- sharded serving decode
+#
+# The serving-side tensor-parallel policy (docs/serving.md "Sharded
+# decode").  Unlike the training rules above, serving carries a HARD
+# bit-identity guarantee against the single-chip twin, which rules out
+# megatron row-parallel entirely: a psum of partial contractions reorders
+# a float sum and therefore changes bits.  Only tensors whose sharded
+# compute is a pure COLUMN SLICE of the replicated compute are split —
+# the per-column numerics are untouched and a tiled all-gather
+# reassembles the columns in device order, i.e. the original order:
+#
+#   - wq/wk/wv shard their out-feature (head) axis: each chip computes a
+#     contiguous stripe of heads exactly as the single chip would.
+#   - the KV cache (slab rows or pool blocks, float or int8 + scale
+#     sidecars) shards its trailing head axis the same way — each chip
+#     holds its Hkv/n stripe of EVERY row/block, so block tables,
+#     allocator, prefix index and CoW stay replicated host data.
+#   - src_emb shards its vocab axis: the input lookup is a local gather
+#     whose misses are exact zeros (psum-of-zeros seam), and the tied
+#     logits projection is a local vocab stripe re-gathered tiled.
+#   - EVERYTHING else (wo, the FFN, biases, LNs, pos) is replicated —
+#     their contractions run whole on every chip, bit-identically.
+#
+# The two all-gather seams (attention output, logits) plus the embedding
+# psum are the ONLY collectives in the step.
+
+_RX_EMB_SCALE = re.compile(r"(^|/)src_emb/(s|__scale__)$")
+_RX_EMB = re.compile(r"(^|/)src_emb(/(q|__int8__))?$")
+_RX_QKV = re.compile(r"/attn/w[qkv](/(q|s|__int8__|__scale__))?$")
+
+
+def lm_decode_param_specs(params, axis=AXIS_MODEL):
+    """PartitionSpec pytree for the decoder-only LM trunk under the
+    bit-exact serving policy above.  Quantized ``{"q","s"}`` leaves
+    shard together: a per-out-channel scale ``[1, dout]`` rides its out
+    axis with the int8 payload; src_emb's scale is per-COLUMN ``[1, d]``
+    (the vocab axis is the one reduced over) and stays replicated."""
+    def spec(path, leaf):
+        p = _path_str(path)
+        if _RX_EMB_SCALE.search(p):
+            return P()
+        if _RX_EMB.search(p):
+            return P(axis, None)
+        if _RX_QKV.search(p):
+            return P(None, axis)
+        return P()
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def lm_cache_specs(cache, axis=AXIS_MODEL):
+    """Trailing-axis (head-stripe) specs for a slab or paged KV cache
+    tree: every buffer — K/V and the int8 scale sidecars — is
+    ``[lead..., Hkv*dh or Hkv]``, so each chip holds its ``Hkv/n``
+    stripe of every slot row / pool block."""
+    return jax.tree_util.tree_map(
+        lambda l: P(*([None] * (np.ndim(l) - 1) + [axis])), cache)
+
+
+def lm_shard_problems(params, num_heads, shards):
+    """Why this LM trunk CANNOT split ``shards`` ways under the
+    bit-exact policy (empty list = it can): every sharded axis must
+    divide evenly — query heads (wq stripes), KV heads (a contiguous
+    ``Hkv/n`` stripe only lines up with its query stripe's GQA groups
+    when ``n | Hkv``) and vocab (embedding stripes)."""
+    shards = int(shards)
+    if shards <= 1:
+        return []
+    from paddle_tpu.quant.weights import weight_shape
+    probs = []
+    vocab = int(weight_shape(params["src_emb"])[0])
+    if num_heads % shards:
+        probs.append(f"num_heads={num_heads} not divisible by "
+                     f"shards={shards}")
+    if vocab % shards:
+        probs.append(f"vocab={vocab} not divisible by shards={shards}")
+    enc = params.get("enc") or []
+    if enc and num_heads and num_heads % shards == 0:
+        d_q = int(weight_shape(enc[0]["attn"]["wq"])[1])
+        dkv = int(weight_shape(enc[0]["attn"]["wk"])[1])
+        dh = d_q // num_heads
+        hkv = dkv // dh if dh and dkv % dh == 0 else 0
+        if not hkv or hkv % shards:
+            probs.append(f"kv heads={hkv or f'?(dkv={dkv})'} not "
+                         f"divisible by shards={shards}")
+    return probs
+
+
+def decode_mesh(shards, devices=None):
+    """A 1-axis ``('model',)`` mesh over the first ``shards`` local
+    devices — the serving mesh (no data axis: continuous batching IS
+    the batch plane, and its slots axis must stay whole for the
+    per-row scatter writes)."""
+    devices = list(jax.devices() if devices is None else devices)
+    shards = int(shards)
+    if shards < 1 or shards > len(devices):
+        raise ValueError(
+            f"decode_mesh: shards={shards} outside [1, "
+            f"{len(devices)} visible devices]")
+    return Mesh(np.asarray(devices[:shards]), (AXIS_MODEL,))
+
+
 def globalize_pytree(tree, shardings, gather=None):
     """Host pytree -> global jax.Arrays on a process-spanning mesh.
     Every process holds the same host value (SPMD discipline:

@@ -353,11 +353,121 @@ def predicted_spec_bytes_per_token(layers, d, dff, vocab, s, t_span,
     return (verify + draft) / emitted, float(nonspec)
 
 
+def predicted_sharded_step_bytes(layers, d, dff, vocab, s, t_span,
+                                 num_heads, shards, dkv=None,
+                                 kv_dtype="float32",
+                                 weight_dtype="float32", chunk=1,
+                                 replicate_weights=False):
+    """First-principles PER-CHIP HBM traffic of one tensor-parallel
+    chunked decode step — the serving_sharded bytes model
+    (docs/serving.md "Sharded decode").  Returns a breakdown dict:
+    ``total`` (per-chip bytes), ``weights``, ``kv``, ``acts_io``, and
+    ``collective`` (the wire bytes of the gather seams).
+
+    The sharding policy is ``parallel.sharding.lm_decode_param_specs``'s,
+    priced term by term: wq/wk/wv shard their out-feature axis and
+    src_emb its vocab axis (each chip streams 1/n of those weights);
+    the K/V pool shards its trailing Dkv axis (1/n of the read/write
+    stream per chip).  Everything bit-exactness forces to stay
+    REPLICATED — wo, the FFN, LNs/biases, the positional table — is
+    streamed in full on every chip: the model never pretends the whole
+    step scales 1/n.  The collective term prices the seams honestly as
+    ring traffic (in + out ~= 2 * (n-1)/n * payload per chip): one
+    attention-output all-gather of [s, chunk, d] per layer, one logits
+    all-gather of [s, vocab], one embedding psum of [s, chunk, d].
+
+    ``replicate_weights=True`` is the adversarial twin: same mesh, same
+    collectives, but every weight streamed in full on every chip — the
+    serving_sharded postcheck requires THAT prediction to FAIL the
+    reduction gate (weight replication must never look like a win), and
+    ``shards=1`` collapses to the single-chip step (no collectives) the
+    sharded prediction is gated against in the other direction."""
+    n = max(1, int(shards))
+    dkv = d if dkv is None else dkv
+    hkv = dkv // (d // num_heads)
+    wsz = 1 if weight_dtype == "int8" else 4
+    # int8 weights carry a per-out-channel f32 scale; the scale shards
+    # with its weight's out axis (the emb scale [1, d] is replicated)
+    ssz = 4 if weight_dtype == "int8" else 0
+    w_shard = layers * ((d * d + 2 * d * dkv) * wsz
+                        + (d + 2 * dkv) * ssz) \
+        + vocab * d * wsz + vocab * 0 * ssz
+    w_repl = layers * ((d * d + 2 * d * dff) * wsz
+                       + (d + 2 * dff) * ssz + 9 * d * 4) \
+        + t_span * d * 4 + 2 * d * 4 + d * ssz
+    if replicate_weights or n == 1:
+        weights = w_shard + w_repl
+    else:
+        weights = w_shard / n + w_repl
+    kv_isz = 1 if kv_dtype == "int8" else 4
+    sidecar = 2 * s * t_span * hkv * 4 if kv_dtype == "int8" else 0
+    kv_read = layers * (2 * s * t_span * dkv * kv_isz + sidecar)
+    kv_write = layers * s * chunk * (2 * dkv * kv_isz
+                                     + (2 * hkv * 4 if kv_isz == 1
+                                        else 0))
+    kv = (kv_read + kv_write) / n      # the pool ALWAYS shards its Dkv
+    acts = layers * 2 * s * chunk * d * 4
+    io = s * chunk * 4 + s * vocab * 4
+    ring = 2.0 * (n - 1) / n if n > 1 else 0.0
+    collective = ring * (layers * s * chunk * d * 4      # att gathers
+                         + s * vocab * 4                 # logits gather
+                         + s * chunk * d * 4)            # embed psum
+    total = weights + kv + acts + io + collective
+    return {"total": float(total), "weights": float(weights),
+            "kv": float(kv), "acts_io": float(acts + io),
+            "collective": float(collective)}
+
+
 def _import_bench():
     if _REPO not in sys.path:
         sys.path.insert(0, _REPO)
     import bench
     return bench
+
+
+# Families whose capture needs a multi-device host platform (the
+# sharded-serving mesh).  XLA's CPU device count is fixed at backend
+# init, and forcing it for the WHOLE snapshot perturbs every
+# single-device family's HLO (the CPU backend re-partitions its thread
+# pool per device — alexnet grows `call` ops under a 2-device flag), so
+# when THIS process lacks the devices these families are captured in a
+# subprocess that sets the flag for itself alone.
+MESH_FAMILIES = {"serving_sharded": 2}
+
+
+def _capture_subprocess(name, model, batch, devices):
+    """Run one family's capture under a forced ``devices``-way host
+    platform in a child ``bench.py --analytic`` process and return its
+    row (an error row on any child failure — same isolation contract
+    as ``capture``)."""
+    import subprocess
+    import tempfile
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        f" --xla_force_host_platform_device_count="
+                        f"{devices}").strip()
+    fd, out = tempfile.mkstemp(suffix=".json")
+    os.close(fd)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "bench.py"),
+             "--analytic", "--families", name, "--out", out],
+            env=env, capture_output=True, text=True, timeout=1800)
+        with open(out) as f:
+            snap = json.load(f)
+        return snap["families"][name]
+    except Exception as e:   # noqa: BLE001 — per-family isolation
+        tail = ""
+        try:
+            tail = proc.stderr[-300:]
+        except Exception:    # noqa: BLE001
+            pass
+        return {"model": model, "batch": batch,
+                "error": f"mesh-capture subprocess failed: "
+                         f"{type(e).__name__}: {e} {tail}"[:500]}
+    finally:
+        if os.path.exists(out):
+            os.unlink(out)
 
 
 def capture(name, model, batch=None, chips=("v5e", "v5p")):
@@ -414,7 +524,7 @@ def capture(name, model, batch=None, chips=("v5e", "v5p")):
                  "serving_fleet", "serving_paged",
                  "serving_decode_fused", "serving_autoscale",
                  "serving_chunked_prefill", "serving_quant",
-                 "serving_speculative"):
+                 "serving_speculative", "serving_sharded"):
         # the lowered program is one batch/slab step while the bench FLOPs
         # model covers the whole stream/burst — scopes differ, no cross-check
         row["bench_model_flops"] = None
@@ -441,7 +551,13 @@ def snapshot(families=None, chips=("v5e", "v5p")):
     rows = {}
     for name, model, batch in sel:
         _log(f"{name} (model={model} batch={batch or 'default'}) ...")
-        rows[name] = capture(name, model, batch, chips=chips)
+        need = MESH_FAMILIES.get(name, 0)
+        if need and len(jax.devices()) < need:
+            _log(f"{name}: needs a {need}-device mesh, forcing it in a "
+                 "subprocess (this process stays single-device)")
+            rows[name] = _capture_subprocess(name, model, batch, need)
+        else:
+            rows[name] = capture(name, model, batch, chips=chips)
         if "error" in rows[name]:
             _log(f"{name}: FAILED {rows[name]['error']}")
         else:

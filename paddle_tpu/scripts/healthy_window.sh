@@ -76,6 +76,13 @@
 #                                        bit-identical, acceptance-rate
 #                                        evidence in /metrics, zero
 #                                        retraces — one JSON line)
+# 19. sharded serving smoke              (tensor-parallel decode on an
+#                                        n=2 forced host mesh vs the
+#                                        single-chip twin: staggered
+#                                        concurrent streams
+#                                        bit-identical, mesh_shards on
+#                                        /metrics, zero retraces — one
+#                                        JSON line)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -355,6 +362,20 @@ log "phase 18: speculative serving smoke (draft-ahead vs non-spec twin)"
 timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-speculative \
     > "$ART/spec_smoke.json" 2> "$ART/spec_smoke.log"
 log "speculative smoke rc=$? -> $ART/spec_smoke.json"
+
+log "phase 19: sharded serving smoke (n=2 host mesh vs single-chip twin)"
+# tensor-parallel sharded decode: the ONE chunked step under a 2-chip
+# model-axis mesh (head-striped attention + KV pool, vocab-striped
+# embedding, speculation riding along) — the probe re-execs itself with
+# XLA_FLAGS=--xla_force_host_platform_device_count=2 on a single-device
+# machine, drives staggered concurrent clients, and every stream must be
+# bit-identical to the single-chip twin at 1 warm-up trace / 0 retraces,
+# with the mesh_shards gauge rendered on /metrics — one JSON line
+# (python -m paddle_tpu.serving --smoke-sharded; docs/serving.md
+# "Sharded decode")
+timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-sharded \
+    > "$ART/sharded_smoke.json" 2> "$ART/sharded_smoke.log"
+log "sharded smoke rc=$? -> $ART/sharded_smoke.json"
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

@@ -160,7 +160,7 @@ class DecodeEngine:
                  kv_layout="slab", kv_block_size=16, kv_num_blocks=0,
                  prefix_cache=True, prefill_chunk=0,
                  prefill_chunk_budget=0, kv_dtype="float32",
-                 speculate_k=0, draft=None):
+                 speculate_k=0, draft=None, mesh=None):
         from paddle_tpu.models import transformer
         self._transformer = transformer
         if params.get("dec"):
@@ -251,32 +251,80 @@ class DecodeEngine:
         # what threads it — no step-signature change, so the 1-trace/
         # 0-retrace discipline is untouched.
         self.kv_dtype = kv_dtype
+        # tensor-parallel sharded decode (docs/serving.md "Sharded
+        # decode"): mesh=... runs the ONE chunked step under
+        # parallel.sharding.shard_map with head-sharded attention, a
+        # head-sharded KV pool (each chip holds its Hkv/n stripe of
+        # every slot row / pool block — tables/allocator/prefix-index/
+        # CoW stay replicated host data) and vocab-sharded tied
+        # embeddings.  Only column-slice-exact tensors shard, so greedy
+        # streams are BIT-IDENTICAL to the single-chip twin; wo and the
+        # FFN replicate (a row-parallel psum would reorder float sums).
+        self.mesh = mesh
+        self.mesh_shards = 1
+        self._shard_axis = None
+        if mesh is not None:
+            from paddle_tpu.parallel import sharding as _psh
+            from paddle_tpu.parallel.mesh import AXIS_MODEL
+            from jax.sharding import NamedSharding
+            if AXIS_MODEL not in dict(mesh.shape):
+                raise ConfigError(
+                    "DecodeEngine(mesh=...) needs a mesh with a "
+                    f"'{AXIS_MODEL}' axis "
+                    "(parallel.sharding.decode_mesh builds one)")
+            if not self.prefill_chunk:
+                raise ConfigError(
+                    "sharded decode runs on the unified chunked step: "
+                    "set prefill_chunk > 0 (the legacy prefill ladder "
+                    "is single-chip only)")
+            probs = _psh.lm_shard_problems(params, self.num_heads,
+                                           int(mesh.shape[AXIS_MODEL]))
+            if probs:
+                raise ConfigError(
+                    f"cannot shard this trunk over the mesh: "
+                    + "; ".join(probs))
+            self._psh = _psh
+            self._shard_axis = AXIS_MODEL
+            self.mesh_shards = int(mesh.shape[AXIS_MODEL])
+            # place the params ONCE: wq/wk/wv + src_emb (and their int8
+            # payload/scale leaves) as stripes, everything else
+            # replicated — admission/step/reset all reuse this placement
+            pspecs = _psh.lm_decode_param_specs(params, AXIS_MODEL)
+            params = jax.tree_util.tree_map(
+                lambda l, s: jax.device_put(l, NamedSharding(mesh, s)),
+                params, pspecs)
+            self.params = params
         self._paged = None
         if kv_layout == "paged":
             self.block_size = int(kv_block_size)
             if self.block_size < 1:
                 raise ConfigError("kv_block_size must be >= 1")
             # kv_num_blocks=0 auto-sizes to the SLAB-EQUIVALENT byte
-            # budget — and int8 blocks are small enough that the same
-            # budget holds 2x the count (slab_equivalent_blocks)
+            # budget — int8 blocks are small enough that the same budget
+            # holds 2x the count, and a mesh multiplies by n: each chip
+            # stores only its Hkv/n stripe of a block, so the PER-CHIP
+            # budget holds n× the blocks (slab_equivalent_blocks)
             num_blocks = (int(kv_num_blocks) if kv_num_blocks
                           else slab_equivalent_blocks(
                               self.num_slots, self.max_len,
-                              self.block_size, kv_dtype))
+                              self.block_size, kv_dtype,
+                              mesh_shards=self.mesh_shards))
             # host allocator + prefix index + per-slot block tables
             self._paged = PagedKVState(self.num_slots, num_blocks,
                                        self.block_size, self.max_len,
                                        prefix_cache=prefix_cache)
             # per-layer [num_blocks, block_size, Dkv] pools (block 0 is
             # the scratch block free slot rows point at)
-            self._cache = transformer.init_lm_cache_paged(
-                params, num_blocks, self.block_size, max_len=self.max_len,
-                kv_dtype=kv_dtype, num_heads=self.num_heads)
+            self._cache = self._place_cache(
+                transformer.init_lm_cache_paged(
+                    params, num_blocks, self.block_size,
+                    max_len=self.max_len, kv_dtype=kv_dtype,
+                    num_heads=self.num_heads))
         else:
             # init_lm_cache validates max_len against the positional table
-            self._cache = transformer.init_lm_cache(
+            self._cache = self._place_cache(transformer.init_lm_cache(
                 params, self.num_slots, self.max_len, kv_dtype=kv_dtype,
-                num_heads=self.num_heads)
+                num_heads=self.num_heads))
         # prefill-compute ledger: real positions run through the prefill
         # ladder (the paged prefix cache's whole point is to NOT grow
         # this; bench.py serving_paged reads it for the elimination rate)
@@ -311,7 +359,14 @@ class DecodeEngine:
                     chunk=max(self.speculate_k + 2, self.prefill_chunk),
                     num_heads=self.num_heads, moe_top_k=self.moe_top_k,
                     pos_type=self.pos_type, name=f"{self.name}.draft",
-                    warm=False)
+                    warm=False, mesh=self.mesh)
+            elif draft.mesh_shards != self.mesh_shards:
+                raise ConfigError(
+                    f"draft trunk spans {draft.mesh_shards} mesh "
+                    f"shard(s) but the engine spans {self.mesh_shards}: "
+                    "build the DraftTrunk with the engine's mesh (or "
+                    "pass the raw draft params and let the engine "
+                    "build it)")
             elif (draft.k != self.speculate_k
                   or draft.num_slots != self.num_slots
                   or draft.max_len < self.max_len):
@@ -348,20 +403,36 @@ class DecodeEngine:
         # host acceptance needs the target's pick after each draft
         # lane); a plain chunked engine keeps the last-lane [S] output
         spec = bool(self.speculate_k)
+        # inside the sharded step's shard_map the model sees LOCAL head
+        # stripes; the single-chip path sees the full count.  Both are
+        # trace-time constants.
+        axis = self._shard_axis
+        heads = (self.num_heads // self.mesh_shards if axis is not None
+                 else self.num_heads)
         if self.prefill_chunk and self.kv_layout == "paged":
+            def _model(p, cache, tokens, pos, lens, tables):
+                logits, cache = transformer.lm_decode_chunk_paged(
+                    p, tokens, pos, lens, cache, tables, heads,
+                    self.moe_top_k, self.pos_type, all_lanes=spec,
+                    shard_axis=axis)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+            body = self._shard_body(_model, n_data=4)
+
             def _step_fn(p, cache, tokens, pos, lens, tables):
                 self._step_traces[0] += 1  # runs only under tracing
-                logits, cache = transformer.lm_decode_chunk_paged(
-                    p, tokens, pos, lens, cache, tables, self.num_heads,
-                    self.moe_top_k, self.pos_type, all_lanes=spec)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+                return body(p, cache, tokens, pos, lens, tables)
         elif self.prefill_chunk:
+            def _model(p, cache, tokens, pos, lens):
+                logits, cache = transformer.lm_decode_chunk_slots(
+                    p, tokens, pos, lens, cache, heads,
+                    self.moe_top_k, self.pos_type, all_lanes=spec,
+                    shard_axis=axis)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+            body = self._shard_body(_model, n_data=3)
+
             def _step_fn(p, cache, tokens, pos, lens):
                 self._step_traces[0] += 1  # runs only under tracing
-                logits, cache = transformer.lm_decode_chunk_slots(
-                    p, tokens, pos, lens, cache, self.num_heads,
-                    self.moe_top_k, self.pos_type, all_lanes=spec)
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+                return body(p, cache, tokens, pos, lens)
         elif self.kv_layout == "paged":
             def _step_fn(p, cache, tokens, pos, tables):
                 self._step_traces[0] += 1  # runs only under tracing
@@ -415,6 +486,43 @@ class DecodeEngine:
         self._warm = False
         if warm:
             self.warmup()
+
+    # --------------------------------------------------- sharded decode
+
+    def _place_cache(self, cache):
+        """Shard a fresh KV cache over the mesh: every buffer's trailing
+        (head-stripe) axis splits, so each chip holds its ``Hkv/n``
+        stripe of every slot row / pool block.  Identity when unsharded.
+        Used at construction AND by ``reset()`` — a recovery rebuild
+        must come back with the same placement or the warm step would
+        recompile."""
+        if self._shard_axis is None:
+            return cache
+        from jax.sharding import NamedSharding
+        specs = self._psh.lm_cache_specs(cache, self._shard_axis)
+        return jax.tree_util.tree_map(
+            lambda l, s: jax.device_put(l, NamedSharding(self.mesh, s)),
+            cache, specs)
+
+    def _shard_body(self, fn, n_data):
+        """Wrap a chunked step body in ``parallel.sharding.shard_map``
+        over the engine's mesh (identity when unsharded).  in_specs:
+        the param-stripe tree, the cache-stripe tree, then ``n_data``
+        replicated host operands (tokens/pos/lens[/tables]).  The
+        replication check is disabled: the tiled all-gathers inside the
+        model produce values the checker cannot prove replicated, but
+        bit-identity to the twin is pinned by tests, which is the
+        stronger guarantee."""
+        if self._shard_axis is None:
+            return fn
+        from jax.sharding import PartitionSpec as _P
+        pspecs = self._psh.lm_decode_param_specs(self.params,
+                                                 self._shard_axis)
+        cspecs = self._psh.lm_cache_specs(self._cache, self._shard_axis)
+        return self._psh.shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(pspecs, cspecs) + (_P(),) * n_data,
+            out_specs=(_P(), cspecs), check_vma=False)
 
     # ------------------------------------------------------------ prefill
 
@@ -547,6 +655,7 @@ class DecodeEngine:
         m.set_prefill_chunk(self.prefill_chunk)
         m.set_kv_dtype(self.kv_dtype)
         m.set_speculate_k(self.speculate_k)
+        m.set_mesh_shards(self.mesh_shards)
         for eng in self._prefill_engines.values():
             eng.metrics = m
 
@@ -1119,14 +1228,19 @@ class DecodeEngine:
                 self._paged = PagedKVState(
                     self.num_slots, old.pool.num_blocks, self.block_size,
                     self.max_len, prefix_cache=old.index is not None)
-                self._cache = self._transformer.init_lm_cache_paged(
-                    self.params, old.pool.num_blocks, self.block_size,
-                    max_len=self.max_len, kv_dtype=self.kv_dtype,
-                    num_heads=self.num_heads)
+                # _place_cache: a sharded engine's rebuilt pool must come
+                # back with the same mesh placement or the (still-cached)
+                # compiled step would see new shardings and recompile
+                self._cache = self._place_cache(
+                    self._transformer.init_lm_cache_paged(
+                        self.params, old.pool.num_blocks, self.block_size,
+                        max_len=self.max_len, kv_dtype=self.kv_dtype,
+                        num_heads=self.num_heads))
             else:
-                self._cache = self._transformer.init_lm_cache(
-                    self.params, self.num_slots, self.max_len,
-                    kv_dtype=self.kv_dtype, num_heads=self.num_heads)
+                self._cache = self._place_cache(
+                    self._transformer.init_lm_cache(
+                        self.params, self.num_slots, self.max_len,
+                        kv_dtype=self.kv_dtype, num_heads=self.num_heads))
         self._tokens[:] = 0
         self._pos[:] = 0
         if self.prefill_chunk:
@@ -1169,14 +1283,28 @@ class DecodeEngine:
             dkv = int(_w_shape(enc[0]["attn"]["wk"])[1])
             blk_len = (self.block_size if self.kv_layout == "paged"
                        else self.max_len)
+            # covers() sees the PER-CHIP stripe (shards=): a kernel that
+            # covers 8 KV heads may not cover the 4-head shard — the
+            # resolved path below is what the compiled step actually took
             self.decode_kernels = _dk.covers(
                 self.num_heads, d, dkv, blk_len,
                 paged=self.kv_layout == "paged",
                 chunk=self._kk or 1,
-                quant=self.kv_dtype == "int8")
+                quant=self.kv_dtype == "int8",
+                shards=self.mesh_shards)
+            if self.mesh_shards > 1 and not self.decode_kernels \
+                    and _dk.covers(self.num_heads, d, dkv, blk_len,
+                                   paged=self.kv_layout == "paged",
+                                   chunk=self._kk or 1,
+                                   quant=self.kv_dtype == "int8"):
+                logger.info(
+                    "decode[%s]: fused kernel covers the FULL trunk but "
+                    "not the per-chip Hkv/%d head stripe -> xla-ref",
+                    self.name, self.mesh_shards)
         self.metrics.set_prefill_chunk(self.prefill_chunk)
         self.metrics.set_kv_dtype(self.kv_dtype)
         self.metrics.set_speculate_k(self.speculate_k)
+        self.metrics.set_mesh_shards(self.mesh_shards)
         if self._draft is not None:
             # the draft rollout is its own ONE warm-up trace
             self._draft.warmup()
@@ -1212,12 +1340,12 @@ class DecodeEngine:
             logger.info(
                 "decode[%s]: warm (%d slots, max_len %d, kv %s/%s, decode "
                 "kernels %s, chunked prefill K=%d budget=%s, "
-                "speculate_k=%d)", self.name,
+                "speculate_k=%d, mesh_shards=%d)", self.name,
                 self.num_slots, self.max_len, self.kv_layout,
                 self.kv_dtype,
                 "fused-pallas" if self.decode_kernels else "xla-ref",
                 self.prefill_chunk, self.prefill_chunk_budget or "inf",
-                self.speculate_k)
+                self.speculate_k, self.mesh_shards)
             return
         if self.kv_layout == "paged":
             # ONE block-write shape and ONE fork shape serve every

@@ -52,21 +52,26 @@ SCRATCH_BLOCK = 0
 
 
 def slab_equivalent_blocks(num_slots, max_len, block_size,
-                           kv_dtype="float32"):
+                           kv_dtype="float32", mesh_shards=1):
     """Auto pool size (``DecodeEngine(kv_num_blocks=0)``) at the SLAB-
-    EQUIVALENT byte budget: an f32 pool gets exactly the slab's
-    ``num_slots * ceil(max_len / block_size)`` blocks (same KV bytes,
-    strictly more packable).  ``kv_dtype="int8"`` DOUBLES the block
-    count inside that same budget: an int8 block plus its f32
+    EQUIVALENT **per-chip** byte budget: an f32 pool gets exactly the
+    slab's ``num_slots * ceil(max_len / block_size)`` blocks (same KV
+    bytes, strictly more packable).  ``kv_dtype="int8"`` DOUBLES the
+    block count inside that same budget: an int8 block plus its f32
     per-(position, head) scale sidecar costs ``(1/4 + 1/head_dim)`` of
     the f32 block's bytes (quant/kv.kv_bytes_per_position), i.e. at
     most half for head_dim >= 4 — so twice the blocks still fit, with
-    headroom that grows with head_dim.  +1 everywhere for the reserved
-    scratch block 0."""
+    headroom that grows with head_dim.  ``mesh_shards=n`` (the sharded
+    decode mesh, docs/serving.md "Sharded decode") MULTIPLIES by n: a
+    chip holds only its ``Hkv/n`` head stripe of each block, so the
+    single-chip per-chip budget holds n× the block count — the capacity
+    win tensor-parallel serving exists for.  +1 everywhere for the
+    reserved scratch block 0."""
     per_row = -(-int(max_len) // int(block_size))
     blocks = int(num_slots) * per_row
     if kv_dtype == "int8":
         blocks *= 2
+    blocks *= max(1, int(mesh_shards))
     return blocks + 1
 
 

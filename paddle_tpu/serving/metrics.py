@@ -96,6 +96,9 @@ class ServingMetrics:
         # ---- speculative decoding (serving/speculative.py): draft
         # lanes scored by verify steps and how many the target accepted
         self.speculate_k = 0             # gauge: draft lanes per slot (0=off)
+        # ---- tensor-parallel sharded decode (DecodeEngine(mesh=...)):
+        # how many chips the ONE jitted step spans (1 = single-chip)
+        self.mesh_shards = 1             # gauge: model-axis mesh size
         self.drafted_tokens_total = 0    # draft lanes scored
         self.accepted_tokens_total = 0   # draft lanes accepted (matched)
         self.spec_steps_total = 0        # steps that verified >= 1 span
@@ -196,6 +199,12 @@ class ServingMetrics:
         setter re-applies it so a fresh object inherits it."""
         with self._lock:
             self.speculate_k = int(k)
+
+    def set_mesh_shards(self, n):
+        """Gauge: model-axis mesh size the decode step is sharded over
+        (1 = single-chip).  Config, like the chunk/speculate gauges."""
+        with self._lock:
+            self.mesh_shards = max(1, int(n))
 
     def observe_gen_tokens(self, n=1):
         with self._lock:
@@ -353,6 +362,7 @@ class ServingMetrics:
                     self.prefill_chunk_lanes_total,
                 "prefill_chunk_size": self.prefill_chunk_size,
                 "speculate_k": self.speculate_k,
+                "mesh_shards": self.mesh_shards,
                 "drafted_tokens_total": self.drafted_tokens_total,
                 "accepted_tokens_total": self.accepted_tokens_total,
                 "spec_steps_total": self.spec_steps_total,
@@ -502,6 +512,7 @@ class ServingMetrics:
             kv_int8 = self.kv_dtype == "int8"
             chunk_size = self.prefill_chunk_size
             spec_k = self.speculate_k
+            mesh_shards = self.mesh_shards
         for metric, value, help_ in gen_counters:
             emit(metric, value, help_, mtype="counter")
         emit("prefill_chunk_size", chunk_size,
@@ -511,6 +522,9 @@ class ServingMetrics:
              "fraction of per-step chunk-lane capacity fed")
         emit("speculate_k", spec_k,
              "draft lanes per slot per verify step (0 = speculation off)")
+        emit("mesh_shards", mesh_shards,
+             "model-axis mesh size the decode step spans (1 = "
+             "single-chip)")
         emit("spec_acceptance_rate", f"{self.spec_acceptance_rate:.6f}",
              "fraction of drafted lanes the target accepted")
         emit("spec_tokens_per_step", f"{self.spec_tokens_per_step:.6f}",

@@ -96,6 +96,20 @@ CLI (``python -m paddle_tpu.serving``):
                                    bit-identical, acceptance evidence
                                    in /metrics, ONE JSON line
                                    (healthy_window.sh phase 18)
+  --mesh-shards N                  tensor-parallel sharded decode: the
+                                   one chunked step runs under an
+                                   N-chip model-axis mesh (head-striped
+                                   attention + KV pool, vocab-striped
+                                   embedding; docs/serving.md "Sharded
+                                   decode"); 0/1 = single-chip
+  --smoke-sharded                  sharded-decode self-test: n=2 forced
+                                   host mesh (re-execs itself with
+                                   XLA_FLAGS when single-device),
+                                   staggered concurrent streams
+                                   bit-identical to the single-chip
+                                   twin, mesh evidence in /metrics, ONE
+                                   JSON line (healthy_window.sh
+                                   phase 19)
 
 The JSON front-end serves plain-array feed slots (dense/index vectors);
 structured SequenceBatch slots are an in-process engine feature.
@@ -598,9 +612,16 @@ def _demo_gen_batcher(args, tiny=False, metrics=None):
         from paddle_tpu.serving.speculative import make_draft
         draft = make_draft(params,
                            layers=getattr(args, "draft_layers", 1))
+    mesh = None
+    mesh_shards = int(getattr(args, "mesh_shards", 0) or 0)
+    if mesh_shards > 1:
+        # tensor-parallel decode (docs/serving.md "Sharded decode"):
+        # the demo trunk's heads/vocab divide any power-of-two mesh
+        from paddle_tpu.parallel import sharding as _psh
+        mesh = _psh.decode_mesh(mesh_shards)
     engine = DecodeEngine(params, num_heads=2, num_slots=slots,
                           max_len=max_len, prefill_buckets=buckets,
-                          name="demo_lm", metrics=metrics,
+                          name="demo_lm", metrics=metrics, mesh=mesh,
                           kv_layout=args.kv_layout,
                           kv_block_size=args.kv_block_size,
                           kv_num_blocks=args.kv_num_blocks,
@@ -1348,6 +1369,109 @@ def _smoke_speculative(args):
     return 0 if passed else 2
 
 
+def _smoke_sharded(args):
+    """Tensor-parallel sharded-decode self-test (healthy_window.sh
+    phase 19; docs/serving.md "Sharded decode"): the demo LM's one
+    chunked step under an n=2 model-axis mesh serving concurrent
+    staggered clients, every stream compared byte-for-byte against the
+    single-chip twin — sharding may only ever change WHERE bytes live,
+    never a token.  Speculation rides along (the draft trunk shards
+    with its target), so the probe composes chunked admission + spec
+    churn over the mesh at exactly one warm-up trace per jitted
+    function.  Mesh evidence must land on the /metrics surface (the
+    mesh_shards gauge).  XLA's host device count is fixed at backend
+    init, so on a single-device machine the probe RE-EXECS itself with
+    the forcing flag and forwards the child's JSON line + exit code.
+    Prints ONE JSON line; returns the process exit code."""
+    import copy
+    import os
+    import subprocess
+    import threading
+    import jax
+
+    shards = max(2, int(getattr(args, "mesh_shards", 0) or 2))
+    if len(jax.devices()) < shards:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={shards}").strip()
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.serving",
+             "--smoke-sharded", "--mesh-shards", str(shards),
+             "--kv-layout", args.kv_layout],
+            env=env, capture_output=True, text=True, timeout=900)
+        sys.stderr.write(proc.stderr[-2000:])
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        print(lines[-1] if lines else json.dumps(
+            {"metric": "sharded serving smoke", "value": 0,
+             "errors": [f"child produced no output, rc="
+                        f"{proc.returncode}"]}), flush=True)
+        return proc.returncode
+
+    sh_args = copy.copy(args)
+    sh_args.mesh_shards = shards
+    sh_args.prefill_chunk = min(4, args.prefill_chunk or 4) or 4
+    sh_args.speculate_k = max(1, getattr(args, "speculate_k", 0) or 2)
+    sh_args.draft_layers = max(1, getattr(args, "draft_layers", 1) or 1)
+    gen = _demo_gen_batcher(sh_args, tiny=True)
+    twin_args = copy.copy(sh_args)
+    twin_args.mesh_shards = 0
+    twin = _demo_gen_batcher(twin_args, tiny=True)
+    rng = np.random.RandomState(0)
+    cases = [(rng.randint(1, 256, int(n)).astype(np.int64), int(m))
+             for n, m in ((4, 12), (9, 8), (3, 14), (12, 10))]
+    errs, results, ref = [], [None] * len(cases), [None] * len(cases)
+    traces = (gen.engine.step_trace_count, gen.engine.draft.trace_count)
+    try:
+        def client(bat, out, i):
+            p, mt = cases[i]
+            time.sleep(0.002 * i)
+            out[i] = bat.submit(p, max_tokens=mt).result(120)["tokens"]
+
+        for bat, out in ((gen, results), (twin, ref)):
+            ts = [threading.Thread(target=client, args=(bat, out, i))
+                  for i in range(len(cases))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(180)
+        requests_ok = sum(r is not None for r in results)
+        bit_identical = results == ref and None not in results
+    except Exception as e:      # noqa: BLE001 — a probe failure must
+        # become a failed flag in the ONE JSON line, not a traceback
+        errs.append(f"{type(e).__name__}: {e}")
+        requests_ok, bit_identical = 0, False
+    no_retrace = ((gen.engine.step_trace_count,
+                   gen.engine.draft.trace_count) == traces == (1, 1))
+    snap = gen.metrics.snapshot()
+    metrics_text = gen.metrics.render_prometheus()
+    name = gen.metrics.name
+    metrics_sane = (snap["mesh_shards"] == shards
+                    and f"{name}_mesh_shards {shards}" in metrics_text
+                    and twin.metrics.snapshot()["mesh_shards"] == 1)
+    out = {
+        "metric": "sharded serving smoke (n-chip mesh vs single-chip "
+                  "twin)",
+        "value": requests_ok, "unit": f"requests_ok/{len(cases)}",
+        "vs_baseline": None,
+        "mesh_shards": snap["mesh_shards"],
+        "devices": len(jax.devices()),
+        "kv_layout": args.kv_layout,
+        "speculate_k": sh_args.speculate_k,
+        "bit_identical": bool(bit_identical),
+        "no_retrace": bool(no_retrace),
+        "metrics_sane": bool(metrics_sane),
+    }
+    if errs:
+        out["errors"] = errs[:5]
+    gen.close()
+    twin.close()
+    print(json.dumps(out), flush=True)
+    passed = (requests_ok == len(cases) and bit_identical and no_retrace
+              and metrics_sane)
+    return 0 if passed else 2
+
+
 def _write_port_file(path, port):
     """Publish the BOUND port (meaningful with --port 0) atomically —
     the fleet supervisor (serving/fleet.py) spawns replicas on ephemeral
@@ -1439,6 +1563,15 @@ def main(argv=None):
                     help="trunk depth of the draft derived from the "
                          "target (first N enc blocks; embedding/vocab "
                          "shared)")
+    # ---- tensor-parallel sharded decode (docs/serving.md "Sharded
+    # decode") ----
+    ap.add_argument("--mesh-shards", type=int,
+                    default=FLAGS.serving_mesh_shards,
+                    help="run the one chunked step under an N-chip "
+                         "model-axis mesh (heads/KV/vocab striped, "
+                         "streams bit-identical to single-chip; "
+                         "requires --prefill-chunk > 0); 0/1 = "
+                         "single-chip")
     ap.add_argument("--pallas-prefill", default=FLAGS.pallas_prefill,
                     help="route the legacy ladder's lm_prefill causal "
                          "pass through the flash kernel (no [Tp, Tp] "
@@ -1491,6 +1624,13 @@ def main(argv=None):
                          "vs a non-spec twin under concurrent clients, "
                          "streams bit-identical, acceptance-rate "
                          "evidence in /metrics; one JSON line, exit")
+    ap.add_argument("--smoke-sharded", action="store_true",
+                    help="sharded-decode self-test: n=2 forced host "
+                         "mesh (re-execs itself with XLA_FLAGS when "
+                         "single-device), concurrent streams "
+                         "bit-identical to the single-chip twin, "
+                         "mesh_shards evidence in /metrics; one JSON "
+                         "line, exit")
     # ---- resilience (docs/serving.md §6) ----
     ap.add_argument("--drain-timeout-s", type=float,
                     default=FLAGS.serving_drain_timeout_s,
@@ -1547,6 +1687,8 @@ def main(argv=None):
         return _smoke_quant(args)
     if args.smoke_speculative:
         return _smoke_speculative(args)
+    if args.smoke_sharded:
+        return _smoke_sharded(args)
     if args.demo_generate and not (args.artifact or args.artifacts
                                    or args.demo):
         # generation-only server: no /v1/infer batcher

@@ -80,11 +80,37 @@ class DraftTrunk:
 
     def __init__(self, params, *, k, num_slots, max_len, chunk,
                  num_heads=8, moe_top_k=2, pos_type="learned",
-                 name="draft", warm=False):
+                 name="draft", warm=False, mesh=None):
         if k < 1:
             raise ConfigError(f"speculate_k must be >= 1, got {k}")
         if chunk < 1:
             raise ConfigError(f"draft chunk must be >= 1, got {chunk}")
+        # tensor-parallel rollout (docs/serving.md "Sharded decode"): the
+        # draft shards EXACTLY like its target — same head/vocab stripe
+        # policy, its own private shard_map — so a sharded engine's
+        # speculation path never leaves the mesh.  The draft shares the
+        # target's head count and vocab, so the engine's divisibility
+        # validation covers it; standalone construction re-checks.
+        self.mesh = mesh
+        self.mesh_shards = 1
+        self._shard_axis = None
+        if mesh is not None:
+            from paddle_tpu.parallel import sharding as _psh
+            from paddle_tpu.parallel.mesh import AXIS_MODEL
+            from jax.sharding import NamedSharding
+            self._psh = _psh
+            self._shard_axis = AXIS_MODEL
+            self.mesh_shards = int(mesh.shape[AXIS_MODEL])
+            probs = _psh.lm_shard_problems(params, num_heads,
+                                           self.mesh_shards)
+            if probs:
+                raise ConfigError(
+                    f"{name}: cannot shard the draft trunk "
+                    f"{self.mesh_shards} ways: " + "; ".join(probs))
+            pspecs = _psh.lm_decode_param_specs(params, AXIS_MODEL)
+            params = jax.tree_util.tree_map(
+                lambda l, s: jax.device_put(l, NamedSharding(mesh, s)),
+                params, pspecs)
         self.params = params
         self.k = int(k)
         self.num_slots = int(num_slots)
@@ -98,14 +124,18 @@ class DraftTrunk:
         self._warm = False
         self._epoch = 0
         self._epoch_lock = threading.Lock()
-        self._cache = transformer.init_lm_cache(params, self.num_slots,
-                                                self.max_len)
+        self._cache = self._place_cache(
+            transformer.init_lm_cache(params, self.num_slots,
+                                      self.max_len))
 
-        def _draft_fn(p, cache, tokens, positions, lengths):
-            self._trace[0] += 1
+        axis = self._shard_axis
+        heads = (self.num_heads // self.mesh_shards if axis is not None
+                 else self.num_heads)
+
+        def _model(p, cache, tokens, positions, lengths):
             logits, cache = transformer.lm_decode_chunk_slots(
-                p, tokens, positions, lengths, cache, self.num_heads,
-                self.moe_top_k, self.pos_type)
+                p, tokens, positions, lengths, cache, heads,
+                self.moe_top_k, self.pos_type, shard_axis=axis)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             drafts = [nxt]
             # rollout writes land past the committed stream; the clamp
@@ -115,15 +145,44 @@ class DraftTrunk:
             for i in range(self.k - 1):
                 qp = jnp.minimum(base + i, self.max_len - 1)
                 logits, cache = transformer.lm_decode_step_slots(
-                    p, nxt, qp, cache, self.num_heads, self.moe_top_k,
-                    self.pos_type)
+                    p, nxt, qp, cache, heads, self.moe_top_k,
+                    self.pos_type, shard_axis=axis)
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 drafts.append(nxt)
             return jnp.stack(drafts, axis=1), cache
 
+        if axis is not None:
+            # ONE shard_map around the whole rollout: the k-1 unrolled
+            # steps stay inside, so the only collectives are the model's
+            # own seams — no per-step re-entry
+            from jax.sharding import PartitionSpec as _P
+            pspecs = self._psh.lm_decode_param_specs(self.params, axis)
+            cspecs = self._psh.lm_cache_specs(self._cache, axis)
+            body = self._psh.shard_map(
+                _model, mesh=mesh,
+                in_specs=(pspecs, cspecs, _P(), _P(), _P()),
+                out_specs=(_P(), cspecs), check_vma=False)
+        else:
+            body = _model
+
+        def _draft_fn(p, cache, tokens, positions, lengths):
+            self._trace[0] += 1
+            return body(p, cache, tokens, positions, lengths)
+
         self._jit = jax.jit(_draft_fn, donate_argnums=(1,))
         if warm:
             self.warmup()
+
+    def _place_cache(self, cache):
+        """Shard a fresh draft slab over the mesh (trailing head-stripe
+        axis, like the target's) — identity when unsharded."""
+        if self._shard_axis is None:
+            return cache
+        from jax.sharding import NamedSharding
+        specs = self._psh.lm_cache_specs(cache, self._shard_axis)
+        return jax.tree_util.tree_map(
+            lambda l, s: jax.device_put(l, NamedSharding(self.mesh, s)),
+            cache, specs)
 
     @property
     def trace_count(self):
@@ -155,8 +214,8 @@ class DraftTrunk:
         in the engine and is re-seeded by the re-seat paths."""
         with self._epoch_lock:
             self._epoch += 1
-            self._cache = transformer.init_lm_cache(
-                self.params, self.num_slots, self.max_len)
+            self._cache = self._place_cache(transformer.init_lm_cache(
+                self.params, self.num_slots, self.max_len))
 
     def warmup(self):
         """Trace the rollout exactly once at the live shapes.

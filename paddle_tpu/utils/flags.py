@@ -135,6 +135,12 @@ class Flags:
     serving_draft_layers: int = 1       # trunk depth of the derived
     #                                     draft (make_draft: first N enc
     #                                     blocks, embedding shared)
+    # ---- tensor-parallel sharded decode (parallel/sharding.py +
+    # decode_engine mesh=; docs/serving.md "Sharded decode")
+    serving_mesh_shards: int = 1        # model-axis mesh size the ONE
+    #                                     chunked step spans (heads/KV/
+    #                                     vocab striped, streams bit-
+    #                                     identical); 0/1 = single-chip
     # ---- fused decode kernels (ops/pallas/decode_attention.py: read
     # the KV cache once per step; docs/perf.md "Fused decode kernels")
     pallas_decode: str = "auto"         # auto (use_pallas(): TPU only) |
@@ -430,6 +436,19 @@ FLAG_DOCS = {
                             "acceptance rule keeps exactly the greedy "
                             "prefix).  0 = off; requires "
                             "serving_prefill_chunk > 0", "—"),
+    "serving_mesh_shards": ("tensor-parallel sharded decode: run the "
+                            "ONE chunked serving step under an N-chip "
+                            "model-axis mesh (decode_mesh) — attention "
+                            "heads + the KV pool stripe Hkv/N per chip, "
+                            "the embedding stripes vocab/N, wq/wk/wv "
+                            "shard their out-feature axis, and the only "
+                            "cross-chip seams are the per-layer "
+                            "attention-output all-gather, the logits "
+                            "all-gather, and the embedding psum.  "
+                            "Streams stay BIT-IDENTICAL to the "
+                            "single-chip engine; requires "
+                            "serving_prefill_chunk > 0 and N dividing "
+                            "heads/Hkv/vocab.  0/1 = single-chip", "—"),
     "serving_draft_layers": ("trunk depth of the draft model derived "
                              "from the target (speculative.make_draft: "
                              "the first N enc blocks; embedding / final "

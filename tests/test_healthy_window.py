@@ -52,7 +52,7 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "decode_fused_smoke.json", "autoscale_smoke.json",
                  "chunked_smoke.json", "quant_smoke.json",
                  "analysis_gate.json", "spec_smoke.json",
-                 "WINDOW_DONE"):
+                 "sharded_smoke.json", "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -193,6 +193,18 @@ def test_dryrun_executes_every_phase(tmp_path):
     assert spc["spec_tokens_per_step"] >= 1.0, spc
     assert spc["no_retrace"] is True, spc
     assert spc["metrics_sane"] is True, spc
+    # the sharded smoke really sharded: a 2-device mesh actually backed
+    # the step (the probe re-execs itself with the forcing flag on a
+    # single-device machine), every staggered stream bit-identical to
+    # the single-chip twin, the mesh_shards gauge on /metrics, and
+    # exactly one warm-up trace per jitted function
+    shd = json.loads((art / "sharded_smoke.json").read_text())
+    assert shd["value"] == int(shd["unit"].split("/")[1]), shd
+    assert shd["mesh_shards"] == 2, shd
+    assert shd["devices"] >= 2, shd
+    assert shd["bit_identical"] is True, shd
+    assert shd["no_retrace"] is True, shd
+    assert shd["metrics_sane"] is True, shd
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows

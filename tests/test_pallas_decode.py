@@ -299,6 +299,38 @@ def test_untileable_shapes_fall_back_not_crash():
         assert dk.maybe_slab(q2, k2, k2, pos, 2) is None
 
 
+def test_covers_judges_the_per_chip_stripe():
+    """Tensor-parallel coverage (docs/serving.md "Sharded decode") is
+    judged on the PER-CHIP widths — num_heads/n query heads over a
+    d/n-wide q and dkv/n-wide K/V stripe — never the full trunk's:
+    inside the engine's shard_map the maybe_* dispatch sees the local
+    arrays, so warmup's resolved-path prediction (covers(shards=n))
+    must localize the same way or the logged path lies."""
+    with dk.forced_mode("always"):
+        # full trunk covered; the 2-way stripe still splits its heads
+        # (hkv = 2 -> one KV head per chip)
+        assert dk.covers(4, 128, 64, 16)
+        assert dk.covers(4, 128, 64, 16, shards=2)
+        # 4-way: the local stripe is one query head over a 16-wide Dkv
+        # — dkv/n stops dividing dh, the grouped-head layout is gone
+        assert not dk.covers(4, 128, 64, 16, shards=4)
+        # uneven stripes never reach the kernels at all
+        assert not dk.covers(4, 128, 64, 16, shards=8)
+        assert not dk.covers(4, 128, 64, 16, shards=3)
+
+
+def test_covers_compiled_stripe_loses_lane_tiling(monkeypatch):
+    """Compiled-mode pin for the same localization: a Dkv that Mosaic's
+    lanes tile at full width (384 = 3 * 128) stops tiling at the 2-way
+    stripe (192 is neither <= 128 nor a 128-multiple), so the sharded
+    engine must reject to the reference path even though the identical
+    single-chip trunk compiles the fused kernel."""
+    monkeypatch.setattr(dk, "_interpret", lambda i: False)
+    with dk.forced_mode("always"):
+        assert dk.covers(16, 384, 384, 16, paged=True)
+        assert not dk.covers(16, 384, 384, 16, paged=True, shards=2)
+
+
 # ------------------------------------------------------- engine parity
 
 
