@@ -1668,6 +1668,174 @@ def bench_serving_chunked_prefill(slots=8, n_requests=36, vocab=256,
         f"{long_prompt}; unified step vs legacy ladder)"), extras
 
 
+def bench_serving_kv_spill(slots=4, n_returns=4, vocab=256, d_model=128,
+                           dff=256, layers=3, heads=2, block_size=8,
+                           chunk=8, prefix_blocks=12, seed=0):
+    """Hierarchical KV cache (serving/kv_pool.py HostTier +
+    decode_engine kv_host_bytes; docs/serving.md "Hierarchical KV"):
+    a long shared system prompt is registered, churn traffic forces
+    the tiny paged pool to evict (and therefore SPILL) its chain, and
+    the prompt keeps RETURNING.  With the tier on, each return visit
+    restore-hits — the chain streams back over the host link and seats
+    by reference, zero prefill chunk lanes — while the tier-less twin
+    RECOMPUTES the whole prefix through chunked prefill every time.
+    The warm drive measures the return-visit TTFT both ways (the
+    measured half of the restore-vs-recompute story) and verifies
+    every stream bit-identical between the two engines.
+
+    The analytic leg is the acceptance bar: extras["lower"] is the one
+    chunked paged step (the tier adds NO jitted code — spill gathers
+    with NumPy, the restore lands through the already-warm block-write
+    path) and extras["postcheck"] gates the routing model in BOTH
+    directions — ``perf/analytic.predicted_restore_ms`` must beat
+    ``predicted_recompute_ms`` for the long prefix and LOSE for a
+    sub-chunk one, at the fleet chip spec and at this host's, with the
+    live engine's router (``_restore_predicted_faster``) agreeing on
+    both verdicts."""
+    import jax
+    from paddle_tpu.models import transformer
+    from paddle_tpu.perf import analytic as perf_analytic
+    from paddle_tpu.serving import GenerationBatcher, ServingMetrics
+    from paddle_tpu.serving.decode_engine import DecodeEngine
+
+    prefix_len = prefix_blocks * block_size         # 96: 12 full blocks
+    max_len = prefix_len + 32
+    # two slots' worth of blocks + 1: the shared chain cannot stay
+    # resident once churn traffic claims seats
+    num_blocks = 2 * (max_len // block_size) + 1
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=1, d_model=d_model, dff=dff,
+                              enc_layers=layers, dec_layers=0,
+                              max_len=max_len, num_heads=heads)
+    warm = os.environ.get("BENCH_ANALYTIC_BUILD") != "1"
+
+    def make_engine(host_bytes, name):
+        return DecodeEngine(params, num_heads=heads, num_slots=slots,
+                            max_len=max_len, prefill_buckets=(8, 16),
+                            name=name, warm=warm, kv_layout="paged",
+                            kv_block_size=block_size,
+                            kv_num_blocks=num_blocks, prefill_chunk=chunk,
+                            kv_host_bytes=host_bytes)
+
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(1, vocab, prefix_len).astype(np.int32)
+    churn = [rng.randint(1, vocab, 56).astype(np.int32)
+             for _ in range(4 * n_returns)]
+    n_tok = 12
+
+    def drive(host_bytes, name):
+        engine = make_engine(host_bytes, name)
+        engine.metrics = ServingMetrics()
+        bat = GenerationBatcher(engine, queue_size=4096)
+        t0 = time.perf_counter()
+        lead = bat.submit(prefix, max_tokens=n_tok).result(300)
+        ttfts, outs, tokens = [], [lead["tokens"]], len(lead["tokens"])
+        for cycle in range(n_returns):
+            # churn: 4 x 8-block admissions against the ~2-slot pool
+            # evict the shared chain (tier on: spill; tier off: drop)
+            for p in churn[4 * cycle:4 * cycle + 4]:
+                tokens += len(bat.submit(p, max_tokens=8)
+                              .result(300)["tokens"])
+            out = bat.submit(prefix, max_tokens=n_tok).result(300)
+            ttfts.append(out["ttft_ms"])
+            outs.append(out["tokens"])
+            tokens += len(out["tokens"])
+        dt = time.perf_counter() - t0
+        snap = engine.metrics.snapshot()
+        bat.close()
+        ttfts.sort()
+        return {"ttft_return_p50_ms": round(ttfts[len(ttfts) // 2], 2),
+                "ttft_return_max_ms": round(ttfts[-1], 2),
+                "tokens_per_s": round(tokens / dt, 1),
+                "restore_hits": snap["kv_restore_hits_total"],
+                "spill_blocks": snap["kv_spill_blocks_total"],
+                "restore_bytes": snap["kv_restore_bytes_total"],
+                "kv_restore_ms": snap["kv_restore_ms"],
+                "outs": outs}
+
+    def lower():
+        return make_engine(256 << 20, "bench_spill_aot").lower()
+
+    def postcheck(_compiled):
+        """The restore-vs-recompute router's model, gated in BOTH
+        directions: the long registered prefix must be predicted
+        cheaper to RESTORE (one host-link stream beats a dozen chunk
+        steps), a sub-chunk prefix cheaper to RECOMPUTE (one cheap
+        chunk step beats the restore's fixed scheduling cycles) — at
+        the fleet chip spec AND this host's — and the live engine's
+        router must return the same verdicts."""
+        leaves = jax.tree_util.tree_leaves(params)
+        pc = sum(l.size for l in leaves)
+        pb = sum(l.size * l.dtype.itemsize for l in leaves)
+        dkv = d_model // heads
+        long_cov, short_cov = prefix_len, chunk // 2
+        row = {}
+        for chip in ("v5e", "cpu"):
+            r_long = perf_analytic.predicted_restore_ms(
+                long_cov, layers, dkv, heads, "float32", chip)
+            c_long = perf_analytic.predicted_recompute_ms(
+                long_cov, pc, pb, chunk, chip)
+            if not r_long < c_long:
+                raise AssertionError(
+                    f"[{chip}] restore NOT predicted faster for the "
+                    f"{long_cov}-position prefix: {r_long:.4f}ms vs "
+                    f"recompute {c_long:.4f}ms")
+            r_short = perf_analytic.predicted_restore_ms(
+                short_cov, layers, dkv, heads, "float32", chip)
+            c_short = perf_analytic.predicted_recompute_ms(
+                short_cov, pc, pb, chunk, chip)
+            if not c_short < r_short:
+                raise AssertionError(
+                    f"[{chip}] recompute NOT predicted faster for the "
+                    f"{short_cov}-position prefix: {c_short:.4f}ms vs "
+                    f"restore {r_short:.4f}ms")
+            row[f"predicted_restore_long_ms_{chip}"] = round(r_long, 4)
+            row[f"predicted_recompute_long_ms_{chip}"] = round(c_long, 4)
+        engine = make_engine(256 << 20, "bench_spill_route")
+        v_long = engine._restore_predicted_faster(long_cov)[0]
+        v_short = engine._restore_predicted_faster(short_cov)[0]
+        if not (v_long and not v_short):
+            raise AssertionError(
+                "the engine's restore router disagrees with the "
+                f"analytic model: long->{v_long} short->{v_short} "
+                "(want True/False)")
+        return dict(row, restore_direction_proof="pass",
+                    restore_route_agreement="pass")
+
+    extras = {"lower": lower, "postcheck": postcheck}
+    if warm:
+        spill = drive(256 << 20, "bench_spill_tier")
+        cold = drive(0, "bench_spill_twin")
+        if spill.pop("outs") != cold.pop("outs"):
+            raise AssertionError(
+                "restored and recomputed greedy streams diverged")
+        if spill["restore_hits"] < 1:
+            raise AssertionError(
+                "the spill drive never restore-hit — churn failed to "
+                "evict the shared chain")
+        extras.update(
+            spill=spill, recompute=cold,
+            ttft_return_speedup=round(
+                cold["ttft_return_p50_ms"]
+                / max(spill["ttft_return_p50_ms"], 1e-9), 2))
+
+    def run(_s):
+        return np.float32(drive(256 << 20, "bench_spill_timed")
+                          ["tokens_per_s"])
+
+    total_tokens = (n_returns + 1) * n_tok + 4 * n_returns * 8
+    prompt_tokens = (n_returns + 1) * prefix_len + 4 * n_returns * 56
+    per_tok = layers * (6 * d_model ** 2 + 2 * d_model * dff) \
+        + d_model * vocab
+    attn = layers * 4.0 * d_model * max_len / 2
+    flops = (2.0 * per_tok + attn) * (total_tokens + prompt_tokens)
+    return run, flops, None, (
+        f"hierarchical-KV serving return-visit TTFT ({n_returns} "
+        f"evict+return cycles, {prefix_len}-token shared prefix, "
+        f"{num_blocks}-block pool, block {block_size}, chunk {chunk}; "
+        "host spill tier vs cold recompute)"), extras
+
+
 def bench_serving_quant(slots=8, n_requests=48, vocab=256, d_model=128,
                         dff=256, layers=3, heads=2, block_size=8, seed=0):
     """Quantized serving (paddle_tpu/quant/; docs/serving.md "Quantized
@@ -2962,6 +3130,12 @@ _BENCHES = {
     # the exact-collective-seams proof and the per-chip predicted-bytes
     # gates; b = the single-chip slot count (sharded gets shards*b)
     "serving_sharded": (lambda b: bench_serving_sharded(slots=b), 8),
+    # hierarchical KV cache (serving/kv_pool.py HostTier): evicted
+    # prefix chains spill to host RAM and restore on the next hit —
+    # return-visit TTFT with the tier vs cold recompute, bit-identical
+    # streams, and the both-directions restore-vs-recompute routing
+    # gate; b = slots
+    "serving_kv_spill": (lambda b: bench_serving_kv_spill(slots=b), 4),
     "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),
     # input-pipeline overlap row: steps/s at train(prefetch=0) vs 2 on a
     # synthetic input-bound workload (the ShardedPrefetcher's win)

@@ -53,6 +53,7 @@ FAMILIES = [
     ("serving_quant", "serving_quant", None),
     ("serving_speculative", "serving_speculative", None),
     ("serving_sharded", "serving_sharded", None),
+    ("serving_kv_spill", "serving_kv_spill", None),
     ("trainer_prefetch", "trainer_prefetch", None),
 ]
 
@@ -226,6 +227,17 @@ FAMILY_ROOTS = {
                         "decode_attention_slab_chunk",
                         "decode_attention_paged_chunk",
                         "flash_attention"),
+    # serving_kv_spill runs the SAME one chunked step as
+    # serving_chunked_prefill — the host tier adds no jitted code (spill
+    # gathers with NumPy on the worker thread; the restore lands through
+    # the already-warm block-write donation path), so the family traces
+    # exactly the chunked-prefill root set.
+    "serving_kv_spill": ("decode_engine_step",
+                         "lm_decode_chunk_slots",
+                         "lm_decode_chunk_paged", "lm_prefill",
+                         "decode_attention_slab_chunk",
+                         "decode_attention_paged_chunk",
+                         "flash_attention"),
     "trainer_prefetch": ("trainer_step",),
 }
 

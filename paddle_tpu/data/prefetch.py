@@ -99,6 +99,86 @@ class _Failure:
         self.exc = exc
 
 
+def _run_jobs(jobs, done, stop):
+    """TransferWorker body.  Module-level for the same GC reason as
+    ``_fill``: a running Thread strongly references its target, so a
+    bound method would pin the worker object and defeat its finalizer."""
+    while not stop.is_set():
+        try:
+            item = jobs.get(timeout=0.1)
+        except _queue.Empty:
+            continue
+        if item is _END:
+            return
+        tag, fn = item
+        try:
+            out = fn()
+        except BaseException as e:  # noqa: BLE001 — must cross threads
+            out = _Failure(e)
+        if not _bounded_put(done, stop, (tag, out)):
+            return
+        del out
+
+
+class TransferWorker:
+    """The transfer core of ``ShardedPrefetcher``, generalized: ONE
+    bounded daemon thread running submitted zero-arg jobs in order, with
+    the same lifecycle discipline (stop event, ``_bounded_put`` against
+    an absent consumer, ``_Failure`` exception crossing, GC finalizer).
+
+    The input pipeline above specializes this shape to an iterator of
+    batches; the serving host tier (``serving/kv_pool.HostTier``) reuses
+    it for asynchronous KV-chain restores (deserialize + ``device_put``
+    off the decode thread).  Jobs run on the worker thread; their
+    results — or a ``_Failure`` wrapping what they raised — arrive via
+    ``poll()`` tagged with the token the submitter chose, so the
+    consumer matches completions to requests without ordering
+    assumptions."""
+
+    def __init__(self, name="paddle-tpu-transfer", depth=8):
+        self._jobs = _queue.Queue()
+        self._done = _queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=_run_jobs, args=(self._jobs, self._done, self._stop),
+            daemon=True, name=name)
+        self._finalizer = weakref.finalize(self, _release,
+                                           self._stop, self._jobs)
+        self._thread.start()
+
+    def submit(self, tag, fn):
+        """Queue ``fn`` (zero-arg) for the worker thread; its result
+        comes back from ``poll()`` as ``(tag, result)``."""
+        self._jobs.put((tag, fn))
+
+    def poll(self, timeout=0.0):
+        """Next completed job as ``(tag, result)`` — ``result`` is a
+        ``_Failure`` if the job raised (the caller decides per-job
+        fate) — or None when nothing completed within ``timeout``."""
+        try:
+            if timeout:
+                return self._done.get(timeout=timeout)
+            return self._done.get_nowait()
+        except _queue.Empty:
+            return None
+
+    def close(self):
+        """Stop the worker and join it; queued jobs and undelivered
+        results are dropped.  Safe to call more than once."""
+        _release(self._stop, self._jobs)
+        _release(self._stop, self._done)
+        self._finalizer.detach()
+        self._jobs.put(_END)
+        self._thread.join(timeout=30.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
 def device_placer(mesh=None, multiprocess=False):
     """Return a fn placing a host feed pytree onto device(s).
 

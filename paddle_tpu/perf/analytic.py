@@ -418,6 +418,62 @@ def predicted_sharded_step_bytes(layers, d, dff, vocab, s, t_span,
             "collective": float(collective)}
 
 
+# ------------------------------------------------ hierarchical-KV model
+
+# Scheduling cycles a host-tier restore spends off the device: the
+# probe-and-claim admission pass that defers the request, the transfer
+# landing between two steps, and the commit-and-reseat pass.  Priced in
+# dispatch floors (below) — the restore never runs device compute.
+RESTORE_CYCLES = 3
+# Per-step host dispatch floor (ms): the irreducible Python/runtime cost
+# of launching one jitted step, which the pure FLOPs/bytes roofline
+# ignores.  Dominant for tiny chunk steps, noise for real trunks — which
+# is exactly why a SHORT prefix should recompute (a couple of cheap
+# chunk steps) while a LONG one should restore (dozens of steps vs one
+# host-link stream).
+STEP_DISPATCH_MS = 0.05
+
+
+def predicted_restore_ms(covered, layers, dkv, kv_heads,
+                         kv_dtype="float32", chip="v5e"):
+    """First-principles wall cost of restoring a ``covered``-position
+    spilled prefix chain from the host tier (docs/serving.md
+    "Hierarchical KV"): the chain's serialized payload — int8 data plus
+    f32 scale sidecars on a quantized engine
+    (``quant.kv.kv_bytes_per_position``), times ``layers`` — streamed
+    once over the host link (``ChipSpec.host_link_bytes_per_s``), plus
+    ``RESTORE_CYCLES`` scheduling cycles at the dispatch floor.  The
+    restore-vs-recompute router compares this against
+    ``predicted_recompute_ms`` at the SAME chip spec; the
+    serving_kv_spill postcheck gates the comparison in both
+    directions."""
+    from paddle_tpu.quant import kv as kvq
+    spec = roofline.SPECS[chip] if isinstance(chip, str) else chip
+    payload = float(covered) * int(layers) \
+        * kvq.kv_bytes_per_position(dkv, kv_heads, kv_dtype)
+    return RESTORE_CYCLES * STEP_DISPATCH_MS \
+        + payload / spec.host_link_bytes_per_s * 1e3
+
+
+def predicted_recompute_ms(covered, param_count, param_bytes,
+                           prefill_chunk, chip="v5e"):
+    """First-principles wall cost of RECOMPUTING a ``covered``-position
+    prefix through the unified chunked-prefill step: ``ceil(covered /
+    (K-1))`` chunk steps, each streaming the trunk's stored weight
+    bytes (``param_bytes`` — int8 data + scales on a quantized tree)
+    and together spending ``2 * covered * param_count`` FLOPs, priced
+    by the roofline's two ceilings plus the per-step dispatch floor.
+    The companion term ``predicted_restore_ms`` replaces all of this
+    with one host-link stream — long prefixes amortize the restore's
+    fixed cycles over dozens of avoided chunk steps, short ones
+    don't."""
+    lanes = max(1, int(prefill_chunk) - 1)
+    steps = -(-int(covered) // lanes)
+    r = roofline.predict(2.0 * float(covered) * float(param_count),
+                         float(steps) * float(param_bytes), chip)
+    return steps * STEP_DISPATCH_MS + r["predicted_ms"]
+
+
 def _import_bench():
     if _REPO not in sys.path:
         sys.path.insert(0, _REPO)
@@ -524,7 +580,8 @@ def capture(name, model, batch=None, chips=("v5e", "v5p")):
                  "serving_fleet", "serving_paged",
                  "serving_decode_fused", "serving_autoscale",
                  "serving_chunked_prefill", "serving_quant",
-                 "serving_speculative", "serving_sharded"):
+                 "serving_speculative", "serving_sharded",
+                 "serving_kv_spill"):
         # the lowered program is one batch/slab step while the bench FLOPs
         # model covers the whole stream/burst — scopes differ, no cross-check
         row["bench_model_flops"] = None

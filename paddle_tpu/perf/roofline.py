@@ -29,6 +29,13 @@ class ChipSpec:
     name: str
     peak_flops: float       # dense bf16 FLOP/s (f32 for the cpu row)
     hbm_bytes_per_s: float  # HBM (DRAM for cpu) bandwidth, bytes/s
+    # host<->device link (PCIe) bandwidth: the third roofline ceiling
+    # the hierarchical KV tier lives under (a restore streams spilled
+    # bytes over THIS link instead of recomputing over HBM+MXU).  The
+    # public TPU spec sheets don't quote it; PCIe Gen3 x16 (~16 GB/s
+    # effective) is the conservative fleet floor, so restore-vs-
+    # recompute routing errs toward recompute.
+    host_link_bytes_per_s: float = 16e9
 
     @property
     def ridge_intensity(self):

@@ -83,6 +83,14 @@
 #                                        bit-identical, mesh_shards on
 #                                        /metrics, zero retraces — one
 #                                        JSON line)
+# 20. hierarchical KV smoke              (host-RAM spill tier: churn
+#                                        evicts a long shared-prefix
+#                                        chain, the returning prompt
+#                                        restore-hits with zero chunk
+#                                        lanes, bit-identical to the
+#                                        tier-less twin, spill/restore
+#                                        evidence on /metrics — one
+#                                        JSON line)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -376,6 +384,20 @@ log "phase 19: sharded serving smoke (n=2 host mesh vs single-chip twin)"
 timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-sharded \
     > "$ART/sharded_smoke.json" 2> "$ART/sharded_smoke.log"
 log "sharded smoke rc=$? -> $ART/sharded_smoke.json"
+
+log "phase 20: hierarchical KV smoke (host spill tier + async restore)"
+# tiny paged pool + host-RAM spill tier: churn traffic forces the pool
+# to evict (and spill) a long block-aligned system-prompt chain, then
+# the prompt RETURNS — the engine must restore-hit from the host tier
+# and seat by reference with ZERO prefill chunk lanes, the stream
+# bit-identical both to its first serving and to a tier-less twin's
+# cold recompute, spill/restore counters + the host_tier_bytes gauge
+# on /metrics, 1 warm-up trace — one JSON line
+# (python -m paddle_tpu.serving --smoke-spill; docs/serving.md
+# "Hierarchical KV")
+timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-spill \
+    > "$ART/spill_smoke.json" 2> "$ART/spill_smoke.log"
+log "spill smoke rc=$? -> $ART/spill_smoke.json"
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

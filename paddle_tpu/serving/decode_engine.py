@@ -86,8 +86,11 @@ from paddle_tpu.serving.batcher import (BatchExecutionError,
 from paddle_tpu.serving.engine import InferenceEngine, InvalidRequestError
 from paddle_tpu.quant.kv import KV_DTYPES
 from paddle_tpu.quant.weights import weight_shape as _w_shape
-from paddle_tpu.serving.kv_pool import (InsufficientBlocksError,
+from paddle_tpu.serving.kv_pool import (HostTier,
+                                        InsufficientBlocksError,
                                         PagedKVState,
+                                        RestorePendingError,
+                                        restore_chain, serialize_chain,
                                         slab_equivalent_blocks)
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.testing.trace import expect_traces
@@ -160,7 +163,7 @@ class DecodeEngine:
                  kv_layout="slab", kv_block_size=16, kv_num_blocks=0,
                  prefix_cache=True, prefill_chunk=0,
                  prefill_chunk_budget=0, kv_dtype="float32",
-                 speculate_k=0, draft=None, mesh=None):
+                 speculate_k=0, draft=None, mesh=None, kv_host_bytes=0):
         from paddle_tpu.models import transformer
         self._transformer = transformer
         if params.get("dec"):
@@ -240,6 +243,13 @@ class DecodeEngine:
         if kv_layout not in ("slab", "paged"):
             raise ConfigError(f"kv_layout={kv_layout!r} (supported: "
                               "'slab', 'paged')")
+        if int(kv_host_bytes) < 0:
+            raise ConfigError(
+                f"kv_host_bytes={kv_host_bytes} must be >= 0")
+        if int(kv_host_bytes) and kv_layout != "paged":
+            raise ConfigError(
+                "kv_host_bytes needs kv_layout='paged': the host tier "
+                "spills evicted prefix-chain blocks")
         if kv_dtype not in KV_DTYPES:
             raise ConfigError(f"kv_dtype={kv_dtype!r} (supported: "
                               f"{KV_DTYPES})")
@@ -295,6 +305,8 @@ class DecodeEngine:
                 params, pspecs)
             self.params = params
         self._paged = None
+        self._host_tier = None
+        self._pending_restores = {}
         if kv_layout == "paged":
             self.block_size = int(kv_block_size)
             if self.block_size < 1:
@@ -309,10 +321,30 @@ class DecodeEngine:
                               self.num_slots, self.max_len,
                               self.block_size, kv_dtype,
                               mesh_shards=self.mesh_shards))
+            # hierarchical KV (docs/serving.md "Hierarchical KV"):
+            # kv_host_bytes > 0 attaches an LRU host-RAM spill tier —
+            # prefix chains evicted under pool pressure serialize to
+            # host blobs instead of being destroyed, and the next hit
+            # restores them over the host link when the analytic model
+            # says that beats recomputing (perf/analytic.py)
+            if int(kv_host_bytes):
+                if not prefix_cache:
+                    raise ConfigError(
+                        "kv_host_bytes needs the prefix cache: the host "
+                        "tier spills/restores prefix-index chains")
+                if mesh is not None:
+                    raise ConfigError(
+                        "kv_host_bytes is single-chip for now: a sharded "
+                        "pool's blocks are head stripes, and the "
+                        "cross-replica payload transport is ROADMAP "
+                        "item 2(b)")
+                self._host_tier = HostTier(cap_bytes=int(kv_host_bytes))
             # host allocator + prefix index + per-slot block tables
-            self._paged = PagedKVState(self.num_slots, num_blocks,
-                                       self.block_size, self.max_len,
-                                       prefix_cache=prefix_cache)
+            self._paged = PagedKVState(
+                self.num_slots, num_blocks, self.block_size, self.max_len,
+                prefix_cache=prefix_cache,
+                on_evict=self._spill_chain if self._host_tier is not None
+                else None)
             # per-layer [num_blocks, block_size, Dkv] pools (block 0 is
             # the scratch block free slot rows point at)
             self._cache = self._place_cache(
@@ -320,6 +352,32 @@ class DecodeEngine:
                     params, num_blocks, self.block_size,
                     max_len=self.max_len, kv_dtype=kv_dtype,
                     num_heads=self.num_heads))
+            # host-tier restore bookkeeping (``_pending_restores``: one
+            # in-flight marker per prefix key -> (epoch at submit,
+            # t_submit) — poll_restores drops a job whose epoch went
+            # stale, its claim having died with the old paged state).
+            # The trunk signature fences blob relocation to identical
+            # trunks; the param count/bytes feed the restore-vs-
+            # recompute model.
+            enc = params.get("enc") or []
+            d = int(_w_shape(params["src_emb"])[1])
+            dkv = int(_w_shape(enc[0]["attn"]["wk"])[1]) if enc else 0
+            self._kv_dims = (len(enc), dkv)
+            self._trunk_sig = (f"L{len(enc)}.d{d}.dkv{dkv}"
+                               f".h{self.num_heads}.{kv_dtype}"
+                               f".b{self.block_size}")
+            leaves = jax.tree_util.tree_leaves(params)
+            self._param_count = sum(int(l.size) for l in leaves)
+            self._param_bytes = sum(
+                int(l.size) * np.dtype(l.dtype).itemsize for l in leaves)
+            # the staging job (transfer thread) rebuilds per-block chunk
+            # pytrees matching the cache structure WITHOUT touching the
+            # live (donated) cache: structure and leaf names are frozen
+            # here, once — they are reset-stable (same init fn)
+            flat = jax.tree_util.tree_flatten_with_path(self._cache)
+            self._cache_leaf_names = [jax.tree_util.keystr(p)
+                                      for p, _l in flat[0]]
+            self._cache_treedef = flat[1]
         else:
             # init_lm_cache validates max_len against the positional table
             self._cache = self._place_cache(transformer.init_lm_cache(
@@ -878,6 +936,190 @@ class DecodeEngine:
             self._paged.register_prefix(np.asarray(tokens, np.int32),
                                         slot)
 
+    # ------------------------------------------------- hierarchical KV tier
+
+    @property
+    def host_tier(self):
+        """The attached host-RAM spill tier (None unless
+        ``kv_host_bytes > 0`` on a paged engine)."""
+        return self._host_tier
+
+    def _spill_chain(self, key, covered, chain):
+        """``PrefixIndex`` eviction hook: gather the chain's block rows
+        off the device (the contents are still owned — the hook fires
+        BEFORE the references release), serialize them as a relocatable
+        blob (``kv_pool.serialize_chain``), and park it in the host
+        tier.  Runs on the batcher worker thread strictly between steps
+        (evictions only happen inside ``_alloc``), so the committed
+        cache is safe to read."""
+        tier = self._host_tier
+        # the index registers EVERY full-block prefix of a stream as its
+        # own entry, and pool pressure evicts them shortest-first — so a
+        # naive hook would serialize the same leading blocks once per
+        # prefix length (O(n^2) payload, all on the claim path that is
+        # waiting for these very blocks).  A spill is redundant while a
+        # LONGER entry of the same stream is still resident (it spills
+        # the superset payload if it ever leaves; until then the content
+        # is servable from the index itself) or already parked.
+        key = tuple(key)
+        n = len(key)
+        if any(len(k) > n and k[:n] == key
+               for k in self._paged.index._entries):
+            return
+        if tier.covers(key):
+            return
+        idx = np.asarray(chain, np.int32)
+        arrays = [(name, np.asarray(leaf[idx]))
+                  for name, leaf in zip(
+                      self._cache_leaf_names,
+                      jax.tree_util.tree_leaves(self._cache))]
+        blob = serialize_chain(key, covered, arrays, self._trunk_sig)
+        dropped = tier.put(key, covered, blob)
+        self.metrics.observe_kv_spill(len(chain))
+        self.metrics.set_host_tier_bytes(tier.bytes)
+        obstrace.instant("kv.spill", blocks=len(chain), bytes=len(blob),
+                         covered=int(covered), lru_dropped=dropped)
+
+    def _restore_predicted_faster(self, covered):
+        """The restore-vs-recompute router (perf/analytic.py): predicted
+        wall cost of streaming ``covered`` spilled positions back over
+        the host link vs re-running them through chunked prefill, at the
+        chip spec matching this backend.  Returns ``(verdict,
+        restore_ms, recompute_ms)`` — the ``serving_kv_spill`` bench
+        gates both directions of this comparison."""
+        from paddle_tpu.perf import analytic
+        chip = "cpu" if jax.default_backend() == "cpu" else "v5e"
+        layers, dkv = self._kv_dims
+        restore = analytic.predicted_restore_ms(
+            covered, layers, dkv, self.num_heads, self.kv_dtype, chip)
+        # the legacy ladder re-prefills in ONE dispatch — model it as a
+        # single whole-prefix chunk step
+        k = self.prefill_chunk if self.prefill_chunk else int(covered) + 1
+        recompute = analytic.predicted_recompute_ms(
+            covered, self._param_count, self._param_bytes, k, chip)
+        return restore < recompute, restore, recompute
+
+    def _maybe_begin_restore(self, full):
+        """Probe the host tier for a spilled coverage of ``full`` after
+        the resident prefix index missed.  On a worthwhile hit whose
+        restore the analytic model predicts to beat recompute: claim
+        fresh blocks (``claim_pending``) and submit the staging job
+        (deserialize + per-block ``device_put``) to the tier's transfer
+        thread, then return ``RestorePendingError`` — the batcher defers
+        the request exactly like a pool-dry one, and its retry after
+        ``poll_restores`` commits seats an ordinary resident hit.
+        Returns None to route as a plain miss (no tier, no coverage, or
+        the model says recompute)."""
+        tier = self._host_tier
+        if tier is None:
+            return None
+        full = np.asarray(full, np.int32)
+        key, covered, blob = tier.lookup(full, self.block_size)
+        if key is None \
+                or not self.cached_seat_worthwhile(covered, full.size):
+            return None
+        if key in self._pending_restores:
+            return RestorePendingError(
+                f"host-tier restore of {covered} position(s) already "
+                "in flight")
+        faster, restore_ms, recompute_ms = \
+            self._restore_predicted_faster(covered)
+        obstrace.instant("kv.restore_route", covered=int(covered),
+                         restore_ms=round(restore_ms, 4),
+                         recompute_ms=round(recompute_ms, 4),
+                         restore=faster)
+        if not faster:
+            return None
+        try:
+            self._paged.claim_pending(key, covered)
+        except InsufficientBlocksError as e:
+            return e        # defer without a marker: the pool must
+            #                 drain before the claim can even be staged
+        names = self._cache_leaf_names
+        treedef = self._cache_treedef
+        sig = self._trunk_sig
+
+        def _stage(blob=blob):
+            # transfer-thread body: deserialize + rebuild one chunk
+            # pytree per block (the cache STRUCTURE was frozen at
+            # construction — the live donated cache is never touched
+            # here) and device_put each; the worker thread _jit_writes
+            # them into the claimed blocks between steps
+            _toks, cov, arrays = restore_chain(blob, sig)
+            named = dict(arrays)
+            n_blocks = int(named[names[0]].shape[0]) if names else 0
+            chunks = []
+            for j in range(n_blocks):
+                chunk = jax.tree_util.tree_unflatten(
+                    treedef, [named[n][j] for n in names])
+                chunks.append(jax.device_put(chunk))
+            return cov, chunks
+
+        self._pending_restores[key] = (self._epoch, time.perf_counter())
+        tier.submit(key, _stage)
+        return RestorePendingError(
+            f"host-tier restore of {covered} position(s) started")
+
+    def poll_restores(self, timeout=0.0):
+        """Land completed host-tier restores, strictly BETWEEN steps
+        (the batcher worker calls this at the top of its loop): write
+        each staged chunk into its claimed block (``_jit_write`` — the
+        one compiled write shape, zero new traces), publish the chain
+        into the prefix index (``commit_pending``), and drop the blob.
+        Epoch-guarded: a job submitted before a ``reset()`` is dropped —
+        its claim died with the replaced paged state and its blob stays
+        resident for the next probe.  A failed job releases its claim
+        and forgets the blob (recompute serves the prefix instead).
+        Returns the number of restores committed."""
+        tier = self._host_tier
+        if tier is None or not self._pending_restores:
+            return 0
+        landed = 0
+        while self._pending_restores:
+            job = tier.poll(timeout=timeout if not landed else 0.0)
+            if job is None:
+                break
+            key, result = job
+            info = self._pending_restores.pop(key, None)
+            if info is None:
+                continue        # marker cleared by a reset
+            epoch, t0 = info
+            if epoch != self._epoch:
+                obstrace.instant("kv.restore_stale")
+                continue
+            from paddle_tpu.data.prefetch import _Failure
+            chain = list(self._paged._pending.get(key, ()))
+            if isinstance(result, _Failure):
+                self._paged.release_pending(key)
+                tier.pop(key)   # a blob that failed to stage must not
+                #                 retry forever
+                logger.warning(
+                    "%s: host-tier restore failed (prefix falls back to "
+                    "recompute): %s: %s", self.name,
+                    type(result.exc).__name__, result.exc)
+                continue
+            covered, chunks = result
+            if len(chunks) != len(chain):
+                self._paged.release_pending(key)
+                tier.pop(key)
+                logger.warning(
+                    "%s: host-tier restore staged %d block(s) for a "
+                    "%d-block claim; dropped", self.name, len(chunks),
+                    len(chain))
+                continue
+            for bid, chunk in zip(chain, chunks):
+                self._cache = self._jit_write(self._cache, chunk,
+                                              np.int32(bid))
+            self._paged.commit_pending(key, covered)
+            ent = tier.pop(key)
+            self.metrics.observe_kv_restore(
+                len(ent[1]) if ent else 0, time.perf_counter() - t0)
+            self.metrics.set_host_tier_bytes(tier.bytes)
+            obstrace.instant("kv.restore_commit", blocks=len(chain),
+                             covered=int(covered))
+            landed += 1
+        return landed
+
     def seat_prefilled(self, fulls):
         """THE seat-prefix helper (one definition, four callers:
         ``Supervisor.reprefill`` slot recovery, the batcher's
@@ -922,6 +1164,13 @@ class DecodeEngine:
                         results[i] = self.seat_cached(full, covered, chain)
                     except Exception as e:    # noqa: BLE001 — isolate
                         results[i] = e        # to this item
+                    continue
+                # resident miss: a spilled twin may be one host-link
+                # stream away — defer behind the async restore when the
+                # analytic model says that beats re-prefilling
+                pending = self._maybe_begin_restore(full)
+                if pending is not None:
+                    results[i] = pending
                     continue
             pre = min(full.size - 1, top)
             if self.kv_layout == "paged" and not self.can_admit(pre + 1):
@@ -986,6 +1235,12 @@ class DecodeEngine:
                             0, int(full.size) - int(covered))
                     except Exception as e:  # noqa: BLE001 — isolate
                         results[i] = e      # to this item
+                    continue
+                # resident miss: consult the host tier before burning
+                # chunk steps on a prefix one restore away
+                pending = self._maybe_begin_restore(full)
+                if pending is not None:
+                    results[i] = pending
                     continue
                 if not self.can_admit(full.size + 1):
                     # pool-dry fast path: defer before burning any work
@@ -1227,7 +1482,14 @@ class DecodeEngine:
                 old = self._paged
                 self._paged = PagedKVState(
                     self.num_slots, old.pool.num_blocks, self.block_size,
-                    self.max_len, prefix_cache=old.index is not None)
+                    self.max_len, prefix_cache=old.index is not None,
+                    on_evict=self._spill_chain
+                    if self._host_tier is not None else None)
+                # in-flight restore claims died with the old state;
+                # poll_restores drops their jobs at the epoch check, and
+                # the blobs stay in the tier — recovery re-seats can
+                # restore-hit the same spilled prefixes
+                self._pending_restores.clear()
                 # _place_cache: a sharded engine's rebuilt pool must come
                 # back with the same mesh placement or the (still-cached)
                 # compiled step would see new shardings and recompile
@@ -1310,6 +1572,19 @@ class DecodeEngine:
             self._draft.warmup()
         if self.prefill_chunk:
             if self.kv_layout == "paged":
+                if self._host_tier is not None:
+                    # host-tier restores land through the block write;
+                    # warm it HERE so the first restore commits with
+                    # zero new compiles (chunked ingestion itself never
+                    # uses it — prompt writes ride the step)
+                    chunk = jax.tree_util.tree_map(
+                        lambda l: np.zeros(l.shape[1:], l.dtype),
+                        self._cache)
+                    with expect_traces(lambda: self._write_traces[0], 1,
+                                       f"decode[{self.name}]: "
+                                       "block-write warm-up"):
+                        self._cache = self._jit_write(self._cache, chunk,
+                                                      np.int32(0))
                 # the CoW fork is the only other device op the chunked
                 # paged engine uses (block writes ride the step itself)
                 with expect_traces(lambda: self._copy_traces[0], 1,
@@ -2282,11 +2557,21 @@ class GenerationBatcher:
                         "generation batcher closed without drain"))
                 self._preempted, self._waiting = [], collections.deque()
                 return
+            # host-tier restores land HERE — strictly between steps: the
+            # staged chunks write into their claimed blocks and the
+            # chain publishes into the prefix index, so a deferred
+            # request's next retry seats as an ordinary resident hit
+            self.engine.poll_restores()
             self._admit_from_queue(block=not self._by_slot)
             if not self._by_slot:
                 if self._closed.is_set() and self._q.empty() \
                         and not self._waiting and not self._preempted:
                     return
+                if self._waiting:
+                    # every runnable request is deferred (restore in
+                    # flight / pool dry): wait a tick on the transfer
+                    # thread instead of hot-spinning the retry loop
+                    self.engine.poll_restores(timeout=0.005)
                 continue
             sup = self.supervisor
             if self.engine.chunked:

@@ -39,16 +39,37 @@ jitted step (``transformer.lm_decode_step_paged``) only ever sees
 fixed-shape pools and tables.  ``check()`` verifies the refcount ledger
 (no leak, no double-free) — the chaos tests run it after every fault
 matrix pass.
+
+The HIERARCHICAL tier (docs/serving.md §5 "Hierarchical KV") extends
+the story below HBM: ``HostTier`` is an LRU byte-capped host-RAM store
+of SPILLED prefix chains — when ``PrefixIndex.evict_lru`` drops an
+entry under pool pressure, an ``on_evict`` hook (the engine's) gathers
+the chain's device blocks and ``serialize_chain``s them into the tier,
+keyed by the SAME block-aligned prefix key; a later prompt that would
+have recomputed that prefix instead restores it asynchronously (the
+tier's ``TransferWorker`` thread deserializes + stages device chunks
+while decode steps keep running) into freshly claimed blocks and seats
+by reference like any resident hit.  ``serialize_chain``/
+``restore_chain`` are the relocatable wire format (version byte +
+trunk signature) the ROADMAP item 2(b) cross-replica handoff reuses.
 """
 
 import collections
+import json
+import threading
 
 import numpy as np
 
 from paddle_tpu.obs import trace as obstrace
 from paddle_tpu.utils.error import ConfigError
+from paddle_tpu.utils.logging import logger
 
 SCRATCH_BLOCK = 0
+
+# serialize_chain wire-format version: byte 0 of every blob.  Bump on
+# any layout change — restore_chain rejects other versions, so a
+# cross-replica peer (item 2(b)) can never mis-parse a newer blob.
+WIRE_VERSION = 1
 
 
 def slab_equivalent_blocks(num_slots, max_len, block_size,
@@ -80,6 +101,232 @@ class InsufficientBlocksError(RuntimeError):
     every prefix-index entry.  Admission defers the request (it is NOT a
     client error); mid-decode the engine preempts a victim slot instead
     (``evictions{reason="pool_exhausted"}``)."""
+
+
+class RestorePendingError(InsufficientBlocksError):
+    """A host-tier restore covering this request's prefix is in flight:
+    blocks are claimed and the payload is crossing the link, so seating
+    now would recompute K/V the transfer is about to deliver.  Subclasses
+    ``InsufficientBlocksError`` on purpose — every defer-and-retry seam
+    (``_waiting`` / ``_preempted``) already treats that as "space, not
+    failure", and the retry after the restore commits seats as an
+    ordinary resident prefix hit."""
+
+
+def serialize_chain(tokens, covered, arrays, trunk_sig):
+    """Pack one prefix chain's K/V payload into a RELOCATABLE blob: the
+    block-aligned prefix key (``tokens``), the positions it covers, and
+    each cache leaf's gathered block rows (int8 payload + f32 scale
+    sidecars on a quantized engine — spilled bytes stay ~halved) as raw
+    bytes behind a JSON manifest.  Nothing in the blob references block
+    IDS — restore lands the payload in whatever blocks the destination
+    pool hands out, which is exactly what lets the same format cross
+    replicas (ROADMAP item 2(b)).
+
+    Layout: 1 version byte, 8-byte little-endian header length, the
+    JSON header ``{version, trunk_sig, tokens, covered, arrays:
+    [{name, dtype, shape}...]}``, then each array's contiguous bytes in
+    manifest order.  ``trunk_sig`` fingerprints the producing engine's
+    trunk (dims + layers + kv dtype + block size); ``restore_chain``
+    rejects a mismatch — K/V bytes are only relocatable between
+    identical trunks."""
+    arrays = list(arrays)
+    header = {
+        "version": WIRE_VERSION,
+        "trunk_sig": str(trunk_sig),
+        "tokens": [int(t) for t in tokens],
+        "covered": int(covered),
+        "arrays": [{"name": str(n), "dtype": str(a.dtype),
+                    "shape": [int(s) for s in a.shape]}
+                   for n, a in arrays],
+    }
+    hdr = json.dumps(header, sort_keys=True).encode("utf-8")
+    parts = [bytes([WIRE_VERSION]), len(hdr).to_bytes(8, "little"), hdr]
+    for _n, a in arrays:
+        parts.append(np.ascontiguousarray(a).tobytes())
+    return b"".join(parts)
+
+
+def restore_chain(blob, trunk_sig):
+    """Inverse of ``serialize_chain``: returns ``(tokens_tuple,
+    covered, [(name, ndarray), ...])``.  Raises ``ValueError`` on a
+    version-byte mismatch, a trunk-signature mismatch, or a truncated /
+    oversized payload — a corrupt or foreign blob must never seat."""
+    if len(blob) < 9:
+        raise ValueError(f"chain blob truncated: {len(blob)} byte(s)")
+    if blob[0] != WIRE_VERSION:
+        raise ValueError(f"chain blob version {blob[0]} != "
+                         f"{WIRE_VERSION} (wire format mismatch)")
+    hlen = int.from_bytes(blob[1:9], "little")
+    if 9 + hlen > len(blob):
+        raise ValueError("chain blob header overruns the payload")
+    header = json.loads(blob[9:9 + hlen].decode("utf-8"))
+    if header.get("version") != WIRE_VERSION:
+        raise ValueError(f"chain header version {header.get('version')} "
+                         f"!= {WIRE_VERSION}")
+    if header["trunk_sig"] != str(trunk_sig):
+        raise ValueError(
+            f"chain trunk signature {header['trunk_sig']!r} does not "
+            f"match this engine's {str(trunk_sig)!r}: K/V bytes are only "
+            "relocatable between identical trunks")
+    off = 9 + hlen
+    arrays = []
+    for spec in header["arrays"]:
+        dt = np.dtype(spec["dtype"])
+        shape = tuple(spec["shape"])
+        nbytes = dt.itemsize * int(np.prod(shape, dtype=np.int64))
+        if off + nbytes > len(blob):
+            raise ValueError(f"chain blob truncated inside array "
+                             f"{spec['name']!r}")
+        arrays.append((spec["name"],
+                       np.frombuffer(blob, dt, count=int(np.prod(
+                           shape, dtype=np.int64)),
+                           offset=off).reshape(shape)))
+        off += nbytes
+    if off != len(blob):
+        raise ValueError(f"chain blob holds {len(blob) - off} trailing "
+                         "byte(s) past the manifest")
+    return tuple(header["tokens"]), int(header["covered"]), arrays
+
+
+class HostTier:
+    """LRU host-RAM store of spilled prefix-chain blobs, byte-capped.
+
+    The device-side ``PrefixIndex`` holds CHAINS (pool references); this
+    tier holds their serialized PAYLOADS after eviction, keyed by the
+    same block-aligned prefix keys, so the reusable-prefix working set
+    is bounded by ``cap_bytes`` of host RAM instead of HBM.  LRU within
+    the cap: ``put`` evicts the stalest blobs until the new one fits
+    (spill-of-spill simply falls off the end — those prefixes recompute,
+    exactly as they would with no tier).
+
+    The tier also owns the bounded background transfer thread
+    (``data/prefetch.TransferWorker``) restores run on: the engine
+    submits a staging job (deserialize + per-block ``device_put``) and
+    polls completions strictly BETWEEN decode steps, so the transfer
+    overlaps compute and the donated cache is only ever written by the
+    worker-thread seam.  All map state is lock-guarded — spills/probes
+    happen on the batcher worker thread while ``/metrics`` reads the
+    byte gauge from HTTP threads.
+    """
+
+    def __init__(self, cap_bytes=0, worker_depth=8):
+        if int(cap_bytes) < 0:
+            raise ConfigError(f"HostTier cap_bytes must be >= 0, got "
+                              f"{cap_bytes}")
+        self.cap_bytes = int(cap_bytes)
+        self._lock = threading.Lock()
+        self._entries = collections.OrderedDict()  # key -> (covered, blob)
+        self._bytes = 0
+        self._worker_depth = int(worker_depth)
+        self._worker = None         # lazy: tests exercise put/lookup
+        #                             without ever paying for a thread
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def bytes(self):
+        """Current resident payload bytes (the host_tier_bytes gauge)."""
+        with self._lock:
+            return self._bytes
+
+    # ------------------------------------------------------------ store
+
+    def put(self, key, covered, blob):
+        """Insert (or refresh) one spilled chain; evicts LRU entries
+        until the tier fits ``cap_bytes`` again.  Returns the number of
+        entries evicted to make room.  Strict-prefix entries of ``key``
+        are dropped — the new blob's payload supersets theirs, and
+        ``lookup`` probes longest-first anyway."""
+        key = tuple(int(t) for t in key)
+        dropped = 0
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old[1])
+            for k in [k for k in self._entries
+                      if len(k) < len(key) and key[:len(k)] == k]:
+                _cov, shadowed = self._entries.pop(k)
+                self._bytes -= len(shadowed)
+            self._entries[key] = (int(covered), blob)
+            self._bytes += len(blob)
+            while self.cap_bytes and self._bytes > self.cap_bytes \
+                    and self._entries:
+                _k, (_cov, dropped_blob) = self._entries.popitem(
+                    last=False)
+                self._bytes -= len(dropped_blob)
+                dropped += 1
+        return dropped
+
+    def pop(self, key):
+        """Remove and return ``(covered, blob)`` for ``key``, or None."""
+        with self._lock:
+            ent = self._entries.pop(tuple(int(t) for t in key), None)
+            if ent is not None:
+                self._bytes -= len(ent[1])
+            return ent
+
+    def covers(self, key):
+        """True if some stored entry's key EXTENDS ``key`` (equal or
+        longer, same leading tokens) — its payload supersets what a
+        spill of ``key`` would store, so that spill is redundant."""
+        key = tuple(int(t) for t in key)
+        n = len(key)
+        with self._lock:
+            return any(len(k) >= n and k[:n] == key
+                       for k in self._entries)
+
+    def lookup(self, tokens, block_size):
+        """Longest spilled coverage of ``tokens`` — the host-tier twin
+        of ``PrefixIndex.lookup``: the exact probe first, then
+        block-aligned prefixes descending.  Returns ``(key, covered,
+        blob)`` or ``(None, 0, None)``.  The hit is an LRU touch; the
+        entry stays resident until the restore COMMITS (an in-flight
+        job going stale across a reset must not lose the payload)."""
+        bs = int(block_size)
+        toks = tuple(int(t) for t in tokens)
+        with self._lock:
+            ent = self._entries.get(toks)
+            if ent is not None:
+                self._entries.move_to_end(toks)
+                return toks, ent[0], ent[1]
+            for m in range(len(toks) // bs, 0, -1):
+                ent = self._entries.get(toks[:m * bs])
+                if ent is not None:
+                    self._entries.move_to_end(toks[:m * bs])
+                    return toks[:m * bs], ent[0], ent[1]
+        return None, 0, None
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    # ------------------------------------------------------ transfer thread
+
+    def submit(self, tag, fn):
+        """Run ``fn`` on the tier's background transfer thread; the
+        result arrives via ``poll()`` as ``(tag, result)``."""
+        if self._worker is None:
+            from paddle_tpu.data.prefetch import TransferWorker
+            self._worker = TransferWorker(name="paddle-tpu-kv-restore",
+                                          depth=self._worker_depth)
+        self._worker.submit(tag, fn)
+
+    def poll(self, timeout=0.0):
+        """Next completed transfer job, or None.  The result may be a
+        ``prefetch._Failure`` — the engine decides per-job fate (a
+        failed restore falls back to recompute, never kills serving)."""
+        if self._worker is None:
+            return None
+        return self._worker.poll(timeout=timeout)
+
+    def close(self):
+        if self._worker is not None:
+            self._worker.close()
+            self._worker = None
 
 
 class BlockPool:
@@ -179,8 +426,12 @@ class PrefixIndex:
     the blocks actually free only once no slot chain shares them.
     """
 
-    def __init__(self, pool):
+    def __init__(self, pool, on_evict=None):
         self._pool = pool
+        # spill hook (the engine's D2H gather + HostTier.put): called
+        # with (key, covered, [bids]) BEFORE the references release —
+        # the block contents must be read while still owned
+        self._on_evict = on_evict
         self._entries = collections.OrderedDict()  # key -> (covered, [bids])
 
     def __len__(self):
@@ -234,10 +485,20 @@ class PrefixIndex:
 
     def evict_lru(self):
         """Release the stalest entry's block references; True if one was
-        evicted."""
+        evicted.  With a spill hook installed, the entry's key/coverage/
+        chain are handed to it FIRST (the hook gathers the device bytes
+        into the host tier) — a hook failure only loses the spill, never
+        the eviction, so pool pressure always makes progress."""
         if not self._entries:
             return False
-        _key, (_cov, chain) = self._entries.popitem(last=False)
+        key, (cov, chain) = self._entries.popitem(last=False)
+        if self._on_evict is not None:
+            try:
+                self._on_evict(key, cov, list(chain))
+            except Exception as e:  # noqa: BLE001 — a spill failure
+                # must never wedge the allocator under pressure
+                logger.warning("prefix spill of %d block(s) failed: "
+                               "%s: %s", len(chain), type(e).__name__, e)
         for bid in chain:
             self._pool.release(bid)
         return True
@@ -257,14 +518,20 @@ class PagedKVState:
     """
 
     def __init__(self, num_slots, num_blocks, block_size, max_len,
-                 prefix_cache=True):
+                 prefix_cache=True, on_evict=None):
         self.pool = BlockPool(num_blocks, block_size)
-        self.index = PrefixIndex(self.pool) if prefix_cache else None
+        self.index = PrefixIndex(self.pool, on_evict=on_evict) \
+            if prefix_cache else None
         self.block_size = self.pool.block_size
         self.blocks_per_row = -(-int(max_len) // self.block_size)
         self.tables = np.zeros((int(num_slots), self.blocks_per_row),
                                np.int32)
         self._chains = [[] for _ in range(int(num_slots))]
+        # host-tier restores in flight: prefix key -> [bids] claimed
+        # ahead of the async transfer (refs held here so the pool can
+        # never hand them out twice; committed into the index — or
+        # released — when the restore lands or dies)
+        self._pending = {}
         # admission order, for pool-pressure victim choice (youngest
         # first: cheapest replay, most blocks still ahead of it)
         self._seat_seq = np.zeros((int(num_slots),), np.int64)
@@ -355,6 +622,57 @@ class PagedKVState:
         if self.index is None:
             return 0, []
         return self.index.lookup(tokens)
+
+    # ------------------------------------------------------ host-tier restore
+
+    def claim_pending(self, key, n_positions):
+        """Claim ``blocks_for(n_positions)`` fresh blocks for an async
+        host-tier restore of prefix ``key`` — held in the pending ledger
+        (refcount 1, outside every slot chain) until the transfer lands.
+        All-or-nothing like ``seat_fresh``; raises
+        ``InsufficientBlocksError`` leaving nothing claimed."""
+        key = tuple(int(t) for t in key)
+        if key in self._pending:
+            raise RuntimeError(f"restore of {len(key)}-token prefix "
+                               "already in flight")
+        need = self.blocks_for(n_positions)
+        chain = []
+        for _ in range(need):
+            bid = self._alloc()
+            if bid is None:
+                for b in chain:
+                    self.pool.release(b)
+                raise InsufficientBlocksError(
+                    f"pool dry claiming {need} block(s) for a host-tier "
+                    f"restore ({self.pool.num_free} free)")
+            chain.append(bid)
+        self._pending[key] = chain
+        obstrace.instant("kv.restore_claim", blocks=len(chain),
+                         free=self.pool.num_free)
+        return list(chain)
+
+    def release_pending(self, key):
+        """Drop a claim whose restore died (job failure or a stale
+        epoch that was caught before the state was replaced)."""
+        chain = self._pending.pop(tuple(int(t) for t in key), None)
+        if chain:
+            for bid in chain:
+                self.pool.release(bid)
+
+    def commit_pending(self, key, covered):
+        """The restore landed (the engine wrote every staged chunk into
+        the claimed blocks): publish the chain into the prefix index —
+        the entry takes its own references, exactly like a chain a slot
+        registered — and drop the pending claim.  If the key was
+        recomputed into the index while the transfer flew, the existing
+        entry wins (identical K/V by determinism) and the restored
+        blocks simply free."""
+        key = tuple(int(t) for t in key)
+        chain = self._pending.pop(key)
+        if self.index is not None:
+            self.index._add(key, int(covered), chain)
+        for bid in chain:
+            self.pool.release(bid)
 
     # ------------------------------------------------------------ stepping
 
@@ -453,6 +771,8 @@ class PagedKVState:
         self.pool.check()
         expect = collections.Counter()
         for chain in self._chains:
+            expect.update(chain)
+        for chain in self._pending.values():
             expect.update(chain)
         if self.index is not None:
             for _cov, chain in self.index._entries.values():

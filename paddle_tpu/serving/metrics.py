@@ -114,6 +114,17 @@ class ServingMetrics:
         #                                  resident prefix blocks
         self.prefix_cache_misses = 0     # fresh admissions that prefilled
         self.cow_forks = 0               # copy-on-write block forks
+        # ---- hierarchical KV host tier (decode_engine.py kv_host_bytes
+        # over serving/kv_pool.HostTier): evicted prefix chains spill to
+        # host RAM and restore over the host link instead of recomputing
+        self.kv_spill_blocks_total = 0   # blocks serialized to the tier
+        self.kv_restore_hits_total = 0   # spilled chains restored + seated
+        self.kv_restore_bytes_total = 0  # payload bytes restored H2D
+        self.host_tier_bytes = 0         # gauge: resident spilled bytes
+        # submit -> commit wall time of one async restore (seconds)
+        self.kv_restore = Histogram(f"{name}_kv_restore",
+                                    max_samples=max_samples,
+                                    keep="last", clock=self.clock)
         # v2 Inference per-row-signature engine cache (satellite): LRU
         # evictions of whole compiled engines under ragged feed signatures
         self.engine_cache_evictions = 0
@@ -244,6 +255,24 @@ class ServingMetrics:
         serving: "int8" -> ``kv_cache_int8 1`` on /metrics)."""
         with self._lock:
             self.kv_dtype = str(kv_dtype)
+
+    def observe_kv_spill(self, blocks):
+        """One prefix chain spilled to the host tier at eviction."""
+        with self._lock:
+            self.kv_spill_blocks_total += int(blocks)
+
+    def observe_kv_restore(self, nbytes, seconds):
+        """One spilled chain restored and committed back into the pool
+        (``seconds`` = submit -> commit wall time of the async job)."""
+        with self._lock:
+            self.kv_restore_hits_total += 1
+            self.kv_restore_bytes_total += int(nbytes)
+        self.kv_restore.add(seconds)
+
+    def set_host_tier_bytes(self, nbytes):
+        """Gauge: serialized payload bytes resident in the host tier."""
+        with self._lock:
+            self.host_tier_bytes = int(nbytes)
 
     # ---- resilience events (resilience/supervisor.py callers) ----
 
@@ -380,6 +409,10 @@ class ServingMetrics:
                 "prefix_cache_hits_total": self.prefix_cache_hits,
                 "prefix_cache_misses_total": self.prefix_cache_misses,
                 "cow_forks_total": self.cow_forks,
+                "kv_spill_blocks_total": self.kv_spill_blocks_total,
+                "kv_restore_hits_total": self.kv_restore_hits_total,
+                "kv_restore_bytes_total": self.kv_restore_bytes_total,
+                "host_tier_bytes": self.host_tier_bytes,
                 "engine_cache_evictions": self.engine_cache_evictions,
                 "retries_total": self.retries_total,
                 "watchdog_trips_total": self.watchdog_trips_total,
@@ -406,6 +439,9 @@ class ServingMetrics:
                           for q, v in ttft.items()}
         out["tpot_ms"] = {f"p{q}": round(v * 1e3, 3)
                           for q, v in tpot.items()}
+        out["kv_restore_ms"] = {
+            f"p{q}": round(v * 1e3, 3)
+            for q, v in self.kv_restore.percentiles(_QUANTILES).items()}
         return out
 
     # ------------------------------------------------------------ render
@@ -486,6 +522,15 @@ class ServingMetrics:
                  "fresh admissions that re-prefilled (paged KV cache)"),
                 ("cow_forks_total", self.cow_forks,
                  "copy-on-write KV block forks (paged KV cache)"),
+                ("kv_spill_blocks_total", self.kv_spill_blocks_total,
+                 "KV blocks serialized to the host tier at prefix "
+                 "eviction (hierarchical KV)"),
+                ("kv_restore_hits_total", self.kv_restore_hits_total,
+                 "spilled prefix chains restored from the host tier "
+                 "and reseated (hierarchical KV)"),
+                ("kv_restore_bytes_total", self.kv_restore_bytes_total,
+                 "serialized payload bytes restored host-to-device "
+                 "(hierarchical KV)"),
                 ("prefill_chunks_total", self.prefill_chunks_total,
                  "prompt-ingestion chunks fed through the unified "
                  "decode step (chunked prefill)"),
@@ -509,6 +554,7 @@ class ServingMetrics:
             slot_count = self.slot_count
             kv_total = self.kv_blocks_total
             kv_free = self.kv_blocks_free
+            host_bytes = self.host_tier_bytes
             kv_int8 = self.kv_dtype == "int8"
             chunk_size = self.prefill_chunk_size
             spec_k = self.speculate_k
@@ -543,6 +589,18 @@ class ServingMetrics:
         emit("kv_cache_int8", int(kv_int8),
              "1 when the KV cache stores int8 + per-head scale sidecars "
              "(quantized serving; docs/serving.md)")
+        emit("host_tier_bytes", host_bytes,
+             "serialized KV payload bytes resident in the host spill "
+             "tier (hierarchical KV; 0 = tier off)")
+        kvr = self.kv_restore.percentiles(_QUANTILES)
+        lines.append(f"# HELP {n}_kv_restore_seconds host-tier restore "
+                     "submit-to-commit wall time, recent-window quantiles")
+        lines.append(f"# TYPE {n}_kv_restore_seconds summary")
+        for q, v in kvr.items():
+            lines.append(
+                f'{n}_kv_restore_seconds{{quantile="0.{q}"}} {v:.6f}')
+        lines.append(f"{n}_kv_restore_seconds_count "
+                     f"{self.kv_restore.count}")
         lines.append(f"# HELP {n}_slot_evictions_total decode slots "
                      "evicted, by reason")
         lines.append(f"# TYPE {n}_slot_evictions_total counter")

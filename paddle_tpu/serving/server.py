@@ -52,11 +52,22 @@ CLI (``python -m paddle_tpu.serving``):
   --kv-layout slab|paged           decode KV-cache layout (paged = block
                                    pool + prefix sharing, kv_pool.py)
   --kv-block-size --kv-num-blocks --kv-prefix-cache
+  --kv-host-bytes N                host-RAM spill-tier cap: evicted
+                                   prefix chains spill to host and
+                                   restore asynchronously on the next
+                                   hit (0 = tier off; docs/serving.md
+                                   "Hierarchical KV")
   --smoke-paged                    paged-KV self-test: shared-system-
                                    prompt clients, prefix hits + CoW
                                    fork, streams bit-identical to the
                                    slab twin, ONE JSON line
                                    (healthy_window.sh phase 11)
+  --smoke-spill                    hierarchical-KV self-test: churn
+                                   evicts the shared chain, the
+                                   returning prefix restore-hits with
+                                   zero chunk lanes, bit-identical to
+                                   the tier-less twin, ONE JSON line
+                                   (healthy_window.sh phase 20)
   --prefill-chunk K                unified chunked prefill (the
                                    default): prompt ingestion rides the
                                    ONE decode step as K-token chunks;
@@ -630,7 +641,8 @@ def _demo_gen_batcher(args, tiny=False, metrics=None):
                           prefill_chunk=getattr(args, "prefill_chunk", 0),
                           prefill_chunk_budget=getattr(
                               args, "prefill_chunk_budget", 0),
-                          speculate_k=speculate_k, draft=draft)
+                          speculate_k=speculate_k, draft=draft,
+                          kv_host_bytes=getattr(args, "kv_host_bytes", 0))
     # supervision on by default for the generation plane: the breaker
     # and recovery are pure host bookkeeping (zero cost absent failures);
     # the step watchdog only arms when a deadline is configured
@@ -990,6 +1002,121 @@ def _smoke_paged(args):
     passed = (ok == len(prompts) and bit_identical and metrics_sane
               and snap["prefix_cache_hits_total"] >= 2
               and snap["cow_forks_total"] >= 1)
+    return 0 if passed else 2
+
+
+def _smoke_spill(args):
+    """Hierarchical-KV self-test (healthy_window.sh phase 20; docs/
+    serving.md "Hierarchical KV"): serve the demo LM with a tiny paged
+    pool plus a host-RAM spill tier on an ephemeral port.  A leader
+    establishes a long block-aligned system-prompt context, churn
+    traffic forces the pool to evict (and therefore spill) that chain,
+    and then the leader's prompt RETURNS: the engine must restore-hit
+    from the host tier and seat by reference — ZERO prefill chunk lanes
+    for the covered prefix — with the stream bit-identical both to the
+    first serving and to a tier-less twin's cold recompute.  /metrics
+    must show the spill/restore counters and the host-tier gauge.
+    Prints ONE JSON line; returns the process exit code."""
+    import copy
+    import urllib.request
+
+    bs = 8
+    spill_args = copy.copy(args)
+    spill_args.kv_layout = "paged"
+    spill_args.kv_block_size = bs
+    # two slots' worth of blocks + 1: the shared chain cannot stay
+    # resident once churn traffic claims seats
+    spill_args.kv_num_blocks = 2 * (48 // bs) + 1
+    spill_args.kv_prefix_cache = True
+    spill_args.prefill_chunk = bs
+    spill_args.kv_host_bytes = 64 << 20
+    gen = _demo_gen_batcher(spill_args, tiny=True)
+    twin_args = copy.copy(spill_args)
+    twin_args.kv_host_bytes = 0
+    twin = _demo_gen_batcher(twin_args, tiny=True)
+
+    httpd = make_server(None, port=0, gen_batcher=gen)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.port}"
+    rng = np.random.RandomState(0)
+    # block-aligned system prompt: the registered chain covers every
+    # prompt position, so the return visit needs no chunk lanes at all
+    sys_prompt = rng.randint(1, 256, 4 * bs).tolist()
+    churn = [rng.randint(1, 256, 28).tolist() for _ in range(4)]
+    n_tok = 6
+    errs = []
+
+    def post(prompt):
+        req = urllib.request.Request(
+            f"{base}/v1/generate",
+            data=json.dumps({"prompt": prompt,
+                             "max_tokens": n_tok}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                resp = json.loads(r.read())
+                if r.status != 200 or resp["finish_reason"] != "length":
+                    errs.append(f"{r.status} {resp}")
+                    return None
+                return resp["tokens"]
+        except Exception as e:    # noqa: BLE001 — a probe failure must
+            # become a False flag in the ONE JSON line, not a traceback
+            errs.append(f"{type(e).__name__}: {e}")
+            return None
+
+    first = post(sys_prompt)                    # miss: registers chains
+    for p in churn:                             # pool pressure -> spill
+        post(p)
+    snap_mid = gen.metrics.snapshot()
+    lanes_before = snap_mid["prefill_chunk_lanes_total"]
+    returned = post(sys_prompt)                 # must restore-hit
+    snap = gen.metrics.snapshot()
+    lanes_return = snap["prefill_chunk_lanes_total"] - lanes_before
+
+    bit_identical = False
+    try:
+        ref = twin.submit(np.asarray(sys_prompt, np.int64),
+                          max_tokens=n_tok).result(120)["tokens"]
+        bit_identical = (first is not None and first == returned
+                         and returned == ref)
+    except Exception as e:    # noqa: BLE001
+        errs.append(f"twin: {type(e).__name__}: {e}")
+
+    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+        metrics_text = r.read().decode()
+    name = gen.metrics.name
+    metrics_sane = (
+        f"{name}_kv_restore_hits_total "
+        f"{snap['kv_restore_hits_total']}" in metrics_text
+        and f"{name}_kv_spill_blocks_total "
+            f"{snap['kv_spill_blocks_total']}" in metrics_text
+        and f"{name}_host_tier_bytes" in metrics_text
+        and f"{name}_kv_restore_seconds_count" in metrics_text)
+    out = {
+        "metric": "hierarchical KV smoke (spill + async restore + HTTP)",
+        "value": snap["kv_restore_hits_total"], "unit": "restore_hits",
+        "vs_baseline": None,
+        "bit_identical": bool(bit_identical),
+        "kv_spill_blocks": snap["kv_spill_blocks_total"],
+        "kv_restore_hits": snap["kv_restore_hits_total"],
+        "kv_restore_bytes": snap["kv_restore_bytes_total"],
+        "kv_restore_ms": snap["kv_restore_ms"],
+        "host_tier_bytes": snap["host_tier_bytes"],
+        "chunk_lanes_return_visit": lanes_return,
+        "step_traces": gen.engine.step_trace_count,
+        "metrics_sane": bool(metrics_sane),
+    }
+    if errs:
+        out["errors"] = errs[:5]
+    httpd.shutdown()
+    gen.close()
+    twin.close()
+    print(json.dumps(out), flush=True)
+    passed = (bit_identical and metrics_sane
+              and snap["kv_spill_blocks_total"] > 0
+              and snap["kv_restore_hits_total"] >= 1
+              and lanes_return == 0
+              and gen.engine.step_trace_count == 1)
     return 0 if passed else 2
 
 
@@ -1521,6 +1648,14 @@ def main(argv=None):
     ap.add_argument("--kv-prefix-cache",
                     type=lambda v: v.lower() in ("1", "true", "yes"),
                     default=FLAGS.serving_kv_prefix_cache)
+    ap.add_argument("--kv-host-bytes", type=int,
+                    default=FLAGS.serving_kv_host_bytes,
+                    help="host-RAM spill-tier byte cap (hierarchical "
+                         "KV: evicted prefix chains spill to host and "
+                         "restore asynchronously on the next hit when "
+                         "the analytic model predicts restore beats "
+                         "recompute; 0 = tier off; paged + "
+                         "prefix-cache only)")
     # ---- quantized serving (quant/; docs/serving.md) ----
     ap.add_argument("--kv-dtype", default=FLAGS.serving_kv_dtype,
                     choices=("float32", "int8"),
@@ -1601,6 +1736,13 @@ def main(argv=None):
                          "clients over kv_layout=paged, prefix hits + "
                          "CoW fork recorded, streams bit-identical to "
                          "the slab layout; one JSON line, exit")
+    ap.add_argument("--smoke-spill", action="store_true",
+                    help="hierarchical-KV self-test: tiny paged pool + "
+                         "host spill tier, churn forces eviction, the "
+                         "returning shared prefix restore-hits with "
+                         "zero prefill chunk lanes, bit-identical to a "
+                         "tier-less twin, spill/restore evidence in "
+                         "/metrics; one JSON line, exit")
     ap.add_argument("--smoke-decode-fused", action="store_true",
                     help="fused decode-kernel self-test: the demo "
                          "generation drive with pallas_decode=always "
@@ -1679,6 +1821,8 @@ def main(argv=None):
         return _smoke_generate(_demo_gen_batcher(args, tiny=True))
     if args.smoke_paged:
         return _smoke_paged(args)
+    if args.smoke_spill:
+        return _smoke_spill(args)
     if args.smoke_decode_fused:
         return _smoke_decode_fused(args)
     if args.smoke_chunked:

@@ -105,6 +105,11 @@ class Flags:
     #                                     (0 = slab-equivalent bytes)
     serving_kv_prefix_cache: bool = True  # share resident prompt-prefix
     #                                       blocks across requests
+    serving_kv_host_bytes: int = 0      # host-RAM spill-tier cap, bytes
+    #                                     (hierarchical KV: evicted
+    #                                     prefix chains spill and
+    #                                     restore instead of
+    #                                     recomputing; 0 = tier off)
     # ---- quantized serving (paddle_tpu/quant/: int8 weights + int8 KV
     # cache with in-register dequant in the fused decode kernels;
     # docs/serving.md "Quantized serving")
@@ -405,6 +410,15 @@ FLAG_DOCS = {
     "serving_kv_prefix_cache": ("share resident prompt-prefix blocks "
                                 "across requests (copy-on-write on "
                                 "divergence)", "—"),
+    "serving_kv_host_bytes": ("host-RAM spill-tier byte cap for the "
+                              "hierarchical KV cache: prefix chains "
+                              "evicted under pool pressure serialize "
+                              "to host buffers and restore "
+                              "asynchronously on the next hit when "
+                              "perf/analytic predicts restore beats "
+                              "recompute (LRU within the cap; 0 = "
+                              "tier off; paged + prefix_cache only)",
+                              "—"),
     "serving_kv_dtype": ("decode KV-cache storage dtype: float32, or "
                          "int8 (quantized K/V + per-(position, head) "
                          "f32 scale sidecars, dequantized in-register "

@@ -163,6 +163,7 @@ def test_chunk_step_len1_matches_tq1_step(params):
 # --------------------------------------------------------- engine parity
 
 
+@pytest.mark.slow
 def test_chunked_staggered_admissions_bit_identical(params):
     """The acceptance drive: more requests than slots, mixed prompt
     lengths (including chunk-boundary sizes 1 / K-1 / K / K+1 / 2K and
@@ -247,6 +248,7 @@ def test_chunked_continuation_replay_bit_identical(params):
 # ------------------------------------------------------------ paged
 
 
+@pytest.mark.slow
 def test_chunked_paged_prefix_cow_pressure_bit_identical(params):
     """The paged composition: chunked admission grows chains block by
     block, prompts register in the prefix index at first emission,
@@ -353,6 +355,7 @@ def test_chunk_budget_bounds_per_step_lanes(params):
 # --------------------------------------------------- fused chunk kernels
 
 
+@pytest.mark.slow
 def test_chunked_with_fused_kernels_token_identical(params):
     """pallas_decode=always compiles the Tq=chunk kernels INTO the
     unified step (interpret mode on CPU): greedy streams must be
@@ -376,6 +379,7 @@ def test_chunked_with_fused_kernels_token_identical(params):
 # ------------------------------------------------- supervisor recovery
 
 
+@pytest.mark.slow
 def test_supervisor_recovery_rides_chunks_bit_identical(params):
     """PR-6 chaos on the chunked engine: an injected decode-step fault
     rebuilds the pool and re-seats every in-flight stream through

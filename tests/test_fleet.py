@@ -542,6 +542,7 @@ def fleet(tmp_path_factory):
     sup.stop()
 
 
+@pytest.mark.slow
 def test_fleet_kill9_midstream_under_concurrent_load(fleet, fleet_params):
     """THE acceptance drive: kill -9 one replica while 8 concurrent
     clients stream — every stream must finish bit-identical to
@@ -614,6 +615,7 @@ def test_fleet_kill9_midstream_under_concurrent_load(fleet, fleet_params):
     assert snap["failovers_total"] >= snap["midstream_failovers_total"]
 
 
+@pytest.mark.slow   # reads the supervision evidence kill9 leaves behind
 def test_fleet_victim_restarted_with_seeded_backoff(fleet):
     """Supervision evidence after the kill: exactly one crash-restart of
     r0, with the first backoff delay replaying the seeded schedule, and
@@ -630,6 +632,7 @@ def test_fleet_victim_restarted_with_seeded_backoff(fleet):
                  .get("ready", False), 30)
 
 
+@pytest.mark.slow
 def test_fleet_rolling_drain_zero_failed_requests(fleet, fleet_params):
     """Satellite: SIGTERM one replica at a time (rolling restart) while
     clients keep generating through the router — zero failed requests,

@@ -52,7 +52,8 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "decode_fused_smoke.json", "autoscale_smoke.json",
                  "chunked_smoke.json", "quant_smoke.json",
                  "analysis_gate.json", "spec_smoke.json",
-                 "sharded_smoke.json", "WINDOW_DONE"):
+                 "sharded_smoke.json", "spill_smoke.json",
+                 "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -205,6 +206,18 @@ def test_dryrun_executes_every_phase(tmp_path):
     assert shd["bit_identical"] is True, shd
     assert shd["no_retrace"] is True, shd
     assert shd["metrics_sane"] is True, shd
+    # the spill smoke really restored: churn evicted (and spilled) the
+    # shared chain, the returning prompt restore-hit from the host tier
+    # and seated by reference — ZERO prefill chunk lanes for the return
+    # visit — bit-identical to the tier-less twin's recompute, with the
+    # spill/restore counters on /metrics and one warm-up trace
+    spl = json.loads((art / "spill_smoke.json").read_text())
+    assert spl["kv_restore_hits"] >= 1, spl
+    assert spl["kv_spill_blocks"] > 0, spl
+    assert spl["chunk_lanes_return_visit"] == 0, spl
+    assert spl["bit_identical"] is True, spl
+    assert spl["step_traces"] == 1, spl
+    assert spl["metrics_sane"] is True, spl
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows

@@ -299,6 +299,7 @@ def test_prefix_cache_off_still_bit_identical(params):
 # ------------------------------------------------------- pool pressure
 
 
+@pytest.mark.slow
 def test_pool_pressure_preemption_recovers_bit_identical(params):
     """A pool deliberately too small for the offered load: admissions
     defer and mid-decode growth preempts victim slots (evictions

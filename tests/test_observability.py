@@ -27,6 +27,7 @@ def test_profiler_trace_writes_files(tmp_path):
     assert found, "no trace files written"
 
 
+@pytest.mark.slow
 def test_profiler_start_idempotent(tmp_path):
     from paddle_tpu.utils import profiler
     d = str(tmp_path / "xprof2")

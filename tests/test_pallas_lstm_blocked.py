@@ -70,6 +70,7 @@ def test_blocked_matches_scan_reverse(np_rng):
                                np.asarray(want.data), atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("use_final", [False, True], ids=["hs", "hs+final"])
 def test_blocked_matches_scan_grads(np_rng, use_final):
     seq, w_r, checks = _mk(np_rng, 7, ragged=True)

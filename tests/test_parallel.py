@@ -83,6 +83,7 @@ def test_data_parallel_matches_single_device(np_rng):
     np.testing.assert_allclose(w1, w8, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 @needs_8
 def test_graft_dryrun_multichip():
     import importlib.util

@@ -65,6 +65,7 @@ def test_single_microbatch_and_unstack(np_rng, mesh):
         np.testing.assert_array_equal(np.asarray(a["w"]), np.asarray(b["w"]))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("remat", [False, True], ids=["plain", "remat"])
 def test_grads_match_sequential(np_rng, mesh, remat):
     params = _mk_params(np_rng)
@@ -136,6 +137,7 @@ def test_stage_count_mismatch_raises(np_rng, mesh):
               microbatch(jnp.zeros((8, D)), 2), mesh=mesh)
 
 
+@pytest.mark.slow
 def test_pp_times_tp_times_dp(np_rng):
     """3D: megatron-sharded MLP blocks (tp over 'model') inside pipeline
     stages (pp over 'stage') on data-sharded microbatches (dp)."""

@@ -120,6 +120,7 @@ def test_export_leaf_format_interop():
 
 # -------------------------------------------- prefill/step composition
 
+@pytest.mark.slow
 def test_quantized_prefill_equals_sequential_steps():
     """The quantized batched prefill attends over the SAME quantize ->
     dequantize round trip the incremental step applies, so the cached
@@ -194,6 +195,7 @@ def _drive(engine, prompts, n_tok=10):
     pytest.param("slab", 0, marks=pytest.mark.slow),
     pytest.param("paged", 0, marks=pytest.mark.slow),
     ("paged", 4)])
+@pytest.mark.slow
 def test_int8_engine_matches_quantized_oracle(layout, chunk):
     """Inside the int8 mode greedy decode stays fully deterministic:
     every engine layout reproduces the quantized ``lm_generate`` oracle

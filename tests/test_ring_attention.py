@@ -63,6 +63,7 @@ def test_ulysses_matches_dense(np_rng):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 @needs_8
 def test_transformer_seq_parallel_training_matches_single(np_rng):
     """The full transformer train step with mesh seq=4: every attention
@@ -108,6 +109,7 @@ def test_transformer_seq_parallel_training_matches_single(np_rng):
                                    rtol=5e-3, atol=5e-5)
 
 
+@pytest.mark.slow
 @needs_8
 @pytest.mark.parametrize("ragged", [False, True], ids=["full", "ragged"])
 def test_zigzag_causal_matches_dense(np_rng, ragged):
@@ -142,6 +144,7 @@ def test_zigzag_causal_matches_dense(np_rng, ragged):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 @needs_8
 def test_zigzag_grads_match_dense(np_rng):
     from paddle_tpu.parallel.ring_attention import (
@@ -183,6 +186,7 @@ def test_zigzag_order_roundtrip():
         zigzag_order(10, 2)
 
 
+@pytest.mark.slow
 @needs_8
 def test_transformer_zigzag_matches_plain_ring(np_rng):
     """zigzag=True (balanced causal self-attention + permuted labels)
@@ -254,6 +258,7 @@ def test_ring_segment_matches_dense(np_rng, causal):
                                atol=2e-5)
 
 
+@pytest.mark.slow
 @needs_8
 def test_zigzag_segment_matches_dense(np_rng):
     """Balanced causal ring with PACKED rows: zigzag-permuted tokens AND
@@ -314,6 +319,7 @@ def test_transformer_encode_packed_seq_parallel(np_rng):
 # ------------------------------------------------- grouped KV (GQA ring)
 
 
+@pytest.mark.slow
 @needs_8
 def test_ring_grouped_kv_matches_dense(np_rng):
     """Grouped K/V stripes ([B, Hkv, T/n, D]) travel the ppermute ring

@@ -73,6 +73,7 @@ def test_rope_lm_generate_matches_oracle(np_rng):
     np.testing.assert_array_equal(got, ids)
 
 
+@pytest.mark.slow
 def test_rope_packed_matches_per_row(np_rng):
     """Packed rope rows use within-segment positions: the loss equals the
     one-sequence-per-row layout, exactly like the learned path."""
@@ -101,6 +102,7 @@ def test_rope_packed_matches_per_row(np_rng):
     np.testing.assert_allclose(float(packed), float(alone), rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_rope_runs_beyond_trained_max_len(np_rng):
     """THE rope payoff: a trunk initialized with max_len=8 runs T=24
     sequences (logits AND generation) — the learned path hard-fails at

@@ -114,6 +114,7 @@ def _drive(bat, cases, stagger_s=0.002):
 # ------------------------------------------------- bit-identity core
 
 
+@pytest.mark.slow
 def test_sharded_streams_bit_identical_paged(params, sharded_engine):
     """Staggered concurrent streams off the 2-way sharded speculating
     paged engine reproduce the single-chip oracle token for token —

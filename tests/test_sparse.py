@@ -117,6 +117,7 @@ def test_sparse_momentum_matches_dense_when_all_rows_touched(np_rng):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_sparse_step_scales_with_touched_rows_not_vocab(np_rng):
     """The capability test: at vocab 1M the sparse step beats the dense
     step by a wide margin because it never materializes a [V, D] gradient

@@ -140,6 +140,7 @@ def test_spec_streams_bit_identical_paged(params, spec_engine):
     eng._paged.check()
 
 
+@pytest.mark.slow
 def test_adversarial_draft_bit_identical_nets_one(params,
                                                   adversarial_params):
     """A draft that (almost) never agrees with the target costs

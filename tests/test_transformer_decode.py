@@ -1,6 +1,7 @@
 """KV-cached incremental decode == full-recompute decode (transformer)."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -19,6 +20,7 @@ def _setup(b=3, src_len=9, vocab=60, d=32, heads=4, layers=2, max_len=12):
     return params, src, heads, max_len
 
 
+@pytest.mark.slow
 def test_cached_step_matches_full_decode_column():
     """decode_step_cached at position t == column t of the full decode()
     over the same prefix, for every t."""
