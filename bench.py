@@ -1836,6 +1836,211 @@ def bench_serving_kv_spill(slots=4, n_returns=4, vocab=256, d_model=128,
         "host spill tier vs cold recompute)"), extras
 
 
+def bench_serving_disagg(slots=4, n_handoffs=4, vocab=256, d_model=128,
+                         dff=256, layers=3, heads=2, block_size=8,
+                         chunk=8, prefix_blocks=12, seed=0):
+    """Disaggregated prefill/decode serving (serving/transfer.py;
+    docs/serving.md "Disaggregated serving"): a prefill replica behind
+    a REAL socket (`make_server` + ``POST /v1/kv/export``) prefills a
+    long prompt to its first token, then a decode replica fetches the
+    resident chain over HTTP (``transfer.receive_chain``), parks it in
+    its host tier and seats the continuation by reference through the
+    EXISTING restore pipeline — zero prefill chunk lanes, zero new
+    traces.  The warm drive measures the handed-off continuation TTFT
+    against a twin replica that recomputes the same context through
+    plain continuation-replay, and verifies every stream bit-identical
+    between the two.
+
+    The analytic leg is the acceptance bar: extras["lower"] is the one
+    chunked paged step (the handoff adds NO jitted code — export
+    gathers with NumPy between steps, the delivered blob lands through
+    the already-warm block-write path) and extras["postcheck"] gates
+    the routing model in BOTH directions —
+    ``perf/analytic.predicted_handoff_ms`` must beat
+    ``predicted_recompute_ms`` for the long handed-off prefix and LOSE
+    for a single-chunk one, at the fleet chip spec and at this host's,
+    with the live engine's router (``_handoff_predicted_faster``)
+    agreeing on both verdicts."""
+    import threading
+    import jax
+    from paddle_tpu.models import transformer
+    from paddle_tpu.perf import analytic as perf_analytic
+    from paddle_tpu.serving import GenerationBatcher, ServingMetrics
+    from paddle_tpu.serving import transfer as kv_transfer
+    from paddle_tpu.serving.decode_engine import DecodeEngine
+    from paddle_tpu.serving.server import make_server
+
+    prefix_len = prefix_blocks * block_size         # 96: 12 full blocks
+    max_len = prefix_len + 32
+    num_blocks = slots * (max_len // block_size) + 1
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=1, d_model=d_model, dff=dff,
+                              enc_layers=layers, dec_layers=0,
+                              max_len=max_len, num_heads=heads)
+    warm = os.environ.get("BENCH_ANALYTIC_BUILD") != "1"
+
+    def make_engine(name):
+        return DecodeEngine(params, num_heads=heads, num_slots=slots,
+                            max_len=max_len, prefill_buckets=(8, 16),
+                            name=name, warm=warm, kv_layout="paged",
+                            kv_block_size=block_size,
+                            kv_num_blocks=num_blocks, prefill_chunk=chunk,
+                            kv_host_bytes=256 << 20)
+
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(1, vocab, prefix_len).astype(np.int32)
+               for _ in range(n_handoffs)]
+    n_tok = 12
+
+    def drive(tag):
+        # prefill replica behind a real ephemeral-port HTTP server;
+        # decode replica receives over the socket; twin recomputes
+        eng_p = make_engine(f"bench_disagg_prefill_{tag}")
+        eng_p.metrics = ServingMetrics()
+        bat_p = GenerationBatcher(eng_p, queue_size=4096)
+        srv = make_server(None, gen_batcher=bat_p)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        src = f"http://127.0.0.1:{srv.port}"
+        eng_d = make_engine(f"bench_disagg_decode_{tag}")
+        eng_d.metrics = ServingMetrics()
+        bat_d = GenerationBatcher(eng_d, queue_size=4096)
+        eng_t = make_engine(f"bench_disagg_twin_{tag}")
+        eng_t.metrics = ServingMetrics()
+        bat_t = GenerationBatcher(eng_t, queue_size=4096)
+        try:
+            ttft_hand, ttft_reco, tokens = [], [], 0
+            t_start = time.perf_counter()
+            for p in prompts:
+                # prefill leg: one greedy token = the handoff boundary
+                lead = bat_p.submit(p, max_tokens=1).result(300)
+                boundary = lead["tokens"]
+                ctx = [int(t) for t in p] + boundary
+                hand = kv_transfer.receive_chain(
+                    eng_d, src, ctx, metrics=eng_d.metrics)
+                if hand["outcome"] != "received" or hand["bytes"] <= 0:
+                    raise AssertionError(
+                        f"socket handoff did not land: {hand}")
+                out_h = bat_d.submit(p, max_tokens=n_tok - 1,
+                                     replay=boundary).result(300)
+                out_r = bat_t.submit(p, max_tokens=n_tok - 1,
+                                     replay=boundary).result(300)
+                if out_h["tokens"] != out_r["tokens"]:
+                    raise AssertionError(
+                        "handed-off and recomputed greedy streams "
+                        "diverged")
+                ttft_hand.append(out_h["ttft_ms"])
+                ttft_reco.append(out_r["ttft_ms"])
+                tokens += 1 + 2 * len(out_h["tokens"])
+            dt = time.perf_counter() - t_start
+            snap_p = eng_p.metrics.snapshot()
+            snap_d = eng_d.metrics.snapshot()
+            if snap_p["kv_handoffs_total"]["sent"] < n_handoffs:
+                raise AssertionError(
+                    "the prefill replica's sent counter is short: "
+                    f"{snap_p['kv_handoffs_total']}")
+            if snap_d["kv_handoffs_total"]["received"] < n_handoffs:
+                raise AssertionError(
+                    "the decode replica's received counter is short: "
+                    f"{snap_d['kv_handoffs_total']}")
+            if snap_d["kv_restore_hits_total"] < n_handoffs:
+                raise AssertionError(
+                    "handed-off chains did not seat through the "
+                    "restore pipeline: "
+                    f"{snap_d['kv_restore_hits_total']} hits")
+            ttft_hand.sort()
+            ttft_reco.sort()
+            return {
+                "ttft_handoff_p50_ms":
+                    round(ttft_hand[len(ttft_hand) // 2], 2),
+                "ttft_recompute_p50_ms":
+                    round(ttft_reco[len(ttft_reco) // 2], 2),
+                "handoffs_sent": snap_p["kv_handoffs_total"]["sent"],
+                "handoffs_received":
+                    snap_d["kv_handoffs_total"]["received"],
+                "handoff_bytes": snap_d["kv_handoff_bytes_total"],
+                "kv_handoff_ms": snap_d["kv_handoff_ms"],
+                "tokens_per_s": round(tokens / dt, 1)}
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            bat_p.close()
+            bat_d.close()
+            bat_t.close()
+
+    def lower():
+        return make_engine("bench_disagg_aot").lower()
+
+    def postcheck(_compiled):
+        """The handoff-vs-recompute router's model, gated in BOTH
+        directions: the long prefill-side prefix must be predicted
+        cheaper to HAND OFF (one socket stream + one host-link seat
+        beats a dozen chunk steps), a single-chunk prefix cheaper to
+        RECOMPUTE (one cheap chunk step beats the transfer's fixed
+        scheduling cycles) — at the fleet chip spec AND this host's —
+        and the live engine's router must return the same verdicts."""
+        leaves = jax.tree_util.tree_leaves(params)
+        pc = sum(l.size for l in leaves)
+        pb = sum(l.size * l.dtype.itemsize for l in leaves)
+        dkv = d_model // heads
+        long_cov, short_cov = prefix_len, chunk
+        row = {}
+        for chip in ("v5e", "cpu"):
+            h_long = perf_analytic.predicted_handoff_ms(
+                long_cov, layers, dkv, heads, "float32", chip)
+            c_long = perf_analytic.predicted_recompute_ms(
+                long_cov, pc, pb, chunk, chip)
+            if not h_long < c_long:
+                raise AssertionError(
+                    f"[{chip}] handoff NOT predicted faster for the "
+                    f"{long_cov}-position prefix: {h_long:.4f}ms vs "
+                    f"recompute {c_long:.4f}ms")
+            h_short = perf_analytic.predicted_handoff_ms(
+                short_cov, layers, dkv, heads, "float32", chip)
+            c_short = perf_analytic.predicted_recompute_ms(
+                short_cov, pc, pb, chunk, chip)
+            if not c_short < h_short:
+                raise AssertionError(
+                    f"[{chip}] recompute NOT predicted faster for the "
+                    f"{short_cov}-position prefix: {c_short:.4f}ms vs "
+                    f"handoff {h_short:.4f}ms")
+            row[f"predicted_handoff_long_ms_{chip}"] = round(h_long, 4)
+            row[f"predicted_recompute_long_ms_{chip}"] = round(c_long, 4)
+        engine = make_engine("bench_disagg_route")
+        v_long = engine._handoff_predicted_faster(long_cov)[0]
+        v_short = engine._handoff_predicted_faster(short_cov)[0]
+        if not (v_long and not v_short):
+            raise AssertionError(
+                "the engine's handoff router disagrees with the "
+                f"analytic model: long->{v_long} short->{v_short} "
+                "(want True/False)")
+        return dict(row, handoff_direction_proof="pass",
+                    handoff_route_agreement="pass")
+
+    extras = {"lower": lower, "postcheck": postcheck}
+    if warm:
+        d = drive("warm")
+        extras.update(
+            disagg=d,
+            ttft_handoff_speedup=round(
+                d["ttft_recompute_p50_ms"]
+                / max(d["ttft_handoff_p50_ms"], 1e-9), 2))
+
+    def run(_s):
+        return np.float32(drive("timed")["tokens_per_s"])
+
+    total_tokens = n_handoffs * (1 + 2 * (n_tok - 1))
+    prompt_tokens = n_handoffs * 3 * prefix_len
+    per_tok = layers * (6 * d_model ** 2 + 2 * d_model * dff) \
+        + d_model * vocab
+    attn = layers * 4.0 * d_model * max_len / 2
+    flops = (2.0 * per_tok + attn) * (total_tokens + prompt_tokens)
+    return run, flops, None, (
+        f"disaggregated prefill->decode serving ({n_handoffs} real "
+        f"socket KV handoffs, {prefix_len}-token prefix, block "
+        f"{block_size}, chunk {chunk}; handed-off seat vs "
+        "continuation-replay recompute)"), extras
+
+
 def bench_serving_quant(slots=8, n_requests=48, vocab=256, d_model=128,
                         dff=256, layers=3, heads=2, block_size=8, seed=0):
     """Quantized serving (paddle_tpu/quant/; docs/serving.md "Quantized
@@ -3136,6 +3341,10 @@ _BENCHES = {
     # streams, and the both-directions restore-vs-recompute routing
     # gate; b = slots
     "serving_kv_spill": (lambda b: bench_serving_kv_spill(slots=b), 4),
+    # disaggregated prefill/decode: real-socket KV handoff TTFT vs
+    # continuation-replay recompute, bit-identical streams, and the
+    # both-directions handoff-vs-recompute routing gate; b = slots
+    "serving_disagg": (lambda b: bench_serving_disagg(slots=b), 4),
     "seq2seq": (lambda b: bench_seq2seq(batch=b), 64),
     # input-pipeline overlap row: steps/s at train(prefetch=0) vs 2 on a
     # synthetic input-bound workload (the ShardedPrefetcher's win)

@@ -54,6 +54,7 @@ FAMILIES = [
     ("serving_speculative", "serving_speculative", None),
     ("serving_sharded", "serving_sharded", None),
     ("serving_kv_spill", "serving_kv_spill", None),
+    ("serving_disagg", "serving_disagg", None),
     ("trainer_prefetch", "trainer_prefetch", None),
 ]
 
@@ -238,6 +239,19 @@ FAMILY_ROOTS = {
                          "decode_attention_slab_chunk",
                          "decode_attention_paged_chunk",
                          "flash_attention"),
+    # serving_disagg (cross-replica KV handoff, serving/transfer.py)
+    # adds NO jitted code either: the export gathers with NumPy on the
+    # source's worker thread, the blob crosses a plain socket, and the
+    # receive lands through the SAME claim/stage/commit restore pipeline
+    # serving_kv_spill exercises — so the receive/commit path traces
+    # exactly the chunked-prefill root set, and the analyzer covers the
+    # handoff by covering these.
+    "serving_disagg": ("decode_engine_step",
+                       "lm_decode_chunk_slots",
+                       "lm_decode_chunk_paged", "lm_prefill",
+                       "decode_attention_slab_chunk",
+                       "decode_attention_paged_chunk",
+                       "flash_attention"),
     "trainer_prefetch": ("trainer_step",),
 }
 

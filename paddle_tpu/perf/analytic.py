@@ -455,6 +455,39 @@ def predicted_restore_ms(covered, layers, dkv, kv_heads,
         + payload / spec.host_link_bytes_per_s * 1e3
 
 
+# Effective socket bandwidth for a cross-replica KV handoff blob
+# (serving/transfer.py).  Datacenter 25GbE at ~realistic goodput is the
+# conservative fleet floor (loopback in the smoke is far faster), so
+# the handoff-vs-recompute router errs toward recompute — same bias the
+# host-link constant gives the local restore pair.
+HANDOFF_LINK_BYTES_PER_S = 3e9
+# Scheduling cycles a handoff spends beyond the restore's three: the
+# source-side export waiting for its between-steps seam, and the HTTP
+# round trip's request leg.
+HANDOFF_CYCLES = RESTORE_CYCLES + 2
+
+
+def predicted_handoff_ms(covered, layers, dkv, kv_heads,
+                         kv_dtype="float32", chip="v5e"):
+    """First-principles wall cost of HANDING OFF a ``covered``-position
+    prefix chain from a peer replica (docs/serving.md "Disaggregated
+    serving"): the same serialized payload as a local restore, streamed
+    once over the handoff socket (``HANDOFF_LINK_BYTES_PER_S``) AND
+    once over the receiver's host link, plus ``HANDOFF_CYCLES``
+    scheduling cycles at the dispatch floor.  The receive path compares
+    this against ``predicted_recompute_ms`` at the SAME chip spec
+    before fetching anything — the serving_disagg postcheck gates the
+    comparison in both directions, exactly as serving_kv_spill gates
+    the local restore pair."""
+    from paddle_tpu.quant import kv as kvq
+    spec = roofline.SPECS[chip] if isinstance(chip, str) else chip
+    payload = float(covered) * int(layers) \
+        * kvq.kv_bytes_per_position(dkv, kv_heads, kv_dtype)
+    return HANDOFF_CYCLES * STEP_DISPATCH_MS \
+        + payload / HANDOFF_LINK_BYTES_PER_S * 1e3 \
+        + payload / spec.host_link_bytes_per_s * 1e3
+
+
 def predicted_recompute_ms(covered, param_count, param_bytes,
                            prefill_chunk, chip="v5e"):
     """First-principles wall cost of RECOMPUTING a ``covered``-position
@@ -581,7 +614,7 @@ def capture(name, model, batch=None, chips=("v5e", "v5p")):
                  "serving_decode_fused", "serving_autoscale",
                  "serving_chunked_prefill", "serving_quant",
                  "serving_speculative", "serving_sharded",
-                 "serving_kv_spill"):
+                 "serving_kv_spill", "serving_disagg"):
         # the lowered program is one batch/slab step while the bench FLOPs
         # model covers the whole stream/burst — scopes differ, no cross-check
         row["bench_model_flops"] = None

@@ -91,6 +91,17 @@
 #                                        tier-less twin, spill/restore
 #                                        evidence on /metrics — one
 #                                        JSON line)
+# 21. disaggregated serving smoke        (prefill+decode replica pools
+#                                        behind the router: streams
+#                                        prefill on one pool, hand the
+#                                        KV chain off over a real
+#                                        socket at first token, decode
+#                                        on the other — bit-identical
+#                                        to the oracle, kill -9 of the
+#                                        prefill replica mid-handoff
+#                                        falls back to recompute,
+#                                        kv_handoff counters on every
+#                                        /metrics — one JSON line)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -398,6 +409,23 @@ log "phase 20: hierarchical KV smoke (host spill tier + async restore)"
 timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-spill \
     > "$ART/spill_smoke.json" 2> "$ART/spill_smoke.log"
 log "spill smoke rc=$? -> $ART/spill_smoke.json"
+
+log "phase 21: disaggregated serving smoke (prefill/decode KV handoff)"
+# a 2-replica fleet split into a prefill pool and a decode pool behind
+# the router: new prompts prefill on one replica, the KV chain crosses
+# to the other as a trunk-signed wire blob over POST /v1/kv/export at
+# first token, and the decode replica seats it through the existing
+# restore pipeline (zero chunk lanes, zero new traces) — streams
+# bit-identical to the single-replica oracle, a sub-crossover prompt
+# proves the analytic recompute direction, kill -9 of the prefill
+# replica mid-handoff falls back to continuation-replay recompute
+# bit-identically, kv_handoff counters on both replicas' AND the
+# router's /metrics — one JSON line
+# (python -m paddle_tpu.serving.router --smoke-disagg; docs/serving.md
+# "Disaggregated serving")
+timeout "$T_SERVE" python -m paddle_tpu.serving.router --smoke-disagg \
+    > "$ART/disagg_smoke.json" 2> "$ART/disagg_smoke.log"
+log "disagg smoke rc=$? -> $ART/disagg_smoke.json"
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

@@ -90,6 +90,8 @@ from paddle_tpu.serving.kv_pool import (HostTier,
                                         InsufficientBlocksError,
                                         PagedKVState,
                                         RestorePendingError,
+                                        WireFormatError,
+                                        peek_chain_header,
                                         restore_chain, serialize_chain,
                                         slab_equivalent_blocks)
 from paddle_tpu.serving.metrics import ServingMetrics
@@ -998,6 +1000,90 @@ class DecodeEngine:
         recompute = analytic.predicted_recompute_ms(
             covered, self._param_count, self._param_bytes, k, chip)
         return restore < recompute, restore, recompute
+
+    def _handoff_predicted_faster(self, covered):
+        """The handoff-vs-recompute router (perf/analytic.py): predicted
+        wall cost of pulling ``covered`` positions' K/V from a peer
+        replica over the network AND restoring them over the host link,
+        vs re-running them through chunked prefill here.  Returns
+        ``(verdict, handoff_ms, recompute_ms)`` — the ``serving_disagg``
+        bench gates both directions of this comparison, exactly like
+        ``serving_kv_spill`` gates the local pair."""
+        from paddle_tpu.perf import analytic
+        chip = "cpu" if jax.default_backend() == "cpu" else "v5e"
+        layers, dkv = self._kv_dims
+        handoff = analytic.predicted_handoff_ms(
+            covered, layers, dkv, self.num_heads, self.kv_dtype, chip)
+        k = self.prefill_chunk if self.prefill_chunk else int(covered) + 1
+        recompute = analytic.predicted_recompute_ms(
+            covered, self._param_count, self._param_bytes, k, chip)
+        return handoff < recompute, handoff, recompute
+
+    def export_chain(self, tokens):
+        """Serialize the longest resident coverage of ``tokens`` as a
+        relocatable wire-format blob for a cross-replica handoff
+        (serving/transfer.py).  Worker-thread-only — the gather reads
+        the committed cache exactly like ``_spill_chain`` does, so HTTP
+        handlers must route through ``GenerationBatcher.export_chain``
+        (which queues it to run strictly between steps).  Prefers the
+        resident prefix index (read-only lookup, no references taken);
+        falls back to an already-serialized host-tier blob.  Returns
+        ``(key, covered, blob)`` or ``(None, 0, None)``."""
+        if self.kv_layout != "paged":
+            return None, 0, None
+        full = np.asarray(tokens, np.int32)
+        covered, chain = self._paged.lookup_prefix(full)
+        if covered:
+            key = tuple(int(t) for t in full[:covered])
+            idx = np.asarray(chain, np.int32)
+            arrays = [(name, np.asarray(leaf[idx]))
+                      for name, leaf in zip(
+                          self._cache_leaf_names,
+                          jax.tree_util.tree_leaves(self._cache))]
+            blob = serialize_chain(key, covered, arrays,
+                                   self._trunk_sig)
+            obstrace.instant("kv.handoff_export", blocks=len(chain),
+                             bytes=len(blob), covered=int(covered))
+            return key, covered, blob
+        if self._host_tier is not None:
+            key, covered, blob = self._host_tier.lookup(full,
+                                                        self.block_size)
+            if key is not None:
+                obstrace.instant("kv.handoff_export", blocks=0,
+                                 bytes=len(blob), covered=int(covered),
+                                 from_tier=True)
+                return key, covered, blob
+        return None, 0, None
+
+    def deliver_chain_blob(self, blob, max_bytes=None):
+        """Cross-replica handoff delivery (any thread): validate the
+        blob's envelope against THIS engine's trunk signature and park
+        it in the host tier.  The next request whose context the blob
+        covers seats it through the EXISTING restore pipeline
+        (``_maybe_begin_restore`` claim → async stage → between-steps
+        commit) — no new jitted code, no new write shape.  Returns
+        ``(key, covered)``; raises ``WireFormatError`` (foreign,
+        garbled, or pool-poisoning header) or ``ConfigError`` (no host
+        tier attached — decode-role replicas need
+        ``kv_host_bytes > 0``)."""
+        if self._host_tier is None:
+            raise ConfigError(
+                "handoff delivery needs the host tier: run the decode "
+                "replica with kv_host_bytes > 0")
+        header = peek_chain_header(blob, self._trunk_sig, max_bytes)
+        key = tuple(int(t) for t in header.get("tokens", ()))
+        covered = int(header.get("covered", 0))
+        # a header that lies about its coverage could wedge receivers in
+        # eternal claim-defer (covered > pool) or seat garbage past the
+        # key — reject it before it touches the tier
+        if not key or covered != len(key) or covered > self.max_len:
+            raise WireFormatError(
+                f"handoff blob declares covered={covered} over a "
+                f"{len(key)}-token key (max_len {self.max_len}); "
+                "refusing to pool it")
+        self._host_tier.put(key, covered, blob)
+        self.metrics.set_host_tier_bytes(self._host_tier.bytes)
+        return key, covered
 
     def _maybe_begin_restore(self, full):
         """Probe the host tier for a spilled coverage of ``full`` after
@@ -1911,6 +1997,11 @@ class GenerationBatcher:
                              "'continuous', 'gang')")
         self._gang = admission == "gang"
         self._q = queue.Queue(maxsize=int(queue_size))
+        # cross-replica KV exports (serving/transfer.py): HTTP handlers
+        # queue (tokens, result_box, done_event) here and the worker
+        # serves them strictly between steps — the gather must read the
+        # committed cache, which belongs to the worker thread
+        self._export_q = queue.Queue()
         self._depth_fn = self._q.qsize
         self.metrics.queue_depth_fns.append(self._depth_fn)
         self._closed = threading.Event()
@@ -2034,6 +2125,39 @@ class GenerationBatcher:
     def generate(self, prompt, timeout=None, **kw):
         """submit() + block for the result (the HTTP handler's path)."""
         return self.submit(prompt, **kw).result(timeout)
+
+    def export_chain(self, tokens, timeout=5.0):
+        """Serialize the longest resident KV coverage of ``tokens`` for
+        a cross-replica handoff (the ``/v1/kv/export`` route's path).
+        The gather reads the committed cache — worker-thread state — so
+        the request queues and the worker serves it strictly between
+        steps (the loop's idle poll is 50ms, bounding the wait).
+        Returns ``(key, covered, blob)``, or ``(None, 0, None)`` on no
+        coverage, a closed batcher, or timeout."""
+        box = [None, 0, None]
+        done = threading.Event()
+        self._export_q.put((tokens, box, done))
+        if not done.wait(timeout):
+            return None, 0, None
+        return box[0], box[1], box[2]
+
+    def _serve_exports(self):
+        """Worker thread, strictly between steps: drain queued
+        cross-replica export requests.  An export failure resolves THAT
+        request empty (the peer falls back to recompute) and never
+        touches the serving loop."""
+        while True:
+            try:
+                tokens, box, done = self._export_q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                box[0], box[1], box[2] = self.engine.export_chain(tokens)
+            except Exception as e:      # noqa: BLE001 — isolate to this
+                # export; the requester serves a miss (recompute)
+                logger.warning("%s: kv export failed: %s: %s",
+                               self.name, type(e).__name__, e)
+            done.set()
 
     def abandon(self, future):
         """The caller behind ``future`` is gone (e.g. the streaming HTTP
@@ -2562,6 +2686,9 @@ class GenerationBatcher:
             # chain publishes into the prefix index, so a deferred
             # request's next retry seats as an ordinary resident hit
             self.engine.poll_restores()
+            # cross-replica exports land here too: same between-steps
+            # seam, same committed-cache safety as the restore commits
+            self._serve_exports()
             self._admit_from_queue(block=not self._by_slot)
             if not self._by_slot:
                 if self._closed.is_set() and self._q.empty() \

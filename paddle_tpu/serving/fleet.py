@@ -56,11 +56,13 @@ class _Replica:
     """One managed replica subprocess (all mutation under the
     supervisor's lock)."""
 
-    def __init__(self, rid, cmd, port_file, log_path):
+    def __init__(self, rid, cmd, port_file, log_path, role=None):
         self.rid = rid
         self.cmd = list(cmd)
         self.port_file = port_file
         self.log_path = log_path
+        self.role = role                  # disaggregated serving role
+        #                                   (prefill|decode|mixed|None)
         self.proc = None
         self.port = None                  # read lazily from port_file
         self.state = "stopped"
@@ -91,13 +93,17 @@ class ReplicaSupervisor:
     healthy_uptime_s: a replica alive this long resets its consecutive-
     failure count (the backoff exponent); seed: the jitter streams.
     base_dir: where port files + replica logs live (default: a fresh
-    temp dir).
+    temp dir).  roles: optional per-replica disaggregated-serving roles
+    (a sequence matched to r0..rN-1, entries from prefill|decode|mixed
+    or None) — each named replica is spawned with ``--role <role>`` and
+    KEEPS that role across crash restarts (docs/serving.md
+    "Disaggregated serving").
     """
 
     def __init__(self, n_replicas=2, cmd=None, extra_args=(),
                  backoff_base_s=0.5, backoff_max_s=10.0, storm_threshold=5,
                  storm_window_s=30.0, healthy_uptime_s=5.0, seed=0,
-                 env=None, base_dir=None, name="fleet"):
+                 env=None, base_dir=None, name="fleet", roles=None):
         if int(n_replicas) < 1:
             raise ValueError("n_replicas must be >= 1")
         self.name = name
@@ -119,11 +125,14 @@ class ReplicaSupervisor:
         self._stopping = False
         self.replicas = {}
         self._rngs = {}
+        roles = list(roles or ())
         for i in range(int(n_replicas)):
             rid = f"r{i}"
             pf = os.path.join(self.base_dir, f"{rid}.port")
+            role = roles[i] if i < len(roles) else None
             self.replicas[rid] = _Replica(
-                rid, base, pf, os.path.join(self.base_dir, f"{rid}.log"))
+                rid, base + (["--role", role] if role else []), pf,
+                os.path.join(self.base_dir, f"{rid}.log"), role=role)
             # one seeded jitter stream per replica: deterministic replays
             # under test, de-synchronized restarts in production
             self._rngs[rid] = random.Random(self.seed * 7919 + i)
@@ -256,22 +265,27 @@ class ReplicaSupervisor:
 
     # ------------------------------------------------------------ scaling
 
-    def add_replica(self):
+    def add_replica(self, role=None):
         """Scale-out primitive (serving/autoscaler.py): spawn ONE new
         replica under supervision and return its rid.  The rid is fresh
         (never reuses a removed replica's identity, so the router builds
-        a clean view with a fresh breaker).  Raises when the spawn
-        itself fails (fleet.spawn fault, fork/exec failure) — the caller
-        owns the retry policy; nothing is registered on failure, so a
-        failed scale-out leaves the fleet exactly as it was."""
+        a clean view with a fresh breaker).  ``role`` optionally pins a
+        disaggregated-serving role (``--role prefill|decode|mixed``) on
+        the new replica.  Raises when the spawn itself fails
+        (fleet.spawn fault, fork/exec failure) — the caller owns the
+        retry policy; nothing is registered on failure, so a failed
+        scale-out leaves the fleet exactly as it was."""
         with self._lock:
             if self._stopping:
                 raise RuntimeError(f"{self.name} is stopping")
             i = self._next_idx
             rid = f"r{i}"
             pf = os.path.join(self.base_dir, f"{rid}.port")
-            rep = _Replica(rid, self._base_cmd, pf,
-                           os.path.join(self.base_dir, f"{rid}.log"))
+            rep = _Replica(rid,
+                           self._base_cmd
+                           + (["--role", role] if role else []), pf,
+                           os.path.join(self.base_dir, f"{rid}.log"),
+                           role=role)
             self._spawn(rep)        # raises on failure: register nothing
             self._next_idx = i + 1
             self.replicas[rid] = rep
@@ -452,6 +466,7 @@ class ReplicaSupervisor:
             return {
                 rep.rid: {
                     "state": rep.state,
+                    "role": rep.role,
                     "port": rep.port,
                     "pid": (rep.proc.pid if rep.proc is not None
                             and rep.proc.poll() is None else None),

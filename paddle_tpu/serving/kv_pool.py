@@ -71,6 +71,27 @@ SCRATCH_BLOCK = 0
 # cross-replica peer (item 2(b)) can never mis-parse a newer blob.
 WIRE_VERSION = 1
 
+# Default decoded-blob ceiling at the NETWORK boundary (serving/
+# transfer.py) — a garbled or malicious peer's length prefix / shape
+# manifest must never drive an allocation.  The host tier itself is
+# byte-capped separately; this bounds a SINGLE blob.
+MAX_CHAIN_BLOB_BYTES = 1 << 30
+
+
+class WireFormatError(ValueError):
+    """A chain blob violates the ``serialize_chain`` wire format
+    (truncated, oversized, inconsistent manifest, foreign trunk).
+    Subclasses ``ValueError`` so every existing rejection path — and
+    test — keeps working; the network receiver catches THIS to count a
+    rejected peer blob without masking programming errors."""
+
+
+class WireVersionError(WireFormatError):
+    """The blob's version byte (or header version field) is not the
+    ``WIRE_VERSION`` this build speaks — an EXPLICIT mismatch, never a
+    silent misparse: a newer peer's layout change lands here instead of
+    inside the manifest parser."""
+
 
 def slab_equivalent_blocks(num_slots, max_len, block_size,
                            kv_dtype="float32", mesh_shards=1):
@@ -147,45 +168,83 @@ def serialize_chain(tokens, covered, arrays, trunk_sig):
     return b"".join(parts)
 
 
-def restore_chain(blob, trunk_sig):
-    """Inverse of ``serialize_chain``: returns ``(tokens_tuple,
-    covered, [(name, ndarray), ...])``.  Raises ``ValueError`` on a
-    version-byte mismatch, a trunk-signature mismatch, or a truncated /
-    oversized payload — a corrupt or foreign blob must never seat."""
+def peek_chain_header(blob, trunk_sig=None, max_bytes=None):
+    """Parse and validate ONLY the blob's envelope — version byte,
+    header length, JSON header, optional trunk-signature and size
+    bound — without touching (or allocating for) the array payload.
+    The network receiver (serving/transfer.py) calls this on received
+    bytes BEFORE anything is staged, so a garbled peer is rejected at
+    the manifest, never mid-``frombuffer``.  Returns the header dict.
+
+    Raises ``WireVersionError`` on a version mismatch and
+    ``WireFormatError`` on everything else (both ``ValueError``)."""
+    if max_bytes is not None and len(blob) > int(max_bytes):
+        raise WireFormatError(
+            f"chain blob of {len(blob)} byte(s) exceeds the "
+            f"{int(max_bytes)}-byte receive bound")
     if len(blob) < 9:
-        raise ValueError(f"chain blob truncated: {len(blob)} byte(s)")
+        raise WireFormatError(
+            f"chain blob truncated: {len(blob)} byte(s)")
     if blob[0] != WIRE_VERSION:
-        raise ValueError(f"chain blob version {blob[0]} != "
-                         f"{WIRE_VERSION} (wire format mismatch)")
+        raise WireVersionError(f"chain blob version {blob[0]} != "
+                               f"{WIRE_VERSION} (wire format mismatch)")
     hlen = int.from_bytes(blob[1:9], "little")
     if 9 + hlen > len(blob):
-        raise ValueError("chain blob header overruns the payload")
-    header = json.loads(blob[9:9 + hlen].decode("utf-8"))
+        raise WireFormatError("chain blob header overruns the payload")
+    try:
+        header = json.loads(blob[9:9 + hlen].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireFormatError(f"chain blob header is not valid JSON: "
+                              f"{e}") from None
+    if not isinstance(header, dict):
+        raise WireFormatError("chain blob header is not a JSON object")
     if header.get("version") != WIRE_VERSION:
-        raise ValueError(f"chain header version {header.get('version')} "
-                         f"!= {WIRE_VERSION}")
-    if header["trunk_sig"] != str(trunk_sig):
-        raise ValueError(
-            f"chain trunk signature {header['trunk_sig']!r} does not "
+        raise WireVersionError(
+            f"chain header version {header.get('version')} "
+            f"!= {WIRE_VERSION}")
+    if trunk_sig is not None and header.get("trunk_sig") != str(trunk_sig):
+        raise WireFormatError(
+            f"chain trunk signature {header.get('trunk_sig')!r} does not "
             f"match this engine's {str(trunk_sig)!r}: K/V bytes are only "
             "relocatable between identical trunks")
+    return header
+
+
+def restore_chain(blob, trunk_sig, max_bytes=None):
+    """Inverse of ``serialize_chain``: returns ``(tokens_tuple,
+    covered, [(name, ndarray), ...])``.  Raises ``WireVersionError`` on
+    a version mismatch and ``WireFormatError`` (both ``ValueError``) on
+    a trunk-signature mismatch or a truncated / oversized payload — a
+    corrupt or foreign blob must never seat.  ``max_bytes`` bounds the
+    whole blob BEFORE any manifest-driven decoding (the network-boundary
+    defense; None = trusted local blob)."""
+    header = peek_chain_header(blob, trunk_sig, max_bytes)
+    hlen = int.from_bytes(blob[1:9], "little")
     off = 9 + hlen
     arrays = []
     for spec in header["arrays"]:
-        dt = np.dtype(spec["dtype"])
-        shape = tuple(spec["shape"])
-        nbytes = dt.itemsize * int(np.prod(shape, dtype=np.int64))
+        try:
+            dt = np.dtype(spec["dtype"])
+            shape = tuple(int(s) for s in spec["shape"])
+        except (TypeError, ValueError, KeyError) as e:
+            raise WireFormatError(
+                f"chain blob manifest is malformed: {e}") from None
+        if any(s < 0 for s in shape):
+            raise WireFormatError(
+                f"chain blob array {spec.get('name')!r} declares a "
+                "negative dimension")
+        count = int(np.prod(shape, dtype=np.int64))
+        nbytes = dt.itemsize * count
         if off + nbytes > len(blob):
-            raise ValueError(f"chain blob truncated inside array "
-                             f"{spec['name']!r}")
+            raise WireFormatError(f"chain blob truncated inside array "
+                                  f"{spec['name']!r}")
         arrays.append((spec["name"],
-                       np.frombuffer(blob, dt, count=int(np.prod(
-                           shape, dtype=np.int64)),
-                           offset=off).reshape(shape)))
+                       np.frombuffer(blob, dt, count=count,
+                                     offset=off).reshape(shape)))
         off += nbytes
     if off != len(blob):
-        raise ValueError(f"chain blob holds {len(blob) - off} trailing "
-                         "byte(s) past the manifest")
+        raise WireFormatError(f"chain blob holds {len(blob) - off} "
+                              "trailing byte(s) past the manifest")
     return tuple(header["tokens"]), int(header["covered"]), arrays
 
 

@@ -36,6 +36,13 @@ REJECT_REASONS = ("overload", "deadline", "invalid", "shutdown", "breaker")
 EVICT_REASONS = ("eos", "length", "error", "shutdown", "abandoned",
                  "recovered", "pool_exhausted")
 
+# cross-replica KV handoff outcomes (serving/transfer.py): sent = this
+# replica exported a chain blob to a peer, received = a peer's blob was
+# fetched + delivered into the host tier, fallback = the handoff was
+# skipped or failed and the stream recomputed its context instead
+# (bit-identical either way).  Keys are part of the /metrics surface.
+HANDOFF_OUTCOMES = ("sent", "received", "fallback")
+
 # circuit-breaker state gauge encoding (breaker_state metric)
 BREAKER_STATES = {"closed": 0, "half_open": 1, "open": 2}
 
@@ -123,6 +130,15 @@ class ServingMetrics:
         self.host_tier_bytes = 0         # gauge: resident spilled bytes
         # submit -> commit wall time of one async restore (seconds)
         self.kv_restore = Histogram(f"{name}_kv_restore",
+                                    max_samples=max_samples,
+                                    keep="last", clock=self.clock)
+        # ---- disaggregated serving (serving/transfer.py): KV chains
+        # crossing replicas as wire-format blobs at stream handoff
+        self.serving_role = "mixed"      # gauge: this replica's fleet role
+        self.kv_handoffs = {o: 0 for o in HANDOFF_OUTCOMES}
+        self.kv_handoff_bytes_total = 0  # blob bytes sent + received
+        # decide -> deliver wall time of one receive-side handoff (s)
+        self.kv_handoff = Histogram(f"{name}_kv_handoff",
                                     max_samples=max_samples,
                                     keep="last", clock=self.clock)
         # v2 Inference per-row-signature engine cache (satellite): LRU
@@ -274,6 +290,23 @@ class ServingMetrics:
         with self._lock:
             self.host_tier_bytes = int(nbytes)
 
+    def set_serving_role(self, role):
+        """Gauge: this replica's fleet role ("prefill" | "decode" |
+        "mixed") — the router reads it off /metrics to build pools."""
+        with self._lock:
+            self.serving_role = str(role)
+
+    def observe_kv_handoff(self, outcome, nbytes=0, seconds=None):
+        """One cross-replica KV handoff event (serving/transfer.py):
+        ``outcome`` in ``HANDOFF_OUTCOMES``; ``nbytes`` the blob bytes
+        crossing the socket; ``seconds`` the receive side's
+        decide-to-deliver wall time."""
+        with self._lock:
+            self.kv_handoffs[outcome] += 1
+            self.kv_handoff_bytes_total += int(nbytes)
+        if seconds is not None:
+            self.kv_handoff.add(seconds)
+
     # ---- resilience events (resilience/supervisor.py callers) ----
 
     def observe_retry(self, n=1):
@@ -413,6 +446,9 @@ class ServingMetrics:
                 "kv_restore_hits_total": self.kv_restore_hits_total,
                 "kv_restore_bytes_total": self.kv_restore_bytes_total,
                 "host_tier_bytes": self.host_tier_bytes,
+                "serving_role": self.serving_role,
+                "kv_handoffs_total": dict(self.kv_handoffs),
+                "kv_handoff_bytes_total": self.kv_handoff_bytes_total,
                 "engine_cache_evictions": self.engine_cache_evictions,
                 "retries_total": self.retries_total,
                 "watchdog_trips_total": self.watchdog_trips_total,
@@ -442,6 +478,9 @@ class ServingMetrics:
         out["kv_restore_ms"] = {
             f"p{q}": round(v * 1e3, 3)
             for q, v in self.kv_restore.percentiles(_QUANTILES).items()}
+        out["kv_handoff_ms"] = {
+            f"p{q}": round(v * 1e3, 3)
+            for q, v in self.kv_handoff.percentiles(_QUANTILES).items()}
         return out
 
     # ------------------------------------------------------------ render
@@ -550,7 +589,13 @@ class ServingMetrics:
                  "per-slot verify spans scored (speculating slots "
                  "summed over steps)"),
             ]
+            gen_counters.append(
+                ("kv_handoff_bytes_total", self.kv_handoff_bytes_total,
+                 "KV blob bytes crossing the cross-replica handoff "
+                 "socket, sent + received (disaggregated serving)"))
             evictions = dict(self.evictions)
+            handoffs = dict(self.kv_handoffs)
+            role = self.serving_role
             slot_count = self.slot_count
             kv_total = self.kv_blocks_total
             kv_free = self.kv_blocks_free
@@ -601,6 +646,26 @@ class ServingMetrics:
                 f'{n}_kv_restore_seconds{{quantile="0.{q}"}} {v:.6f}')
         lines.append(f"{n}_kv_restore_seconds_count "
                      f"{self.kv_restore.count}")
+        emit("serving_role", 1,
+             "this replica's disaggregated-fleet role (the router "
+             "builds its prefill/decode pools from this)",
+             labels=f'{{role="{role}"}}')
+        lines.append(f"# HELP {n}_kv_handoffs_total cross-replica KV "
+                     "handoffs, by outcome (disaggregated serving)")
+        lines.append(f"# TYPE {n}_kv_handoffs_total counter")
+        for outcome in sorted(handoffs):
+            lines.append(f'{n}_kv_handoffs_total{{outcome="{outcome}"}} '
+                         f"{handoffs[outcome]}")
+        kvh = self.kv_handoff.percentiles(_QUANTILES)
+        lines.append(f"# HELP {n}_kv_handoff_seconds receive-side "
+                     "handoff decide-to-deliver wall time, "
+                     "recent-window quantiles")
+        lines.append(f"# TYPE {n}_kv_handoff_seconds summary")
+        for q, v in kvh.items():
+            lines.append(
+                f'{n}_kv_handoff_seconds{{quantile="0.{q}"}} {v:.6f}')
+        lines.append(f"{n}_kv_handoff_seconds_count "
+                     f"{self.kv_handoff.count}")
         lines.append(f"# HELP {n}_slot_evictions_total decode slots "
                      "evicted, by reason")
         lines.append(f"# TYPE {n}_slot_evictions_total counter")

@@ -39,6 +39,18 @@ Scale" playbook over PR-6's resilience substrate):
 * CLIENT-DISCONNECT PROPAGATION — a dropped downstream stream closes
   the upstream replica connection, so the replica's ``abandon()`` slot
   reclamation fires instead of decoding to max_tokens for nobody.
+* DISAGGREGATED PREFILL/DECODE (serving/transfer.py; docs/serving.md
+  "Disaggregated serving") — when the ready set holds both a
+  prefill-role and a decode-role replica (``--role`` on server.py,
+  advertised via /metrics), a fresh stream runs a 1-token PREFILL leg
+  on the prefill pool, then hands off at the first token: the decode
+  leg carries chain key + continuation and the decode replica pulls the
+  KV blocks over ``/v1/kv/export`` (length-prefixed, trunk-signed spill
+  blobs).  Every failure — dead prefill (kill -9), oversized/foreign
+  blob, the analytic model preferring recompute — degrades to the plain
+  continuation-replay leg, bit-identical by greedy determinism;
+  ``kv_handoffs_total{outcome=...}`` counters on the replicas and the
+  router prove which path ran.
 
 The ``router.dispatch`` fault point (resilience/faults.py) sits at the
 router->replica network boundary: seeded plans inject dispatch errors/
@@ -52,6 +64,15 @@ CLI (``python -m paddle_tpu.serving.router``):
                                    assert bit-identical completion +
                                    /metrics evidence; ONE JSON line
                                    (healthy_window.sh phase 10)
+  --smoke-disagg                   disaggregated-serving self-test:
+                                   1 prefill + 1 decode replica,
+                                   concurrent streams handed off at the
+                                   first token over the socket KV
+                                   transport, analytic fallback for a
+                                   short prompt, kill -9 of the prefill
+                                   replica falls back to recompute —
+                                   every stream bit-identical; ONE JSON
+                                   line (healthy_window.sh phase 21)
 """
 
 import argparse
@@ -74,6 +95,11 @@ from paddle_tpu.utils.stats import Histogram
 
 _QUANTILES = (50, 95, 99)
 _QDEPTH_RE = re.compile(r"^\S*_queue_depth (\d+)\s*$", re.MULTILINE)
+# disaggregated serving (serving/transfer.py): each replica advertises
+# its role on /metrics; the router parses it from the SAME text the
+# queue-depth probe already fetched (zero extra requests)
+_ROLE_RE = re.compile(r'^\S*_serving_role\{role="(\w+)"\} 1\s*$',
+                      re.MULTILINE)
 
 # router-side rejection reasons (part of the /metrics surface);
 # shed = the adaptive overload controller refused it (serving/overload.py)
@@ -109,6 +135,15 @@ class RouterMetrics:
         self.readmissions_total = {}      # replica id -> half-open closes
         self.client_disconnects_total = 0
         self.tokens_proxied_total = 0
+        # disaggregated prefill/decode handoffs as the ROUTER saw them
+        # resolve (the replicas keep their own sent/received/fallback
+        # counters; serving/transfer.py)
+        from paddle_tpu.serving.metrics import HANDOFF_OUTCOMES
+        self.kv_handoffs = {o: 0 for o in HANDOFF_OUTCOMES}
+        self.kv_handoff_bytes_total = 0
+        self.kv_handoff = Histogram(f"{name}_kv_handoff",
+                                    max_samples=max_samples,
+                                    keep="last", clock=self.clock)
         self.latency = Histogram(f"{name}_latency", max_samples=max_samples,
                                  keep="last", clock=self.clock)
         # fleet-wide time-to-first-token as the ROUTER's clients feel it
@@ -119,6 +154,17 @@ class RouterMetrics:
 
     def observe_ttft(self, seconds):
         self.ttft.add(seconds)
+
+    def observe_kv_handoff(self, outcome, nbytes=0, seconds=None):
+        """One disaggregated KV handoff resolved through this router
+        (outcome from serving.metrics.HANDOFF_OUTCOMES; seconds = the
+        receive-side fetch+verify+deliver latency when known)."""
+        with self._lock:
+            self.kv_handoffs[outcome] = \
+                self.kv_handoffs.get(outcome, 0) + 1
+            self.kv_handoff_bytes_total += int(nbytes)
+        if seconds is not None:
+            self.kv_handoff.add(seconds)
 
     def slo_p99_recent_s(self, window_s=None):
         """The control loops' SLO signal: recent-window TTFT p99, falling
@@ -180,8 +226,13 @@ class RouterMetrics:
                 "readmissions_total": dict(self.readmissions_total),
                 "client_disconnects_total": self.client_disconnects_total,
                 "tokens_proxied_total": self.tokens_proxied_total,
+                "kv_handoffs_total": dict(self.kv_handoffs),
+                "kv_handoff_bytes_total": self.kv_handoff_bytes_total,
             }
         out["faults_fired"] = faults.fired_counts()
+        out["kv_handoff_ms"] = {f"p{q}": round(v * 1e3, 3)
+                                for q, v in self.kv_handoff.percentiles(
+                                    _QUANTILES).items()}
         out["latency_ms"] = {f"p{q}": round(v * 1e3, 3)
                              for q, v in lat.items()}
         out["ttft_ms"] = {f"p{q}": round(v * 1e3, 3)
@@ -208,6 +259,9 @@ class _ReplicaView:
         self.not_before = 0.0         # honored Retry-After (monotonic)
         self.queue_depth = 0
         self.inflight = 0
+        self.role = "mixed"           # serving_role{role=...} from the
+        #                               probe's /metrics read (prefill|
+        #                               decode|mixed; transfer.py)
 
 
 class Router:
@@ -351,10 +405,14 @@ class Router:
         try:
             with urllib.request.urlopen(f"{rep.base_url}/metrics",
                                         timeout=5) as r:
-                m = _QDEPTH_RE.search(r.read().decode())
+                text = r.read().decode()
+            m = _QDEPTH_RE.search(text)
             if m is not None:
                 rep.queue_depth = int(m.group(1))
-        except Exception:   # noqa: BLE001 — depth is advisory
+            m = _ROLE_RE.search(text)
+            if m is not None:
+                rep.role = m.group(1)
+        except Exception:   # noqa: BLE001 — depth/role are advisory
             pass
 
     def _poll_loop(self):
@@ -415,17 +473,30 @@ class Router:
 
     # ------------------------------------------------------------ picking
 
-    def _pick(self, exclude=(), session=None):
+    @staticmethod
+    def _role_penalty(rep, prefer_role):
+        """0 = the preferred role, 1 = a mixed replica (serves both
+        phases), 2 = the opposite role — a dead prefill pool degrades to
+        ANY replica rather than failing the request."""
+        if prefer_role is None or rep.role == prefer_role:
+            return 0
+        return 1 if rep.role == "mixed" else 2
+
+    def _pick(self, exclude=(), session=None, prefer_role=None):
         """Least-loaded eligible replica, or None.  ``session`` pins a
         conversation to its previous replica while that replica stays
-        eligible (re-pinned on failover)."""
+        eligible (re-pinned on failover).  ``prefer_role`` biases toward
+        a disaggregated-serving role (prefill for new prompts, decode
+        for handed-off streams) WITHOUT excluding anyone — the role sort
+        key outranks load, and session affinity outranks both."""
         now = self._clock()
         with self._lock:
             cands = sorted(
                 (r for r in self._replicas.values()
                  if r.rid not in exclude and r.ready
                  and now >= r.not_before),
-                key=lambda r: (r.queue_depth + r.inflight, r.rid))
+                key=lambda r: (self._role_penalty(r, prefer_role),
+                               r.queue_depth + r.inflight, r.rid))
             if session is not None:
                 pinned = self._affinity.get(session)
                 cands.sort(key=lambda r: 0 if r.rid == pinned else 1)
@@ -440,16 +511,17 @@ class Router:
                 return r
         return None
 
-    def _pick_eligible(self, exclude=(), session=None):
+    def _pick_eligible(self, exclude=(), session=None, prefer_role=None):
         """``_pick`` plus the retry-anywhere fallback: when nothing ELSE
         is eligible, a transient blip is still retryable on a replica
         that already failed this request."""
-        rep = self._pick(exclude=exclude, session=session)
+        rep = self._pick(exclude=exclude, session=session,
+                         prefer_role=prefer_role)
         if rep is None and exclude:
-            rep = self._pick(session=session)
+            rep = self._pick(session=session, prefer_role=prefer_role)
         return rep
 
-    def _pick_wait(self, exclude=(), session=None):
+    def _pick_wait(self, exclude=(), session=None, prefer_role=None):
         """``_pick_eligible``, but a miss does not immediately fail the
         request: the poll thread's view of a freshly restarted replica
         lags by up to a full interval (exactly the rolling-restart
@@ -457,7 +529,7 @@ class Router:
         is back but not yet re-probed), so probe the unready replicas
         synchronously and wait the transient out, bounded by
         ``unready_grace_s``."""
-        rep = self._pick_eligible(exclude, session)
+        rep = self._pick_eligible(exclude, session, prefer_role)
         if rep is not None:
             return rep
         deadline = self._clock() + self.unready_grace_s
@@ -470,11 +542,24 @@ class Router:
                 self._probe(r)
             if stale:
                 self._track_breakers()
-            rep = self._pick_eligible(exclude, session)
+            rep = self._pick_eligible(exclude, session, prefer_role)
             if rep is not None or self._clock() >= deadline:
                 return rep
             self._closed.wait(0.05)
         return None
+
+    def disagg_active(self):
+        """True when disaggregated prefill/decode orchestration should
+        run: handoffs are enabled AND the ready set contains both a
+        prefill-role and a decode-role replica.  An all-mixed fleet (the
+        default) never pays the extra leg; a half-dead disagg fleet
+        degrades to ordinary routing."""
+        from paddle_tpu.utils.flags import FLAGS
+        if not FLAGS.serving_handoff:
+            return False
+        with self._lock:
+            roles = {r.role for r in self._replicas.values() if r.ready}
+        return "prefill" in roles and "decode" in roles
 
     def _retry_after_hint(self):
         """Seconds until routing could plausibly succeed — min over
@@ -713,7 +798,7 @@ class Router:
             r.rid: {
                 "url": r.base_url, "ready": r.ready,
                 "queue_depth": r.queue_depth, "inflight": r.inflight,
-                "breaker": r.breaker.state,
+                "breaker": r.breaker.state, "role": r.role,
             } for r in reps
         }
 
@@ -758,6 +843,21 @@ class Router:
                 ("tokens_proxied_total", "generation tokens streamed "
                                          "through the router")):
             emit(field, snap[field], help_)
+        emit_labeled("kv_handoffs_total", snap["kv_handoffs_total"],
+                     "disaggregated prefill->decode KV handoffs resolved "
+                     "through this router, by outcome (serving/"
+                     "transfer.py)", label="outcome")
+        emit("kv_handoff_bytes_total", snap["kv_handoff_bytes_total"],
+             "KV chain bytes shipped replica-to-replica for handoffs "
+             "this router brokered")
+        lines.append(f"# HELP {n}_kv_handoff_seconds receive-side "
+                     "fetch+verify+deliver latency of brokered KV "
+                     "handoffs, recent-window quantiles")
+        lines.append(f"# TYPE {n}_kv_handoff_seconds summary")
+        for q, v in m.kv_handoff.percentiles(_QUANTILES).items():
+            lines.append(f'{n}_kv_handoff_seconds{{quantile="0.{q}"}} '
+                         f"{v:.6f}")
+        lines.append(f"{n}_kv_handoff_seconds_count {m.kv_handoff.count}")
         emit_labeled("ejections_total", snap["ejections_total"],
                      "replicas ejected from rotation (consecutive "
                      "dispatch failures)")
@@ -1064,6 +1164,22 @@ class RouterHandler(BaseHTTPRequestHandler):
         attempts = 0
         exclude = set()
         last_shed = None              # last orderly 503 (status, hd, data)
+        # disaggregated prefill/decode (serving/transfer.py;
+        # docs/serving.md "Disaggregated serving"): when the ready set
+        # holds both roles, split a fresh stream into a PREFILL leg
+        # (max_tokens=1 on a prefill-role replica — its done record is
+        # the handoff boundary, not the stream's end) and a DECODE leg
+        # that ships chain key + continuation; the decode replica pulls
+        # the KV blocks over /v1/kv/export.  Any prefill death or
+        # transfer failure degrades to the plain continuation-replay
+        # path below — recompute, bit-identical by greedy determinism.
+        prompt_ids = req.get("prompt")
+        disagg = (router.disagg_active()
+                  and isinstance(prompt_ids, list) and prompt_ids
+                  and all(isinstance(t, int) for t in prompt_ids))
+        handoff_src = None        # prefill replica URL once the boundary
+        #                           lands (stays attached across decode-
+        #                           leg failovers)
 
         def send_headers():
             if state["headers_sent"]:
@@ -1084,6 +1200,15 @@ class RouterHandler(BaseHTTPRequestHandler):
 
         def finish(done_rec):
             out = dict(done_rec)
+            # the decode replica reports how its leg got the context
+            # (serving/transfer.py outcome dict) — fold it into the
+            # router's fleet-wide handoff counters/latency histogram
+            hand = out.get("kv_handoff")
+            if isinstance(hand, dict) and hand.get("outcome"):
+                ms = hand.get("ms")
+                m.observe_kv_handoff(
+                    hand["outcome"], hand.get("bytes") or 0,
+                    ms / 1e3 if isinstance(ms, (int, float)) else None)
             out["tokens"] = list(delivered)
             out["latency_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
             chunk(out)
@@ -1121,7 +1246,16 @@ class RouterHandler(BaseHTTPRequestHandler):
                 m.reject("exhausted")
                 fail_stream("stream failover budget exhausted")
                 return
-            rep = router._pick_wait(exclude=exclude, session=session)
+            prefer = None
+            if disagg:
+                prefer = ("prefill" if not delivered
+                          and handoff_src is None else "decode")
+            # the prefill leg ignores session affinity (the session
+            # belongs with the decode replica that will own the stream)
+            rep = router._pick_wait(
+                exclude=exclude,
+                session=None if prefer == "prefill" else session,
+                prefer_role=prefer)
             if rep is None:
                 if last_shed is not None and not state["headers_sent"]:
                     st, hd, data = last_shed
@@ -1141,27 +1275,56 @@ class RouterHandler(BaseHTTPRequestHandler):
                 return
             leg = dict(req)
             leg["stream"] = True
-            leg["max_tokens"] = eff_max - len(delivered)
+            boundary_leg = disagg and prefer == "prefill"
+            if boundary_leg:
+                # stop at the first token: the prefill leg's done record
+                # is the handoff boundary, swallowed below — the decode
+                # leg continues the stream
+                leg["max_tokens"] = 1
+            else:
+                leg["max_tokens"] = eff_max - len(delivered)
             replay = orig_replay + delivered
             if replay:
                 leg["replay"] = replay
             elif "replay" in leg:
                 del leg["replay"]
+            if handoff_src is not None and not boundary_leg \
+                    and handoff_src != rep.base_url:
+                # ship the chain key: the decode replica pulls the
+                # prefill replica's KV blocks over /v1/kv/export before
+                # admission (a failed pull is its recompute fallback)
+                leg["kv_handoff"] = {
+                    "source": handoff_src,
+                    "tokens": [int(t) for t in prompt_ids] + orig_replay}
+            elif "kv_handoff" in leg:
+                # never forward a client-supplied hint past the replica
+                # that already owns the context
+                del leg["kv_handoff"]
             with router._lock:
                 rep.inflight += 1
             try:
                 # one upstream leg = one span: a failed-over stream shows
                 # leg[replica=r0] then leg[replica=r1] on the same trace
                 with obstrace.span("router.leg", replica=rep.rid,
-                                   attempt=attempts, replay=len(replay)):
-                    outcome = self._proxy_leg(router, rep, leg, delivered,
-                                              send_headers, chunk, finish,
-                                              t0)
+                                   attempt=attempts, replay=len(replay),
+                                   boundary=boundary_leg):
+                    outcome = self._proxy_leg(
+                        router, rep, leg, delivered, send_headers, chunk,
+                        (lambda rec: None) if boundary_leg else finish,
+                        t0)
             finally:
                 with router._lock:
                     rep.inflight -= 1
             if outcome[0] == "done":
                 router._record(rep, ok=True)
+                if boundary_leg:
+                    # the 1-token prefill leg completed: this is the
+                    # HANDOFF, not the stream's end — loop into the
+                    # decode leg with the chain key attached
+                    handoff_src = rep.base_url
+                    self._obs.event("kv_handoff_boundary",
+                                    replica=rep.rid)
+                    continue
                 return
             if outcome[0] == "client_gone":
                 # the downstream reader left: upstream already closed
@@ -1448,6 +1611,231 @@ def _smoke():
     return 0 if all(checks) else 2
 
 
+def _smoke_disagg():
+    """Disaggregated-serving self-test (healthy_window.sh phase 21):
+    ONE prefill-role + ONE decode-role replica behind the router,
+    concurrent streaming clients handed off mid-flight — each new
+    prompt prefills on r0, crosses the socket transport at the first
+    token (chain key + continuation; the decode replica pulls the KV
+    blocks over /v1/kv/export), and decodes on r1.  Every stream must
+    finish bit-identical to the local ``lm_generate`` oracle; the
+    handoff counters on BOTH replicas' /metrics and the router's must
+    prove the blocks really crossed the socket; a short prompt must
+    take the analytic recompute fallback; and after kill -9 of the
+    prefill replica a handoff against the dead source must fall back to
+    recompute, still bit-identical.  ONE JSON line; returns the exit
+    code."""
+    import urllib.request
+    import numpy as np
+    import jax
+    from paddle_tpu.models import transformer
+    from paddle_tpu.serving.fleet import ReplicaSupervisor
+
+    errs = []
+    out = {"metric": "disaggregated serving smoke (prefill/decode "
+                     "replicas, socket KV handoff, kill -9 fallback)",
+           "vs_baseline": None}
+    n_tokens, max_len, bs = 24, 64, 8
+    # block-aligned prompts: the handed-off chain covers the WHOLE
+    # prompt, so the decode replica seats it with zero prefill chunk
+    # lanes.  Lengths 32/40 sit above the analytic crossover (handoff
+    # beats recompute); 16 sits below it — that stream must take the
+    # analytic fallback and still stream bit-identically.
+    lengths = [32, 40, 16, 32]
+    extra = ["--gen-slots", "4", "--gen-max-len", str(max_len),
+             "--gen-prefill-buckets", "8,16",
+             "--gen-max-tokens", str(n_tokens),
+             "--prefill-chunk", str(bs),
+             "--kv-layout", "paged", "--kv-block-size", str(bs),
+             "--kv-num-blocks", "49", "--kv-prefix-cache", "1",
+             "--kv-host-bytes", str(64 << 20),
+             "--fault-spec",
+             "serving.decode_step:every=1,action=hang,hang_s=0.015"]
+    sup = ReplicaSupervisor(n_replicas=2, roles=("prefill", "decode"),
+                            extra_args=extra, backoff_base_s=0.3, seed=0,
+                            name="disagg_smoke")
+    router = Router(supervisor=sup, poll_interval_s=0.1,
+                    eject_threshold=2, eject_cooldown_s=1.0,
+                    retry_budget=3, name="router_disagg")
+
+    def outcome_count(text, outcome):
+        m = re.search(r'^\S*_kv_handoffs_total\{outcome="'
+                      + outcome + r'"\} (\d+)\s*$', text, re.MULTILINE)
+        return int(m.group(1)) if m else 0
+
+    def fetch_metrics(url):
+        with urllib.request.urlopen(f"{url}/metrics", timeout=30) as r:
+            return r.read().decode()
+
+    def stream(port, prompt, replay=None, handoff=None, max_tokens=None):
+        """One streaming /v1/generate client; returns (tokens, done)."""
+        body = {"prompt": list(map(int, prompt)),
+                "max_tokens": (n_tokens if max_tokens is None
+                               else max_tokens), "stream": True}
+        if replay:
+            body["replay"] = list(map(int, replay))
+        if handoff is not None:
+            body["kv_handoff"] = handoff
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        try:
+            conn.request("POST", "/v1/generate",
+                         json.dumps(body).encode(),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            toks, done = [], None
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                rec = json.loads(line)
+                if "token" in rec:
+                    toks.append(rec["token"])
+                if rec.get("done"):
+                    done = rec
+                    break
+            return toks, done
+        finally:
+            conn.close()
+
+    httpd = None
+    try:
+        sup.start()
+        if not sup.wait_ready(timeout=240):
+            errs.append("replicas never became ready")
+            raise RuntimeError("fleet warm-up timeout")
+        eps = dict(sup.endpoints())
+        prefill_url, decode_url = eps["r0"], eps["r1"]
+        httpd = router.start(port=0)
+        # the router must have PROBED both roles before disaggregated
+        # routing activates (role rides the /metrics poll)
+        deadline = time.monotonic() + 30
+        while not router.disagg_active() \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        out["disagg_active"] = router.disagg_active()
+
+        rng = np.random.RandomState(7)
+        prompts = [rng.randint(1, 256, n).astype(np.int64)
+                   for n in lengths + [32, 32]]   # +kill-fallback, +post
+        params = transformer.init(jax.random.PRNGKey(0), src_vocab=256,
+                                  trg_vocab=1, d_model=32, num_heads=2,
+                                  dff=64, enc_layers=2, dec_layers=0,
+                                  max_len=max_len)
+        oracle = []
+        for p in prompts:
+            ids = np.asarray(transformer.lm_generate(
+                params, p[None], max_len=max_len, num_heads=2,
+                prompt_lengths=np.asarray([p.size])))
+            oracle.append(ids[0, p.size:p.size + n_tokens].tolist())
+
+        # ---- phase 1: concurrent streams, handed off mid-flight ----
+        results = [None] * len(lengths)
+
+        def hit(i):
+            try:
+                results[i] = stream(httpd.port, prompts[i])
+            except Exception as e:      # noqa: BLE001
+                errs.append(f"client {i}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=hit, args=(i,))
+                   for i in range(len(lengths))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        done_ok = sum(1 for r in results
+                      if r is not None and r[1] is not None)
+        bit_identical = all(
+            r is not None and r[0] == oracle[i]
+            and r[1] and r[1]["tokens"] == oracle[i]
+            for i, r in enumerate(results))
+        hand_outcomes = [
+            (r[1].get("kv_handoff") or {}).get("outcome")
+            if r is not None and r[1] else None for r in results]
+        # long prompts (>= the analytic crossover) must have RECEIVED a
+        # real handoff; the short one must have fallen back (analytic)
+        long_received = all(
+            hand_outcomes[i] == "received"
+            for i in range(len(lengths)) if lengths[i] >= 32)
+        short_fellback = all(
+            hand_outcomes[i] == "fallback"
+            for i in range(len(lengths)) if lengths[i] < 32)
+        pre_text = fetch_metrics(prefill_url)
+        dec_text = fetch_metrics(decode_url)
+        sent = outcome_count(pre_text, "sent")
+        received = outcome_count(dec_text, "received")
+        bytes_m = re.search(r"^\S*_kv_handoff_bytes_total (\d+)\s*$",
+                            dec_text, re.MULTILINE)
+        handoff_bytes = int(bytes_m.group(1)) if bytes_m else 0
+        snap = router.metrics.snapshot()
+        out.update(
+            streams_ok=done_ok,
+            bit_identical=bool(bit_identical),
+            handoff_outcomes=hand_outcomes,
+            prefill_sent=sent,
+            decode_received=received,
+            decode_handoff_bytes=handoff_bytes,
+            router_handoffs=snap["kv_handoffs_total"],
+            router_handoff_ms_p50=snap["kv_handoff_ms"].get("p50"),
+        )
+
+        # ---- phase 2: kill -9 the prefill replica; a handoff against
+        # the dead source must fall back to recompute, bit-identically
+        sup.kill("r0", signal.SIGKILL)
+        out["victim_killed"] = True
+        time.sleep(0.2)                  # let the socket really die
+        p_kill, o_kill = prompts[len(lengths)], oracle[len(lengths)]
+        dec_port = urlsplit(decode_url).port
+        toks, done = stream(
+            dec_port, p_kill, replay=o_kill[:1], max_tokens=n_tokens - 1,
+            handoff={"source": prefill_url,
+                     "tokens": list(map(int, p_kill))})
+        kill_hand = (done or {}).get("kv_handoff") or {}
+        kill_fallback_ok = (done is not None
+                            and toks == o_kill[1:]
+                            and done["tokens"] == o_kill[1:]
+                            and kill_hand.get("outcome") == "fallback")
+        out["kill_fallback_outcome"] = kill_hand
+        fallbacks_after = outcome_count(fetch_metrics(decode_url),
+                                        "fallback")
+        out["decode_fallbacks"] = fallbacks_after
+
+        # ---- phase 3: the fleet keeps serving THROUGH the kill — a
+        # fresh stream via the router (its view of r0 may still be
+        # stale) must complete bit-identically on what's left
+        p_post, o_post = prompts[len(lengths) + 1], oracle[len(lengths) + 1]
+        toks3, done3 = stream(httpd.port, p_post)
+        post_ok = (done3 is not None and toks3 == o_post
+                   and done3["tokens"] == o_post)
+        out["post_kill_stream_ok"] = bool(post_ok)
+
+        checks = [
+            bool(out["disagg_active"]),
+            done_ok == len(lengths),
+            bool(bit_identical),
+            bool(long_received) and bool(short_fellback),
+            sent >= 3 and received >= 3 and handoff_bytes > 0,
+            snap["kv_handoffs_total"].get("received", 0) >= 3
+            and snap["kv_handoffs_total"].get("fallback", 0) >= 1,
+            bool(kill_fallback_ok) and fallbacks_after >= 2,
+            bool(post_ok),
+        ]
+    except Exception as e:      # noqa: BLE001 — a harness failure must
+        errs.append(f"smoke: {type(e).__name__}: {e}")
+        checks = [False]
+    finally:
+        try:
+            router.close()
+        finally:
+            sup.stop()
+    out["value"] = sum(bool(c) for c in checks)
+    out["unit"] = f"checks_ok/{len(checks)}"
+    if errs:
+        out["errors"] = errs[:5]
+    print(json.dumps(out), flush=True)
+    return 0 if all(checks) else 2
+
+
 # -------------------------------------------------------------------- CLI
 
 
@@ -1495,9 +1883,16 @@ def main(argv=None):
     ap.add_argument("--smoke", action="store_true",
                     help="fleet self-test (2 replicas, kill -9 one "
                          "mid-stream), one JSON line, exit")
+    ap.add_argument("--smoke-disagg", action="store_true",
+                    help="disaggregated-serving self-test (1 prefill + "
+                         "1 decode replica, socket KV handoff at the "
+                         "first token, analytic fallback, kill -9 of "
+                         "the prefill replica), one JSON line, exit")
     args = ap.parse_args(argv)
     if args.smoke:
         return _smoke()
+    if args.smoke_disagg:
+        return _smoke_disagg()
     if args.fault_spec:
         faults.install_spec(args.fault_spec)
         logger.warning("fault injection ACTIVE: %s", args.fault_spec)

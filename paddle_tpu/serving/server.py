@@ -28,6 +28,14 @@ Endpoints:
   GET  /readyz     READINESS: 200 when warm-up is complete, the circuit
                    breaker is closed, and no drain has begun; 503 (with
                    the blocking reasons and Retry-After) otherwise
+  POST /v1/kv/export {"tokens": [ids]}
+                   -> the longest resident KV coverage of that prefix as
+                   one length-prefixed spill-format blob (application/
+                   octet-stream), serialized by the batcher worker
+                   strictly BETWEEN decode steps; 404 = no coverage (the
+                   peer recomputes).  The disaggregated-serving
+                   transport (serving/transfer.py; docs/serving.md
+                   "Disaggregated serving").
   GET  /metrics    Prometheus text (serving/metrics.py)
   GET  /debug/traces  recent request spans + slowest-request trace_ids
                    (obs/trace.py; {"enabled": false} when tracing is
@@ -68,6 +76,15 @@ CLI (``python -m paddle_tpu.serving``):
                                    zero chunk lanes, bit-identical to
                                    the tier-less twin, ONE JSON line
                                    (healthy_window.sh phase 20)
+  --role prefill|decode|mixed      disaggregated-serving role advertised
+                                   on /metrics (serving_role{role=...}):
+                                   the router prefers prefill replicas
+                                   for new prompts and hands streams to
+                                   decode replicas at the first token,
+                                   shipping the KV chain over
+                                   /v1/kv/export (serving/transfer.py;
+                                   docs/serving.md "Disaggregated
+                                   serving"); mixed (default) = both
   --prefill-chunk K                unified chunked prefill (the
                                    default): prompt ingestion rides the
                                    ONE decode step as K-token chunks;
@@ -146,6 +163,7 @@ from paddle_tpu.resilience.supervisor import (BreakerOpenError, Supervisor,
 from paddle_tpu.serving.batcher import (Batcher, DeadlineExceededError,
                                         OverloadedError, ShutdownError)
 from paddle_tpu.serving.engine import InferenceEngine, InvalidRequestError
+from paddle_tpu.serving import transfer as kv_transfer
 from paddle_tpu.utils.logging import log_context, logger
 
 _STATUS = ((InvalidRequestError, 400), (OverloadedError, 429),
@@ -382,6 +400,9 @@ class ServingHandler(BaseHTTPRequestHandler):
         if self.path == "/v1/generate":
             self._post_generate()
             return
+        if self.path == kv_transfer.EXPORT_PATH:
+            self._post_kv_export()
+            return
         if self.path != "/v1/infer":
             self._reply(404, {"error": f"no route {self.path!r}"})
             return
@@ -413,6 +434,83 @@ class ServingHandler(BaseHTTPRequestHandler):
             self._reply(200, resp)
         except Exception as e:    # noqa: BLE001 — every error is a response
             self._error_reply(e, metrics=batcher.metrics)
+
+    # ----------------------------------------------------- POST kv export
+
+    def _post_kv_export(self):
+        """Disaggregated-serving SOURCE side (serving/transfer.py;
+        docs/serving.md "Disaggregated serving"): a peer decode replica
+        asks for our longest resident KV coverage of a token prefix.
+        The gather reads the committed (donated) cache, which belongs to
+        the batcher worker thread, so the worker serializes the chain
+        strictly BETWEEN decode steps (``GenerationBatcher.
+        export_chain``); this handler only ships the resulting blob —
+        8-byte little-endian length prefix + payload, bounded chunks."""
+        from paddle_tpu.utils.flags import FLAGS
+        gen = self.server.gen_batcher
+        if gen is None:
+            self._reply(404, {"error": "no generation plane on this "
+                                       "replica: nothing to export"})
+            return
+        try:
+            req = self._read_json()
+            tokens = req.get("tokens")
+            if not isinstance(tokens, list) or not tokens \
+                    or not all(isinstance(t, int) for t in tokens):
+                raise InvalidRequestError(
+                    "'tokens' must be a non-empty list of int token ids")
+        except Exception as e:   # noqa: BLE001 — every error is a response
+            self._error_reply(e, metrics=gen.metrics)
+            return
+        key, covered, blob = gen.export_chain(
+            tokens, timeout=FLAGS.serving_handoff_timeout_s)
+        if blob is None:
+            # no resident coverage (evicted, never prefilled here, or
+            # the export timed out behind a wedged step): the peer falls
+            # back to recompute — this 404 is an outcome, not a failure
+            self._reply(404, {"error": "no resident KV coverage for the "
+                                       "requested tokens"})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        # the length prefix travels INSIDE the body so the framing is
+        # transport-independent; read_blob re-checks the declared length
+        # against the receiver's own bound before buffering toward it
+        self.send_header("Content-Length", str(8 + len(blob)))
+        self.send_header("X-KV-Covered", str(int(covered)))
+        if self._obs.trace_id:
+            self.send_header("X-Trace-Id", self._obs.trace_id)
+        self.end_headers()
+        kv_transfer.write_blob(self.wfile, blob)
+        gen.metrics.observe_kv_handoff("sent", len(blob))
+
+    def _receive_handoff(self, gen, hint):
+        """Disaggregated-serving RECEIVE side: the router attached a
+        ``{"source": url, "tokens": [ids]}`` hint naming the prefill
+        replica that holds this stream's KV.  Fetch + verify + park the
+        chain in the host tier BEFORE admission, so the request's
+        ordinary seat probe restore-hits it through the existing
+        claim/stage/commit pipeline.  ANY failure — dead peer, foreign
+        or oversized blob, the analytic model preferring recompute, a
+        malformed hint — is the recompute fallback, never a client
+        error."""
+        from paddle_tpu.utils.flags import FLAGS
+        if not FLAGS.serving_handoff:
+            gen.metrics.observe_kv_handoff("fallback")
+            return {"outcome": "fallback", "bytes": 0, "covered": 0,
+                    "ms": 0.0, "reason": "disabled"}
+        source = hint.get("source") if isinstance(hint, dict) else None
+        tokens = hint.get("tokens") if isinstance(hint, dict) else None
+        if not isinstance(source, str) \
+                or not isinstance(tokens, list) or not tokens \
+                or not all(isinstance(t, int) for t in tokens):
+            gen.metrics.observe_kv_handoff("fallback")
+            return {"outcome": "fallback", "bytes": 0, "covered": 0,
+                    "ms": 0.0, "reason": "malformed_hint"}
+        return kv_transfer.receive_chain(
+            gen.engine, source, tokens, metrics=gen.metrics,
+            max_bytes=FLAGS.serving_handoff_max_bytes,
+            timeout=FLAGS.serving_handoff_timeout_s)
 
     # ------------------------------------------------------- POST generate
 
@@ -457,15 +555,22 @@ class ServingHandler(BaseHTTPRequestHandler):
                 except (OverflowError, ValueError) as e:
                     raise InvalidRequestError(
                         f"replay ids out of range: {e}") from e
+            # disaggregated handoff (serving/transfer.py): pull the
+            # stream's KV off the named prefill replica before admission
+            handoff = None
+            if req.get("kv_handoff") is not None:
+                handoff = self._receive_handoff(gen, req["kv_handoff"])
             kw = dict(max_tokens=req.get("max_tokens"),
                       eos_id=req.get("eos_id"), deadline_ms=deadline_ms,
                       replay=replay)
             if req.get("stream"):
-                self._generate_stream(gen, prompt, kw, t0)
+                self._generate_stream(gen, prompt, kw, t0, handoff=handoff)
                 return
             out = self._submit_retrying(
                 gen, lambda: gen.submit(prompt, **kw)).result(timeout=600)
             out = dict(out)
+            if handoff is not None:
+                out["kv_handoff"] = handoff
             out["latency_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
             if self._obs.trace_id:
                 out["trace_id"] = self._obs.trace_id
@@ -474,7 +579,7 @@ class ServingHandler(BaseHTTPRequestHandler):
         except Exception as e:    # noqa: BLE001 — every error is a response
             self._error_reply(e, metrics=gen.metrics)
 
-    def _generate_stream(self, gen, prompt, kw, t0):
+    def _generate_stream(self, gen, prompt, kw, t0, handoff=None):
         """Chunked-transfer NDJSON stream: one {"token": id} record per
         emitted token (pushed from the decode loop as the slot advances),
         then a closing {"done": true, ...} record.  Admission errors are
@@ -526,6 +631,8 @@ class ServingHandler(BaseHTTPRequestHandler):
                 else:
                     out = dict(val.result())
                     out["done"] = True
+                    if handoff is not None:
+                        out["kv_handoff"] = handoff
                     out["latency_ms"] = round(
                         (time.perf_counter() - t0) * 1e3, 3)
                     if self._obs.trace_id:
@@ -1656,6 +1763,17 @@ def main(argv=None):
                          "the analytic model predicts restore beats "
                          "recompute; 0 = tier off; paged + "
                          "prefix-cache only)")
+    # ---- disaggregated serving (serving/transfer.py; docs/serving.md
+    # "Disaggregated serving") ----
+    ap.add_argument("--role", default=FLAGS.serving_role,
+                    choices=("prefill", "decode", "mixed"),
+                    help="disaggregated-serving role, advertised on "
+                         "/metrics as serving_role{role=...}: the "
+                         "router sends new prompts to the prefill pool "
+                         "and at the first token hands the stream to a "
+                         "decode replica by shipping chain key + "
+                         "continuation (KV blocks ride /v1/kv/export); "
+                         "mixed (the default) serves both phases")
     # ---- quantized serving (quant/; docs/serving.md) ----
     ap.add_argument("--kv-dtype", default=FLAGS.serving_kv_dtype,
                     choices=("float32", "int8"),
@@ -1837,6 +1955,7 @@ def main(argv=None):
                                    or args.demo):
         # generation-only server: no /v1/infer batcher
         gen_batcher = _demo_gen_batcher(args)
+        gen_batcher.metrics.set_serving_role(args.role)
         httpd = make_server(None, args.host, args.port,
                             gen_batcher=gen_batcher)
         # the bound port is the replica's identity in a merged fleet
@@ -1863,6 +1982,7 @@ def main(argv=None):
     # batcher's metrics, so the ONE /metrics page reports both
     gen_batcher = (_demo_gen_batcher(args, metrics=engine.metrics)
                    if args.demo_generate else None)
+    engine.metrics.set_serving_role(args.role)
     httpd = make_server(batcher, args.host, args.port,
                         gen_batcher=gen_batcher)
     obstrace.set_process(f"replica:{httpd.port}")

@@ -110,6 +110,23 @@ class Flags:
     #                                     prefix chains spill and
     #                                     restore instead of
     #                                     recomputing; 0 = tier off)
+    # ---- disaggregated serving (serving/transfer.py: cross-replica
+    # KV-block handoff over a socket transport; docs/serving.md
+    # "Disaggregated serving")
+    serving_role: str = "mixed"         # replica role in a disaggregated
+    #                                     fleet: "prefill" | "decode" |
+    #                                     "mixed"
+    serving_handoff: bool = True        # router: hand streams off from
+    #                                     the prefill pool to the decode
+    #                                     pool at first token (active
+    #                                     only when both roles exist)
+    serving_handoff_max_bytes: int = 256 << 20  # receive-side bound on
+    #                                     ONE handoff blob's bytes (a
+    #                                     garbled peer must never OOM
+    #                                     the receiver)
+    serving_handoff_timeout_s: float = 5.0  # socket timeout for one
+    #                                     export fetch (expired =
+    #                                     recompute fallback)
     # ---- quantized serving (paddle_tpu/quant/: int8 weights + int8 KV
     # cache with in-register dequant in the fused decode kernels;
     # docs/serving.md "Quantized serving")
@@ -419,6 +436,31 @@ FLAG_DOCS = {
                               "recompute (LRU within the cap; 0 = "
                               "tier off; paged + prefix_cache only)",
                               "—"),
+    "serving_role": ("replica role in a disaggregated fleet: prefill "
+                     "(takes new prompts, exports KV chains), decode "
+                     "(receives handoffs, decodes), or mixed (both — "
+                     "the single-replica default).  The router routes "
+                     "new prompts to the prefill pool and hands "
+                     "streams off at first token when both pools "
+                     "exist", "—"),
+    "serving_handoff": ("router-side switch for cross-replica KV "
+                        "handoff: when a prefill pool AND a decode "
+                        "pool are both present, new streams prefill "
+                        "on one pool and decode on the other, the KV "
+                        "chain crossing as a wire-format blob; off = "
+                        "roles only affect routing preference and "
+                        "every stream recomputes its context on the "
+                        "decode replica", "—"),
+    "serving_handoff_max_bytes": ("receive-side ceiling on one handoff "
+                                  "blob (length prefix AND decoded "
+                                  "size are bounded before any "
+                                  "allocation); larger exports fall "
+                                  "back to recompute", "—"),
+    "serving_handoff_timeout_s": ("socket timeout for one KV-export "
+                                  "fetch; expiry (e.g. the prefill "
+                                  "replica died) falls back to "
+                                  "continuation-replay recompute",
+                                  "—"),
     "serving_kv_dtype": ("decode KV-cache storage dtype: float32, or "
                          "int8 (quantized K/V + per-(position, head) "
                          "f32 scale sidecars, dequantized in-register "

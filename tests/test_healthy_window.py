@@ -53,7 +53,7 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "chunked_smoke.json", "quant_smoke.json",
                  "analysis_gate.json", "spec_smoke.json",
                  "sharded_smoke.json", "spill_smoke.json",
-                 "WINDOW_DONE"):
+                 "disagg_smoke.json", "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -218,6 +218,23 @@ def test_dryrun_executes_every_phase(tmp_path):
     assert spl["bit_identical"] is True, spl
     assert spl["step_traces"] == 1, spl
     assert spl["metrics_sane"] is True, spl
+    # the disagg smoke really handed off: prompts prefilled on one pool,
+    # the KV chain crossed the socket at first token and the decode pool
+    # seated it (received counters on both replicas AND the router), a
+    # sub-crossover prompt took the analytic recompute fallback, kill -9
+    # of the prefill replica fell back to recompute — every stream
+    # bit-identical to the single-replica oracle
+    dsg = json.loads((art / "disagg_smoke.json").read_text())
+    assert dsg["value"] == int(dsg["unit"].split("/")[1]), dsg
+    assert dsg["disagg_active"] is True, dsg
+    assert dsg["bit_identical"] is True, dsg
+    assert dsg["prefill_sent"] >= 3, dsg
+    assert dsg["decode_received"] >= 3, dsg
+    assert dsg["decode_handoff_bytes"] > 0, dsg
+    assert dsg["router_handoffs"]["received"] >= 3, dsg
+    assert dsg["router_handoffs"]["fallback"] >= 1, dsg
+    assert dsg["kill_fallback_outcome"]["outcome"] == "fallback", dsg
+    assert dsg["post_kill_stream_ok"] is True, dsg
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows
