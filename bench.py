@@ -2269,9 +2269,7 @@ def bench_serving_quant(slots=8, n_requests=48, vocab=256, d_model=128,
             h8, _ = transformer.lm_prefill(p, ctxs, max_len, heads,
                                            kv_dtype="int8")
             l8 = transformer._lm_project(p, h8)
-            err = np.abs(np.asarray(l32) - np.asarray(l8)).max(axis=-1)
-            valid = np.arange(max_len)[None, :] < lens[:, None]
-            per_stream = np.where(valid, err, 0.0).max(axis=1)
+            per_stream = quant_kv.logit_err(l32, l8, lens)
             in_budget = int((per_stream
                              <= quant_kv.LOGIT_ERR_BUDGET).sum())
             return within, exact, in_budget, float(per_stream.max())
@@ -2325,6 +2323,279 @@ def bench_serving_quant(slots=8, n_requests=48, vocab=256, d_model=128,
         f"fp32 {slots} slots vs int8 {2 * slots} slots at "
         f"{budget_blocks} f32-budget blocks, block {block_size})"), \
         extras
+
+
+def bench_serving_quant_prefill(batch=8, tp=64, vocab=256, d_model=128,
+                                dff=256, layers=3, heads=2, seed=0):
+    """Int8 flash prefill (ops/pallas/flash_attention.flash_attention_
+    quant; docs/serving.md "Quantized serving"): the batched causal
+    prefill over an int8 cache + int8 weights, streaming the int8 K/V
+    bytes and their per-(position, head) scale sidecars straight into
+    the kernel, vs the reference path that widens each layer's whole
+    just-quantized K/V set back to f32 before attending.
+
+    The analytic leg is the acceptance bar (capture runs
+    extras["postcheck"] on extras["lower"] — the int8-weights int8-KV
+    ``lm_prefill`` with the quant kernel forced ON): (a) NO f32
+    [b, tp, dkv]-element widen-the-cache convert exists in the
+    kernel-forced HLO (assert_prefill_kv_quantized) while the
+    kernels-off twin must TRIP the same detector — it dequantizes every
+    layer's full set; (b) every quantized weight still enters as an s8
+    parameter (assert_weights_quantized, fp32 twin must FAIL); and (c)
+    predicted prefill bytes (perf/analytic.predicted_prefill_bytes —
+    first-principles, the XLA-CPU cost model materializes the converts
+    the TPU kernel keeps in registers) shrink >= 35% for int8 vs the
+    fp32 prefill.  The quality leg bounds the max |logit error| of the
+    quantized prefill vs the fp32 twin on mixed-length prompts under
+    the COMMITTED budget (quant/kv.logit_err + LOGIT_ERR_BUDGET)."""
+    import importlib
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import transformer
+    # the package re-exports the flash_attention FUNCTION, shadowing the
+    # submodule — import the module itself for the mode controls
+    flash = importlib.import_module(
+        "paddle_tpu.ops.pallas.flash_attention")
+    from paddle_tpu.perf import analytic as perf_analytic
+    from paddle_tpu.quant import kv as quant_kv
+    from paddle_tpu.quant import weights as quant_weights
+
+    b = batch
+    max_len = 2 * tp
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=1, d_model=d_model, dff=dff,
+                              enc_layers=layers, dec_layers=0,
+                              max_len=max_len, num_heads=heads)
+    qparams = quant_weights.quantize_lm(params)
+    dkv = int(quant_weights.weight_shape(
+        params["enc"][0]["attn"]["wk"])[1])
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(1, vocab, (b, tp)).astype(np.int32)
+    lens = rng.randint(tp // 2, tp + 1, b).astype(np.int32)
+
+    def staged(p, mode):
+        with flash.forced_prefill_quant_mode(mode):
+            def fn(pp, toks):
+                h, cache = transformer.lm_prefill(pp, toks, max_len,
+                                                  heads,
+                                                  kv_dtype="int8")
+                return h, cache
+            return jax.jit(fn).lower(p, tokens)
+
+    def predicted_bytes():
+        b_f32 = perf_analytic.predicted_prefill_bytes(
+            params, b, tp, heads, "float32")
+        b_i8 = perf_analytic.predicted_prefill_bytes(
+            qparams, b, tp, heads, "int8")
+        return {"predicted_prefill_bytes_f32": b_f32,
+                "predicted_prefill_bytes_i8": b_i8,
+                "predicted_prefill_bytes_reduction":
+                    round(1 - b_i8 / b_f32, 4)}
+
+    def postcheck(compiled):
+        """The prefill quantization gates (see the factory docstring) —
+        every detector also proven to fire on its widening/fp32 twin."""
+        txt = compiled.as_text()
+        perf_analytic.assert_prefill_kv_quantized(txt, b, tp, dkv)
+        shapes = quant_weights.quantized_weight_shapes(qparams)
+        floats = quant_weights.float_leaf_shapes(qparams)
+        perf_analytic.assert_weights_quantized(txt, shapes, floats)
+        f32_hlo = staged(params, "off").compile().as_text()
+        try:
+            perf_analytic.assert_weights_quantized(f32_hlo, shapes,
+                                                   floats)
+        except AssertionError:
+            pass
+        else:
+            raise AssertionError(
+                "weights-quantized gate failed to flag the fp32 "
+                "prefill — the detector is broken")
+        ref_hlo = staged(qparams, "off").compile().as_text()
+        ref_hits = perf_analytic.widened_prefill_kv_instrs(
+            ref_hlo, b, tp, dkv)
+        if not ref_hits:
+            raise AssertionError(
+                "widened-prefill gate failed to flag the kernel-off "
+                "int8 reference prefill — the detector is broken")
+        out = predicted_bytes()
+        if out["predicted_prefill_bytes_reduction"] < 0.35:
+            raise AssertionError(
+                f"int8 predicted prefill bytes shrink only "
+                f"{out['predicted_prefill_bytes_reduction']:.1%} "
+                "(< the 35% acceptance bar)")
+        out.update(prefill_kv_quantized_proof="pass",
+                   weights_quantized_proof="pass",
+                   widened_prefill_instrs_reference=len(ref_hits))
+        return out
+
+    extras = {"lower": lambda: staged(qparams, "always"),
+              "postcheck": postcheck}
+
+    def prefill(p, mode):
+        with flash.forced_prefill_quant_mode(mode):
+            h, _cache = jax.jit(lambda pp, t: transformer.lm_prefill(
+                pp, t, max_len, heads, kv_dtype="int8"))(p, tokens)
+        return h
+
+    if os.environ.get("BENCH_ANALYTIC_BUILD") != "1":
+        # quality: quantized prefill (int8 KV + weights + kernel) vs
+        # the fp32 twin, max |logit err| per stream over the VALID
+        # positions of mixed-length prompts — the committed budget
+        h32, _ = transformer.lm_prefill(params, tokens, max_len, heads)
+        l32 = transformer._lm_project(params, h32)
+        lq = transformer._lm_project(qparams, prefill(qparams, "always"))
+        per_stream = quant_kv.logit_err(l32, lq, lens)
+        # kernel-vs-reference: the SAME int8 cache attended through the
+        # quant kernel vs the widen-then-flash reference path
+        lref = transformer._lm_project(qparams, prefill(qparams, "off"))
+        kernel_err = float(quant_kv.logit_err(lref, lq, lens).max())
+        if float(per_stream.max()) > quant_kv.LOGIT_ERR_BUDGET:
+            raise AssertionError(
+                f"quantized prefill logit error {per_stream.max():.4f} "
+                f"exceeds the committed budget "
+                f"{quant_kv.LOGIT_ERR_BUDGET}")
+        extras.update(
+            streams_in_logit_budget=int(
+                (per_stream <= quant_kv.LOGIT_ERR_BUDGET).sum()),
+            n_streams=b,
+            max_logit_err=round(float(per_stream.max()), 4),
+            kernel_vs_reference_max_err=round(kernel_err, 6),
+            logit_err_budget=quant_kv.LOGIT_ERR_BUDGET,
+            **predicted_bytes())
+
+    fn = jax.jit(lambda pp, t: transformer.lm_prefill(
+        pp, t, max_len, heads, kv_dtype="int8")[0])
+
+    def run(_s):
+        with flash.forced_prefill_quant_mode("always"):
+            return fn(qparams, tokens)
+
+    per_tok = layers * (6.0 * d_model ** 2 + 2.0 * d_model * dff)
+    attn = layers * 4.0 * d_model * tp * tp / 2
+    flops = (2.0 * per_tok * tp + attn) * b
+    return run, flops, None, (
+        f"int8 flash prefill ms/batch ({b} prompts x {tp} positions, "
+        f"int8 KV + int8 weights, quant kernel forced)"), extras
+
+
+def bench_trainer_int8(batch=64, dim=64, hidden=128, n_batches=24,
+                       seed=0):
+    """Int8 weight-streaming training (trainer/trainer.py
+    ``SGD(quant_weights=True)``; docs/perf.md "Int8 weight-streaming
+    trainer"): the jitted step is fed the {master f32, q int8+scale}
+    bundle, dequantizes at the matmul boundary, applies grads to the
+    f32 masters and requantizes in-step — so the int8 tree, not a
+    widened f32 copy, is what persists across steps.
+
+    The analytic leg is the acceptance bar (capture runs
+    extras["postcheck"] on extras["lower"] — the quant-mode
+    ``lower_step``): every quantized weight enters the compiled step as
+    an s8 ENTRY parameter with the f32 float params limited to the
+    step's own legitimate leaves (masters + optimizer state), and the
+    plain-f32 twin step must FAIL the same gate.  The quality leg
+    trains the int8 trainer and its f32 twin from identical inits on
+    identical batches and bounds the max per-step relative loss gap
+    under the COMMITTED budget (quant/weights.TRAIN_LOSS_BUDGET)."""
+    import jax
+    import paddle_tpu.layers as L
+    from paddle_tpu import optim
+    from paddle_tpu.data import dense_vector, integer_value
+    from paddle_tpu.data.feeder import DataFeeder
+    from paddle_tpu.layers.graph import reset_names
+    from paddle_tpu.perf import analytic as perf_analytic
+    from paddle_tpu.quant import weights as quant_weights
+    from paddle_tpu.trainer.trainer import SGD
+
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n_batches, batch, dim).astype(np.float32)
+    ys = (xs.sum(-1) > 0).astype(np.int64)
+    feeding = {"x": dense_vector(dim), "lab": integer_value(2)}
+    feeder = DataFeeder(feeding)
+
+    def build(quant):
+        reset_names()
+        x = L.data_layer("x", size=dim)
+        lab = L.data_layer("lab", size=1)
+        h = L.fc_layer(x, size=hidden, act="tanh")
+        y = L.fc_layer(h, size=2, act="softmax")
+        cost = L.classification_cost(y, lab)
+        return SGD(cost=cost,
+                   update_equation=optim.Momentum(learning_rate=0.01,
+                                                  momentum=0.9),
+                   quant_weights=quant, quant_min_size=1024)
+
+    tr = build(True)
+    assert tr._qtree, "the int8 trainer must quantize the fc weights"
+
+    def batches():
+        for i in range(n_batches):
+            yield [(xs[i, j], int(ys[i, j])) for j in range(batch)]
+
+    def postcheck(compiled):
+        """The weight-streaming structural gate (see the factory
+        docstring) — also proven to fire on the plain-f32 twin."""
+        txt = compiled.as_text()
+        shapes = [quant_weights.weight_shape(l)
+                  for l in tr._qtree.values()]
+        floats = [np.shape(l) for l in jax.tree_util.tree_leaves(
+                      (tr.parameters, tr.opt_state, tr.model_state))
+                  if hasattr(l, "dtype")
+                  and np.issubdtype(l.dtype, np.floating)]
+        perf_analytic.assert_weights_quantized(txt, shapes, floats)
+        f32_hlo = build(False).lower_step(
+            feeder.feed_specs(batch)[0]).compile().as_text()
+        try:
+            perf_analytic.assert_weights_quantized(f32_hlo, shapes,
+                                                   floats)
+        except AssertionError:
+            pass
+        else:
+            raise AssertionError(
+                "weights-quantized gate failed to flag the plain f32 "
+                "train step — the detector is broken")
+        return {"weights_quantized_proof": "pass",
+                "quantized_weight_shapes": [list(s) for s in shapes]}
+
+    extras = {"lower": lambda: tr.lower_step(feeder.feed_specs(batch)[0]),
+              "postcheck": postcheck}
+
+    if os.environ.get("BENCH_ANALYTIC_BUILD") != "1":
+        f32 = build(False)
+        gaps, qcost = [], None
+        for bat in batches():
+            qcost = float(tr.train_one_batch(bat, feeder))
+            fcost = float(f32.train_one_batch(bat, feeder))
+            gaps.append(abs(qcost - fcost) / max(abs(fcost), 1.0))
+        gap = max(gaps)
+        if gap > quant_weights.TRAIN_LOSS_BUDGET:
+            raise AssertionError(
+                f"int8 trainer loss gap {gap:.4f} exceeds the "
+                f"committed budget {quant_weights.TRAIN_LOSS_BUDGET}")
+        # bytes the FORWARD streams: the int8 tree replaces its f32
+        # masters on the matmul path (masters stay optimizer-side and
+        # are touched only by the update, like any opt-state slot)
+        f32_w = quant_weights.param_bytes(tr.parameters)
+        q_displaced = sum(
+            int(np.prod(quant_weights.weight_shape(l))) * 4
+            for l in tr._qtree.values())
+        extras.update(
+            loss_gap_max=round(gap, 5),
+            loss_gap_budget=quant_weights.TRAIN_LOSS_BUDGET,
+            final_loss_int8=round(qcost, 5),
+            steps_compared=n_batches,
+            fwd_weight_bytes_f32=f32_w,
+            fwd_weight_bytes_int8=f32_w - q_displaced
+            + quant_weights.param_bytes(tr._qtree))
+
+    def run(_s):
+        i = rng.randint(n_batches)
+        return tr.train_one_batch(
+            [(xs[i, j], int(ys[i, j])) for j in range(batch)], feeder)
+
+    flops = 3.0 * 2.0 * (dim * hidden + hidden * 2) * batch
+    return run, flops, None, (
+        f"int8 weight-streaming trainer ms/batch bs={batch} "
+        f"(master+q bundle, in-step requantize)"), extras
 
 
 def bench_serving_speculative(slots=8, n_requests=32, vocab=256,
@@ -3323,6 +3594,18 @@ _BENCHES = {
     # step-bytes reduction gate; b = the fp32 slot count (int8 engines
     # get 2*b slots over the same bytes)
     "serving_quant": (lambda b: bench_serving_quant(slots=b), 8),
+    # int8 flash prefill (ops/pallas/flash_attention_quant): the batched
+    # causal prefill streaming int8 K/V bytes + scale sidecars straight
+    # into the kernel vs the widen-to-f32 reference, the no-widened-
+    # convert proof both directions, and the >= 35% predicted
+    # prefill-bytes reduction gate; b = the prompt-batch size
+    "serving_quant_prefill": (lambda b: bench_serving_quant_prefill(
+        batch=b), 8),
+    # int8 weight-streaming trainer (SGD(quant_weights=True)): the
+    # {master, q} bundle step with in-step requantize, the s8-entry-
+    # params proof both directions, and the committed loss-parity
+    # budget vs the f32 twin; b = the batch size
+    "trainer_int8": (lambda b: bench_trainer_int8(batch=b), 64),
     # speculative decoding (serving/speculative.py): draft-ahead +
     # chunk-kernel verify vs the same chunked engine without a draft at
     # 8/32 clients, the adversarial >= 1 token/step floor, and the

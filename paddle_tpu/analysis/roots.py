@@ -51,11 +51,13 @@ FAMILIES = [
     ("serving_decode_fused", "serving_decode_fused", None),
     ("serving_chunked_prefill", "serving_chunked_prefill", None),
     ("serving_quant", "serving_quant", None),
+    ("serving_quant_prefill", "serving_quant_prefill", None),
     ("serving_speculative", "serving_speculative", None),
     ("serving_sharded", "serving_sharded", None),
     ("serving_kv_spill", "serving_kv_spill", None),
     ("serving_disagg", "serving_disagg", None),
     ("trainer_prefetch", "trainer_prefetch", None),
+    ("trainer_int8", "trainer_int8", None),
 ]
 
 
@@ -166,6 +168,23 @@ JIT_ROOTS = {r.name: r for r in [
          static_args=("scale", "causal", "block_q", "block_k",
                       "interpret"),
          note="flash prefill kernel (pallas_prefill routing)"),
+    Root("flash_attention_quant",
+         "paddle_tpu.ops.pallas.flash_attention:flash_attention_quant",
+         static_args=("num_heads", "scale", "causal", "block_q",
+                      "block_k", "interpret"),
+         note="int8 flash prefill kernel (pallas_prefill_quant "
+              "routing): int8 K/V + per-(position, head) scale "
+              "sidecars stream block-by-block, widen in registers"),
+    # ---- int8 weight-streaming train step (SGD quant_weights=True):
+    # a SEPARATE closure from dense_step — the {master, q} bundle step
+    # with the in-step requantize
+    Root("trainer_quant_step",
+         "paddle_tpu.trainer.trainer:"
+         "SGD._build_step.<locals>.quant_step",
+         static_args=(),
+         note="the int8 weight-streaming train step (dequant at the "
+              "matmul boundary, f32 masters optimizer-side, in-step "
+              "requantize)"),
 ]}
 
 
@@ -210,6 +229,12 @@ FAMILY_ROOTS = {
                                 "flash_attention"),
     "serving_quant": ("decode_engine_step", "lm_decode_step_paged",
                       "decode_attention_paged", "lm_prefill"),
+    # serving_quant_prefill lowers the int8-KV lm_prefill with the
+    # quant kernel forced ON — the per-layer seam dispatches into
+    # flash_attention_quant (the f32 twin it gates falls back through
+    # flash_attention).
+    "serving_quant_prefill": ("lm_prefill", "flash_attention_quant",
+                              "flash_attention"),
     "serving_speculative": ("decode_engine_step", "draft_rollout",
                             "lm_decode_chunk_slots",
                             "lm_decode_chunk_paged",
@@ -253,6 +278,10 @@ FAMILY_ROOTS = {
                        "decode_attention_paged_chunk",
                        "flash_attention"),
     "trainer_prefetch": ("trainer_step",),
+    # trainer_int8 lowers SGD(quant_weights=True).lower_step — the
+    # quant_step closure (NOT dense_step) wrapped by the same
+    # trace-counting `step`.
+    "trainer_int8": ("trainer_step", "trainer_quant_step"),
 }
 
 
@@ -266,6 +295,7 @@ TRACE_TIME_FLAGS = frozenset({
     "pallas_decode",
     "pallas_decode_block_k",
     "pallas_prefill",
+    "pallas_prefill_quant",
 })
 
 

@@ -749,31 +749,44 @@ def lm_prefill(params, prompt, max_len, num_heads=8, moe_top_k=2,
             q = _rope_flat(q, jnp.arange(tp), dh)
         hkv = k.shape[-1] // dh
         k_set, v_set, sk, sv = _kv_writes(c, k, v)
-        if sk is not None:
-            # quantize-on-write + attend over the round trip: position
-            # p's K/V is quantized BEFORE any later position attends it,
-            # so the batched pass equals sequential quantized steps
-            k, v = _kv_view(k_set, sk), _kv_view(v_set, sv)
-        split = lambda a, hh: a.reshape(b, tp, hh, dh).transpose(
-            0, 2, 1, 3)
-        # batched causal pass: the pallas_prefill flag (trace-time, like
-        # pallas_decode) routes it through ops/pallas/flash_attention —
-        # O(Tp) HBM, no [Tp, Tp] score matrix (perf/analytic.py's
-        # prefill-flash gate pins its absence).  The CPU tier-1 default
-        # stays the masked XLA reference so greedy bit-identity
-        # discipline is untouched; flash_attention itself falls back on
-        # shapes its blocking cannot cover.
         import importlib
         # importlib: the ops.pallas package re-exports the
         # flash_attention FUNCTION, shadowing the submodule attribute
         _flash_mod = importlib.import_module(
             "paddle_tpu.ops.pallas.flash_attention")
-        att = attn_ops.dot_product_attention(
-            split(q, num_heads),
-            attn_ops.repeat_kv_heads(split(k, hkv), num_heads),
-            attn_ops.repeat_kv_heads(split(v, hkv), num_heads),
-            causal=True, use_flash=_flash_mod.prefill_flash_enabled())
-        att = att.transpose(0, 2, 1, 3).reshape(b, tp, d)
+        # int8 caches first try the quant flash kernel (the
+        # pallas_prefill_quant trace-time routing): the just-quantized
+        # int8 bytes + scale sidecars stream straight into the kernel,
+        # widened in registers — no dequantized f32 [Tp, Dkv] buffer
+        # (perf/analytic.assert_prefill_kv_quantized pins its absence).
+        # The quantization math above is IDENTICAL either way, so the
+        # cache stays bit-exact to sequential quantized steps on every
+        # path.
+        att = _flash_mod.maybe_prefill_quant(q, k_set, v_set, sk, sv,
+                                             num_heads)
+        if att is None:
+            if sk is not None:
+                # quantize-on-write + attend over the round trip:
+                # position p's K/V is quantized BEFORE any later
+                # position attends it, so the batched pass equals
+                # sequential quantized steps
+                k, v = _kv_view(k_set, sk), _kv_view(v_set, sv)
+            split = lambda a, hh: a.reshape(b, tp, hh, dh).transpose(
+                0, 2, 1, 3)
+            # batched causal pass: the pallas_prefill flag (trace-time,
+            # like pallas_decode) routes it through
+            # ops/pallas/flash_attention — O(Tp) HBM, no [Tp, Tp] score
+            # matrix (perf/analytic.py's prefill-flash gate pins its
+            # absence).  The CPU tier-1 default stays the masked XLA
+            # reference so greedy bit-identity discipline is untouched;
+            # flash_attention itself falls back on shapes its blocking
+            # cannot cover.
+            att = attn_ops.dot_product_attention(
+                split(q, num_heads),
+                attn_ops.repeat_kv_heads(split(k, hkv), num_heads),
+                attn_ops.repeat_kv_heads(split(v, hkv), num_heads),
+                causal=True, use_flash=_flash_mod.prefill_flash_enabled())
+            att = att.transpose(0, 2, 1, 3).reshape(b, tp, d)
         x = x + linear.matmul(att, blk["attn"]["wo"])
         x = x + _block_ffn(blk, _ln(blk["ln2"], x), moe_top_k)[0]
         upd = lambda buf, val: jax.lax.dynamic_update_slice_in_dim(

@@ -322,6 +322,26 @@ def _bwd(scale, causal, blk_q, blk_k, interpret, res, g):
 
 # -------------------------------------------------------------- public API
 
+def _tileable(n):
+    # _lanes() can slice (n < _LANES) or tile (n % _LANES == 0)
+    return n <= _LANES or n % _LANES == 0
+
+
+def _pick_block(want, n, sublane=8):
+    """Largest b <= want that divides n, is sublane-divisible and
+    lane-tileable; halve from `want` so a 128-multiple sequence that is
+    not a 512-multiple (e.g. T=640) still gets the flash path with
+    smaller blocks instead of the materialized-O(T^2) fallback.
+    ``sublane``: 8 for f32 operands, 32 for int8 K/V (the s8 VMEM tile
+    is (32, 128)) — the decode-side `_pick_block_k` convention."""
+    b = min(want, n)
+    while b >= sublane:
+        if n % b == 0 and b % sublane == 0 and _tileable(b):
+            return b
+        b //= 2
+    return None
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_bhtd(q, k, v, scale, causal, blk_q, blk_k, interpret):
     o, _ = _fwd(q, k, v, scale, causal, blk_q, blk_k, interpret)
@@ -354,22 +374,6 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=512,
     tk = k.shape[2]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
-    def _tileable(n):
-        # _lanes() can slice (n < _LANES) or tile (n % _LANES == 0)
-        return n <= _LANES or n % _LANES == 0
-
-    def _pick_block(want, n):
-        """Largest b <= want that divides n, is 8-sublane-divisible and
-        lane-tileable; halve from `want` so a 128-multiple sequence that is
-        not a 512-multiple (e.g. T=640) still gets the flash path with
-        smaller blocks instead of the materialized-O(T^2) fallback."""
-        b = min(want, n)
-        while b >= 8:
-            if n % b == 0 and b % 8 == 0 and _tileable(b):
-                return b
-            b //= 2
-        return None
-
     blk_q = _pick_block(block_q, tq)
     blk_k = _pick_block(block_k, tk)
 
@@ -386,3 +390,227 @@ def flash_attention(q, k, v, scale=None, causal=False, block_q=512,
     vf = v.reshape(b * h, tk, d)
     o = _flash_bhtd(qf, kf, vf, scale, causal, blk_q, blk_k, interpret)
     return o.reshape(b, h, tq, d)
+
+
+# ----------------------------------------- int8 K/V forward (quant prefill)
+#
+# The decode kernels' quant contract (ops/pallas/decode_attention.py),
+# applied to the batched prefill pass: int8 K/V blocks plus their
+# per-(position, KV-head) f32 scale sidecars ride the SAME block-indexed
+# DMA stream as the values, and widening happens in REGISTERS —
+# `k_i8.astype(f32) * scale` right before the qk dot, elementwise
+# identical to quant/kv.dequantize_heads — so no f32 [Tp, Dkv] K/V
+# buffer ever exists in HBM (perf/analytic.assert_prefill_kv_quantized
+# pins its absence structurally).  Forward-only: prefill is inference;
+# the training path keeps the f32 custom_vjp kernel above.
+#
+# GQA is handled by the index maps, not by widening: the grid carries the
+# QUERY head h, and the K/V/scale BlockSpecs select kv-head h//group's
+# dh-column stripe (block-unit indexing on the flat [B, Tk, Dkv] cache
+# buffer), so repeat_kv_heads never materializes.
+
+# test/bench override for the pallas_prefill_quant flag: None = read
+# FLAGS, else "auto" | "always" | "off" — same trace-time contract as
+# PREFILL_MODE above.
+PREFILL_QUANT_MODE = None
+
+
+def _prefill_quant_mode():
+    if PREFILL_QUANT_MODE is not None:
+        return PREFILL_QUANT_MODE
+    from paddle_tpu.utils.flags import FLAGS
+    return getattr(FLAGS, "pallas_prefill_quant", "auto")
+
+
+@contextlib.contextmanager
+def forced_prefill_quant_mode(mode):
+    """Temporarily force the int8-prefill kernel routing ("always" |
+    "off" | "auto") — tests, the analytic gate, and the A/B bench.
+    Trace-time: wrap the jit/lower call, not just the execution."""
+    global PREFILL_QUANT_MODE
+    old = PREFILL_QUANT_MODE
+    PREFILL_QUANT_MODE = mode
+    try:
+        yield
+    finally:
+        PREFILL_QUANT_MODE = old
+
+
+def prefill_quant_enabled():
+    """True when ``lm_prefill(kv_dtype="int8")``'s batched causal pass
+    should stream the int8 cache bytes through ``flash_attention_quant``
+    instead of dequantizing to a widened f32 K/V first (read at trace
+    time by ``models/transformer``).  "auto" follows use_pallas() — the
+    CPU tier-1 default stays the dequant + masked XLA reference path,
+    preserving the batched-vs-sequential bit-exactness discipline."""
+    m = str(_prefill_quant_mode()).lower()
+    if m in ("0", "off", "false", "no"):
+        return False
+    if m in ("1", "on", "always", "true", "yes"):
+        return True
+    if m != "auto":
+        raise ValueError(f"pallas_prefill_quant={m!r} (takes auto | "
+                         "always | off)")
+    from paddle_tpu.ops import pallas as pk
+    return pk.use_pallas()
+
+
+def _fwd_quant_kernel(q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+                      m_scr, l_scr, acc_scr, *, blk_q, blk_k, scale,
+                      causal):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+    d = q_ref.shape[-1]
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    needed = (qi * blk_q + blk_q - 1 >= ki * blk_k) if causal else True
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        # widen in registers: int8 block * per-(position, head) scale
+        # column — the exact dequantize_heads product, so the kernel is
+        # bit-identical to flash over the dequantized widened twin
+        k = k_ref[0].astype(jnp.float32) * ks_ref[0]   # [blk_k, dh]
+        v = v_ref[0].astype(jnp.float32) * vs_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [blk_q, blk_k]
+        if causal:
+            rows = jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0) + qi * blk_q
+            cols = jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1) + ki * blk_k
+            s = jnp.where(rows >= cols, s, _NEG)
+        m_prev, l_prev = m_scr[:], l_scr[:]          # [blk_q, _LANES]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - _lanes(m_new, blk_k))
+        alpha = jnp.exp(m_prev - m_new)              # [blk_q, _LANES]
+        m_scr[:] = m_new
+        l_scr[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * _lanes(alpha, d) + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / _lanes(l, d)).astype(o_ref.dtype)
+
+
+def flash_attention_quant(q, k, v, kscale, vscale, num_heads, scale=None,
+                          causal=True, block_q=512, block_k=512,
+                          interpret=None):
+    """Int8-K/V flash prefill: q [B, Tq, D] f32 (flat projection), k/v
+    [B, Tk, Dkv] int8 (the cache layout), kscale/vscale [B, Tk, Hkv]
+    f32 per-(position, KV-head) sidecars -> [B, H, Tq, dh].
+
+    The sidecars ride the same block-indexed stream as the int8 values
+    (each k block pairs with its [blk_k, 1] scale column); widening is
+    in-register.  Per-head the K/V stripe is re-streamed (grid is
+    (B, H, Tq/blk, Tk/blk)) — the honest CostEstimate below — still
+    ~4x fewer KV bytes than a widened f32 stream at dh=128.
+
+    Shape contract (the caller pre-checks via ``prefill_quant_covers``):
+    blocks divide Tq/Tk, dh lane-tileable, compiled mode wants
+    32-sublane int8 k-tiles; interpret mode takes any divisor."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, tq, d = q.shape
+    tk, dkv = k.shape[1], k.shape[2]
+    from paddle_tpu.ops.pallas import decode_attention as _dk
+    hs = _dk._head_split(d, dkv, num_heads)
+    if hs is None:
+        raise ValueError(
+            f"flash_attention_quant: d={d}, dkv={dkv} do not describe a "
+            f"grouped-head layout for num_heads={num_heads}")
+    dh, hkv, group = hs
+    if not _dk._check_scales("flash_attention_quant", kscale, vscale,
+                             (b, tk), hkv):
+        raise ValueError("flash_attention_quant: scale sidecars required")
+    if k.dtype != jnp.int8 or v.dtype != jnp.int8:
+        raise ValueError(
+            f"flash_attention_quant: k/v must be int8, got "
+            f"{k.dtype}/{v.dtype}")
+    scale = scale if scale is not None else 1.0 / math.sqrt(dh)
+    blk_q = _pick_block(block_q, tq)
+    blk_k = _pick_block(block_k, tk, sublane=8 if interpret else 32)
+    if blk_q is None or blk_k is None or not _tileable(dh) \
+            or (causal and tq != tk):
+        raise ValueError(
+            f"flash_attention_quant: uncoverable shape tq={tq} tk={tk} "
+            f"dh={dh} (use prefill_quant_covers before calling)")
+
+    qh = q.reshape(b, tq, num_heads, dh).transpose(0, 2, 1, 3)
+    kernel = functools.partial(_fwd_quant_kernel, blk_q=blk_q,
+                               blk_k=blk_k, scale=scale, causal=causal)
+    kv_map = lambda bb, hh, i, j: (bb, j, hh // group)
+    o = pl.pallas_call(
+        kernel,
+        grid=(b, num_heads, tq // blk_q, tk // blk_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, blk_q, dh),
+                         lambda bb, hh, i, j: (bb, hh, i, 0)),
+            pl.BlockSpec((1, blk_k, dh), kv_map),
+            pl.BlockSpec((1, blk_k, dh), kv_map),
+            pl.BlockSpec((1, blk_k, 1), kv_map),
+            pl.BlockSpec((1, blk_k, 1), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, blk_q, dh),
+                               lambda bb, hh, i, j: (bb, hh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, num_heads, tq, dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, _LANES), jnp.float32),
+            pltpu.VMEM((blk_q, _LANES), jnp.float32),
+            pltpu.VMEM((blk_q, dh), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            # 2 matmuls (qk, pv), per-head KV re-stream of int8 bytes +
+            # f32 scale column, q in + o out
+            flops=2 * 2 * b * num_heads * tq * tk * dh,
+            bytes_accessed=(b * num_heads * 2 * tk * (dh * 1 + 4)
+                            + 2 * b * num_heads * tq * dh * 4),
+            transcendentals=b * num_heads * tq * tk),
+        interpret=interpret,
+    )(qh, k, v, kscale, vscale)
+    return o
+
+
+def prefill_quant_covers(b, tq, tk, d, dkv, num_heads, interpret,
+                         block_q=512, block_k=512):
+    """True when flash_attention_quant's blocking covers the shape —
+    the dispatch predicate (decode_attention.covers's twin)."""
+    from paddle_tpu.ops.pallas import decode_attention as _dk
+    hs = _dk._head_split(d, dkv, num_heads)
+    if hs is None:
+        return False
+    dh, _, _ = hs
+    if not _tileable(dh) or tq != tk:
+        return False
+    return (_pick_block(block_q, tq) is not None
+            and _pick_block(block_k, tk,
+                            sublane=8 if interpret else 32) is not None)
+
+
+def maybe_prefill_quant(q, k_set, v_set, sk, sv, num_heads):
+    """lm_prefill's int8 dispatch: q [B, Tp, D] f32, k_set/v_set
+    [B, Tp, Dkv] int8 (the just-quantized cache writes), sk/sv
+    [B, Tp, Hkv] scales -> attention output [B, Tp, D], or None when
+    the routing is off / the shape is uncoverable (caller falls back to
+    the dequant + masked XLA reference path)."""
+    if sk is None or not prefill_quant_enabled():
+        return None
+    interpret = jax.default_backend() != "tpu"
+    b, tp, d = q.shape
+    dkv = k_set.shape[-1]
+    if not prefill_quant_covers(b, tp, tp, d, dkv, num_heads, interpret):
+        return None
+    o = flash_attention_quant(q, k_set, v_set, sk, sv, num_heads,
+                              causal=True, interpret=interpret)
+    return o.transpose(0, 2, 1, 3).reshape(b, tp, d)

@@ -222,6 +222,56 @@ def assert_kv_quantized(hlo_text, num_rows, t_span, dkv):
             + "\n  ".join(hits[:4]))
 
 
+def widened_prefill_kv_instrs(hlo_text, b, tp, dkv):
+    """``convert`` instructions that widen the WHOLE just-quantized
+    prefill cache back to float: an f32-result convert with an s8
+    operand holding exactly ``b * tp * dkv`` elements and leading with
+    ``b``.  The int8-KV reference prefill dequantizes each layer's full
+    K and V set (``_kv_view``) into exactly such a buffer before
+    attending; ``flash_attention_quant`` widens int8 blocks in
+    registers, so with it engaged NO such convert may exist.  (The
+    quantize direction never matches — those converts RESULT in s8; the
+    in-kernel interpret-mode converts never match — they are
+    block-shaped, leading with 1, holding blk_k * dh < b * tp * dkv
+    elements.)  Returns the offending lines."""
+    import re
+    from paddle_tpu.perf import cost as _cost
+    target = int(b) * int(tp) * int(dkv)
+    shape_re = re.compile(r"^f32\[([0-9,]+)\]")
+    hits = []
+    for line in hlo_text.splitlines():
+        m = _cost._INSTR_RE.match(line)
+        if not m:
+            continue
+        rhs = m.group(1)
+        if _cost._op_of(rhs) != "convert" or "s8[" not in rhs:
+            continue
+        sm = shape_re.match(rhs)
+        if not sm:
+            continue
+        shape = [int(d) for d in sm.group(1).split(",")]
+        n = 1
+        for d in shape:
+            n *= d
+        if shape[0] == int(b) and n == target:
+            hits.append(line.strip())
+    return hits
+
+
+def assert_prefill_kv_quantized(hlo_text, b, tp, dkv):
+    """Raise AssertionError when an int8-KV batched prefill HLO still
+    widens the whole per-layer cache into a float [b, tp, dkv]-element
+    buffer (``flash_attention_quant`` was supposed to stream the int8
+    bytes and widen block-by-block in registers)."""
+    hits = widened_prefill_kv_instrs(hlo_text, b, tp, dkv)
+    if hits:
+        raise AssertionError(
+            f"int8-KV prefill widens the whole cache into float "
+            f"[{b}, {tp}, {dkv}]-element buffers before attending — "
+            f"the quantized flash prefill did not engage:\n  "
+            + "\n  ".join(hits[:4]))
+
+
 def entry_param_types(hlo_text):
     """(dtype, dims-tuple) of every ENTRY parameter, parsed from the
     module's ``entry_computation_layout`` — the program's resident
@@ -306,6 +356,43 @@ def predicted_decode_step_bytes(params, s, t_span, num_heads,
     acts = layers * 2 * s * d * 4          # residual stream in/out
     io = s * 4 + s * vocab * 4             # ids in, logits out
     return qw.param_bytes(params) + kv_read + kv_write + acts + io
+
+
+def predicted_prefill_bytes(params, b, tp, num_heads,
+                            kv_dtype="float32"):
+    """First-principles HBM traffic of ONE batched causal prefill of
+    ``b`` prompts x ``tp`` positions — the serving_quant_prefill bytes
+    model, ``predicted_decode_step_bytes``'s ingestion-side twin.
+
+    Terms: every trunk weight as STORED (int8 data + f32 scales for a
+    quantized tree), each layer's freshly written K/V set streamed back
+    through attention once per QUERY head (the flash kernels' declared
+    stream — GQA re-reads the kv head's stripe per group member; int8
+    streams 1 byte/value + the f32 per-(position, head) scale sidecar
+    per block row, f32 streams 4), the per-position K/V cache write as
+    stored, the inter-layer activations, and the ids-in / hidden-out
+    io.  The int8 win the >= 35% acceptance bar gates: the attention
+    re-stream — the term that grows with Tp^0 * heads — drops ~4x, and
+    the cache write drops ~4x, while weights (int8 tree) drop ~4x too.
+    (The XLA-CPU cost model cannot show any of this: it materializes
+    the widened converts the quant kernel keeps in registers.)"""
+    from paddle_tpu.quant import kv as kvq
+    from paddle_tpu.quant import weights as qw
+    enc = params["enc"]
+    layers = len(enc)
+    _vocab, d = qw.weight_shape(params["src_emb"])
+    dkv = qw.weight_shape(enc[0]["attn"]["wk"])[1]
+    dh = d // num_heads
+    hkv = dkv // dh
+    # per query head, per position: int8 value bytes + the f32 scale
+    # rides the same block stream (flash_attention_quant CostEstimate)
+    per_pos = (dh * 1 + 4) if kv_dtype == "int8" else dh * 4
+    kv_stream = layers * 2 * b * num_heads * tp * per_pos
+    kv_write = layers * b * tp * kvq.kv_bytes_per_position(
+        dkv, hkv, kv_dtype)
+    acts = layers * 2 * b * tp * d * 4     # residual stream in/out
+    io = b * tp * 4 + b * tp * d * 4       # ids in, hidden out
+    return qw.param_bytes(params) + kv_stream + kv_write + acts + io
 
 
 def predicted_spec_bytes_per_token(layers, d, dff, vocab, s, t_span,
@@ -613,6 +700,7 @@ def capture(name, model, batch=None, chips=("v5e", "v5p")):
                  "serving_fleet", "serving_paged",
                  "serving_decode_fused", "serving_autoscale",
                  "serving_chunked_prefill", "serving_quant",
+                 "serving_quant_prefill",
                  "serving_speculative", "serving_sharded",
                  "serving_kv_spill", "serving_disagg"):
         # the lowered program is one batch/slab step while the bench FLOPs

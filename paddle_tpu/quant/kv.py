@@ -31,6 +31,8 @@ clip, convert, multiply by 1.0) — tests/test_quant.py pins it, so the
 quantize/dequant math itself is proven bias-free.
 """
 
+import numpy as np
+
 import jax.numpy as jnp
 
 KV_DTYPES = ("float32", "int8")
@@ -94,6 +96,24 @@ def greedy_prefix_len(a, b):
             break
         n += 1
     return n
+
+
+def logit_err(ref_logits, logits, lens=None):
+    """Per-stream max |logit error| of a quantized forward against its
+    fp32 twin — THE comparison the LOGIT_ERR_BUDGET is defined over,
+    shared by tests/test_quant.py, the serving_quant* benches and the
+    ``--smoke-quant*`` phases so every consumer measures the same
+    thing.  ``ref_logits``/``logits``: [..., T, vocab]; ``lens``
+    (optional, [...]): valid positions per stream — padded tail
+    positions are masked out of the max.  Returns the per-stream max
+    as an ndarray (one value per leading index)."""
+    err = np.abs(np.asarray(ref_logits, np.float32)
+                 - np.asarray(logits, np.float32)).max(axis=-1)
+    if lens is not None:
+        t = err.shape[-1]
+        valid = np.arange(t) < np.asarray(lens)[..., None]
+        err = np.where(valid, err, 0.0)
+    return err.max(axis=-1)
 
 
 def kv_bytes_per_position(dkv, hkv, kv_dtype):

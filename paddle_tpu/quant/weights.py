@@ -102,6 +102,34 @@ def quantize_lm(params, min_size=1024):
     return out
 
 
+def quantize_tree(params, min_size=1024):
+    """``quantize_lm`` for a GENERIC params pytree (the trainer's int8
+    weight-streaming mode): every 2-D f32 leaf with >= ``min_size``
+    elements becomes a ``{"q", "s"}`` pair, everything else passes
+    through.  No ``pos`` special case — a topology params dict has no
+    reserved keys.  Deterministic (round-half-to-even, clip), so
+    requantizing the same masters always rebuilds the same tree —
+    kill-9 resume bit-identity rides on it."""
+
+    def q(x):
+        if getattr(x, "dtype", None) != jnp.float32 or x.ndim != 2 \
+                or int(np.prod(x.shape)) < min_size:
+            return x
+        return quantize_leaf(x)
+
+    return jax.tree_util.tree_map(q, params)
+
+
+# Committed training-quality budget for the int8 weight-streaming step
+# (tests/test_trainer_quant.py, bench trainer_int8, --smoke-quant-prefill):
+# max per-step |loss_int8 - loss_f32| / max(|loss_f32|, 1) over a short
+# run on the shared fixtures.  Measured headroom: the smallnet fixture
+# tracks within ~1e-3 relative; the budget is deliberately loose enough
+# to stay meaningful across seeds without masking a broken dequant
+# boundary (which shows up as O(1) divergence).
+TRAIN_LOSS_BUDGET = 0.05
+
+
 def dequant_tree(params):
     """Rebuild the float tree: quantized leaves widen at their consuming
     matmul (XLA fuses the convert+scale into the operand read on TPU);

@@ -102,6 +102,13 @@
 #                                        falls back to recompute,
 #                                        kv_handoff counters on every
 #                                        /metrics — one JSON line)
+# 22. quantized prefill + int8 trainer   (int8 flash prefill within the
+#                                        committed logit budget vs the
+#                                        fp32 twin, cache matching Tp
+#                                        sequential steps; 3-step int8
+#                                        weight-streaming trainer loss
+#                                        parity vs its f32 twin — one
+#                                        JSON line)
 set -u
 # make bench.py's exit code distinguish cached-replay-over-failure (rc 4)
 # from a live measurement, so the rc=$? logs below mean what they say
@@ -426,6 +433,19 @@ log "phase 21: disaggregated serving smoke (prefill/decode KV handoff)"
 timeout "$T_SERVE" python -m paddle_tpu.serving.router --smoke-disagg \
     > "$ART/disagg_smoke.json" 2> "$ART/disagg_smoke.log"
 log "disagg smoke rc=$? -> $ART/disagg_smoke.json"
+
+log "phase 22: quantized prefill + int8 trainer smoke (end-to-end low precision)"
+# the int8 flash prefill (pallas_prefill_quant forced ON — interpret
+# mode off-TPU, the real kernel on-chip) against the fp32 prefill twin
+# under the committed logit budget, its int8 cache matching Tp
+# sequential decode steps; then 3 steps of the int8 weight-streaming
+# trainer (SGD(quant_weights=True)) tracking the f32 twin within
+# TRAIN_LOSS_BUDGET — one JSON line
+# (python -m paddle_tpu.serving --smoke-quant-prefill; docs/perf.md
+# "Int8 flash prefill" / "Int8 weight-streaming trainer")
+timeout "$T_SERVE" python -m paddle_tpu.serving --smoke-quant-prefill \
+    > "$ART/quant_prefill_smoke.json" 2> "$ART/quant_prefill_smoke.log"
+log "quant-prefill smoke rc=$? -> $ART/quant_prefill_smoke.json"
 
 cat > "$ART/WINDOW_DONE" <<EOF2
 window completed $(date -u +%Y%m%dT%H%M%SZ) at revision $(git rev-parse --short HEAD 2>/dev/null || echo unknown) (dryrun=$DRY)

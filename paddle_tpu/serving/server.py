@@ -110,6 +110,12 @@ CLI (``python -m paddle_tpu.serving``):
                                    exact vs the quantized oracle,
                                    kv_blocks_total doubled, ONE JSON
                                    line (healthy_window.sh phase 16)
+  --smoke-quant-prefill            end-to-end low-precision self-test:
+                                   int8 flash prefill within the logit
+                                   budget vs the fp32 twin, int8 cache
+                                   bit-exact vs sequential steps, int8
+                                   trainer 3-step loss parity, ONE JSON
+                                   line (healthy_window.sh phase 22)
   --speculate-k K                  speculative decoding: a truncated-
                                    trunk draft proposes K tokens per
                                    slot, the one chunked step scores
@@ -1518,6 +1524,131 @@ def _smoke_quant(args):
     return 0 if passed else 2
 
 
+def _smoke_quant_prefill(args):
+    """End-to-end low-precision self-test (healthy_window.sh phase 22;
+    docs/perf.md "Int8 flash prefill" / "Int8 weight-streaming
+    trainer").  Serving half: the demo trunk's batched causal prefill
+    with ``kv_dtype="int8"`` THROUGH the int8 flash kernel
+    (``pallas_prefill_quant=always`` — interpret mode off-TPU), its
+    logits bounded against the fp32 prefill twin by the COMMITTED
+    budget (quant/kv.logit_err vs LOGIT_ERR_BUDGET, the one comparison
+    every quant surface shares), and the kernel-written cache checked
+    against Tp sequential ``lm_decode_step`` calls — int8 codes
+    BIT-EQUAL, f32 scale sidecars to float-epsilon (layer N>0's scales
+    see layer N-1's kernel output, which is reference-equal only to
+    ~1e-7; tests/test_flash_quant.py holds the per-layer bit-exact
+    claim).  Training half: a 3-step int8 weight-streaming trainer
+    (``SGD(quant_weights=True)``) must track its f32 twin's per-step
+    cost within quant/weights.TRAIN_LOSS_BUDGET with a non-empty int8
+    twin tree.  Prints ONE JSON line; returns the process exit code."""
+    import importlib
+
+    from paddle_tpu.models import transformer
+    from paddle_tpu.quant import kv as quant_kv
+    from paddle_tpu.quant import weights as quant_weights
+    flash = importlib.import_module(
+        "paddle_tpu.ops.pallas.flash_attention")
+
+    b, tp, max_len, heads, vocab = 4, 16, 48, 2, 256
+    params = transformer.init(jax.random.PRNGKey(0), src_vocab=vocab,
+                              trg_vocab=1, d_model=32, num_heads=heads,
+                              dff=64, enc_layers=2, dec_layers=0,
+                              max_len=max_len)
+    rng = np.random.RandomState(0)
+    tokens = jax.numpy.asarray(rng.randint(1, vocab, (b, tp)),
+                               jax.numpy.int32)
+    errs = []
+
+    # ---- int8 flash prefill vs the fp32 twin (eager: the bit-exact
+    # contract is defined eagerly; whole-program jit may reassociate
+    # the scale divide by 1 ulp on any path — tests/test_flash_quant.py)
+    with flash.forced_prefill_quant_mode("always"):
+        h8, cache8 = transformer.lm_prefill(params, tokens, max_len,
+                                            heads, kv_dtype="int8")
+    h32, _ = transformer.lm_prefill(params, tokens, max_len, heads)
+    l8 = transformer._lm_project(params, h8)
+    l32 = transformer._lm_project(params, h32)
+    per_stream = quant_kv.logit_err(l32, l8)
+    max_err = float(per_stream.max())
+    in_budget = int((np.asarray(per_stream)
+                     <= quant_kv.LOGIT_ERR_BUDGET).sum())
+
+    # the kernel-fed cache vs Tp sequential decode steps: bit-equal
+    cache_seq = transformer.init_lm_cache(params, b, max_len,
+                                          kv_dtype="int8",
+                                          num_heads=heads)
+    for t in range(tp):
+        _lg, cache_seq = transformer.lm_decode_step(
+            params, tokens[:, t], t, cache_seq, num_heads=heads)
+    cache_exact = all(
+        bool(np.array_equal(np.asarray(l8_[k])[:, :tp],
+                            np.asarray(ls[k])[:, :tp]))
+        for l8_, ls in zip(cache8, cache_seq)
+        for k in ("k", "v")) and all(
+        bool(np.allclose(np.asarray(l8_[k])[:, :tp],
+                         np.asarray(ls[k])[:, :tp], rtol=1e-6, atol=0))
+        for l8_, ls in zip(cache8, cache_seq)
+        for k in ("ks", "vs"))
+
+    # ---- int8 weight-streaming trainer: 3-step loss parity ----------
+    import paddle_tpu.optim as optim
+    from paddle_tpu.data import DataFeeder, dense_vector, integer_value
+    from paddle_tpu.layers import api as L
+    from paddle_tpu.layers.graph import reset_names
+    from paddle_tpu.trainer.trainer import SGD
+
+    def build(quant):
+        reset_names()
+        x = L.data_layer("qp_x", size=4)
+        lab = L.data_layer("qp_lab", size=1)
+        h = L.fc_layer(input=x, size=16, act="tanh")
+        y = L.fc_layer(input=h, size=2, act="softmax")
+        cost = L.classification_cost(y, lab)
+        return SGD(cost=cost,
+                   update_equation=optim.Momentum(learning_rate=0.1,
+                                                  momentum=0.9),
+                   seed=7, quant_weights=quant, quant_min_size=16)
+
+    loss_gap = qtree_leaves = -1
+    try:
+        tq, tf = build(True), build(False)
+        qtree_leaves = len(tq._qtree)
+        feeder = DataFeeder({"qp_x": dense_vector(4),
+                             "qp_lab": integer_value(2)})
+        trng = np.random.RandomState(1)
+        loss_gap = 0.0
+        for _ in range(3):
+            xs = trng.randn(8, 4).astype(np.float32)
+            ys = (xs[:, 0] > 0).astype(np.int64)
+            batch = [(xs[j], int(ys[j])) for j in range(8)]
+            cq = float(tq.train_one_batch(batch, feeder))
+            cf = float(tf.train_one_batch(batch, feeder))
+            loss_gap = max(loss_gap, abs(cq - cf) / max(abs(cf), 1.0))
+    except Exception as e:    # noqa: BLE001 — the probe must report
+        errs.append(f"trainer: {type(e).__name__}: {e}")
+
+    out = {
+        "metric": "quantized prefill + int8 trainer smoke (int8 flash "
+                  "prefill vs fp32 twin; quant trainer vs f32 twin)",
+        "value": in_budget, "unit": f"streams_in_budget/{b}",
+        "vs_baseline": None,
+        "max_logit_err": round(max_err, 4),
+        "logit_err_budget": quant_kv.LOGIT_ERR_BUDGET,
+        "cache_matches_sequential": bool(cache_exact),
+        "trainer_loss_gap_max": (round(loss_gap, 5)
+                                 if loss_gap >= 0 else None),
+        "train_loss_budget": quant_weights.TRAIN_LOSS_BUDGET,
+        "quant_tree_leaves": qtree_leaves,
+    }
+    if errs:
+        out["errors"] = errs[:5]
+    print(json.dumps(out), flush=True)
+    passed = (not errs and in_budget == b and cache_exact
+              and 0 <= loss_gap <= quant_weights.TRAIN_LOSS_BUDGET
+              and qtree_leaves >= 2)
+    return 0 if passed else 2
+
+
 def _smoke_speculative(args):
     """Speculative-decoding self-test (healthy_window.sh phase 18;
     docs/serving.md "Speculative decoding"): the demo LM behind a
@@ -1829,6 +1960,13 @@ def main(argv=None):
                     help="route the legacy ladder's lm_prefill causal "
                          "pass through the flash kernel (no [Tp, Tp] "
                          "scores): auto (TPU only) | always | off")
+    ap.add_argument("--pallas-prefill-quant",
+                    default=FLAGS.pallas_prefill_quant,
+                    help="int8-cache prefill through the int8 flash "
+                         "kernel (streams the quantized bytes + scale "
+                         "sidecars, no f32 cache widen): auto (TPU "
+                         "only) | always | off — docs/perf.md 'Int8 "
+                         "flash prefill'")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=FLAGS.serving_port)
     ap.add_argument("--port-file",
@@ -1879,6 +2017,13 @@ def main(argv=None):
                          "quality budget, int8-KV+weights engine exact "
                          "vs the quantized oracle, kv_blocks_total "
                          "doubled at equal bytes; one JSON line, exit")
+    ap.add_argument("--smoke-quant-prefill", action="store_true",
+                    help="end-to-end low-precision self-test: int8 "
+                         "flash prefill within the committed logit "
+                         "budget vs the fp32 twin with a bit-exact "
+                         "int8 cache vs sequential steps, plus 3-step "
+                         "int8-trainer loss parity; one JSON line, "
+                         "exit")
     ap.add_argument("--smoke-speculative", action="store_true",
                     help="speculative-decoding self-test: spec engine "
                          "vs a non-spec twin under concurrent clients, "
@@ -1920,6 +2065,7 @@ def main(argv=None):
     # engine is constructed
     FLAGS.pallas_decode = args.pallas_decode
     FLAGS.pallas_prefill = args.pallas_prefill
+    FLAGS.pallas_prefill_quant = args.pallas_prefill_quant
     if args.fault_spec:
         from paddle_tpu.resilience import faults
         faults.install_spec(args.fault_spec)
@@ -1947,6 +2093,8 @@ def main(argv=None):
         return _smoke_chunked(args)
     if args.smoke_quant:
         return _smoke_quant(args)
+    if args.smoke_quant_prefill:
+        return _smoke_quant_prefill(args)
     if args.smoke_speculative:
         return _smoke_speculative(args)
     if args.smoke_sharded:

@@ -487,6 +487,37 @@ def _decode_paged_chunk_int8_case(tol=1e-4):
     return err
 
 
+def _flash_int8_case(tol=1e-4):
+    """Int8 flash prefill kernel (flash_attention_quant): int8 K/V with
+    their per-(position, head) scale sidecars riding the same
+    block-indexed stream, widened in registers, vs the dequantize-then-
+    attend oracle — GQA width, causal, multi-position.  Note the
+    compiled backend wants 32-sublane int8 k-tiles: t is a multiple of
+    32 (interpret mode relaxes to 8)."""
+    import importlib
+    from paddle_tpu.models import transformer
+    from paddle_tpu.quant import kv as kvq
+    fa = importlib.import_module(
+        "paddle_tpu.ops.pallas.flash_attention")
+
+    b, h, hkv, dh, t = 2, 8, 2, 128, 256
+    d, dkv = h * dh, hkv * dh
+    rng = np.random.RandomState(51)
+    q = jnp.asarray(rng.randn(b, t, d) * 0.5, jnp.float32)
+    qk, sk = _quantize_kv((b, t, dkv), hkv, seed=9)
+    qv, sv = _quantize_kv((b, t, dkv), hkv, seed=10)
+    out = jax.jit(lambda q, k, v, ks, vs: fa.flash_attention_quant(
+        q, k, v, ks, vs, h, causal=True))(q, qk, qv, sk, sv)
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
+    pm = jnp.asarray(np.tril(np.ones((t, t), bool)))[None]
+    want = transformer._attend(q, kvq.dequantize_heads(qk, sk),
+                               kvq.dequantize_heads(qv, sv), h,
+                               jnp.broadcast_to(pm, (b, t, t)))
+    err = _max_err(out, want)
+    assert err <= tol, f"flash_int8 max err {err:.3e} > tol {tol}"
+    return err
+
+
 CASES = {
     "lstm_fused": lambda: _rnn_case("lstm"),
     "lstm_blocked": _lstm_blocked_case,
@@ -494,6 +525,7 @@ CASES = {
     "simple_rnn_fused": lambda: _rnn_case("simple_rnn"),
     "flash_attention": lambda: _flash_case(causal=False),
     "flash_attention_causal": lambda: _flash_case(causal=True),
+    "flash_attention_int8": _flash_int8_case,
     "decode_attention_slab": _decode_slab_case,
     "decode_attention_paged": _decode_paged_case,
     "decode_attention_slab_chunk": _decode_slab_chunk_case,

@@ -177,6 +177,13 @@ def main(argv=None):
                    help="sum grads over N micro-batches, apply their mean "
                         "every Nth step (large effective batch in fixed "
                         "HBM)")
+    t.add_argument("--quant-train", dest="quant_train",
+                   action="store_true",
+                   help="int8 weight-streaming training: the jitted step "
+                        "reads per-out-channel int8 weights + f32 scale "
+                        "sidecars at the matmul boundary, f32 masters "
+                        "update optimizer-side and requantize each step; "
+                        "checkpoints carry both trees (quant_train flag)")
     t.add_argument("--save_dir", default=None)
     t.add_argument("--saving_period", type=int, default=1)
     t.add_argument("--save_only_one", action="store_true")
@@ -345,13 +352,19 @@ def main(argv=None):
         # via SGD(compute_dtype=...) so HBM reads are half-width
         from paddle_tpu.core import dtypes as _dtypes
         _dtypes.set_policy(compute_dtype=args.dtype)
+    from paddle_tpu.utils.flags import FLAGS
+    quant_train = bool(getattr(args, "quant_train", False)
+                       or getattr(FLAGS, "quant_train", False))
+    if quant_train:
+        FLAGS.quant_train = True
     trainer = SGD(cost=cfg["cost"], update_equation=optimizer,
                   mesh=mesh,
                   sharding_rules=cfg.get("sharding_rules"),
                   evaluators=cfg.get("evaluators"),
                   compute_dtype=(jnp.bfloat16
                                  if args.dtype == "bfloat16" else None),
-                  grad_accum_steps=getattr(args, "grad_accum_steps", 1))
+                  grad_accum_steps=getattr(args, "grad_accum_steps", 1),
+                  quant_weights=quant_train)
 
     if args.job == "train":
         save_dir = args.save_dir or cfg.get("save_dir")

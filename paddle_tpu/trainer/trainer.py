@@ -78,7 +78,8 @@ class SGD:
     def __init__(self, cost, parameters=None, update_equation=None,
                  extra_layers=None, is_local=True, mesh=None,
                  sharding_rules=None, seed=1, donate=True, evaluators=None,
-                 compute_dtype=None, grad_accum_steps=1):
+                 compute_dtype=None, grad_accum_steps=1,
+                 quant_weights=False, quant_min_size=1024):
         self.costs = cost if isinstance(cost, (list, tuple)) else [cost]
         self.extra_layers = list(extra_layers or [])
         # evaluator specs (evaluators.dsl): fetch their bound layers as
@@ -140,6 +141,37 @@ class SGD:
             raise ConfigError(
                 "grad_accum_steps > 1 is unsupported with sparse_update "
                 "embeddings (touched-row sets differ per micro-batch)")
+        # int8 weight-streaming training (quant/weights.py, the serving
+        # quant_weights scheme turned on the train step): the jitted
+        # step is fed {"master": f32 tree, "q": int8+scale tree},
+        # forward/backward run over the dequantized view (widening fuses
+        # into each matmul's operand read), the optimizer updates the
+        # f32 masters and the step requantizes them before returning —
+        # so between steps the weight STREAM the forward pass reads is
+        # int8 bytes + scale sidecars, and the f32 masters are touched
+        # once, optimizer-side.  Deterministic requantization is what
+        # makes kill-9 resume bit-identical.
+        self._quant = bool(quant_weights)
+        self._quant_min_size = int(quant_min_size)
+        if self._quant:
+            if self._sparse_specs:
+                raise ConfigError(
+                    "quant_weights=True is unsupported with sparse_update "
+                    "embeddings (row-sliced tables have no per-out-channel "
+                    "scale home)")
+            if mesh is not None:
+                raise ConfigError(
+                    "quant_weights=True is single-chip for now (sharding "
+                    "the int8+scale pair tree is the named residual)")
+            if self.grad_accum_steps > 1:
+                raise ConfigError(
+                    "quant_weights=True with grad_accum_steps > 1 is "
+                    "unsupported (the held-grads window would read stale "
+                    "quantized weights)")
+            if self.compute_dtype is not None:
+                raise ConfigError(
+                    "quant_weights=True already streams int8 weights; "
+                    "combining it with compute_dtype is unsupported")
         dense_params = {k: v for k, v in self.parameters.items()
                         if k not in self._sparse_specs}
         self.opt_state = self.optimizer.init(dense_params) \
@@ -186,6 +218,14 @@ class SGD:
                 self.parameters = self._globalize(self.parameters, ps)
             else:
                 self.parameters = shard_params(self.parameters, mesh, rules)
+        # the int8 twin of self.parameters: ONLY the quantized leaves
+        # (masters carry the small f32 leaves — duplicating them in the
+        # bundle would donate the same buffer twice).  Always the
+        # masters' deterministic requantization; rebuilt by the step
+        # every update.
+        self._qtree = None
+        if self._quant:
+            self._qtree = self._requant(self.parameters)
         self._step_fn = None
         self._eval_fn = None
         self._gather_cache = {}   # jitted replicate-gathers (save path)
@@ -394,7 +434,43 @@ class SGD:
             return (new_params, {"dense": new_dstate, "sparse": new_sparse},
                     merged_state, loss, extras)
 
-        base_step = sparse_step if specs else dense_step
+        def quant_step(params, opt_state, state, feed, rng):
+            """The int8 weight-streaming step: params is the {"master",
+            "q"} bundle — q holds the int8+scale pairs for the big 2-D
+            weights, master the f32 tree.  Forward/backward
+            differentiate the DEQUANTIZED view (straight-through: the
+            int8 grid is piecewise-constant, so grads at the dequantized
+            values are the estimator — the mixed-precision master-weight
+            recipe with int8 in place of bf16); the optimizer applies
+            them to the f32 masters and the new masters requantize
+            IN-step, so the returned bundle is self-consistent and
+            checkpoint/resume carries both trees."""
+            from paddle_tpu.quant import weights as qw
+            from jax.tree_util import keystr, tree_map_with_path
+            masters, qtree = params["master"], params["q"]
+            # forward tree: the dequantized int8 view overlaid on the
+            # masters' small f32 leaves (biases/norms — their bytes are
+            # noise; this is what keeps the weight STREAM int8)
+            fwd = tree_map_with_path(
+                lambda path, x: qw.dequantize_leaf(qtree[keystr(path)])
+                if keystr(path) in qtree else x, masters)
+            (loss, (new_state, extras)), grads = jax.value_and_grad(
+                self._loss_and_extras, has_aux=True)(fwd, state, feed, rng)
+            if prune_masks:
+                grads = param_hooks.apply_masks(grads, prune_masks)
+            new_masters, new_opt = self.optimizer.update(
+                grads, opt_state, masters)
+            new_q = {}
+            tree_map_with_path(
+                lambda path, x: new_q.update(
+                    {keystr(path): qw.quantize_leaf(x)})
+                if keystr(path) in qtree else x, new_masters)
+            merged_state = {**state, **new_state}
+            return ({"master": new_masters, "q": new_q}, new_opt,
+                    merged_state, loss, extras)
+
+        base_step = quant_step if self._quant else (
+            sparse_step if specs else dense_step)
 
         def step(params, opt_state, state, feed, rng):
             # Python body runs only under tracing: this is the trace-count
@@ -519,8 +595,45 @@ class SGD:
             self._build_step(feed)
         rng_spec = jax.ShapeDtypeStruct(np.shape(self.rng), self.rng.dtype)
         return self._step_fn.lower(
-            self.parameters, self.opt_state, self.model_state, feed,
+            self._step_params(), self.opt_state, self.model_state, feed,
             rng_spec)
+
+    def _requant(self, params):
+        """The masters' int8 twin: quantize every eligible 2-D f32
+        weight (quant/weights.quantize_tree's predicate) into a
+        path-keyed flat dict {tree path: {"q", "s"}} — ONLY the
+        quantized leaves (the bundle must not duplicate the small f32
+        leaves, or the step would donate the same buffer twice).
+        Deterministic, so rebuilding it from loaded masters is
+        bit-exact."""
+        from paddle_tpu.quant import weights as qw
+        from jax.tree_util import keystr, tree_map_with_path
+        out = {}
+
+        def visit(path, x):
+            q = qw.quantize_tree(x, min_size=self._quant_min_size)
+            if qw.is_quantized_leaf(q):
+                out[keystr(path)] = q
+            return x
+
+        tree_map_with_path(visit, params)
+        return out
+
+    def _step_params(self):
+        """The jitted step's first operand: the plain params tree, or —
+        in quant_weights mode — the {"master": f32, "q": int8+scale}
+        bundle (both donated together)."""
+        if self._quant:
+            return {"master": self.parameters, "q": self._qtree}
+        return self.parameters
+
+    def _absorb_step_params(self, p):
+        """Unpack what the step returned back into self.parameters (+
+        the int8 twin in quant mode) — `_step_params`' inverse."""
+        if self._quant:
+            self.parameters, self._qtree = p["master"], p["q"]
+        else:
+            self.parameters = p
 
     def _dispatch_step(self, feed):
         """The executable for this feed shape: a precompiled bucket
@@ -800,10 +913,11 @@ class SGD:
                                 pass_id=pass_id, batch=batch_id,
                                 h2d_wait_ms=round(h2d_dt * 1e3, 3)), \
                                 timer("train_step"):
-                            (self.parameters, self.opt_state, self.model_state,
+                            (new_p, self.opt_state, self.model_state,
                              cost, extras) = step_fn(
-                                self.parameters, self.opt_state, self.model_state,
-                                feed, step_rng)
+                                self._step_params(), self.opt_state,
+                                self.model_state, feed, step_rng)
+                            self._absorb_step_params(new_p)
                         # per-step distribution (BarrierStat skew-profiling role):
                         # record this step's own delta, not the cumulative timer
                         from paddle_tpu.utils.stats import step_histogram
@@ -970,10 +1084,11 @@ class SGD:
         if self._step_fn is None:
             self._build_step(feed)
         feed, step_rng = self._globalize_step_inputs(feed, step_rng)
-        (self.parameters, self.opt_state, self.model_state,
+        (new_p, self.opt_state, self.model_state,
          cost, _extras) = self._dispatch_step(feed)(
-            self.parameters, self.opt_state, self.model_state,
+            self._step_params(), self.opt_state, self.model_state,
             feed, step_rng)
+        self._absorb_step_params(new_p)
         return cost
 
     # ------------------------------------------------------------ test
@@ -1015,6 +1130,13 @@ class SGD:
     def save(self, save_dir, pass_id=0, save_only_one=False, block=True,
              extra=None):
         params, opt_state = self.parameters, self.opt_state
+        if self._quant and self._qtree:
+            # checkpoint BOTH trees (kill-9 resume must be
+            # bit-identical; requantizing on load would also be exact —
+            # quantize_tree is deterministic — but carrying the int8
+            # twin keeps the resumed step operand byte-equal by
+            # construction, no recompute in the restore path)
+            params = {"master": self.parameters, "q": self._qtree}
         if self._multiprocess:
             block = True    # the barrier promise needs the file on disk
             # model-sharded leaves are not process-0-addressable: gather to
@@ -1058,7 +1180,21 @@ class SGD:
 
     def load(self, save_dir, pass_id=None):
         params, opt_state, model_state, meta = load_checkpoint(save_dir, pass_id)
-        self.parameters = params
+        bundled = isinstance(params, dict) and set(params) == {"master", "q"}
+        if self._quant:
+            if bundled:
+                self.parameters, self._qtree = params["master"], params["q"]
+            else:
+                # plain (f32) checkpoint into a quant trainer: adopt the
+                # masters and requantize deterministically
+                self.parameters = params
+                self._qtree = self._requant(params)
+        elif bundled:
+            # quant checkpoint into a plain trainer: the masters ARE the
+            # f32 params; the int8 twin is dropped
+            self.parameters = params["master"]
+        else:
+            self.parameters = params
         if opt_state is not None:
             opt_state = self._adapt_accum_state(opt_state, meta)
             self.opt_state = opt_state

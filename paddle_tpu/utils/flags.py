@@ -136,6 +136,10 @@ class Flags:
     #                                     block count at equal bytes)
     quant_weights: bool = False         # serve per-channel int8 trunk
     #                                     weights (quant/weights.py)
+    quant_train: bool = False           # int8 weight-streaming train
+    #                                     step (trainer quant_weights
+    #                                     mode: f32 masters optimizer-
+    #                                     side, requantize after update)
     # ---- unified chunked prefill (decode_engine.py prefill_chunk:
     # prompt ingestion folded into the ONE jitted decode step as K-lane
     # chunks; docs/serving.md "Chunked prefill").  The serving CLI
@@ -172,6 +176,11 @@ class Flags:
     #                                     causal pass through the flash
     #                                     kernel (no [Tp, Tp] scores):
     #                                     auto (TPU only) | always | off
+    pallas_prefill_quant: str = "auto"  # int8 caches: stream the int8
+    #                                     bytes + scale sidecars through
+    #                                     flash_attention_quant (no f32
+    #                                     widened K/V): auto | always |
+    #                                     off
     # ---- replicated serving tier (serving/fleet.py supervisor +
     # serving/router.py health-checked router; docs/serving.md §7)
     router_port: int = 8000             # HTTP port for the router CLI
@@ -471,6 +480,13 @@ FLAG_DOCS = {
                       "(quant/weights.py): int8 data + f32 scale "
                       "sidecars are what stays resident; dequant fuses "
                       "into each consuming matmul's operand read", "—"),
+    "quant_train": ("int8 weight-streaming training step (trainer "
+                    "quant_weights mode): the jitted step is fed the "
+                    "{q: int8, s: f32} tree and dequantizes at the "
+                    "matmul boundary; f32 master weights live on the "
+                    "optimizer side and re-quantize after each update.  "
+                    "Checkpoints carry both trees and resume "
+                    "bit-identically", "—"),
     "serving_prefill_chunk": ("unified chunked prefill: prompt "
                               "ingestion rides the ONE jitted decode "
                               "step as up-to-K-token chunks per slot "
@@ -528,6 +544,16 @@ FLAG_DOCS = {
                        "preserving bit-identity discipline), always = "
                        "force (interpret off-TPU), off.  Read at trace "
                        "time", "—"),
+    "pallas_prefill_quant": ("int8 caches: stream the just-quantized "
+                             "int8 K/V bytes + per-(position, head) "
+                             "scale sidecars straight through "
+                             "flash_attention_quant, widening in "
+                             "registers — no dequantized f32 [Tp, Dkv] "
+                             "buffer in the prefill program (the "
+                             "analytic postcheck pins its absence): "
+                             "auto = TPU only, always = force "
+                             "(interpret off-TPU), off.  Read at trace "
+                             "time", "—"),
     "router_port": ("HTTP port for python -m paddle_tpu.serving.router",
                     "—"),
     "router_poll_interval_s": ("how often the router polls each "

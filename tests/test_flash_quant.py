@@ -1,0 +1,258 @@
+"""Int8 flash prefill (ops/pallas/flash_attention.flash_attention_quant;
+docs/serving.md "Quantized serving"): the kernel pinned bit-exactly
+against flash over the dequantized widened twin (same blocks = identical
+summation order), against the XLA reference within float tolerance, the
+dispatch/coverage/validation surface, the lm_prefill routing's cache
+bit-exactness to the sequential-step round trip, and the perf/analytic
+widened-prefill structural gate in both directions."""
+
+import importlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import transformer
+from paddle_tpu.ops.attention import dot_product_attention, repeat_kv_heads
+from paddle_tpu.quant import kv as kvq
+from paddle_tpu.quant import weights as qw
+
+fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+
+V, D, HEADS, LAYERS, MAXLEN = 64, 32, 2, 2, 48
+
+
+def _trunk(seed=0):
+    return transformer.init(jax.random.PRNGKey(seed), src_vocab=V,
+                            trg_vocab=1, d_model=D, num_heads=HEADS,
+                            dff=64, enc_layers=LAYERS, dec_layers=0,
+                            max_len=MAXLEN)
+
+
+def _case(seed, b, heads, hkv, tq, dh):
+    rng = np.random.RandomState(seed)
+    d, dkv = heads * dh, hkv * dh
+    q = jnp.asarray(rng.randn(b, tq, d).astype(np.float32))
+    qk, sk = kvq.quantize_heads(
+        jnp.asarray(rng.randn(b, tq, dkv).astype(np.float32)), hkv)
+    qv, sv = kvq.quantize_heads(
+        jnp.asarray(rng.randn(b, tq, dkv).astype(np.float32)), hkv)
+    return q, qk, qv, sk, sv
+
+
+def _widened_bhtd(q, qk, qv, sk, sv, heads):
+    """The dequantized [B, H, T, dh] twin of the kernel's int8 inputs."""
+    b, tq, d = q.shape
+    hkv = sk.shape[-1]
+    split = lambda a, hh: a.reshape(b, tq, hh, -1).transpose(0, 2, 1, 3)
+    kw = kvq.dequantize_heads(qk, sk)
+    vw = kvq.dequantize_heads(qv, sv)
+    return (split(q, heads),
+            repeat_kv_heads(split(kw, hkv), heads),
+            repeat_kv_heads(split(vw, hkv), heads))
+
+
+# ------------------------------------------------------- kernel oracle
+
+def test_quant_kernel_bit_exact_vs_dequant_flash_oracle():
+    """The acceptance oracle: flash_attention_quant vs flash_attention
+    over the dequantized widened K/V with the SAME block sizes — the
+    in-register widen is the exact dequantize_heads product and the
+    blocks impose identical summation order, so the outputs agree to
+    1e-7 (bit-exact in practice)."""
+    q, qk, qv, sk, sv = _case(0, b=2, heads=2, hkv=2, tq=32, dh=16)
+    out = fa.flash_attention_quant(q, qk, qv, sk, sv, 2, causal=True,
+                                   interpret=True)
+    qh, kh, vh = _widened_bhtd(q, qk, qv, sk, sv, 2)
+    want = fa.flash_attention(qh, kh, vh, causal=True, interpret=True)
+    err = float(jnp.abs(out - want).max())
+    assert err <= 1e-7, err
+
+
+def test_quant_kernel_matches_xla_reference():
+    q, qk, qv, sk, sv = _case(1, b=2, heads=2, hkv=2, tq=32, dh=16)
+    out = fa.flash_attention_quant(q, qk, qv, sk, sv, 2, causal=True,
+                                   interpret=True)
+    qh, kh, vh = _widened_bhtd(q, qk, qv, sk, sv, 2)
+    want = dot_product_attention(qh, kh, vh, causal=True,
+                                 use_flash=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_quant_kernel_gqa_group_reads_kv_head_stripe():
+    """heads=4 over hkv=2: each query head's BlockSpec index map selects
+    its KV head's dh-column stripe from the FLAT [B, Tk, Dkv] cache —
+    no repeat_kv_heads materialization feeds the kernel."""
+    q, qk, qv, sk, sv = _case(2, b=1, heads=4, hkv=2, tq=32, dh=16)
+    out = fa.flash_attention_quant(q, qk, qv, sk, sv, 4, causal=True,
+                                   interpret=True)
+    qh, kh, vh = _widened_bhtd(q, qk, qv, sk, sv, 4)
+    want = fa.flash_attention(qh, kh, vh, causal=True, interpret=True)
+    err = float(jnp.abs(out - want).max())
+    assert err <= 1e-7, err
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("b,heads,hkv,tq,dh,blk", [
+    (1, 2, 2, 64, 16, 512),      # single kv head group, one block
+    (2, 4, 1, 64, 32, 32),       # MQA: every head reads the one stripe
+    (2, 8, 2, 128, 64, 64),      # multi-block q AND k loops
+    (1, 2, 2, 96, 128, 48),      # lane-width dh, non-power-of-2 blocks
+])
+def test_quant_kernel_grid(b, heads, hkv, tq, dh, blk):
+    """The blocking/GQA grid — every (multi-block, group, dh) corner
+    stays on the 1e-7 oracle."""
+    q, qk, qv, sk, sv = _case(b + heads + tq, b, heads, hkv, tq, dh)
+    out = fa.flash_attention_quant(q, qk, qv, sk, sv, heads,
+                                   causal=True, block_q=blk,
+                                   block_k=blk, interpret=True)
+    qh, kh, vh = _widened_bhtd(q, qk, qv, sk, sv, heads)
+    want = fa.flash_attention(qh, kh, vh, causal=True, block_q=blk,
+                              block_k=blk, interpret=True)
+    err = float(jnp.abs(out - want).max())
+    assert err <= 1e-7, err
+
+
+# -------------------------------------------- validation + dispatch
+
+def test_quant_kernel_validation():
+    q, qk, qv, sk, sv = _case(3, b=1, heads=2, hkv=2, tq=16, dh=16)
+    with pytest.raises(ValueError):        # f32 K/V is the caller's bug
+        fa.flash_attention_quant(q, kvq.dequantize_heads(qk, sk), qv,
+                                 sk, sv, 2, interpret=True)
+    with pytest.raises(ValueError):        # missing sidecars
+        fa.flash_attention_quant(q, qk, qv, None, None, 2,
+                                 interpret=True)
+    with pytest.raises(ValueError):        # d/dkv not a head layout
+        fa.flash_attention_quant(q, qk[..., :24], qv[..., :24],
+                                 sk, sv, 2, interpret=True)
+    with pytest.raises(ValueError):        # causal needs tq == tk
+        fa.flash_attention_quant(q[:, :8], qk, qv, sk, sv, 2,
+                                 causal=True, interpret=True)
+
+
+def test_prefill_quant_covers():
+    assert fa.prefill_quant_covers(1, 32, 32, 32, 32, 2, True)
+    assert not fa.prefill_quant_covers(1, 32, 16, 32, 32, 2, True)
+    assert not fa.prefill_quant_covers(1, 12, 12, 32, 32, 2, True)
+    assert not fa.prefill_quant_covers(1, 32, 32, 32, 24, 2, True)
+
+
+def test_maybe_prefill_quant_dispatch():
+    q, qk, qv, sk, sv = _case(4, b=1, heads=2, hkv=2, tq=16, dh=16)
+    with fa.forced_prefill_quant_mode("off"):
+        assert fa.maybe_prefill_quant(q, qk, qv, sk, sv, 2) is None
+    with fa.forced_prefill_quant_mode("always"):
+        # a float cache (no sidecars) never routes here
+        assert fa.maybe_prefill_quant(q, qk, qv, None, None, 2) is None
+        out = fa.maybe_prefill_quant(q, qk, qv, sk, sv, 2)
+    assert out is not None and out.shape == q.shape
+    qh, kh, vh = _widened_bhtd(q, qk, qv, sk, sv, 2)
+    want = fa.flash_attention(qh, kh, vh, causal=True, interpret=True)
+    b, tq, d = q.shape
+    want = want.transpose(0, 2, 1, 3).reshape(b, tq, d)
+    assert float(jnp.abs(out - want).max()) <= 1e-7
+    # uncoverable shape: fall back (Tp=12 has no sublane block)
+    with fa.forced_prefill_quant_mode("always"):
+        assert fa.maybe_prefill_quant(q[:, :12], qk[:, :12], qv[:, :12],
+                                      sk[:, :12], sv[:, :12], 2) is None
+
+
+def test_prefill_quant_mode_parsing():
+    with fa.forced_prefill_quant_mode("off"):
+        assert not fa.prefill_quant_enabled()
+    with fa.forced_prefill_quant_mode("always"):
+        assert fa.prefill_quant_enabled()
+    with fa.forced_prefill_quant_mode("bogus"):
+        with pytest.raises(ValueError):
+            fa.prefill_quant_enabled()
+    # the tier-1 default: auto follows use_pallas() — off on CPU, so
+    # the reference path keeps the batched-vs-sequential bit-exactness
+    with fa.forced_prefill_quant_mode("auto"):
+        from paddle_tpu.ops import pallas as pk
+        assert fa.prefill_quant_enabled() == pk.use_pallas()
+
+
+# ------------------------------------------------ lm_prefill routing
+
+def test_lm_prefill_quant_cache_bit_exact_to_sequential_steps():
+    """The ingestion-order invariant EXTENDED to the kernel path: with
+    the quant kernel forced ON, lm_prefill's int8 cache (values AND
+    sidecar scales) stays bit-identical to the sequential-step round
+    trip — the quantize math feeding the cache is untouched by how
+    attention reads it back.  (Eager like the reference-path twin in
+    test_quant.py: whole-program jit may reassociate the scale divide
+    by 1 ulp on ANY attention path — that is jit fusion, not the
+    kernel, and the int8 values stay bit-exact either way.)"""
+    params = _trunk()
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(1, V, (1, 8)).astype(np.int32)
+    with fa.forced_prefill_quant_mode("always"):
+        _h, cache = transformer.lm_prefill(params, prompt, MAXLEN,
+                                           HEADS, kv_dtype="int8")
+    cache2 = transformer.init_lm_cache(params, 1, MAXLEN,
+                                       kv_dtype="int8", num_heads=HEADS)
+    for t in range(prompt.shape[1]):
+        _l, cache2 = transformer.lm_decode_step(params, prompt[:, t], t,
+                                                cache2, HEADS)
+    tp = prompt.shape[1]
+    for key in ("k", "v", "ks", "vs"):
+        np.testing.assert_array_equal(
+            np.asarray(cache[0][key])[:, :tp],
+            np.asarray(cache2[0][key])[:, :tp])
+
+
+def test_lm_prefill_quant_kernel_matches_reference_path():
+    """Kernel ON vs kernel OFF over the SAME int8 cache: the hidden
+    states agree to float tolerance and the caches bit-exactly."""
+    params = _trunk(1)
+    rng = np.random.RandomState(6)
+    prompt = rng.randint(1, V, (2, 16)).astype(np.int32)
+
+    def prefill(mode):
+        with fa.forced_prefill_quant_mode(mode):
+            return jax.jit(lambda p, t: transformer.lm_prefill(
+                p, t, MAXLEN, HEADS, kv_dtype="int8"))(params, prompt)
+
+    h_on, c_on = prefill("always")
+    h_off, c_off = prefill("off")
+    assert float(jnp.abs(h_on - h_off).max()) <= 1e-4
+    for key in ("k", "v", "ks", "vs"):
+        np.testing.assert_array_equal(np.asarray(c_on[0][key]),
+                                      np.asarray(c_off[0][key]))
+
+
+# ------------------------------------------------------ analytic gates
+
+def test_analytic_prefill_gates_both_directions():
+    """assert_prefill_kv_quantized passes on the kernel-forced int8
+    prefill and FIRES on the dequant twin (>= 2 widen converts per
+    layer: K and V) — plus the predicted-prefill-bytes model clears the
+    35% acceptance bar."""
+    from paddle_tpu.perf import analytic as pa
+    params = _trunk()
+    qp = qw.quantize_lm(params, min_size=512)
+    b, tp = 2, 16
+    prompt = np.random.RandomState(0).randint(
+        1, V, (b, tp)).astype(np.int32)
+    dkv = qw.weight_shape(params["enc"][0]["attn"]["wk"])[1]
+
+    def staged(mode):
+        with fa.forced_prefill_quant_mode(mode):
+            def fn(p, toks):
+                return transformer.lm_prefill(p, toks, MAXLEN, HEADS,
+                                              kv_dtype="int8")
+            return jax.jit(fn).lower(qp, prompt).compile().as_text()
+
+    pa.assert_prefill_kv_quantized(staged("always"), b, tp, dkv)
+    twin = staged("off")
+    with pytest.raises(AssertionError):
+        pa.assert_prefill_kv_quantized(twin, b, tp, dkv)
+    assert len(pa.widened_prefill_kv_instrs(twin, b, tp, dkv)) \
+        >= 2 * LAYERS
+    b_f32 = pa.predicted_prefill_bytes(params, b, tp, HEADS)
+    b_i8 = pa.predicted_prefill_bytes(qp, b, tp, HEADS, "int8")
+    assert 1 - b_i8 / b_f32 >= 0.35

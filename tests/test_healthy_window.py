@@ -53,7 +53,8 @@ def test_dryrun_executes_every_phase(tmp_path):
                  "chunked_smoke.json", "quant_smoke.json",
                  "analysis_gate.json", "spec_smoke.json",
                  "sharded_smoke.json", "spill_smoke.json",
-                 "disagg_smoke.json", "WINDOW_DONE"):
+                 "disagg_smoke.json", "quant_prefill_smoke.json",
+                 "WINDOW_DONE"):
         assert (art / name).exists(), f"{name} missing; log tail:\n" \
             + log[-4000:]
 
@@ -235,6 +236,20 @@ def test_dryrun_executes_every_phase(tmp_path):
     assert dsg["router_handoffs"]["fallback"] >= 1, dsg
     assert dsg["kill_fallback_outcome"]["outcome"] == "fallback", dsg
     assert dsg["post_kill_stream_ok"] is True, dsg
+    # the quant-prefill smoke really went low-precision end to end:
+    # every stream of the int8 flash prefill inside the committed logit
+    # budget vs the fp32 twin, the kernel-fed int8 cache matching the
+    # sequential-step round trip, and the int8 weight-streaming trainer
+    # tracking its f32 twin within the committed training budget with a
+    # non-empty int8 tree
+    qpf = json.loads((art / "quant_prefill_smoke.json").read_text())
+    assert qpf["value"] == int(qpf["unit"].split("/")[1]), qpf
+    assert qpf["max_logit_err"] <= qpf["logit_err_budget"], qpf
+    assert qpf["cache_matches_sequential"] is True, qpf
+    assert qpf["trainer_loss_gap_max"] is not None, qpf
+    assert qpf["trainer_loss_gap_max"] <= qpf["train_loss_budget"], qpf
+    assert qpf["quant_tree_leaves"] >= 2, qpf
+    assert "errors" not in qpf, qpf
     assert "dryrun=1" in (art / "WINDOW_DONE").read_text()
 
     # a dry run must never rewrite the committed perf artifacts (cpu rows

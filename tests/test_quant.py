@@ -174,7 +174,7 @@ def test_quality_budget_greedy_prefix_and_logits(seed):
             h, _ = transformer.lm_prefill(p, prompt[None], MAXLEN,
                                           HEADS, kv_dtype=kvd)
             lq = transformer._lm_project(p, h)
-            err = float(jnp.abs(l32 - lq).max())
+            err = float(kvq.logit_err(l32, lq).max())
             assert err <= kvq.LOGIT_ERR_BUDGET, err
 
 
